@@ -1,0 +1,17 @@
+"""TPU-native inference router + engine.
+
+Two halves, mirroring the deployment topology of the reference
+(llm-d/llm-d-inference-scheduler, see SURVEY.md):
+
+- the *router* half: an Endpoint-Picker (EPP) control plane — request handlers,
+  scheduler (profiles/filters/scorers/pickers), data layer, flow control, and a
+  prefill/decode disaggregation sidecar. The reference implements this in Go
+  against vLLM/GPU backends; here it is implemented TPU-first against
+  JetStream-style engines.
+- the *engine* half: a JAX/XLA continuous-batching model server (paged KV cache
+  on HBM, pjit-sharded models over a jax.sharding.Mesh, ring attention for
+  sequence parallelism) that the reference delegates to vLLM and therefore does
+  not contain. It is required here so the full serving path is TPU-native.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,4 @@
+from .configs import ModelConfig, get_config, LLAMA3_8B, LLAMA3_70B, TINY
+from . import llama
+
+__all__ = ["ModelConfig", "get_config", "LLAMA3_8B", "LLAMA3_70B", "TINY", "llama"]

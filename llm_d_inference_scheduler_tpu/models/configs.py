@@ -1,0 +1,90 @@
+"""Model architecture configs for the engine half.
+
+The reference router schedules onto external vLLM servers and has no model code;
+these configs define the TPU-native engines that replace them (SURVEY.md §7).
+Dimensions follow the public Llama-3 architecture card.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    name: str
+    vocab_size: int
+    d_model: int
+    n_layers: int
+    n_heads: int
+    n_kv_heads: int
+    d_ff: int
+    rope_theta: float = 500_000.0
+    max_seq_len: int = 8192
+    norm_eps: float = 1e-5
+    dtype: str = "bfloat16"
+    # Paged KV cache geometry (engine half).
+    kv_block_size: int = 16
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def q_per_kv(self) -> int:
+        return self.n_heads // self.n_kv_heads
+
+
+LLAMA3_8B = ModelConfig(
+    name="llama3-8b",
+    vocab_size=128_256,
+    d_model=4096,
+    n_layers=32,
+    n_heads=32,
+    n_kv_heads=8,
+    d_ff=14336,
+)
+
+LLAMA3_70B = ModelConfig(
+    name="llama3-70b",
+    vocab_size=128_256,
+    d_model=8192,
+    n_layers=80,
+    n_heads=64,
+    n_kv_heads=8,
+    d_ff=28672,
+)
+
+# Small config used for CI tests, compile checks, and the single-chip dry run.
+TINY = ModelConfig(
+    name="tiny",
+    vocab_size=512,
+    d_model=128,
+    n_layers=2,
+    n_heads=4,
+    n_kv_heads=2,
+    d_ff=256,
+    max_seq_len=256,
+    rope_theta=10_000.0,
+)
+
+# Mid-size config for single-chip benchmarking when full 8B weights are not
+# materialisable (random-init bench still exercises the same kernels/layout).
+LLAMA3_1B = ModelConfig(
+    name="llama3-1b",
+    vocab_size=128_256,
+    d_model=2048,
+    n_layers=16,
+    n_heads=32,
+    n_kv_heads=8,
+    d_ff=8192,
+)
+
+_REGISTRY = {c.name: c for c in (LLAMA3_8B, LLAMA3_70B, LLAMA3_1B, TINY)}
+
+
+def get_config(name: str) -> ModelConfig:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise ValueError(f"unknown model config {name!r}; have {sorted(_REGISTRY)}") from None

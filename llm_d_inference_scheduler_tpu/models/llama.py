@@ -1,0 +1,202 @@
+"""Functional JAX Llama-family model for the TPU engine half.
+
+The reference router has no model code (it schedules onto external vLLM pods —
+SURVEY.md preamble); this module provides the TPU-native engine it routes to.
+
+Design notes (TPU-first):
+- Parameters are a plain pytree with layer weights STACKED on a leading axis so
+  the training/prefill path runs ``lax.scan`` over layers: one traced layer
+  body, L-step loop — fast compiles, XLA-friendly.
+- The decode path is an unrolled layer loop over the same stacked params
+  (static slice per layer) so each layer's paged KV cache can be updated with
+  ``dynamic_update``-style scatters and donated for in-place HBM updates.
+- All matmuls run in the params' dtype (bf16 by default) with f32 softmax/norm
+  accumulation; logits are f32.
+- Attention is injected via ``attention_fn`` so the sequence-parallel path can
+  substitute a ring-attention shard_map without changing the model.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import apply_rope, causal_attention, paged_decode_attention, rms_norm, rope_table
+from .configs import ModelConfig
+
+Params = dict[str, Any]
+
+
+def init_params(cfg: ModelConfig, key: jax.Array, dtype: jnp.dtype | None = None) -> Params:
+    """Random-init parameters (stacked-layer layout)."""
+    dtype = dtype or jnp.dtype(cfg.dtype)
+    L, D, F, V = cfg.n_layers, cfg.d_model, cfg.d_ff, cfg.vocab_size
+    Hq, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    ks = jax.random.split(key, 9)
+
+    def w(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32) * (fan_in ** -0.5)).astype(dtype)
+
+    return {
+        "embed": w(ks[0], (V, D), D),
+        "layers": {
+            "wq": w(ks[1], (L, D, Hq * Dh), D),
+            "wk": w(ks[2], (L, D, Hkv * Dh), D),
+            "wv": w(ks[3], (L, D, Hkv * Dh), D),
+            "wo": w(ks[4], (L, Hq * Dh, D), Hq * Dh),
+            "w1": w(ks[5], (L, D, F), D),
+            "w2": w(ks[6], (L, F, D), F),
+            "w3": w(ks[7], (L, D, F), D),
+            "ln_attn": jnp.ones((L, D), dtype),
+            "ln_mlp": jnp.ones((L, D), dtype),
+        },
+        "final_norm": jnp.ones((D,), dtype),
+        "lm_head": w(ks[8], (D, V), D),
+    }
+
+
+def _layer(
+    cfg: ModelConfig,
+    lp: Params,
+    x: jnp.ndarray,  # [B, S, D]
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    attention_fn: Callable[..., jnp.ndarray],
+    attn_kwargs: dict[str, Any],
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One transformer block. Returns (x_out, k, v) with k/v pre-rope-applied."""
+    B, S, _ = x.shape
+    Dh = cfg.head_dim
+
+    h = rms_norm(x, lp["ln_attn"], cfg.norm_eps)
+    q = (h @ lp["wq"]).reshape(B, S, cfg.n_heads, Dh)
+    k = (h @ lp["wk"]).reshape(B, S, cfg.n_kv_heads, Dh)
+    v = (h @ lp["wv"]).reshape(B, S, cfg.n_kv_heads, Dh)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+
+    attn = attention_fn(q, k, v, **attn_kwargs)
+    x = x + attn.reshape(B, S, -1) @ lp["wo"]
+
+    h = rms_norm(x, lp["ln_mlp"], cfg.norm_eps)
+    x = x + (jax.nn.silu(h @ lp["w1"]) * (h @ lp["w3"])) @ lp["w2"]
+    return x, k, v
+
+
+def forward(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,  # [B, S]
+    positions: jnp.ndarray | None = None,  # [B, S]
+    *,
+    want_kv: bool = False,
+    attention_fn: Callable[..., jnp.ndarray] = causal_attention,
+    kv_valid: jnp.ndarray | None = None,  # [B, S] padding mask
+) -> tuple[jnp.ndarray, tuple[jnp.ndarray, jnp.ndarray] | None]:
+    """Full-sequence forward (training / prefill).
+
+    Returns (logits [B, S, V] f32, (K, V) each [L, B, S, Hkv, Dh] if want_kv).
+    """
+    B, S = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
+    cos, sin = rope_table(positions, cfg.head_dim, cfg.rope_theta)
+
+    x = params["embed"][tokens]  # [B, S, D]
+    attn_kwargs = dict(q_positions=positions, kv_positions=positions, kv_valid=kv_valid)
+
+    def body(x, lp):
+        x, k, v = _layer(cfg, lp, x, cos, sin, attention_fn, attn_kwargs)
+        return x, (k, v) if want_kv else None
+
+    x, kv = jax.lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, kv
+
+
+def decode_step(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,       # [B] current input token per sequence
+    positions: jnp.ndarray,    # [B] 0-based position of that token
+    k_pages: jnp.ndarray,      # [L, N_blocks, block, Hkv, Dh]
+    v_pages: jnp.ndarray,      # [L, N_blocks, block, Hkv, Dh]
+    block_tables: jnp.ndarray,  # [B, max_blocks] int32
+    active: jnp.ndarray | None = None,  # [B] bool — padding-slot mask
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One decode step with paged KV; returns (logits [B, V] f32, k_pages, v_pages).
+
+    The new token's K/V is written into its page *before* attention so the token
+    attends to itself. Inactive batch slots write to block 0/slot-of-position via
+    their block table; callers must point padding slots at a dedicated trash
+    block (allocator reserves block 0 for this).
+    """
+    B = tokens.shape[0]
+    block = k_pages.shape[2]
+    Dh = cfg.head_dim
+    cos, sin = rope_table(positions, cfg.head_dim, cfg.rope_theta)  # [B, half]
+    seq_lens = positions + 1
+
+    blk_idx = block_tables[jnp.arange(B), positions // block]  # [B] physical block
+    slot = positions % block
+
+    x = params["embed"][tokens]  # [B, D]
+    new_k_pages, new_v_pages = [], []
+    for li in range(cfg.n_layers):
+        lp = jax.tree.map(lambda a, li=li: a[li], params["layers"])
+        h = rms_norm(x, lp["ln_attn"], cfg.norm_eps)
+        q = (h @ lp["wq"]).reshape(B, cfg.n_heads, Dh)
+        k = (h @ lp["wk"]).reshape(B, cfg.n_kv_heads, Dh)
+        v = (h @ lp["wv"]).reshape(B, cfg.n_kv_heads, Dh)
+        q = apply_rope(q[:, None], cos[:, None], sin[:, None])[:, 0]
+        k = apply_rope(k[:, None], cos[:, None], sin[:, None])[:, 0]
+
+        kp = k_pages[li].at[blk_idx, slot].set(k)
+        vp = v_pages[li].at[blk_idx, slot].set(v)
+        new_k_pages.append(kp)
+        new_v_pages.append(vp)
+
+        attn = paged_decode_attention(q, kp, vp, block_tables, seq_lens)
+        x = x + attn.reshape(B, -1) @ lp["wo"]
+        h = rms_norm(x, lp["ln_mlp"], cfg.norm_eps)
+        x = x + (jax.nn.silu(h @ lp["w1"]) * (h @ lp["w3"])) @ lp["w2"]
+
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    if active is not None:
+        logits = jnp.where(active[:, None], logits, 0.0)
+    return logits, jnp.stack(new_k_pages), jnp.stack(new_v_pages)
+
+
+def write_prefill_kv(
+    k_pages: jnp.ndarray,  # [L, N, block, Hkv, Dh]
+    v_pages: jnp.ndarray,
+    k_new: jnp.ndarray,    # [L, B, S, Hkv, Dh] from forward(want_kv=True)
+    v_new: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, max_blocks]
+    seq_lens: jnp.ndarray,      # [B] number of valid prompt tokens
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Scatter freshly-prefilled KV rows into their assigned pages.
+
+    Token t of sequence b lands in physical block block_tables[b, t//block] at
+    slot t%block. Padding tokens (t >= seq_lens[b]) are redirected to the trash
+    block 0 so the scatter stays static-shaped.
+    """
+    L, B, S, Hkv, Dh = k_new.shape
+    block = k_pages.shape[2]
+    t = jnp.arange(S, dtype=jnp.int32)
+    blk_for_t = block_tables[:, t // block]  # [B, S]
+    valid = t[None, :] < seq_lens[:, None]  # [B, S]
+    blk_for_t = jnp.where(valid, blk_for_t, 0)
+    slot_for_t = jnp.where(valid, t[None, :] % block, 0)
+
+    bidx = blk_for_t.reshape(-1)   # [B*S]
+    sidx = slot_for_t.reshape(-1)
+    k_flat = k_new.reshape(L, B * S, Hkv, Dh)
+    v_flat = v_new.reshape(L, B * S, Hkv, Dh)
+    k_pages = k_pages.at[:, bidx, sidx].set(k_flat)
+    v_pages = v_pages.at[:, bidx, sidx].set(v_flat)
+    return k_pages, v_pages

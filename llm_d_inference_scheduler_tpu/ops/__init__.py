@@ -1,0 +1,5 @@
+from .norms import rms_norm
+from .rope import apply_rope, rope_table
+from .attention import causal_attention, paged_decode_attention
+
+__all__ = ["rms_norm", "apply_rope", "rope_table", "causal_attention", "paged_decode_attention"]

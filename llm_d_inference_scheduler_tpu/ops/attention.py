@@ -1,0 +1,97 @@
+"""Attention ops for the TPU engine.
+
+Two shapes of attention are needed by the serving stack:
+
+- ``causal_attention``: full-sequence causal attention used by prefill and by
+  the training/dry-run path. Plain XLA einsum formulation — XLA fuses the
+  softmax chain and tiles the matmuls onto the MXU; a Pallas flash kernel can
+  replace it behind the same signature.
+- ``paged_decode_attention``: one-token decode against a paged KV cache
+  (block-table gather), the JetStream/vLLM-style layout that makes continuous
+  batching possible without reshuffling KV state.
+
+All softmax math accumulates in f32 regardless of input dtype.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _repeat_kv(x: jnp.ndarray, q_per_kv: int) -> jnp.ndarray:
+    """[..., n_kv, d] -> [..., n_kv * q_per_kv, d] (GQA head broadcast)."""
+    if q_per_kv == 1:
+        return x
+    return jnp.repeat(x, q_per_kv, axis=-2)
+
+
+def causal_attention(
+    q: jnp.ndarray,  # [B, S, H, D]
+    k: jnp.ndarray,  # [B, T, Hkv, D]
+    v: jnp.ndarray,  # [B, T, Hkv, D]
+    *,
+    q_positions: jnp.ndarray | None = None,  # [B, S] global positions of q rows
+    kv_positions: jnp.ndarray | None = None,  # [B, T]
+    kv_valid: jnp.ndarray | None = None,  # [B, T] bool — padding mask for kv
+) -> jnp.ndarray:
+    """Causal attention; returns [B, S, H, D] in q.dtype.
+
+    When positions are omitted, q and kv are assumed aligned ([B, S] == [B, T])
+    with standard lower-triangular causality.
+    """
+    B, S, H, D = q.shape
+    T = k.shape[1]
+    q_per_kv = H // k.shape[2]
+    k = _repeat_kv(k, q_per_kv)
+    v = _repeat_kv(v, q_per_kv)
+
+    scale = 1.0 / (D ** 0.5)
+    logits = jnp.einsum("bshd,bthd->bhst", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
+
+    if q_positions is None:
+        q_positions = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
+    if kv_positions is None:
+        kv_positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+    mask = q_positions[:, None, :, None] >= kv_positions[:, None, None, :]  # [B,1,S,T]
+    if kv_valid is not None:
+        mask = jnp.logical_and(mask, kv_valid[:, None, None, :])
+    logits = jnp.where(mask, logits, NEG_INF)
+
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhst,bthd->bshd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def paged_decode_attention(
+    q: jnp.ndarray,            # [B, H, D] — one new token per sequence
+    k_pages: jnp.ndarray,      # [N_blocks, block, Hkv, D]
+    v_pages: jnp.ndarray,      # [N_blocks, block, Hkv, D]
+    block_tables: jnp.ndarray,  # [B, max_blocks] int32 — physical block ids
+    seq_lens: jnp.ndarray,      # [B] int32 — tokens valid in cache (incl. current)
+) -> jnp.ndarray:
+    """Decode-step attention over a paged KV cache; returns [B, H, D].
+
+    The gather materialises [B, max_blocks*block] KV rows; a Pallas kernel with
+    scalar-prefetched block tables replaces this on the hot path (see ops/pallas).
+    """
+    B, H, D = q.shape
+    block = k_pages.shape[1]
+    max_blocks = block_tables.shape[1]
+    T = max_blocks * block
+    q_per_kv = H // k_pages.shape[2]
+
+    k = k_pages[block_tables].reshape(B, T, -1, D)  # [B, T, Hkv, D]
+    v = v_pages[block_tables].reshape(B, T, -1, D)
+    k = _repeat_kv(k, q_per_kv)
+    v = _repeat_kv(v, q_per_kv)
+
+    scale = 1.0 / (D ** 0.5)
+    logits = jnp.einsum("bhd,bthd->bht", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
+    valid = jnp.arange(T)[None, :] < seq_lens[:, None]  # [B, T]
+    logits = jnp.where(valid[:, None, :], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bht,bthd->bhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)

@@ -1,0 +1,15 @@
+from .mesh import make_mesh, mesh_shape
+from .shardings import param_pspecs, ACT_SPEC
+from .ring_attention import ring_attention, make_ring_attention_fn
+from .train import make_train_state, make_train_step
+
+__all__ = [
+    "make_mesh",
+    "mesh_shape",
+    "param_pspecs",
+    "ACT_SPEC",
+    "ring_attention",
+    "make_ring_attention_fn",
+    "make_train_state",
+    "make_train_step",
+]

@@ -1,0 +1,37 @@
+"""Device-mesh construction.
+
+Axis convention (used across the engine and train paths):
+- ``dp``: data parallel — batch dim; gradients all-reduced over it.
+- ``sp``: sequence/context parallel — activations' sequence dim; ring
+  attention rotates KV chunks over this axis via ``ppermute`` (ICI neighbors).
+- ``tp``: tensor parallel — hidden/head dims of weight matrices; XLA inserts
+  all-reduce/reduce-scatter over it from the shardings.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXES = ("dp", "sp", "tp")
+
+
+def mesh_shape(n_devices: int, tp: int | None = None, sp: int | None = None) -> tuple[int, int, int]:
+    """Factor n_devices into (dp, sp, tp); powers of two get all three axes."""
+    if tp is None:
+        tp = 2 if n_devices % 2 == 0 else 1
+    rem = n_devices // tp
+    if sp is None:
+        sp = 2 if rem % 2 == 0 else 1
+    dp = rem // sp
+    if dp * sp * tp != n_devices:
+        raise ValueError(f"cannot factor {n_devices} into (dp,sp,tp)=({dp},{sp},{tp})")
+    return dp, sp, tp
+
+
+def make_mesh(devices=None, tp: int | None = None, sp: int | None = None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    dp, sp_, tp_ = mesh_shape(len(devices), tp=tp, sp=sp)
+    arr = np.array(devices).reshape(dp, sp_, tp_)
+    return Mesh(arr, AXES)

@@ -1,0 +1,105 @@
+"""Ring attention: causal attention with the sequence dim sharded over ``sp``.
+
+Blockwise flash-style attention where each device holds one sequence chunk of
+Q permanently and the K/V chunks rotate around the ``sp`` ring via
+``lax.ppermute`` (one ICI hop per step). Online softmax keeps running
+(max, denom, out) accumulators in f32, so the result is exact — this is the
+long-context scaling path the task requires (SURVEY.md §5 notes the reference
+delegates sequence scaling to its engines; here it is first-class).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.attention import NEG_INF, _repeat_kv
+
+
+def ring_attention(
+    q: jnp.ndarray,  # [B, S_loc, H, D] local shard
+    k: jnp.ndarray,  # [B, S_loc, Hkv, D]
+    v: jnp.ndarray,  # [B, S_loc, Hkv, D]
+    *,
+    axis_name: str = "sp",
+    n_shards: int,
+) -> jnp.ndarray:
+    """Causal ring attention body; must run inside shard_map over ``axis_name``.
+
+    ``n_shards`` is the static ring size (mesh axis size); the loop is unrolled
+    over it so the final iteration skips its (otherwise wasted) ppermute.
+    """
+    B, S, H, D = q.shape
+    n = n_shards
+    my = jax.lax.axis_index(axis_name)
+    q_per_kv = H // k.shape[2]
+    scale = 1.0 / (D ** 0.5)
+
+    q_pos = my * S + jnp.arange(S)  # [S] global positions of local q rows
+    qf = q.astype(jnp.float32)
+
+    o = jnp.zeros((B, H, S, D), jnp.float32)
+    m = jnp.full((B, H, S), NEG_INF, jnp.float32)
+    l = jnp.zeros((B, H, S), jnp.float32)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    for s in range(n):
+        origin = (my - s) % n  # which shard this kv chunk came from
+        kv_pos = origin * S + jnp.arange(S)
+        kf = _repeat_kv(k, q_per_kv).astype(jnp.float32)
+        vf = _repeat_kv(v, q_per_kv).astype(jnp.float32)
+
+        logits = jnp.einsum("bshd,bthd->bhst", qf, kf) * scale  # [B,H,S,T]
+        mask = q_pos[None, None, :, None] >= kv_pos[None, None, None, :]
+        logits = jnp.where(mask, logits, NEG_INF)
+
+        blk_max = jnp.max(logits, axis=-1)            # [B,H,S]
+        new_m = jnp.maximum(m, blk_max)
+        # Guard fully-masked blocks: exp(logits - new_m) would be exp(0)=1 for
+        # masked rows when new_m == NEG_INF, so re-mask the probabilities.
+        p = jnp.exp(logits - new_m[..., None]) * mask  # [B,H,S,T]
+        corr = jnp.exp(m - new_m)
+        l = l * corr + jnp.sum(p, axis=-1)
+        o = o * corr[..., None] + jnp.einsum("bhst,bthd->bhsd", p, vf)
+        m = new_m
+
+        if s != n - 1:
+            k = jax.lax.ppermute(k, axis_name, perm)
+            v = jax.lax.ppermute(v, axis_name, perm)
+
+    out = o / jnp.maximum(l, 1e-30)[..., None]  # [B,H,S,D]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def make_ring_attention_fn(mesh: Mesh, *, dp_axis: str = "dp", sp_axis: str = "sp", tp_axis: str = "tp"):
+    """Adapter matching ops.causal_attention's signature for models.llama.forward.
+
+    Heads are sharded over ``tp`` (they arrive that way from the column-parallel
+    QKV projections), batch over ``dp``, sequence over ``sp``. Positions/masks
+    are recomputed inside the shard (contiguous 0..S-1 layout is assumed, which
+    holds for the training path); kv_valid is not supported.
+    """
+    head_axis = tp_axis if mesh.shape.get(tp_axis, 1) > 1 else None
+    spec = P(dp_axis, sp_axis, head_axis, None)
+    n_shards = mesh.shape[sp_axis]
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    def _sharded(q, k, v):
+        return ring_attention(q, k, v, axis_name=sp_axis, n_shards=n_shards)
+
+    def attention_fn(q, k, v, *, q_positions=None, kv_positions=None, kv_valid=None):
+        del q_positions, kv_positions
+        if kv_valid is not None:
+            raise NotImplementedError("ring attention path does not take padding masks")
+        return _sharded(q, k, v)
+
+    return attention_fn

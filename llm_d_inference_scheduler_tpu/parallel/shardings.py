@@ -1,0 +1,35 @@
+"""PartitionSpecs for the stacked-layer Llama pytree (models/llama.py).
+
+Megatron-style TP: column-parallel wq/wk/wv/w1/w3 (output dim on ``tp``),
+row-parallel wo/w2 (input dim on ``tp``) so each block needs one all-reduce,
+which XLA inserts from these shardings. Embedding/lm_head shard the vocab dim.
+Layer-stacked arrays carry a leading unsharded L axis.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from jax.sharding import PartitionSpec as P
+
+# Activations [B, S, D]: batch over dp, sequence over sp.
+ACT_SPEC = P("dp", "sp", None)
+
+
+def param_pspecs(_cfg=None) -> dict[str, Any]:
+    return {
+        "embed": P("tp", None),
+        "layers": {
+            "wq": P(None, None, "tp"),
+            "wk": P(None, None, "tp"),
+            "wv": P(None, None, "tp"),
+            "wo": P(None, "tp", None),
+            "w1": P(None, None, "tp"),
+            "w2": P(None, "tp", None),
+            "w3": P(None, None, "tp"),
+            "ln_attn": P(None, None),
+            "ln_mlp": P(None, None),
+        },
+        "final_norm": P(None),
+        "lm_head": P(None, "tp"),
+    }

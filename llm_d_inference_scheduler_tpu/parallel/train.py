@@ -1,0 +1,68 @@
+"""Sharded training step (dp × sp × tp) for the engine models.
+
+Used by the multi-chip dry run (`__graft_entry__.dryrun_multichip`) and as the
+fine-tuning path of the engine half. Parameters are laid out per
+``shardings.param_pspecs`` (TP), the batch is sharded over ``dp``, the sequence
+over ``sp`` with ring attention; XLA inserts the psum/reduce-scatter
+collectives from the shardings.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models import llama
+from ..models.configs import ModelConfig
+from .ring_attention import make_ring_attention_fn
+from .shardings import param_pspecs
+
+
+def make_train_state(cfg: ModelConfig, mesh: Mesh, seed: int = 0, lr: float = 1e-4):
+    """Init sharded (params, opt_state) and the optax tx."""
+    pspecs = param_pspecs(cfg)
+    shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), pspecs)
+
+    def _init(key):
+        return llama.init_params(cfg, key)
+
+    params = jax.jit(_init, out_shardings=shardings)(jax.random.key(seed))
+    tx = optax.adamw(lr)
+    opt_state = jax.jit(tx.init)(params)  # adamw state mirrors param shardings
+    return params, opt_state, tx, shardings
+
+
+def make_train_step(cfg: ModelConfig, mesh: Mesh, tx: optax.GradientTransformation):
+    """Returns jitted train_step(params, opt_state, tokens) -> (params, opt_state, loss).
+
+    tokens: [B, S] int32 with B % dp == 0 and S % sp == 0.
+    """
+    use_ring = mesh.shape.get("sp", 1) > 1
+    attention_fn = make_ring_attention_fn(mesh) if use_ring else None
+    tok_sharding = NamedSharding(mesh, P("dp", "sp"))
+    act = NamedSharding(mesh, P("dp", "sp", None))
+
+    def loss_fn(params, tokens):
+        kwargs: dict[str, Any] = {}
+        if attention_fn is not None:
+            kwargs["attention_fn"] = attention_fn
+        logits, _ = llama.forward(params, cfg, tokens, **kwargs)
+        logits = jax.lax.with_sharding_constraint(logits, act)
+        targets = tokens[:, 1:]
+        lp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+        nll = -jnp.take_along_axis(lp, targets[..., None], axis=-1)[..., 0]
+        return jnp.mean(nll)
+
+    @jax.jit
+    def train_step(params, opt_state, tokens):
+        tokens = jax.lax.with_sharding_constraint(tokens, tok_sharding)
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return train_step

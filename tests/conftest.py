@@ -1,0 +1,19 @@
+"""Test env: force an 8-device virtual CPU mesh before JAX initialises.
+
+Mirrors the reference's "multi-node without cluster" strategy (SURVEY.md §4):
+envtest/simulators there, virtual CPU devices here.
+
+Note: the axon TPU plugin in this image overrides the JAX_PLATFORMS env var,
+so the backend must be pinned via jax.config before first device use.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
