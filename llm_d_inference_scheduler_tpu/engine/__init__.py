@@ -1,0 +1,18 @@
+"""The TPU engine half: a JetStream-style continuous-batching model server.
+
+The reference router (llm-d/llm-d-inference-scheduler) schedules onto external
+vLLM pods it does not contain; this package provides the TPU-native engines
+those pods map to (SURVEY.md §7 "the engine is JetStream/MaxText-style").
+
+Engines expose the OpenAI HTTP surface the router's parsers/producers expect
+(/v1/completions, /v1/chat/completions, /v1/models, /v1/completions/render)
+plus Prometheus /metrics carrying the five-signal telemetry contract the
+router's data layer scrapes (SURVEY.md §2.5) — jetstream:* gauges replacing
+the reference's vllm:* gauges.
+"""
+
+from .request import EngineRequest, TokenEvent, FinishReason
+from .telemetry import EngineTelemetry
+from .config import EngineConfig
+
+__all__ = ["EngineRequest", "TokenEvent", "FinishReason", "EngineTelemetry", "EngineConfig"]

@@ -1,0 +1,45 @@
+"""KV-cache block allocator.
+
+Physical block 0 is reserved as the trash block: padding lanes and inactive
+decode slots scatter their writes there (models/llama.py relies on this), so
+the hot-path scatters stay static-shaped with no masking branches.
+"""
+
+from __future__ import annotations
+
+
+class OutOfBlocks(Exception):
+    pass
+
+
+class BlockAllocator:
+    TRASH = 0
+
+    def __init__(self, n_blocks: int, block_size: int):
+        self.n_blocks = n_blocks
+        self.block_size = block_size
+        self._free: list[int] = list(range(n_blocks - 1, 0, -1))  # pop() yields 1,2,…
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_fraction(self) -> float:
+        usable = self.n_blocks - 1
+        return (usable - len(self._free)) / usable if usable else 0.0
+
+    def blocks_for_tokens(self, n_tokens: int) -> int:
+        return -(-n_tokens // self.block_size)
+
+    def alloc(self, n: int) -> list[int]:
+        if n > len(self._free):
+            raise OutOfBlocks(f"need {n} blocks, have {len(self._free)}")
+        out = [self._free.pop() for _ in range(n)]
+        return out
+
+    def free(self, blocks: list[int]) -> None:
+        for b in blocks:
+            if b == self.TRASH:
+                raise ValueError("attempt to free trash block 0")
+            self._free.append(b)

@@ -1,0 +1,43 @@
+"""Engine configuration."""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ..models.configs import ModelConfig, get_config
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    model: str = "tiny"
+    served_model_name: str | None = None
+    backend: str = "tpu"          # "tpu" (JAX) | "sim" (CPU simulator)
+    max_batch: int = 8            # decode batch slots
+    max_model_len: int = 2048
+    hbm_kv_blocks: int = 0        # 0 = derive from max_batch * max_model_len
+    tokenizer: str = "byte"
+    seed: int = 0
+    port: int = 8200
+    host: str = "127.0.0.1"
+    # sim backend knobs (mirrors llm-d-inference-sim's role in the reference
+    # e2e suite, /root/reference test/e2e — SURVEY §4):
+    sim_prefill_ms_per_token: float = 0.02
+    sim_decode_ms_per_token: float = 2.0
+    # P/D role advertised to the router via labels/metadata.
+    role: str = "both"            # "prefill" | "decode" | "both" | "encode"
+    engine_id: str = ""
+
+    @property
+    def model_config(self) -> ModelConfig:
+        return get_config(self.model)
+
+    @property
+    def model_name(self) -> str:
+        return self.served_model_name or self.model
+
+    def num_kv_blocks(self) -> int:
+        if self.hbm_kv_blocks:
+            return self.hbm_kv_blocks
+        block = self.model_config.kv_block_size
+        per_seq = -(-self.max_model_len // block)
+        return 1 + self.max_batch * per_seq  # +1 for the trash block

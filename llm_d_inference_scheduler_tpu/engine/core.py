@@ -1,0 +1,534 @@
+"""TpuEngine: continuous-batching JAX engine (the model-server half).
+
+Architecture (TPU-first, JetStream-style):
+- One engine thread owns the device: it alternates admission/prefill with
+  batched decode steps. aiohttp handlers talk to it through thread-safe
+  submission + per-request asyncio queues (events hop back to the event loop
+  via call_soon_threadsafe).
+- Decode runs one jit-compiled step over a FIXED batch of slots (static
+  shapes). Inactive slots point their block tables at the trash block 0, so
+  no masking branches exist on the hot path; their lanes are dead compute.
+- Prefill pads prompts to power-of-two buckets (bounded compile cache) and
+  scatters KV into the slot's pages inside the same jit (donated buffers →
+  in-place HBM updates).
+- P/D disaggregation (reference behavior:
+  /root/reference/pkg/sidecar/proxy/connector_nixlv2.go:109-253):
+  prefills tagged do_remote_decode host-stage their KV for pickup (exports
+  swept by TTL); decode-side imports fetch KV on a separate thread so the
+  engine thread never blocks on the network, then scatter on-device.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import logging
+import threading
+import time
+import uuid
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import llama
+from .blocks import BlockAllocator
+from .config import EngineConfig
+from .request import EngineRequest, FinishReason, TokenEvent
+from .sampling import sample_tokens
+from .telemetry import EngineTelemetry
+from .tokenizer import get_tokenizer
+
+log = logging.getLogger("engine.core")
+
+KV_EXPORT_TTL_S = 60.0
+
+
+@dataclasses.dataclass
+class _Slot:
+    req: EngineRequest
+    out: asyncio.Queue
+    loop: asyncio.AbstractEventLoop
+    blocks: list[int]
+    position: int              # next token position to be written
+    generated: list[int]
+    last_token: int
+    first_emitted: bool = False
+    aborted: bool = False
+
+
+@dataclasses.dataclass
+class _PendingImport:
+    req: EngineRequest
+    out: asyncio.Queue
+    loop: asyncio.AbstractEventLoop
+    payload: bytes | None = None
+    headers: dict[str, str] | None = None
+    error: str | None = None
+
+
+class TpuEngine:
+    """Continuous-batching engine over models.llama with paged KV on HBM."""
+
+    def __init__(self, cfg: EngineConfig, params=None):
+        self.cfg = cfg
+        self.mcfg = cfg.model_config
+        self.engine_id = cfg.engine_id or f"tpu-{uuid.uuid4().hex[:8]}"
+        self.tokenizer = get_tokenizer(cfg.tokenizer, self.mcfg.vocab_size)
+        self.model_name = cfg.model_name
+
+        block = self.mcfg.kv_block_size
+        self.n_blocks = max(cfg.num_kv_blocks(), 2)  # ≥ trash + 1 usable
+        self.max_blocks_per_seq = -(-cfg.max_model_len // block)
+        self.allocator = BlockAllocator(self.n_blocks, block)
+        self.telemetry = EngineTelemetry(block_size=block, num_blocks=self.n_blocks)
+
+        key = jax.random.key(cfg.seed)
+        self.params = params if params is not None else llama.init_params(self.mcfg, key)
+        kshape = (self.mcfg.n_layers, self.n_blocks, block,
+                  self.mcfg.n_kv_heads, self.mcfg.head_dim)
+        dtype = jnp.dtype(self.mcfg.dtype)
+        self.k_pages = jnp.zeros(kshape, dtype)
+        self.v_pages = jnp.zeros(kshape, dtype)
+
+        self.slots: list[_Slot | None] = [None] * cfg.max_batch
+        self._waiting: list[tuple[EngineRequest, asyncio.Queue, asyncio.AbstractEventLoop]] = []
+        self._import_ready: list[_PendingImport] = []
+        self._abort_ids: set[str] = set()
+        self._cond = threading.Condition()
+        self._stop = False
+        self._thread: threading.Thread | None = None
+        self._sample_key = jax.random.key(cfg.seed + 1)
+        # Host-staged KV exports for P/D handoff: request_id -> record.
+        self.kv_exports: dict[str, dict[str, Any]] = {}
+        self._prefill_fns: dict[int, Any] = {}
+        self._jit_decode = jax.jit(self._decode_impl, donate_argnums=(3, 4))
+        self._jit_sample = jax.jit(sample_tokens)
+        self._jit_import = jax.jit(
+            lambda kp, vp, blocks, k_new, v_new: (
+                kp.at[:, blocks].set(k_new), vp.at[:, blocks].set(v_new)),
+            donate_argnums=(0, 1))
+
+    # ---- jitted bodies -------------------------------------------------
+
+    def _decode_impl(self, params, tokens, positions, k_pages, v_pages, block_tables):
+        return llama.decode_step(params, self.mcfg, tokens, positions, k_pages, v_pages,
+                                 block_tables)
+
+    def _prefill_fn(self, bucket: int):
+        """Per-bucket jitted prefill: forward + KV scatter + last-token logits."""
+        if bucket not in self._prefill_fns:
+            def impl(params, tokens, seq_len, k_pages, v_pages, block_table_row):
+                logits, (k_new, v_new) = llama.forward(params, self.mcfg, tokens, want_kv=True)
+                k_pages, v_pages = llama.write_prefill_kv(
+                    k_pages, v_pages, k_new, v_new, block_table_row, seq_len)
+                last = jnp.take_along_axis(
+                    logits, (seq_len - 1)[:, None, None], axis=1)[:, 0]  # [1, V]
+                return last, k_pages, v_pages
+            self._prefill_fns[bucket] = jax.jit(impl, donate_argnums=(3, 4))
+        return self._prefill_fns[bucket]
+
+    # ---- public API (event-loop side) ---------------------------------
+
+    async def start(self):
+        self._thread = threading.Thread(target=self._run, name="tpu-engine", daemon=True)
+        self._thread.start()
+
+    async def stop(self):
+        with self._cond:
+            self._stop = True
+            self._cond.notify()
+        if self._thread:
+            self._thread.join(timeout=10)
+
+    def submit(self, req: EngineRequest) -> asyncio.Queue:
+        """Thread-safe enqueue; returns the per-request event queue."""
+        out: asyncio.Queue = asyncio.Queue()
+        loop = asyncio.get_running_loop()
+        with self._cond:
+            self._waiting.append((req, out, loop))
+            self.telemetry.waiting.set(len(self._waiting))
+            self._cond.notify()
+        return out
+
+    def abort(self, request_id: str) -> None:
+        """Thread-safe abort: stops decode and frees blocks for the request."""
+        with self._cond:
+            self._abort_ids.add(request_id)
+            self._cond.notify()
+
+    def release_kv_export(self, request_id: str) -> None:
+        """Drop a staged P/D export once the decode side has pulled it."""
+        self.kv_exports.pop(request_id, None)
+
+    # ---- engine thread -------------------------------------------------
+
+    def _emit(self, slot: _Slot, ev: TokenEvent):
+        slot.loop.call_soon_threadsafe(slot.out.put_nowait, ev)
+
+    def _emit_to(self, out, loop, ev: TokenEvent):
+        loop.call_soon_threadsafe(out.put_nowait, ev)
+
+    def _bucket(self, n: int) -> int:
+        b = 16
+        while b < n:
+            b *= 2
+        return min(b, self.cfg.max_model_len)
+
+    def _run(self):
+        while True:
+            with self._cond:
+                while (not self._stop and not self._waiting and not self._import_ready
+                       and not self._abort_ids and not any(self.slots)):
+                    self._cond.wait(timeout=0.1)
+                if self._stop:
+                    return
+            try:
+                self._step()
+            except Exception:
+                log.exception("engine loop failure; aborting in-flight requests")
+                self._abort_all("engine loop failure")
+
+    def _step(self):
+        self._sweep_exports()
+        self._process_aborts()
+        self._process_imports()
+        self._admit()
+        if any(s is not None for s in self.slots):
+            self._decode_once()
+        else:
+            with self._cond:
+                if (self._waiting or self._import_ready) and not self._abort_ids:
+                    # Head-of-line can't be placed yet (no free blocks / no slot
+                    # / fetch in flight): sleep until something changes.
+                    self._cond.wait(timeout=0.05)
+
+    def _abort_all(self, reason: str):
+        for i, s in enumerate(self.slots):
+            if s is not None:
+                self._finish_slot(i, FinishReason.ABORT)
+        with self._cond:
+            drained, self._waiting = self._waiting, []
+            self.telemetry.waiting.set(0)
+            imports, self._import_ready = self._import_ready, []
+        for req, out, loop in drained:
+            self._emit_to(out, loop, TokenEvent(
+                request_id=req.request_id, token_id=None,
+                finish_reason=FinishReason.ABORT,
+                prompt_tokens=len(req.prompt_token_ids)))
+        for pi in imports:
+            self._emit_to(pi.out, pi.loop, TokenEvent(
+                request_id=pi.req.request_id, token_id=None,
+                finish_reason=FinishReason.ABORT,
+                prompt_tokens=len(pi.req.prompt_token_ids)))
+
+    def _sweep_exports(self):
+        now = time.monotonic()
+        for rid in [r for r, rec in self.kv_exports.items()
+                    if now - rec["created"] > KV_EXPORT_TTL_S]:
+            log.warning("kv export %s expired unclaimed; dropping", rid)
+            self.kv_exports.pop(rid, None)
+
+    def _process_aborts(self):
+        with self._cond:
+            ids, self._abort_ids = self._abort_ids, set()
+            if not ids:
+                return
+            keep = []
+            for req, out, loop in self._waiting:
+                if req.request_id in ids:
+                    self._emit_to(out, loop, TokenEvent(
+                        request_id=req.request_id, token_id=None,
+                        finish_reason=FinishReason.ABORT,
+                        prompt_tokens=len(req.prompt_token_ids)))
+                else:
+                    keep.append((req, out, loop))
+            self._waiting = keep
+            self.telemetry.waiting.set(len(self._waiting))
+        for i, s in enumerate(self.slots):
+            if s is not None and s.req.request_id in ids:
+                self._finish_slot(i, FinishReason.ABORT)
+
+    # ---- admission -----------------------------------------------------
+
+    def _blocks_needed(self, req: EngineRequest) -> int:
+        prompt_len = len(req.prompt_token_ids)
+        total = min(prompt_len + req.max_tokens, self.cfg.max_model_len)
+        need = self.allocator.blocks_for_tokens(total)
+        ktp = req.kv_transfer_params or {}
+        if ktp.get("remote_num_blocks"):
+            need = max(need, int(ktp["remote_num_blocks"]))
+        return need
+
+    def _admit(self):
+        for i, slot in enumerate(self.slots):
+            if slot is not None:
+                continue
+            with self._cond:
+                if not self._waiting:
+                    break
+                req, out, loop = self._waiting[0]
+                need = self._blocks_needed(req)
+                if need > self.n_blocks - 1:
+                    # Impossible request: reject instead of wedging the queue.
+                    self._waiting.pop(0)
+                    self.telemetry.waiting.set(len(self._waiting))
+                    self._emit_to(out, loop, TokenEvent(
+                        request_id=req.request_id, token_id=None,
+                        finish_reason=FinishReason.ABORT,
+                        prompt_tokens=len(req.prompt_token_ids)))
+                    continue
+                if (req.kv_transfer_params or {}).get("remote_host") is not None:
+                    # Fetch off-thread; the payload comes back via _import_ready.
+                    self._waiting.pop(0)
+                    self.telemetry.waiting.set(len(self._waiting))
+                    self._start_kv_fetch(req, out, loop)
+                    continue
+                if need > self.allocator.free_blocks:
+                    break  # head-of-line waits for capacity
+                self._waiting.pop(0)
+                self.telemetry.waiting.set(len(self._waiting))
+                blocks = self.allocator.alloc(need)
+                self.telemetry.kv_usage.set(self.allocator.used_fraction)
+            self._prefill_into_slot(i, req, out, loop, blocks)
+
+    # ---- prefill -------------------------------------------------------
+
+    def _prefill_into_slot(self, idx, req, out, loop, blocks):
+        prompt = req.prompt_token_ids[: self.cfg.max_model_len - 1]
+        bucket = self._bucket(len(prompt))
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, : len(prompt)] = prompt
+        row = np.zeros((1, self.max_blocks_per_seq), np.int32)
+        row[0, : len(blocks)] = blocks
+
+        fn = self._prefill_fn(bucket)
+        seq_len = jnp.asarray([len(prompt)], jnp.int32)
+        logits, self.k_pages, self.v_pages = fn(
+            self.params, jnp.asarray(tokens), seq_len, self.k_pages, self.v_pages,
+            jnp.asarray(row))
+        tok = int(self._sample(logits, [req])[0])
+        self.telemetry.prompt_tokens.inc(len(prompt))
+        self.telemetry.ttft.observe(time.monotonic() - req.arrival_time)
+
+        slot = _Slot(req=req, out=out, loop=loop, blocks=blocks,
+                     position=len(prompt), generated=[tok], last_token=tok)
+        self.slots[idx] = slot
+        self.telemetry.running.set(sum(s is not None for s in self.slots))
+        self.telemetry.generation_tokens.inc()
+
+        # Remote-decode prefill: hand KV off instead of decoding here.
+        ktp = req.kv_transfer_params or {}
+        if ktp.get("do_remote_decode"):
+            self._finish_slot(idx, FinishReason.LENGTH, retain_for_transfer=True,
+                              first_token=tok)
+            return
+        self._emit(slot, TokenEvent(
+            request_id=req.request_id, token_id=tok,
+            text=self.tokenizer.decode([tok]), is_first=True,
+            prompt_tokens=len(prompt), completion_tokens=1))
+        slot.first_emitted = True
+        self._maybe_finish_after_token(idx, tok)
+
+    # ---- P/D import (decode side) --------------------------------------
+
+    def _start_kv_fetch(self, req, out, loop):
+        """Fetch the prefiller's staged KV on a separate thread (the engine
+        thread must keep decoding while the network round-trip happens)."""
+        pi = _PendingImport(req=req, out=out, loop=loop)
+
+        def fetch():
+            import httpx
+
+            ktp = req.kv_transfer_params or {}
+            url = (f"http://{ktp['remote_host']}:{ktp['remote_port']}"
+                   f"/kv/{ktp['remote_request_id']}")
+            try:
+                r = httpx.get(url, timeout=30.0)
+                r.raise_for_status()
+                pi.payload = r.content
+                pi.headers = dict(r.headers)
+                try:
+                    httpx.delete(url, timeout=5.0)
+                except Exception:
+                    pass  # exporter TTL sweep reclaims
+            except Exception as e:
+                pi.error = str(e)
+            with self._cond:
+                self._import_ready.append(pi)
+                self._cond.notify()
+
+        threading.Thread(target=fetch, name="kv-fetch", daemon=True).start()
+
+    def _process_imports(self):
+        while True:
+            free = [i for i, s in enumerate(self.slots) if s is None]
+            with self._cond:
+                if not self._import_ready or not free:
+                    return
+                pi = self._import_ready[0]
+                blocks: list[int] = []
+                if pi.error is None:
+                    need = self._blocks_needed(pi.req)
+                    if need > self.allocator.free_blocks:
+                        return  # wait for capacity
+                    blocks = self.allocator.alloc(need)
+                    self.telemetry.kv_usage.set(self.allocator.used_fraction)
+                self._import_ready.pop(0)
+            if pi.error is not None:
+                # Reference semantics: fall back to local prefill on transfer
+                # failure (connector_nixlv2.go:160-177).
+                log.warning("kv import for %s failed (%s); local prefill fallback",
+                            pi.req.request_id, pi.error)
+                with self._cond:
+                    self._waiting.insert(0, (self._strip_remote(pi.req), pi.out, pi.loop))
+                    self.telemetry.waiting.set(len(self._waiting))
+                continue
+            idx = free[0]
+            self._import_into_slot(idx, pi, blocks)
+
+    @staticmethod
+    def _strip_remote(req: EngineRequest) -> EngineRequest:
+        return dataclasses.replace(req, kv_transfer_params=None)
+
+    def _import_into_slot(self, idx: int, pi: _PendingImport, blocks: list[int]):
+        req, headers = pi.req, pi.headers or {}
+        shape = tuple(json.loads(headers["x-kv-shape"]))
+        seq_len = int(headers["x-kv-seq-len"])
+        dtype = jnp.dtype(headers["x-kv-dtype"])
+        nbytes = len(pi.payload) // 2
+        k_np = np.frombuffer(pi.payload[:nbytes], dtype=dtype).reshape(shape)
+        v_np = np.frombuffer(pi.payload[nbytes:], dtype=dtype).reshape(shape)
+        nb = shape[1]
+
+        # Pad to the fixed per-seq block budget so the scatter compiles once.
+        maxB = self.max_blocks_per_seq
+        L, _, block, Hkv, Dh = shape
+        k_pad = np.zeros((L, maxB, block, Hkv, Dh), dtype)
+        v_pad = np.zeros((L, maxB, block, Hkv, Dh), dtype)
+        k_pad[:, :nb], v_pad[:, :nb] = k_np, v_np
+        blocks_pad = np.zeros((maxB,), np.int32)  # padding lands in trash block 0
+        blocks_pad[:nb] = blocks[:nb]
+        self.k_pages, self.v_pages = self._jit_import(
+            self.k_pages, self.v_pages, jnp.asarray(blocks_pad),
+            jnp.asarray(k_pad), jnp.asarray(v_pad))
+
+        ktp = req.kv_transfer_params or {}
+        first = int(ktp.get("remote_first_token")
+                    if ktp.get("remote_first_token") is not None
+                    else headers["x-kv-first-token"])
+        slot = _Slot(req=req, out=pi.out, loop=pi.loop, blocks=blocks,
+                     position=seq_len, generated=[first], last_token=first)
+        self.slots[idx] = slot
+        self.telemetry.running.set(sum(s is not None for s in self.slots))
+        self.telemetry.ttft.observe(time.monotonic() - req.arrival_time)
+        self._emit(slot, TokenEvent(
+            request_id=req.request_id, token_id=first,
+            text=self.tokenizer.decode([first]), is_first=True,
+            prompt_tokens=seq_len, completion_tokens=1,
+            cached_tokens=seq_len))
+        slot.first_emitted = True
+        self._maybe_finish_after_token(idx, first)
+
+    # ---- decode --------------------------------------------------------
+
+    def _sample(self, logits, reqs) -> np.ndarray:
+        self._sample_key, sub = jax.random.split(self._sample_key)
+        temps = np.array([r.temperature for r in reqs], np.float32)
+        top_k = np.array([r.top_k for r in reqs], np.int32)
+        top_p = np.array([r.top_p for r in reqs], np.float32)
+        return np.asarray(self._jit_sample(logits, sub, temps, top_k, top_p))
+
+    def _decode_once(self):
+        B = self.cfg.max_batch
+        tokens = np.zeros((B,), np.int32)
+        positions = np.zeros((B,), np.int32)
+        tables = np.zeros((B, self.max_blocks_per_seq), np.int32)
+        active = [i for i, s in enumerate(self.slots) if s is not None]
+        for i in active:
+            s = self.slots[i]
+            tokens[i] = s.last_token
+            positions[i] = s.position
+            tables[i, : len(s.blocks)] = s.blocks
+
+        logits, self.k_pages, self.v_pages = self._jit_decode(
+            self.params, jnp.asarray(tokens), jnp.asarray(positions),
+            self.k_pages, self.v_pages, jnp.asarray(tables))
+
+        reqs = [self.slots[i].req if self.slots[i] else _DUMMY_REQ for i in range(B)]
+        sampled = self._sample(logits, reqs)
+        for i in active:
+            s = self.slots[i]
+            tok = int(sampled[i])
+            s.position += 1
+            s.generated.append(tok)
+            s.last_token = tok
+            self.telemetry.generation_tokens.inc()
+            if tok not in (set(s.req.stop_token_ids) | {self.tokenizer.eos_id}):
+                self._emit(s, TokenEvent(
+                    request_id=s.req.request_id, token_id=tok,
+                    text=self.tokenizer.decode([tok]), is_first=not s.first_emitted,
+                    completion_tokens=len(s.generated)))
+                s.first_emitted = True
+            self._maybe_finish_after_token(i, tok)
+
+    def _maybe_finish_after_token(self, idx: int, tok: int):
+        s = self.slots[idx]
+        stop_ids = set(s.req.stop_token_ids) | {self.tokenizer.eos_id}
+        reason = None
+        if tok in stop_ids:
+            reason = FinishReason.STOP
+        elif len(s.generated) >= s.req.max_tokens:
+            reason = FinishReason.LENGTH
+        elif s.position + 1 >= self.cfg.max_model_len:
+            reason = FinishReason.LENGTH
+        if reason is not None:
+            self._finish_slot(idx, reason)
+
+    def _finish_slot(self, idx: int, reason: FinishReason, *,
+                     retain_for_transfer: bool = False, first_token: int | None = None):
+        s = self.slots[idx]
+        self.slots[idx] = None
+        kv_params = None
+        if retain_for_transfer:
+            # Host-stage the prefilled KV (DCN handoff path): copy the slot's
+            # pages out synchronously so device blocks free immediately and the
+            # HTTP thread never touches live (donated) page buffers. The ICI
+            # fast path (device-to-device) replaces this copy for same-slice
+            # prefill/decode pairs.
+            self.kv_exports[s.req.request_id] = {
+                "k": np.asarray(self.k_pages[:, s.blocks]),
+                "v": np.asarray(self.v_pages[:, s.blocks]),
+                "seq_len": s.position,  # prompt tokens in cache
+                "first_token": first_token,
+                "created": time.monotonic(),
+            }
+            kv_params = {
+                "remote_engine_id": self.engine_id,
+                "remote_request_id": s.req.request_id,
+                "remote_num_blocks": len(s.blocks),
+                "remote_seq_len": s.position,
+                "remote_first_token": first_token,
+                "remote_host": self.cfg.host,
+                "remote_port": self.cfg.port,
+            }
+        with self._cond:
+            self.allocator.free(s.blocks)
+            self.telemetry.kv_usage.set(self.allocator.used_fraction)
+            self._cond.notify()  # capacity freed: wake admission
+        self.telemetry.running.set(sum(x is not None for x in self.slots))
+        self.telemetry.request_success.labels(finished_reason=reason.value).inc()
+        ev = TokenEvent(
+            request_id=s.req.request_id, token_id=None, finish_reason=reason,
+            kv_transfer_params=kv_params,
+            prompt_tokens=len(s.req.prompt_token_ids),
+            completion_tokens=len(s.generated))
+        if retain_for_transfer and first_token is not None:
+            ev.text = self.tokenizer.decode([first_token])
+            ev.token_id = first_token
+        self._emit(s, ev)
+
+
+_DUMMY_REQ = EngineRequest(request_id="__pad__", prompt_token_ids=[0])

@@ -1,0 +1,48 @@
+"""Engine-side request/response types."""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import time
+from typing import Any
+
+
+class FinishReason(str, enum.Enum):
+    STOP = "stop"          # EOS or stop sequence
+    LENGTH = "length"      # hit max_tokens
+    ABORT = "abort"        # client disconnect / eviction
+    CACHE_THRESHOLD = "cache_threshold"  # shared-storage connector probe (SURVEY §2.10)
+
+
+@dataclasses.dataclass
+class EngineRequest:
+    request_id: str
+    prompt_token_ids: list[int]
+    max_tokens: int = 16
+    temperature: float = 0.0
+    top_k: int = 0            # 0 = disabled
+    top_p: float = 1.0        # 1.0 = disabled
+    stop_token_ids: tuple[int, ...] = ()
+    stream: bool = False
+    # P/D disaggregation handshake (mirrors the reference's kv_transfer_params
+    # relay, /root/reference pkg/sidecar/proxy/connector_nixlv2.go:109-131):
+    kv_transfer_params: dict[str, Any] | None = None
+    arrival_time: float = dataclasses.field(default_factory=time.monotonic)
+
+
+@dataclasses.dataclass
+class TokenEvent:
+    """One emitted token (or terminal event) on a request's output stream."""
+    request_id: str
+    token_id: int | None
+    text: str = ""
+    finish_reason: FinishReason | None = None
+    # Set on the first event so servers can report TTFT.
+    is_first: bool = False
+    # Terminal event may carry KV handoff params back to the sidecar connector.
+    kv_transfer_params: dict[str, Any] | None = None
+    # usage accounting
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+    cached_tokens: int = 0

@@ -1,0 +1,42 @@
+"""Batched token sampling (jit-compiled once; all shapes static)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def sample_tokens(
+    logits: jnp.ndarray,   # [B, V] f32
+    key: jax.Array,
+    temps: jnp.ndarray,    # [B] f32; <=0 means greedy
+    top_k: jnp.ndarray,    # [B] int32; 0 disables
+    top_p: jnp.ndarray,    # [B] f32; >=1 disables
+) -> jnp.ndarray:
+    """Per-row temperature/top-k/top-p sampling with greedy fallback."""
+    B, V = logits.shape
+    greedy = jnp.argmax(logits, axis=-1)
+
+    def restricted(logits):
+        # Rank-based top-k: keep entries whose descending rank < k.
+        order = jnp.argsort(-logits, axis=-1)                  # [B, V]
+        ranks = jnp.argsort(order, axis=-1)                    # rank of each vocab entry
+        k = jnp.where(top_k > 0, top_k, V)[:, None]
+        logits = jnp.where(ranks < k, logits, NEG_INF)
+        # Nucleus: keep the smallest prefix of the sorted distribution with
+        # cumulative prob <= p (always keeping the top entry).
+        sorted_logits = jnp.take_along_axis(logits, order, axis=-1)
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep_sorted = (cum - probs) < top_p[:, None]           # prefix rule, top-1 always kept
+        keep = jnp.take_along_axis(keep_sorted, ranks, axis=-1)
+        return jnp.where(keep, logits, NEG_INF)
+
+    needs_restrict = jnp.any((top_k > 0) | (top_p < 1.0))
+    logits = jax.lax.cond(needs_restrict, restricted, lambda l: l, logits)
+
+    safe_t = jnp.maximum(temps, 1e-4)[:, None]
+    sampled = jax.random.categorical(key, logits / safe_t, axis=-1)
+    return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)

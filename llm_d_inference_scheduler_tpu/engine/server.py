@@ -1,0 +1,357 @@
+"""Engine HTTP server: the OpenAI-compatible surface the router schedules onto.
+
+Endpoint parity with what the reference's router-side plugins consume:
+- /v1/completions, /v1/chat/completions (openai-parser,
+  /root/reference/pkg/epp/framework/plugins/requesthandling/parsers/openai)
+- /v1/models (models-data-source, SURVEY §2.5)
+- /v1/completions/render + /v1/chat/completions/render (token-producer,
+  /root/reference .../dataproducer/tokenizer/vllm_http.go)
+- /metrics Prometheus text (metrics-data-source five-signal contract)
+- /kv/{request_id} + DELETE: the KV handoff data path for the P/D sidecar
+  connectors (replaces the reference's engine-side NIXL pull).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+import uuid
+from typing import Any
+
+import numpy as np
+from aiohttp import web
+
+from .config import EngineConfig
+from .core import TpuEngine
+from .request import EngineRequest, FinishReason, TokenEvent
+from .sim import SimEngine
+
+log = logging.getLogger("engine.server")
+
+
+def make_engine(cfg: EngineConfig):
+    if cfg.backend == "sim":
+        return SimEngine(cfg)
+    if cfg.backend == "tpu":
+        return TpuEngine(cfg)
+    raise ValueError(f"unknown engine backend {cfg.backend!r}")
+
+
+async def _json_body(request: web.Request) -> dict[str, Any]:
+    try:
+        body = await request.json()
+    except Exception:
+        raise web.HTTPBadRequest(text="request body must be valid JSON")
+    if not isinstance(body, dict):
+        raise web.HTTPBadRequest(text="request body must be a JSON object")
+    return body
+
+
+def _first_stop_hit(text: str, stop_strings: list[str] | None) -> int | None:
+    """Index of the earliest stop-string occurrence in text, or None."""
+    if not stop_strings:
+        return None
+    hits = [text.find(s) for s in stop_strings]
+    hits = [h for h in hits if h >= 0]
+    return min(hits) if hits else None
+
+
+def _chat_to_prompt(messages: list[dict[str, Any]]) -> str:
+    """Minimal chat template: role-tagged lines + assistant cue."""
+    parts = []
+    for m in messages:
+        content = m.get("content") or ""
+        if isinstance(content, list):  # multimodal blocks: concatenate text parts
+            content = " ".join(c.get("text", "") for c in content if isinstance(c, dict))
+        parts.append(f"{m.get('role', 'user')}: {content}")
+    parts.append("assistant:")
+    return "\n".join(parts)
+
+
+class EngineServer:
+    def __init__(self, cfg: EngineConfig, engine=None):
+        self.cfg = cfg
+        self.engine = engine or make_engine(cfg)
+        self.app = web.Application()
+        self.app.add_routes([
+            web.post("/v1/completions", self.completions),
+            web.post("/v1/chat/completions", self.chat_completions),
+            web.post("/v1/completions/render", self.render_completions),
+            web.post("/v1/chat/completions/render", self.render_chat),
+            web.get("/v1/models", self.models),
+            web.get("/metrics", self.metrics),
+            web.get("/health", self.health),
+            web.get("/kv/{request_id}", self.kv_fetch),
+            web.delete("/kv/{request_id}", self.kv_release),
+        ])
+        self._runner: web.AppRunner | None = None
+
+    # ---- lifecycle ----------------------------------------------------
+
+    async def start(self):
+        await self.engine.start()
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.cfg.host, self.cfg.port)
+        await site.start()
+        log.info("engine %s listening on %s:%s", self.engine.engine_id,
+                 self.cfg.host, self.cfg.port)
+
+    async def stop(self):
+        if self._runner:
+            await self._runner.cleanup()
+        await self.engine.stop()
+
+    # ---- request plumbing ---------------------------------------------
+
+    def _tokenize_prompt(self, prompt) -> list[int]:
+        if isinstance(prompt, str):
+            return self.engine.tokenizer.encode(prompt)
+        if isinstance(prompt, list) and all(isinstance(t, int) for t in prompt):
+            return prompt
+        raise web.HTTPBadRequest(text="prompt must be a string or a list of token ids")
+
+    def _build_request(self, body: dict[str, Any], prompt_ids: list[int]) -> EngineRequest:
+        return EngineRequest(
+            request_id=body.get("request_id") or f"req-{uuid.uuid4().hex[:12]}",
+            prompt_token_ids=prompt_ids,
+            max_tokens=int(body.get("max_tokens", 16)),
+            temperature=float(body.get("temperature", 0.0)),
+            top_k=int(body.get("top_k", 0)),
+            top_p=float(body.get("top_p", 1.0)),
+            stream=bool(body.get("stream", False)),
+            stop_token_ids=tuple(body.get("stop_token_ids") or ()),
+            kv_transfer_params=body.get("kv_transfer_params"),
+        )
+
+    @staticmethod
+    def _stop_strings(body: dict[str, Any]) -> list[str]:
+        stop = body.get("stop")
+        if stop is None:
+            return []
+        return [stop] if isinstance(stop, str) else [s for s in stop if isinstance(s, str)]
+
+    async def _collect(self, req: EngineRequest, out: asyncio.Queue,
+                       stop_strings: list[str] | None = None) -> dict[str, Any]:
+        acc = ""
+        n_completion, n_prompt = 0, len(req.prompt_token_ids)
+        finish = FinishReason.LENGTH
+        kv_params = None
+        while True:
+            ev: TokenEvent = await out.get()
+            if ev.token_id is not None:
+                acc += ev.text
+                hit = _first_stop_hit(acc, stop_strings)
+                if hit is not None:
+                    acc = acc[:hit]
+                    finish = FinishReason.STOP
+                    self.engine.abort(req.request_id)
+                    n_completion = max(n_completion, ev.completion_tokens)
+                    break
+            n_completion = max(n_completion, ev.completion_tokens)
+            if ev.finish_reason is not None:
+                finish = ev.finish_reason
+                kv_params = ev.kv_transfer_params
+                break
+        text = [acc]
+        resp: dict[str, Any] = {
+            "id": req.request_id,
+            "object": "text_completion",
+            "created": int(time.time()),
+            "model": self.engine.model_name,
+            "choices": [{
+                "index": 0,
+                "text": "".join(text),
+                "finish_reason": finish.value,
+            }],
+            "usage": {
+                "prompt_tokens": n_prompt,
+                "completion_tokens": n_completion,
+                "total_tokens": n_prompt + n_completion,
+            },
+        }
+        if kv_params is not None:
+            resp["kv_transfer_params"] = kv_params
+        return resp
+
+    async def _stream(self, request: web.Request, req: EngineRequest,
+                      out: asyncio.Queue, chat: bool,
+                      stop_strings: list[str] | None = None) -> web.StreamResponse:
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+        })
+        await resp.prepare(request)
+        created = int(time.time())
+        obj = "chat.completion.chunk" if chat else "text_completion"
+        acc = ""
+        while True:
+            ev: TokenEvent = await out.get()
+            if ev.token_id is not None:
+                piece = ev.text
+                hit = _first_stop_hit(acc + piece, stop_strings)
+                if hit is not None:
+                    piece = (acc + piece)[:hit][len(acc):]
+                    self.engine.abort(req.request_id)
+                    ev = TokenEvent(request_id=req.request_id, token_id=None,
+                                    finish_reason=FinishReason.STOP,
+                                    prompt_tokens=ev.prompt_tokens,
+                                    completion_tokens=ev.completion_tokens)
+                acc += piece
+                if piece:
+                    if chat:
+                        delta = {"delta": {"content": piece}, "index": 0, "finish_reason": None}
+                    else:
+                        delta = {"text": piece, "index": 0, "finish_reason": None}
+                    chunk = {"id": req.request_id, "object": obj, "created": created,
+                             "model": self.engine.model_name, "choices": [delta]}
+                    await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
+            if ev.finish_reason is not None:
+                final_choice = ({"delta": {}, "index": 0, "finish_reason": ev.finish_reason.value}
+                                if chat else
+                                {"text": "", "index": 0, "finish_reason": ev.finish_reason.value})
+                chunk = {"id": req.request_id, "object": obj, "created": created,
+                         "model": self.engine.model_name, "choices": [final_choice],
+                         "usage": {"prompt_tokens": ev.prompt_tokens,
+                                   "completion_tokens": ev.completion_tokens,
+                                   "total_tokens": ev.prompt_tokens + ev.completion_tokens}}
+                await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
+                await resp.write(b"data: [DONE]\n\n")
+                break
+        await resp.write_eof()
+        return resp
+
+    # ---- handlers ------------------------------------------------------
+
+    async def completions(self, request: web.Request) -> web.StreamResponse:
+        body = await _json_body(request)
+        prompt_ids = self._tokenize_prompt(body.get("prompt", ""))
+        req = self._build_request(body, prompt_ids)
+        stops = self._stop_strings(body)
+        out = self.engine.submit(req)
+        try:
+            if req.stream:
+                return await self._stream(request, req, out, chat=False, stop_strings=stops)
+            return web.json_response(await self._collect(req, out, stops))
+        except (asyncio.CancelledError, ConnectionResetError):
+            self.engine.abort(req.request_id)  # client went away: stop decoding
+            raise
+
+    async def chat_completions(self, request: web.Request) -> web.StreamResponse:
+        body = await _json_body(request)
+        messages = body.get("messages", [])
+        prompt_ids = self.engine.tokenizer.encode(_chat_to_prompt(messages))
+        req = self._build_request(body, prompt_ids)
+        stops = self._stop_strings(body)
+        out = self.engine.submit(req)
+        try:
+            if req.stream:
+                return await self._stream(request, req, out, chat=True, stop_strings=stops)
+            resp = await self._collect(req, out, stops)
+        except (asyncio.CancelledError, ConnectionResetError):
+            self.engine.abort(req.request_id)
+            raise
+        resp["object"] = "chat.completion"
+        text = resp["choices"][0].pop("text")
+        resp["choices"][0]["message"] = {"role": "assistant", "content": text}
+        return web.json_response(resp)
+
+    async def render_completions(self, request: web.Request) -> web.Response:
+        body = await _json_body(request)
+        prompt_ids = self._tokenize_prompt(body.get("prompt", ""))
+        return web.json_response({"token_ids": prompt_ids, "count": len(prompt_ids)})
+
+    async def render_chat(self, request: web.Request) -> web.Response:
+        body = await _json_body(request)
+        rendered = _chat_to_prompt(body.get("messages", []))
+        prompt_ids = self.engine.tokenizer.encode(rendered)
+        return web.json_response({
+            "token_ids": prompt_ids, "count": len(prompt_ids), "rendered": rendered})
+
+    async def models(self, request: web.Request) -> web.Response:
+        return web.json_response({"object": "list", "data": [{
+            "id": self.engine.model_name, "object": "model",
+            "owned_by": "llm-d-inference-scheduler-tpu",
+        }]})
+
+    async def metrics(self, request: web.Request) -> web.Response:
+        return web.Response(body=self.engine.telemetry.render(),
+                            content_type="text/plain", charset="utf-8")
+
+    async def health(self, request: web.Request) -> web.Response:
+        return web.json_response({
+            "status": "ok", "engine_id": self.engine.engine_id,
+            "model": self.engine.model_name, "role": self.cfg.role,
+        })
+
+    # ---- KV handoff data path (P/D disaggregation) ---------------------
+
+    async def kv_fetch(self, request: web.Request) -> web.Response:
+        """Serve retained prefill KV pages for a request (host-staged DCN path).
+
+        Returns raw bytes: concatenated K then V, each
+        [L, n_blocks, block, Hkv, Dh] in the model dtype, plus geometry headers.
+        """
+        rid = request.match_info["request_id"]
+        rec = self.engine.kv_exports.get(rid)
+        if rec is None:
+            raise web.HTTPNotFound(text=f"no kv export for {rid}")
+        if "k" not in rec:
+            raise web.HTTPNotImplemented(text="sim engine holds no real KV")
+        k, v = rec["k"], rec["v"]
+        payload = k.tobytes() + v.tobytes()
+        return web.Response(body=payload, content_type="application/octet-stream", headers={
+            "x-kv-seq-len": str(rec["seq_len"]),
+            "x-kv-num-blocks": str(k.shape[1]),
+            "x-kv-dtype": str(k.dtype),
+            "x-kv-shape": json.dumps(list(k.shape)),
+            "x-kv-first-token": str(rec.get("first_token")),
+        })
+
+    async def kv_release(self, request: web.Request) -> web.Response:
+        rid = request.match_info["request_id"]
+        self.engine.release_kv_export(rid)
+        return web.json_response({"released": rid})
+
+
+async def run_server(cfg: EngineConfig):
+    server = EngineServer(cfg)
+    await server.start()
+    try:
+        while True:
+            await asyncio.sleep(3600)
+    except asyncio.CancelledError:
+        await server.stop()
+
+
+def main(argv: list[str] | None = None):
+    import argparse
+
+    p = argparse.ArgumentParser(description="TPU engine server")
+    p.add_argument("--model", default="tiny")
+    p.add_argument("--backend", default="tpu", choices=["tpu", "sim"])
+    p.add_argument("--port", type=int, default=8200)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--max-batch", type=int, default=8)
+    p.add_argument("--max-model-len", type=int, default=2048)
+    p.add_argument("--role", default="both")
+    p.add_argument("--served-model-name", default=None)
+    p.add_argument("--platform", default=None,
+                   help="pin the JAX platform (e.g. 'cpu'); needed to run a second "
+                        "engine process on a box whose TPU chip is already claimed")
+    args = p.parse_args(argv)
+    if args.platform:
+        import jax
+        jax.config.update("jax_platforms", args.platform)
+    cfg = EngineConfig(model=args.model, backend=args.backend, port=args.port,
+                       host=args.host, max_batch=args.max_batch,
+                       max_model_len=args.max_model_len, role=args.role,
+                       served_model_name=args.served_model_name)
+    logging.basicConfig(level=logging.INFO)
+    asyncio.run(run_server(cfg))
+
+
+if __name__ == "__main__":
+    main()

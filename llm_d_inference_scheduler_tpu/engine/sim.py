@@ -1,0 +1,150 @@
+"""SimEngine: CPU-only engine simulator.
+
+Plays the role llm-d-inference-sim plays in the reference's e2e suite
+(/root/reference/config/manifests/vllm/sim-deployment.yaml, SURVEY §4): a pod
+that looks exactly like a real engine to the router — same OpenAI surface,
+same telemetry contract, same P/D handshake — with scripted latencies, so the
+whole routing stack is testable without TPUs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+import uuid
+from typing import Any
+
+from .config import EngineConfig
+from .request import EngineRequest, FinishReason, TokenEvent
+from .telemetry import EngineTelemetry
+from .tokenizer import get_tokenizer
+
+_LOREM = "lorem ipsum dolor sit amet "
+
+
+class SimEngine:
+    def __init__(self, cfg: EngineConfig):
+        self.cfg = cfg
+        self.mcfg = cfg.model_config
+        self.engine_id = cfg.engine_id or f"sim-{uuid.uuid4().hex[:8]}"
+        self.tokenizer = get_tokenizer(cfg.tokenizer, self.mcfg.vocab_size)
+        self.model_name = cfg.model_name
+        block = self.mcfg.kv_block_size
+        self.n_blocks = cfg.num_kv_blocks()
+        self.telemetry = EngineTelemetry(block_size=block, num_blocks=self.n_blocks)
+        self._sem = asyncio.Semaphore(cfg.max_batch)
+        self._waiting = 0
+        self._running = 0
+        self._blocks_used = 0
+        self.kv_exports: dict[str, dict[str, Any]] = {}
+        self._tasks: dict[str, asyncio.Task] = {}
+        self._gen_tokens = self.tokenizer.encode(_LOREM, add_bos=False)
+
+    async def start(self):
+        pass
+
+    async def stop(self):
+        pass
+
+    def _update_gauges(self):
+        self.telemetry.waiting.set(self._waiting)
+        self.telemetry.running.set(self._running)
+        usable = max(self.n_blocks - 1, 1)
+        self.telemetry.kv_usage.set(min(self._blocks_used / usable, 1.0))
+
+    def submit(self, req: EngineRequest) -> asyncio.Queue:
+        out: asyncio.Queue = asyncio.Queue()
+        task = asyncio.get_running_loop().create_task(self._serve(req, out))
+        self._tasks[req.request_id] = task
+        task.add_done_callback(lambda _: self._tasks.pop(req.request_id, None))
+        return out
+
+    def abort(self, request_id: str) -> None:
+        task = self._tasks.get(request_id)
+        if task is not None:
+            task.cancel()
+
+    def release_kv_export(self, request_id: str) -> None:
+        rec = self.kv_exports.pop(request_id, None)
+        if rec:
+            self._blocks_used -= rec["n_blocks"]
+            self._update_gauges()
+
+    async def _serve(self, req: EngineRequest, out: asyncio.Queue):
+        self._waiting += 1
+        self._update_gauges()
+        try:
+            await self._sem.acquire()
+        except asyncio.CancelledError:  # aborted while queued
+            self._waiting -= 1
+            self._update_gauges()
+            out.put_nowait(TokenEvent(
+                request_id=req.request_id, token_id=None,
+                finish_reason=FinishReason.ABORT,
+                prompt_tokens=len(req.prompt_token_ids)))
+            return
+        try:
+            self._waiting -= 1
+            self._running += 1
+            prompt_len = len(req.prompt_token_ids)
+            block = self.mcfg.kv_block_size
+            n_blocks = -(-max(prompt_len + req.max_tokens, 1) // block)
+            self._blocks_used += n_blocks
+            self._update_gauges()
+            try:
+                await asyncio.sleep(self.cfg.sim_prefill_ms_per_token * prompt_len / 1000)
+                self.telemetry.prompt_tokens.inc(prompt_len)
+                self.telemetry.ttft.observe(time.monotonic() - req.arrival_time)
+
+                ktp = req.kv_transfer_params or {}
+                first = self._gen_tokens[0]
+                if ktp.get("do_remote_decode"):
+                    self.kv_exports[req.request_id] = {"n_blocks": n_blocks, "seq_len": prompt_len}
+                    block_ids = list(range(n_blocks))
+                    n_blocks = 0  # retained by the export, not released below
+                    out.put_nowait(TokenEvent(
+                        request_id=req.request_id, token_id=first,
+                        text=self.tokenizer.decode([first]),
+                        finish_reason=FinishReason.LENGTH, is_first=True,
+                        kv_transfer_params={
+                            "remote_engine_id": self.engine_id,
+                            "remote_request_id": req.request_id,
+                            "remote_block_ids": block_ids,
+                            "remote_seq_len": prompt_len,
+                            "remote_first_token": first,
+                            "remote_host": self.cfg.host,
+                            "remote_port": self.cfg.port,
+                        },
+                        prompt_tokens=prompt_len, completion_tokens=1))
+                    self.telemetry.request_success.labels(
+                        finished_reason=FinishReason.LENGTH.value).inc()
+                    return
+
+                n = max(req.max_tokens, 1)
+                for i in range(n):
+                    await asyncio.sleep(self.cfg.sim_decode_ms_per_token / 1000)
+                    tok = self._gen_tokens[i % len(self._gen_tokens)]
+                    self.telemetry.generation_tokens.inc()
+                    out.put_nowait(TokenEvent(
+                        request_id=req.request_id, token_id=tok,
+                        text=self.tokenizer.decode([tok]), is_first=(i == 0),
+                        prompt_tokens=prompt_len, completion_tokens=i + 1))
+                out.put_nowait(TokenEvent(
+                    request_id=req.request_id, token_id=None,
+                    finish_reason=FinishReason.LENGTH,
+                    prompt_tokens=prompt_len, completion_tokens=n))
+                self.telemetry.request_success.labels(
+                    finished_reason=FinishReason.LENGTH.value).inc()
+            except asyncio.CancelledError:
+                out.put_nowait(TokenEvent(
+                    request_id=req.request_id, token_id=None,
+                    finish_reason=FinishReason.ABORT,
+                    prompt_tokens=prompt_len))
+                self.telemetry.request_success.labels(
+                    finished_reason=FinishReason.ABORT.value).inc()
+            finally:
+                self._running -= 1
+                self._blocks_used -= n_blocks
+                self._update_gauges()
+        finally:
+            self._sem.release()

@@ -1,0 +1,48 @@
+"""Engine telemetry: the five-signal scrape contract + serving metrics.
+
+The reference's entire engine-telemetry contract is five vllm:* metric names
+scraped from each pod (/root/reference pkg/epp/server/options.go:121-125,
+SURVEY §2.5). The TPU engines publish the same shapes under jetstream:* names;
+the router's default extractor maps them (and can map vllm:* for heterogeneous
+fleets via its mapping registry).
+"""
+
+from __future__ import annotations
+
+from prometheus_client import CollectorRegistry, Counter, Gauge, Histogram, generate_latest
+
+WAITING = "jetstream:num_requests_waiting"
+RUNNING = "jetstream:num_requests_running"
+KV_USAGE = "jetstream:kv_cache_usage_perc"
+LORA_INFO = "jetstream:lora_requests_info"
+CACHE_CONFIG = "jetstream:cache_config_info"
+
+
+class EngineTelemetry:
+    def __init__(self, *, block_size: int, num_blocks: int):
+        self.registry = CollectorRegistry()
+        g = lambda name, doc, labels=(): Gauge(name, doc, labels, registry=self.registry)
+        self.waiting = g(WAITING, "Requests waiting for admission")
+        self.running = g(RUNNING, "Requests actively decoding")
+        self.kv_usage = g(KV_USAGE, "Fraction of HBM KV blocks in use")
+        self.lora_info = g(LORA_INFO, "Active/waiting LoRA adapters",
+                           ("running_lora_adapters", "waiting_lora_adapters", "max_lora"))
+        self.cache_config = g(CACHE_CONFIG, "KV cache geometry",
+                              ("block_size", "num_gpu_blocks"))
+        # num_gpu_blocks: label name kept scrape-compatible with the reference's
+        # extractor expectations; counts TPU HBM blocks.
+        self.cache_config.labels(block_size=str(block_size), num_gpu_blocks=str(num_blocks)).set(1)
+        self.lora_info.labels(running_lora_adapters="", waiting_lora_adapters="", max_lora="0").set(1)
+
+        self.prompt_tokens = Counter("jetstream:prompt_tokens_total", "Prefilled tokens",
+                                     registry=self.registry)
+        self.generation_tokens = Counter("jetstream:generation_tokens_total", "Decoded tokens",
+                                         registry=self.registry)
+        self.ttft = Histogram("jetstream:time_to_first_token_seconds", "TTFT",
+                              registry=self.registry,
+                              buckets=(.005, .01, .025, .05, .1, .25, .5, 1, 2.5, 5, 10))
+        self.request_success = Counter("jetstream:request_success_total", "Finished requests",
+                                       ("finished_reason",), registry=self.registry)
+
+    def render(self) -> bytes:
+        return generate_latest(self.registry)

@@ -1,0 +1,39 @@
+"""Tokenizers for the engine.
+
+ByteTokenizer is the default for tests/sim/bench: ids are raw UTF-8 bytes
+offset past the specials, so it round-trips any text, needs no vocab files,
+and incremental decode is prefix-safe. A HuggingFace tokenizer can be swapped
+in behind the same interface when real checkpoints are served.
+"""
+
+from __future__ import annotations
+
+
+class ByteTokenizer:
+    PAD = 0
+    BOS = 1
+    EOS = 2
+    _OFFSET = 3
+
+    def __init__(self, vocab_size: int = 512):
+        assert vocab_size >= self._OFFSET + 256, "vocab must fit all bytes"
+        self.vocab_size = vocab_size
+        self.eos_id = self.EOS
+        self.pad_id = self.PAD
+        self.bos_id = self.BOS
+
+    def encode(self, text: str, add_bos: bool = True) -> list[int]:
+        ids = [self._OFFSET + b for b in text.encode("utf-8")]
+        return [self.BOS] + ids if add_bos else ids
+
+    def decode(self, ids: list[int]) -> str:
+        # Ids past the byte range (possible under random-weight sampling) wrap
+        # modulo 256 so decode is total; true text ids round-trip unchanged.
+        data = bytes((i - self._OFFSET) % 256 for i in ids if i >= self._OFFSET)
+        return data.decode("utf-8", errors="replace")
+
+
+def get_tokenizer(name: str, vocab_size: int):
+    if name == "byte":
+        return ByteTokenizer(vocab_size)
+    raise ValueError(f"unknown tokenizer {name!r}")

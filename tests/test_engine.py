@@ -1,0 +1,187 @@
+"""Engine tests: continuous batching core, HTTP surface, telemetry, P/D handoff."""
+
+import asyncio
+import json
+
+import httpx
+import pytest
+
+from llm_d_inference_scheduler_tpu.engine import EngineConfig, EngineRequest
+from llm_d_inference_scheduler_tpu.engine.server import EngineServer
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def _cfg(backend, port, **kw):
+    kw.setdefault("model", "tiny")
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_model_len", 128)
+    return EngineConfig(backend=backend, port=port, **kw)
+
+
+# ---------- TpuEngine core (runs on CPU backend via conftest) ----------
+
+def test_tpu_engine_generates_and_batches():
+    async def body():
+        from llm_d_inference_scheduler_tpu.engine.core import TpuEngine
+
+        eng = TpuEngine(_cfg("tpu", 0))
+        await eng.start()
+        try:
+            reqs = [EngineRequest(request_id=f"r{i}", prompt_token_ids=[1] + [10 + i] * 5,
+                                  max_tokens=6) for i in range(3)]
+            outs = [eng.submit(r) for r in reqs]
+
+            async def drain(out):
+                evs = []
+                while True:
+                    ev = await asyncio.wait_for(out.get(), timeout=30)
+                    evs.append(ev)
+                    if ev.finish_reason is not None:
+                        return evs
+
+            results = await asyncio.gather(*[drain(o) for o in outs])
+            for r, evs in zip(reqs, results):
+                toks = [e.token_id for e in evs if e.token_id is not None]
+                assert 1 <= len(toks) <= r.max_tokens
+                assert evs[-1].finish_reason is not None
+            # all blocks returned
+            assert eng.allocator.free_blocks == eng.n_blocks - 1
+        finally:
+            await eng.stop()
+
+    run(body())
+
+
+def test_tpu_engine_greedy_matches_across_batching():
+    """The same prompt decoded alone and alongside others yields the same tokens
+    (continuous batching must not change results; greedy, f32-tolerant)."""
+    async def body():
+        from llm_d_inference_scheduler_tpu.engine.core import TpuEngine
+
+        eng = TpuEngine(_cfg("tpu", 0))
+        await eng.start()
+        try:
+            prompt = [1] + [42, 17, 9] * 3
+
+            async def gen(rid, prompt):
+                out = eng.submit(EngineRequest(request_id=rid, prompt_token_ids=prompt,
+                                               max_tokens=5))
+                toks = []
+                while True:
+                    ev = await asyncio.wait_for(out.get(), timeout=30)
+                    if ev.token_id is not None:
+                        toks.append(ev.token_id)
+                    if ev.finish_reason is not None:
+                        return toks
+
+            solo = await gen("solo", prompt)
+            batched = await asyncio.gather(
+                gen("a", prompt), gen("b", [1, 99, 98, 97]), gen("c", prompt))
+            assert batched[0] == solo and batched[2] == solo
+        finally:
+            await eng.stop()
+
+    run(body())
+
+
+# ---------- HTTP surface (sim backend) ----------
+
+def test_sim_server_openai_surface():
+    async def body():
+        cfg = _cfg("sim", 18301)
+        server = EngineServer(cfg)
+        await server.start()
+        try:
+            async with httpx.AsyncClient(base_url="http://127.0.0.1:18301") as c:
+                r = await c.post("/v1/completions",
+                                 json={"model": "tiny", "prompt": "hello", "max_tokens": 4})
+                assert r.status_code == 200
+                body_ = r.json()
+                assert body_["choices"][0]["finish_reason"] == "length"
+                assert body_["usage"]["completion_tokens"] == 4
+
+                r = await c.post("/v1/chat/completions", json={
+                    "model": "tiny",
+                    "messages": [{"role": "user", "content": "hi"}], "max_tokens": 3})
+                assert r.json()["choices"][0]["message"]["role"] == "assistant"
+
+                r = await c.get("/v1/models")
+                assert r.json()["data"][0]["id"] == "tiny"
+
+                r = await c.post("/v1/completions/render", json={"prompt": "abc"})
+                assert len(r.json()["token_ids"]) == 4  # BOS + 3 bytes
+
+                r = await c.get("/metrics")
+                text = r.text
+                for name in ("jetstream:num_requests_waiting",
+                             "jetstream:num_requests_running",
+                             "jetstream:kv_cache_usage_perc",
+                             "jetstream:cache_config_info",
+                             "jetstream:lora_requests_info"):
+                    assert name in text, f"missing metric {name}"
+
+                # streaming
+                async with c.stream("POST", "/v1/completions",
+                                    json={"prompt": "s", "max_tokens": 3,
+                                          "stream": True}) as r:
+                    chunks = []
+                    async for line in r.aiter_lines():
+                        if line.startswith("data: "):
+                            chunks.append(line[6:])
+                    assert chunks[-1] == "[DONE]"
+                    assert len(chunks) >= 4  # 3 tokens + final + DONE
+        finally:
+            await server.stop()
+
+    run(body())
+
+
+# ---------- P/D KV handoff between two real engines ----------
+
+def test_pd_handoff_between_tpu_engines():
+    """Prefill on engine A with do_remote_decode, decode on engine B importing
+    A's KV over HTTP; result must equal a monolithic decode on one engine."""
+    async def body():
+        prompt = [1] + [33, 44, 55] * 4
+        max_tokens = 6
+
+        mono = EngineServer(_cfg("tpu", 18311))
+        await mono.start()
+        try:
+            async with httpx.AsyncClient() as c:
+                r = await c.post("http://127.0.0.1:18311/v1/completions",
+                                 json={"prompt": prompt, "max_tokens": max_tokens},
+                                 timeout=60)
+                mono_text = r.json()["choices"][0]["text"]
+        finally:
+            await mono.stop()
+
+        pre = EngineServer(_cfg("tpu", 18312, role="prefill"))
+        dec = EngineServer(_cfg("tpu", 18313, role="decode"))
+        await pre.start()
+        await dec.start()
+        try:
+            async with httpx.AsyncClient(timeout=60) as c:
+                r1 = await c.post("http://127.0.0.1:18312/v1/completions", json={
+                    "prompt": prompt, "max_tokens": 1, "stream": False,
+                    "kv_transfer_params": {"do_remote_decode": True}})
+                assert r1.status_code == 200
+                ktp = r1.json()["kv_transfer_params"]
+                assert ktp["remote_seq_len"] == len(prompt)
+
+                r2 = await c.post("http://127.0.0.1:18313/v1/completions", json={
+                    "prompt": prompt, "max_tokens": max_tokens,
+                    "kv_transfer_params": ktp})
+                assert r2.status_code == 200
+                disagg_text = r2.json()["choices"][0]["text"]
+                assert disagg_text == mono_text
+                # export released after pull
+                assert not pre.engine.kv_exports
+        finally:
+            await pre.stop()
+            await dec.stop()
+
+    run(body())

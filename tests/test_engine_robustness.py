@@ -1,0 +1,162 @@
+"""Engine robustness: aborts, stop handling, rejection, P/D edge cases."""
+
+import asyncio
+
+import httpx
+
+from llm_d_inference_scheduler_tpu.engine import EngineConfig, EngineRequest
+from llm_d_inference_scheduler_tpu.engine.request import FinishReason
+from llm_d_inference_scheduler_tpu.engine.server import EngineServer
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def _cfg(backend, port, **kw):
+    kw.setdefault("model", "tiny")
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_model_len", 128)
+    return EngineConfig(backend=backend, port=port, **kw)
+
+
+def test_abort_mid_decode_frees_blocks():
+    async def body():
+        from llm_d_inference_scheduler_tpu.engine.core import TpuEngine
+
+        eng = TpuEngine(_cfg("tpu", 0))
+        await eng.start()
+        try:
+            req = EngineRequest(request_id="long", prompt_token_ids=[1, 5, 6],
+                                max_tokens=100, stop_token_ids=(99999,))
+            out = eng.submit(req)
+            ev = await asyncio.wait_for(out.get(), timeout=30)  # first token
+            assert ev.token_id is not None
+            eng.abort("long")
+            while True:
+                ev = await asyncio.wait_for(out.get(), timeout=30)
+                if ev.finish_reason is not None:
+                    break
+            assert ev.finish_reason == FinishReason.ABORT
+            for _ in range(50):  # engine thread frees asynchronously
+                if eng.allocator.free_blocks == eng.n_blocks - 1:
+                    break
+                await asyncio.sleep(0.05)
+            assert eng.allocator.free_blocks == eng.n_blocks - 1
+        finally:
+            await eng.stop()
+
+    run(body())
+
+
+def test_impossible_request_rejected_not_wedged():
+    async def body():
+        from llm_d_inference_scheduler_tpu.engine.core import TpuEngine
+
+        cfg = _cfg("tpu", 0, max_model_len=128, hbm_kv_blocks=3)
+        eng = TpuEngine(cfg)
+        await eng.start()
+        try:
+            # Needs 8 blocks of 16, only 2 usable exist -> immediate abort.
+            big = EngineRequest(request_id="big", prompt_token_ids=[1] * 100,
+                                max_tokens=28)
+            out = eng.submit(big)
+            ev = await asyncio.wait_for(out.get(), timeout=10)
+            assert ev.finish_reason == FinishReason.ABORT
+            # Engine still serves normal requests afterwards.
+            ok = EngineRequest(request_id="ok", prompt_token_ids=[1, 2, 3], max_tokens=2)
+            out2 = eng.submit(ok)
+            while True:
+                ev = await asyncio.wait_for(out2.get(), timeout=30)
+                if ev.finish_reason is not None:
+                    break
+            assert ev.finish_reason in (FinishReason.LENGTH, FinishReason.STOP)
+        finally:
+            await eng.stop()
+
+    run(body())
+
+
+def test_pd_import_block_count_exceeds_decode_allocation():
+    """Exporter retained more blocks (prompt+16 default) than the decode side
+    would allocate for max_tokens=1; import must still work."""
+    async def body():
+        pre = EngineServer(_cfg("tpu", 18321, role="prefill"))
+        dec = EngineServer(_cfg("tpu", 18322, role="decode"))
+        await pre.start()
+        await dec.start()
+        try:
+            prompt = [1] + list(range(10, 23))  # 14 tokens: 1 block of 16...
+            async with httpx.AsyncClient(timeout=60) as c:
+                r1 = await c.post("http://127.0.0.1:18321/v1/completions", json={
+                    "prompt": prompt,  # server default max_tokens=16 -> 2 blocks
+                    "kv_transfer_params": {"do_remote_decode": True}})
+                ktp = r1.json()["kv_transfer_params"]
+                assert ktp["remote_num_blocks"] == 2
+                r2 = await c.post("http://127.0.0.1:18322/v1/completions", json={
+                    "prompt": prompt, "max_tokens": 1,
+                    "kv_transfer_params": ktp})
+                assert r2.status_code == 200
+                assert r2.json()["usage"]["completion_tokens"] >= 1
+        finally:
+            await pre.stop()
+            await dec.stop()
+
+    run(body())
+
+
+def test_stop_strings_and_stop_token_ids():
+    async def body():
+        cfg = _cfg("sim", 18323)
+        server = EngineServer(cfg)
+        await server.start()
+        try:
+            async with httpx.AsyncClient(base_url="http://127.0.0.1:18323",
+                                         timeout=30) as c:
+                # sim emits "lorem ipsum dolor ..." -> stop at "ipsum"
+                r = await c.post("/v1/completions", json={
+                    "prompt": "x", "max_tokens": 30, "stop": ["ipsum"]})
+                body_ = r.json()
+                assert body_["choices"][0]["finish_reason"] == "stop"
+                assert "ipsum" not in body_["choices"][0]["text"]
+                assert body_["choices"][0]["text"].startswith("lorem")
+        finally:
+            await server.stop()
+
+    run(body())
+
+
+def test_kv_export_ttl_sweep():
+    async def body():
+        from llm_d_inference_scheduler_tpu.engine import core as core_mod
+        from llm_d_inference_scheduler_tpu.engine.core import TpuEngine
+
+        eng = TpuEngine(_cfg("tpu", 0))
+        old_ttl = core_mod.KV_EXPORT_TTL_S
+        core_mod.KV_EXPORT_TTL_S = 0.2
+        await eng.start()
+        try:
+            req = EngineRequest(request_id="exp", prompt_token_ids=[1, 2, 3],
+                                max_tokens=1,
+                                kv_transfer_params={"do_remote_decode": True})
+            out = eng.submit(req)
+            while True:
+                ev = await asyncio.wait_for(out.get(), timeout=30)
+                if ev.finish_reason is not None:
+                    break
+            assert ev.kv_transfer_params is not None
+            assert "exp" in eng.kv_exports
+            await asyncio.sleep(0.5)
+            # Submit another request so the engine loop runs a sweep.
+            out2 = eng.submit(EngineRequest(request_id="poke",
+                                            prompt_token_ids=[1, 2], max_tokens=1))
+            while True:
+                ev = await asyncio.wait_for(out2.get(), timeout=30)
+                if ev.finish_reason is not None:
+                    break
+            assert "exp" not in eng.kv_exports
+        finally:
+            core_mod.KV_EXPORT_TTL_S = old_ttl
+            await eng.stop()
+
+    run(body())
