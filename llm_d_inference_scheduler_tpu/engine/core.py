@@ -102,7 +102,10 @@ class TpuEngine:
         self._thread: threading.Thread | None = None
         self._sample_key = jax.random.key(cfg.seed + 1)
         # Host-staged KV exports for P/D handoff: request_id -> record.
+        # Guarded by _exports_lock: written by the engine thread, read/popped
+        # by the aiohttp event-loop thread (kv_fetch / kv_release).
         self.kv_exports: dict[str, dict[str, Any]] = {}
+        self._exports_lock = threading.Lock()
         self._prefill_fns: dict[int, Any] = {}
         self._jit_decode = jax.jit(self._decode_impl, donate_argnums=(3, 4))
         self._jit_sample = jax.jit(sample_tokens)
@@ -161,7 +164,12 @@ class TpuEngine:
 
     def release_kv_export(self, request_id: str) -> None:
         """Drop a staged P/D export once the decode side has pulled it."""
-        self.kv_exports.pop(request_id, None)
+        with self._exports_lock:
+            self.kv_exports.pop(request_id, None)
+
+    def get_kv_export(self, request_id: str) -> dict[str, Any] | None:
+        with self._exports_lock:
+            return self.kv_exports.get(request_id)
 
     # ---- engine thread -------------------------------------------------
 
@@ -226,10 +234,12 @@ class TpuEngine:
 
     def _sweep_exports(self):
         now = time.monotonic()
-        for rid in [r for r, rec in self.kv_exports.items()
-                    if now - rec["created"] > KV_EXPORT_TTL_S]:
-            log.warning("kv export %s expired unclaimed; dropping", rid)
-            self.kv_exports.pop(rid, None)
+        with self._exports_lock:
+            expired = [r for r, rec in self.kv_exports.items()
+                       if now - rec["created"] > KV_EXPORT_TTL_S]
+            for rid in expired:
+                log.warning("kv export %s expired unclaimed; dropping", rid)
+                self.kv_exports.pop(rid, None)
 
     def _process_aborts(self):
         with self._cond:
@@ -377,35 +387,58 @@ class TpuEngine:
                     blocks = self.allocator.alloc(need)
                     self.telemetry.kv_usage.set(self.allocator.used_fraction)
                 self._import_ready.pop(0)
-            if pi.error is not None:
-                # Reference semantics: fall back to local prefill on transfer
-                # failure (connector_nixlv2.go:160-177).
-                log.warning("kv import for %s failed (%s); local prefill fallback",
-                            pi.req.request_id, pi.error)
-                with self._cond:
-                    self._waiting.insert(0, (self._strip_remote(pi.req), pi.out, pi.loop))
-                    self.telemetry.waiting.set(len(self._waiting))
-                continue
-            idx = free[0]
-            self._import_into_slot(idx, pi, blocks)
+            if pi.error is None:
+                try:
+                    self._import_into_slot(free[0], pi, blocks)
+                    continue
+                except Exception as e:
+                    # Malformed payload/headers or geometry mismatch: reclaim
+                    # the allocation and degrade to local prefill.
+                    with self._cond:
+                        self.allocator.free(blocks)
+                        self.telemetry.kv_usage.set(self.allocator.used_fraction)
+                    pi.error = f"import rejected: {e}"
+            # Reference semantics: fall back to local prefill on transfer
+            # failure (connector_nixlv2.go:160-177).
+            log.warning("kv import for %s failed (%s); local prefill fallback",
+                        pi.req.request_id, pi.error)
+            with self._cond:
+                self._waiting.insert(0, (self._strip_remote(pi.req), pi.out, pi.loop))
+                self.telemetry.waiting.set(len(self._waiting))
 
     @staticmethod
     def _strip_remote(req: EngineRequest) -> EngineRequest:
         return dataclasses.replace(req, kv_transfer_params=None)
 
     def _import_into_slot(self, idx: int, pi: _PendingImport, blocks: list[int]):
+        """Validates and scatters a fetched KV payload; raises on any
+        malformed/mismatched import (caller falls back to local prefill)."""
         req, headers = pi.req, pi.headers or {}
-        shape = tuple(json.loads(headers["x-kv-shape"]))
+        shape = tuple(int(x) for x in json.loads(headers["x-kv-shape"]))
         seq_len = int(headers["x-kv-seq-len"])
         dtype = jnp.dtype(headers["x-kv-dtype"])
+        if len(shape) != 5:
+            raise ValueError(f"bad kv shape {shape}")
+        L, nb, block, Hkv, Dh = shape
+        if (L, block, Hkv, Dh) != (self.mcfg.n_layers, self.mcfg.kv_block_size,
+                                   self.mcfg.n_kv_heads, self.mcfg.head_dim):
+            raise ValueError(f"kv geometry mismatch: {shape} vs model "
+                             f"(L={self.mcfg.n_layers}, block={self.mcfg.kv_block_size}, "
+                             f"Hkv={self.mcfg.n_kv_heads}, Dh={self.mcfg.head_dim})")
+        if nb > self.max_blocks_per_seq or nb > len(blocks):
+            raise ValueError(f"{nb} exported blocks exceed budget "
+                             f"(maxB={self.max_blocks_per_seq}, alloc={len(blocks)})")
+        expected = 2 * int(np.prod(shape)) * dtype.itemsize
+        if len(pi.payload) != expected:
+            raise ValueError(f"kv payload size {len(pi.payload)} != expected {expected}")
+        if not (0 < seq_len <= nb * block):
+            raise ValueError(f"kv seq_len {seq_len} outside exported blocks")
         nbytes = len(pi.payload) // 2
         k_np = np.frombuffer(pi.payload[:nbytes], dtype=dtype).reshape(shape)
         v_np = np.frombuffer(pi.payload[nbytes:], dtype=dtype).reshape(shape)
-        nb = shape[1]
 
         # Pad to the fixed per-seq block budget so the scatter compiles once.
         maxB = self.max_blocks_per_seq
-        L, _, block, Hkv, Dh = shape
         k_pad = np.zeros((L, maxB, block, Hkv, Dh), dtype)
         v_pad = np.zeros((L, maxB, block, Hkv, Dh), dtype)
         k_pad[:, :nb], v_pad[:, :nb] = k_np, v_np
@@ -498,13 +531,14 @@ class TpuEngine:
             # HTTP thread never touches live (donated) page buffers. The ICI
             # fast path (device-to-device) replaces this copy for same-slice
             # prefill/decode pairs.
-            self.kv_exports[s.req.request_id] = {
-                "k": np.asarray(self.k_pages[:, s.blocks]),
-                "v": np.asarray(self.v_pages[:, s.blocks]),
-                "seq_len": s.position,  # prompt tokens in cache
-                "first_token": first_token,
-                "created": time.monotonic(),
-            }
+            with self._exports_lock:
+                self.kv_exports[s.req.request_id] = {
+                    "k": np.asarray(self.k_pages[:, s.blocks]),
+                    "v": np.asarray(self.v_pages[:, s.blocks]),
+                    "seq_len": s.position,  # prompt tokens in cache
+                    "first_token": first_token,
+                    "created": time.monotonic(),
+                }
             kv_params = {
                 "remote_engine_id": self.engine_id,
                 "remote_request_id": s.req.request_id,

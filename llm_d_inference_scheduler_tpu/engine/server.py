@@ -58,6 +58,20 @@ def _first_stop_hit(text: str, stop_strings: list[str] | None) -> int | None:
     return min(hits) if hits else None
 
 
+def _stop_holdback(text: str, stop_strings: list[str] | None) -> int:
+    """Length of the longest text suffix that is a proper prefix of a stop
+    string — held back so a stop spanning token boundaries never leaks."""
+    if not stop_strings:
+        return 0
+    hold = 0
+    for s in stop_strings:
+        for k in range(min(len(s) - 1, len(text)), 0, -1):
+            if text.endswith(s[:k]):
+                hold = max(hold, k)
+                break
+    return hold
+
+
 def _chat_to_prompt(messages: list[dict[str, Any]]) -> str:
     """Minimal chat template: role-tagged lines + assistant cue."""
     parts = []
@@ -114,17 +128,20 @@ class EngineServer:
         raise web.HTTPBadRequest(text="prompt must be a string or a list of token ids")
 
     def _build_request(self, body: dict[str, Any], prompt_ids: list[int]) -> EngineRequest:
-        return EngineRequest(
-            request_id=body.get("request_id") or f"req-{uuid.uuid4().hex[:12]}",
-            prompt_token_ids=prompt_ids,
-            max_tokens=int(body.get("max_tokens", 16)),
-            temperature=float(body.get("temperature", 0.0)),
-            top_k=int(body.get("top_k", 0)),
-            top_p=float(body.get("top_p", 1.0)),
-            stream=bool(body.get("stream", False)),
-            stop_token_ids=tuple(body.get("stop_token_ids") or ()),
-            kv_transfer_params=body.get("kv_transfer_params"),
-        )
+        try:
+            return EngineRequest(
+                request_id=str(body.get("request_id") or f"req-{uuid.uuid4().hex[:12]}"),
+                prompt_token_ids=prompt_ids,
+                max_tokens=int(body.get("max_tokens") or 16),
+                temperature=float(body.get("temperature") or 0.0),
+                top_k=int(body.get("top_k") or 0),
+                top_p=float(body.get("top_p") if body.get("top_p") is not None else 1.0),
+                stream=bool(body.get("stream", False)),
+                stop_token_ids=tuple(int(t) for t in (body.get("stop_token_ids") or ())),
+                kv_transfer_params=body.get("kv_transfer_params"),
+            )
+        except (TypeError, ValueError) as e:
+            raise web.HTTPBadRequest(text=f"invalid sampling/limit parameter: {e}")
 
     @staticmethod
     def _stop_strings(body: dict[str, Any]) -> list[str]:
@@ -186,37 +203,54 @@ class EngineServer:
         await resp.prepare(request)
         created = int(time.time())
         obj = "chat.completion.chunk" if chat else "text_completion"
-        acc = ""
+        n_prompt = len(req.prompt_token_ids)
+
+        async def write_piece(piece: str):
+            if not piece:
+                return
+            if chat:
+                delta = {"delta": {"content": piece}, "index": 0, "finish_reason": None}
+            else:
+                delta = {"text": piece, "index": 0, "finish_reason": None}
+            chunk = {"id": req.request_id, "object": obj, "created": created,
+                     "model": self.engine.model_name, "choices": [delta]}
+            await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
+
+        total = ""       # all generated text so far
+        emitted = 0      # prefix of `total` already written to the stream
         while True:
             ev: TokenEvent = await out.get()
             if ev.token_id is not None:
-                piece = ev.text
-                hit = _first_stop_hit(acc + piece, stop_strings)
+                total += ev.text
+                hit = _first_stop_hit(total, stop_strings)
                 if hit is not None:
-                    piece = (acc + piece)[:hit][len(acc):]
+                    await write_piece(total[emitted:hit])
+                    emitted = hit
                     self.engine.abort(req.request_id)
                     ev = TokenEvent(request_id=req.request_id, token_id=None,
                                     finish_reason=FinishReason.STOP,
-                                    prompt_tokens=ev.prompt_tokens,
+                                    prompt_tokens=n_prompt,
                                     completion_tokens=ev.completion_tokens)
-                acc += piece
-                if piece:
-                    if chat:
-                        delta = {"delta": {"content": piece}, "index": 0, "finish_reason": None}
-                    else:
-                        delta = {"text": piece, "index": 0, "finish_reason": None}
-                    chunk = {"id": req.request_id, "object": obj, "created": created,
-                             "model": self.engine.model_name, "choices": [delta]}
-                    await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
+                else:
+                    # Hold back any suffix that could be the start of a stop
+                    # string spanning token boundaries.
+                    safe = len(total) - _stop_holdback(total, stop_strings)
+                    if safe > emitted:
+                        await write_piece(total[emitted:safe])
+                        emitted = safe
             if ev.finish_reason is not None:
+                if ev.finish_reason != FinishReason.STOP and emitted < len(total):
+                    await write_piece(total[emitted:])  # flush holdback
+                    emitted = len(total)
+                prompt_tokens = ev.prompt_tokens or n_prompt
                 final_choice = ({"delta": {}, "index": 0, "finish_reason": ev.finish_reason.value}
                                 if chat else
                                 {"text": "", "index": 0, "finish_reason": ev.finish_reason.value})
                 chunk = {"id": req.request_id, "object": obj, "created": created,
                          "model": self.engine.model_name, "choices": [final_choice],
-                         "usage": {"prompt_tokens": ev.prompt_tokens,
+                         "usage": {"prompt_tokens": prompt_tokens,
                                    "completion_tokens": ev.completion_tokens,
-                                   "total_tokens": ev.prompt_tokens + ev.completion_tokens}}
+                                   "total_tokens": prompt_tokens + ev.completion_tokens}}
                 await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
                 await resp.write(b"data: [DONE]\n\n")
                 break
@@ -295,7 +329,8 @@ class EngineServer:
         [L, n_blocks, block, Hkv, Dh] in the model dtype, plus geometry headers.
         """
         rid = request.match_info["request_id"]
-        rec = self.engine.kv_exports.get(rid)
+        get = getattr(self.engine, "get_kv_export", self.engine.kv_exports.get)
+        rec = get(rid)
         if rec is None:
             raise web.HTTPNotFound(text=f"no kv export for {rid}")
         if "k" not in rec:

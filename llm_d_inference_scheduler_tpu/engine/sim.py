@@ -47,10 +47,20 @@ class SimEngine:
         pass
 
     def _update_gauges(self):
+        self._sweep_exports()
         self.telemetry.waiting.set(self._waiting)
         self.telemetry.running.set(self._running)
         usable = max(self.n_blocks - 1, 1)
         self.telemetry.kv_usage.set(min(self._blocks_used / usable, 1.0))
+
+    def _sweep_exports(self):
+        # Decoders can never pull real KV from a sim (kv_fetch is 501), so
+        # unclaimed exports must expire or kv_usage ratchets to 1.0.
+        from .core import KV_EXPORT_TTL_S
+        now = time.monotonic()
+        for rid in [r for r, rec in self.kv_exports.items()
+                    if now - rec.get("created", now) > KV_EXPORT_TTL_S]:
+            self.release_kv_export(rid)
 
     def submit(self, req: EngineRequest) -> asyncio.Queue:
         out: asyncio.Queue = asyncio.Queue()
@@ -99,7 +109,9 @@ class SimEngine:
                 ktp = req.kv_transfer_params or {}
                 first = self._gen_tokens[0]
                 if ktp.get("do_remote_decode"):
-                    self.kv_exports[req.request_id] = {"n_blocks": n_blocks, "seq_len": prompt_len}
+                    self.kv_exports[req.request_id] = {
+                        "n_blocks": n_blocks, "seq_len": prompt_len,
+                        "created": time.monotonic()}
                     block_ids = list(range(n_blocks))
                     n_blocks = 0  # retained by the export, not released below
                     out.put_nowait(TokenEvent(

@@ -1,0 +1,8 @@
+"""The router half: TPU-native Endpoint Picker (EPP) + disaggregation sidecar.
+
+Implements the capabilities of the reference's control plane
+(/root/reference, llm-d/llm-d-inference-scheduler — see SURVEY.md):
+scheduler with pluggable filters/scorers/pickers, data layer scraping
+JetStream-style engine telemetry, flow control, request orchestration, and the
+prefill/decode disaggregation protocol — re-targeted at TPU engines.
+"""

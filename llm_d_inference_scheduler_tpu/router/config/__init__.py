@@ -1,0 +1,3 @@
+from .loader import RouterConfig, load_config, load_raw_config, instantiate
+
+__all__ = ["RouterConfig", "load_config", "load_raw_config", "instantiate"]

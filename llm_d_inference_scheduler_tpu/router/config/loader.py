@@ -1,0 +1,211 @@
+"""EndpointPickerConfig loader: two-phase (raw YAML → instantiate/validate).
+
+Mirrors /root/reference/pkg/epp/config/loader/{configloader.go:79-303,
+defaults.go:42-340}: phase one parses the YAML and applies feature gates;
+phase two instantiates plugins through the registry and injects system
+defaults — the built-in default profile (queue w=2 + kv-cache-utilization w=2
++ prefix-cache w=3), single-profile-handler when one profile has no handler,
+max-score-picker for picker-less profiles, weight 1.0 for weightless scorers,
+openai-parser when none is configured, and the metrics source/extractor
+unless injectDefaults is false.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import yaml
+
+from ..datalayer.datastore import Datastore, EndpointPool
+from ..datalayer.extractor import CoreMetricsExtractor
+from ..datalayer.metrics_source import MetricsDataSource
+from ..datalayer.runtime import DataLayerRuntime
+from ..framework.datalayer import EndpointMetadata
+from ..framework.plugin import PluginRegistry, global_registry
+from ..scheduling.scheduler import Scheduler, SchedulerProfile, WeightedScorer
+
+DEFAULT_PROFILE_PLUGINS = [
+    # reference defaults.go:46-103
+    {"type": "queue-scorer", "weight": 2},
+    {"type": "kv-cache-utilization-scorer", "weight": 2},
+    {"type": "prefix-cache-scorer", "weight": 3},
+]
+
+
+@dataclasses.dataclass
+class RawConfig:
+    feature_gates: dict[str, bool]
+    plugins: list[dict[str, Any]]
+    scheduling_profiles: list[dict[str, Any]]
+    parser: dict[str, Any] | None
+    data_layer: dict[str, Any]
+    flow_control: dict[str, Any]
+    saturation_detector: dict[str, Any] | None
+    pool: dict[str, Any]
+
+
+@dataclasses.dataclass
+class RouterConfig:
+    scheduler: Scheduler
+    plugins_by_name: dict[str, Any]
+    producers: list[Any]
+    admit_plugins: list[Any]
+    pre_request_plugins: list[Any]
+    response_received: list[Any]
+    response_streaming: list[Any]
+    response_complete: list[Any]
+    feature_gates: dict[str, bool]
+    parser_spec: dict[str, Any]
+    flow_control: dict[str, Any]
+    saturation_detector_spec: dict[str, Any] | None
+    static_endpoints: list[EndpointMetadata]
+    pool: EndpointPool
+
+
+@dataclasses.dataclass
+class Handle:
+    """Shared services visible to plugin factories (reference plugin.Handle)."""
+
+    datastore: Datastore | None = None
+    dl_runtime: DataLayerRuntime | None = None
+
+
+def load_raw_config(text: str | None) -> RawConfig:
+    doc = yaml.safe_load(text) if text else {}
+    doc = doc or {}
+    return RawConfig(
+        feature_gates=doc.get("featureGates") or {},
+        plugins=doc.get("plugins") or [],
+        scheduling_profiles=doc.get("schedulingProfiles") or [],
+        parser=doc.get("parser"),
+        data_layer=doc.get("dataLayer") or {},
+        flow_control=doc.get("flowControl") or {},
+        saturation_detector=doc.get("saturationDetector"),
+        pool=doc.get("pool") or {},
+    )
+
+
+def _endpoint_meta(e: dict[str, Any]) -> EndpointMetadata:
+    return EndpointMetadata(
+        name=e.get("name") or f"{e['address']}:{e['port']}",
+        address=e["address"],
+        port=int(e["port"]),
+        metrics_port=int(e["metricsPort"]) if e.get("metricsPort") else None,
+        labels=e.get("labels") or {},
+    )
+
+
+def instantiate(raw: RawConfig, handle: Handle,
+                registry: PluginRegistry | None = None) -> RouterConfig:
+    registry = registry or global_registry
+
+    plugin_specs = list(raw.plugins)
+    profiles_spec = list(raw.scheduling_profiles)
+
+    # --- system default injection (reference defaults.go:146-327) --------
+    if not profiles_spec:
+        for spec in DEFAULT_PROFILE_PLUGINS:
+            if not any(p.get("type") == spec["type"] for p in plugin_specs):
+                plugin_specs.append({"type": spec["type"]})
+        profiles_spec = [{
+            "name": "default",
+            "plugins": [{"pluginRef": s["type"], "weight": s.get("weight", 1)}
+                        for s in DEFAULT_PROFILE_PLUGINS],
+        }]
+
+    # Instantiate declared plugins.
+    plugins_by_name: dict[str, Any] = {}
+    for spec in plugin_specs:
+        ptype = spec["type"]
+        name = spec.get("name") or ptype
+        if name in plugins_by_name:
+            raise ValueError(f"duplicate plugin name {name!r}")
+        plugins_by_name[name] = registry.instantiate(
+            ptype, name, spec.get("parameters") or {}, handle)
+
+    def _ensure(type_name: str) -> Any:
+        if type_name not in plugins_by_name:
+            plugins_by_name[type_name] = registry.instantiate(type_name, type_name, {}, handle)
+        return plugins_by_name[type_name]
+
+    # Build profiles.
+    profiles: dict[str, SchedulerProfile] = {}
+    profile_handler = None
+    for pspec in profiles_spec:
+        pname = pspec.get("name") or "default"
+        filters, scorers, picker = [], [], None
+        for ref in pspec.get("plugins") or []:
+            plugin = plugins_by_name.get(ref["pluginRef"])
+            if plugin is None:
+                raise ValueError(f"profile {pname!r} references unknown plugin "
+                                 f"{ref['pluginRef']!r}")
+            if hasattr(plugin, "pick"):
+                picker = plugin
+            elif hasattr(plugin, "score"):
+                scorers.append(WeightedScorer(plugin, float(ref.get("weight", 1.0))))
+            elif hasattr(plugin, "filter"):
+                filters.append(plugin)
+            else:
+                raise ValueError(f"plugin {ref['pluginRef']!r} fits no profile role")
+        if picker is None:
+            picker = _ensure("max-score-picker")  # defaults.go: picker injection
+        profiles[pname] = SchedulerProfile(pname, filters, scorers, picker)
+
+    # Profile handler: explicit plugin wins; else single-profile-handler.
+    for plugin in plugins_by_name.values():
+        if hasattr(plugin, "pick_profiles"):
+            profile_handler = plugin
+    if profile_handler is None:
+        if len(profiles) > 1:
+            raise ValueError("multiple scheduling profiles need an explicit "
+                             "profile-handler plugin")
+        profile_handler = _ensure("single-profile-handler")
+
+    # Bucket request-control plugins by capability (reference
+    # requestcontrol/request_control_config.go).
+    producers = [p for p in plugins_by_name.values() if hasattr(p, "produce")]
+    admit = [p for p in plugins_by_name.values() if hasattr(p, "admit")]
+    pre_request = [p for p in plugins_by_name.values() if hasattr(p, "pre_request")]
+    resp_received = [p for p in plugins_by_name.values() if hasattr(p, "response_received")]
+    resp_streaming = [p for p in plugins_by_name.values() if hasattr(p, "response_streaming")]
+    resp_complete = [p for p in plugins_by_name.values() if hasattr(p, "response_complete")]
+
+    # Data layer defaults: metrics source + core extractor unless disabled.
+    inject_dl = (raw.data_layer.get("injectDefaults", True)
+                 if isinstance(raw.data_layer, dict) else True)
+    if handle.dl_runtime is not None and inject_dl:
+        if not handle.dl_runtime.sources:
+            src = MetricsDataSource("metrics-data-source")
+            src.add_extractor(CoreMetricsExtractor("core-metrics-extractor"))
+            handle.dl_runtime.register_source(src)
+
+    parser_spec = raw.parser or {"type": "openai-parser"}
+
+    pool_spec = raw.pool
+    pool = EndpointPool(
+        name=pool_spec.get("name", "default-pool"),
+        namespace=pool_spec.get("namespace", "default"),
+    )
+    static_endpoints = [_endpoint_meta(e) for e in pool_spec.get("endpoints") or []]
+
+    return RouterConfig(
+        scheduler=Scheduler(profiles, profile_handler),
+        plugins_by_name=plugins_by_name,
+        producers=producers,
+        admit_plugins=admit,
+        pre_request_plugins=pre_request,
+        response_received=resp_received,
+        response_streaming=resp_streaming,
+        response_complete=resp_complete,
+        feature_gates=raw.feature_gates,
+        parser_spec=parser_spec,
+        flow_control=raw.flow_control,
+        saturation_detector_spec=raw.saturation_detector,
+        static_endpoints=static_endpoints,
+        pool=pool,
+    )
+
+
+def load_config(text: str | None, handle: Handle) -> RouterConfig:
+    return instantiate(load_raw_config(text), handle)

@@ -1,0 +1,8 @@
+from .datastore import Datastore, EndpointPool
+from .runtime import DataLayerRuntime
+from .metrics_source import MetricsDataSource
+from .extractor import CoreMetricsExtractor, MappingRegistry
+from .data_graph import validate_and_order_producers
+
+__all__ = ["Datastore", "EndpointPool", "DataLayerRuntime", "MetricsDataSource",
+           "CoreMetricsExtractor", "MappingRegistry", "validate_and_order_producers"]

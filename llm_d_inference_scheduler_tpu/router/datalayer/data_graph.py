@@ -1,0 +1,63 @@
+"""Producer/consumer data-DAG ordering.
+
+Mirrors /root/reference/pkg/epp/datalayer/data_graph.go:
+ValidateAndOrderDataDependencies — topologically sorts DataProducer plugins by
+their Produces()/Consumes() keys and rejects cycles, so producers always run
+after the producers of their inputs.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+class DataDependencyError(Exception):
+    pass
+
+
+def validate_and_order_producers(producers: list[Any]) -> list[Any]:
+    """Topo-sort producers so consumed keys are produced first; raise on cycles."""
+    produced_by: dict[str, Any] = {}
+    for p in producers:
+        for key in p.produces():
+            if key in produced_by:
+                raise DataDependencyError(
+                    f"attribute {key!r} produced by both "
+                    f"{produced_by[key].typed_name()} and {p.typed_name()}")
+            produced_by[key] = p
+
+    # edges: producer-of-consumed-key -> consumer
+    indeg = {id(p): 0 for p in producers}
+    edges: dict[int, list[Any]] = {id(p): [] for p in producers}
+    for p in producers:
+        for key in p.consumes():
+            dep = produced_by.get(key)
+            if dep is not None and dep is not p:
+                edges[id(dep)].append(p)
+                indeg[id(p)] += 1
+
+    ready = [p for p in producers if indeg[id(p)] == 0]
+    out: list[Any] = []
+    while ready:
+        p = ready.pop(0)
+        out.append(p)
+        for q in edges[id(p)]:
+            indeg[id(q)] -= 1
+            if indeg[id(q)] == 0:
+                ready.append(q)
+    if len(out) != len(producers):
+        stuck = [str(p.typed_name()) for p in producers if p not in out]
+        raise DataDependencyError(f"data-dependency cycle among producers: {stuck}")
+    return out
+
+
+def unsatisfied_keys(producers: list[Any], consumers: list[Any]) -> set[str]:
+    """Attribute keys consumed by scorers/producers that nothing produces
+    (reference: CreateMissingDataProducers feeds on this)."""
+    produced = {k for p in producers for k in p.produces()}
+    wanted: set[str] = set()
+    for c in consumers:
+        get = getattr(c, "consumes", None)
+        if get:
+            wanted.update(get())
+    return wanted - produced

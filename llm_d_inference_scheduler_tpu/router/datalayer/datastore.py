@@ -1,0 +1,137 @@
+"""Datastore: the router's view of the endpoint pool + inference objectives +
+model rewrites.
+
+Mirrors /root/reference/pkg/epp/datastore/datastore.go:62-475. In standalone
+mode (no k8s) the pool is seeded from config; a k8s reconciler layer can drive
+the same mutation API.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Callable, Iterable
+
+from ..framework.datalayer import Endpoint, EndpointMetadata
+
+
+@dataclasses.dataclass
+class EndpointPool:
+    name: str = "default-pool"
+    namespace: str = "default"
+    target_ports: list[int] = dataclasses.field(default_factory=list)
+    selector: dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class InferenceObjective:
+    name: str
+    priority: int = 0
+
+
+@dataclasses.dataclass
+class ModelRewriteTarget:
+    model: str
+    weight: int = 1
+
+
+@dataclasses.dataclass
+class InferenceModelRewrite:
+    """Weighted model-name rewrite (A/B, canary) — reference
+    apix/v1alpha2/inferencemodelrewrite_types.go:29-176."""
+
+    name: str
+    source_model: str
+    targets: list[ModelRewriteTarget] = dataclasses.field(default_factory=list)
+
+    def pick_target(self, rng: random.Random | None = None) -> str:
+        rng = rng or random
+        total = sum(t.weight for t in self.targets) or 1
+        r = rng.uniform(0, total)
+        acc = 0.0
+        for t in self.targets:
+            acc += t.weight
+            if r <= acc:
+                return t.model
+        return self.targets[-1].model if self.targets else self.source_model
+
+
+class Datastore:
+    def __init__(self):
+        self._pool: EndpointPool | None = None
+        self._endpoints: dict[str, Endpoint] = {}  # key: address_port
+        self._objectives: dict[str, InferenceObjective] = {}
+        self._rewrites: dict[str, InferenceModelRewrite] = {}
+        self._listeners: list[Callable[[str, Endpoint], None]] = []
+
+    # ---- pool ----------------------------------------------------------
+
+    def pool_set(self, pool: EndpointPool | None) -> None:
+        self._pool = pool
+
+    def pool_get(self) -> EndpointPool | None:
+        return self._pool
+
+    @property
+    def pool_ready(self) -> bool:
+        return self._pool is not None
+
+    # ---- endpoints -----------------------------------------------------
+
+    def on_endpoint_event(self, fn: Callable[[str, Endpoint], None]) -> None:
+        """fn(event, endpoint) with event in {'added','removed'}."""
+        self._listeners.append(fn)
+
+    def endpoint_add_or_update(self, meta: EndpointMetadata) -> Endpoint:
+        key = meta.address_port
+        ep = self._endpoints.get(key)
+        if ep is None:
+            ep = Endpoint(meta)
+            self._endpoints[key] = ep
+            for fn in self._listeners:
+                fn("added", ep)
+        else:
+            ep.metadata = meta
+        return ep
+
+    def endpoint_delete(self, address_port: str) -> None:
+        ep = self._endpoints.pop(address_port, None)
+        if ep is not None:
+            for fn in self._listeners:
+                fn("removed", ep)
+
+    def endpoint_list(self, predicate: Callable[[Endpoint], bool] | None = None) -> list[Endpoint]:
+        eps = list(self._endpoints.values())
+        return [e for e in eps if predicate(e)] if predicate else eps
+
+    def endpoint_get(self, address_port: str) -> Endpoint | None:
+        return self._endpoints.get(address_port)
+
+    def resync(self, metas: Iterable[EndpointMetadata]) -> None:
+        """Replace the endpoint set (pool change / reconciler resync)."""
+        new_keys = set()
+        for m in metas:
+            new_keys.add(m.address_port)
+            self.endpoint_add_or_update(m)
+        for key in [k for k in self._endpoints if k not in new_keys]:
+            self.endpoint_delete(key)
+
+    # ---- objectives & rewrites ----------------------------------------
+
+    def objective_set(self, obj: InferenceObjective) -> None:
+        self._objectives[obj.name] = obj
+
+    def objective_delete(self, name: str) -> None:
+        self._objectives.pop(name, None)
+
+    def objective_get(self, name: str) -> InferenceObjective | None:
+        return self._objectives.get(name)
+
+    def rewrite_set(self, rw: InferenceModelRewrite) -> None:
+        self._rewrites[rw.source_model] = rw
+
+    def rewrite_delete(self, source_model: str) -> None:
+        self._rewrites.pop(source_model, None)
+
+    def rewrite_for(self, source_model: str) -> InferenceModelRewrite | None:
+        return self._rewrites.get(source_model)

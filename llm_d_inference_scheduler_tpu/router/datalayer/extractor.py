@@ -1,0 +1,147 @@
+"""Core metrics extractor: Prometheus text → Metrics snapshot.
+
+Mirrors the reference's core-metrics-extractor with its per-engine-type
+MappingRegistry (/root/reference/pkg/epp/framework/plugins/datalayer/extractor/
+metrics/mapping_registry.go:24-40): heterogeneous fleets map different metric
+names per pod via the `llm-d.ai/engine-type` label; `default` is the fallback.
+The default mapping speaks the TPU engines' jetstream:* contract; a vllm
+mapping ships for mixed fleets.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any
+
+from prometheus_client.parser import text_string_to_metric_families
+
+from ..framework.datalayer import ENGINE_TYPE_LABEL, Endpoint
+from ..framework.plugin import PluginBase
+
+
+@dataclasses.dataclass(frozen=True)
+class MetricSpec:
+    """Metric name + optional label matches (reference backend/metrics/
+    metrics_spec.go:25-119)."""
+
+    name: str
+    labels: dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass(frozen=True)
+class MetricMapping:
+    waiting: MetricSpec
+    running: MetricSpec
+    kv_usage: MetricSpec
+    lora_info: MetricSpec | None = None
+    cache_config: MetricSpec | None = None
+
+
+JETSTREAM_MAPPING = MetricMapping(
+    waiting=MetricSpec("jetstream:num_requests_waiting"),
+    running=MetricSpec("jetstream:num_requests_running"),
+    kv_usage=MetricSpec("jetstream:kv_cache_usage_perc"),
+    lora_info=MetricSpec("jetstream:lora_requests_info"),
+    cache_config=MetricSpec("jetstream:cache_config_info"),
+)
+
+VLLM_MAPPING = MetricMapping(
+    waiting=MetricSpec("vllm:num_requests_waiting"),
+    running=MetricSpec("vllm:num_requests_running"),
+    kv_usage=MetricSpec("vllm:kv_cache_usage_perc"),
+    lora_info=MetricSpec("vllm:lora_requests_info"),
+    cache_config=MetricSpec("vllm:cache_config_info"),
+)
+
+
+class MappingRegistry:
+    def __init__(self):
+        self._by_engine: dict[str, MetricMapping] = {
+            "default": JETSTREAM_MAPPING,
+            "jetstream": JETSTREAM_MAPPING,
+            "tpu": JETSTREAM_MAPPING,
+            "vllm": VLLM_MAPPING,
+        }
+
+    def register(self, engine_type: str, mapping: MetricMapping) -> None:
+        self._by_engine[engine_type] = mapping
+
+    def for_endpoint(self, ep: Endpoint) -> MetricMapping:
+        et = ep.metadata.labels.get(ENGINE_TYPE_LABEL, "default")
+        return self._by_engine.get(et, self._by_engine["default"])
+
+
+def _sample_value(families: dict, spec: MetricSpec):
+    fam = families.get(spec.name)
+    if fam is None:
+        return None, None
+    best = None
+    for s in fam.samples:
+        if s.name != spec.name:
+            continue
+        if all(s.labels.get(k) == v for k, v in spec.labels.items()):
+            best = s
+    return (best.value, best.labels) if best is not None else (None, None)
+
+
+class CoreMetricsExtractor(PluginBase):
+    TYPE = "core-metrics-extractor"
+
+    def __init__(self, name: str | None = None, registry: MappingRegistry | None = None):
+        super().__init__(name)
+        self.registry = registry or MappingRegistry()
+
+    def configure(self, params: dict[str, Any], handle: Any) -> None:
+        # engineConfigs: {engineType: {waiting: name, running: name, kvUsage: name}}
+        for et, cfg in (params.get("engineConfigs") or {}).items():
+            self.registry.register(et, MetricMapping(
+                waiting=MetricSpec(cfg["waiting"]),
+                running=MetricSpec(cfg["running"]),
+                kv_usage=MetricSpec(cfg["kvUsage"]),
+                lora_info=MetricSpec(cfg["loraInfo"]) if "loraInfo" in cfg else None,
+                cache_config=MetricSpec(cfg["cacheConfig"]) if "cacheConfig" in cfg else None,
+            ))
+
+    def extract(self, raw: Any, endpoint: Endpoint) -> None:
+        if not raw:
+            return
+        mapping = self.registry.for_endpoint(endpoint)
+        families = {f.name: f for f in text_string_to_metric_families(raw)}
+        # prometheus_client strips the _total/_info suffixes into family names;
+        # index under both the family name and the sample names.
+        for f in list(families.values()):
+            for s in f.samples:
+                families.setdefault(s.name, f)
+
+        m = endpoint.metrics
+        v, _ = _sample_value(families, mapping.waiting)
+        if v is not None:
+            m.waiting_queue_size = int(v)
+        v, _ = _sample_value(families, mapping.running)
+        if v is not None:
+            m.running_requests_size = int(v)
+        v, _ = _sample_value(families, mapping.kv_usage)
+        if v is not None:
+            m.kv_cache_usage_percent = float(v)
+        if mapping.lora_info:
+            v, labels = _sample_value(families, mapping.lora_info)
+            if v is not None and labels:
+                running = [x for x in labels.get("running_lora_adapters", "").split(",") if x]
+                waiting = [x for x in labels.get("waiting_lora_adapters", "").split(",") if x]
+                m.active_models = {name: 1 for name in running}
+                m.waiting_models = {name: 1 for name in waiting}
+                try:
+                    m.max_active_models = int(labels.get("max_lora", "0"))
+                except ValueError:
+                    pass
+        if mapping.cache_config:
+            v, labels = _sample_value(families, mapping.cache_config)
+            if v is not None and labels:
+                try:
+                    m.cache_block_size = int(labels.get("block_size", "0"))
+                    m.cache_num_blocks = int(labels.get("num_gpu_blocks", "0") or 0)
+                    m.kv_cache_max_token_capacity = m.cache_block_size * m.cache_num_blocks
+                except ValueError:
+                    pass
+        m.update_time = time.monotonic()

@@ -1,0 +1,52 @@
+"""Metrics data source: scrapes each endpoint's Prometheus /metrics.
+
+Reference: framework/plugins/datalayer/source/metrics (HTTP scrape) feeding
+core-metrics-extractor — SURVEY §2.5.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+import httpx
+
+from ..framework.datalayer import Endpoint
+from ..framework.plugin import PluginBase
+
+log = logging.getLogger("router.datalayer.metrics")
+
+
+class MetricsDataSource(PluginBase):
+    TYPE = "metrics-data-source"
+
+    def __init__(self, name: str | None = None, timeout_s: float = 2.0):
+        super().__init__(name)
+        self._extractors: list[Any] = []
+        self._timeout = timeout_s
+        self._client: httpx.AsyncClient | None = None
+
+    def configure(self, params: dict[str, Any], handle: Any) -> None:
+        self._timeout = float(params.get("timeoutSeconds", self._timeout))
+
+    def add_extractor(self, ex: Any) -> None:
+        self._extractors.append(ex)
+
+    def extractors(self) -> list[Any]:
+        return list(self._extractors)
+
+    async def collect(self, endpoint: Endpoint) -> str | None:
+        if self._client is None:
+            self._client = httpx.AsyncClient(timeout=self._timeout)
+        try:
+            r = await self._client.get(endpoint.metadata.metrics_url)
+            r.raise_for_status()
+            return r.text
+        except Exception as e:
+            log.debug("scrape failed for %s: %s", endpoint.metadata.address_port, e)
+            return None
+
+    async def close(self):
+        if self._client is not None:
+            await self._client.aclose()
+            self._client = None

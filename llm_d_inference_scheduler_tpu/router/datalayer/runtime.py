@@ -1,0 +1,111 @@
+"""Data-layer runtime: per-endpoint collectors on a poll ticker.
+
+Mirrors /root/reference/pkg/epp/datalayer/{runtime.go:36-466,
+collector.go:52-154}: the runtime owns registered data sources; each endpoint
+gets a Collector task that, every tick (default 50ms like the reference),
+runs source.collect() and feeds the raw result through the source's
+extractors, updating the endpoint's Metrics/Attributes in place. Endpoint
+lifecycle events fan out to registered EndpointLifecycle plugins.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any
+
+from ..framework.datalayer import Endpoint
+from .datastore import Datastore
+
+log = logging.getLogger("router.datalayer.runtime")
+
+DEFAULT_POLL_INTERVAL_S = 0.05  # reference: datalayer/collector.go:52
+
+
+class _Collector:
+    def __init__(self, endpoint: Endpoint, sources: list[Any], interval: float):
+        self.endpoint = endpoint
+        self.sources = sources
+        self.interval = interval
+        self._task: asyncio.Task | None = None
+
+    def start(self):
+        self._task = asyncio.get_running_loop().create_task(self._run())
+
+    def stop(self):
+        if self._task:
+            self._task.cancel()
+
+    async def _run(self):
+        try:
+            while True:
+                for src in self.sources:
+                    try:
+                        raw = await src.collect(self.endpoint)
+                        for ex in src.extractors():
+                            ex.extract(raw, self.endpoint)
+                    except Exception:
+                        log.exception("collector error for %s",
+                                      self.endpoint.metadata.address_port)
+                await asyncio.sleep(self.interval)
+        except asyncio.CancelledError:
+            pass
+
+
+class DataLayerRuntime:
+    def __init__(self, datastore: Datastore, poll_interval: float = DEFAULT_POLL_INTERVAL_S):
+        self.datastore = datastore
+        self.poll_interval = poll_interval
+        self.sources: list[Any] = []
+        self.lifecycle_plugins: list[Any] = []
+        self._collectors: dict[str, _Collector] = {}
+        self._started = False
+        datastore.on_endpoint_event(self._on_endpoint_event)
+
+    def register_source(self, source: Any) -> None:
+        self.sources.append(source)
+
+    def register_lifecycle(self, plugin: Any) -> None:
+        self.lifecycle_plugins.append(plugin)
+
+    async def start(self):
+        self._started = True
+        for ep in self.datastore.endpoint_list():
+            self._start_collector(ep)
+
+    async def stop(self):
+        self._started = False
+        for c in self._collectors.values():
+            c.stop()
+        self._collectors.clear()
+        for src in self.sources:
+            close = getattr(src, "close", None)
+            if close:
+                await close()
+
+    def _on_endpoint_event(self, event: str, ep: Endpoint) -> None:
+        if event == "added":
+            if self._started:
+                self._start_collector(ep)
+            for p in self.lifecycle_plugins:
+                try:
+                    p.endpoint_added(ep)
+                except Exception:
+                    log.exception("lifecycle plugin failure (add)")
+        elif event == "removed":
+            c = self._collectors.pop(ep.metadata.address_port, None)
+            if c:
+                c.stop()
+            for p in self.lifecycle_plugins:
+                try:
+                    p.endpoint_removed(ep)
+                except Exception:
+                    log.exception("lifecycle plugin failure (remove)")
+
+    def _start_collector(self, ep: Endpoint) -> None:
+        key = ep.metadata.address_port
+        if key in self._collectors:
+            return
+        c = _Collector(ep, self.sources, self.poll_interval)
+        self._collectors[key] = c
+        c.start()

@@ -1,0 +1,5 @@
+from .plugin import Plugin, PluginRegistry, TypedName, global_registry
+from . import datalayer, scheduling, requestcontrol
+
+__all__ = ["Plugin", "PluginRegistry", "TypedName", "global_registry",
+           "datalayer", "scheduling", "requestcontrol"]

@@ -1,0 +1,133 @@
+"""Data-layer contracts: endpoint model, metrics snapshot, attributes.
+
+Mirrors the reference's framework/interface/datalayer
+(/root/reference/pkg/epp/framework/interface/datalayer/{metrics.go:26-42,
+endpoint_metadata.go:27-35, attributemap.go:24-95}): an Endpoint is
+Metadata + Metrics + AttributeMap; scorers/filters read this view and never
+touch the datastore directly.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import time
+from typing import Any, Iterable, Protocol, runtime_checkable
+
+ROLE_LABEL = "llm-d.ai/role"
+ENGINE_TYPE_LABEL = "llm-d.ai/engine-type"
+
+
+@dataclasses.dataclass
+class EndpointMetadata:
+    name: str
+    address: str
+    port: int
+    namespace: str = "default"
+    metrics_port: int | None = None
+    labels: dict[str, str] = dataclasses.field(default_factory=dict)
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.address}:{self.port}"
+
+    @property
+    def metrics_url(self) -> str:
+        return f"http://{self.address}:{self.metrics_port or self.port}/metrics"
+
+    @property
+    def address_port(self) -> str:
+        return f"{self.address}:{self.port}"
+
+    @property
+    def role(self) -> str:
+        return self.labels.get(ROLE_LABEL, "")
+
+
+@dataclasses.dataclass
+class Metrics:
+    """Per-endpoint engine telemetry snapshot (the five-signal contract of
+    SURVEY §2.5, plus derived cache geometry)."""
+
+    active_models: dict[str, int] = dataclasses.field(default_factory=dict)
+    waiting_models: dict[str, int] = dataclasses.field(default_factory=dict)
+    max_active_models: int = 0
+    running_requests_size: int = 0
+    waiting_queue_size: int = 0
+    kv_cache_usage_percent: float = 0.0
+    kv_cache_max_token_capacity: int = 0
+    cache_block_size: int = 0
+    cache_num_blocks: int = 0
+    update_time: float = 0.0
+
+    def clone(self) -> "Metrics":
+        return copy.deepcopy(self)
+
+    @property
+    def fresh(self) -> bool:
+        return (time.monotonic() - self.update_time) < 5.0 if self.update_time else False
+
+
+class AttributeMap:
+    """Typed k/v bus between DataProducers and scorers/filters.
+
+    Values exposing .clone() are cloned on read (the reference's
+    clone-on-read Cloneable contract); plain values are returned as-is and
+    must be treated as immutable.
+    """
+
+    def __init__(self):
+        self._data: dict[str, Any] = {}
+
+    def put(self, key: str, value: Any) -> None:
+        self._data[key] = value
+
+    def get(self, key: str, default: Any = None) -> Any:
+        v = self._data.get(key, default)
+        if v is not default and hasattr(v, "clone"):
+            return v.clone()
+        return v
+
+    def keys(self) -> Iterable[str]:
+        return self._data.keys()
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._data
+
+
+class Endpoint:
+    """The scorer-visible endpoint view: metadata + metrics + attributes."""
+
+    def __init__(self, metadata: EndpointMetadata):
+        self.metadata = metadata
+        self.metrics = Metrics()
+        self.attributes = AttributeMap()
+
+    def __repr__(self) -> str:
+        return f"Endpoint({self.metadata.address_port}, role={self.metadata.role!r})"
+
+
+@runtime_checkable
+class DataSource(Protocol):
+    """Polling data source: fetches raw data from an endpoint each tick."""
+
+    def typed_name(self): ...
+    async def collect(self, endpoint: Endpoint) -> Any: ...
+    def extractors(self) -> list["Extractor"]: ...
+    def add_extractor(self, ex: "Extractor") -> None: ...
+
+
+@runtime_checkable
+class Extractor(Protocol):
+    """Turns a source's raw output into endpoint metrics/attributes."""
+
+    def typed_name(self): ...
+    def extract(self, raw: Any, endpoint: Endpoint) -> None: ...
+
+
+class EndpointLifecycle(Protocol):
+    """Receives endpoint add/delete events (e.g. to manage per-pod
+    subscriptions, like the reference's EndpointExtractors)."""
+
+    def endpoint_added(self, endpoint: Endpoint) -> None: ...
+    def endpoint_removed(self, endpoint: Endpoint) -> None: ...

@@ -1,0 +1,90 @@
+"""Plugin framework: typed names, registry, factories.
+
+Mirrors the reference's plugin registry
+(/root/reference/pkg/epp/framework/interface/plugin/registry.go:25-36): every
+plugin has a (type, name) TypedName; factories instantiate plugins from config
+parameters; a process-global registry maps type names to factories.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Protocol, runtime_checkable
+
+
+@dataclasses.dataclass(frozen=True)
+class TypedName:
+    type: str
+    name: str
+
+    def __str__(self) -> str:
+        return f"{self.type}/{self.name}"
+
+
+@runtime_checkable
+class Plugin(Protocol):
+    def typed_name(self) -> TypedName: ...
+
+
+class PluginBase:
+    """Convenience base: plugins get .name and .typed_name() for free."""
+
+    TYPE: str = "plugin"
+
+    def __init__(self, name: str | None = None):
+        self.name = name or self.TYPE
+
+    def typed_name(self) -> TypedName:
+        return TypedName(self.TYPE, self.name)
+
+
+# A factory builds a plugin from (name, parameters, handle). The handle exposes
+# shared services (datastore, pool info, event loop) like the reference's
+# plugin Handle.
+Factory = Callable[[str, dict[str, Any], Any], Any]
+
+
+class PluginRegistry:
+    def __init__(self):
+        self._factories: dict[str, Factory] = {}
+
+    def register(self, type_name: str, factory: Factory, *aliases: str) -> None:
+        for t in (type_name, *aliases):
+            if t in self._factories:
+                raise ValueError(f"plugin type {t!r} already registered")
+            self._factories[t] = factory
+
+    def known_types(self) -> list[str]:
+        return sorted(self._factories)
+
+    def instantiate(self, type_name: str, name: str, params: dict[str, Any], handle: Any):
+        try:
+            factory = self._factories[type_name]
+        except KeyError:
+            raise ValueError(
+                f"unknown plugin type {type_name!r}; known: {self.known_types()}") from None
+        plugin = factory(name, params or {}, handle)
+        if hasattr(plugin, "name"):
+            plugin.name = name
+        return plugin
+
+
+global_registry = PluginRegistry()
+
+
+def register_plugin(type_name: str, *aliases: str):
+    """Decorator: register a PluginBase subclass whose factory is cls(name) +
+    optional cls.configure(params, handle)."""
+
+    def deco(cls):
+        def factory(name: str, params: dict[str, Any], handle: Any):
+            obj = cls(name)
+            if hasattr(obj, "configure"):
+                obj.configure(params or {}, handle)
+            return obj
+
+        cls.TYPE = type_name
+        global_registry.register(type_name, factory, *aliases)
+        return cls
+
+    return deco

@@ -1,0 +1,62 @@
+"""Request-control extension points (reference: framework/interface
+requestcontrol plugins — DataProducer, AdmitRequest, PreRequest, Response*).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Protocol, runtime_checkable
+
+from .datalayer import Endpoint
+from .scheduling import InferenceRequest, SchedulingResult
+
+
+@runtime_checkable
+class DataProducer(Protocol):
+    """Produces per-endpoint attributes before scheduling (runs under the
+    director's producer budget). Declares produced/consumed keys for the
+    data-DAG ordering (reference: datalayer/data_graph.go)."""
+
+    def typed_name(self): ...
+    def produces(self) -> list[str]: ...
+    def consumes(self) -> list[str]: ...
+    async def produce(self, ctx: Any, request: InferenceRequest,
+                      endpoints: list[Endpoint]) -> None: ...
+
+
+@runtime_checkable
+class AdmitRequest(Protocol):
+    def typed_name(self): ...
+    async def admit(self, ctx: Any, request: InferenceRequest,
+                    endpoints: list[Endpoint]) -> tuple[bool, str]: ...
+    # (admitted, reason-if-denied)
+
+
+@runtime_checkable
+class PreRequest(Protocol):
+    """Runs after scheduling, before the response is sent to the proxy; may
+    mutate request headers (e.g. disagg routing headers)."""
+
+    def typed_name(self): ...
+    def pre_request(self, ctx: Any, request: InferenceRequest,
+                    result: SchedulingResult) -> None: ...
+
+
+@runtime_checkable
+class ResponseReceived(Protocol):
+    def typed_name(self): ...
+    def response_received(self, ctx: Any, request: InferenceRequest,
+                          endpoint: Endpoint | None, status: int) -> None: ...
+
+
+@runtime_checkable
+class ResponseStreaming(Protocol):
+    def typed_name(self): ...
+    def response_streaming(self, ctx: Any, request: InferenceRequest,
+                           endpoint: Endpoint | None, chunk: bytes) -> None: ...
+
+
+@runtime_checkable
+class ResponseComplete(Protocol):
+    def typed_name(self): ...
+    def response_complete(self, ctx: Any, request: InferenceRequest,
+                          endpoint: Endpoint | None, usage: dict[str, int]) -> None: ...

@@ -1,0 +1,149 @@
+"""Scheduling contracts: request/result types and the plugin extension points.
+
+Mirrors /root/reference/pkg/epp/framework/interface/scheduling/
+{plugins.go:43-76, types.go:39-168, cycle_state.go:43}.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Protocol, runtime_checkable
+
+from .datalayer import Endpoint
+
+
+@dataclasses.dataclass
+class Objectives:
+    priority: int = 0
+
+
+@dataclasses.dataclass
+class InferenceRequestBody:
+    """Parsed request body; exactly one of the payload fields is set."""
+
+    completions: dict[str, Any] | None = None
+    chat_completions: dict[str, Any] | None = None
+    embeddings: dict[str, Any] | None = None
+    raw: bytes | None = None
+    tokenized_prompt: list[int] | None = None
+
+    @property
+    def payload(self) -> dict[str, Any] | None:
+        return self.completions if self.completions is not None else self.chat_completions
+
+    def prompt_text(self) -> str:
+        if self.completions is not None:
+            p = self.completions.get("prompt", "")
+            if isinstance(p, list):
+                return " ".join(str(x) for x in p)
+            return str(p)
+        if self.chat_completions is not None:
+            parts = []
+            for m in self.chat_completions.get("messages", []):
+                c = m.get("content") or ""
+                if isinstance(c, list):
+                    c = " ".join(x.get("text", "") for x in c if isinstance(x, dict))
+                parts.append(f"{m.get('role', 'user')}: {c}")
+            return "\n".join(parts)
+        return ""
+
+    def stream(self) -> bool:
+        p = self.payload
+        return bool(p and p.get("stream"))
+
+
+@dataclasses.dataclass
+class InferenceRequest:
+    request_id: str
+    target_model: str
+    body: InferenceRequestBody
+    headers: dict[str, str] = dataclasses.field(default_factory=dict)
+    objectives: Objectives = dataclasses.field(default_factory=Objectives)
+    request_size_bytes: int = 0
+    # filled by the director after scheduling:
+    scheduling_result: "SchedulingResult | None" = None
+
+
+class CycleState:
+    """Per-scheduling-cycle scratch shared between plugins of one cycle."""
+
+    def __init__(self):
+        self._data: dict[str, Any] = {}
+
+    def write(self, key: str, value: Any) -> None:
+        self._data[key] = value
+
+    def read(self, key: str, default: Any = None) -> Any:
+        return self._data.get(key, default)
+
+
+@dataclasses.dataclass
+class ScoredEndpoint:
+    endpoint: Endpoint
+    score: float
+
+
+@dataclasses.dataclass
+class ProfileRunResult:
+    """Outcome of running one SchedulerProfile."""
+
+    target_endpoints: list[Endpoint]
+    raw_scores: dict[str, dict[str, float]] = dataclasses.field(default_factory=dict)
+    # raw_scores: scorer type -> endpoint address_port -> [0,1] score
+
+
+@dataclasses.dataclass
+class SchedulingResult:
+    profile_results: dict[str, ProfileRunResult]
+    primary_profile_name: str
+
+    def primary(self) -> ProfileRunResult:
+        return self.profile_results[self.primary_profile_name]
+
+    def all_endpoints(self) -> list[Endpoint]:
+        seen, out = set(), []
+        for r in self.profile_results.values():
+            for ep in r.target_endpoints:
+                if ep.metadata.address_port not in seen:
+                    seen.add(ep.metadata.address_port)
+                    out.append(ep)
+        return out
+
+
+# ---- extension points --------------------------------------------------
+
+
+@runtime_checkable
+class Filter(Protocol):
+    def typed_name(self): ...
+    def filter(self, ctx: Any, state: CycleState, request: InferenceRequest,
+               endpoints: list[Endpoint]) -> list[Endpoint]: ...
+
+
+@runtime_checkable
+class Scorer(Protocol):
+    def typed_name(self): ...
+    def score(self, ctx: Any, state: CycleState, request: InferenceRequest,
+              endpoints: list[Endpoint]) -> dict[str, float]: ...
+    # returns address_port -> [0,1]
+
+
+@runtime_checkable
+class Picker(Protocol):
+    def typed_name(self): ...
+    def pick(self, ctx: Any, state: CycleState, request: InferenceRequest,
+             scored: list[ScoredEndpoint]) -> list[Endpoint]: ...
+
+
+class ProfileHandler(Protocol):
+    """Decides which profiles run next and folds their results together
+    (reference: ProfileHandler{Pick,ProcessResults}, plugins.go:43-76)."""
+
+    def typed_name(self): ...
+
+    def pick_profiles(self, ctx: Any, request: InferenceRequest,
+                      profiles: dict[str, Any],
+                      results: dict[str, ProfileRunResult]) -> dict[str, Any]: ...
+
+    def process_results(self, ctx: Any, request: InferenceRequest,
+                        results: dict[str, ProfileRunResult]) -> SchedulingResult: ...

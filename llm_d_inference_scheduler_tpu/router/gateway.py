@@ -1,0 +1,367 @@
+"""Standalone EPP gateway: the router's HTTP data plane.
+
+Plays the role of Envoy+EPP fused into one process (the reference's
+standalone mode, chart at config/charts/standalone/ — SURVEY §L0/L1): parses
+OpenAI requests, runs the Director (admission → producers → scheduling),
+proxies to the picked engine, streams the response back, and feeds the
+response hooks. The ext-proc gRPC server for a real Envoy data plane layers
+on the same Director.
+
+Wire behavior kept from the reference:
+- x-gateway-destination-endpoint set from the scheduling result
+  (handlers/request.go), echoed back as x-gateway-destination-endpoint-served
+- unparseable bodies fall back to a random endpoint (server.go:335-342)
+- 429/503 rejections carry x-removal-reason (pkg/common/error)
+- response bodies rewrite "model" back to the client-facing name when a
+  rewrite was applied (server.go:471-485)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+import uuid
+from typing import Any
+
+import httpx
+from aiohttp import web
+from prometheus_client import generate_latest
+
+from .config.loader import Handle, RouterConfig, load_config
+from .datalayer.datastore import Datastore
+from .datalayer.runtime import DataLayerRuntime
+from .framework.scheduling import InferenceRequest
+from .handlers.parsers import make_parser
+from .metrics import (
+    POOL_AVG_KV_CACHE,
+    POOL_AVG_QUEUE,
+    POOL_READY_ENDPOINTS,
+    REGISTRY,
+    REQUEST_DURATION,
+    TTFT_SECONDS,
+    INPUT_TOKENS,
+    OUTPUT_TOKENS,
+)
+from .requestcontrol.admission import AdmissionError, X_REMOVAL_REASON
+from .requestcontrol.director import (
+    Director,
+    H_DESTINATION,
+    H_DESTINATION_SERVED,
+    H_REQUEST_ID,
+    RequestError,
+)
+from .datalayer.data_graph import validate_and_order_producers
+
+log = logging.getLogger("router.gateway")
+
+FORWARD_HEADERS = ("x-prefiller-host-port", "x-encoder-hosts-ports",
+                   "x-data-parallel-host-port", "x-request-id", "content-type")
+
+
+class Gateway:
+    def __init__(self, cfg: RouterConfig, datastore: Datastore,
+                 dl_runtime: DataLayerRuntime, *, host: str = "127.0.0.1",
+                 port: int = 8081):
+        self.cfg = cfg
+        self.datastore = datastore
+        self.dl_runtime = dl_runtime
+        self.host, self.port = host, port
+        self.parser = make_parser(cfg.parser_spec)
+
+        # saturation detector: explicit spec or default utilization-detector
+        from .framework.plugin import global_registry
+        det_spec = cfg.saturation_detector_spec or {"type": "utilization-detector"}
+        self.detector = global_registry.instantiate(
+            det_spec.get("type", "utilization-detector"),
+            det_spec.get("name", "saturation-detector"),
+            det_spec.get("parameters") or {}, None)
+
+        from .requestcontrol.admission import LegacyAdmissionController
+        admission = LegacyAdmissionController(self.detector)
+
+        producers = validate_and_order_producers(cfg.producers)
+        self.director = Director(
+            datastore, cfg.scheduler, admission=admission,
+            producers=producers,
+            admit_plugins=cfg.admit_plugins,
+            pre_request_plugins=cfg.pre_request_plugins,
+            response_received=cfg.response_received,
+            response_streaming=cfg.response_streaming,
+            response_complete=cfg.response_complete)
+
+        self.app = web.Application()
+        self.app.add_routes([
+            web.post("/v1/completions", self.handle_inference),
+            web.post("/v1/chat/completions", self.handle_inference),
+            web.get("/metrics", self.metrics),
+            web.get("/health", self.health),
+            web.get("/v1/models", self.models),
+        ])
+        self._runner: web.AppRunner | None = None
+        self._client: httpx.AsyncClient | None = None
+        self._flusher: asyncio.Task | None = None
+
+    # ---- lifecycle ------------------------------------------------------
+
+    async def start(self):
+        for meta in self.cfg.static_endpoints:
+            self.datastore.endpoint_add_or_update(meta)
+        self.datastore.pool_set(self.cfg.pool)
+        await self.dl_runtime.start()
+        self._client = httpx.AsyncClient(timeout=httpx.Timeout(300.0, connect=5.0))
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        self._flusher = asyncio.get_running_loop().create_task(self._flush_pool_gauges())
+        log.info("gateway listening on %s:%s (%d endpoints)",
+                 self.host, self.port, len(self.datastore.endpoint_list()))
+
+    async def stop(self):
+        if self._flusher:
+            self._flusher.cancel()
+        if self._runner:
+            await self._runner.cleanup()
+        if self._client:
+            await self._client.aclose()
+        await self.dl_runtime.stop()
+
+    async def _flush_pool_gauges(self):
+        # reference: periodic pool-gauge flusher (datalayer/logger.go:38-124)
+        try:
+            while True:
+                eps = self.datastore.endpoint_list()
+                POOL_READY_ENDPOINTS.set(len(eps))
+                if eps:
+                    POOL_AVG_KV_CACHE.set(
+                        sum(e.metrics.kv_cache_usage_percent for e in eps) / len(eps))
+                    POOL_AVG_QUEUE.set(
+                        sum(e.metrics.waiting_queue_size for e in eps) / len(eps))
+                await asyncio.sleep(1.0)
+        except asyncio.CancelledError:
+            pass
+
+    # ---- handlers ---------------------------------------------------------
+
+    async def handle_inference(self, request: web.Request) -> web.StreamResponse:
+        t_start = time.monotonic()
+        raw = await request.read()
+        headers = {k.lower(): v for k, v in request.headers.items()}
+        headers.setdefault(H_REQUEST_ID, f"req-{uuid.uuid4().hex[:12]}")
+
+        parse = self.parser.parse(raw, headers, path=request.path)
+        if parse.error:
+            return web.json_response({"error": parse.error}, status=400)
+
+        if parse.skip:
+            ep = self.director.get_random_endpoint()
+            if ep is None:
+                return web.json_response({"error": "no endpoints"}, status=503)
+            return await self._proxy(request, None, ep, raw, headers, t_start,
+                                     original_model="")
+
+        ireq = InferenceRequest(
+            request_id=headers[H_REQUEST_ID],
+            target_model=parse.model,
+            body=parse.body,
+            headers=headers,
+            request_size_bytes=len(raw))
+        original_model = parse.model
+
+        try:
+            result = await self.director.handle_request(None, ireq)
+        except RequestError as e:
+            return web.json_response(
+                {"error": e.reason}, status=e.code,
+                headers={X_REMOVAL_REASON: e.reason})
+
+        target = result.primary().target_endpoints[0]
+        body_out = raw
+        payload = ireq.body.payload
+        if payload is not None and ireq.target_model != original_model:
+            payload = dict(payload)
+            payload["model"] = ireq.target_model  # repackage (director.go:289-306)
+            body_out = json.dumps(payload).encode()
+
+        return await self._proxy(request, ireq, target, body_out, ireq.headers,
+                                 t_start, original_model=original_model)
+
+    async def _proxy(self, request: web.Request, ireq: InferenceRequest | None,
+                     endpoint, body: bytes, headers: dict[str, str],
+                     t_start: float, original_model: str) -> web.StreamResponse:
+        url = endpoint.metadata.url + request.path
+        fwd = {k: v for k, v in headers.items() if k in FORWARD_HEADERS}
+        fwd["content-type"] = "application/json"
+        model_label = (ireq.target_model if ireq else "") or "unknown"
+
+        try:
+            upstream = self._client.build_request("POST", url, content=body, headers=fwd)
+            resp = await self._client.send(upstream, stream=True)
+        except Exception as e:
+            if ireq is not None:
+                self.director.handle_response_complete(None, ireq, endpoint, {})
+            return web.json_response({"error": f"upstream connect failed: {e}"},
+                                     status=502)
+
+        if ireq is not None:
+            self.director.handle_response_received(None, ireq, endpoint, resp.status_code)
+
+        out_headers = {
+            H_DESTINATION_SERVED: endpoint.metadata.address_port,
+            "content-type": resp.headers.get("content-type", "application/json"),
+        }
+        streaming = "text/event-stream" in resp.headers.get("content-type", "")
+        usage: dict[str, int] = {}
+        first_byte_at: float | None = None
+
+        try:
+            if streaming:
+                ws = web.StreamResponse(status=resp.status_code, headers=out_headers)
+                await ws.prepare(request)
+                async for chunk in resp.aiter_bytes():
+                    if first_byte_at is None:
+                        first_byte_at = time.monotonic()
+                        TTFT_SECONDS.labels(model_label).observe(first_byte_at - t_start)
+                    if ireq is not None:
+                        self.director.handle_response_streaming(None, ireq, endpoint, chunk)
+                    usage = _usage_from_sse(chunk) or usage
+                    await ws.write(chunk)
+                await ws.write_eof()
+                return ws
+            else:
+                data = await resp.aread()
+                first_byte_at = time.monotonic()
+                TTFT_SECONDS.labels(model_label).observe(first_byte_at - t_start)
+                data = _rewrite_model_name(data, ireq, original_model)
+                usage = _usage_from_json(data) or {}
+                return web.Response(body=data, status=resp.status_code,
+                                    headers=out_headers)
+        finally:
+            await resp.aclose()
+            if ireq is not None:
+                self.director.handle_response_complete(None, ireq, endpoint, usage)
+                REQUEST_DURATION.labels(model_label).observe(time.monotonic() - t_start)
+                if usage.get("prompt_tokens"):
+                    INPUT_TOKENS.labels(model_label).observe(usage["prompt_tokens"])
+                if usage.get("completion_tokens"):
+                    OUTPUT_TOKENS.labels(model_label).observe(usage["completion_tokens"])
+
+    async def metrics(self, request: web.Request) -> web.Response:
+        return web.Response(body=generate_latest(REGISTRY),
+                            content_type="text/plain", charset="utf-8")
+
+    async def health(self, request: web.Request) -> web.Response:
+        ready = self.datastore.pool_ready and bool(self.datastore.endpoint_list())
+        return web.json_response(
+            {"status": "ok" if ready else "not-ready",
+             "endpoints": len(self.datastore.endpoint_list())},
+            status=200 if ready else 503)
+
+    async def models(self, request: web.Request) -> web.Response:
+        # aggregate across one endpoint (homogeneous pools)
+        eps = self.datastore.endpoint_list()
+        if not eps:
+            return web.json_response({"object": "list", "data": []})
+        try:
+            r = await self._client.get(eps[0].metadata.url + "/v1/models")
+            return web.json_response(r.json())
+        except Exception:
+            return web.json_response({"object": "list", "data": []})
+
+
+def _rewrite_model_name(data: bytes, ireq: InferenceRequest | None,
+                        original_model: str) -> bytes:
+    """Rewrite "model" in responses back to the client-facing name
+    (reference server.go:471-485)."""
+    if ireq is None or not original_model or ireq.target_model == original_model:
+        return data
+    try:
+        doc = json.loads(data)
+        if isinstance(doc, dict) and "model" in doc:
+            doc["model"] = original_model
+            return json.dumps(doc).encode()
+    except Exception:
+        pass
+    return data
+
+
+def _usage_from_json(data: bytes) -> dict[str, int] | None:
+    try:
+        doc = json.loads(data)
+        u = doc.get("usage")
+        return u if isinstance(u, dict) else None
+    except Exception:
+        return None
+
+
+def _usage_from_sse(chunk: bytes) -> dict[str, int] | None:
+    for line in chunk.split(b"\n"):
+        if line.startswith(b"data: ") and line != b"data: [DONE]":
+            try:
+                doc = json.loads(line[6:])
+                u = doc.get("usage")
+                if isinstance(u, dict):
+                    return u
+            except Exception:
+                continue
+    return None
+
+
+def build_gateway(config_text: str | None, *, host: str = "127.0.0.1",
+                  port: int = 8081, poll_interval: float = 0.05) -> Gateway:
+    datastore = Datastore()
+    dl_runtime = DataLayerRuntime(datastore, poll_interval=poll_interval)
+    handle = Handle(datastore=datastore, dl_runtime=dl_runtime)
+    import llm_d_inference_scheduler_tpu.router.plugins  # noqa: F401 (register)
+    import llm_d_inference_scheduler_tpu.router.plugins.saturation  # noqa: F401
+    import llm_d_inference_scheduler_tpu.router.requestcontrol.producers  # noqa: F401
+    cfg = load_config(config_text, handle)
+    return Gateway(cfg, datastore, dl_runtime, host=host, port=port)
+
+
+def main(argv: list[str] | None = None):
+    import argparse
+
+    p = argparse.ArgumentParser(description="TPU inference router gateway (standalone EPP)")
+    p.add_argument("--config-file", default=None)
+    p.add_argument("--config-text", default=None)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8081)
+    p.add_argument("--endpoints", default=None,
+                   help="comma-separated host:port[:role] static pool "
+                        "(overrides config pool)")
+    args = p.parse_args(argv)
+
+    text = args.config_text
+    if args.config_file:
+        with open(args.config_file) as f:
+            text = f.read()
+
+    gw = build_gateway(text, host=args.host, port=args.port)
+    if args.endpoints:
+        from .framework.datalayer import EndpointMetadata
+        metas = []
+        for spec in args.endpoints.split(","):
+            parts = spec.strip().split(":")
+            labels = {"llm-d.ai/role": parts[2]} if len(parts) > 2 else {}
+            metas.append(EndpointMetadata(name=spec, address=parts[0],
+                                          port=int(parts[1]), labels=labels))
+        gw.cfg.static_endpoints = metas
+
+    logging.basicConfig(level=logging.INFO)
+
+    async def run():
+        await gw.start()
+        try:
+            while True:
+                await asyncio.sleep(3600)
+        except asyncio.CancelledError:
+            await gw.stop()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,3 @@
+from .parsers import OpenAIParser, PassthroughParser, ParseResult, make_parser
+
+__all__ = ["OpenAIParser", "PassthroughParser", "ParseResult", "make_parser"]

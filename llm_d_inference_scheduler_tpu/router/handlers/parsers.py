@@ -1,0 +1,72 @@
+"""Request parsers (reference: framework/plugins/requesthandling/parsers;
+interface at framework/interface/requesthandling/plugins.go:28-67).
+
+ParseResult.skip routes opaque bodies to a random endpoint (the reference's
+passthrough-parser fallback semantics, handlers/server.go:335-342).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any
+
+from ..framework.plugin import PluginBase, register_plugin
+from ..framework.scheduling import InferenceRequestBody
+
+
+@dataclasses.dataclass
+class ParseResult:
+    body: InferenceRequestBody | None
+    model: str = ""
+    skip: bool = False
+    error: str | None = None
+
+
+@register_plugin("openai-parser")
+class OpenAIParser(PluginBase):
+    """OpenAI /v1/completions + /v1/chat/completions (+ SSE stream awareness)."""
+
+    def parse(self, raw: bytes, headers: dict[str, str], path: str = "") -> ParseResult:
+        try:
+            doc = json.loads(raw)
+        except Exception as e:
+            return ParseResult(body=None, error=f"invalid JSON body: {e}")
+        if not isinstance(doc, dict):
+            return ParseResult(body=None, error="body must be a JSON object")
+        model = str(doc.get("model", ""))
+        if "messages" in doc:
+            body = InferenceRequestBody(chat_completions=doc, raw=raw)
+        elif "prompt" in doc or "completions" in path:
+            body = InferenceRequestBody(completions=doc, raw=raw)
+        elif "input" in doc:
+            body = InferenceRequestBody(embeddings=doc, raw=raw)
+        else:
+            body = InferenceRequestBody(completions=doc, raw=raw)
+        return ParseResult(body=body, model=model)
+
+    def serialize(self, body: InferenceRequestBody) -> bytes:
+        payload = body.payload if body.payload is not None else (
+            body.embeddings if body.embeddings is not None else None)
+        if payload is None:
+            return body.raw or b""
+        return json.dumps(payload).encode()
+
+
+@register_plugin("passthrough-parser")
+class PassthroughParser(PluginBase):
+    """Opaque bodies → ParseResult.skip → random-endpoint fallback."""
+
+    def parse(self, raw: bytes, headers: dict[str, str], path: str = "") -> ParseResult:
+        return ParseResult(body=InferenceRequestBody(raw=raw), skip=True)
+
+    def serialize(self, body: InferenceRequestBody) -> bytes:
+        return body.raw or b""
+
+
+def make_parser(spec: dict[str, Any], handle: Any = None):
+    from ..framework.plugin import global_registry
+
+    ptype = spec.get("type", "openai-parser")
+    return global_registry.instantiate(ptype, spec.get("name") or ptype,
+                                       spec.get("parameters") or {}, handle)

@@ -1,0 +1,62 @@
+"""Router-side Prometheus metrics (reference: pkg/epp/metrics/metrics.go:88-460).
+
+One process-global registry; families mirror the reference's names where the
+concept carries over.
+"""
+
+from __future__ import annotations
+
+from prometheus_client import CollectorRegistry, Counter, Gauge, Histogram
+
+REGISTRY = CollectorRegistry()
+
+REQUEST_TOTAL = Counter(
+    "inference_extension_request_total", "Requests handled",
+    ("model", "target_model"), registry=REGISTRY)
+REQUEST_ERROR_TOTAL = Counter(
+    "inference_extension_request_error_total", "Request errors",
+    ("model", "error_code"), registry=REGISTRY)
+REQUEST_DURATION = Histogram(
+    "inference_extension_request_duration_seconds", "End-to-end request latency",
+    ("model",), registry=REGISTRY)
+TTFT_SECONDS = Histogram(
+    "inference_extension_time_to_first_token_seconds", "TTFT observed at the router",
+    ("model",), registry=REGISTRY,
+    buckets=(.005, .01, .025, .05, .1, .25, .5, 1, 2.5, 5, 10, 30))
+INPUT_TOKENS = Histogram(
+    "inference_extension_input_tokens", "Prompt tokens per request",
+    ("model",), registry=REGISTRY, buckets=(1, 8, 32, 128, 512, 2048, 8192, 32768))
+OUTPUT_TOKENS = Histogram(
+    "inference_extension_output_tokens", "Completion tokens per request",
+    ("model",), registry=REGISTRY, buckets=(1, 8, 32, 128, 512, 2048, 8192))
+RUNNING_REQUESTS = Gauge(
+    "inference_extension_running_requests", "In-flight requests at the router",
+    ("model",), registry=REGISTRY)
+SCHEDULER_E2E_SECONDS = Histogram(
+    "inference_extension_scheduler_e2e_duration_seconds", "Scheduling latency",
+    registry=REGISTRY,
+    buckets=(.0001, .0005, .001, .0025, .005, .01, .025, .05, .1))
+PLUGIN_DURATION_SECONDS = Histogram(
+    "inference_extension_plugin_duration_seconds", "Per-plugin latency",
+    ("extension_point", "plugin"), registry=REGISTRY,
+    buckets=(.0001, .0005, .001, .005, .01, .05, .1, .5))
+DISAGG_DECISION_TOTAL = Counter(
+    "disagg_decision_total", "Disaggregation decisions",
+    ("decision_type",), registry=REGISTRY)
+POOL_READY_ENDPOINTS = Gauge(
+    "inference_pool_ready_pods", "Endpoints in the pool", registry=REGISTRY)
+POOL_AVG_KV_CACHE = Gauge(
+    "inference_pool_average_kv_cache_utilization", "Mean pool KV utilization",
+    registry=REGISTRY)
+POOL_AVG_QUEUE = Gauge(
+    "inference_pool_average_queue_size", "Mean pool queue depth", registry=REGISTRY)
+FLOW_CONTROL_QUEUE_SIZE = Gauge(
+    "inference_extension_flow_control_queue_size", "Queued flow-control requests",
+    registry=REGISTRY)
+FLOW_CONTROL_QUEUE_SECONDS = Histogram(
+    "inference_extension_flow_control_queue_duration_seconds",
+    "Time spent queued in flow control", registry=REGISTRY,
+    buckets=(.001, .005, .01, .05, .1, .5, 1, 5, 30))
+PREFIX_HIT_RATIO = Histogram(
+    "inference_extension_prefix_indexer_hit_ratio", "Prefix-cache hit ratio",
+    registry=REGISTRY, buckets=(0, .1, .25, .5, .75, .9, 1))

@@ -1,0 +1,12 @@
+"""In-tree router plugins (reference: cmd/epp/main.go RegisterAllPlugins).
+
+Importing this package registers every in-tree plugin type with the global
+registry; the config loader instantiates them by type name.
+"""
+
+from . import filters, scorers, pickers, profile_handlers  # noqa: F401
+
+from .attributes import PrefixCacheMatchInfo, PREFIX_ATTRIBUTE_KEY, INFLIGHT_ATTRIBUTE_KEY
+
+__all__ = ["filters", "scorers", "pickers", "profile_handlers",
+           "PrefixCacheMatchInfo", "PREFIX_ATTRIBUTE_KEY", "INFLIGHT_ATTRIBUTE_KEY"]

@@ -1,0 +1,32 @@
+"""Well-known endpoint attribute keys shared between producers and scorers
+(reference: framework/plugins/datalayer/attribute/*)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+PREFIX_ATTRIBUTE_KEY = "attribute/prefix"
+INFLIGHT_ATTRIBUTE_KEY = "attribute/concurrency"
+
+
+@dataclasses.dataclass
+class PrefixCacheMatchInfo:
+    match_blocks: int
+    total_blocks: int
+    block_size_tokens: int
+
+    def clone(self) -> "PrefixCacheMatchInfo":
+        return dataclasses.replace(self)
+
+    @property
+    def hit_ratio(self) -> float:
+        return self.match_blocks / self.total_blocks if self.total_blocks else 0.0
+
+
+@dataclasses.dataclass
+class InFlightLoad:
+    requests: int = 0
+    tokens: int = 0
+
+    def clone(self) -> "InFlightLoad":
+        return dataclasses.replace(self)

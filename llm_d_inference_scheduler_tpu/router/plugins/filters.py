@@ -1,0 +1,88 @@
+"""Scheduling filters (reference: framework/plugins/scheduling/filter/*)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..framework.datalayer import ROLE_LABEL, Endpoint
+from ..framework.plugin import PluginBase, register_plugin
+from ..framework.scheduling import CycleState, InferenceRequest
+
+
+class _RoleFilter(PluginBase):
+    """Match the llm-d.ai/role label against a role set
+    (reference filter/bylabel/roles.go:10-69)."""
+
+    ROLES: tuple[str, ...] = ()
+    MATCH_UNLABELED = False
+
+    def filter(self, ctx: Any, state: CycleState, request: InferenceRequest,
+               endpoints: list[Endpoint]) -> list[Endpoint]:
+        out = []
+        for ep in endpoints:
+            role = ep.metadata.labels.get(ROLE_LABEL)
+            if role in self.ROLES or (role in (None, "") and self.MATCH_UNLABELED):
+                out.append(ep)
+        return out
+
+
+@register_plugin("decode-filter")
+class DecodeFilter(_RoleFilter):
+    ROLES = ("decode", "both")
+    MATCH_UNLABELED = True  # unlabeled pods count as decode-capable
+
+
+@register_plugin("prefill-filter")
+class PrefillFilter(_RoleFilter):
+    ROLES = ("prefill", "both")
+
+
+@register_plugin("encode-filter")
+class EncodeFilter(_RoleFilter):
+    ROLES = ("encode",)
+
+
+@register_plugin("label-selector-filter", "by-label-selector", "by-label")
+class LabelSelectorFilter(PluginBase):
+    """Generic label matcher: matchLabels equality + matchExpressions
+    (In/NotIn/Exists/DoesNotExist)."""
+
+    def __init__(self, name: str | None = None):
+        super().__init__(name)
+        self.match_labels: dict[str, str] = {}
+        self.match_expressions: list[dict[str, Any]] = []
+
+    def configure(self, params: dict[str, Any], handle: Any) -> None:
+        self.match_labels = params.get("matchLabels") or {}
+        self.match_expressions = params.get("matchExpressions") or []
+
+    def _matches(self, labels: dict[str, str]) -> bool:
+        for k, v in self.match_labels.items():
+            if labels.get(k) != v:
+                return False
+        for expr in self.match_expressions:
+            key, op = expr.get("key"), expr.get("operator", "In")
+            values = expr.get("values") or []
+            if op == "In" and labels.get(key) not in values:
+                return False
+            if op == "NotIn" and labels.get(key) in values:
+                return False
+            if op == "Exists" and key not in labels:
+                return False
+            if op == "DoesNotExist" and key in labels:
+                return False
+        return True
+
+    def filter(self, ctx, state, request, endpoints):
+        return [ep for ep in endpoints if self._matches(ep.metadata.labels)]
+
+
+@register_plugin("fresh-metrics-filter")
+class FreshMetricsFilter(PluginBase):
+    """Drop endpoints with stale telemetry unless that would empty the set
+    (fail-open, like the reference's PodsWithFreshMetrics + utilization
+    detector fallback)."""
+
+    def filter(self, ctx, state, request, endpoints):
+        fresh = [ep for ep in endpoints if ep.metrics.fresh]
+        return fresh or endpoints

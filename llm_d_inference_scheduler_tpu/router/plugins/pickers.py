@@ -1,0 +1,67 @@
+"""Pickers (reference: framework/plugins/scheduling/picker/*): all share
+maxNumOfEndpoints (default 1); picking N>1 yields multi-endpoint routing."""
+
+from __future__ import annotations
+
+import random
+from typing import Any
+
+from ..framework.plugin import PluginBase, register_plugin
+from ..framework.scheduling import ScoredEndpoint
+
+
+class _PickerBase(PluginBase):
+    def __init__(self, name: str | None = None):
+        super().__init__(name)
+        self.max_endpoints = 1
+        self._rng = random.Random()
+
+    def configure(self, params: dict[str, Any], handle: Any) -> None:
+        self.max_endpoints = int(params.get("maxNumOfEndpoints", 1))
+
+
+@register_plugin("max-score-picker")
+class MaxScorePicker(_PickerBase):
+    """Highest total score; ties broken randomly."""
+
+    def pick(self, ctx, state, request, scored: list[ScoredEndpoint]):
+        if not scored:
+            return []
+        pool = list(scored)
+        self._rng.shuffle(pool)  # randomize tie order
+        pool.sort(key=lambda s: s.score, reverse=True)
+        return [s.endpoint for s in pool[: self.max_endpoints]]
+
+
+@register_plugin("random-picker")
+class RandomPicker(_PickerBase):
+    def pick(self, ctx, state, request, scored: list[ScoredEndpoint]):
+        if not scored:
+            return []
+        picked = self._rng.sample(scored, k=min(self.max_endpoints, len(scored)))
+        return [s.endpoint for s in picked]
+
+
+@register_plugin("weighted-random-picker")
+class WeightedRandomPicker(_PickerBase):
+    """Score-proportional sampling without replacement."""
+
+    def pick(self, ctx, state, request, scored: list[ScoredEndpoint]):
+        pool = list(scored)
+        out = []
+        while pool and len(out) < self.max_endpoints:
+            total = sum(max(s.score, 0.0) for s in pool)
+            if total <= 0:
+                out.extend(s.endpoint for s in
+                           self._rng.sample(pool, k=min(self.max_endpoints - len(out),
+                                                        len(pool))))
+                break
+            r = self._rng.uniform(0, total)
+            acc = 0.0
+            for i, s in enumerate(pool):
+                acc += max(s.score, 0.0)
+                if r <= acc:
+                    out.append(s.endpoint)
+                    pool.pop(i)
+                    break
+        return out

@@ -1,0 +1,30 @@
+"""Profile handlers (reference: framework/plugins/scheduling/profilehandler/*)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..framework.plugin import PluginBase, register_plugin
+from ..framework.scheduling import InferenceRequest, ProfileRunResult, SchedulingResult
+
+
+class SchedulingError(Exception):
+    pass
+
+
+@register_plugin("single-profile-handler")
+class SingleProfileHandler(PluginBase):
+    """One profile, one pass (reference profilehandler/single)."""
+
+    def pick_profiles(self, ctx, request: InferenceRequest, profiles: dict[str, Any],
+                      results: dict[str, ProfileRunResult]) -> dict[str, Any]:
+        if results:
+            return {}
+        return profiles
+
+    def process_results(self, ctx, request, results) -> SchedulingResult:
+        ok = {n: r for n, r in results.items() if r is not None}
+        if not ok:
+            raise SchedulingError("no profile produced a target endpoint")
+        primary = next(iter(ok))
+        return SchedulingResult(profile_results=ok, primary_profile_name=primary)

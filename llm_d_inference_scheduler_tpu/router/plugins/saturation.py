@@ -1,0 +1,78 @@
+"""Saturation detectors (reference: framework/plugins/flowcontrol/
+saturationdetector/{utilization,concurrency} — SURVEY §2.6).
+
+Each detector doubles as a scheduling Filter with fail-open fallback and
+exposes saturation() in [0, 1+] for the admission layer.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..framework.datalayer import Endpoint
+from ..framework.plugin import PluginBase, register_plugin
+from ..plugins.attributes import INFLIGHT_ATTRIBUTE_KEY, InFlightLoad
+
+
+@register_plugin("utilization-detector", "saturation-detector")
+class UtilizationDetector(PluginBase):
+    """EndpointScore = max(queue/queueThresh, kv/kvThresh); pool = mean."""
+
+    def __init__(self, name: str | None = None):
+        super().__init__(name)
+        self.queue_threshold = 5
+        self.kv_threshold = 0.8
+
+    def configure(self, params: dict[str, Any], handle: Any) -> None:
+        self.queue_threshold = int(params.get("queueDepthThreshold", self.queue_threshold))
+        self.kv_threshold = float(params.get("kvCacheUtilThreshold", self.kv_threshold))
+
+    def endpoint_score(self, ep: Endpoint) -> float:
+        q = ep.metrics.waiting_queue_size / max(self.queue_threshold, 1)
+        kv = ep.metrics.kv_cache_usage_percent / max(self.kv_threshold, 1e-9)
+        return max(q, kv)
+
+    def saturation(self, endpoints: list[Endpoint]) -> float:
+        if not endpoints:
+            return 1.0  # empty pool is saturated by definition
+        return sum(self.endpoint_score(ep) for ep in endpoints) / len(endpoints)
+
+    def filter(self, ctx, state, request, endpoints):
+        ok = [ep for ep in endpoints if self.endpoint_score(ep) < 1.0]
+        return ok or endpoints  # fail open
+
+
+@register_plugin("concurrency-detector")
+class ConcurrencyDetector(PluginBase):
+    """In-flight load against capacity×(1+headroom), requests or tokens mode."""
+
+    def __init__(self, name: str | None = None):
+        super().__init__(name)
+        self.capacity = 64
+        self.headroom = 0.25
+        self.mode = "requests"
+
+    def configure(self, params: dict[str, Any], handle: Any) -> None:
+        self.capacity = int(params.get("capacity", self.capacity))
+        self.headroom = float(params.get("headroom", self.headroom))
+        self.mode = params.get("mode", self.mode)
+
+    def consumes(self) -> list[str]:
+        return [INFLIGHT_ATTRIBUTE_KEY]
+
+    def endpoint_score(self, ep: Endpoint) -> float:
+        load: InFlightLoad | None = ep.attributes.get(INFLIGHT_ATTRIBUTE_KEY)
+        if load is None:
+            return 0.0
+        used = load.tokens if self.mode == "tokens" else load.requests
+        limit = self.capacity * (1 + self.headroom)
+        return used / max(limit, 1e-9)
+
+    def saturation(self, endpoints: list[Endpoint]) -> float:
+        if not endpoints:
+            return 1.0
+        return sum(self.endpoint_score(ep) for ep in endpoints) / len(endpoints)
+
+    def filter(self, ctx, state, request, endpoints):
+        ok = [ep for ep in endpoints if self.endpoint_score(ep) < 1.0]
+        return ok or endpoints

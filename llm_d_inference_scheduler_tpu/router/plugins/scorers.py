@@ -1,0 +1,187 @@
+"""Scheduling scorers — all return {address_port: score in [0,1]}
+(reference: framework/plugins/scheduling/scorer/*, SURVEY §2.7)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..framework.datalayer import Endpoint
+from ..framework.plugin import PluginBase, register_plugin
+from ..framework.scheduling import CycleState, InferenceRequest
+from .attributes import (
+    INFLIGHT_ATTRIBUTE_KEY,
+    PREFIX_ATTRIBUTE_KEY,
+    InFlightLoad,
+    PrefixCacheMatchInfo,
+)
+
+
+def _normalized_inverse(values: dict[str, float]) -> dict[str, float]:
+    """Lower raw value → higher score; equal values → all 1.0."""
+    if not values:
+        return {}
+    lo, hi = min(values.values()), max(values.values())
+    if hi == lo:
+        return {k: 1.0 for k in values}
+    return {k: (hi - v) / (hi - lo) for k, v in values.items()}
+
+
+@register_plugin("queue-scorer", "queue")
+class QueueScorer(PluginBase):
+    """Inverse waiting-queue depth (reference scorer/queuedepth)."""
+
+    def score(self, ctx, state, request, endpoints):
+        return _normalized_inverse(
+            {ep.metadata.address_port: float(ep.metrics.waiting_queue_size)
+             for ep in endpoints})
+
+
+@register_plugin("kv-cache-utilization-scorer", "kv-cache-scorer")
+class KvCacheUtilizationScorer(PluginBase):
+    """1 − KV cache usage (reference scorer/kvcacheutilization)."""
+
+    def score(self, ctx, state, request, endpoints):
+        return {ep.metadata.address_port:
+                min(max(1.0 - ep.metrics.kv_cache_usage_percent, 0.0), 1.0)
+                for ep in endpoints}
+
+
+@register_plugin("running-requests-size-scorer")
+class RunningRequestsScorer(PluginBase):
+    def score(self, ctx, state, request, endpoints):
+        return _normalized_inverse(
+            {ep.metadata.address_port: float(ep.metrics.running_requests_size)
+             for ep in endpoints})
+
+
+@register_plugin("load-aware-scorer")
+class LoadAwareScorer(PluginBase):
+    """Queue depth against a saturation threshold (reference scorer/loadaware):
+    score = max(0, 1 - queue/threshold)."""
+
+    def __init__(self, name: str | None = None):
+        super().__init__(name)
+        self.queue_threshold = 128
+
+    def configure(self, params: dict[str, Any], handle: Any) -> None:
+        self.queue_threshold = int(params.get("queueDepthThreshold", self.queue_threshold))
+
+    def score(self, ctx, state, request, endpoints):
+        t = max(self.queue_threshold, 1)
+        return {ep.metadata.address_port:
+                max(0.0, 1.0 - ep.metrics.waiting_queue_size / t)
+                for ep in endpoints}
+
+
+@register_plugin("prefix-cache-scorer", "prefix-cache")
+class PrefixCacheScorer(PluginBase):
+    """Approximate prefix-match ratio from the approx-prefix-cache-producer's
+    PrefixCacheMatchInfo attribute (reference scorer/prefix)."""
+
+    def consumes(self) -> list[str]:
+        return [PREFIX_ATTRIBUTE_KEY]
+
+    def score(self, ctx, state, request, endpoints):
+        out = {}
+        for ep in endpoints:
+            info: PrefixCacheMatchInfo | None = ep.attributes.get(PREFIX_ATTRIBUTE_KEY)
+            out[ep.metadata.address_port] = info.hit_ratio if info else 0.0
+        return out
+
+
+@register_plugin("active-request-scorer")
+class ActiveRequestScorer(PluginBase):
+    """EPP-side in-flight request count from inflight-load-producer
+    (reference scorer/activerequest)."""
+
+    def consumes(self) -> list[str]:
+        return [INFLIGHT_ATTRIBUTE_KEY]
+
+    def score(self, ctx, state, request, endpoints):
+        vals = {}
+        for ep in endpoints:
+            load: InFlightLoad | None = ep.attributes.get(INFLIGHT_ATTRIBUTE_KEY)
+            vals[ep.metadata.address_port] = float(load.requests if load else 0)
+        return _normalized_inverse(vals)
+
+
+@register_plugin("token-load-scorer")
+class TokenLoadScorer(PluginBase):
+    """Token-weighted in-flight load (reference scorer/tokenload)."""
+
+    def consumes(self) -> list[str]:
+        return [INFLIGHT_ATTRIBUTE_KEY]
+
+    def score(self, ctx, state, request, endpoints):
+        vals = {}
+        for ep in endpoints:
+            load: InFlightLoad | None = ep.attributes.get(INFLIGHT_ATTRIBUTE_KEY)
+            vals[ep.metadata.address_port] = float(load.tokens if load else 0)
+        return _normalized_inverse(vals)
+
+
+@register_plugin("lora-affinity-scorer")
+class LoraAffinityScorer(PluginBase):
+    """Prefer pods with the requested LoRA active (1.0) or waiting (0.75),
+    else pods with a free adapter slot (0.5) (reference scorer/loraaffinity)."""
+
+    def score(self, ctx, state, request, endpoints):
+        model = request.target_model
+        out = {}
+        for ep in endpoints:
+            m = ep.metrics
+            if model in m.active_models:
+                s = 1.0
+            elif model in m.waiting_models:
+                s = 0.75
+            elif m.max_active_models and (
+                    len(m.active_models) + len(m.waiting_models) < m.max_active_models):
+                s = 0.5
+            else:
+                s = 0.0
+            out[ep.metadata.address_port] = s
+        return out
+
+
+@register_plugin("session-affinity-scorer")
+class SessionAffinityScorer(PluginBase):
+    """Sticky routing via a session token header (reference
+    scorer/sessionaffinity): the PreRequest hook stamps the chosen endpoint
+    into the session token; subsequent requests with the token prefer it."""
+
+    SESSION_HEADER = "x-session-token"
+
+    def score(self, ctx, state, request, endpoints):
+        token = request.headers.get(self.SESSION_HEADER, "")
+        return {ep.metadata.address_port:
+                (1.0 if token and token == ep.metadata.address_port else 0.0)
+                for ep in endpoints}
+
+    def pre_request(self, ctx, request, result) -> None:
+        primary = result.primary().target_endpoints
+        if primary:
+            request.headers[self.SESSION_HEADER] = primary[0].metadata.address_port
+
+
+@register_plugin("context-length-aware-scorer", "context-length-aware")
+class ContextLengthAwareScorer(PluginBase):
+    """Route long-context requests to endpoints with token budget for them
+    (reference scorer/contextlengthaware): estimated tokens vs remaining KV
+    token capacity; falls back to chars/4 when no tokenization is present."""
+
+    AVG_CHARS_PER_TOKEN = 4
+
+    def score(self, ctx, state, request, endpoints):
+        if request.body.tokenized_prompt is not None:
+            need = len(request.body.tokenized_prompt)
+        else:
+            need = len(request.body.prompt_text()) // self.AVG_CHARS_PER_TOKEN
+        out = {}
+        for ep in endpoints:
+            cap = ep.metrics.kv_cache_max_token_capacity
+            if cap <= 0:
+                out[ep.metadata.address_port] = 0.5  # unknown capacity: neutral
+                continue
+            free_tokens = cap * (1.0 - ep.metrics.kv_cache_usage_percent)
+            out[ep.metadata.address_port] = 1.0 if need <= free_tokens else 0.0
+        return out

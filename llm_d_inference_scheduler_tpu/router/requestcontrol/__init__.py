@@ -1,0 +1,5 @@
+from .director import Director, RequestError
+from .admission import LegacyAdmissionController
+from . import producers  # noqa: F401 (registers plugins)
+
+__all__ = ["Director", "RequestError", "LegacyAdmissionController"]

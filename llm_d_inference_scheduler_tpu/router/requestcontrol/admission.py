@@ -1,0 +1,40 @@
+"""Admission controllers (reference: pkg/epp/requestcontrol/admission.go).
+
+LegacyAdmissionController: sheddable requests (priority < 0) are rejected
+while the pool saturation is >= 1.0 (admission.go:64-128). The
+flow-control-backed controller lives in router.flowcontrol and blocks in
+EnqueueAndWait instead.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..framework.datalayer import Endpoint
+from ..framework.scheduling import InferenceRequest
+
+X_REMOVAL_REASON = "x-removal-reason"
+
+
+class AdmissionError(Exception):
+    def __init__(self, code: int, reason: str):
+        super().__init__(reason)
+        self.code = code
+        self.reason = reason
+
+
+class LegacyAdmissionController:
+    def __init__(self, detector: Any):
+        self.detector = detector
+
+    async def admit(self, ctx: Any, request: InferenceRequest,
+                    endpoints: list[Endpoint]) -> None:
+        if request.objectives.priority >= 0:
+            return  # non-sheddable: always admitted here
+        if self.detector is not None and self.detector.saturation(endpoints) >= 1.0:
+            raise AdmissionError(429, "saturated: sheddable request rejected")
+
+
+class AlwaysAdmitController:
+    async def admit(self, ctx, request, endpoints) -> None:
+        return

@@ -1,0 +1,183 @@
+"""Director: per-request orchestration.
+
+Mirrors /root/reference/pkg/epp/requestcontrol/director.go:182-306 —
+model rewrite → objective lookup → admission → candidate endpoints →
+DataProducer plugins (bounded budget, director.go:55: 400ms) → AdmitRequest
+plugins → scheduler → prepareRequest (target header + PreRequest plugins).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+import time
+from typing import Any
+
+from ..datalayer.datastore import Datastore
+from ..framework.datalayer import Endpoint
+from ..framework.scheduling import InferenceRequest, SchedulingResult
+from ..metrics import (
+    REQUEST_ERROR_TOTAL,
+    REQUEST_TOTAL,
+    RUNNING_REQUESTS,
+)
+from .admission import AdmissionError
+
+log = logging.getLogger("router.director")
+
+PRODUCER_BUDGET_S = 0.4  # reference director.go:55
+
+# Wire contract headers (reference pkg/epp/metadata/metadata.go:38-61,
+# pkg/common/routing/common.go:11-17).
+H_REQUEST_ID = "x-request-id"
+H_OBJECTIVE = "x-gateway-inference-objective"
+H_FAIRNESS_ID = "x-gateway-inference-fairness-id"
+H_MODEL_REWRITE = "x-gateway-model-name-rewrite"
+H_DESTINATION = "x-gateway-destination-endpoint"
+H_DESTINATION_SERVED = "x-gateway-destination-endpoint-served"
+H_SUBSET_HINT = "x-gateway-destination-endpoint-subset"
+H_PREFILLER = "x-prefiller-host-port"
+H_ENCODERS = "x-encoder-hosts-ports"
+H_DATA_PARALLEL = "x-data-parallel-host-port"
+
+
+class RequestError(Exception):
+    def __init__(self, code: int, reason: str):
+        super().__init__(reason)
+        self.code = code
+        self.reason = reason
+
+
+class Director:
+    def __init__(self, datastore: Datastore, scheduler: Any, *,
+                 admission: Any,
+                 producers: list[Any] | None = None,
+                 admit_plugins: list[Any] | None = None,
+                 pre_request_plugins: list[Any] | None = None,
+                 response_received: list[Any] | None = None,
+                 response_streaming: list[Any] | None = None,
+                 response_complete: list[Any] | None = None):
+        self.datastore = datastore
+        self.scheduler = scheduler
+        self.admission = admission
+        self.producers = producers or []
+        self.admit_plugins = admit_plugins or []
+        self.pre_request_plugins = pre_request_plugins or []
+        self.response_received = response_received or []
+        self.response_streaming = response_streaming or []
+        self.response_complete = response_complete or []
+        self._rng = random.Random()
+
+    # ---- request path ---------------------------------------------------
+
+    async def handle_request(self, ctx: Any, request: InferenceRequest) -> SchedulingResult:
+        original_model = request.target_model
+
+        # 1. weighted model rewrite (director.go:263-343)
+        rewrite_hdr = request.headers.get(H_MODEL_REWRITE)
+        if rewrite_hdr:
+            request.target_model = rewrite_hdr
+        else:
+            rw = self.datastore.rewrite_for(request.target_model)
+            if rw is not None:
+                request.target_model = rw.pick_target(self._rng)
+
+        # 2. objective → priority (director.go:164-178)
+        obj_name = request.headers.get(H_OBJECTIVE, "")
+        if obj_name:
+            obj = self.datastore.objective_get(obj_name)
+            if obj is not None:
+                request.objectives.priority = obj.priority
+
+        # 3. candidates (+ Envoy subset hint restriction, metadata.go:40-50)
+        candidates = self._candidates(request)
+        if not candidates:
+            REQUEST_ERROR_TOTAL.labels(original_model, "no_endpoints").inc()
+            raise RequestError(503, "no ready endpoints in pool")
+
+        # 4. admission (may block in flow control / shed sheddable load)
+        try:
+            await self.admission.admit(ctx, request, candidates)
+        except AdmissionError as e:
+            REQUEST_ERROR_TOTAL.labels(original_model, "admission").inc()
+            raise RequestError(e.code, e.reason) from None
+
+        # 5. data producers under a global budget (director.go:232, 400ms)
+        await self._run_producers(ctx, request, candidates)
+
+        # 6. admit plugins (latency SLO admitters etc.)
+        for p in self.admit_plugins:
+            ok, reason = await p.admit(ctx, request, candidates)
+            if not ok:
+                REQUEST_ERROR_TOTAL.labels(original_model, "admit_plugin").inc()
+                raise RequestError(429, reason)
+
+        # 7. schedule
+        try:
+            result = self.scheduler.schedule(ctx, request, candidates)
+        except Exception as e:
+            REQUEST_ERROR_TOTAL.labels(original_model, "scheduling").inc()
+            raise RequestError(503, f"scheduling failed: {e}") from None
+        request.scheduling_result = result
+
+        # 8. prepare: destination header + PreRequest plugins (director.go:347-372)
+        primary = result.primary().target_endpoints
+        request.headers[H_DESTINATION] = ",".join(
+            ep.metadata.address_port for ep in primary)
+        for p in self.pre_request_plugins:
+            p.pre_request(ctx, request, result)
+
+        REQUEST_TOTAL.labels(original_model, request.target_model).inc()
+        RUNNING_REQUESTS.labels(request.target_model).inc()
+        return result
+
+    def _candidates(self, request: InferenceRequest) -> list[Endpoint]:
+        eps = self.datastore.endpoint_list()
+        subset = request.headers.get(H_SUBSET_HINT)
+        if subset:
+            allowed = {s.strip() for s in subset.split(",") if s.strip()}
+            eps = [ep for ep in eps if ep.metadata.address_port in allowed]
+        return eps
+
+    async def _run_producers(self, ctx, request, candidates):
+        if not self.producers:
+            return
+        async def run_all():
+            for p in self.producers:  # DAG order (validated at startup)
+                await p.produce(ctx, request, candidates)
+        try:
+            await asyncio.wait_for(run_all(), timeout=PRODUCER_BUDGET_S)
+        except asyncio.TimeoutError:
+            log.warning("data producers exceeded %.0fms budget for %s",
+                        PRODUCER_BUDGET_S * 1e3, request.request_id)
+
+    # ---- fallback & response path ----------------------------------------
+
+    def get_random_endpoint(self) -> Endpoint | None:
+        """Fallback for unparseable bodies (director.go:466)."""
+        eps = self.datastore.endpoint_list()
+        return self._rng.choice(eps) if eps else None
+
+    def handle_response_received(self, ctx, request, endpoint, status: int) -> None:
+        for p in self.response_received:
+            try:
+                p.response_received(ctx, request, endpoint, status)
+            except Exception:
+                log.exception("response_received plugin failure")
+
+    def handle_response_streaming(self, ctx, request, endpoint, chunk: bytes) -> None:
+        for p in self.response_streaming:
+            try:
+                p.response_streaming(ctx, request, endpoint, chunk)
+            except Exception:
+                log.exception("response_streaming plugin failure")
+
+    def handle_response_complete(self, ctx, request, endpoint,
+                                 usage: dict[str, int]) -> None:
+        RUNNING_REQUESTS.labels(request.target_model).dec()
+        for p in self.response_complete:
+            try:
+                p.response_complete(ctx, request, endpoint, usage)
+            except Exception:
+                log.exception("response_complete plugin failure")

@@ -1,0 +1,189 @@
+"""DataProducer plugins: approximate prefix cache + in-flight load.
+
+- approx-prefix-cache-producer (reference:
+  framework/plugins/requestcontrol/dataproducer/approximateprefix — xxhash
+  chains of prompt blocks, per-pod LRU of served block hashes; Produce writes
+  PrefixCacheMatchInfo per endpoint, PreRequest records the chosen pod's
+  blocks; block size auto-tunes from the endpoint's cache_config metrics).
+- inflight-load-producer (reference: .../dataproducer/inflightload — atomic
+  per-endpoint in-flight request/token counters via PreRequest /
+  ResponseComplete; writes InFlightLoad).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any
+
+import xxhash
+
+from ..framework.datalayer import Endpoint
+from ..framework.plugin import PluginBase, register_plugin
+from ..framework.scheduling import InferenceRequest, SchedulingResult
+from ..metrics import PREFIX_HIT_RATIO
+from ..plugins.attributes import (
+    INFLIGHT_ATTRIBUTE_KEY,
+    PREFIX_ATTRIBUTE_KEY,
+    InFlightLoad,
+    PrefixCacheMatchInfo,
+)
+
+AVG_CHARS_PER_TOKEN = 4  # reference prefix_based_pd_decider.go:23
+DEFAULT_BLOCK_SIZE_TOKENS = 16
+DEFAULT_LRU_CAPACITY = 4096
+MAX_PREFIX_BLOCKS = 128
+
+
+class _PodLru:
+    """LRU set of block hashes served by one pod."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self._od: OrderedDict[int, None] = OrderedDict()
+
+    def add(self, h: int) -> None:
+        self._od[h] = None
+        self._od.move_to_end(h)
+        while len(self._od) > self.capacity:
+            self._od.popitem(last=False)
+
+    def contains(self, h: int) -> bool:
+        if h in self._od:
+            self._od.move_to_end(h)
+            return True
+        return False
+
+    def __len__(self):
+        return len(self._od)
+
+
+def chain_block_hashes(model: str, token_ids: list[int] | None, text: str,
+                       block_size_tokens: int) -> list[int]:
+    """xxhash chain over prompt blocks: h_i = xxh64(h_{i-1} || block_i)
+    (reference approximateprefix/hashing.go:35-101)."""
+    h = xxhash.xxh64(model.encode()).intdigest()
+    out = []
+    if token_ids:
+        blocks = [token_ids[i:i + block_size_tokens]
+                  for i in range(0, len(token_ids), block_size_tokens)]
+        # only complete blocks participate in matching
+        blocks = [b for b in blocks if len(b) == block_size_tokens]
+        for b in blocks[:MAX_PREFIX_BLOCKS]:
+            data = h.to_bytes(8, "little") + b"".join(
+                t.to_bytes(4, "little", signed=False) for t in b)
+            h = xxhash.xxh64(data).intdigest()
+            out.append(h)
+    else:
+        step = block_size_tokens * AVG_CHARS_PER_TOKEN
+        raw = text.encode()
+        chunks = [raw[i:i + step] for i in range(0, len(raw), step)]
+        chunks = [c for c in chunks if len(c) == step]
+        for c in chunks[:MAX_PREFIX_BLOCKS]:
+            h = xxhash.xxh64(h.to_bytes(8, "little") + c).intdigest()
+            out.append(h)
+    return out
+
+
+@register_plugin("approx-prefix-cache-producer", "prefix-cache-producer")
+class ApproxPrefixCacheProducer(PluginBase):
+    def __init__(self, name: str | None = None):
+        super().__init__(name)
+        self.block_size_tokens = DEFAULT_BLOCK_SIZE_TOKENS
+        self.lru_capacity = DEFAULT_LRU_CAPACITY
+        self._indexes: dict[str, _PodLru] = {}
+
+    def configure(self, params: dict[str, Any], handle: Any) -> None:
+        self.block_size_tokens = int(params.get("blockSizeTokens", self.block_size_tokens))
+        self.lru_capacity = int(params.get("lruCapacity", self.lru_capacity))
+
+    def produces(self) -> list[str]:
+        return [PREFIX_ATTRIBUTE_KEY]
+
+    def consumes(self) -> list[str]:
+        return []
+
+    def _block_size_for(self, ep: Endpoint) -> int:
+        # autoTune from scraped cache geometry (reference plugin.go:135-248)
+        return ep.metrics.cache_block_size or self.block_size_tokens
+
+    def _lru_for(self, ep: Endpoint) -> _PodLru:
+        key = ep.metadata.address_port
+        lru = self._indexes.get(key)
+        if lru is None:
+            cap = ep.metrics.cache_num_blocks or self.lru_capacity
+            lru = self._indexes[key] = _PodLru(cap)
+        return lru
+
+    def _hashes(self, request: InferenceRequest, block_size: int) -> list[int]:
+        return chain_block_hashes(
+            request.target_model, request.body.tokenized_prompt,
+            request.body.prompt_text(), block_size)
+
+    async def produce(self, ctx: Any, request: InferenceRequest,
+                      endpoints: list[Endpoint]) -> None:
+        for ep in endpoints:
+            bs = self._block_size_for(ep)
+            hashes = self._hashes(request, bs)
+            lru = self._lru_for(ep)
+            match = 0
+            for h in hashes:
+                if lru.contains(h):
+                    match += 1
+                else:
+                    break  # prefix match must be consecutive from the start
+            ep.attributes.put(PREFIX_ATTRIBUTE_KEY,
+                              PrefixCacheMatchInfo(match, len(hashes), bs))
+            if hashes:
+                PREFIX_HIT_RATIO.observe(match / len(hashes))
+
+    def pre_request(self, ctx: Any, request: InferenceRequest,
+                    result: SchedulingResult) -> None:
+        # The chosen pod will now hold these blocks: record them.
+        for ep in result.primary().target_endpoints[:1]:
+            bs = self._block_size_for(ep)
+            lru = self._lru_for(ep)
+            for h in self._hashes(request, bs):
+                lru.add(h)
+
+    def endpoint_removed(self, endpoint: Endpoint) -> None:
+        self._indexes.pop(endpoint.metadata.address_port, None)
+
+    def endpoint_added(self, endpoint: Endpoint) -> None:
+        pass
+
+
+@register_plugin("inflight-load-producer")
+class InflightLoadProducer(PluginBase):
+    def __init__(self, name: str | None = None):
+        super().__init__(name)
+        self._loads: dict[str, InFlightLoad] = {}
+
+    def produces(self) -> list[str]:
+        return [INFLIGHT_ATTRIBUTE_KEY]
+
+    def consumes(self) -> list[str]:
+        return []
+
+    async def produce(self, ctx, request, endpoints):
+        for ep in endpoints:
+            load = self._loads.get(ep.metadata.address_port, InFlightLoad())
+            ep.attributes.put(INFLIGHT_ATTRIBUTE_KEY, load.clone())
+
+    def _estimate_tokens(self, request: InferenceRequest) -> int:
+        if request.body.tokenized_prompt is not None:
+            return len(request.body.tokenized_prompt)
+        return max(len(request.body.prompt_text()) // AVG_CHARS_PER_TOKEN, 1)
+
+    def pre_request(self, ctx, request, result: SchedulingResult) -> None:
+        for ep in result.primary().target_endpoints[:1]:
+            load = self._loads.setdefault(ep.metadata.address_port, InFlightLoad())
+            load.requests += 1
+            load.tokens += self._estimate_tokens(request)
+
+    def response_complete(self, ctx, request, endpoint, usage) -> None:
+        if endpoint is None:
+            return
+        load = self._loads.get(endpoint.metadata.address_port)
+        if load:
+            load.requests = max(load.requests - 1, 0)
+            load.tokens = max(load.tokens - self._estimate_tokens(request), 0)

@@ -1,0 +1,3 @@
+from .scheduler import Scheduler, SchedulerProfile, WeightedScorer
+
+__all__ = ["Scheduler", "SchedulerProfile", "WeightedScorer"]

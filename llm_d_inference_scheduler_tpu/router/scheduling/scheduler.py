@@ -1,0 +1,102 @@
+"""Scheduler core: iterative profile loop → filters → weighted scorers → picker.
+
+Mirrors /root/reference/pkg/epp/scheduling/{scheduler.go:54-102,
+scheduler_profile.go:117-202}: the profile handler picks which profiles to run
+until none remain, each profile runs its filter chain (short-circuit on
+empty), weighted-sums scorer outputs (clamped to [0,1]), and delegates the
+final choice to its picker; the handler then folds per-profile results into a
+SchedulingResult.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Any
+
+from ..framework.datalayer import Endpoint
+from ..framework.scheduling import (
+    CycleState,
+    InferenceRequest,
+    ProfileRunResult,
+    ScoredEndpoint,
+    SchedulingResult,
+)
+from ..metrics import SCHEDULER_E2E_SECONDS, PLUGIN_DURATION_SECONDS
+
+log = logging.getLogger("router.scheduler")
+
+
+@dataclasses.dataclass
+class WeightedScorer:
+    scorer: Any
+    weight: float = 1.0
+
+
+class SchedulerProfile:
+    def __init__(self, name: str, filters: list[Any], scorers: list[WeightedScorer],
+                 picker: Any):
+        self.name = name
+        self.filters = filters
+        self.scorers = scorers
+        self.picker = picker
+
+    def run(self, ctx: Any, request: InferenceRequest, state: CycleState,
+            endpoints: list[Endpoint]) -> ProfileRunResult | None:
+        candidates = endpoints
+        for f in self.filters:
+            t0 = time.monotonic()
+            candidates = f.filter(ctx, state, request, candidates)
+            PLUGIN_DURATION_SECONDS.labels("filter", str(f.typed_name())).observe(
+                time.monotonic() - t0)
+            if not candidates:
+                log.debug("profile %s: filter %s emptied the candidate set",
+                          self.name, f.typed_name())
+                return None
+
+        totals: dict[str, float] = {ep.metadata.address_port: 0.0 for ep in candidates}
+        raw_scores: dict[str, dict[str, float]] = {}
+        for ws in self.scorers:
+            t0 = time.monotonic()
+            scores = ws.scorer.score(ctx, state, request, candidates)
+            PLUGIN_DURATION_SECONDS.labels("scorer", str(ws.scorer.typed_name())).observe(
+                time.monotonic() - t0)
+            raw_scores[str(ws.scorer.typed_name())] = scores
+            for key in totals:
+                s = min(max(scores.get(key, 0.0), 0.0), 1.0)  # clamp to [0,1]
+                totals[key] += ws.weight * s
+
+        scored = [ScoredEndpoint(ep, totals[ep.metadata.address_port])
+                  for ep in candidates]
+        t0 = time.monotonic()
+        picked = self.picker.pick(ctx, state, request, scored)
+        PLUGIN_DURATION_SECONDS.labels("picker", str(self.picker.typed_name())).observe(
+            time.monotonic() - t0)
+        if not picked:
+            return None
+        return ProfileRunResult(target_endpoints=picked, raw_scores=raw_scores)
+
+
+class Scheduler:
+    def __init__(self, profiles: dict[str, SchedulerProfile], profile_handler: Any):
+        self.profiles = profiles
+        self.profile_handler = profile_handler
+
+    def schedule(self, ctx: Any, request: InferenceRequest,
+                 candidates: list[Endpoint]) -> SchedulingResult:
+        t_start = time.monotonic()
+        state = CycleState()
+        results: dict[str, ProfileRunResult] = {}
+        while True:
+            to_run = self.profile_handler.pick_profiles(
+                ctx, request, {n: p for n, p in self.profiles.items() if n not in results},
+                results)
+            if not to_run:
+                break
+            for name, profile in to_run.items():
+                res = profile.run(ctx, request, state, candidates)
+                results[name] = res  # None marks a failed/empty profile
+        result = self.profile_handler.process_results(ctx, request, results)
+        SCHEDULER_E2E_SECONDS.observe(time.monotonic() - t_start)
+        return result

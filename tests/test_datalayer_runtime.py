@@ -1,0 +1,52 @@
+"""Data layer against a live engine: collectors scrape real /metrics."""
+
+import asyncio
+
+from llm_d_inference_scheduler_tpu.engine import EngineConfig
+from llm_d_inference_scheduler_tpu.engine.server import EngineServer
+from llm_d_inference_scheduler_tpu.router.datalayer.datastore import Datastore
+from llm_d_inference_scheduler_tpu.router.datalayer.extractor import CoreMetricsExtractor
+from llm_d_inference_scheduler_tpu.router.datalayer.metrics_source import MetricsDataSource
+from llm_d_inference_scheduler_tpu.router.datalayer.runtime import DataLayerRuntime
+from llm_d_inference_scheduler_tpu.router.framework.datalayer import EndpointMetadata
+
+
+def test_collector_scrapes_live_engine():
+    async def body():
+        server = EngineServer(EngineConfig(backend="sim", model="tiny", port=18331,
+                                           max_batch=2))
+        await server.start()
+        ds = Datastore()
+        runtime = DataLayerRuntime(ds, poll_interval=0.02)
+        src = MetricsDataSource("metrics-data-source")
+        src.add_extractor(CoreMetricsExtractor("core-metrics-extractor"))
+        runtime.register_source(src)
+        await runtime.start()
+        try:
+            ep = ds.endpoint_add_or_update(EndpointMetadata(
+                name="e1", address="127.0.0.1", port=18331))
+            # Generate load so the gauges move.
+            import httpx
+            async with httpx.AsyncClient(timeout=30) as c:
+                tasks = [c.post("http://127.0.0.1:18331/v1/completions",
+                                json={"prompt": "x" * 50, "max_tokens": 20})
+                         for _ in range(4)]
+                done = asyncio.gather(*tasks)
+                seen_running = False
+                for _ in range(60):
+                    await asyncio.sleep(0.02)
+                    if ep.metrics.running_requests_size > 0:
+                        seen_running = True
+                        break
+                await done
+            assert seen_running, "collector never observed running requests"
+            assert ep.metrics.fresh
+            assert ep.metrics.cache_block_size == 16
+            # Endpoint removal stops its collector.
+            ds.endpoint_delete("127.0.0.1:18331")
+            assert not runtime._collectors
+        finally:
+            await runtime.stop()
+            await server.stop()
+
+    asyncio.run(body())

@@ -160,3 +160,36 @@ def test_kv_export_ttl_sweep():
             await eng.stop()
 
     run(body())
+
+
+def test_stream_stop_string_across_token_boundary():
+    """Sim emits one char per token; a multi-char stop string must not leak
+    its prefix into the SSE stream."""
+    async def body():
+        cfg = _cfg("sim", 18324)
+        server = EngineServer(cfg)
+        await server.start()
+        try:
+            async with httpx.AsyncClient(base_url="http://127.0.0.1:18324",
+                                         timeout=30) as c:
+                text = ""
+                finish = None
+                async with c.stream("POST", "/v1/completions", json={
+                        "prompt": "x", "max_tokens": 30, "stream": True,
+                        "stop": ["m ips"]}) as r:
+                    async for line in r.aiter_lines():
+                        if not line.startswith("data: ") or line == "data: [DONE]":
+                            continue
+                        import json as _json
+                        doc = _json.loads(line[6:])
+                        ch = doc["choices"][0]
+                        text += ch.get("text", "")
+                        if ch.get("finish_reason"):
+                            finish = ch["finish_reason"]
+                            assert doc["usage"]["prompt_tokens"] > 0
+                assert finish == "stop"
+                assert text == "lore", repr(text)  # truncated before "m ips"
+        finally:
+            await server.stop()
+
+    run(body())

@@ -1,0 +1,202 @@
+"""Router core: scheduler loop, scorers/filters/pickers, config loader, extractor."""
+
+import pytest
+
+from llm_d_inference_scheduler_tpu.router import plugins  # noqa: F401 (registers)
+from llm_d_inference_scheduler_tpu.router.config.loader import Handle, load_config
+from llm_d_inference_scheduler_tpu.router.datalayer.data_graph import (
+    DataDependencyError,
+    validate_and_order_producers,
+)
+from llm_d_inference_scheduler_tpu.router.datalayer.datastore import Datastore
+from llm_d_inference_scheduler_tpu.router.datalayer.extractor import CoreMetricsExtractor
+from llm_d_inference_scheduler_tpu.router.framework.datalayer import (
+    Endpoint,
+    EndpointMetadata,
+)
+from llm_d_inference_scheduler_tpu.router.framework.plugin import TypedName
+from llm_d_inference_scheduler_tpu.router.framework.scheduling import (
+    InferenceRequest,
+    InferenceRequestBody,
+)
+from llm_d_inference_scheduler_tpu.router.plugins.attributes import (
+    PREFIX_ATTRIBUTE_KEY,
+    PrefixCacheMatchInfo,
+)
+
+
+def ep(addr, port=8200, role=None, waiting=0, kv=0.0, running=0, fresh=True):
+    labels = {"llm-d.ai/role": role} if role else {}
+    e = Endpoint(EndpointMetadata(name=addr, address=addr, port=port, labels=labels))
+    e.metrics.waiting_queue_size = waiting
+    e.metrics.kv_cache_usage_percent = kv
+    e.metrics.running_requests_size = running
+    if fresh:
+        import time
+        e.metrics.update_time = time.monotonic()
+    return e
+
+
+def req(model="m", prompt="hello", headers=None):
+    return InferenceRequest(
+        request_id="r1", target_model=model,
+        body=InferenceRequestBody(completions={"model": model, "prompt": prompt}),
+        headers=headers or {})
+
+
+def test_default_config_schedules_least_loaded():
+    handle = Handle(datastore=Datastore())
+    cfg = load_config(None, handle)
+    eps = [ep("10.0.0.1", waiting=10, kv=0.9),
+           ep("10.0.0.2", waiting=0, kv=0.1),
+           ep("10.0.0.3", waiting=5, kv=0.5)]
+    result = cfg.scheduler.schedule(None, req(), eps)
+    picked = result.primary().target_endpoints
+    assert len(picked) == 1
+    assert picked[0].metadata.address == "10.0.0.2"
+
+
+def test_prefix_scorer_dominates_when_weighted():
+    handle = Handle(datastore=Datastore())
+    cfg = load_config(None, handle)  # prefix weight 3 vs queue/kv 2 each
+    hot = ep("10.0.0.1", waiting=3, kv=0.5)
+    hot.attributes.put(PREFIX_ATTRIBUTE_KEY, PrefixCacheMatchInfo(9, 10, 16))
+    cold = ep("10.0.0.2", waiting=2, kv=0.4)
+    cold.attributes.put(PREFIX_ATTRIBUTE_KEY, PrefixCacheMatchInfo(0, 10, 16))
+    result = cfg.scheduler.schedule(None, req(), [hot, cold])
+    # hot: queue 0*2 + kv 0.5*2 + prefix 0.9*3 = 3.7 ; cold: 2 + 1.2 + 0 = 3.2
+    assert result.primary().target_endpoints[0].metadata.address == "10.0.0.1"
+
+
+def test_role_filters():
+    from llm_d_inference_scheduler_tpu.router.plugins.filters import (
+        DecodeFilter, EncodeFilter, PrefillFilter)
+
+    eps = [ep("1", role="prefill"), ep("2", role="decode"), ep("3"),
+           ep("4", role="both"), ep("5", role="encode")]
+    d = DecodeFilter("d").filter(None, None, req(), eps)
+    assert {e.metadata.address for e in d} == {"2", "3", "4"}
+    p = PrefillFilter("p").filter(None, None, req(), eps)
+    assert {e.metadata.address for e in p} == {"1", "4"}
+    enc = EncodeFilter("e").filter(None, None, req(), eps)
+    assert {e.metadata.address for e in enc} == {"5"}
+
+
+def test_custom_config_yaml():
+    yaml_text = """
+featureGates: {flowControl: false}
+pool:
+  endpoints:
+    - address: 127.0.0.1
+      port: 9001
+      labels: {llm-d.ai/role: decode}
+plugins:
+  - type: load-aware-scorer
+    parameters: {queueDepthThreshold: 10}
+  - type: weighted-random-picker
+    parameters: {maxNumOfEndpoints: 2}
+schedulingProfiles:
+  - name: default
+    plugins:
+      - pluginRef: load-aware-scorer
+        weight: 1
+      - pluginRef: weighted-random-picker
+"""
+    handle = Handle(datastore=Datastore())
+    cfg = load_config(yaml_text, handle)
+    assert cfg.static_endpoints[0].port == 9001
+    eps = [ep("a", waiting=0), ep("b", waiting=0), ep("c", waiting=100)]
+    result = cfg.scheduler.schedule(None, req(), eps)
+    picked = result.primary().target_endpoints
+    assert len(picked) == 2  # maxNumOfEndpoints honored
+    assert {e.metadata.address for e in picked} <= {"a", "b", "c"}
+
+
+def test_session_affinity_roundtrip():
+    handle = Handle(datastore=Datastore())
+    cfg = load_config("""
+plugins:
+  - type: session-affinity-scorer
+  - type: queue-scorer
+schedulingProfiles:
+  - name: default
+    plugins:
+      - pluginRef: session-affinity-scorer
+        weight: 10
+      - pluginRef: queue-scorer
+""", handle)
+    eps = [ep("a", waiting=0), ep("b", waiting=5)]
+    r1 = req()
+    result = cfg.scheduler.schedule(None, r1, eps)
+    chosen = result.primary().target_endpoints[0].metadata.address_port
+    for p in cfg.pre_request_plugins:
+        p.pre_request(None, r1, result)
+    assert r1.headers["x-session-token"] == chosen
+    # A follow-up with the token sticks even if the other endpoint is less loaded.
+    r2 = req(headers={"x-session-token": "b:8200"})
+    result2 = cfg.scheduler.schedule(None, r2, eps)
+    assert result2.primary().target_endpoints[0].metadata.address_port == "b:8200"
+
+
+def test_extractor_parses_jetstream_and_vllm():
+    text = """# HELP jetstream:num_requests_waiting w
+# TYPE jetstream:num_requests_waiting gauge
+jetstream:num_requests_waiting 7.0
+jetstream:num_requests_running 3.0
+jetstream:kv_cache_usage_perc 0.42
+jetstream:lora_requests_info{max_lora="4",running_lora_adapters="a,b",waiting_lora_adapters="c"} 1.0
+jetstream:cache_config_info{block_size="16",num_gpu_blocks="1000"} 1.0
+"""
+    e = ep("x", fresh=False)
+    CoreMetricsExtractor("core").extract(text, e)
+    m = e.metrics
+    assert m.waiting_queue_size == 7 and m.running_requests_size == 3
+    assert abs(m.kv_cache_usage_percent - 0.42) < 1e-9
+    assert m.active_models == {"a": 1, "b": 1} and m.waiting_models == {"c": 1}
+    assert m.max_active_models == 4
+    assert m.kv_cache_max_token_capacity == 16000
+    assert m.fresh
+
+    vllm_text = "vllm:num_requests_waiting 9\nvllm:num_requests_running 1\nvllm:kv_cache_usage_perc 0.5\n"
+    e2 = ep("y", fresh=False)
+    e2.metadata.labels["llm-d.ai/engine-type"] = "vllm"
+    CoreMetricsExtractor("core").extract(vllm_text, e2)
+    assert e2.metrics.waiting_queue_size == 9
+
+
+def test_data_graph_ordering_and_cycles():
+    class P:
+        def __init__(self, name, produces, consumes):
+            self._n, self._p, self._c = name, produces, consumes
+
+        def typed_name(self):
+            return TypedName("producer", self._n)
+
+        def produces(self):
+            return self._p
+
+        def consumes(self):
+            return self._c
+
+    a = P("a", ["k1"], [])
+    b = P("b", ["k2"], ["k1"])
+    c = P("c", [], ["k2"])
+    order = validate_and_order_producers([c, b, a])
+    assert order.index(a) < order.index(b) < order.index(c)
+
+    x = P("x", ["k3"], ["k4"])
+    y = P("y", ["k4"], ["k3"])
+    with pytest.raises(DataDependencyError):
+        validate_and_order_producers([x, y])
+
+
+def test_model_rewrite_weighted():
+    from llm_d_inference_scheduler_tpu.router.datalayer.datastore import (
+        InferenceModelRewrite, ModelRewriteTarget)
+    import random
+
+    rw = InferenceModelRewrite("rw", "base", [
+        ModelRewriteTarget("a", 3), ModelRewriteTarget("b", 1)])
+    rng = random.Random(7)
+    picks = [rw.pick_target(rng) for _ in range(400)]
+    assert 0.6 < picks.count("a") / 400 < 0.9
