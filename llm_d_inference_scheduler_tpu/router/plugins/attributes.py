@@ -8,6 +8,16 @@ import dataclasses
 PREFIX_ATTRIBUTE_KEY = "attribute/prefix"
 INFLIGHT_ATTRIBUTE_KEY = "attribute/concurrency"
 
+AVG_CHARS_PER_TOKEN = 4  # reference prefix_based_pd_decider.go:23
+
+
+def estimate_input_tokens(request) -> int:
+    """Shared token estimate: exact when a tokenized prompt is present,
+    chars/4 heuristic otherwise (never below 1)."""
+    if request.body.tokenized_prompt is not None:
+        return max(len(request.body.tokenized_prompt), 1)
+    return max(len(request.body.prompt_text()) // AVG_CHARS_PER_TOKEN, 1)
+
 
 @dataclasses.dataclass
 class PrefixCacheMatchInfo:

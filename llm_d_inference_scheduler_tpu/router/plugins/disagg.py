@@ -1,0 +1,159 @@
+"""P/D(/E) disaggregation: profile handler + deciders.
+
+Mirrors the reference's disagg-profile-handler
+(/root/reference/pkg/epp/framework/plugins/scheduling/profilehandler/disagg/
+disagg_profile_handler.go:246-444) and its decider sub-plugins
+(decider_plugin.go, prefix_based_pd_decider.go:99-149):
+
+- the decode profile always runs first;
+- the prefill stage is gated by a PD decider evaluated against the *chosen
+  decode pod's* prefix-cache state (only non-cached prefix tokens justify a
+  remote prefill);
+- PreRequest writes the x-prefiller-host-port (and x-encoder-hosts-ports)
+  routing headers consumed by the decode pod's sidecar.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+from ..framework.datalayer import Endpoint
+from ..framework.plugin import PluginBase, global_registry, register_plugin
+from ..framework.scheduling import (
+    InferenceRequest,
+    ProfileRunResult,
+    SchedulingResult,
+)
+from ..metrics import DISAGG_DECISION_TOTAL
+from ..requestcontrol.director import H_ENCODERS, H_PREFILLER
+from .attributes import PREFIX_ATTRIBUTE_KEY, PrefixCacheMatchInfo, estimate_input_tokens
+from .profile_handlers import SchedulingError
+
+log = logging.getLogger("router.disagg")
+
+
+@register_plugin("prefix-based-pd-decider")
+class PrefixBasedPdDecider(PluginBase):
+    """Disaggregate iff non-cached input tokens ≥ threshold
+    (prefix_based_pd_decider.go:99-149)."""
+
+    def __init__(self, name: str | None = None):
+        super().__init__(name)
+        self.threshold_tokens = 256
+
+    def configure(self, params: dict[str, Any], handle: Any) -> None:
+        self.threshold_tokens = int(params.get("thresholdTokens", self.threshold_tokens))
+
+    def disaggregate(self, ctx: Any, request: InferenceRequest,
+                     decode_endpoint: Endpoint) -> bool:
+        input_tokens = estimate_input_tokens(request)
+        info: PrefixCacheMatchInfo | None = decode_endpoint.attributes.get(
+            PREFIX_ATTRIBUTE_KEY)
+        cached = info.match_blocks * info.block_size_tokens if info else 0
+        return (input_tokens - cached) >= self.threshold_tokens
+
+
+@register_plugin("always-disagg-pd-decider")
+class AlwaysDisaggPdDecider(PluginBase):
+    """Always split (benchmarking — always_disagg_pd_decider.go)."""
+
+    def disaggregate(self, ctx, request, decode_endpoint) -> bool:
+        return True
+
+
+@register_plugin("always-disagg-multimodal-decider")
+class AlwaysDisaggMultimodalDecider(PluginBase):
+    """Split iff the request carries image/video/audio blocks
+    (always_disagg_mm_decider.go)."""
+
+    MM_TYPES = ("image_url", "video_url", "input_audio")
+
+    def disaggregate(self, ctx, request, decode_endpoint) -> bool:
+        chat = request.body.chat_completions
+        if not chat:
+            return False
+        for m in chat.get("messages", []):
+            content = m.get("content")
+            if isinstance(content, list):
+                for block in content:
+                    if isinstance(block, dict) and block.get("type") in self.MM_TYPES:
+                        return True
+        return False
+
+
+@register_plugin("disagg-profile-handler", "pd-profile-handler")
+class DisaggProfileHandler(PluginBase):
+    """Unified D / P-D (E-stages reserved) profile orchestration."""
+
+    DECODE, PREFILL, ENCODE = "decode", "prefill", "encode"
+
+    def __init__(self, name: str | None = None):
+        super().__init__(name)
+        self.pd_decider: Any = None
+        self.encode_decider: Any = None
+
+    def configure(self, params: dict[str, Any], handle: Any) -> None:
+        spec = params.get("pdDecider") or {"type": "prefix-based-pd-decider"}
+        if isinstance(spec, str):
+            spec = {"type": spec}
+        self.pd_decider = global_registry.instantiate(
+            spec["type"], spec.get("name") or spec["type"],
+            spec.get("parameters") or params.get("pdDeciderParameters") or {}, handle)
+        enc = params.get("encodeDecider")
+        if enc:
+            if isinstance(enc, str):
+                enc = {"type": enc}
+            self.encode_decider = global_registry.instantiate(
+                enc["type"], enc.get("name") or enc["type"],
+                enc.get("parameters") or {}, handle)
+
+    # ---- ProfileHandler ------------------------------------------------
+
+    def pick_profiles(self, ctx, request: InferenceRequest, profiles: dict[str, Any],
+                      results: dict[str, ProfileRunResult]) -> dict[str, Any]:
+        # Decode first, always (disagg_profile_handler.go:246-319).
+        if self.DECODE not in results:
+            if self.DECODE not in profiles:
+                raise SchedulingError("disagg-profile-handler requires a 'decode' profile")
+            return {self.DECODE: profiles[self.DECODE]}
+        decode_res = results.get(self.DECODE)
+        if decode_res is None:
+            return {}  # decode failed; nothing else to do
+
+        to_run: dict[str, Any] = {}
+        decode_ep = decode_res.target_endpoints[0]
+        if (self.ENCODE in profiles and self.ENCODE not in results
+                and self.encode_decider is not None
+                and self.encode_decider.disaggregate(ctx, request, decode_ep)):
+            to_run[self.ENCODE] = profiles[self.ENCODE]
+        if (self.PREFILL in profiles and self.PREFILL not in results
+                and self.pd_decider is not None
+                and self.pd_decider.disaggregate(ctx, request, decode_ep)):
+            to_run[self.PREFILL] = profiles[self.PREFILL]
+        return to_run
+
+    def process_results(self, ctx, request, results) -> SchedulingResult:
+        ok = {n: r for n, r in results.items() if r is not None}
+        if self.DECODE not in ok:
+            raise SchedulingError("no decode endpoint available")
+        stages = []
+        if self.ENCODE in ok:
+            stages.append("encode")
+        if self.PREFILL in ok:
+            stages.append("prefill")
+        stages.append("decode")
+        DISAGG_DECISION_TOTAL.labels(decision_type="-".join(stages)).inc()
+        return SchedulingResult(profile_results=ok, primary_profile_name=self.DECODE)
+
+    # ---- PreRequest: routing headers (disagg_profile_handler.go:360-444) --
+
+    def pre_request(self, ctx, request: InferenceRequest,
+                    result: SchedulingResult) -> None:
+        prefill = result.profile_results.get(self.PREFILL)
+        if prefill and prefill.target_endpoints:
+            request.headers[H_PREFILLER] = prefill.target_endpoints[0].metadata.address_port
+        encode = result.profile_results.get(self.ENCODE)
+        if encode and encode.target_endpoints:
+            request.headers[H_ENCODERS] = ",".join(
+                ep.metadata.address_port for ep in encode.target_endpoints)

@@ -169,13 +169,10 @@ class ContextLengthAwareScorer(PluginBase):
     (reference scorer/contextlengthaware): estimated tokens vs remaining KV
     token capacity; falls back to chars/4 when no tokenization is present."""
 
-    AVG_CHARS_PER_TOKEN = 4
-
     def score(self, ctx, state, request, endpoints):
-        if request.body.tokenized_prompt is not None:
-            need = len(request.body.tokenized_prompt)
-        else:
-            need = len(request.body.prompt_text()) // self.AVG_CHARS_PER_TOKEN
+        from .attributes import estimate_input_tokens
+
+        need = estimate_input_tokens(request)
         out = {}
         for ep in endpoints:
             cap = ep.metrics.kv_cache_max_token_capacity

@@ -22,13 +22,13 @@ from ..framework.plugin import PluginBase, register_plugin
 from ..framework.scheduling import InferenceRequest, SchedulingResult
 from ..metrics import PREFIX_HIT_RATIO
 from ..plugins.attributes import (
+    AVG_CHARS_PER_TOKEN,
     INFLIGHT_ATTRIBUTE_KEY,
     PREFIX_ATTRIBUTE_KEY,
     InFlightLoad,
     PrefixCacheMatchInfo,
+    estimate_input_tokens,
 )
-
-AVG_CHARS_PER_TOKEN = 4  # reference prefix_based_pd_decider.go:23
 DEFAULT_BLOCK_SIZE_TOKENS = 16
 DEFAULT_LRU_CAPACITY = 4096
 MAX_PREFIX_BLOCKS = 128
@@ -170,9 +170,7 @@ class InflightLoadProducer(PluginBase):
             ep.attributes.put(INFLIGHT_ATTRIBUTE_KEY, load.clone())
 
     def _estimate_tokens(self, request: InferenceRequest) -> int:
-        if request.body.tokenized_prompt is not None:
-            return len(request.body.tokenized_prompt)
-        return max(len(request.body.prompt_text()) // AVG_CHARS_PER_TOKEN, 1)
+        return estimate_input_tokens(request)
 
     def pre_request(self, ctx, request, result: SchedulingResult) -> None:
         for ep in result.primary().target_endpoints[:1]:

@@ -1,0 +1,3 @@
+from .proxy import Sidecar, SidecarConfig
+
+__all__ = ["Sidecar", "SidecarConfig"]

@@ -1,0 +1,207 @@
+"""P/D disaggregation sidecar: the decode-pod data plane.
+
+Mirrors /root/reference/pkg/sidecar/proxy (SURVEY §2.10): an HTTP reverse
+proxy colocated with each decode engine that executes the multi-stage
+Prefill→Decode lifecycle. It reads and strips the router's
+x-prefiller-host-port header, runs the configured KV connector protocol
+against the remote prefill worker, then dispatches decode locally. No sidecar
+runs on prefill nodes (docs/disaggregation.md:168-177).
+
+Connectors:
+- tpu-dcn (default; the NIXL-v2 analogue, connector_nixlv2.go:35-300):
+  2-phase — (1) prefill request with kv_transfer_params{do_remote_decode},
+  stream=false, max_tokens=1; (2) decode request carrying the prefiller's
+  returned kv_transfer_params so the decode engine pulls KV over the
+  host-staged DCN path (engine /kv fetch). Falls back to plain decode when
+  prefill fails.
+- passthrough: ignore disagg headers, always decode locally.
+
+SSRF protection: with an allowlist configured, only listed prefill targets
+are honored (reference allowlist.go).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import time
+from typing import Any
+
+import httpx
+from aiohttp import web
+
+log = logging.getLogger("router.sidecar")
+
+H_PREFILLER = "x-prefiller-host-port"
+H_ENCODERS = "x-encoder-hosts-ports"
+
+GEN_PATHS = ("/v1/completions", "/v1/chat/completions", "/v1/responses")
+
+
+@dataclasses.dataclass
+class SidecarConfig:
+    port: int = 8000
+    host: str = "127.0.0.1"
+    decoder_url: str = "http://127.0.0.1:8200"
+    connector: str = "tpu-dcn"         # "tpu-dcn" | "passthrough"
+    ssrf_allowlist: list[str] | None = None  # None disables SSRF protection
+    prefill_timeout_s: float = 120.0
+    decode_timeout_s: float = 300.0
+
+
+class Sidecar:
+    def __init__(self, cfg: SidecarConfig):
+        self.cfg = cfg
+        self.app = web.Application()
+        self.app.add_routes([web.post(p, self.handle_generate) for p in GEN_PATHS])
+        self.app.add_routes([
+            web.get("/metrics", self._proxy_get),
+            web.get("/health", self._proxy_get),
+            web.get("/v1/models", self._proxy_get),
+        ])
+        self._runner: web.AppRunner | None = None
+        self._client: httpx.AsyncClient | None = None
+
+    async def start(self):
+        self._client = httpx.AsyncClient(
+            timeout=httpx.Timeout(self.cfg.decode_timeout_s, connect=5.0))
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.cfg.host, self.cfg.port)
+        await site.start()
+        log.info("sidecar on %s:%s -> decoder %s (connector=%s)",
+                 self.cfg.host, self.cfg.port, self.cfg.decoder_url,
+                 self.cfg.connector)
+
+    async def stop(self):
+        if self._runner:
+            await self._runner.cleanup()
+        if self._client:
+            await self._client.aclose()
+
+    # ---- request handling ------------------------------------------------
+
+    async def handle_generate(self, request: web.Request) -> web.StreamResponse:
+        raw = await request.read()
+        try:
+            body = json.loads(raw)
+        except Exception:
+            return web.json_response({"error": "invalid JSON"}, status=400)
+
+        # Disagg headers are consumed here and never forwarded downstream
+        # (upstream dispatch builds its own header set).
+        prefiller = request.headers.get(H_PREFILLER)
+
+        if prefiller and self.cfg.connector != "passthrough":
+            if (self.cfg.ssrf_allowlist is not None
+                    and prefiller not in self.cfg.ssrf_allowlist):
+                return web.json_response(
+                    {"error": f"prefiller {prefiller} not in allowlist"}, status=403)
+            return await self._run_pd_protocol(request, body, prefiller)
+        return await self._dispatch_decode(request, body)
+
+    async def _run_pd_protocol(self, request: web.Request, body: dict[str, Any],
+                               prefiller: str) -> web.StreamResponse:
+        """2-phase tpu-dcn protocol (NIXL-v2 analogue)."""
+        t0 = time.monotonic()
+        prefill_body = dict(body)
+        prefill_body["kv_transfer_params"] = {"do_remote_decode": True}
+        prefill_body["stream"] = False
+        prefill_body["max_tokens"] = 1  # connector_nixlv2.go:109-131
+
+        ktp = None
+        try:
+            r = await self._client.post(
+                f"http://{prefiller}{request.path}", json=prefill_body,
+                timeout=self.cfg.prefill_timeout_s)
+            if r.status_code == 200:
+                ktp = r.json().get("kv_transfer_params")
+            else:
+                log.warning("prefill at %s returned %d; falling back to decode",
+                            prefiller, r.status_code)
+        except Exception as e:
+            log.warning("prefill at %s failed (%s); falling back to decode",
+                        prefiller, e)
+
+        decode_body = dict(body)
+        if ktp is not None:
+            decode_body["kv_transfer_params"] = ktp
+        prefill_ms = (time.monotonic() - t0) * 1e3
+        return await self._dispatch_decode(request, decode_body,
+                                           extra_headers={
+                                               "x-prefill-duration-ms": f"{prefill_ms:.1f}"})
+
+    async def _dispatch_decode(self, request: web.Request, body: dict[str, Any],
+                               extra_headers: dict[str, str] | None = None
+                               ) -> web.StreamResponse:
+        url = self.cfg.decoder_url + request.path
+        try:
+            upstream = self._client.build_request(
+                "POST", url, json=body, headers={"content-type": "application/json"})
+            resp = await self._client.send(upstream, stream=True)
+        except Exception as e:
+            return web.json_response({"error": f"decode dispatch failed: {e}"},
+                                     status=502)
+        out_headers = {"content-type": resp.headers.get("content-type",
+                                                        "application/json")}
+        out_headers.update(extra_headers or {})
+        try:
+            if "text/event-stream" in out_headers["content-type"]:
+                ws = web.StreamResponse(status=resp.status_code, headers=out_headers)
+                await ws.prepare(request)
+                async for chunk in resp.aiter_bytes():
+                    await ws.write(chunk)
+                await ws.write_eof()
+                return ws
+            data = await resp.aread()
+            return web.Response(body=data, status=resp.status_code,
+                                headers=out_headers)
+        finally:
+            await resp.aclose()
+
+    async def _proxy_get(self, request: web.Request) -> web.Response:
+        try:
+            r = await self._client.get(self.cfg.decoder_url + request.path)
+            return web.Response(body=r.content, status=r.status_code,
+                                content_type=r.headers.get("content-type",
+                                                           "text/plain").split(";")[0])
+        except Exception as e:
+            return web.json_response({"error": str(e)}, status=502)
+
+
+def main(argv: list[str] | None = None):
+    import argparse
+    import asyncio
+
+    p = argparse.ArgumentParser(description="P/D disaggregation sidecar")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--decoder", default="http://127.0.0.1:8200")
+    p.add_argument("--connector", default="tpu-dcn",
+                   choices=["tpu-dcn", "passthrough"])
+    p.add_argument("--allowlist", default=None,
+                   help="comma-separated allowed prefill host:ports "
+                        "(enables SSRF protection)")
+    args = p.parse_args(argv)
+    cfg = SidecarConfig(
+        port=args.port, host=args.host, decoder_url=args.decoder,
+        connector=args.connector,
+        ssrf_allowlist=[s.strip() for s in args.allowlist.split(",") if s.strip()]
+        if args.allowlist else None)
+    logging.basicConfig(level=logging.INFO)
+
+    async def run():
+        sc = Sidecar(cfg)
+        await sc.start()
+        try:
+            while True:
+                await asyncio.sleep(3600)
+        except asyncio.CancelledError:
+            await sc.stop()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

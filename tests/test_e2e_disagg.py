@@ -1,0 +1,161 @@
+"""Full P/D disaggregation path: gateway → sidecar → prefill/decode engines.
+
+BASELINE config #3 shape at CPU-test scale: the disagg profile handler gates a
+remote prefill on the decode pod's prefix state, the sidecar runs the 2-phase
+tpu-dcn connector, and the decode engine imports the prefilled KV.
+"""
+
+import asyncio
+
+import httpx
+
+from llm_d_inference_scheduler_tpu.engine import EngineConfig
+from llm_d_inference_scheduler_tpu.engine.server import EngineServer
+from llm_d_inference_scheduler_tpu.router.gateway import build_gateway
+from llm_d_inference_scheduler_tpu.router.sidecar import Sidecar, SidecarConfig
+
+GW, SC, DEC, PRE = 18360, 18361, 18362, 18363
+
+CFG = f"""
+pool:
+  endpoints:
+    - {{address: 127.0.0.1, port: {SC}, labels: {{llm-d.ai/role: decode}}}}
+    - {{address: 127.0.0.1, port: {PRE}, labels: {{llm-d.ai/role: prefill}}}}
+plugins:
+  - {{type: decode-filter}}
+  - {{type: prefill-filter}}
+  - {{type: queue-scorer}}
+  - {{type: approx-prefix-cache-producer}}
+  - {{type: prefix-cache-scorer}}
+  - type: disagg-profile-handler
+    parameters:
+      pdDecider:
+        type: prefix-based-pd-decider
+        parameters: {{thresholdTokens: 16}}
+schedulingProfiles:
+  - name: decode
+    plugins:
+      - {{pluginRef: decode-filter}}
+      - {{pluginRef: prefix-cache-scorer, weight: 3}}
+      - {{pluginRef: queue-scorer, weight: 2}}
+  - name: prefill
+    plugins:
+      - {{pluginRef: prefill-filter}}
+      - {{pluginRef: queue-scorer}}
+"""
+
+LONG_PROMPT = "please summarise the following very important document: " * 4
+SHORT_PROMPT = "hi"
+
+
+def _engine(port, role):
+    return EngineServer(EngineConfig(backend="tpu", model="tiny", port=port,
+                                     max_batch=4, max_model_len=256, role=role))
+
+
+def test_disagg_path_end_to_end():
+    async def body():
+        dec = _engine(DEC, "decode")
+        pre = _engine(PRE, "prefill")
+        await dec.start()
+        await pre.start()
+        sc = Sidecar(SidecarConfig(port=SC, decoder_url=f"http://127.0.0.1:{DEC}",
+                                   ssrf_allowlist=[f"127.0.0.1:{PRE}"]))
+        await sc.start()
+        gw = build_gateway(CFG, port=GW, poll_interval=0.02)
+        await gw.start()
+        try:
+            async with httpx.AsyncClient(timeout=120) as c:
+                # Monolithic reference answer straight from the decode engine.
+                r = await c.post(f"http://127.0.0.1:{DEC}/v1/completions",
+                                 json={"prompt": LONG_PROMPT, "max_tokens": 6})
+                mono_text = r.json()["choices"][0]["text"]
+
+                pre_prompt_tokens_before = _counter_value(
+                    pre, "jetstream:prompt_tokens_total")
+
+                # Through the router: long prompt → P/D split.
+                r = await c.post(f"http://127.0.0.1:{GW}/v1/completions",
+                                 json={"model": "tiny", "prompt": LONG_PROMPT,
+                                       "max_tokens": 6})
+                assert r.status_code == 200
+                assert r.headers["x-gateway-destination-endpoint-served"] == \
+                    f"127.0.0.1:{SC}"
+                assert r.json()["choices"][0]["text"] == mono_text
+
+                # The prefill engine really prefilled.
+                assert _counter_value(pre, "jetstream:prompt_tokens_total") > \
+                    pre_prompt_tokens_before
+
+                # Short prompt below threshold → decode-only (no prefill growth).
+                pre_after = _counter_value(pre, "jetstream:prompt_tokens_total")
+                r = await c.post(f"http://127.0.0.1:{GW}/v1/completions",
+                                 json={"model": "tiny", "prompt": SHORT_PROMPT,
+                                       "max_tokens": 2})
+                assert r.status_code == 200
+                assert _counter_value(pre, "jetstream:prompt_tokens_total") == pre_after
+
+                # Router counted both decision types.
+                m = await c.get(f"http://127.0.0.1:{GW}/metrics")
+                assert 'disagg_decision_total{decision_type="prefill-decode"}' in m.text
+                assert 'disagg_decision_total{decision_type="decode"}' in m.text
+        finally:
+            await gw.stop()
+            await sc.stop()
+            await pre.stop()
+            await dec.stop()
+
+    asyncio.run(body())
+
+
+def test_disagg_fallback_when_prefill_dead():
+    async def body():
+        dec = _engine(DEC, "decode")
+        await dec.start()
+        sc = Sidecar(SidecarConfig(port=SC, decoder_url=f"http://127.0.0.1:{DEC}",
+                                   prefill_timeout_s=2.0))
+        await sc.start()
+        gw = build_gateway(CFG, port=GW, poll_interval=0.02)  # PRE never started
+        await gw.start()
+        try:
+            async with httpx.AsyncClient(timeout=120) as c:
+                r = await c.post(f"http://127.0.0.1:{GW}/v1/completions",
+                                 json={"model": "tiny", "prompt": LONG_PROMPT,
+                                       "max_tokens": 4})
+                # Prefill target is dead: sidecar must fall back to local decode.
+                assert r.status_code == 200
+                assert len(r.json()["choices"][0]["text"]) > 0
+        finally:
+            await gw.stop()
+            await sc.stop()
+            await dec.stop()
+
+    asyncio.run(body())
+
+
+def test_sidecar_ssrf_allowlist():
+    async def body():
+        dec = _engine(DEC, "decode")
+        await dec.start()
+        sc = Sidecar(SidecarConfig(port=SC, decoder_url=f"http://127.0.0.1:{DEC}",
+                                   ssrf_allowlist=["10.0.0.1:9999"]))
+        await sc.start()
+        try:
+            async with httpx.AsyncClient(timeout=60) as c:
+                r = await c.post(f"http://127.0.0.1:{SC}/v1/completions",
+                                 json={"prompt": "x", "max_tokens": 1},
+                                 headers={"x-prefiller-host-port": "evil:1"})
+                assert r.status_code == 403
+        finally:
+            await sc.stop()
+            await dec.stop()
+
+    asyncio.run(body())
+
+
+def _counter_value(server: EngineServer, metric: str) -> float:
+    text = server.engine.telemetry.render().decode()
+    for line in text.splitlines():
+        if line.startswith(metric + " ") or line.startswith(metric + "_total "):
+            return float(line.split()[-1])
+    return 0.0
