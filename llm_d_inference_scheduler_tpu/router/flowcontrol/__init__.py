@@ -1,0 +1,6 @@
+from .types import FlowKey, QueueOutcome, FlowControlRequest
+from .controller import FlowController, FlowControlConfig
+from .admission import FlowControlAdmissionController
+
+__all__ = ["FlowKey", "QueueOutcome", "FlowControlRequest", "FlowController",
+           "FlowControlConfig", "FlowControlAdmissionController"]

@@ -1,0 +1,43 @@
+"""Flow-control-backed admission (reference: requestcontrol/admission.go:149-237
+FlowControlAdmissionController): adapts the inference request into a
+FlowControlRequest, blocks in EnqueueAndWait, and maps QueueOutcome to
+client-facing error codes with x-removal-reason semantics."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..framework.datalayer import Endpoint
+from ..framework.scheduling import InferenceRequest
+from ..requestcontrol.admission import AdmissionError
+from .controller import FlowController
+from .types import FlowControlRequest, FlowKey, QueueOutcome
+
+FAIRNESS_HEADER = "x-gateway-inference-fairness-id"
+DEFAULT_FLOW = "default-flow"  # reference handlers/request.go:37-65
+
+_OUTCOME_ERRORS = {
+    QueueOutcome.REJECTED_CAPACITY: (429, "queue capacity exceeded"),
+    QueueOutcome.REJECTED_OTHER: (429, "rejected by flow control"),
+    QueueOutcome.EVICTED_TTL: (429, "queue wait exceeded TTL"),
+    QueueOutcome.EVICTED_CONTEXT_CANCELLED: (499, "client cancelled while queued"),
+    QueueOutcome.EVICTED_SHED: (429, "shed under saturation"),
+}
+
+
+class FlowControlAdmissionController:
+    def __init__(self, controller: FlowController):
+        self.controller = controller
+
+    async def admit(self, ctx: Any, request: InferenceRequest,
+                    endpoints: list[Endpoint]) -> None:
+        flow_id = request.headers.get(FAIRNESS_HEADER, DEFAULT_FLOW)
+        item = FlowControlRequest(
+            request_id=request.request_id,
+            flow_key=FlowKey(flow_id, request.objectives.priority),
+            size_bytes=max(request.request_size_bytes, 1),
+        )
+        outcome = await self.controller.enqueue_and_wait(item)
+        if outcome != QueueOutcome.DISPATCHED:
+            code, reason = _OUTCOME_ERRORS.get(outcome, (429, outcome.value))
+            raise AdmissionError(code, reason)

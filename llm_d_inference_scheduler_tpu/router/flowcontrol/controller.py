@@ -1,0 +1,216 @@
+"""FlowController: sharded queuing with fairness/ordering policies.
+
+Reference shape (pkg/epp/flowcontrol/{controller,registry} — SURVEY §2.6):
+- `EnqueueAndWait` is the single public entry: callers block until the request
+  is dispatched, rejected (capacity), or evicted (TTL / caller cancelled).
+- Work is distributed over shard processors; each shard is a single-owner
+  actor (here: one asyncio task — the event loop provides the actor model the
+  reference builds with goroutines) running the enqueue→capacity→dispatch
+  cycle: inter-flow fairness picks the flow, intra-flow ordering picks the
+  item.
+- Dispatch is gated by a saturation signal: items drain while the pool has
+  headroom, pause while saturated (the reference's saturation-detector
+  coupling), with a small poll interval.
+- Per-priority-band byte capacity (default 1 GB) and optional global caps
+  (registry/config.go:40-125).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import time
+from typing import Any, Callable
+
+from ..metrics import FLOW_CONTROL_QUEUE_SECONDS, FLOW_CONTROL_QUEUE_SIZE
+from .policies import (
+    FAIRNESS_POLICIES,
+    ORDERING_POLICIES,
+    FcfsOrdering,
+    GlobalStrictFairness,
+)
+from .types import FlowControlRequest, FlowKey, QueueOutcome
+
+log = logging.getLogger("router.flowcontrol")
+
+DEFAULT_BAND_CAPACITY_BYTES = 1 << 30  # reference registry/config.go:48-60
+DEFAULT_TTL_S = 30.0
+DISPATCH_POLL_S = 0.01
+
+
+@dataclasses.dataclass
+class FlowControlConfig:
+    shards: int = 1
+    fairness: str = GlobalStrictFairness.NAME
+    ordering: str = FcfsOrdering.NAME
+    band_capacity_bytes: int = DEFAULT_BAND_CAPACITY_BYTES
+    max_global_bytes: int | None = None
+    max_global_requests: int | None = None
+    default_ttl_s: float = DEFAULT_TTL_S
+
+    @classmethod
+    def from_spec(cls, spec: dict[str, Any]) -> "FlowControlConfig":
+        return cls(
+            shards=int(spec.get("shards", 1)),
+            fairness=spec.get("fairnessPolicy", GlobalStrictFairness.NAME),
+            ordering=spec.get("orderingPolicy", FcfsOrdering.NAME),
+            band_capacity_bytes=int(spec.get("bandCapacityBytes",
+                                             DEFAULT_BAND_CAPACITY_BYTES)),
+            max_global_bytes=spec.get("maxGlobalBytes"),
+            max_global_requests=spec.get("maxGlobalRequests"),
+            default_ttl_s=float(spec.get("defaultTTLSeconds", DEFAULT_TTL_S)),
+        )
+
+
+class _Shard:
+    """Single-owner shard: all state mutated only from its dispatch task's
+    loop context (+ synchronous enqueue on the same event loop)."""
+
+    def __init__(self, idx: int, cfg: FlowControlConfig,
+                 saturation_fn: Callable[[], float]):
+        self.idx = idx
+        self.cfg = cfg
+        self.saturation_fn = saturation_fn
+        self.fairness = FAIRNESS_POLICIES[cfg.fairness]()
+        self._ordering = ORDERING_POLICIES[cfg.ordering]()
+        self.queues: dict[FlowKey, Any] = {}
+        self.total_requests = 0
+        self.total_bytes = 0
+        self._wake = asyncio.Event()
+        self._task: asyncio.Task | None = None
+
+    # ---- metrics helpers ----
+
+    def band_bytes(self, priority: int) -> int:
+        return sum(q.bytes for k, q in self.queues.items() if k.priority == priority)
+
+    # ---- enqueue (called from EnqueueAndWait) ----
+
+    def try_enqueue(self, item: FlowControlRequest) -> QueueOutcome | None:
+        cfg = self.cfg
+        if (cfg.max_global_requests is not None
+                and self.total_requests >= cfg.max_global_requests):
+            return QueueOutcome.REJECTED_CAPACITY
+        if (cfg.max_global_bytes is not None
+                and self.total_bytes + item.size_bytes > cfg.max_global_bytes):
+            return QueueOutcome.REJECTED_CAPACITY
+        if self.band_bytes(item.flow_key.priority) + item.size_bytes > cfg.band_capacity_bytes:
+            return QueueOutcome.REJECTED_CAPACITY
+        q = self.queues.get(item.flow_key)
+        if q is None:
+            q = self.queues[item.flow_key] = self._ordering.make_queue()
+        q.add(item)
+        self.total_requests += 1
+        self.total_bytes += item.size_bytes
+        self._wake.set()
+        return None
+
+    def _drop(self, item: FlowControlRequest, outcome: QueueOutcome) -> None:
+        q = self.queues.get(item.flow_key)
+        if q is not None and q.remove(item):
+            self.total_requests -= 1
+            self.total_bytes -= item.size_bytes
+        item.resolve(outcome)
+
+    # ---- dispatch loop ----
+
+    def start(self):
+        self._task = asyncio.get_running_loop().create_task(self._run())
+
+    def stop(self):
+        if self._task:
+            self._task.cancel()
+
+    async def _run(self):
+        try:
+            while True:
+                if self.total_requests == 0:
+                    self._wake.clear()
+                    await self._wake.wait()
+                self._sweep_expired()
+                if self.total_requests == 0:
+                    continue
+                if self.saturation_fn() >= 1.0:
+                    await asyncio.sleep(DISPATCH_POLL_S)
+                    continue
+                key = self.fairness.pick_flow(self.queues)
+                if key is None:
+                    continue
+                item = self.queues[key].pop()
+                if item is None:
+                    continue
+                self.total_requests -= 1
+                self.total_bytes -= item.size_bytes
+                FLOW_CONTROL_QUEUE_SECONDS.observe(time.monotonic() - item.enqueue_time)
+                item.resolve(QueueOutcome.DISPATCHED)
+                await asyncio.sleep(0)  # yield so dispatched work can start
+        except asyncio.CancelledError:
+            for q in self.queues.values():
+                while (item := q.pop()) is not None:
+                    item.resolve(QueueOutcome.EVICTED_SHED)
+
+    def _sweep_expired(self):
+        now = time.monotonic()
+        for key in list(self.queues):
+            q = self.queues[key]
+            expired = []
+            # peek-only sweep for FIFO head; full scan is avoided — TTL items
+            # deeper in the queue expire when they reach the head.
+            head = q.peek()
+            while head is not None and head.deadline is not None and head.deadline < now:
+                q.pop()
+                self.total_requests -= 1
+                self.total_bytes -= head.size_bytes
+                expired.append(head)
+                head = q.peek()
+            for item in expired:
+                item.resolve(QueueOutcome.EVICTED_TTL)
+
+
+class FlowController:
+    def __init__(self, cfg: FlowControlConfig,
+                 saturation_fn: Callable[[], float]):
+        self.cfg = cfg
+        self.shards = [_Shard(i, cfg, saturation_fn) for i in range(cfg.shards)]
+        self._started = False
+
+    async def start(self):
+        for s in self.shards:
+            s.start()
+        self._started = True
+
+    async def stop(self):
+        for s in self.shards:
+            s.stop()
+        self._started = False
+
+    def _least_loaded_shard(self) -> _Shard:
+        # reference controller.go:393-425 least-loaded candidate selection
+        return min(self.shards, key=lambda s: s.total_requests)
+
+    @property
+    def queued_requests(self) -> int:
+        return sum(s.total_requests for s in self.shards)
+
+    async def enqueue_and_wait(self, item: FlowControlRequest) -> QueueOutcome:
+        """Block until dispatched/rejected/evicted (controller.go:218)."""
+        assert self._started, "FlowController not started"
+        loop = asyncio.get_running_loop()
+        item.future = loop.create_future()
+        if item.deadline is None:
+            item.deadline = time.monotonic() + self.cfg.default_ttl_s
+
+        shard = self._least_loaded_shard()
+        rejection = shard.try_enqueue(item)
+        FLOW_CONTROL_QUEUE_SIZE.set(self.queued_requests)
+        if rejection is not None:
+            return rejection
+        try:
+            outcome = await item.future
+        except asyncio.CancelledError:
+            shard._drop(item, QueueOutcome.EVICTED_CONTEXT_CANCELLED)
+            raise
+        finally:
+            FLOW_CONTROL_QUEUE_SIZE.set(self.queued_requests)
+        return outcome

@@ -1,0 +1,95 @@
+"""Fairness (inter-flow) and ordering (intra-flow) policies.
+
+Reference: framework/plugins/flowcontrol/{fairness,ordering} — fairness picks
+which flow dispatches next (global-strict: highest priority band, round-robin
+within; round-robin: cycle all flows), ordering picks which item within a flow
+(fcfs; edf earliest-deadline-first; slo-deadline).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+from .queues import ListQueue, MaxMinHeap
+from .types import FlowControlRequest, FlowKey
+
+
+# ---- ordering policies -------------------------------------------------
+
+class FcfsOrdering:
+    NAME = "fcfs-ordering-policy"
+
+    def make_queue(self):
+        return ListQueue()
+
+
+class EdfOrdering:
+    """Earliest deadline first; items without a deadline sort last."""
+
+    NAME = "edf-ordering-policy"
+
+    def make_queue(self):
+        return MaxMinHeap(key=lambda it: it.deadline if it.deadline is not None
+                          else float("inf"))
+
+
+class SloDeadlineOrdering:
+    """Least slack first. Slack = deadline − now, and `now` is common to every
+    queued item at dispatch time, so ranking by absolute deadline IS the
+    least-slack order; kept as a distinct type for config parity with the
+    reference's slo-deadline-ordering-policy."""
+
+    NAME = "slo-deadline-ordering-policy"
+
+    def make_queue(self):
+        return MaxMinHeap(key=lambda it: it.deadline if it.deadline is not None
+                          else float("inf"))
+
+
+ORDERING_POLICIES = {p.NAME: p for p in (FcfsOrdering, EdfOrdering, SloDeadlineOrdering)}
+
+
+# ---- fairness policies -------------------------------------------------
+
+class GlobalStrictFairness:
+    """Strict priority bands; round-robin among flows within the top band
+    (reference global-strict-fairness-policy)."""
+
+    NAME = "global-strict-fairness-policy"
+
+    def __init__(self):
+        self._rr: dict[int, int] = {}
+
+    def pick_flow(self, queues: dict[FlowKey, object]) -> FlowKey | None:
+        non_empty = [k for k, q in queues.items() if len(q)]
+        if not non_empty:
+            return None
+        top = max(k.priority for k in non_empty)
+        band = sorted([k for k in non_empty if k.priority == top],
+                      key=lambda k: k.flow_id)
+        idx = self._rr.get(top, 0) % len(band)
+        self._rr[top] = idx + 1
+        return band[idx]
+
+
+class RoundRobinFairness:
+    """Cycle through all non-empty flows regardless of priority
+    (reference round-robin-fairness-policy)."""
+
+    NAME = "round-robin-fairness-policy"
+
+    def __init__(self):
+        self._idx = 0
+
+    def pick_flow(self, queues: dict[FlowKey, object]) -> FlowKey | None:
+        non_empty = sorted([k for k, q in queues.items() if len(q)],
+                           key=lambda k: (k.priority, k.flow_id))
+        if not non_empty:
+            return None
+        key = non_empty[self._idx % len(non_empty)]
+        self._idx += 1
+        return key
+
+
+FAIRNESS_POLICIES = {p.NAME: p for p in (GlobalStrictFairness, RoundRobinFairness)}

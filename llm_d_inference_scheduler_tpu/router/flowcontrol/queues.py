@@ -1,0 +1,96 @@
+"""Queue plugins (reference: flowcontrol/framework/plugins/queue):
+listqueue (FIFO) and maxminheap (priority heap ordered by a comparator)."""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+from collections import deque
+from typing import Callable
+
+from .types import FlowControlRequest
+
+
+class ListQueue:
+    """FIFO linked-list queue (reference listqueue)."""
+
+    NAME = "listqueue"
+
+    def __init__(self):
+        self._dq: deque[FlowControlRequest] = deque()
+        self.bytes = 0
+
+    def add(self, item: FlowControlRequest) -> None:
+        self._dq.append(item)
+        self.bytes += item.size_bytes
+
+    def peek(self) -> FlowControlRequest | None:
+        return self._dq[0] if self._dq else None
+
+    def pop(self) -> FlowControlRequest | None:
+        if not self._dq:
+            return None
+        item = self._dq.popleft()
+        self.bytes -= item.size_bytes
+        return item
+
+    def remove(self, item: FlowControlRequest) -> bool:
+        try:
+            self._dq.remove(item)
+        except ValueError:
+            return False
+        self.bytes -= item.size_bytes
+        return True
+
+    def __len__(self):
+        return len(self._dq)
+
+
+class MaxMinHeap:
+    """Heap queue ordered by a key function (reference maxminheap); backs the
+    EDF / SLO-deadline ordering policies."""
+
+    NAME = "maxminheap"
+
+    def __init__(self, key: Callable[[FlowControlRequest], float]):
+        self._key = key
+        self._heap: list[tuple[float, int, FlowControlRequest]] = []
+        self._removed: set[int] = set()
+        self._counter = itertools.count()
+        self.bytes = 0
+        self._live = 0
+
+    def add(self, item: FlowControlRequest) -> None:
+        heapq.heappush(self._heap, (self._key(item), next(self._counter), item))
+        self.bytes += item.size_bytes
+        self._live += 1
+
+    def _prune(self) -> None:
+        while self._heap and id(self._heap[0][2]) in self._removed:
+            _, _, item = heapq.heappop(self._heap)
+            self._removed.discard(id(item))
+
+    def peek(self) -> FlowControlRequest | None:
+        self._prune()
+        return self._heap[0][2] if self._heap else None
+
+    def pop(self) -> FlowControlRequest | None:
+        self._prune()
+        if not self._heap:
+            return None
+        _, _, item = heapq.heappop(self._heap)
+        self.bytes -= item.size_bytes
+        self._live -= 1
+        return item
+
+    def remove(self, item: FlowControlRequest) -> bool:
+        for _, _, it in self._heap:
+            if it is item and id(it) not in self._removed:
+                self._removed.add(id(it))
+                self.bytes -= item.size_bytes
+                self._live -= 1
+                return True
+        return False
+
+    def __len__(self):
+        return self._live

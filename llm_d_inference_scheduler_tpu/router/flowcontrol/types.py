@@ -1,0 +1,46 @@
+"""Flow-control contracts (reference: pkg/epp/flowcontrol/{contracts,types}).
+
+FlowKey{id, priority} identifies a flow; QueueOutcome enumerates terminal
+request states (types/ QueueOutcome enum — Dispatched / RejectedCapacity /
+EvictedTTL / EvictedContextCancelled / …).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import enum
+import time
+from typing import Any
+
+
+@dataclasses.dataclass(frozen=True)
+class FlowKey:
+    flow_id: str
+    priority: int
+
+
+class QueueOutcome(str, enum.Enum):
+    DISPATCHED = "dispatched"
+    REJECTED_CAPACITY = "rejected_capacity"
+    REJECTED_OTHER = "rejected_other"
+    EVICTED_TTL = "evicted_ttl"
+    EVICTED_CONTEXT_CANCELLED = "evicted_context_cancelled"
+    EVICTED_SHED = "evicted_shed"
+
+
+@dataclasses.dataclass
+class FlowControlRequest:
+    """One queued admission request."""
+
+    request_id: str
+    flow_key: FlowKey
+    size_bytes: int = 0
+    deadline: float | None = None  # monotonic; EDF/SLO ordering + TTL eviction
+    enqueue_time: float = dataclasses.field(default_factory=time.monotonic)
+    future: asyncio.Future | None = None
+    context: Any = None  # carries cancellation (e.g. client connection)
+
+    def resolve(self, outcome: QueueOutcome) -> None:
+        if self.future is not None and not self.future.done():
+            self.future.set_result(outcome)

@@ -58,6 +58,9 @@ log = logging.getLogger("router.gateway")
 
 FORWARD_HEADERS = ("x-prefiller-host-port", "x-encoder-hosts-ports",
                    "x-data-parallel-host-port", "x-request-id", "content-type")
+ROUTER_OWNED_HEADERS = ("x-prefiller-host-port", "x-encoder-hosts-ports",
+                        "x-data-parallel-host-port",
+                        "x-gateway-destination-endpoint")
 
 
 class Gateway:
@@ -78,8 +81,24 @@ class Gateway:
             det_spec.get("name", "saturation-detector"),
             det_spec.get("parameters") or {}, None)
 
-        from .requestcontrol.admission import LegacyAdmissionController
-        admission = LegacyAdmissionController(self.detector)
+        self.flow_controller = None
+        if cfg.feature_gates.get("flowControl"):
+            from .flowcontrol import (
+                FlowControlAdmissionController,
+                FlowControlConfig,
+                FlowController,
+            )
+
+            fc_cfg = FlowControlConfig.from_spec(cfg.flow_control or {})
+            self.flow_controller = FlowController(
+                fc_cfg,
+                saturation_fn=lambda: self.detector.saturation(
+                    self.datastore.endpoint_list()))
+            admission = FlowControlAdmissionController(self.flow_controller)
+        else:
+            from .requestcontrol.admission import LegacyAdmissionController
+
+            admission = LegacyAdmissionController(self.detector)
 
         producers = validate_and_order_producers(cfg.producers)
         self.director = Director(
@@ -110,6 +129,8 @@ class Gateway:
             self.datastore.endpoint_add_or_update(meta)
         self.datastore.pool_set(self.cfg.pool)
         await self.dl_runtime.start()
+        if self.flow_controller is not None:
+            await self.flow_controller.start()
         self._client = httpx.AsyncClient(timeout=httpx.Timeout(300.0, connect=5.0))
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
@@ -122,6 +143,8 @@ class Gateway:
     async def stop(self):
         if self._flusher:
             self._flusher.cancel()
+        if self.flow_controller is not None:
+            await self.flow_controller.stop()
         if self._runner:
             await self._runner.cleanup()
         if self._client:
@@ -149,6 +172,11 @@ class Gateway:
         t_start = time.monotonic()
         raw = await request.read()
         headers = {k.lower(): v for k, v in request.headers.items()}
+        # Router-owned routing headers must never be client-controlled: only
+        # scheduling plugins (e.g. DisaggProfileHandler.pre_request) may set
+        # them, else a client could SSRF the sidecar into arbitrary targets.
+        for h in ROUTER_OWNED_HEADERS:
+            headers.pop(h, None)
         headers.setdefault(H_REQUEST_ID, f"req-{uuid.uuid4().hex[:12]}")
 
         parse = self.parser.parse(raw, headers, path=request.path)

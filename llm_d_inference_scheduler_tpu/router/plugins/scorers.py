@@ -13,6 +13,7 @@ from .attributes import (
     PREFIX_ATTRIBUTE_KEY,
     InFlightLoad,
     PrefixCacheMatchInfo,
+    estimate_input_tokens,
 )
 
 
@@ -170,8 +171,6 @@ class ContextLengthAwareScorer(PluginBase):
     token capacity; falls back to chars/4 when no tokenization is present."""
 
     def score(self, ctx, state, request, endpoints):
-        from .attributes import estimate_input_tokens
-
         need = estimate_input_tokens(request)
         out = {}
         for ep in endpoints:

@@ -31,10 +31,9 @@ from typing import Any
 import httpx
 from aiohttp import web
 
-log = logging.getLogger("router.sidecar")
+from ..requestcontrol.director import H_ENCODERS, H_PREFILLER
 
-H_PREFILLER = "x-prefiller-host-port"
-H_ENCODERS = "x-encoder-hosts-ports"
+log = logging.getLogger("router.sidecar")
 
 GEN_PATHS = ("/v1/completions", "/v1/chat/completions", "/v1/responses")
 
@@ -92,6 +91,8 @@ class Sidecar:
         # Disagg headers are consumed here and never forwarded downstream
         # (upstream dispatch builds its own header set).
         prefiller = request.headers.get(H_PREFILLER)
+        encoders = request.headers.get(H_ENCODERS)  # E/PD protocol: phase 2
+        del encoders
 
         if prefiller and self.cfg.connector != "passthrough":
             if (self.cfg.ssrf_allowlist is not None

@@ -159,3 +159,34 @@ def _counter_value(server: EngineServer, metric: str) -> float:
         if line.startswith(metric + " ") or line.startswith(metric + "_total "):
             return float(line.split()[-1])
     return 0.0
+
+
+def test_gateway_strips_client_injected_disagg_headers():
+    """A client must not be able to steer the sidecar via x-prefiller-host-port
+    (SSRF/decider bypass): the gateway strips router-owned headers."""
+    async def body():
+        dec = _engine(DEC, "decode")
+        await dec.start()
+        sc = Sidecar(SidecarConfig(port=SC, decoder_url=f"http://127.0.0.1:{DEC}"))
+        await sc.start()
+        gw = build_gateway(CFG, port=GW, poll_interval=0.02)
+        await gw.start()
+        try:
+            async with httpx.AsyncClient(timeout=120) as c:
+                # Short prompt (decode-only decision) + injected prefiller
+                # header pointing at an attacker target.
+                r = await c.post(
+                    f"http://127.0.0.1:{GW}/v1/completions",
+                    json={"model": "tiny", "prompt": SHORT_PROMPT,
+                          "max_tokens": 2},
+                    headers={"x-prefiller-host-port": "127.0.0.1:1"})
+                # Served normally (no prefill attempt against the bogus host;
+                # a forwarded header would stall the sidecar on connect).
+                assert r.status_code == 200
+                assert len(r.json()["choices"][0]["text"]) > 0
+        finally:
+            await gw.stop()
+            await sc.stop()
+            await dec.stop()
+
+    asyncio.run(body())

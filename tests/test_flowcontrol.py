@@ -1,0 +1,235 @@
+"""Flow control: fairness, ordering, capacity, TTL, saturation gating."""
+
+import asyncio
+
+import pytest
+
+from llm_d_inference_scheduler_tpu.router.flowcontrol import (
+    FlowControlConfig,
+    FlowController,
+    FlowControlRequest,
+    FlowKey,
+    QueueOutcome,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def _req(rid, flow="f", prio=0, size=1, deadline=None):
+    return FlowControlRequest(request_id=rid, flow_key=FlowKey(flow, prio),
+                              size_bytes=size, deadline=deadline)
+
+
+def test_dispatch_when_unsaturated():
+    async def body():
+        fc = FlowController(FlowControlConfig(), saturation_fn=lambda: 0.0)
+        await fc.start()
+        try:
+            outcome = await asyncio.wait_for(
+                fc.enqueue_and_wait(_req("a")), timeout=5)
+            assert outcome == QueueOutcome.DISPATCHED
+        finally:
+            await fc.stop()
+
+    run(body())
+
+
+def test_queue_blocks_under_saturation_then_drains():
+    async def body():
+        sat = {"v": 2.0}
+        fc = FlowController(FlowControlConfig(), saturation_fn=lambda: sat["v"])
+        await fc.start()
+        try:
+            task = asyncio.create_task(fc.enqueue_and_wait(_req("a")))
+            await asyncio.sleep(0.1)
+            assert not task.done()          # held while saturated
+            assert fc.queued_requests == 1
+            sat["v"] = 0.5                   # headroom appears
+            outcome = await asyncio.wait_for(task, timeout=5)
+            assert outcome == QueueOutcome.DISPATCHED
+        finally:
+            await fc.stop()
+
+    run(body())
+
+
+def test_strict_priority_dispatch_order():
+    async def body():
+        sat = {"v": 2.0}
+        fc = FlowController(FlowControlConfig(), saturation_fn=lambda: sat["v"])
+        await fc.start()
+        try:
+            order = []
+
+            async def one(rid, prio):
+                out = await fc.enqueue_and_wait(_req(rid, flow=rid, prio=prio))
+                order.append(rid)
+                return out
+
+            tasks = [asyncio.create_task(one("low1", -1)),
+                     asyncio.create_task(one("high1", 5)),
+                     asyncio.create_task(one("mid1", 0)),
+                     asyncio.create_task(one("high2", 5))]
+            await asyncio.sleep(0.1)  # everything queued while saturated
+            sat["v"] = 0.0
+            await asyncio.gather(*tasks)
+            assert set(order[:2]) == {"high1", "high2"}
+            assert order[2] == "mid1" and order[3] == "low1"
+        finally:
+            await fc.stop()
+
+    run(body())
+
+
+def test_capacity_rejection():
+    async def body():
+        cfg = FlowControlConfig(band_capacity_bytes=100)
+        fc = FlowController(cfg, saturation_fn=lambda: 2.0)  # nothing drains
+        await fc.start()
+        try:
+            t1 = asyncio.create_task(fc.enqueue_and_wait(_req("a", size=80)))
+            await asyncio.sleep(0.05)
+            out2 = await fc.enqueue_and_wait(_req("b", size=50))
+            assert out2 == QueueOutcome.REJECTED_CAPACITY
+            t1.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await t1
+        finally:
+            await fc.stop()
+
+    run(body())
+
+
+def test_ttl_eviction():
+    async def body():
+        import time
+        fc = FlowController(FlowControlConfig(default_ttl_s=0.1),
+                            saturation_fn=lambda: 2.0)
+        await fc.start()
+        try:
+            out = await asyncio.wait_for(fc.enqueue_and_wait(_req("a")), timeout=5)
+            assert out == QueueOutcome.EVICTED_TTL
+        finally:
+            await fc.stop()
+
+    run(body())
+
+
+def test_cancellation_eviction():
+    async def body():
+        fc = FlowController(FlowControlConfig(), saturation_fn=lambda: 2.0)
+        await fc.start()
+        try:
+            task = asyncio.create_task(fc.enqueue_and_wait(_req("a")))
+            await asyncio.sleep(0.05)
+            task.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await task
+            assert fc.queued_requests == 0  # dropped from the queue
+        finally:
+            await fc.stop()
+
+    run(body())
+
+
+def test_round_robin_fairness_across_flows():
+    async def body():
+        sat = {"v": 2.0}
+        cfg = FlowControlConfig(fairness="round-robin-fairness-policy")
+        fc = FlowController(cfg, saturation_fn=lambda: sat["v"])
+        await fc.start()
+        try:
+            order = []
+
+            async def one(rid, flow):
+                await fc.enqueue_and_wait(_req(rid, flow=flow))
+                order.append((rid, flow))
+
+            tasks = [asyncio.create_task(one(f"{f}{i}", f))
+                     for i in range(2) for f in ("A", "B")]
+            await asyncio.sleep(0.1)
+            sat["v"] = 0.0
+            await asyncio.gather(*tasks)
+            flows = [f for _, f in order]
+            # alternation: no flow serves twice before the other gets a turn
+            assert flows[0] != flows[1] and flows[2] != flows[3], flows
+        finally:
+            await fc.stop()
+
+    run(body())
+
+
+def test_edf_ordering_within_flow():
+    async def body():
+        import time
+        sat = {"v": 2.0}
+        cfg = FlowControlConfig(ordering="edf-ordering-policy", default_ttl_s=60)
+        fc = FlowController(cfg, saturation_fn=lambda: sat["v"])
+        await fc.start()
+        try:
+            order = []
+            now = time.monotonic()
+
+            async def one(rid, deadline):
+                await fc.enqueue_and_wait(_req(rid, deadline=deadline))
+                order.append(rid)
+
+            tasks = [asyncio.create_task(one("late", now + 50)),
+                     asyncio.create_task(one("soon", now + 5)),
+                     asyncio.create_task(one("mid", now + 20))]
+            await asyncio.sleep(0.1)
+            sat["v"] = 0.0
+            await asyncio.gather(*tasks)
+            assert order == ["soon", "mid", "late"]
+        finally:
+            await fc.stop()
+
+    run(body())
+
+
+def test_gateway_flow_control_gate_sheds_on_saturation():
+    """featureGates.flowControl: requests queue while the pool is saturated and
+    time out with 429 + x-removal-reason."""
+    import httpx
+    from llm_d_inference_scheduler_tpu.engine import EngineConfig
+    from llm_d_inference_scheduler_tpu.engine.server import EngineServer
+    from llm_d_inference_scheduler_tpu.router.gateway import build_gateway
+
+    cfg = """
+featureGates: {flowControl: true}
+flowControl: {defaultTTLSeconds: 0.3}
+saturationDetector:
+  type: utilization-detector
+  parameters: {queueDepthThreshold: 1}
+pool:
+  endpoints:
+    - {address: 127.0.0.1, port: 18371}
+"""
+
+    async def body():
+        # slow engine so its waiting queue builds up
+        eng = EngineServer(EngineConfig(backend="sim", model="tiny", port=18371,
+                                        max_batch=1, sim_decode_ms_per_token=50.0))
+        await eng.start()
+        gw = build_gateway(cfg, port=18370, poll_interval=0.02)
+        await gw.start()
+        try:
+            async with httpx.AsyncClient(timeout=60) as c:
+                # saturate: 4 slow requests directly at the engine
+                hogs = [asyncio.create_task(c.post(
+                    "http://127.0.0.1:18371/v1/completions",
+                    json={"prompt": "x", "max_tokens": 40})) for _ in range(4)]
+                await asyncio.sleep(0.3)  # collectors see queue depth > threshold
+                r = await c.post("http://127.0.0.1:18370/v1/completions",
+                                 json={"model": "tiny", "prompt": "y",
+                                       "max_tokens": 1})
+                assert r.status_code == 429
+                assert "ttl" in r.headers.get("x-removal-reason", "").lower()
+                await asyncio.gather(*hogs)
+        finally:
+            await gw.stop()
+            await eng.stop()
+
+    run(body())
