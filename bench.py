@@ -1,0 +1,164 @@
+"""Benchmark: serving throughput + TTFT of the TPU engine on one real chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+
+Measures the BASELINE.md north-star quantity at single-chip scale: aggregate
+decode tokens/sec/chip through the full continuous-batching engine (paged KV,
+jitted prefill buckets + decode step), plus p50/p99 TTFT.
+
+Robustness: the measurement runs in a child process per candidate model with a
+watchdog (the axon remote-compile service can wedge on very large graphs); the
+first candidate that completes wins. The reference publishes no numbers
+(BASELINE.md), so vs_baseline compares against BENCH_PREV.json when present,
+else 1.0.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+# (model, watchdog seconds) — largest first; fall back if compile wedges.
+CANDIDATES = [
+    ("llama3-1b", 900),
+    ("tiny", 300),
+]
+
+
+def child(model: str) -> None:
+    import asyncio
+    import statistics
+    import time
+
+    import jax
+
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+    except Exception:
+        pass
+
+    from llm_d_inference_scheduler_tpu.engine import EngineConfig, EngineRequest
+    from llm_d_inference_scheduler_tpu.engine.core import TpuEngine
+
+    max_batch = int(os.environ.get("BENCH_BATCH", "8"))
+    prompt_len = int(os.environ.get("BENCH_PROMPT", "120"))
+    gen_tokens = int(os.environ.get("BENCH_GEN", "64"))
+    n_requests = int(os.environ.get("BENCH_REQUESTS", "16"))
+
+    cfg = EngineConfig(model=model, backend="tpu", max_batch=max_batch,
+                       max_model_len=512)
+
+    async def run():
+        eng = TpuEngine(cfg)
+        await eng.start()
+        try:
+            async def one(i, max_tokens, record):
+                prompt = [1] + [(7 * i + j) % 1000 + 10 for j in range(prompt_len - 1)]
+                req = EngineRequest(request_id=f"b{i}-{max_tokens}",
+                                    prompt_token_ids=prompt,
+                                    max_tokens=max_tokens,
+                                    stop_token_ids=(-1,))
+                t0 = time.monotonic()
+                out = eng.submit(req)
+                first = None
+                completion = 0
+                while True:
+                    ev = await out.get()
+                    if ev.token_id is not None and first is None:
+                        first = time.monotonic() - t0
+                    completion = max(completion, ev.completion_tokens)
+                    if ev.finish_reason is not None:
+                        break
+                if record is not None:
+                    record.append((first, completion))
+
+            await one(0, 2, None)  # warmup: compile prefill bucket + decode
+
+            record: list[tuple[float, int]] = []
+            t_start = time.monotonic()
+            await asyncio.gather(*[one(i + 1, gen_tokens, record)
+                                   for i in range(n_requests)])
+            elapsed = time.monotonic() - t_start
+        finally:
+            await eng.stop()
+
+        total_tokens = sum(c for _, c in record)
+        ttfts = sorted(t for t, _ in record if t is not None)
+        return {
+            "tokens_per_sec": total_tokens / elapsed,
+            "ttft_p50_ms": statistics.median(ttfts) * 1e3,
+            "ttft_p99_ms": ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.99))] * 1e3,
+        }
+
+    res = asyncio.run(run())
+    res["model"] = model
+    res["max_batch"] = max_batch
+    res["prompt_len"] = prompt_len
+    res["gen_tokens"] = gen_tokens
+    print(json.dumps(res))
+
+
+def main() -> None:
+    if len(sys.argv) > 2 and sys.argv[1] == "--child":
+        child(sys.argv[2])
+        return
+
+    forced = os.environ.get("BENCH_MODEL")
+    candidates = ([(forced, int(os.environ.get("BENCH_TIMEOUT", "900")))]
+                  if forced else CANDIDATES)
+
+    res = None
+    for model, timeout_s in candidates:
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--child", model],
+                capture_output=True, text=True, timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            print(f"bench child for {model} exceeded {timeout_s}s; "
+                  f"falling back", file=sys.stderr)
+            continue
+        if proc.returncode == 0 and proc.stdout.strip():
+            try:
+                res = json.loads(proc.stdout.strip().splitlines()[-1])
+                break
+            except json.JSONDecodeError:
+                pass
+        print(f"bench child for {model} failed rc={proc.returncode}:\n"
+              f"{proc.stderr[-2000:]}", file=sys.stderr)
+
+    if res is None:
+        print(json.dumps({"metric": "decode_tokens_per_sec_per_chip",
+                          "value": 0.0, "unit": "tokens/s/chip",
+                          "vs_baseline": 0.0,
+                          "error": "all bench candidates failed"}))
+        return
+
+    vs_baseline = 1.0
+    prev_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BENCH_PREV.json")
+    if os.path.exists(prev_path):
+        try:
+            with open(prev_path) as f:
+                prev = json.load(f)
+            if prev.get("value"):
+                vs_baseline = res["tokens_per_sec"] / float(prev["value"])
+        except Exception:
+            pass
+
+    print(json.dumps({
+        "metric": (f"decode_tokens_per_sec_per_chip ({res['model']}, "
+                   f"bs={res['max_batch']}, prompt={res['prompt_len']}, "
+                   f"gen={res['gen_tokens']})"),
+        "value": round(res["tokens_per_sec"], 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(vs_baseline, 3),
+        "ttft_p50_ms": round(res["ttft_p50_ms"], 1),
+        "ttft_p99_ms": round(res["ttft_p99_ms"], 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,38 @@
+"""Engine checkpointing via Orbax.
+
+The reference router is stateless (SURVEY §5: no checkpoint/restore — durable
+state lives in k8s CRDs); checkpointing in this stack belongs to the engine
+half (model weights), served here with Orbax so multi-host engines can restore
+sharded params directly onto their mesh.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import orbax.checkpoint as ocp
+
+from ..models import llama
+from ..models.configs import ModelConfig
+
+
+def save_params(path: str, params) -> None:
+    path = os.path.abspath(path)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, params)
+    ckptr.wait_until_finished()
+
+
+def load_params(path: str, cfg: ModelConfig, shardings=None):
+    """Restore params; with `shardings` (a pytree of jax.sharding.Sharding)
+    arrays restore directly onto the mesh."""
+    path = os.path.abspath(path)
+    ckptr = ocp.StandardCheckpointer()
+    template = jax.eval_shape(
+        lambda: llama.init_params(cfg, jax.random.key(0)))
+    if shardings is not None:
+        template = jax.tree.map(
+            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+            template, shardings)
+    return ckptr.restore(path, template)

@@ -26,6 +26,7 @@ class EngineConfig:
     # P/D role advertised to the router via labels/metadata.
     role: str = "both"            # "prefill" | "decode" | "both" | "encode"
     engine_id: str = ""
+    checkpoint_path: str = ""     # orbax dir; empty = random init (dev/bench)
 
     @property
     def model_config(self) -> ModelConfig:

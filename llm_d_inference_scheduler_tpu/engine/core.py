@@ -85,8 +85,14 @@ class TpuEngine:
         self.allocator = BlockAllocator(self.n_blocks, block)
         self.telemetry = EngineTelemetry(block_size=block, num_blocks=self.n_blocks)
 
-        key = jax.random.key(cfg.seed)
-        self.params = params if params is not None else llama.init_params(self.mcfg, key)
+        if params is not None:
+            self.params = params
+        elif cfg.checkpoint_path:
+            from .checkpoint import load_params
+
+            self.params = load_params(cfg.checkpoint_path, self.mcfg)
+        else:
+            self.params = llama.init_params(self.mcfg, jax.random.key(cfg.seed))
         kshape = (self.mcfg.n_layers, self.n_blocks, block,
                   self.mcfg.n_kv_heads, self.mcfg.head_dim)
         dtype = jnp.dtype(self.mcfg.dtype)

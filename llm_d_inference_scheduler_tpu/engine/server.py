@@ -72,14 +72,22 @@ def _stop_holdback(text: str, stop_strings: list[str] | None) -> int:
     return hold
 
 
-def _chat_to_prompt(messages: list[dict[str, Any]]) -> str:
-    """Minimal chat template: role-tagged lines + assistant cue."""
+def _chat_to_prompt(messages: list[dict[str, Any]], *,
+                    continue_final_message: bool = False) -> str:
+    """Minimal chat template: role-tagged lines + assistant cue.
+
+    With continue_final_message (the chunked-decode continuation contract,
+    reference docs/architecture.md:214-254), the final assistant message is
+    rendered WITHOUT a closing newline or a fresh cue so generation continues
+    the same turn."""
     parts = []
     for m in messages:
         content = m.get("content") or ""
         if isinstance(content, list):  # multimodal blocks: concatenate text parts
             content = " ".join(c.get("text", "") for c in content if isinstance(c, dict))
         parts.append(f"{m.get('role', 'user')}: {content}")
+    if continue_final_message and messages and messages[-1].get("role") == "assistant":
+        return "\n".join(parts)
     parts.append("assistant:")
     return "\n".join(parts)
 
@@ -99,7 +107,16 @@ class EngineServer:
             web.get("/health", self.health),
             web.get("/kv/{request_id}", self.kv_fetch),
             web.delete("/kv/{request_id}", self.kv_release),
+            web.post("/v1/encode", self.encode),
         ])
+        # E/PD encode-primer store: request_id -> encoded multimodal items
+        # (the reference reads these engine-side via an EC connector;
+        # SURVEY §2.10 connector_epd_shared_storage.go). Bounded LRU so
+        # unclaimed primers can't grow host memory without limit.
+        from collections import OrderedDict
+
+        self.ec_store: "OrderedDict[str, int]" = OrderedDict()
+        self._ec_capacity = 1024
         self._runner: web.AppRunner | None = None
 
     # ---- lifecycle ----------------------------------------------------
@@ -276,7 +293,8 @@ class EngineServer:
     async def chat_completions(self, request: web.Request) -> web.StreamResponse:
         body = await _json_body(request)
         messages = body.get("messages", [])
-        prompt_ids = self.engine.tokenizer.encode(_chat_to_prompt(messages))
+        prompt_ids = self.engine.tokenizer.encode(_chat_to_prompt(
+            messages, continue_final_message=bool(body.get("continue_final_message"))))
         req = self._build_request(body, prompt_ids)
         stops = self._stop_strings(body)
         out = self.engine.submit(req)
@@ -299,7 +317,9 @@ class EngineServer:
 
     async def render_chat(self, request: web.Request) -> web.Response:
         body = await _json_body(request)
-        rendered = _chat_to_prompt(body.get("messages", []))
+        rendered = _chat_to_prompt(
+            body.get("messages", []),
+            continue_final_message=bool(body.get("continue_final_message")))
         prompt_ids = self.engine.tokenizer.encode(rendered)
         return web.json_response({
             "token_ids": prompt_ids, "count": len(prompt_ids), "rendered": rendered})
@@ -350,6 +370,23 @@ class EngineServer:
         self.engine.release_kv_export(rid)
         return web.json_response({"released": rid})
 
+    async def encode(self, request: web.Request) -> web.Response:
+        """E/PD encoder-primer endpoint: accept multimodal items and stage
+        their embeddings for the prefill/decode engines (sidecar fan-out
+        target; reference connector_epd_shared_storage.go:38-211). Real
+        vision towers land behind this surface; the protocol contract is
+        item receipt + ack keyed by request id."""
+        body = await _json_body(request)
+        rid = str(body.get("request_id") or f"enc-{uuid.uuid4().hex[:8]}")
+        items = body.get("items") or []
+        if not isinstance(items, list):
+            raise web.HTTPBadRequest(text="items must be a list")
+        self.ec_store[rid] = len(items)
+        self.ec_store.move_to_end(rid)
+        while len(self.ec_store) > self._ec_capacity:
+            self.ec_store.popitem(last=False)
+        return web.json_response({"request_id": rid, "encoded_items": len(items)})
+
 
 async def run_server(cfg: EngineConfig):
     server = EngineServer(cfg)
@@ -376,6 +413,7 @@ def main(argv: list[str] | None = None):
     p.add_argument("--platform", default=None,
                    help="pin the JAX platform (e.g. 'cpu'); needed to run a second "
                         "engine process on a box whose TPU chip is already claimed")
+    p.add_argument("--checkpoint", default="", help="orbax checkpoint dir to load")
     args = p.parse_args(argv)
     if args.platform:
         import jax
@@ -383,7 +421,8 @@ def main(argv: list[str] | None = None):
     cfg = EngineConfig(model=args.model, backend=args.backend, port=args.port,
                        host=args.host, max_batch=args.max_batch,
                        max_model_len=args.max_model_len, role=args.role,
-                       served_model_name=args.served_model_name)
+                       served_model_name=args.served_model_name,
+                       checkpoint_path=args.checkpoint)
     logging.basicConfig(level=logging.INFO)
     asyncio.run(run_server(cfg))
 

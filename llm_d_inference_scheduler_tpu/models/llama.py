@@ -129,10 +129,15 @@ def decode_step(
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One decode step with paged KV; returns (logits [B, V] f32, k_pages, v_pages).
 
-    The new token's K/V is written into its page *before* attention so the token
-    attends to itself. Inactive batch slots write to block 0/slot-of-position via
-    their block table; callers must point padding slots at a dedicated trash
-    block (allocator reserves block 0 for this).
+    TPU-first structure: a single ``lax.scan`` over the stacked layers (one
+    traced layer body → L-step loop, so compile time is layer-count-free) that
+    only READS the pages; the current token's per-layer K/V comes back as scan
+    outputs and is written with one fused scatter afterwards — the page
+    buffers are touched once per step, not once per layer. The current token
+    attends to itself via the appended cur_k/cur_v attention column.
+
+    Inactive batch slots must point their block table at the dedicated trash
+    block 0 (the allocator reserves it).
     """
     B = tokens.shape[0]
     block = k_pages.shape[2]
@@ -144,9 +149,9 @@ def decode_step(
     slot = positions % block
 
     x = params["embed"][tokens]  # [B, D]
-    new_k_pages, new_v_pages = [], []
-    for li in range(cfg.n_layers):
-        lp = jax.tree.map(lambda a, li=li: a[li], params["layers"])
+
+    def body(x, layer_in):
+        lp, kp, vp = layer_in
         h = rms_norm(x, lp["ln_attn"], cfg.norm_eps)
         q = (h @ lp["wq"]).reshape(B, cfg.n_heads, Dh)
         k = (h @ lp["wk"]).reshape(B, cfg.n_kv_heads, Dh)
@@ -154,21 +159,24 @@ def decode_step(
         q = apply_rope(q[:, None], cos[:, None], sin[:, None])[:, 0]
         k = apply_rope(k[:, None], cos[:, None], sin[:, None])[:, 0]
 
-        kp = k_pages[li].at[blk_idx, slot].set(k)
-        vp = v_pages[li].at[blk_idx, slot].set(v)
-        new_k_pages.append(kp)
-        new_v_pages.append(vp)
-
-        attn = paged_decode_attention(q, kp, vp, block_tables, seq_lens)
+        attn = paged_decode_attention(q, kp, vp, block_tables, seq_lens,
+                                      cur_k=k, cur_v=v)
         x = x + attn.reshape(B, -1) @ lp["wo"]
         h = rms_norm(x, lp["ln_mlp"], cfg.norm_eps)
         x = x + (jax.nn.silu(h @ lp["w1"]) * (h @ lp["w3"])) @ lp["w2"]
+        return x, (k, v)
+
+    x, (k_cur, v_cur) = jax.lax.scan(body, x, (params["layers"], k_pages, v_pages))
+    # One fused scatter of all layers' current-token KV: [L, B, Hkv, Dh] into
+    # pages at (layer, blk_idx[b], slot[b]).
+    k_pages = k_pages.at[:, blk_idx, slot].set(k_cur)
+    v_pages = v_pages.at[:, blk_idx, slot].set(v_cur)
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = (x @ params["lm_head"]).astype(jnp.float32)
     if active is not None:
         logits = jnp.where(active[:, None], logits, 0.0)
-    return logits, jnp.stack(new_k_pages), jnp.stack(new_v_pages)
+    return logits, k_pages, v_pages
 
 
 def write_prefill_kv(

@@ -71,8 +71,16 @@ def paged_decode_attention(
     v_pages: jnp.ndarray,      # [N_blocks, block, Hkv, D]
     block_tables: jnp.ndarray,  # [B, max_blocks] int32 — physical block ids
     seq_lens: jnp.ndarray,      # [B] int32 — tokens valid in cache (incl. current)
+    cur_k: jnp.ndarray | None = None,  # [B, Hkv, D] current token's K (not yet in pages)
+    cur_v: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Decode-step attention over a paged KV cache; returns [B, H, D].
+
+    When ``cur_k``/``cur_v`` are given, the current token's KV is appended as
+    an extra attention column instead of being read from the pages (the engine
+    then scatters all layers' current-token KV in one fused write after the
+    layer scan). Cache rows at the current position are masked as invalid in
+    that mode.
 
     The gather materialises [B, max_blocks*block] KV rows; a Pallas kernel with
     scalar-prefetched block tables replaces this on the hot path (see ops/pallas).
@@ -85,12 +93,20 @@ def paged_decode_attention(
 
     k = k_pages[block_tables].reshape(B, T, -1, D)  # [B, T, Hkv, D]
     v = v_pages[block_tables].reshape(B, T, -1, D)
+    cached_valid_len = seq_lens if cur_k is None else seq_lens - 1
+    if cur_k is not None:
+        k = jnp.concatenate([k, cur_k[:, None]], axis=1)  # [B, T+1, Hkv, D]
+        v = jnp.concatenate([v, cur_v[:, None]], axis=1)
     k = _repeat_kv(k, q_per_kv)
     v = _repeat_kv(v, q_per_kv)
+    total = k.shape[1]
 
     scale = 1.0 / (D ** 0.5)
     logits = jnp.einsum("bhd,bthd->bht", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
-    valid = jnp.arange(T)[None, :] < seq_lens[:, None]  # [B, T]
+    valid = jnp.arange(T)[None, :] < cached_valid_len[:, None]  # [B, T]
+    if cur_k is not None:
+        valid = jnp.concatenate(
+            [valid, jnp.ones((B, 1), bool)], axis=1)  # current token always visible
     logits = jnp.where(valid[:, None, :], logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bht,bthd->bhd", probs, v.astype(jnp.float32))

@@ -43,6 +43,8 @@ class RawConfig:
     flow_control: dict[str, Any]
     saturation_detector: dict[str, Any] | None
     pool: dict[str, Any]
+    objectives: list[dict[str, Any]]
+    model_rewrites: list[dict[str, Any]]
 
 
 @dataclasses.dataclass
@@ -61,6 +63,8 @@ class RouterConfig:
     saturation_detector_spec: dict[str, Any] | None
     static_endpoints: list[EndpointMetadata]
     pool: EndpointPool
+    objectives: list[Any] = dataclasses.field(default_factory=list)
+    model_rewrites: list[Any] = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass
@@ -83,6 +87,8 @@ def load_raw_config(text: str | None) -> RawConfig:
         flow_control=doc.get("flowControl") or {},
         saturation_detector=doc.get("saturationDetector"),
         pool=doc.get("pool") or {},
+        objectives=doc.get("objectives") or [],
+        model_rewrites=doc.get("modelRewrites") or [],
     )
 
 
@@ -189,6 +195,21 @@ def instantiate(raw: RawConfig, handle: Handle,
     )
     static_endpoints = [_endpoint_meta(e) for e in pool_spec.get("endpoints") or []]
 
+    from ..datalayer.datastore import (
+        InferenceModelRewrite,
+        InferenceObjective,
+        ModelRewriteTarget,
+    )
+
+    objectives = [InferenceObjective(name=o["name"], priority=int(o.get("priority", 0)))
+                  for o in raw.objectives]
+    rewrites = [InferenceModelRewrite(
+        name=rw.get("name") or rw["source"],
+        source_model=rw["source"],
+        targets=[ModelRewriteTarget(model=t["model"], weight=int(t.get("weight", 1)))
+                 for t in rw.get("targets") or []])
+        for rw in raw.model_rewrites]
+
     return RouterConfig(
         scheduler=Scheduler(profiles, profile_handler),
         plugins_by_name=plugins_by_name,
@@ -204,6 +225,8 @@ def instantiate(raw: RawConfig, handle: Handle,
         saturation_detector_spec=raw.saturation_detector,
         static_endpoints=static_endpoints,
         pool=pool,
+        objectives=objectives,
+        model_rewrites=rewrites,
     )
 
 

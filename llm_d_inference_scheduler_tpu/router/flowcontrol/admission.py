@@ -26,8 +26,9 @@ _OUTCOME_ERRORS = {
 
 
 class FlowControlAdmissionController:
-    def __init__(self, controller: FlowController):
+    def __init__(self, controller: FlowController, evictor: Any = None):
         self.controller = controller
+        self.evictor = evictor
 
     async def admit(self, ctx: Any, request: InferenceRequest,
                     endpoints: list[Endpoint]) -> None:
@@ -38,6 +39,20 @@ class FlowControlAdmissionController:
             size_bytes=max(request.request_size_bytes, 1),
         )
         outcome = await self.controller.enqueue_and_wait(item)
+        if (outcome == QueueOutcome.REJECTED_CAPACITY
+                and request.objectives.priority >= 0):
+            # Make room: shed queued sheddable items (frees queue capacity for
+            # the retry) and evict an in-flight sheddable request (frees
+            # backend capacity so the queue drains).
+            freed_queue_slot = self.controller.shed_queued(1) > 0
+            if self.evictor is not None:
+                self.evictor.evict_n(1)
+            if freed_queue_slot:
+                retry = FlowControlRequest(
+                    request_id=request.request_id,
+                    flow_key=item.flow_key,
+                    size_bytes=item.size_bytes)
+                outcome = await self.controller.enqueue_and_wait(retry)
         if outcome != QueueOutcome.DISPATCHED:
             code, reason = _OUTCOME_ERRORS.get(outcome, (429, outcome.value))
             raise AdmissionError(code, reason)

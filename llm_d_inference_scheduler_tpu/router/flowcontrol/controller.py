@@ -113,6 +113,25 @@ class _Shard:
             self.total_bytes -= item.size_bytes
         item.resolve(outcome)
 
+    def shed_queued(self, n: int) -> int:
+        """Evict up to n queued sheddable items (priority < 0), lowest priority
+        first — frees queue capacity for higher-priority arrivals."""
+        shed = 0
+        for key in sorted((k for k in self.queues if k.priority < 0),
+                          key=lambda k: k.priority):
+            q = self.queues[key]
+            while shed < n:
+                item = q.pop()
+                if item is None:
+                    break
+                self.total_requests -= 1
+                self.total_bytes -= item.size_bytes
+                item.resolve(QueueOutcome.EVICTED_SHED)
+                shed += 1
+            if shed >= n:
+                break
+        return shed
+
     # ---- dispatch loop ----
 
     def start(self):
@@ -192,6 +211,15 @@ class FlowController:
     @property
     def queued_requests(self) -> int:
         return sum(s.total_requests for s in self.shards)
+
+    def shed_queued(self, n: int) -> int:
+        """Shed up to n queued sheddable items across shards."""
+        shed = 0
+        for s in self.shards:
+            if shed >= n:
+                break
+            shed += s.shed_queued(n - shed)
+        return shed
 
     async def enqueue_and_wait(self, item: FlowControlRequest) -> QueueOutcome:
         """Block until dispatched/rejected/evicted (controller.go:218)."""

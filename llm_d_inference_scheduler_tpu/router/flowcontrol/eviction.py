@@ -1,0 +1,82 @@
+"""Mid-flight request eviction.
+
+Reference: pkg/epp/flowcontrol/eviction (SURVEY §2.6) — the RequestEvictor
+tracks in-flight requests via PreRequest/ResponseComplete-style hooks; EvictN
+pops candidates ordered by the priority-then-time policy, filtered to
+sheddable requests (priority < 0), and cancels them so the protocol layer can
+answer 429 with x-removal-reason (the reference arms an eviction channel into
+the ext-proc loop; here the cancel callback unwinds the gateway's proxy task).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Callable
+
+from ..metrics import REGISTRY
+from prometheus_client import Counter
+
+log = logging.getLogger("router.eviction")
+
+EVICTIONS_TOTAL = Counter(
+    "inference_extension_request_evictions_total",
+    "In-flight requests evicted to make room", registry=REGISTRY)
+
+EVICTED_REASON = "evicted to admit higher-priority work"
+
+
+@dataclasses.dataclass
+class _InFlight:
+    request_id: str
+    priority: int
+    start_time: float
+    cancel: Callable[[], None]
+
+
+class RequestEvictor:
+    """Tracks in-flight requests; evicts sheddable ones on demand."""
+
+    def __init__(self):
+        self._inflight: dict[str, _InFlight] = {}
+        self._evicted: set[str] = set()
+
+    def register(self, request_id: str, priority: int,
+                 cancel: Callable[[], None]) -> None:
+        self._inflight[request_id] = _InFlight(
+            request_id, priority, time.monotonic(), cancel)
+
+    def deregister(self, request_id: str) -> None:
+        self._inflight.pop(request_id, None)
+        self._evicted.discard(request_id)
+
+    def was_evicted(self, request_id: str) -> bool:
+        return request_id in self._evicted
+
+    @property
+    def inflight_count(self) -> int:
+        return len(self._inflight)
+
+    def evict_n(self, n: int) -> int:
+        """Cancel up to n sheddable in-flight requests (lowest priority first,
+        oldest first within a priority — the reference's
+        priority-then-time-eviction-order-policy + sheddable-eviction-filter).
+        """
+        sheddable = sorted(
+            (r for r in self._inflight.values() if r.priority < 0),
+            key=lambda r: (r.priority, r.start_time))
+        evicted = 0
+        for rec in sheddable[:n]:
+            self._evicted.add(rec.request_id)
+            self._inflight.pop(rec.request_id, None)
+            try:
+                rec.cancel()
+            except Exception:
+                log.exception("evict cancel failed for %s", rec.request_id)
+                continue
+            EVICTIONS_TOTAL.inc()
+            evicted += 1
+            log.info("evicted in-flight request %s (priority %d)",
+                     rec.request_id, rec.priority)
+        return evicted

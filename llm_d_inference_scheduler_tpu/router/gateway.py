@@ -81,6 +81,9 @@ class Gateway:
             det_spec.get("name", "saturation-detector"),
             det_spec.get("parameters") or {}, None)
 
+        from .flowcontrol.eviction import RequestEvictor
+
+        self.evictor = RequestEvictor()
         self.flow_controller = None
         if cfg.feature_gates.get("flowControl"):
             from .flowcontrol import (
@@ -94,7 +97,8 @@ class Gateway:
                 fc_cfg,
                 saturation_fn=lambda: self.detector.saturation(
                     self.datastore.endpoint_list()))
-            admission = FlowControlAdmissionController(self.flow_controller)
+            admission = FlowControlAdmissionController(self.flow_controller,
+                                                       evictor=self.evictor)
         else:
             from .requestcontrol.admission import LegacyAdmissionController
 
@@ -128,6 +132,10 @@ class Gateway:
         for meta in self.cfg.static_endpoints:
             self.datastore.endpoint_add_or_update(meta)
         self.datastore.pool_set(self.cfg.pool)
+        for obj in self.cfg.objectives:
+            self.datastore.objective_set(obj)
+        for rw in self.cfg.model_rewrites:
+            self.datastore.rewrite_set(rw)
         await self.dl_runtime.start()
         if self.flow_controller is not None:
             await self.flow_controller.start()
@@ -213,12 +221,34 @@ class Gateway:
             payload["model"] = ireq.target_model  # repackage (director.go:289-306)
             body_out = json.dumps(payload).encode()
 
-        return await self._proxy(request, ireq, target, body_out, ireq.headers,
-                                 t_start, original_model=original_model)
+        # Register for mid-flight eviction: sheddable in-flight requests can be
+        # cancelled to admit higher-priority work (reference eviction channel →
+        # ImmediateResponse(429), handlers/server.go:266-284).
+        task = asyncio.current_task()
+        self.evictor.register(ireq.request_id, ireq.objectives.priority, task.cancel)
+        stream_state = {"started": False}
+        try:
+            return await self._proxy(request, ireq, target, body_out, ireq.headers,
+                                     t_start, original_model=original_model,
+                                     stream_state=stream_state)
+        except asyncio.CancelledError:
+            if self.evictor.was_evicted(ireq.request_id) and not stream_state["started"]:
+                from .flowcontrol.eviction import EVICTED_REASON
+
+                return web.json_response(
+                    {"error": EVICTED_REASON}, status=429,
+                    headers={X_REMOVAL_REASON: EVICTED_REASON})
+            # Mid-stream eviction (or external cancel): the 200 status line is
+            # already on the wire — the only clean signal is the dropped
+            # connection, so propagate.
+            raise
+        finally:
+            self.evictor.deregister(ireq.request_id)
 
     async def _proxy(self, request: web.Request, ireq: InferenceRequest | None,
                      endpoint, body: bytes, headers: dict[str, str],
-                     t_start: float, original_model: str) -> web.StreamResponse:
+                     t_start: float, original_model: str,
+                     stream_state: dict | None = None) -> web.StreamResponse:
         url = endpoint.metadata.url + request.path
         fwd = {k: v for k, v in headers.items() if k in FORWARD_HEADERS}
         fwd["content-type"] = "application/json"
@@ -247,6 +277,8 @@ class Gateway:
         try:
             if streaming:
                 ws = web.StreamResponse(status=resp.status_code, headers=out_headers)
+                if stream_state is not None:
+                    stream_state["started"] = True
                 await ws.prepare(request)
                 async for chunk in resp.aiter_bytes():
                     if first_byte_at is None:

@@ -1,0 +1,269 @@
+"""ext-proc protocol state machine (reference L1: pkg/epp/handlers).
+
+Implements the behavior of the reference's StreamingServer.Process
+(/root/reference/pkg/epp/handlers/server.go:168-598) against an abstract
+message model mirroring Envoy's ext-proc FULL_DUPLEX_STREAMED protocol:
+
+- strict Header→Body→Trailer response ordering (updateStateAndSendIfNeeded,
+  server.go:489-598);
+- request body accumulated across chunks until end_of_stream, then parsed and
+  scheduled; header mutation carries x-gateway-destination-endpoint and the
+  dynamic-metadata analogue;
+- bodyless requests (end_of_stream on headers) and unparseable bodies fall
+  back to a random endpoint (server.go:335-342, request.go:40-47);
+- scheduling/admission failures produce an ImmediateResponse with
+  x-removal-reason (server.go:493-517);
+- response phases run the ResponseReceived/Streaming/Complete hooks and
+  rewrite the model name back to the client-facing one (server.go:471-485).
+
+The Envoy gRPC wire binding is a codec layer over these dataclasses; tests
+and the standalone gateway drive the same machine directly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import logging
+import uuid
+from typing import Any
+
+from ..framework.scheduling import InferenceRequest
+from ..requestcontrol.admission import X_REMOVAL_REASON
+from ..requestcontrol.director import (
+    H_DESTINATION,
+    H_DESTINATION_SERVED,
+    H_REQUEST_ID,
+    RequestError,
+)
+
+log = logging.getLogger("router.extproc")
+
+
+# ---- message model (ext-proc ProcessingRequest analogue) -----------------
+
+@dataclasses.dataclass
+class RequestHeaders:
+    headers: dict[str, str]
+    end_of_stream: bool = False
+    path: str = "/v1/completions"
+
+
+@dataclasses.dataclass
+class RequestBody:
+    chunk: bytes
+    end_of_stream: bool = False
+
+
+@dataclasses.dataclass
+class RequestTrailers:
+    trailers: dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class ResponseHeaders:
+    headers: dict[str, str]
+    status: int = 200
+
+
+@dataclasses.dataclass
+class ResponseBody:
+    chunk: bytes
+    end_of_stream: bool = False
+
+
+# ---- response model (ProcessingResponse analogue) ------------------------
+
+@dataclasses.dataclass
+class HeaderMutation:
+    set_headers: dict[str, str] = dataclasses.field(default_factory=dict)
+    remove_headers: list[str] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class CommonResponse:
+    phase: str  # request_headers | request_body | response_headers | response_body
+    header_mutation: HeaderMutation | None = None
+    body: bytes | None = None  # replacement body (request_body/response_body)
+    dynamic_metadata: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class ImmediateResponse:
+    status: int
+    headers: dict[str, str] = dataclasses.field(default_factory=dict)
+    body: bytes = b""
+
+
+class StreamState(enum.Enum):
+    # reference StreamRequestState (server.go:98-160)
+    AWAITING_REQUEST = enum.auto()
+    REQUEST_HEADERS_DONE = enum.auto()
+    REQUEST_BODY_DONE = enum.auto()
+    RESPONSE_HEADERS_DONE = enum.auto()
+    COMPLETE = enum.auto()
+
+
+class ExtProcSession:
+    """One per ext-proc stream (i.e. per proxied request)."""
+
+    def __init__(self, director: Any, parser: Any):
+        self.director = director
+        self.parser = parser
+        self.state = StreamState.AWAITING_REQUEST
+        self.headers: dict[str, str] = {}
+        self.path = "/v1/completions"
+        self._body = bytearray()
+        self.request: InferenceRequest | None = None
+        self.original_model = ""
+        self.target_endpoint = None
+        self.usage: dict[str, int] = {}
+
+    # ---- request phase -------------------------------------------------
+
+    async def on_request_headers(self, msg: RequestHeaders):
+        if self.state is not StreamState.AWAITING_REQUEST:
+            raise ProtocolError("request headers after request phase started")
+        self.state = StreamState.REQUEST_HEADERS_DONE
+        self.headers = {k.lower(): v for k, v in msg.headers.items()}
+        from ..gateway import ROUTER_OWNED_HEADERS
+
+        for h in ROUTER_OWNED_HEADERS:
+            self.headers.pop(h, None)
+        self.headers.setdefault(H_REQUEST_ID, f"req-{uuid.uuid4().hex[:12]}")
+        self.path = msg.path
+        if msg.end_of_stream:
+            # Bodyless request: random-endpoint fallback (request.go:40-47).
+            self.state = StreamState.REQUEST_BODY_DONE
+            return self._fallback_response("request_headers")
+        return CommonResponse(phase="request_headers")
+
+    async def on_request_body(self, msg: RequestBody):
+        if self.state is not StreamState.REQUEST_HEADERS_DONE:
+            raise ProtocolError("request body before headers / after EOS")
+        self._body.extend(msg.chunk)
+        if not msg.end_of_stream:
+            return CommonResponse(phase="request_body")
+        self.state = StreamState.REQUEST_BODY_DONE
+
+        raw = bytes(self._body)
+        parse = self.parser.parse(raw, self.headers, path=self.path)
+        if parse.error:
+            return ImmediateResponse(
+                status=400, headers={X_REMOVAL_REASON: parse.error},
+                body=json.dumps({"error": parse.error}).encode())
+        if parse.skip:
+            return self._fallback_response("request_body", body=raw)
+
+        self.request = InferenceRequest(
+            request_id=self.headers[H_REQUEST_ID],
+            target_model=parse.model,
+            body=parse.body,
+            headers=self.headers,
+            request_size_bytes=len(raw))
+        self.original_model = parse.model
+        try:
+            result = await self.director.handle_request(None, self.request)
+        except RequestError as e:
+            return ImmediateResponse(
+                status=e.code, headers={X_REMOVAL_REASON: e.reason},
+                body=json.dumps({"error": e.reason}).encode())
+
+        self.target_endpoint = result.primary().target_endpoints[0]
+        body_out = raw
+        payload = self.request.body.payload
+        if payload is not None and self.request.target_model != self.original_model:
+            payload = dict(payload)
+            payload["model"] = self.request.target_model
+            body_out = json.dumps(payload).encode()
+
+        mutation = HeaderMutation(set_headers={
+            H_DESTINATION: self.request.headers[H_DESTINATION],
+            **{h: self.request.headers[h] for h in (
+                "x-prefiller-host-port", "x-encoder-hosts-ports",
+                "x-data-parallel-host-port") if h in self.request.headers},
+        })
+        return CommonResponse(
+            phase="request_body",
+            header_mutation=mutation,
+            body=body_out,
+            dynamic_metadata={"envoy.lb": {
+                H_DESTINATION: self.request.headers[H_DESTINATION]}})
+
+    async def on_request_trailers(self, msg: RequestTrailers):
+        return CommonResponse(phase="request_trailers")
+
+    # ---- response phase ------------------------------------------------
+
+    async def on_response_headers(self, msg: ResponseHeaders):
+        if self.state is not StreamState.REQUEST_BODY_DONE:
+            raise ProtocolError("response headers before request completed")
+        self.state = StreamState.RESPONSE_HEADERS_DONE
+        if self.request is not None:
+            self.director.handle_response_received(
+                None, self.request, self.target_endpoint, msg.status)
+        mutation = HeaderMutation(set_headers={
+            H_DESTINATION_SERVED: (self.target_endpoint.metadata.address_port
+                                   if self.target_endpoint else "")})
+        return CommonResponse(phase="response_headers", header_mutation=mutation)
+
+    async def on_response_body(self, msg: ResponseBody):
+        if self.state is not StreamState.RESPONSE_HEADERS_DONE:
+            raise ProtocolError("response body before response headers")
+        body = msg.chunk
+        if self.request is not None:
+            self.director.handle_response_streaming(
+                None, self.request, self.target_endpoint, body)
+        if msg.end_of_stream:
+            self.state = StreamState.COMPLETE
+            body = self._rewrite_model(body)
+            self.usage = self._extract_usage(body) or self.usage
+            if self.request is not None:
+                self.director.handle_response_complete(
+                    None, self.request, self.target_endpoint, self.usage)
+            return CommonResponse(phase="response_body", body=body,
+                                  dynamic_metadata={"usage": self.usage})
+        return CommonResponse(phase="response_body", body=body)
+
+    # ---- helpers -------------------------------------------------------
+
+    def _fallback_response(self, phase: str, body: bytes | None = None):
+        ep = self.director.get_random_endpoint()
+        if ep is None:
+            return ImmediateResponse(
+                status=503, headers={X_REMOVAL_REASON: "no ready endpoints"},
+                body=b'{"error": "no ready endpoints"}')
+        self.target_endpoint = ep
+        return CommonResponse(
+            phase=phase,
+            header_mutation=HeaderMutation(
+                set_headers={H_DESTINATION: ep.metadata.address_port}),
+            body=body,
+            dynamic_metadata={"envoy.lb": {H_DESTINATION: ep.metadata.address_port}})
+
+    def _rewrite_model(self, body: bytes) -> bytes:
+        if (self.request is None or not self.original_model
+                or self.request.target_model == self.original_model):
+            return body
+        try:
+            doc = json.loads(body)
+            if isinstance(doc, dict) and "model" in doc:
+                doc["model"] = self.original_model
+                return json.dumps(doc).encode()
+        except Exception:
+            pass
+        return body
+
+    @staticmethod
+    def _extract_usage(body: bytes) -> dict[str, int] | None:
+        try:
+            doc = json.loads(body)
+            u = doc.get("usage")
+            return u if isinstance(u, dict) else None
+        except Exception:
+            return None
+
+
+class ProtocolError(Exception):
+    pass

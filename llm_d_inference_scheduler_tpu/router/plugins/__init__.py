@@ -4,7 +4,7 @@ Importing this package registers every in-tree plugin type with the global
 registry; the config loader instantiates them by type name.
 """
 
-from . import filters, scorers, pickers, profile_handlers, disagg, saturation  # noqa: F401
+from . import filters, scorers, pickers, profile_handlers, disagg, saturation, reporter  # noqa: F401
 
 from .attributes import PrefixCacheMatchInfo, PREFIX_ATTRIBUTE_KEY, INFLIGHT_ATTRIBUTE_KEY
 

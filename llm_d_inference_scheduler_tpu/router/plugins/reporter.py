@@ -1,0 +1,55 @@
+"""request-attribute-reporter: per-request attribute emission.
+
+Reference: framework/plugins/requestcontrol/requestattributereporter — emits
+per-request attributes (usage, timings, decision context) to logs/metrics so
+operators can trace scheduling decisions per request.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any
+
+from ..framework.plugin import PluginBase, register_plugin
+from ..framework.scheduling import InferenceRequest, SchedulingResult
+
+log = logging.getLogger("router.request_report")
+
+
+@register_plugin("request-attribute-reporter")
+class RequestAttributeReporter(PluginBase):
+    def __init__(self, name: str | None = None):
+        super().__init__(name)
+        self.log_level = logging.INFO
+        self._start_times: dict[str, float] = {}
+        self._decisions: dict[str, dict[str, Any]] = {}
+
+    def configure(self, params: dict[str, Any], handle: Any) -> None:
+        if params.get("verbose"):
+            self.log_level = logging.DEBUG
+
+    def pre_request(self, ctx: Any, request: InferenceRequest,
+                    result: SchedulingResult) -> None:
+        self._start_times[request.request_id] = time.monotonic()
+        self._decisions[request.request_id] = {
+            "profiles": {name: [ep.metadata.address_port
+                                for ep in r.target_endpoints]
+                         for name, r in result.profile_results.items()},
+            "model": request.target_model,
+            "priority": request.objectives.priority,
+        }
+
+    def response_complete(self, ctx: Any, request: InferenceRequest,
+                          endpoint: Any, usage: dict[str, int]) -> None:
+        start = self._start_times.pop(request.request_id, None)
+        decision = self._decisions.pop(request.request_id, {})
+        log.log(self.log_level,
+                "request=%s model=%s priority=%s endpoint=%s duration_ms=%s "
+                "prompt_tokens=%s completion_tokens=%s profiles=%s",
+                request.request_id, decision.get("model"),
+                decision.get("priority"),
+                endpoint.metadata.address_port if endpoint else None,
+                round((time.monotonic() - start) * 1e3, 1) if start else None,
+                usage.get("prompt_tokens"), usage.get("completion_tokens"),
+                decision.get("profiles"))

@@ -164,6 +164,46 @@ class SessionAffinityScorer(PluginBase):
             request.headers[self.SESSION_HEADER] = primary[0].metadata.address_port
 
 
+@register_plugin("no-hit-lru-scorer")
+class NoHitLruScorer(PluginBase):
+    """For cold requests (no prefix hit on any endpoint), favor the endpoint
+    least-recently chosen for a cold request, spreading cache growth across
+    the pool (reference scorer/nohitlru). Neutral when any endpoint has a hit.
+    """
+
+    def __init__(self, name: str | None = None):
+        super().__init__(name)
+        self._last_cold: dict[str, float] = {}  # address_port -> monotonic ts
+        self._counter = 0.0
+
+    def consumes(self) -> list[str]:
+        return [PREFIX_ATTRIBUTE_KEY]
+
+    def _any_hit(self, endpoints) -> bool:
+        for ep in endpoints:
+            info: PrefixCacheMatchInfo | None = ep.attributes.get(PREFIX_ATTRIBUTE_KEY)
+            if info and info.match_blocks > 0:
+                return True
+        return False
+
+    def score(self, ctx, state, request, endpoints):
+        if self._any_hit(endpoints):
+            return {ep.metadata.address_port: 0.5 for ep in endpoints}
+        return _normalized_inverse(
+            {ep.metadata.address_port: self._last_cold.get(ep.metadata.address_port, 0.0)
+             for ep in endpoints})
+
+    def pre_request(self, ctx, request, result) -> None:
+        info = None
+        primary = result.primary().target_endpoints
+        if primary:
+            info = primary[0].attributes.get(PREFIX_ATTRIBUTE_KEY)
+        if info is None or info.match_blocks == 0:
+            self._counter += 1.0
+            for ep in primary[:1]:
+                self._last_cold[ep.metadata.address_port] = self._counter
+
+
 @register_plugin("context-length-aware-scorer", "context-length-aware")
 class ContextLengthAwareScorer(PluginBase):
     """Route long-context requests to endpoints with token budget for them

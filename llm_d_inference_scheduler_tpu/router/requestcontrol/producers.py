@@ -152,6 +152,68 @@ class ApproxPrefixCacheProducer(PluginBase):
         pass
 
 
+@register_plugin("token-producer", "tokenizer")
+class TokenProducer(PluginBase):
+    """Tokenizes the prompt via an engine's render endpoints and publishes
+    TokenizedPrompt on the request body.
+
+    Reference: dataproducer/tokenizer — calls vLLM's /v1/completions/render +
+    /v1/chat/completions/render over HTTP (tokenizer/vllm_http.go); here the
+    TPU engines expose the same endpoints. An LRU keyed by (model, prompt)
+    keeps repeat tokenizations off the producer budget.
+    """
+
+    TOKENIZED_KEY = "request/tokenized"
+
+    def __init__(self, name: str | None = None):
+        super().__init__(name)
+        self.timeout_s = 0.35  # must fit the director's 400ms producer budget
+        self.cache_capacity = 2048
+        self._cache: OrderedDict[tuple[str, str], list[int]] = OrderedDict()
+        self._client = None
+
+    def configure(self, params: dict[str, Any], handle: Any) -> None:
+        self.timeout_s = float(params.get("timeoutSeconds", self.timeout_s))
+        self.cache_capacity = int(params.get("cacheCapacity", self.cache_capacity))
+
+    def produces(self) -> list[str]:
+        return [self.TOKENIZED_KEY]
+
+    def consumes(self) -> list[str]:
+        return []
+
+    async def produce(self, ctx: Any, request: InferenceRequest,
+                      endpoints: list[Endpoint]) -> None:
+        if request.body.tokenized_prompt is not None or not endpoints:
+            return
+        chat = request.body.chat_completions is not None
+        key = (request.target_model, request.body.prompt_text())
+        cached = self._cache.get(key)
+        if cached is not None:
+            self._cache.move_to_end(key)
+            request.body.tokenized_prompt = cached
+            return
+        import httpx
+
+        if self._client is None:
+            self._client = httpx.AsyncClient(timeout=self.timeout_s)
+        ep = endpoints[0]
+        path = "/v1/chat/completions/render" if chat else "/v1/completions/render"
+        payload = (request.body.chat_completions if chat
+                   else request.body.completions) or {}
+        try:
+            r = await self._client.post(ep.metadata.url + path, json=payload)
+            r.raise_for_status()
+            ids = r.json().get("token_ids")
+        except Exception:
+            return  # tokenization is best-effort; char estimates take over
+        if isinstance(ids, list):
+            request.body.tokenized_prompt = ids
+            self._cache[key] = ids
+            while len(self._cache) > self.cache_capacity:
+                self._cache.popitem(last=False)
+
+
 @register_plugin("inflight-load-producer")
 class InflightLoadProducer(PluginBase):
     def __init__(self, name: str | None = None):

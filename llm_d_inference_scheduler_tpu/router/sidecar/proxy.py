@@ -47,11 +47,18 @@ class SidecarConfig:
     ssrf_allowlist: list[str] | None = None  # None disables SSRF protection
     prefill_timeout_s: float = 120.0
     decode_timeout_s: float = 300.0
+    # Chunked decode (reference decode.go:62-444): split decode into
+    # max_tokens=N slices, re-appending generated text. 0 disables.
+    decode_chunk_size: int = 0
+    # Data parallelism (reference data_parallel.go:19-88): one extra listener
+    # per DP rank; rank i listens on port+i and dispatches to decoderPort+i.
+    data_parallel_size: int = 1
 
 
 class Sidecar:
-    def __init__(self, cfg: SidecarConfig):
+    def __init__(self, cfg: SidecarConfig, *, dp_rank: int = 0):
         self.cfg = cfg
+        self.dp_rank = dp_rank
         self.app = web.Application()
         self.app.add_routes([web.post(p, self.handle_generate) for p in GEN_PATHS])
         self.app.add_routes([
@@ -61,19 +68,42 @@ class Sidecar:
         ])
         self._runner: web.AppRunner | None = None
         self._client: httpx.AsyncClient | None = None
+        self._dp_children: list["Sidecar"] = []
+
+    def _rank_url(self) -> str:
+        """decoder URL shifted by this listener's DP rank (data_parallel.go:39-88)."""
+        if self.dp_rank == 0:
+            return self.cfg.decoder_url
+        from urllib.parse import urlsplit
+
+        parts = urlsplit(self.cfg.decoder_url)
+        if parts.port is None:
+            raise ValueError(
+                f"decoder URL {self.cfg.decoder_url!r} needs an explicit port "
+                f"for data-parallel rank dispatch")
+        return f"{parts.scheme}://{parts.hostname}:{parts.port + self.dp_rank}"
 
     async def start(self):
         self._client = httpx.AsyncClient(
             timeout=httpx.Timeout(self.cfg.decode_timeout_s, connect=5.0))
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, self.cfg.host, self.cfg.port)
+        site = web.TCPSite(self._runner, self.cfg.host, self.cfg.port + self.dp_rank)
         await site.start()
-        log.info("sidecar on %s:%s -> decoder %s (connector=%s)",
-                 self.cfg.host, self.cfg.port, self.cfg.decoder_url,
-                 self.cfg.connector)
+        log.info("sidecar on %s:%s -> decoder %s (connector=%s, dp_rank=%d)",
+                 self.cfg.host, self.cfg.port + self.dp_rank, self._rank_url(),
+                 self.cfg.connector, self.dp_rank)
+        if self.dp_rank == 0 and self.cfg.data_parallel_size > 1:
+            for rank in range(1, self.cfg.data_parallel_size):
+                child = Sidecar(self.cfg, dp_rank=rank)
+                child._rank_url()  # fail fast on port-less decoder URLs
+                await child.start()
+                self._dp_children.append(child)
 
     async def stop(self):
+        for child in self._dp_children:
+            await child.stop()
+        self._dp_children.clear()
         if self._runner:
             await self._runner.cleanup()
         if self._client:
@@ -91,8 +121,18 @@ class Sidecar:
         # Disagg headers are consumed here and never forwarded downstream
         # (upstream dispatch builds its own header set).
         prefiller = request.headers.get(H_PREFILLER)
-        encoders = request.headers.get(H_ENCODERS)  # E/PD protocol: phase 2
-        del encoders
+        encoders = request.headers.get(H_ENCODERS)
+
+        if encoders and self.cfg.connector != "passthrough":
+            hosts = [h.strip() for h in encoders.split(",") if h.strip()]
+            if self.cfg.ssrf_allowlist is not None:
+                bad = [h for h in hosts if h not in self.cfg.ssrf_allowlist]
+                if bad:
+                    return web.json_response(
+                        {"error": f"encoders {bad} not in allowlist"}, status=403)
+            err = await self._run_encode_primers(request, body, hosts)
+            if err is not None:
+                log.warning("encode primer failed (%s); continuing without", err)
 
         if prefiller and self.cfg.connector != "passthrough":
             if (self.cfg.ssrf_allowlist is not None
@@ -101,6 +141,47 @@ class Sidecar:
                     {"error": f"prefiller {prefiller} not in allowlist"}, status=403)
             return await self._run_pd_protocol(request, body, prefiller)
         return await self._dispatch_decode(request, body)
+
+    @staticmethod
+    def _multimodal_items(body: dict[str, Any]) -> list[dict[str, Any]]:
+        """Extract image/video/audio content blocks from a chat body
+        (reference multimodal_helpers.go)."""
+        items = []
+        for m in body.get("messages") or []:
+            content = m.get("content")
+            if isinstance(content, list):
+                for block in content:
+                    if isinstance(block, dict) and block.get("type") in (
+                            "image_url", "video_url", "input_audio"):
+                        items.append(block)
+        return items
+
+    async def _run_encode_primers(self, request: web.Request,
+                                  body: dict[str, Any],
+                                  hosts: list[str]) -> str | None:
+        """E/PD stage: fan multimodal items out across the encode workers
+        (reference connector_epd_shared_storage.go:38-211). Items are split
+        round-robin; every worker is primed with its share before P/D runs."""
+        items = self._multimodal_items(body)
+        if not items or not hosts:
+            return None
+        rid = body.get("request_id") or request.headers.get("x-request-id", "")
+        shares: list[list[dict[str, Any]]] = [[] for _ in hosts]
+        for i, item in enumerate(items):
+            shares[i % len(hosts)].append(item)
+        try:
+            import asyncio as _aio
+
+            results = await _aio.gather(*[
+                self._client.post(f"http://{h}/v1/encode",
+                                  json={"request_id": rid, "items": share})
+                for h, share in zip(hosts, shares) if share])
+            for r in results:
+                if r.status_code != 200:
+                    return f"encoder returned {r.status_code}"
+        except Exception as e:
+            return str(e)
+        return None
 
     async def _run_pd_protocol(self, request: web.Request, body: dict[str, Any],
                                prefiller: str) -> web.StreamResponse:
@@ -136,7 +217,13 @@ class Sidecar:
     async def _dispatch_decode(self, request: web.Request, body: dict[str, Any],
                                extra_headers: dict[str, str] | None = None
                                ) -> web.StreamResponse:
-        url = self.cfg.decoder_url + request.path
+        chunkable = (self.cfg.decode_chunk_size > 0 and not body.get("stream")
+                     and "kv_transfer_params" not in body
+                     and int(body.get("max_tokens") or 16) > 0
+                     and ("messages" in body or isinstance(body.get("prompt"), str)))
+        if chunkable:
+            return await self._chunked_decode(request, body, extra_headers)
+        url = self._rank_url() + request.path
         try:
             upstream = self._client.build_request(
                 "POST", url, json=body, headers={"content-type": "application/json"})
@@ -161,9 +248,60 @@ class Sidecar:
         finally:
             await resp.aclose()
 
+    async def _chunked_decode(self, request: web.Request, body: dict[str, Any],
+                              extra_headers: dict[str, str] | None
+                              ) -> web.StreamResponse:
+        """Bounded decode slices (reference decode.go:62-444): issue decode in
+        max_tokens=chunk steps, re-appending the generated text between steps
+        (chat uses the continue-final-message pattern)."""
+        chunk = self.cfg.decode_chunk_size
+        total = int(body.get("max_tokens", 16))
+        chat = "messages" in body
+        acc_text = ""
+        completion_tokens = 0
+        doc: dict[str, Any] = {}
+        remaining = total
+        while remaining > 0:
+            step_body = dict(body)
+            step_body["max_tokens"] = min(chunk, remaining)
+            if chat:
+                msgs = list(body["messages"])
+                if acc_text:
+                    msgs.append({"role": "assistant", "content": acc_text})
+                    step_body["continue_final_message"] = True
+                step_body["messages"] = msgs
+            else:
+                step_body["prompt"] = body["prompt"] + acc_text
+            r = await self._client.post(self._rank_url() + request.path,
+                                        json=step_body)
+            if r.status_code != 200:
+                return web.Response(body=r.content, status=r.status_code,
+                                    content_type="application/json")
+            doc = r.json()
+            choice = doc["choices"][0]
+            piece = (choice.get("message", {}).get("content")
+                     if chat else choice.get("text")) or ""
+            acc_text += piece
+            completion_tokens += doc.get("usage", {}).get("completion_tokens", 0)
+            remaining -= step_body["max_tokens"]
+            if choice.get("finish_reason") != "length":
+                break
+
+        if chat:
+            doc["choices"][0]["message"]["content"] = acc_text
+        else:
+            doc["choices"][0]["text"] = acc_text
+        if "usage" in doc:
+            doc["usage"]["completion_tokens"] = completion_tokens
+            doc["usage"]["total_tokens"] = (doc["usage"].get("prompt_tokens", 0)
+                                            + completion_tokens)
+        headers = {"content-type": "application/json"}
+        headers.update(extra_headers or {})
+        return web.Response(body=json.dumps(doc).encode(), headers=headers)
+
     async def _proxy_get(self, request: web.Request) -> web.Response:
         try:
-            r = await self._client.get(self.cfg.decoder_url + request.path)
+            r = await self._client.get(self._rank_url() + request.path)
             return web.Response(body=r.content, status=r.status_code,
                                 content_type=r.headers.get("content-type",
                                                            "text/plain").split(";")[0])
@@ -184,12 +322,16 @@ def main(argv: list[str] | None = None):
     p.add_argument("--allowlist", default=None,
                    help="comma-separated allowed prefill host:ports "
                         "(enables SSRF protection)")
+    p.add_argument("--decode-chunk-size", type=int, default=0)
+    p.add_argument("--data-parallel-size", type=int, default=1)
     args = p.parse_args(argv)
     cfg = SidecarConfig(
         port=args.port, host=args.host, decoder_url=args.decoder,
         connector=args.connector,
         ssrf_allowlist=[s.strip() for s in args.allowlist.split(",") if s.strip()]
-        if args.allowlist else None)
+        if args.allowlist else None,
+        decode_chunk_size=args.decode_chunk_size,
+        data_parallel_size=args.data_parallel_size)
     logging.basicConfig(level=logging.INFO)
 
     async def run():

@@ -1,0 +1,19 @@
+"""Orbax checkpoint save/restore roundtrip for engine params."""
+
+import tempfile
+
+import jax
+import numpy as np
+
+from llm_d_inference_scheduler_tpu.engine.checkpoint import load_params, save_params
+from llm_d_inference_scheduler_tpu.models import TINY, llama
+
+
+def test_checkpoint_roundtrip():
+    params = llama.init_params(TINY, jax.random.key(42))
+    path = tempfile.mkdtemp() + "/ckpt"
+    save_params(path, params)
+    restored = load_params(path, TINY)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a, np.float32),
+                                      np.asarray(b, np.float32))

@@ -190,3 +190,39 @@ def test_gateway_strips_client_injected_disagg_headers():
             await dec.stop()
 
     asyncio.run(body())
+
+
+def test_sidecar_chunked_decode_and_dp_ranks():
+    """Chunked decode reassembles full text across max_tokens slices; DP rank
+    listeners dispatch to per-rank decoder ports."""
+    SC2, DEC2 = 18390, 18394  # SC2+rank must not collide with engine ports
+
+    async def body():
+        # two sim "DP rank" engines on consecutive ports
+        e0 = EngineServer(EngineConfig(backend="sim", model="tiny", port=DEC2))
+        e1 = EngineServer(EngineConfig(backend="sim", model="tiny", port=DEC2 + 1))
+        await e0.start()
+        await e1.start()
+        sc = Sidecar(SidecarConfig(port=SC2, decoder_url=f"http://127.0.0.1:{DEC2}",
+                                   decode_chunk_size=3, data_parallel_size=2))
+        await sc.start()
+        try:
+            async with httpx.AsyncClient(timeout=60) as c:
+                # chunked: 8 tokens in chunks of 3 -> "lorem ip" reassembled
+                r = await c.post(f"http://127.0.0.1:{SC2}/v1/completions",
+                                 json={"prompt": "x", "max_tokens": 8})
+                assert r.status_code == 200
+                doc = r.json()
+                assert doc["usage"]["completion_tokens"] == 8
+                assert len(doc["choices"][0]["text"]) == 8
+
+                # DP rank 1 listener dispatches to engine on DEC2+1
+                r = await c.post(f"http://127.0.0.1:{SC2 + 1}/v1/completions",
+                                 json={"prompt": "x", "max_tokens": 2})
+                assert r.status_code == 200
+        finally:
+            await sc.stop()
+            await e1.stop()
+            await e0.stop()
+
+    asyncio.run(body())

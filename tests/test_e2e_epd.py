@@ -1,0 +1,94 @@
+"""E/PD encode disaggregation: multimodal requests prime encode workers."""
+
+import asyncio
+
+import httpx
+
+from llm_d_inference_scheduler_tpu.engine import EngineConfig
+from llm_d_inference_scheduler_tpu.engine.server import EngineServer
+from llm_d_inference_scheduler_tpu.router.gateway import build_gateway
+from llm_d_inference_scheduler_tpu.router.sidecar import Sidecar, SidecarConfig
+
+GW, SC, DEC, PRE, ENC = 18460, 18461, 18462, 18463, 18464
+
+CFG = f"""
+pool:
+  endpoints:
+    - {{address: 127.0.0.1, port: {SC}, labels: {{llm-d.ai/role: decode}}}}
+    - {{address: 127.0.0.1, port: {PRE}, labels: {{llm-d.ai/role: prefill}}}}
+    - {{address: 127.0.0.1, port: {ENC}, labels: {{llm-d.ai/role: encode}}}}
+plugins:
+  - {{type: decode-filter}}
+  - {{type: prefill-filter}}
+  - {{type: encode-filter}}
+  - {{type: queue-scorer}}
+  - {{type: approx-prefix-cache-producer}}
+  - {{type: prefix-cache-scorer}}
+  - type: disagg-profile-handler
+    parameters:
+      pdDecider:
+        type: prefix-based-pd-decider
+        parameters: {{thresholdTokens: 16}}
+      encodeDecider: always-disagg-multimodal-decider
+schedulingProfiles:
+  - name: decode
+    plugins:
+      - {{pluginRef: decode-filter}}
+      - {{pluginRef: queue-scorer}}
+  - name: prefill
+    plugins:
+      - {{pluginRef: prefill-filter}}
+      - {{pluginRef: queue-scorer}}
+  - name: encode
+    plugins:
+      - {{pluginRef: encode-filter}}
+      - {{pluginRef: queue-scorer}}
+"""
+
+
+def test_epd_encode_fanout():
+    async def body():
+        servers = [
+            EngineServer(EngineConfig(backend="sim", model="tiny", port=p,
+                                      role=role))
+            for p, role in ((DEC, "decode"), (PRE, "prefill"), (ENC, "encode"))]
+        for s in servers:
+            await s.start()
+        enc_server = servers[2]
+        sc = Sidecar(SidecarConfig(port=SC, decoder_url=f"http://127.0.0.1:{DEC}",
+                                   ssrf_allowlist=[f"127.0.0.1:{PRE}",
+                                                   f"127.0.0.1:{ENC}"]))
+        await sc.start()
+        gw = build_gateway(CFG, port=GW, poll_interval=0.02)
+        await gw.start()
+        try:
+            long_text = "describe this image in detail please " * 4
+            async with httpx.AsyncClient(timeout=60) as c:
+                r = await c.post(f"http://127.0.0.1:{GW}/v1/chat/completions", json={
+                    "model": "tiny", "max_tokens": 3,
+                    "messages": [{"role": "user", "content": [
+                        {"type": "text", "text": long_text},
+                        {"type": "image_url", "image_url": {"url": "http://x/cat.png"}},
+                        {"type": "image_url", "image_url": {"url": "http://x/dog.png"}},
+                    ]}]})
+                assert r.status_code == 200
+                # encoder was primed with both items
+                assert sum(enc_server.ec_store.values()) == 2
+
+                m = await c.get(f"http://127.0.0.1:{GW}/metrics")
+                assert 'decision_type="encode-prefill-decode"' in m.text
+
+                # text-only request: no encode stage
+                before = dict(enc_server.ec_store)
+                r = await c.post(f"http://127.0.0.1:{GW}/v1/chat/completions", json={
+                    "model": "tiny", "max_tokens": 2,
+                    "messages": [{"role": "user", "content": "plain text"}]})
+                assert r.status_code == 200
+                assert enc_server.ec_store == before
+        finally:
+            await gw.stop()
+            await sc.stop()
+            for s in servers:
+                await s.stop()
+
+    asyncio.run(body())

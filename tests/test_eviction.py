@@ -1,0 +1,114 @@
+"""Mid-flight request eviction: ordering policy, admission retry, gateway 429."""
+
+import asyncio
+
+import httpx
+import pytest
+
+from llm_d_inference_scheduler_tpu.engine import EngineConfig
+from llm_d_inference_scheduler_tpu.engine.server import EngineServer
+from llm_d_inference_scheduler_tpu.router.flowcontrol.eviction import RequestEvictor
+from llm_d_inference_scheduler_tpu.router.gateway import build_gateway
+
+
+def test_evictor_priority_then_time_order():
+    ev = RequestEvictor()
+    cancelled = []
+    ev.register("old-low", -2, lambda: cancelled.append("old-low"))
+    ev.register("new-low", -2, lambda: cancelled.append("new-low"))
+    ev.register("mid", -1, lambda: cancelled.append("mid"))
+    ev.register("normal", 0, lambda: cancelled.append("normal"))
+
+    assert ev.evict_n(2) == 2
+    assert cancelled == ["old-low", "new-low"]  # lowest priority, oldest first
+    assert ev.evict_n(5) == 1  # only "mid" remains sheddable
+    assert cancelled == ["old-low", "new-low", "mid"]
+    assert "normal" not in cancelled  # non-sheddable never evicted
+    assert ev.was_evicted("mid")
+
+
+def test_gateway_evicts_inflight_sheddable_with_429():
+    cfg = """
+objectives:
+  - {name: batch-tier, priority: -1}
+pool:
+  endpoints:
+    - {address: 127.0.0.1, port: 18386}
+"""
+
+    async def body():
+        eng = EngineServer(EngineConfig(backend="sim", model="tiny", port=18386,
+                                        max_batch=2, sim_decode_ms_per_token=50.0))
+        await eng.start()
+        gw = build_gateway(cfg, port=18385, poll_interval=0.02)
+        await gw.start()
+        try:
+            async with httpx.AsyncClient(timeout=60) as c:
+                sheddable = asyncio.create_task(c.post(
+                    "http://127.0.0.1:18385/v1/completions",
+                    json={"model": "tiny", "prompt": "long", "max_tokens": 60},
+                    headers={"x-gateway-inference-objective": "batch-tier"}))
+                for _ in range(100):
+                    await asyncio.sleep(0.02)
+                    if gw.evictor.inflight_count == 1:
+                        break
+                assert gw.evictor.inflight_count == 1
+
+                assert gw.evictor.evict_n(1) == 1
+                r = await sheddable
+                assert r.status_code == 429
+                assert "evicted" in r.headers.get("x-removal-reason", "")
+                assert gw.evictor.inflight_count == 0
+        finally:
+            await gw.stop()
+            await eng.stop()
+
+    asyncio.run(body())
+
+
+def test_admission_capacity_retry_after_eviction():
+    """Non-sheddable request rejected on capacity triggers evict_n + a retry."""
+    from llm_d_inference_scheduler_tpu.router.flowcontrol import (
+        FlowControlAdmissionController, FlowControlConfig, FlowController)
+    from llm_d_inference_scheduler_tpu.router.framework.scheduling import (
+        InferenceRequest, InferenceRequestBody, Objectives)
+
+    async def body():
+        sat = {"v": 2.0}
+        fc = FlowController(FlowControlConfig(max_global_requests=1,
+                                              default_ttl_s=5.0),
+                            saturation_fn=lambda: sat["v"])
+        await fc.start()
+        evictor = RequestEvictor()
+        admission = FlowControlAdmissionController(fc, evictor=evictor)
+
+        def req(rid, prio):
+            return InferenceRequest(
+                request_id=rid, target_model="m",
+                body=InferenceRequestBody(completions={"prompt": "x"}),
+                objectives=Objectives(priority=prio), request_size_bytes=10)
+
+        from llm_d_inference_scheduler_tpu.router.requestcontrol.admission import (
+            AdmissionError)
+
+        try:
+            # Fill the single queue slot with a sheddable request.
+            filler = asyncio.create_task(admission.admit(None, req("filler", -1), []))
+            await asyncio.sleep(0.05)
+            evictor.register("victim", -1, lambda: None)  # a sheddable in-flight
+
+            # Non-sheddable arrival: capacity-rejected -> sheds the QUEUED
+            # filler (frees the slot), evicts the in-flight victim, and the
+            # retry enqueues successfully.
+            high = asyncio.create_task(admission.admit(None, req("high", 5), []))
+            await asyncio.sleep(0.1)
+            assert evictor.was_evicted("victim")
+            with pytest.raises(AdmissionError) as exc:
+                await filler  # shed from the queue -> 429
+            assert exc.value.code == 429
+            sat["v"] = 0.0  # headroom: the retried high-priority dispatches
+            await asyncio.wait_for(high, timeout=5)  # no exception = admitted
+        finally:
+            await fc.stop()
+
+    asyncio.run(body())

@@ -1,0 +1,129 @@
+"""ext-proc state machine: protocol ordering, mutations, fallbacks, errors."""
+
+import asyncio
+import json
+
+import pytest
+
+from llm_d_inference_scheduler_tpu.router import plugins  # noqa: F401
+from llm_d_inference_scheduler_tpu.router.config.loader import Handle, load_config
+from llm_d_inference_scheduler_tpu.router.datalayer.datastore import Datastore
+from llm_d_inference_scheduler_tpu.router.framework.datalayer import EndpointMetadata
+from llm_d_inference_scheduler_tpu.router.handlers.extproc import (
+    CommonResponse,
+    ExtProcSession,
+    ImmediateResponse,
+    ProtocolError,
+    RequestBody,
+    RequestHeaders,
+    ResponseBody,
+    ResponseHeaders,
+)
+from llm_d_inference_scheduler_tpu.router.handlers.parsers import OpenAIParser
+from llm_d_inference_scheduler_tpu.router.requestcontrol.admission import (
+    AlwaysAdmitController,
+)
+from llm_d_inference_scheduler_tpu.router.requestcontrol.director import Director
+
+
+def make_session(n_endpoints=2):
+    ds = Datastore()
+    for i in range(n_endpoints):
+        ds.endpoint_add_or_update(EndpointMetadata(
+            name=f"e{i}", address=f"10.0.0.{i+1}", port=8200))
+    handle = Handle(datastore=ds)
+    cfg = load_config(None, handle)
+    director = Director(ds, cfg.scheduler, admission=AlwaysAdmitController(),
+                        producers=cfg.producers,
+                        pre_request_plugins=cfg.pre_request_plugins)
+    return ExtProcSession(director, OpenAIParser("p")), ds
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_full_stream_happy_path():
+    async def body():
+        sess, _ = make_session()
+        r = await sess.on_request_headers(RequestHeaders(headers={"X-Foo": "1"}))
+        assert isinstance(r, CommonResponse) and r.phase == "request_headers"
+
+        payload = json.dumps({"model": "m", "prompt": "hello"}).encode()
+        r = await sess.on_request_body(RequestBody(payload[:5]))
+        assert r.phase == "request_body" and r.header_mutation is None
+        r = await sess.on_request_body(RequestBody(payload[5:], end_of_stream=True))
+        dest = r.header_mutation.set_headers["x-gateway-destination-endpoint"]
+        assert dest.startswith("10.0.0.")
+        assert r.dynamic_metadata["envoy.lb"]["x-gateway-destination-endpoint"] == dest
+
+        r = await sess.on_response_headers(ResponseHeaders(headers={}, status=200))
+        assert r.header_mutation.set_headers[
+            "x-gateway-destination-endpoint-served"] == dest
+
+        resp = json.dumps({"model": "m", "usage": {"prompt_tokens": 3,
+                                                   "completion_tokens": 5}}).encode()
+        r = await sess.on_response_body(ResponseBody(resp, end_of_stream=True))
+        assert r.dynamic_metadata["usage"]["completion_tokens"] == 5
+
+    run(body())
+
+
+def test_bodyless_request_falls_back_to_random():
+    async def body():
+        sess, _ = make_session()
+        r = await sess.on_request_headers(
+            RequestHeaders(headers={}, end_of_stream=True))
+        assert isinstance(r, CommonResponse)
+        assert "x-gateway-destination-endpoint" in r.header_mutation.set_headers
+
+    run(body())
+
+
+def test_ordering_violations_raise():
+    async def body():
+        sess, _ = make_session()
+        with pytest.raises(ProtocolError):
+            await sess.on_request_body(RequestBody(b"x", end_of_stream=True))
+        sess2, _ = make_session()
+        await sess2.on_request_headers(RequestHeaders(headers={}))
+        with pytest.raises(ProtocolError):
+            await sess2.on_response_headers(ResponseHeaders(headers={}))
+
+    run(body())
+
+
+def test_invalid_body_immediate_response():
+    async def body():
+        sess, _ = make_session()
+        await sess.on_request_headers(RequestHeaders(headers={}))
+        r = await sess.on_request_body(RequestBody(b"{nope", end_of_stream=True))
+        assert isinstance(r, ImmediateResponse) and r.status == 400
+        assert "x-removal-reason" in r.headers
+
+    run(body())
+
+
+def test_no_endpoints_immediate_503():
+    async def body():
+        sess, _ = make_session(n_endpoints=0)
+        await sess.on_request_headers(RequestHeaders(headers={}))
+        r = await sess.on_request_body(
+            RequestBody(json.dumps({"model": "m", "prompt": "x"}).encode(),
+                        end_of_stream=True))
+        assert isinstance(r, ImmediateResponse) and r.status == 503
+
+    run(body())
+
+
+def test_client_injected_routing_header_stripped():
+    async def body():
+        sess, _ = make_session()
+        await sess.on_request_headers(RequestHeaders(
+            headers={"x-prefiller-host-port": "evil:1"}))
+        r = await sess.on_request_body(
+            RequestBody(json.dumps({"model": "m", "prompt": "x"}).encode(),
+                        end_of_stream=True))
+        assert "x-prefiller-host-port" not in r.header_mutation.set_headers
+
+    run(body())

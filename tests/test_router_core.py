@@ -200,3 +200,30 @@ def test_model_rewrite_weighted():
     rng = random.Random(7)
     picks = [rw.pick_target(rng) for _ in range(400)]
     assert 0.6 < picks.count("a") / 400 < 0.9
+
+
+def test_no_hit_lru_scorer_spreads_cold_traffic():
+    from llm_d_inference_scheduler_tpu.router.plugins.scorers import NoHitLruScorer
+    from llm_d_inference_scheduler_tpu.router.framework.scheduling import (
+        ProfileRunResult, SchedulingResult)
+
+    s = NoHitLruScorer("lru")
+    eps = [ep("a"), ep("b"), ep("c")]
+    for e in eps:
+        e.attributes.put(PREFIX_ATTRIBUTE_KEY, PrefixCacheMatchInfo(0, 10, 16))
+
+    # all cold, no history: everyone ties at 1.0
+    scores = s.score(None, None, req(), eps)
+    assert set(scores.values()) == {1.0}
+
+    # record a cold route to "a": next cold request must prefer b/c over a
+    res = SchedulingResult({"default": ProfileRunResult([eps[0]])}, "default")
+    s.pre_request(None, req(), res)
+    scores = s.score(None, None, req(), eps)
+    assert scores["a:8200"] == 0.0
+    assert scores["b:8200"] == scores["c:8200"] == 1.0
+
+    # with a prefix hit somewhere, the scorer goes neutral
+    eps[1].attributes.put(PREFIX_ATTRIBUTE_KEY, PrefixCacheMatchInfo(5, 10, 16))
+    scores = s.score(None, None, req(), eps)
+    assert set(scores.values()) == {0.5}
