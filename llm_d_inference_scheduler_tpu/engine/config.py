@@ -27,6 +27,8 @@ class EngineConfig:
     role: str = "both"            # "prefill" | "decode" | "both" | "encode"
     engine_id: str = ""
     checkpoint_path: str = ""     # orbax dir; empty = random init (dev/bench)
+    pallas_attention: bool = False  # Pallas paged-attention decode kernel (TPU)
+    pallas_interpret: bool = False  # interpret the kernel (CPU testing only)
 
     @property
     def model_config(self) -> ModelConfig:

@@ -124,7 +124,8 @@ class TpuEngine:
 
     def _decode_impl(self, params, tokens, positions, k_pages, v_pages, block_tables):
         return llama.decode_step(params, self.mcfg, tokens, positions, k_pages, v_pages,
-                                 block_tables)
+                                 block_tables, use_pallas=self.cfg.pallas_attention,
+                                 pallas_interpret=self.cfg.pallas_interpret)
 
     def _prefill_fn(self, bucket: int):
         """Per-bucket jitted prefill: forward + KV scatter + last-token logits."""

@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops import apply_rope, causal_attention, paged_decode_attention, rms_norm, rope_table
+from ..ops.pallas_paged_attention import paged_decode_attention_pallas
 from .configs import ModelConfig
 
 Params = dict[str, Any]
@@ -126,6 +127,8 @@ def decode_step(
     v_pages: jnp.ndarray,      # [L, N_blocks, block, Hkv, Dh]
     block_tables: jnp.ndarray,  # [B, max_blocks] int32
     active: jnp.ndarray | None = None,  # [B] bool — padding-slot mask
+    use_pallas: bool = False,
+    pallas_interpret: bool = False,  # run the kernel interpreted (CPU tests)
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One decode step with paged KV; returns (logits [B, V] f32, k_pages, v_pages).
 
@@ -159,8 +162,13 @@ def decode_step(
         q = apply_rope(q[:, None], cos[:, None], sin[:, None])[:, 0]
         k = apply_rope(k[:, None], cos[:, None], sin[:, None])[:, 0]
 
-        attn = paged_decode_attention(q, kp, vp, block_tables, seq_lens,
-                                      cur_k=k, cur_v=v)
+        if use_pallas:
+            attn = paged_decode_attention_pallas(q, kp, vp, block_tables,
+                                                 seq_lens, k, v,
+                                                 interpret=pallas_interpret)
+        else:
+            attn = paged_decode_attention(q, kp, vp, block_tables, seq_lens,
+                                          cur_k=k, cur_v=v)
         x = x + attn.reshape(B, -1) @ lp["wo"]
         h = rms_norm(x, lp["ln_mlp"], cfg.norm_eps)
         x = x + (jax.nn.silu(h @ lp["w1"]) * (h @ lp["w3"])) @ lp["w2"]
