@@ -60,7 +60,7 @@ def child(model: str) -> None:
                 req = EngineRequest(request_id=f"b{i}-{max_tokens}",
                                     prompt_token_ids=prompt,
                                     max_tokens=max_tokens,
-                                    stop_token_ids=(-1,))
+                                    ignore_eos=True)
                 t0 = time.monotonic()
                 out = eng.submit(req)
                 first = None

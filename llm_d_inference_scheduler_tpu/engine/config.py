@@ -29,6 +29,12 @@ class EngineConfig:
     checkpoint_path: str = ""     # orbax dir; empty = random init (dev/bench)
     pallas_attention: bool = False  # Pallas paged-attention decode kernel (TPU)
     pallas_interpret: bool = False  # interpret the kernel (CPU testing only)
+    # KV cache event stream (ZMQ PUB) feeding the router's precise prefix
+    # scorer; 0 disables, -1 = port + 1000.
+    kv_events_port: int = -1
+
+    def resolved_kv_events_port(self) -> int:
+        return self.port + 1000 if self.kv_events_port == -1 else self.kv_events_port
 
     @property
     def model_config(self) -> ModelConfig:

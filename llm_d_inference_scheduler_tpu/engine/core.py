@@ -57,6 +57,7 @@ class _Slot:
     last_token: int
     first_emitted: bool = False
     aborted: bool = False
+    block_hashes: list[int] = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass
@@ -112,6 +113,17 @@ class TpuEngine:
         # by the aiohttp event-loop thread (kv_fetch / kv_release).
         self.kv_exports: dict[str, dict[str, Any]] = {}
         self._exports_lock = threading.Lock()
+        self.kv_events = None
+        self._last_kv_snapshot = 0.0
+        ev_port = cfg.resolved_kv_events_port()
+        if ev_port:
+            from .kv_events import KvEventPublisher
+
+            try:
+                self.kv_events = KvEventPublisher(ev_port, self.engine_id,
+                                                  host=cfg.host)
+            except Exception:
+                log.exception("kv-event publisher disabled (bind failed)")
         self._prefill_fns: dict[int, Any] = {}
         self._jit_decode = jax.jit(self._decode_impl, donate_argnums=(3, 4))
         self._jit_sample = jax.jit(sample_tokens)
@@ -152,6 +164,8 @@ class TpuEngine:
             self._cond.notify()
         if self._thread:
             self._thread.join(timeout=10)
+        if self.kv_events is not None:
+            self.kv_events.close()
 
     def submit(self, req: EngineRequest) -> asyncio.Queue:
         """Thread-safe enqueue; returns the per-request event queue."""
@@ -193,6 +207,14 @@ class TpuEngine:
         return min(b, self.cfg.max_model_len)
 
     def _run(self):
+        if self.kv_events is not None:
+            try:
+                # Bind here so the PUB socket lives on the thread that uses it
+                # AND subscribers can join long before the first real event.
+                self.kv_events.bind_now()
+            except Exception:
+                log.exception("kv event publisher bind failed; disabled")
+                self.kv_events = None
         while True:
             with self._cond:
                 while (not self._stop and not self._waiting and not self._import_ready
@@ -208,6 +230,7 @@ class TpuEngine:
 
     def _step(self):
         self._sweep_exports()
+        self._publish_kv_snapshot()
         self._process_aborts()
         self._process_imports()
         self._admit()
@@ -238,6 +261,25 @@ class TpuEngine:
                 request_id=pi.req.request_id, token_id=None,
                 finish_reason=FinishReason.ABORT,
                 prompt_tokens=len(pi.req.prompt_token_ids)))
+
+    def _publish_kv_snapshot(self):
+        """Periodically re-publish the block hashes of live slots.
+
+        ZMQ PUB/SUB has no retransmit: a `stored` event published before a
+        late-joining subscriber finishes its handshake is lost forever. The
+        snapshot (idempotent `stored` adds, 1s cadence) guarantees the
+        router's index converges regardless of join timing — the analogue of
+        the reference engines' continuous event stream.
+        """
+        if self.kv_events is None:
+            return
+        now = time.monotonic()
+        if now - self._last_kv_snapshot < 1.0:
+            return
+        self._last_kv_snapshot = now
+        hashes = [h for s in self.slots if s is not None for h in s.block_hashes]
+        if hashes:
+            self.kv_events.stored(hashes)
 
     def _sweep_exports(self):
         now = time.monotonic()
@@ -332,6 +374,12 @@ class TpuEngine:
 
         slot = _Slot(req=req, out=out, loop=loop, blocks=blocks,
                      position=len(prompt), generated=[tok], last_token=tok)
+        if self.kv_events is not None:
+            from ..utils.hashing import chain_block_hashes
+
+            slot.block_hashes = chain_block_hashes(
+                self.model_name, prompt, "", self.mcfg.kv_block_size)
+            self.kv_events.stored(slot.block_hashes)
         self.slots[idx] = slot
         self.telemetry.running.set(sum(s is not None for s in self.slots))
         self.telemetry.generation_tokens.inc()
@@ -461,6 +509,13 @@ class TpuEngine:
                     else headers["x-kv-first-token"])
         slot = _Slot(req=req, out=pi.out, loop=pi.loop, blocks=blocks,
                      position=seq_len, generated=[first], last_token=first)
+        if self.kv_events is not None:
+            from ..utils.hashing import chain_block_hashes
+
+            slot.block_hashes = chain_block_hashes(
+                self.model_name, req.prompt_token_ids[:seq_len], "",
+                self.mcfg.kv_block_size)
+            self.kv_events.stored(slot.block_hashes)
         self.slots[idx] = slot
         self.telemetry.running.set(sum(s is not None for s in self.slots))
         self.telemetry.ttft.observe(time.monotonic() - req.arrival_time)
@@ -506,7 +561,7 @@ class TpuEngine:
             s.generated.append(tok)
             s.last_token = tok
             self.telemetry.generation_tokens.inc()
-            if tok not in (set(s.req.stop_token_ids) | {self.tokenizer.eos_id}):
+            if tok not in self._stop_ids(s.req):
                 self._emit(s, TokenEvent(
                     request_id=s.req.request_id, token_id=tok,
                     text=self.tokenizer.decode([tok]), is_first=not s.first_emitted,
@@ -514,9 +569,15 @@ class TpuEngine:
                 s.first_emitted = True
             self._maybe_finish_after_token(i, tok)
 
+    def _stop_ids(self, req: EngineRequest) -> set[int]:
+        stop_ids = set(req.stop_token_ids)
+        if not req.ignore_eos:
+            stop_ids.add(self.tokenizer.eos_id)
+        return stop_ids
+
     def _maybe_finish_after_token(self, idx: int, tok: int):
         s = self.slots[idx]
-        stop_ids = set(s.req.stop_token_ids) | {self.tokenizer.eos_id}
+        stop_ids = self._stop_ids(s.req)
         reason = None
         if tok in stop_ids:
             reason = FinishReason.STOP
@@ -559,6 +620,8 @@ class TpuEngine:
             self.allocator.free(s.blocks)
             self.telemetry.kv_usage.set(self.allocator.used_fraction)
             self._cond.notify()  # capacity freed: wake admission
+        if self.kv_events is not None and s.block_hashes:
+            self.kv_events.removed(s.block_hashes)
         self.telemetry.running.set(sum(x is not None for x in self.slots))
         self.telemetry.request_success.labels(finished_reason=reason.value).inc()
         ev = TokenEvent(

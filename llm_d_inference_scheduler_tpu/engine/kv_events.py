@@ -1,0 +1,117 @@
+"""Engine-side KV cache event publishing.
+
+Replaces the reference's vLLM→router ZMQ KV-event stream (SURVEY §3.5: engine
+publishes block stored/removed events consumed by the router's precise prefix
+scorer via the llm-d-kv-cache indexer). Events carry xxhash chain block
+hashes computed with the same scheme the router uses (utils/hashing.py), so
+the router's index is token-exact.
+
+Two transports publish the same events:
+- ZMQ PUB (reference parity): topic-prefixed multipart
+  [b"kv-events", json{event, engine_id, hashes}].
+- HTTP SSE via the engine server's /kv_events route (EventHub below): the
+  default subscriber transport — in-process pyzmq PUB/SUB proved capable of
+  silently stalling subscription processing under load in this stack, while
+  the HTTP path shares the battle-tested server machinery.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+
+import zmq
+
+log = logging.getLogger("engine.kv_events")
+
+TOPIC = b"kv-events"
+
+
+class EventHub:
+    """Thread-safe fan-out of engine events to asyncio subscriber queues
+    (the engine thread pushes; the server loop streams via SSE)."""
+
+    def __init__(self, loop: asyncio.AbstractEventLoop):
+        self._loop = loop
+        self._subscribers: set[asyncio.Queue] = set()
+        self.pushed = 0       # diagnostics
+        self.delivered = 0
+
+    def subscribe(self) -> asyncio.Queue:
+        q: asyncio.Queue = asyncio.Queue(maxsize=10_000)
+        self._subscribers.add(q)
+        return q
+
+    def unsubscribe(self, q: asyncio.Queue) -> None:
+        self._subscribers.discard(q)
+
+    def push(self, event: dict) -> None:
+        """Callable from any thread."""
+        self.pushed += 1
+
+        def _deliver():
+            for q in list(self._subscribers):
+                try:
+                    q.put_nowait(event)
+                    self.delivered += 1
+                except asyncio.QueueFull:
+                    pass  # slow subscriber: drop (snapshots re-converge)
+
+        self._loop.call_soon_threadsafe(_deliver)
+
+
+class KvEventPublisher:
+    """ZMQ sockets are single-thread objects: the PUB socket is created
+    lazily on the FIRST publishing thread (the engine thread) — creating it
+    on the main thread and using it from the engine thread is undefined
+    behavior that manifests as some subscribers silently receiving nothing.
+    ``bind_now()`` exists for callers that publish from the construction
+    thread."""
+
+    def __init__(self, port: int, engine_id: str, host: str = "127.0.0.1"):
+        self.port = port
+        self.host = host
+        self.engine_id = engine_id
+        self._ctx = zmq.Context.instance()
+        self._sock: zmq.Socket | None = None
+        self._failed = False
+        self.hub: EventHub | None = None  # attached by the engine server
+
+    def bind_now(self) -> None:
+        if self._sock is None:
+            self._sock = self._ctx.socket(zmq.PUB)
+            self._sock.setsockopt(zmq.SNDHWM, 10_000)
+            self._sock.bind(f"tcp://{self.host}:{self.port}")
+
+    def publish(self, event: str, hashes: list[int]) -> None:
+        if not hashes:
+            return
+        doc = {"event": event, "engine_id": self.engine_id, "hashes": hashes}
+        if self.hub is not None:
+            self.hub.push(doc)
+        if self._failed:
+            return
+        if self._sock is None:
+            try:
+                self.bind_now()
+            except Exception:
+                log.exception("kv event publisher bind failed; disabled")
+                self._failed = True
+                return
+        try:
+            self._sock.send_multipart([TOPIC, json.dumps(doc).encode()],
+                                      flags=zmq.NOBLOCK)
+        except zmq.ZMQError:
+            log.debug("kv event dropped (HWM)")
+
+    def stored(self, hashes: list[int]) -> None:
+        self.publish("stored", hashes)
+
+    def removed(self, hashes: list[int]) -> None:
+        self.publish("removed", hashes)
+
+    def close(self) -> None:
+        if self._sock is not None:
+            self._sock.close(linger=0)
+            self._sock = None

@@ -24,6 +24,7 @@ class EngineRequest:
     top_k: int = 0            # 0 = disabled
     top_p: float = 1.0        # 1.0 = disabled
     stop_token_ids: tuple[int, ...] = ()
+    ignore_eos: bool = False   # benchmark/test knob (vLLM-compatible)
     stream: bool = False
     # P/D disaggregation handshake (mirrors the reference's kv_transfer_params
     # relay, /root/reference pkg/sidecar/proxy/connector_nixlv2.go:109-131):

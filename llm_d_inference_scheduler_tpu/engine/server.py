@@ -108,6 +108,7 @@ class EngineServer:
             web.get("/kv/{request_id}", self.kv_fetch),
             web.delete("/kv/{request_id}", self.kv_release),
             web.post("/v1/encode", self.encode),
+            web.get("/kv_events", self.kv_events_stream),
         ])
         # E/PD encode-primer store: request_id -> encoded multimodal items
         # (the reference reads these engine-side via an EC connector;
@@ -122,6 +123,12 @@ class EngineServer:
     # ---- lifecycle ----------------------------------------------------
 
     async def start(self):
+        # Attach the SSE event hub before the engine thread starts publishing.
+        pub = getattr(self.engine, "kv_events", None)
+        if pub is not None:
+            from .kv_events import EventHub
+
+            pub.hub = EventHub(asyncio.get_running_loop())
         await self.engine.start()
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
@@ -155,6 +162,7 @@ class EngineServer:
                 top_p=float(body.get("top_p") if body.get("top_p") is not None else 1.0),
                 stream=bool(body.get("stream", False)),
                 stop_token_ids=tuple(int(t) for t in (body.get("stop_token_ids") or ())),
+                ignore_eos=bool(body.get("ignore_eos", False)),
                 kv_transfer_params=body.get("kv_transfer_params"),
             )
         except (TypeError, ValueError) as e:
@@ -369,6 +377,31 @@ class EngineServer:
         rid = request.match_info["request_id"]
         self.engine.release_kv_export(rid)
         return web.json_response({"released": rid})
+
+    async def kv_events_stream(self, request: web.Request) -> web.StreamResponse:
+        """SSE stream of KV cache events (stored/removed block hashes) for the
+        router's precise prefix scorer — the HTTP transport of the engine's
+        event stream (see engine/kv_events.py)."""
+        pub = getattr(self.engine, "kv_events", None)
+        if pub is None or pub.hub is None:
+            raise web.HTTPNotImplemented(text="kv events disabled on this engine")
+        resp = web.StreamResponse(headers={"Content-Type": "text/event-stream",
+                                           "Cache-Control": "no-cache"})
+        await resp.prepare(request)
+        q = pub.hub.subscribe()
+        try:
+            while True:
+                try:
+                    doc = await asyncio.wait_for(q.get(), timeout=1.0)
+                except asyncio.TimeoutError:
+                    await resp.write(b": ping\n\n")  # heartbeat keeps reads alive
+                    continue
+                await resp.write(f"data: {json.dumps(doc)}\n\n".encode())
+        except (asyncio.CancelledError, ConnectionResetError):
+            pass
+        finally:
+            pub.hub.unsubscribe(q)
+        return resp
 
     async def encode(self, request: web.Request) -> web.Response:
         """E/PD encoder-primer endpoint: accept multimodal items and stage

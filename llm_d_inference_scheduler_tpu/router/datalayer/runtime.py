@@ -89,7 +89,7 @@ class DataLayerRuntime:
                 self._start_collector(ep)
             for p in self.lifecycle_plugins:
                 try:
-                    p.endpoint_added(ep)
+                    getattr(p, "endpoint_added", lambda _ep: None)(ep)
                 except Exception:
                     log.exception("lifecycle plugin failure (add)")
         elif event == "removed":
@@ -98,7 +98,7 @@ class DataLayerRuntime:
                 c.stop()
             for p in self.lifecycle_plugins:
                 try:
-                    p.endpoint_removed(ep)
+                    getattr(p, "endpoint_removed", lambda _ep: None)(ep)
                 except Exception:
                     log.exception("lifecycle plugin failure (remove)")
 

@@ -378,6 +378,11 @@ def build_gateway(config_text: str | None, *, host: str = "127.0.0.1",
     import llm_d_inference_scheduler_tpu.router.plugins.saturation  # noqa: F401
     import llm_d_inference_scheduler_tpu.router.requestcontrol.producers  # noqa: F401
     cfg = load_config(config_text, handle)
+    # Endpoint lifecycle plugins (per-pod subscribers, LRU teardown — the
+    # reference's EndpointExtractors, runtime.go:361) ride datastore events.
+    for plugin in cfg.plugins_by_name.values():
+        if hasattr(plugin, "endpoint_added") or hasattr(plugin, "endpoint_removed"):
+            dl_runtime.register_lifecycle(plugin)
     return Gateway(cfg, datastore, dl_runtime, host=host, port=port)
 
 

@@ -4,7 +4,16 @@ Importing this package registers every in-tree plugin type with the global
 registry; the config loader instantiates them by type name.
 """
 
-from . import filters, scorers, pickers, profile_handlers, disagg, saturation, reporter  # noqa: F401
+from . import (  # noqa: F401
+    disagg,
+    filters,
+    pickers,
+    precise_prefix,
+    profile_handlers,
+    reporter,
+    saturation,
+    scorers,
+)
 
 from .attributes import PrefixCacheMatchInfo, PREFIX_ATTRIBUTE_KEY, INFLIGHT_ATTRIBUTE_KEY
 

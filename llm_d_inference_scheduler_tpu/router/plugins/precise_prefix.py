@@ -1,0 +1,248 @@
+"""precise-prefix-cache-scorer: token-exact KV block index fed by engine
+cache events.
+
+Mirrors the reference's preciseprefixcache scorer
+(/root/reference/pkg/epp/framework/plugins/scheduling/scorer/
+preciseprefixcache/precise_prefix_cache.go:34-853): an exact KV-block index
+built from engine KV events over ZMQ; block keys derive from the tokenized
+prompt; speculative entries with TTL cover the routing→event blind spot; the
+EndpointLifecycle hooks tear per-pod subscribers up and down.
+
+Engine side: engine/kv_events.py publishes stored/removed block-hash events
+on tcp://<pod>:<port+1000> using the shared hash chain (utils/hashing.py).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from typing import Any
+
+import zmq
+
+from ...utils.hashing import chain_block_hashes
+from ..framework.datalayer import Endpoint
+from ..framework.plugin import PluginBase, register_plugin
+from ..framework.scheduling import InferenceRequest, SchedulingResult
+
+log = logging.getLogger("router.precise_prefix")
+
+TOPIC = b"kv-events"
+SPECULATIVE_TTL_S = 10.0
+
+
+class KvBlockIndex:
+    """(pod, hash) → expiry index with TTL'd speculative entries.
+
+    Confirmed entries also carry a TTL (renewed by the engines' 1s snapshot
+    re-publication): a lost 'removed' event — dropped SSE frame, subscriber
+    reconnect, HWM drop — then self-heals within CONFIRMED_TTL_S instead of
+    poisoning routing forever. Thread-safe: written by subscriber threads,
+    read by the scheduler on the event loop.
+    """
+
+    CONFIRMED_TTL_S = 10.0  # several snapshot periods
+
+    def __init__(self):
+        self._by_pod: dict[str, dict[int, float]] = {}  # hash -> expiry
+        self._speculative: dict[tuple[str, int], float] = {}  # -> expiry
+        self._lock = threading.Lock()
+
+    def add(self, pod: str, hashes: list[int]) -> None:
+        expiry = time.monotonic() + self.CONFIRMED_TTL_S
+        with self._lock:
+            entries = self._by_pod.setdefault(pod, {})
+            for h in hashes:
+                entries[h] = expiry
+                self._speculative.pop((pod, h), None)  # confirmed
+
+    def remove(self, pod: str, hashes: list[int]) -> None:
+        with self._lock:
+            entries = self._by_pod.get(pod, {})
+            for h in hashes:
+                entries.pop(h, None)
+
+    def add_speculative(self, pod: str, hashes: list[int]) -> None:
+        expiry = time.monotonic() + SPECULATIVE_TTL_S
+        with self._lock:
+            for h in hashes:
+                self._speculative[(pod, h)] = expiry
+
+    def holds(self, pod: str, h: int) -> bool:
+        now = time.monotonic()
+        with self._lock:
+            exp = self._by_pod.get(pod, {}).get(h)
+            if exp is not None:
+                if exp > now:
+                    return True
+                self._by_pod[pod].pop(h, None)
+            exp = self._speculative.get((pod, h))
+            if exp is not None:
+                if exp > now:
+                    return True
+                self._speculative.pop((pod, h), None)
+            return False
+
+    def drop_pod(self, pod: str) -> None:
+        with self._lock:
+            self._by_pod.pop(pod, None)
+            self._speculative = {k: v for k, v in self._speculative.items()
+                                 if k[0] != pod}
+
+    def pod_block_count(self, pod: str) -> int:
+        now = time.monotonic()
+        with self._lock:
+            entries = self._by_pod.get(pod, {})
+            return sum(1 for exp in entries.values() if exp > now)
+
+
+@register_plugin("precise-prefix-cache-scorer")
+class PrecisePrefixCacheScorer(PluginBase):
+    def __init__(self, name: str | None = None):
+        super().__init__(name)
+        self.index = KvBlockIndex()
+        self.block_size_tokens = 16
+        self.events_port_offset = 1000
+        self.transport = "http"  # "http" (SSE, default) | "zmq"
+        # One sync SUB per pod, each on its own thread. Deliberately NOT
+        # zmq.asyncio: asyncio SUB sockets in this stack intermittently never
+        # woke for delivered messages (the same wire traffic was visible to a
+        # sync socket); a blocking recv loop with RCVTIMEO is boring and
+        # reliable, and the index is lock-protected for cross-thread reads.
+        self._subs: dict[str, tuple[threading.Thread, threading.Event]] = {}
+
+    def configure(self, params: dict[str, Any], handle: Any) -> None:
+        self.block_size_tokens = int(params.get("blockSizeTokens",
+                                                self.block_size_tokens))
+        self.events_port_offset = int(params.get("eventsPortOffset",
+                                                 self.events_port_offset))
+        self.transport = params.get("transport", self.transport)
+
+    # ---- scoring -------------------------------------------------------
+
+    def consumes(self) -> list[str]:
+        return ["request/tokenized"]
+
+    def _hashes(self, request: InferenceRequest, block_size: int) -> list[int]:
+        return chain_block_hashes(request.target_model,
+                                  request.body.tokenized_prompt,
+                                  request.body.prompt_text(), block_size)
+
+    def score(self, ctx, state, request, endpoints):
+        out: dict[str, float] = {}
+        hashes_by_bs: dict[int, list[int]] = {}  # hashing once per block size
+        for ep in endpoints:
+            bs = ep.metrics.cache_block_size or self.block_size_tokens
+            hashes = hashes_by_bs.setdefault(bs, self._hashes(request, bs))
+            pod = ep.metadata.address_port
+            match = 0
+            for h in hashes:
+                if self.index.holds(pod, h):
+                    match += 1
+                else:
+                    break  # consecutive-prefix requirement
+            out[pod] = match / len(hashes) if hashes else 0.0
+        return out
+
+    def pre_request(self, ctx, request: InferenceRequest,
+                    result: SchedulingResult) -> None:
+        # Speculative indexing: the chosen pod will hold these blocks once the
+        # engine commits them; cover the blind spot with a TTL'd entry.
+        for ep in result.primary().target_endpoints[:1]:
+            bs = ep.metrics.cache_block_size or self.block_size_tokens
+            self.index.add_speculative(ep.metadata.address_port,
+                                       self._hashes(request, bs))
+
+    # ---- endpoint lifecycle: ZMQ subscriber per pod --------------------
+
+    def endpoint_added(self, ep: Endpoint) -> None:
+        pod = ep.metadata.address_port
+        if pod in self._subs:
+            return
+        if self.transport == "zmq":
+            # Engines bind serving-port + offset (config.resolved_kv_events_port)
+            # — NOT the metrics port.
+            port = ep.metadata.port + self.events_port_offset
+            url = f"tcp://{ep.metadata.address}:{port}"
+        else:
+            url = ep.metadata.url + "/kv_events"
+        stop = threading.Event()
+        target = self._subscribe if self.transport == "zmq" else self._subscribe_http
+        thread = threading.Thread(target=target, args=(pod, url, stop),
+                                  name=f"kv-sub-{pod}", daemon=True)
+        self._subs[pod] = (thread, stop)
+        thread.start()
+
+    def endpoint_removed(self, ep: Endpoint) -> None:
+        pod = ep.metadata.address_port
+        sub = self._subs.pop(pod, None)
+        if sub:
+            sub[1].set()
+        self.index.drop_pod(pod)
+
+    def shutdown(self) -> None:
+        for _, stop in self._subs.values():
+            stop.set()
+        self._subs.clear()
+
+    def _handle_event(self, pod: str, msg: dict) -> None:
+        hashes = [int(h) for h in msg.get("hashes", [])]
+        if msg.get("event") == "stored":
+            self.index.add(pod, hashes)
+        elif msg.get("event") == "removed":
+            self.index.remove(pod, hashes)
+
+    def _subscribe_http(self, pod: str, url: str, stop: threading.Event) -> None:
+        """SSE subscriber (default transport) with reconnect."""
+        import httpx
+
+        log.info("kv-event SSE subscriber for %s at %s", pod, url)
+        while not stop.is_set():
+            try:
+                with httpx.Client(timeout=httpx.Timeout(5.0, read=5.0)) as client:
+                    with client.stream("GET", url) as r:
+                        if r.status_code != 200:
+                            raise ConnectionError(f"status {r.status_code}")
+                        buf = ""
+                        for chunk in r.iter_text():
+                            if stop.is_set():
+                                return
+                            buf += chunk
+                            while "\n\n" in buf:
+                                frame, buf = buf.split("\n\n", 1)
+                                for line in frame.splitlines():
+                                    if line.startswith("data: "):
+                                        try:
+                                            self._handle_event(pod,
+                                                               json.loads(line[6:]))
+                                        except Exception:
+                                            log.debug("bad kv event from %s", pod)
+            except Exception:
+                # read timeouts double as stop-flag checks; reconnect otherwise
+                if stop.is_set():
+                    return
+                stop.wait(1.0)
+
+    def _subscribe(self, pod: str, url: str, stop: threading.Event) -> None:
+        ctx = zmq.Context.instance()
+        sock = ctx.socket(zmq.SUB)
+        sock.setsockopt(zmq.SUBSCRIBE, TOPIC)
+        sock.setsockopt(zmq.RCVHWM, 10_000)
+        sock.setsockopt(zmq.RCVTIMEO, 500)  # wake to check the stop flag
+        sock.connect(url)
+        log.info("kv-event subscriber for %s at %s", pod, url)
+        try:
+            while not stop.is_set():
+                try:
+                    _, payload = sock.recv_multipart()
+                    msg = json.loads(payload)
+                except zmq.Again:
+                    continue
+                except Exception:
+                    log.debug("bad kv event from %s", pod)
+                    continue
+                self._handle_event(pod, msg)
+        finally:
+            sock.close(linger=0)

@@ -15,23 +15,21 @@ from __future__ import annotations
 from collections import OrderedDict
 from typing import Any
 
-import xxhash
-
+from ...utils.hashing import chain_block_hashes
 from ..framework.datalayer import Endpoint
 from ..framework.plugin import PluginBase, register_plugin
 from ..framework.scheduling import InferenceRequest, SchedulingResult
 from ..metrics import PREFIX_HIT_RATIO
 from ..plugins.attributes import (
-    AVG_CHARS_PER_TOKEN,
     INFLIGHT_ATTRIBUTE_KEY,
     PREFIX_ATTRIBUTE_KEY,
     InFlightLoad,
     PrefixCacheMatchInfo,
     estimate_input_tokens,
 )
+
 DEFAULT_BLOCK_SIZE_TOKENS = 16
 DEFAULT_LRU_CAPACITY = 4096
-MAX_PREFIX_BLOCKS = 128
 
 
 class _PodLru:
@@ -55,33 +53,6 @@ class _PodLru:
 
     def __len__(self):
         return len(self._od)
-
-
-def chain_block_hashes(model: str, token_ids: list[int] | None, text: str,
-                       block_size_tokens: int) -> list[int]:
-    """xxhash chain over prompt blocks: h_i = xxh64(h_{i-1} || block_i)
-    (reference approximateprefix/hashing.go:35-101)."""
-    h = xxhash.xxh64(model.encode()).intdigest()
-    out = []
-    if token_ids:
-        blocks = [token_ids[i:i + block_size_tokens]
-                  for i in range(0, len(token_ids), block_size_tokens)]
-        # only complete blocks participate in matching
-        blocks = [b for b in blocks if len(b) == block_size_tokens]
-        for b in blocks[:MAX_PREFIX_BLOCKS]:
-            data = h.to_bytes(8, "little") + b"".join(
-                t.to_bytes(4, "little", signed=False) for t in b)
-            h = xxhash.xxh64(data).intdigest()
-            out.append(h)
-    else:
-        step = block_size_tokens * AVG_CHARS_PER_TOKEN
-        raw = text.encode()
-        chunks = [raw[i:i + step] for i in range(0, len(raw), step)]
-        chunks = [c for c in chunks if len(c) == step]
-        for c in chunks[:MAX_PREFIX_BLOCKS]:
-            h = xxhash.xxh64(h.to_bytes(8, "little") + c).intdigest()
-            out.append(h)
-    return out
 
 
 @register_plugin("approx-prefix-cache-producer", "prefix-cache-producer")

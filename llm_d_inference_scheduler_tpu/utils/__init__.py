@@ -1,0 +1,3 @@
+from .hashing import chain_block_hashes
+
+__all__ = ["chain_block_hashes"]

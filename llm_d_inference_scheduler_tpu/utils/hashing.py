@@ -1,0 +1,38 @@
+"""Prefix-block hash chain shared by the router index and the engine's KV
+event stream — both sides MUST hash identically or precise prefix scores are
+garbage (SURVEY §7 "hard parts": block hashing must match the engine's).
+
+Scheme (reference approximateprefix/hashing.go:35-101): h_0 = xxh64(model);
+h_i = xxh64(h_{i-1} || block_i) over complete blocks only.
+"""
+
+from __future__ import annotations
+
+import xxhash
+
+AVG_CHARS_PER_TOKEN = 4
+MAX_PREFIX_BLOCKS = 128
+
+
+def chain_block_hashes(model: str, token_ids: list[int] | None, text: str,
+                       block_size_tokens: int) -> list[int]:
+    h = xxhash.xxh64(model.encode()).intdigest()
+    out: list[int] = []
+    if token_ids:
+        blocks = [token_ids[i:i + block_size_tokens]
+                  for i in range(0, len(token_ids), block_size_tokens)]
+        blocks = [b for b in blocks if len(b) == block_size_tokens]
+        for b in blocks[:MAX_PREFIX_BLOCKS]:
+            data = h.to_bytes(8, "little") + b"".join(
+                t.to_bytes(4, "little", signed=False) for t in b)
+            h = xxhash.xxh64(data).intdigest()
+            out.append(h)
+    else:
+        step = block_size_tokens * AVG_CHARS_PER_TOKEN
+        raw = text.encode()
+        chunks = [raw[i:i + step] for i in range(0, len(raw), step)]
+        chunks = [c for c in chunks if len(c) == step]
+        for c in chunks[:MAX_PREFIX_BLOCKS]:
+            h = xxhash.xxh64(h.to_bytes(8, "little") + c).intdigest()
+            out.append(h)
+    return out

@@ -1,0 +1,184 @@
+"""Precise prefix cache: engine KV events over ZMQ → router exact-block index."""
+
+import asyncio
+import json
+
+import httpx
+import zmq
+
+
+from llm_d_inference_scheduler_tpu.engine import EngineConfig, EngineRequest
+from llm_d_inference_scheduler_tpu.engine.core import TpuEngine
+from llm_d_inference_scheduler_tpu.router.gateway import build_gateway
+from llm_d_inference_scheduler_tpu.router.plugins.precise_prefix import KvBlockIndex
+from llm_d_inference_scheduler_tpu.utils.hashing import chain_block_hashes
+
+
+def test_engine_publishes_stored_and_removed_events():
+    async def body():
+        cfg = EngineConfig(model="tiny", backend="tpu", max_batch=2,
+                           max_model_len=128, port=18510, kv_events_port=18520)
+        eng = TpuEngine(cfg)
+
+        events = []
+
+        def listen():
+            sock = zmq.Context.instance().socket(zmq.SUB)
+            sock.setsockopt(zmq.SUBSCRIBE, b"kv-events")
+            sock.setsockopt(zmq.RCVTIMEO, 500)
+            sock.connect("tcp://127.0.0.1:18520")
+            import time
+            deadline = time.monotonic() + 30
+            try:
+                while time.monotonic() < deadline:
+                    try:
+                        _, payload = sock.recv_multipart()
+                    except zmq.Again:
+                        continue
+                    events.append(json.loads(payload))
+                    if events[-1]["event"] == "removed":
+                        return
+            finally:
+                sock.close(linger=0)
+
+        import threading
+        t = threading.Thread(target=listen, daemon=True)
+        t.start()
+        await asyncio.sleep(0.3)  # late-joiner settle
+
+        await eng.start()
+        try:
+            prompt = [1] + list(range(10, 41))  # 32 tokens = 2 full blocks
+            out = eng.submit(EngineRequest(request_id="r", prompt_token_ids=prompt,
+                                           max_tokens=2, stop_token_ids=(-1,)))
+            while True:
+                ev = await asyncio.wait_for(out.get(), timeout=60)
+                if ev.finish_reason is not None:
+                    break
+            await asyncio.get_running_loop().run_in_executor(None, t.join, 30)
+            expect = chain_block_hashes("tiny", prompt, "", 16)
+            assert len(expect) == 2
+            stored = [e for e in events if e["event"] == "stored"]
+            removed = [e for e in events if e["event"] == "removed"]
+            assert stored and removed, events
+            assert expect == stored[0]["hashes"][:2] or set(expect) <= set(
+                h for e in stored for h in e["hashes"])
+            assert set(expect) <= set(removed[0]["hashes"])
+        finally:
+            await eng.stop()
+
+    asyncio.run(body())
+
+
+def test_kv_block_index_semantics():
+    idx = KvBlockIndex()
+    idx.add("a", [1, 2, 3])
+    idx.add("b", [1])
+    assert idx.holds("a", 2) and idx.holds("b", 1) and not idx.holds("b", 2)
+    idx.remove("a", [2])
+    assert not idx.holds("a", 2) and idx.holds("a", 3)
+    idx.add_speculative("c", [9])
+    assert idx.holds("c", 9)  # within TTL
+    idx.drop_pod("a")
+    assert not idx.holds("a", 1) and idx.holds("b", 1)
+
+
+def test_precise_scorer_e2e_steers_to_warm_pod():
+    cfg_yaml = """
+plugins:
+  - {type: token-producer}
+  - {type: precise-prefix-cache-scorer}
+  - {type: queue-scorer}
+schedulingProfiles:
+  - name: default
+    plugins:
+      - {pluginRef: precise-prefix-cache-scorer, weight: 5}
+      - {pluginRef: queue-scorer}
+pool:
+  endpoints:
+    - {address: 127.0.0.1, port: 18511}
+    - {address: 127.0.0.1, port: 18512}
+"""
+
+    async def body():
+        from llm_d_inference_scheduler_tpu.engine.server import EngineServer
+
+        engines = [EngineServer(EngineConfig(
+            model="tiny", backend="tpu", max_batch=2, max_model_len=512,
+            port=p, kv_events_port=p + 1000)) for p in (18511, 18512)]
+        for e in engines:
+            await e.start()
+        gw = build_gateway(cfg_yaml, port=18513, poll_interval=0.02)
+        await gw.start()
+        try:
+            scorer = gw.cfg.plugins_by_name["precise-prefix-cache-scorer"]
+            await asyncio.sleep(0.3)  # let SUB sockets connect
+            prompt = "warm cache target prompt " * 8  # > 2 token blocks
+            async with httpx.AsyncClient(timeout=60) as c:
+                # Long-running request holds its blocks; events land while it
+                # decodes (blocks free → 'removed' when it finishes, matching
+                # this engine's no-retention cache lifecycle).
+                long_req = asyncio.create_task(c.post(
+                    "http://127.0.0.1:18513/v1/completions",
+                    json={"model": "tiny", "prompt": prompt, "max_tokens": 80,
+                          "ignore_eos": True}))
+                first_pod = None
+                for _ in range(900):  # generous: first jit compiles serialize here
+                    await asyncio.sleep(0.05)
+                    for pod in ("127.0.0.1:18511", "127.0.0.1:18512"):
+                        if scorer.index.pod_block_count(pod) > 0:
+                            first_pod = pod
+                            break
+                    if first_pod:
+                        break
+                if first_pod is None:
+                    diags = {
+                        "long_req_done": long_req.done(),
+                        "hub_subs": [len(e.engine.kv_events.hub._subscribers)
+                                     if e.engine.kv_events and e.engine.kv_events.hub
+                                     else -1 for e in engines],
+                        "hub_pushed": [e.engine.kv_events.hub.pushed
+                                       if e.engine.kv_events and e.engine.kv_events.hub
+                                       else -1 for e in engines],
+                        "hub_delivered": [e.engine.kv_events.hub.delivered
+                                          if e.engine.kv_events and e.engine.kv_events.hub
+                                          else -1 for e in engines],
+                        "pub_bound": [e.engine.kv_events is not None
+                                      and e.engine.kv_events._sock is not None
+                                      for e in engines],
+                        "slots": [[s is not None for s in e.engine.slots]
+                                  for e in engines],
+                        "prompt_tokens": [
+                            e.engine.telemetry.prompt_tokens._value.get()
+                            for e in engines],
+                    }
+                    raise AssertionError(f"no kv events reached the index: {diags}")
+
+                # While the index holds the pod's blocks, scoring the same
+                # prompt must prefer that pod with a full prefix hit. (Routing
+                # stickiness end-to-end is racy against request lifetime on
+                # slow CI hosts; the approx-prefix e2e covers it. Here we
+                # assert the exact-index scoring signal itself.)
+                from llm_d_inference_scheduler_tpu.router.framework.scheduling import (
+                    InferenceRequest, InferenceRequestBody)
+
+                tok_ids = engines[0].engine.tokenizer.encode(prompt)
+                ireq = InferenceRequest(
+                    request_id="probe", target_model="tiny",
+                    body=InferenceRequestBody(
+                        completions={"model": "tiny", "prompt": prompt},
+                        tokenized_prompt=tok_ids))
+                eps = gw.datastore.endpoint_list()
+                scores = scorer.score(None, None, ireq, eps)
+                other = [p for p in ("127.0.0.1:18511", "127.0.0.1:18512")
+                         if p != first_pod][0]
+                assert scores[first_pod] > 0.9, scores
+                assert scores[other] == 0.0, scores
+                r1 = await long_req
+                assert r1.headers["x-gateway-destination-endpoint-served"] == first_pod
+        finally:
+            await gw.stop()
+            for e in engines:
+                await e.stop()
+
+    asyncio.run(body())
