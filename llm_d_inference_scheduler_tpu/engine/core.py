@@ -34,7 +34,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models import llama
-from .blocks import BlockAllocator
+from ..utils.hashing import chain_block_hashes
+from .blocks import BlockAllocator, PrefixCachingAllocator
 from .config import EngineConfig
 from .request import EngineRequest, FinishReason, TokenEvent
 from .sampling import sample_tokens
@@ -57,6 +58,7 @@ class _Slot:
     last_token: int
     first_emitted: bool = False
     aborted: bool = False
+    cached_tokens: int = 0
     block_hashes: list[int] = dataclasses.field(default_factory=list)
 
 
@@ -83,7 +85,9 @@ class TpuEngine:
         block = self.mcfg.kv_block_size
         self.n_blocks = max(cfg.num_kv_blocks(), 2)  # ≥ trash + 1 usable
         self.max_blocks_per_seq = -(-cfg.max_model_len // block)
-        self.allocator = BlockAllocator(self.n_blocks, block)
+        self.allocator = (PrefixCachingAllocator(self.n_blocks, block)
+                          if cfg.enable_prefix_caching
+                          else BlockAllocator(self.n_blocks, block))
         self.telemetry = EngineTelemetry(block_size=block, num_blocks=self.n_blocks)
 
         if params is not None:
@@ -151,6 +155,19 @@ class TpuEngine:
                 return last, k_pages, v_pages
             self._prefill_fns[bucket] = jax.jit(impl, donate_argnums=(3, 4))
         return self._prefill_fns[bucket]
+
+    def _prefix_prefill_fn(self, suffix_bucket: int, prefix_bucket: int):
+        """Jitted prefill continuing from cached prefix KV, keyed on
+        (suffix, prefix) pow2 buckets so a hit costs O(prefix)."""
+        key = ("prefix", suffix_bucket, prefix_bucket)
+        if key not in self._prefill_fns:
+            def impl(params, tokens, suffix_len, prefix_len, k_pages, v_pages,
+                     block_table_row, prior_table_row):
+                return llama.prefill_with_prefix(
+                    params, self.mcfg, tokens, suffix_len, prefix_len,
+                    k_pages, v_pages, block_table_row, prior_table_row)
+            self._prefill_fns[key] = jax.jit(impl, donate_argnums=(4, 5))
+        return self._prefill_fns[key]
 
     # ---- public API (event-loop side) ---------------------------------
 
@@ -277,7 +294,13 @@ class TpuEngine:
         if now - self._last_kv_snapshot < 1.0:
             return
         self._last_kv_snapshot = now
-        hashes = [h for s in self.slots if s is not None for h in s.block_hashes]
+        if isinstance(self.allocator, PrefixCachingAllocator):
+            # With prefix caching the content-addressed map IS the cache state
+            # (active + parked reusable blocks).
+            hashes = self.allocator.cached_hashes()
+        else:
+            hashes = [h for s in self.slots if s is not None
+                      for h in s.block_hashes]
         if hashes:
             self.kv_events.stored(hashes)
 
@@ -345,40 +368,72 @@ class TpuEngine:
                     self.telemetry.waiting.set(len(self._waiting))
                     self._start_kv_fetch(req, out, loop)
                     continue
-                if need > self.allocator.free_blocks:
+                available = getattr(self.allocator, "reusable_blocks",
+                                    self.allocator.free_blocks)
+                if need > available:
                     break  # head-of-line waits for capacity
                 self._waiting.pop(0)
                 self.telemetry.waiting.set(len(self._waiting))
-                blocks = self.allocator.alloc(need)
-                self.telemetry.kv_usage.set(self.allocator.used_fraction)
-            self._prefill_into_slot(i, req, out, loop, blocks)
+            self._prefill_into_slot(i, req, out, loop, need)
 
     # ---- prefill -------------------------------------------------------
 
-    def _prefill_into_slot(self, idx, req, out, loop, blocks):
+    def _prefill_into_slot(self, idx, req, out, loop, need: int):
         prompt = req.prompt_token_ids[: self.cfg.max_model_len - 1]
-        bucket = self._bucket(len(prompt))
-        tokens = np.zeros((1, bucket), np.int32)
-        tokens[0, : len(prompt)] = prompt
+        block = self.mcfg.kv_block_size
+        caching_enabled = isinstance(self.allocator, PrefixCachingAllocator)
+        hashes = (chain_block_hashes(self.model_name, prompt, "", block)
+                  if caching_enabled or self.kv_events is not None else [])
+
+        # Automatic prefix caching: reuse the longest cached run of complete
+        # prompt blocks (keeping ≥1 suffix token so logits can be computed).
+        matched_bids: list[int] = []
+        caching = caching_enabled
+        with self._cond:
+            if caching and hashes:
+                max_match = (len(prompt) - 1) // block
+                matched_bids = self.allocator.match_prefix(hashes)[:max_match]
+                self.allocator.acquire_cached(matched_bids)
+            new_bids = self.allocator.alloc(need - len(matched_bids))
+            evicted = list(getattr(self.allocator, "last_evicted_hashes", []))
+            blocks = matched_bids + new_bids
+            self.telemetry.kv_usage.set(self.allocator.used_fraction)
+        if evicted and self.kv_events is not None:
+            self.kv_events.removed(evicted)
+
+        cached_tokens = len(matched_bids) * block
+        suffix = prompt[cached_tokens:]
         row = np.zeros((1, self.max_blocks_per_seq), np.int32)
         row[0, : len(blocks)] = blocks
 
-        fn = self._prefill_fn(bucket)
-        seq_len = jnp.asarray([len(prompt)], jnp.int32)
-        logits, self.k_pages, self.v_pages = fn(
-            self.params, jnp.asarray(tokens), seq_len, self.k_pages, self.v_pages,
-            jnp.asarray(row))
-        tok = int(self._sample(logits, [req])[0])
-        self.telemetry.prompt_tokens.inc(len(prompt))
+        try:
+            tok = self._run_prefill_compute(req, prompt, suffix, cached_tokens,
+                                            matched_bids, row)
+        except Exception:
+            with self._cond:
+                self.allocator.free(blocks)
+                self.telemetry.kv_usage.set(self.allocator.used_fraction)
+            self._emit_to(out, loop, TokenEvent(
+                request_id=req.request_id, token_id=None,
+                finish_reason=FinishReason.ABORT,
+                prompt_tokens=len(prompt)))
+            raise
+
+        self.telemetry.prompt_tokens.inc(len(suffix))
         self.telemetry.ttft.observe(time.monotonic() - req.arrival_time)
 
         slot = _Slot(req=req, out=out, loop=loop, blocks=blocks,
-                     position=len(prompt), generated=[tok], last_token=tok)
-        if self.kv_events is not None:
-            from ..utils.hashing import chain_block_hashes
-
-            slot.block_hashes = chain_block_hashes(
-                self.model_name, prompt, "", self.mcfg.kv_block_size)
+                     position=len(prompt), generated=[tok], last_token=tok,
+                     cached_tokens=cached_tokens)
+        n_complete = len(prompt) // block
+        if caching:
+            # Content-address the freshly computed complete prompt blocks.
+            with self._cond:
+                self.allocator.commit_hashes(
+                    blocks[len(matched_bids):n_complete],
+                    hashes[len(matched_bids):n_complete])
+        slot.block_hashes = hashes[:n_complete]
+        if self.kv_events is not None and slot.block_hashes:
             self.kv_events.stored(slot.block_hashes)
         self.slots[idx] = slot
         self.telemetry.running.set(sum(s is not None for s in self.slots))
@@ -393,9 +448,41 @@ class TpuEngine:
         self._emit(slot, TokenEvent(
             request_id=req.request_id, token_id=tok,
             text=self.tokenizer.decode([tok]), is_first=True,
-            prompt_tokens=len(prompt), completion_tokens=1))
+            prompt_tokens=len(prompt), completion_tokens=1,
+            cached_tokens=cached_tokens))
         slot.first_emitted = True
         self._maybe_finish_after_token(idx, tok)
+
+    def _run_prefill_compute(self, req, prompt, suffix, cached_tokens,
+                             matched_bids, row) -> int:
+        if matched_bids:
+            bucket = self._bucket(len(suffix))
+            prefix_bucket = 1
+            while prefix_bucket < len(matched_bids):
+                prefix_bucket *= 2
+            prefix_bucket = min(prefix_bucket, self.max_blocks_per_seq)
+            prior = np.zeros((1, prefix_bucket), np.int32)  # padding → trash
+            prior[0, : len(matched_bids)] = matched_bids
+            tokens = np.zeros((1, bucket), np.int32)
+            tokens[0, : len(suffix)] = suffix
+            fn = self._prefix_prefill_fn(bucket, prefix_bucket)
+            logits, self.k_pages, self.v_pages = fn(
+                self.params, jnp.asarray(tokens),
+                jnp.asarray([len(suffix)], jnp.int32),
+                jnp.asarray([cached_tokens], jnp.int32),
+                self.k_pages, self.v_pages, jnp.asarray(row),
+                jnp.asarray(prior))
+            self.telemetry.prefix_cached_tokens.inc(cached_tokens)
+        else:
+            bucket = self._bucket(len(prompt))
+            tokens = np.zeros((1, bucket), np.int32)
+            tokens[0, : len(prompt)] = prompt
+            fn = self._prefill_fn(bucket)
+            logits, self.k_pages, self.v_pages = fn(
+                self.params, jnp.asarray(tokens),
+                jnp.asarray([len(prompt)], jnp.int32),
+                self.k_pages, self.v_pages, jnp.asarray(row))
+        return int(self._sample(logits, [req])[0])
 
     # ---- P/D import (decode side) --------------------------------------
 
@@ -435,13 +522,20 @@ class TpuEngine:
                     return
                 pi = self._import_ready[0]
                 blocks: list[int] = []
+                evicted: list[int] = []
                 if pi.error is None:
                     need = self._blocks_needed(pi.req)
-                    if need > self.allocator.free_blocks:
+                    available = getattr(self.allocator, "reusable_blocks",
+                                        self.allocator.free_blocks)
+                    if need > available:
                         return  # wait for capacity
                     blocks = self.allocator.alloc(need)
+                    evicted = list(getattr(self.allocator,
+                                           "last_evicted_hashes", []))
                     self.telemetry.kv_usage.set(self.allocator.used_fraction)
                 self._import_ready.pop(0)
+            if evicted and self.kv_events is not None:
+                self.kv_events.removed(evicted)
             if pi.error is None:
                 try:
                     self._import_into_slot(free[0], pi, blocks)
@@ -508,13 +602,18 @@ class TpuEngine:
                     if ktp.get("remote_first_token") is not None
                     else headers["x-kv-first-token"])
         slot = _Slot(req=req, out=pi.out, loop=pi.loop, blocks=blocks,
-                     position=seq_len, generated=[first], last_token=first)
-        if self.kv_events is not None:
-            from ..utils.hashing import chain_block_hashes
-
-            slot.block_hashes = chain_block_hashes(
-                self.model_name, req.prompt_token_ids[:seq_len], "",
-                self.mcfg.kv_block_size)
+                     position=seq_len, generated=[first], last_token=first,
+                     cached_tokens=seq_len)
+        hashes = chain_block_hashes(self.model_name,
+                                    req.prompt_token_ids[:seq_len], "",
+                                    self.mcfg.kv_block_size)
+        n_complete = seq_len // self.mcfg.kv_block_size
+        slot.block_hashes = hashes[:n_complete]
+        if isinstance(self.allocator, PrefixCachingAllocator):
+            with self._cond:
+                self.allocator.commit_hashes(blocks[:n_complete],
+                                             hashes[:n_complete])
+        if self.kv_events is not None and slot.block_hashes:
             self.kv_events.stored(slot.block_hashes)
         self.slots[idx] = slot
         self.telemetry.running.set(sum(s is not None for s in self.slots))
@@ -620,7 +719,10 @@ class TpuEngine:
             self.allocator.free(s.blocks)
             self.telemetry.kv_usage.set(self.allocator.used_fraction)
             self._cond.notify()  # capacity freed: wake admission
-        if self.kv_events is not None and s.block_hashes:
+        if (self.kv_events is not None and s.block_hashes
+                and not isinstance(self.allocator, PrefixCachingAllocator)):
+            # With prefix caching the blocks PARK instead of freeing; 'removed'
+            # is published at LRU eviction time (alloc path), not here.
             self.kv_events.removed(s.block_hashes)
         self.telemetry.running.set(sum(x is not None for x in self.slots))
         self.telemetry.request_success.labels(finished_reason=reason.value).inc()

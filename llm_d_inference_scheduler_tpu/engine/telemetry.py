@@ -36,6 +36,9 @@ class EngineTelemetry:
 
         self.prompt_tokens = Counter("jetstream:prompt_tokens_total", "Prefilled tokens",
                                      registry=self.registry)
+        self.prefix_cached_tokens = Counter(
+            "jetstream:prefix_cached_tokens_total",
+            "Prompt tokens served from the prefix cache", registry=self.registry)
         self.generation_tokens = Counter("jetstream:generation_tokens_total", "Decoded tokens",
                                          registry=self.registry)
         self.ttft = Histogram("jetstream:time_to_first_token_seconds", "TTFT",

@@ -187,6 +187,83 @@ def decode_step(
     return logits, k_pages, v_pages
 
 
+def prefill_with_prefix(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,       # [1, S_bucket] suffix tokens (padded)
+    suffix_len: jnp.ndarray,   # [1] valid suffix tokens
+    prefix_len: jnp.ndarray,   # [1] tokens already present in the pages
+    k_pages: jnp.ndarray,      # [L, N, block, Hkv, Dh]
+    v_pages: jnp.ndarray,
+    block_table_row: jnp.ndarray,  # [1, max_blocks] — full table (KV scatter)
+    prior_table_row: jnp.ndarray | None = None,  # [1, prefix_bucket] — gather
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Prefill continuing from cached prefix KV (automatic prefix caching).
+
+    The suffix attends to the cached prefix (gathered from the pages) plus
+    itself causally; its KV is scattered into the pages at positions
+    prefix_len + t. ``prior_table_row`` bounds the gather window to the
+    actual (bucketed) prefix size so a cache hit costs O(prefix), not
+    O(max_context). Returns (last-token logits [1, V] f32, k_pages, v_pages).
+    """
+    B, S = tokens.shape
+    assert B == 1
+    block = k_pages.shape[2]
+    if prior_table_row is None:
+        prior_table_row = block_table_row
+    T = prior_table_row.shape[1] * block
+    Dh = cfg.head_dim
+
+    positions = prefix_len[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]  # [1,S]
+    cos, sin = rope_table(positions, Dh, cfg.rope_theta)
+    suffix_valid = jnp.arange(S)[None, :] < suffix_len[:, None]          # [1,S]
+    prior_pos = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (1, T))
+    prior_valid = prior_pos < prefix_len[:, None]                        # [1,T]
+    kv_positions = jnp.concatenate([prior_pos, positions], axis=1)       # [1,T+S]
+    kv_valid = jnp.concatenate([prior_valid, suffix_valid], axis=1)
+
+    x = params["embed"][tokens]  # [1, S, D]
+
+    def body(x, layer_in):
+        lp, kp, vp = layer_in
+        h = rms_norm(x, lp["ln_attn"], cfg.norm_eps)
+        q = (h @ lp["wq"]).reshape(1, S, cfg.n_heads, Dh)
+        k = (h @ lp["wk"]).reshape(1, S, cfg.n_kv_heads, Dh)
+        v = (h @ lp["wv"]).reshape(1, S, cfg.n_kv_heads, Dh)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+
+        k_prior = kp[prior_table_row].reshape(1, T, cfg.n_kv_heads, Dh)
+        v_prior = vp[prior_table_row].reshape(1, T, cfg.n_kv_heads, Dh)
+        k_all = jnp.concatenate([k_prior, k], axis=1)
+        v_all = jnp.concatenate([v_prior, v], axis=1)
+        attn = causal_attention(q, k_all, v_all, q_positions=positions,
+                                kv_positions=kv_positions, kv_valid=kv_valid)
+        x = x + attn.reshape(1, S, -1) @ lp["wo"]
+        h = rms_norm(x, lp["ln_mlp"], cfg.norm_eps)
+        x = x + (jax.nn.silu(h @ lp["w1"]) * (h @ lp["w3"])) @ lp["w2"]
+        return x, (k, v)
+
+    x, (k_new, v_new) = jax.lax.scan(body, x, (params["layers"], k_pages, v_pages))
+
+    # Scatter suffix KV at offset positions (padding → trash block 0).
+    t = jnp.arange(S, dtype=jnp.int32)
+    tgt = prefix_len[0] + t                                   # [S]
+    valid = t < suffix_len[0]
+    blk_for_t = jnp.where(valid, block_table_row[0, tgt // block], 0)
+    slot_for_t = jnp.where(valid, tgt % block, 0)
+    L = cfg.n_layers
+    k_flat = k_new.reshape(L, S, cfg.n_kv_heads, Dh)
+    v_flat = v_new.reshape(L, S, cfg.n_kv_heads, Dh)
+    k_pages = k_pages.at[:, blk_for_t, slot_for_t].set(k_flat)
+    v_pages = v_pages.at[:, blk_for_t, slot_for_t].set(v_flat)
+
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    last = jnp.take_along_axis(x, (suffix_len - 1)[:, None, None], axis=1)[:, 0]
+    logits = (last @ params["lm_head"]).astype(jnp.float32)
+    return logits, k_pages, v_pages
+
+
 def write_prefill_kv(
     k_pages: jnp.ndarray,  # [L, N, block, Hkv, Dh]
     v_pages: jnp.ndarray,

@@ -1,0 +1,136 @@
+"""Latency-throughput load generator.
+
+Reproduces the reference's benchmark harness shape
+(/root/reference/config/manifests/benchmark/benchmark.yaml:19-47: request
+rates sweep, fixed duration, fixed input/output lengths) against any OpenAI
+endpoint (gateway or engine). Reports per-rate p50/p99 TTFT, request latency,
+and aggregate output tokens/sec — the BASELINE.md metric set.
+
+Usage:
+  python scripts/loadgen.py --url http://127.0.0.1:8081 --rates 2,5,10 \
+      --duration 30 --input-tokens 128 --output-tokens 64 [--stream]
+
+Prints one JSON line per rate plus a summary line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import random
+import statistics
+import time
+
+import httpx
+
+
+def _percentile(xs: list[float], p: float) -> float:
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(len(xs) * p))]
+
+
+async def one_request(client: httpx.AsyncClient, url: str, prompt: str,
+                      output_tokens: int, stream: bool, results: list):
+    t0 = time.monotonic()
+    ttft = None
+    completion_tokens = 0
+    try:
+        if stream:
+            async with client.stream(
+                    "POST", url + "/v1/completions",
+                    json={"model": "bench", "prompt": prompt, "stream": True,
+                          "max_tokens": output_tokens, "ignore_eos": True}) as r:
+                async for line in r.aiter_lines():
+                    if line.startswith("data: ") and line != "data: [DONE]":
+                        if ttft is None:
+                            ttft = time.monotonic() - t0
+                        completion_tokens += 1
+        else:
+            r = await client.post(
+                url + "/v1/completions",
+                json={"model": "bench", "prompt": prompt,
+                      "max_tokens": output_tokens, "ignore_eos": True})
+            ttft = time.monotonic() - t0  # non-stream: first byte == full body
+            if r.status_code == 200:
+                completion_tokens = r.json().get("usage", {}).get(
+                    "completion_tokens", 0)
+            else:
+                results.append({"error": r.status_code})
+                return
+        results.append({"ttft": ttft, "latency": time.monotonic() - t0,
+                        "tokens": completion_tokens})
+    except Exception as e:
+        results.append({"error": str(e)})
+
+
+async def run_rate(url: str, rate: float, duration: float, input_tokens: int,
+                   output_tokens: int, stream: bool) -> dict:
+    rng = random.Random(0)
+    words = ["alpha", "beta", "gamma", "delta", "epsilon", "zeta", "eta"]
+
+    def prompt():
+        # ~4 chars/token heuristic with a unique head so prefix caching
+        # reflects realistic partial overlap
+        head = f"req-{rng.randint(0, 1 << 30)} "
+        return head + " ".join(rng.choice(words)
+                               for _ in range(max(input_tokens - 4, 1)))
+
+    results: list[dict] = []
+    tasks = []
+    async with httpx.AsyncClient(timeout=300) as client:
+        t_start = time.monotonic()
+        n = 0
+        while time.monotonic() - t_start < duration:
+            target = t_start + n / rate
+            delay = target - time.monotonic()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            tasks.append(asyncio.create_task(one_request(
+                client, url, prompt(), output_tokens, stream, results)))
+            n += 1
+        await asyncio.gather(*tasks)
+        elapsed = time.monotonic() - t_start
+
+    ok = [r for r in results if "ttft" in r and r["ttft"] is not None]
+    errors = len(results) - len(ok)
+    return {
+        "rate_rps": rate,
+        "sent": n,
+        "completed": len(ok),
+        "errors": errors,
+        "duration_s": round(elapsed, 2),
+        "ttft_p50_ms": round(_percentile([r["ttft"] for r in ok], 0.5) * 1e3, 1),
+        "ttft_p99_ms": round(_percentile([r["ttft"] for r in ok], 0.99) * 1e3, 1),
+        "latency_p50_ms": round(_percentile([r["latency"] for r in ok], 0.5) * 1e3, 1),
+        "latency_p99_ms": round(_percentile([r["latency"] for r in ok], 0.99) * 1e3, 1),
+        "output_tokens_per_sec": round(sum(r["tokens"] for r in ok) / elapsed, 2),
+    }
+
+
+def main():
+    p = argparse.ArgumentParser(description="latency-throughput sweep")
+    p.add_argument("--url", default="http://127.0.0.1:8081")
+    p.add_argument("--rates", default="2,5,10",
+                   help="comma-separated requests/sec sweep")
+    p.add_argument("--duration", type=float, default=30.0)
+    p.add_argument("--input-tokens", type=int, default=128)
+    p.add_argument("--output-tokens", type=int, default=64)
+    p.add_argument("--stream", action="store_true")
+    args = p.parse_args()
+
+    rows = []
+    for rate in [float(r) for r in args.rates.split(",")]:
+        row = asyncio.run(run_rate(args.url, rate, args.duration,
+                                   args.input_tokens, args.output_tokens,
+                                   args.stream))
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    best = max(rows, key=lambda r: r["output_tokens_per_sec"])
+    print(json.dumps({"summary": "best", **best}))
+
+
+if __name__ == "__main__":
+    main()

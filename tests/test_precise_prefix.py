@@ -16,8 +16,12 @@ from llm_d_inference_scheduler_tpu.utils.hashing import chain_block_hashes
 
 def test_engine_publishes_stored_and_removed_events():
     async def body():
+        # prefix caching off: this test asserts the plain block lifecycle
+        # (stored at prefill, removed at free); with caching, blocks park and
+        # 'removed' fires at LRU eviction instead.
         cfg = EngineConfig(model="tiny", backend="tpu", max_batch=2,
-                           max_model_len=128, port=18510, kv_events_port=18520)
+                           max_model_len=128, port=18510, kv_events_port=18520,
+                           enable_prefix_caching=False)
         eng = TpuEngine(cfg)
 
         events = []
