@@ -106,6 +106,23 @@ def main() -> None:
         child(sys.argv[2])
         return
 
+    # Fail fast if the device is unreachable (the axon tunnel can wedge hard
+    # enough that even jax.devices() hangs) instead of burning the full
+    # per-candidate watchdogs.
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax, jax.numpy as jnp; jnp.ones(2).sum(); print('ok')"],
+            capture_output=True, text=True, timeout=120)
+        if "ok" not in probe.stdout:
+            raise RuntimeError(probe.stderr[-500:])
+    except Exception as e:
+        print(json.dumps({"metric": "decode_tokens_per_sec_per_chip",
+                          "value": 0.0, "unit": "tokens/s/chip",
+                          "vs_baseline": 0.0,
+                          "error": f"TPU unreachable: {e}"}))
+        return
+
     forced = os.environ.get("BENCH_MODEL")
     candidates = ([(forced, int(os.environ.get("BENCH_TIMEOUT", "900")))]
                   if forced else CANDIDATES)

@@ -47,6 +47,10 @@ class FlowControlConfig:
     band_capacity_bytes: int = DEFAULT_BAND_CAPACITY_BYTES
     max_global_bytes: int | None = None
     max_global_requests: int | None = None
+    # static-usage-limit-policy (reference framework/plugins/flowcontrol/
+    # usagelimits): per-flow queued-capacity caps.
+    per_flow_max_requests: int | None = None
+    per_flow_max_bytes: int | None = None
     default_ttl_s: float = DEFAULT_TTL_S
 
     @classmethod
@@ -59,6 +63,8 @@ class FlowControlConfig:
                                              DEFAULT_BAND_CAPACITY_BYTES)),
             max_global_bytes=spec.get("maxGlobalBytes"),
             max_global_requests=spec.get("maxGlobalRequests"),
+            per_flow_max_requests=spec.get("perFlowMaxRequests"),
+            per_flow_max_bytes=spec.get("perFlowMaxBytes"),
             default_ttl_s=float(spec.get("defaultTTLSeconds", DEFAULT_TTL_S)),
         )
 
@@ -228,6 +234,24 @@ class FlowController:
         item.future = loop.create_future()
         if item.deadline is None:
             item.deadline = time.monotonic() + self.cfg.default_ttl_s
+
+        # Per-flow usage caps (static-usage-limit-policy) are GLOBAL across
+        # shards — least-loaded placement would otherwise multiply the cap by
+        # the shard count — and apply from the flow's very first request.
+        cfg = self.cfg
+        if cfg.per_flow_max_requests is not None or cfg.per_flow_max_bytes is not None:
+            flow_requests = flow_bytes = 0
+            for s in self.shards:
+                fq = s.queues.get(item.flow_key)
+                if fq is not None:
+                    flow_requests += len(fq)
+                    flow_bytes += fq.bytes
+            if (cfg.per_flow_max_requests is not None
+                    and flow_requests >= cfg.per_flow_max_requests):
+                return QueueOutcome.REJECTED_CAPACITY
+            if (cfg.per_flow_max_bytes is not None
+                    and flow_bytes + item.size_bytes > cfg.per_flow_max_bytes):
+                return QueueOutcome.REJECTED_CAPACITY
 
         shard = self._least_loaded_shard()
         rejection = shard.try_enqueue(item)

@@ -121,6 +121,7 @@ class Gateway:
             web.get("/metrics", self.metrics),
             web.get("/health", self.health),
             web.get("/v1/models", self.models),
+            web.get("/debug/traces", self.traces),
         ])
         self._runner: web.AppRunner | None = None
         self._client: httpx.AsyncClient | None = None
@@ -176,7 +177,21 @@ class Gateway:
 
     # ---- handlers ---------------------------------------------------------
 
+    async def traces(self, request: web.Request) -> web.Response:
+        from .tracing import tracer
+
+        return web.json_response({"spans": tracer.snapshot()})
+
     async def handle_inference(self, request: web.Request) -> web.StreamResponse:
+        from .tracing import tracer
+
+        with tracer.span("gateway.request", path=request.path) as span:
+            resp = await self._handle_inference(request, span)
+            span.set_attribute("status", resp.status)
+            return resp
+
+    async def _handle_inference(self, request: web.Request,
+                                span=None) -> web.StreamResponse:
         t_start = time.monotonic()
         raw = await request.read()
         headers = {k.lower(): v for k, v in request.headers.items()}
@@ -214,12 +229,15 @@ class Gateway:
                 headers={X_REMOVAL_REASON: e.reason})
 
         target = result.primary().target_endpoints[0]
+        # Repackage through the parser (director.go:289-306): translates
+        # non-OpenAI shapes (e.g. vertexai) to the engine contract and applies
+        # the model rewrite.
         body_out = raw
         payload = ireq.body.payload
-        if payload is not None and ireq.target_model != original_model:
-            payload = dict(payload)
-            payload["model"] = ireq.target_model  # repackage (director.go:289-306)
-            body_out = json.dumps(payload).encode()
+        if payload is not None:
+            if ireq.target_model != original_model:
+                payload["model"] = ireq.target_model
+            body_out = self.parser.serialize(ireq.body)
 
         # Register for mid-flight eviction: sheddable in-flight requests can be
         # cancelled to admit higher-priority work (reference eviction channel →

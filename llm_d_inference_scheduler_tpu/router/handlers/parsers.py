@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import re
 from typing import Any
 
 from ..framework.plugin import PluginBase, register_plugin
@@ -51,6 +52,57 @@ class OpenAIParser(PluginBase):
         if payload is None:
             return body.raw or b""
         return json.dumps(payload).encode()
+
+
+@register_plugin("vertexai-parser")
+class VertexAIParser(PluginBase):
+    """Vertex AI prediction shape: {"instances": [...], "parameters": {...}}
+    (reference parsers/vertexai). The first instance's prompt/messages map to
+    the OpenAI body the scheduler plugins understand; parameters carry
+    sampling knobs (maxOutputTokens, temperature)."""
+
+    def parse(self, raw: bytes, headers: dict[str, str], path: str = "") -> ParseResult:
+        try:
+            doc = json.loads(raw)
+        except Exception as e:
+            return ParseResult(body=None, error=f"invalid JSON body: {e}")
+        instances = doc.get("instances")
+        if not isinstance(instances, list) or not instances:
+            return ParseResult(body=None, error="vertexai body needs instances[]")
+        if len(instances) > 1:
+            return ParseResult(
+                body=None,
+                error="vertexai multi-instance batches are not supported; "
+                      "send one instance per request")
+        inst = instances[0]
+        if isinstance(inst, str):
+            inst = {"prompt": inst}  # Vertex allows bare-string instances
+        if not isinstance(inst, dict):
+            return ParseResult(body=None, error="vertexai instance must be an "
+                                                "object or string")
+        params = doc.get("parameters") or {}
+        model = str(doc.get("model", ""))
+        if not model:
+            # Vertex carries the model in the :predict URL, not the body.
+            m = re.search(r"models/([^/:]+)", path or "")
+            if m:
+                model = m.group(1)
+        mapped: dict[str, Any] = {"model": model}
+        if "maxOutputTokens" in params:
+            mapped["max_tokens"] = params["maxOutputTokens"]
+        if "temperature" in params:
+            mapped["temperature"] = params["temperature"]
+        if "messages" in inst:
+            mapped["messages"] = inst["messages"]
+            return ParseResult(
+                body=InferenceRequestBody(chat_completions=mapped, raw=raw),
+                model=model)
+        mapped["prompt"] = inst.get("prompt", inst.get("content", ""))
+        return ParseResult(
+            body=InferenceRequestBody(completions=mapped, raw=raw), model=model)
+
+    def serialize(self, body: InferenceRequestBody) -> bytes:
+        return json.dumps(body.payload or {}).encode()
 
 
 @register_plugin("passthrough-parser")

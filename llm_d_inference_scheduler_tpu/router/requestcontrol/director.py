@@ -72,6 +72,19 @@ class Director:
     # ---- request path ---------------------------------------------------
 
     async def handle_request(self, ctx: Any, request: InferenceRequest) -> SchedulingResult:
+        from ..tracing import tracer
+
+        with tracer.span("gateway.request_orchestration",
+                         request_id=request.request_id,
+                         model=request.target_model) as span:
+            result = await self._handle_request(ctx, request)
+            span.set_attribute(
+                "target", request.headers.get(H_DESTINATION, ""))
+            span.set_attribute("profiles", list(result.profile_results))
+            return result
+
+    async def _handle_request(self, ctx: Any,
+                              request: InferenceRequest) -> SchedulingResult:
         original_model = request.target_model
 
         # 1. weighted model rewrite (director.go:263-343)

@@ -185,7 +185,15 @@ class Sidecar:
 
     async def _run_pd_protocol(self, request: web.Request, body: dict[str, Any],
                                prefiller: str) -> web.StreamResponse:
-        """2-phase tpu-dcn protocol (NIXL-v2 analogue)."""
+        """2-phase tpu-dcn protocol (NIXL-v2 analogue). Span attributes mirror
+        the reference's sidecar spans (true_ttft_ms/prefill_duration_ms,
+        connector_nixlv2.go:276-299)."""
+        from ..tracing import tracer
+
+        with tracer.span("sidecar.pd_protocol", prefiller=prefiller) as span:
+            return await self._run_pd_protocol_inner(request, body, prefiller, span)
+
+    async def _run_pd_protocol_inner(self, request, body, prefiller, span):
         t0 = time.monotonic()
         prefill_body = dict(body)
         prefill_body["kv_transfer_params"] = {"do_remote_decode": True}
@@ -210,6 +218,8 @@ class Sidecar:
         if ktp is not None:
             decode_body["kv_transfer_params"] = ktp
         prefill_ms = (time.monotonic() - t0) * 1e3
+        span.set_attribute("prefill_duration_ms", round(prefill_ms, 1))
+        span.set_attribute("fallback_to_decode", ktp is None)
         return await self._dispatch_decode(request, decode_body,
                                            extra_headers={
                                                "x-prefill-duration-ms": f"{prefill_ms:.1f}"})

@@ -1,0 +1,118 @@
+"""Request tracing: span instrumentation across the router hot path.
+
+Mirrors the reference's OTel span topology (SURVEY §5): `gateway.request`
+(handlers/server.go:172), `gateway.request_orchestration` (director.go:183),
+scorer spans, disagg decision spans, sidecar P/D spans with true_ttft_ms /
+prefill_duration_ms attributes (connector_nixlv2.go:276-299).
+
+Zero-egress environment: instead of OTLP export, spans go to a ring buffer
+(inspectable via the gateway's /debug/traces endpoint) and, at TRACE log
+level, to the logger. The Span API is OTel-shaped so an OTLP exporter can
+replace the sink without touching instrumentation. Env-configured like the
+reference: TRACING_ENABLED=1, TRACING_SAMPLE_RATIO (default 0.1 — the
+reference's default sampler ratio, telemetry/tracing.go:48-51).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import logging
+import os
+import random
+import time
+import uuid
+from collections import deque
+from typing import Any
+
+log = logging.getLogger("router.tracing")
+
+_current_span: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
+    "current_span", default=None)
+
+
+class Span:
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "start", "end",
+                 "attributes", "status")
+
+    def __init__(self, name: str, trace_id: str, parent_id: str | None):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = uuid.uuid4().hex[:16]
+        self.parent_id = parent_id
+        self.start = time.monotonic()
+        self.end: float | None = None
+        self.attributes: dict[str, Any] = {}
+        self.status = "ok"
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        self.attributes[key] = value
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "name": self.name,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "duration_ms": round(((self.end or time.monotonic()) - self.start) * 1e3, 3),
+            "attributes": self.attributes,
+            "status": self.status,
+        }
+
+
+class Tracer:
+    def __init__(self, *, enabled: bool | None = None,
+                 sample_ratio: float | None = None, capacity: int = 512):
+        self.enabled = (enabled if enabled is not None
+                        else os.environ.get("TRACING_ENABLED", "") == "1")
+        self.sample_ratio = (sample_ratio if sample_ratio is not None
+                             else float(os.environ.get("TRACING_SAMPLE_RATIO", "0.1")))
+        self.finished: deque[dict[str, Any]] = deque(maxlen=capacity)
+        self._rng = random.Random()
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attributes):
+        parent = _current_span.get()
+        if not self.enabled or parent is _DROPPED:
+            yield _NoopSpan()
+            return
+        if parent is None and self._rng.random() > self.sample_ratio:
+            # Propagate the drop decision so children don't re-roll into
+            # orphan spans with no assemblable root.
+            token = _current_span.set(_DROPPED)
+            try:
+                yield _NoopSpan()
+            finally:
+                _current_span.reset(token)
+            return
+        trace_id = parent.trace_id if parent else uuid.uuid4().hex
+        s = Span(name, trace_id, parent.span_id if parent else None)
+        s.attributes.update(attributes)
+        token = _current_span.set(s)
+        try:
+            yield s
+        except BaseException as e:
+            s.status = f"error: {type(e).__name__}"
+            raise
+        finally:
+            s.end = time.monotonic()
+            _current_span.reset(token)
+            self.finished.append(s.to_dict())
+            log.debug("span %s %.2fms %s", s.name,
+                      (s.end - s.start) * 1e3, s.attributes)
+
+    def snapshot(self) -> list[dict[str, Any]]:
+        return list(self.finished)
+
+
+class _NoopSpan:
+    def set_attribute(self, key: str, value: Any) -> None:
+        pass
+
+
+_DROPPED = object()  # contextvar sentinel: this trace was sampled out
+
+
+# Process-global tracer (the reference similarly holds a global tracer
+# initialised from env at process start, telemetry/tracing.go:129).
+tracer = Tracer()

@@ -233,3 +233,31 @@ pool:
             await eng.stop()
 
     run(body())
+
+
+def test_per_flow_usage_limit():
+    """static-usage-limit-policy: per-flow queued caps reject the overflowing
+    flow while other flows still enqueue."""
+    async def body():
+        cfg = FlowControlConfig(per_flow_max_requests=2)
+        fc = FlowController(cfg, saturation_fn=lambda: 2.0)  # nothing drains
+        await fc.start()
+        try:
+            tasks = [asyncio.create_task(fc.enqueue_and_wait(_req(f"a{i}", flow="A")))
+                     for i in range(2)]
+            await asyncio.sleep(0.05)
+            out = await fc.enqueue_and_wait(_req("a2", flow="A"))
+            assert out == QueueOutcome.REJECTED_CAPACITY  # flow A at its cap
+            other = asyncio.create_task(fc.enqueue_and_wait(_req("b0", flow="B")))
+            await asyncio.sleep(0.05)
+            assert not other.done()  # flow B enqueued fine
+            for t in tasks + [other]:
+                t.cancel()
+            import contextlib
+            for t in tasks + [other]:
+                with contextlib.suppress(asyncio.CancelledError):
+                    await t
+        finally:
+            await fc.stop()
+
+    run(body())

@@ -227,3 +227,24 @@ def test_no_hit_lru_scorer_spreads_cold_traffic():
     eps[1].attributes.put(PREFIX_ATTRIBUTE_KEY, PrefixCacheMatchInfo(5, 10, 16))
     scores = s.score(None, None, req(), eps)
     assert set(scores.values()) == {0.5}
+
+
+def test_vertexai_parser():
+    from llm_d_inference_scheduler_tpu.router.handlers.parsers import VertexAIParser
+    import json
+
+    p = VertexAIParser("v")
+    res = p.parse(json.dumps({
+        "model": "m", "instances": [{"prompt": "hello"}],
+        "parameters": {"maxOutputTokens": 7, "temperature": 0.5}}).encode(), {})
+    assert res.error is None and not res.skip
+    assert res.body.completions["prompt"] == "hello"
+    assert res.body.completions["max_tokens"] == 7
+
+    res = p.parse(json.dumps({
+        "model": "m",
+        "instances": [{"messages": [{"role": "user", "content": "hi"}]}]}).encode(), {})
+    assert res.body.chat_completions is not None
+
+    res = p.parse(b'{"no": "instances"}', {})
+    assert res.error

@@ -1,0 +1,69 @@
+"""Tracing: span capture, parent linkage, sampling, /debug/traces."""
+
+import asyncio
+
+import httpx
+
+from llm_d_inference_scheduler_tpu.engine import EngineConfig
+from llm_d_inference_scheduler_tpu.engine.server import EngineServer
+from llm_d_inference_scheduler_tpu.router import tracing
+from llm_d_inference_scheduler_tpu.router.gateway import build_gateway
+
+
+def test_span_nesting_and_sampling():
+    t = tracing.Tracer(enabled=True, sample_ratio=1.0)
+    with t.span("outer", a=1) as outer:
+        with t.span("inner") as inner:
+            inner.set_attribute("b", 2)
+    spans = t.snapshot()
+    assert [s["name"] for s in spans] == ["inner", "outer"]
+    assert spans[0]["parent_id"] == spans[1]["span_id"]
+    assert spans[0]["trace_id"] == spans[1]["trace_id"]
+    assert spans[0]["attributes"]["b"] == 2
+
+    off = tracing.Tracer(enabled=False)
+    with off.span("nope") as s:
+        s.set_attribute("x", 1)  # noop span tolerates attributes
+    assert off.snapshot() == []
+
+    sampled = tracing.Tracer(enabled=True, sample_ratio=0.0)
+    with sampled.span("dropped"):
+        pass
+    assert sampled.snapshot() == []
+
+
+def test_gateway_traces_endpoint():
+    async def body():
+        old = (tracing.tracer.enabled, tracing.tracer.sample_ratio)
+        tracing.tracer.enabled, tracing.tracer.sample_ratio = True, 1.0
+        eng = EngineServer(EngineConfig(backend="sim", model="tiny", port=18631))
+        await eng.start()
+        gw = build_gateway("""
+pool:
+  endpoints:
+    - {address: 127.0.0.1, port: 18631}
+""", port=18630, poll_interval=0.02)
+        await gw.start()
+        try:
+            async with httpx.AsyncClient(timeout=30) as c:
+                r = await c.post("http://127.0.0.1:18630/v1/completions",
+                                 json={"model": "tiny", "prompt": "t",
+                                       "max_tokens": 2})
+                assert r.status_code == 200
+                r = await c.get("http://127.0.0.1:18630/debug/traces")
+                spans = r.json()["spans"]
+                names = [s["name"] for s in spans]
+                assert "gateway.request" in names
+                assert "gateway.request_orchestration" in names
+                orch = next(s for s in spans
+                            if s["name"] == "gateway.request_orchestration")
+                root = next(s for s in spans if s["name"] == "gateway.request")
+                assert orch["trace_id"] == root["trace_id"]
+                assert orch["parent_id"] == root["span_id"]
+                assert orch["attributes"]["target"].startswith("127.0.0.1")
+        finally:
+            tracing.tracer.enabled, tracing.tracer.sample_ratio = old
+            await gw.stop()
+            await eng.stop()
+
+    asyncio.run(body())
