@@ -393,6 +393,27 @@ class TpuEngine:
             if caching and hashes:
                 max_match = (len(prompt) - 1) // block
                 matched_bids = self.allocator.match_prefix(hashes)[:max_match]
+
+            # Shared-storage probe: bail out before any allocation when the
+            # cache can't cover enough of the prompt (sidecar then runs the
+            # remote prefill leg and retries). Ratio is over the MATCHABLE
+            # prefix (complete blocks minus the mandatory suffix token), so a
+            # fully warm cache always scores 1.0 even for block-aligned
+            # prompts.
+            if req.cache_hit_threshold is not None and prompt:
+                max_match = (len(prompt) - 1) // block
+                hit_ratio = (len(matched_bids) / max_match) if max_match else 1.0
+                if hit_ratio < req.cache_hit_threshold:
+                    self._emit_to(out, loop, TokenEvent(
+                        request_id=req.request_id, token_id=None,
+                        finish_reason=FinishReason.CACHE_THRESHOLD,
+                        prompt_tokens=len(prompt),
+                        cached_tokens=len(matched_bids) * block))
+                    self.telemetry.request_success.labels(
+                        finished_reason=FinishReason.CACHE_THRESHOLD.value).inc()
+                    return
+
+            if caching and matched_bids:
                 self.allocator.acquire_cached(matched_bids)
             new_bids = self.allocator.alloc(need - len(matched_bids))
             evicted = list(getattr(self.allocator, "last_evicted_hashes", []))

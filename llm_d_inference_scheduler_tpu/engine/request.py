@@ -26,6 +26,11 @@ class EngineRequest:
     stop_token_ids: tuple[int, ...] = ()
     ignore_eos: bool = False   # benchmark/test knob (vLLM-compatible)
     stream: bool = False
+    # Shared-storage disaggregation probe (reference
+    # connector_shared_storage.go:30-271): if the prefix-cache hit ratio at
+    # prefill is below this threshold, finish immediately with
+    # finish_reason="cache_threshold" so the sidecar can prefill remotely.
+    cache_hit_threshold: float | None = None
     # P/D disaggregation handshake (mirrors the reference's kv_transfer_params
     # relay, /root/reference pkg/sidecar/proxy/connector_nixlv2.go:109-131):
     kv_transfer_params: dict[str, Any] | None = None

@@ -163,6 +163,9 @@ class EngineServer:
                 stream=bool(body.get("stream", False)),
                 stop_token_ids=tuple(int(t) for t in (body.get("stop_token_ids") or ())),
                 ignore_eos=bool(body.get("ignore_eos", False)),
+                cache_hit_threshold=(float(body["cache_hit_threshold"])
+                                     if body.get("cache_hit_threshold") is not None
+                                     else None),
                 kv_transfer_params=body.get("kv_transfer_params"),
             )
         except (TypeError, ValueError) as e:

@@ -229,12 +229,16 @@ class Gateway:
                 headers={X_REMOVAL_REASON: e.reason})
 
         target = result.primary().target_endpoints[0]
-        # Repackage through the parser (director.go:289-306): translates
-        # non-OpenAI shapes (e.g. vertexai) to the engine contract and applies
-        # the model rewrite.
+        # Repackage through the parser (director.go:289-306) only when the
+        # bytes must change: model rewrite, or a translating (non-OpenAI)
+        # parser; otherwise forward the raw body untouched (hot path).
         body_out = raw
         payload = ireq.body.payload
-        if payload is not None:
+        needs_repackage = (payload is not None
+                           and (ireq.target_model != original_model
+                                or self.parser.typed_name().type
+                                not in ("openai-parser", "passthrough-parser")))
+        if needs_repackage:
             if ireq.target_model != original_model:
                 payload["model"] = ireq.target_model
             body_out = self.parser.serialize(ireq.body)

@@ -43,7 +43,8 @@ class SidecarConfig:
     port: int = 8000
     host: str = "127.0.0.1"
     decoder_url: str = "http://127.0.0.1:8200"
-    connector: str = "tpu-dcn"         # "tpu-dcn" | "passthrough"
+    connector: str = "tpu-dcn"         # "tpu-dcn" | "shared-storage" | "passthrough"
+    cache_hit_threshold: float = 0.8   # shared-storage decode-first probe
     ssrf_allowlist: list[str] | None = None  # None disables SSRF protection
     prefill_timeout_s: float = 120.0
     decode_timeout_s: float = 300.0
@@ -139,8 +140,45 @@ class Sidecar:
                     and prefiller not in self.cfg.ssrf_allowlist):
                 return web.json_response(
                     {"error": f"prefiller {prefiller} not in allowlist"}, status=403)
+            if self.cfg.connector == "shared-storage":
+                return await self._run_shared_storage_protocol(request, body,
+                                                               prefiller)
             return await self._run_pd_protocol(request, body, prefiller)
         return await self._dispatch_decode(request, body)
+
+    async def _run_shared_storage_protocol(self, request: web.Request,
+                                           body: dict[str, Any],
+                                           prefiller: str) -> web.StreamResponse:
+        """Shared-storage connector (reference connector_shared_storage.go:
+        30-271): try decode FIRST with a cache_hit_threshold probe; only if the
+        decode engine reports finish_reason=cache_threshold (cache too cold),
+        run the remote prefill leg, then retry decode. Here the 'shared
+        storage' is the prefill engine's staged KV export pulled over DCN."""
+        from ..tracing import tracer
+
+        with tracer.span("sidecar.shared_storage_protocol",
+                         prefiller=prefiller) as span:
+            # Cheap probe: max_tokens=1 so a warm hit never generates the
+            # completion twice; the real generation always goes through
+            # _dispatch_decode (which also honors decode_chunk_size/stream).
+            probe_body = dict(body)
+            probe_body["cache_hit_threshold"] = self.cfg.cache_hit_threshold
+            probe_body["stream"] = False
+            probe_body["max_tokens"] = 1
+            warm = False
+            try:
+                r = await self._client.post(self._rank_url() + request.path,
+                                            json=probe_body)
+                if r.status_code == 200:
+                    doc = r.json()
+                    finish = (doc.get("choices") or [{}])[0].get("finish_reason")
+                    warm = finish != "cache_threshold"
+            except Exception as e:
+                log.warning("shared-storage probe failed (%s); running P/D", e)
+            span.set_attribute("cache_hit", warm)
+            if warm:
+                return await self._dispatch_decode(request, body)
+            return await self._run_pd_protocol(request, body, prefiller)
 
     @staticmethod
     def _multimodal_items(body: dict[str, Any]) -> list[dict[str, Any]]:
@@ -328,7 +366,8 @@ def main(argv: list[str] | None = None):
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--decoder", default="http://127.0.0.1:8200")
     p.add_argument("--connector", default="tpu-dcn",
-                   choices=["tpu-dcn", "passthrough"])
+                   choices=["tpu-dcn", "shared-storage", "passthrough"])
+    p.add_argument("--cache-hit-threshold", type=float, default=0.8)
     p.add_argument("--allowlist", default=None,
                    help="comma-separated allowed prefill host:ports "
                         "(enables SSRF protection)")
@@ -341,7 +380,8 @@ def main(argv: list[str] | None = None):
         ssrf_allowlist=[s.strip() for s in args.allowlist.split(",") if s.strip()]
         if args.allowlist else None,
         decode_chunk_size=args.decode_chunk_size,
-        data_parallel_size=args.data_parallel_size)
+        data_parallel_size=args.data_parallel_size,
+        cache_hit_threshold=args.cache_hit_threshold)
     logging.basicConfig(level=logging.INFO)
 
     async def run():

@@ -27,3 +27,24 @@ def test_ring_matches_reference(sp):
     with jax.set_mesh(mesh):
         out = jax.jit(lambda q, k, v: ring_fn(q, k, v))(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("sp", [2, 4])
+def test_ulysses_matches_reference(sp):
+    from llm_d_inference_scheduler_tpu.parallel.ulysses import make_ulysses_attention_fn
+
+    devices = jax.devices()
+    mesh = make_mesh(devices[: 2 * sp], tp=1, sp=sp)
+
+    B, S, H, Hkv, D = 2, 8 * sp, 8, 4, 16
+    key = jax.random.key(1)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, S, H, D), jnp.float32)
+    k = jax.random.normal(kk, (B, S, Hkv, D), jnp.float32)
+    v = jax.random.normal(kv, (B, S, Hkv, D), jnp.float32)
+
+    ref = causal_attention(q, k, v)
+    fn = make_ulysses_attention_fn(mesh)
+    with jax.set_mesh(mesh):
+        out = jax.jit(lambda q, k, v: fn(q, k, v))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
