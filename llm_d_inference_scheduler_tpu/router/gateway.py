@@ -66,7 +66,7 @@ ROUTER_OWNED_HEADERS = ("x-prefiller-host-port", "x-encoder-hosts-ports",
 class Gateway:
     def __init__(self, cfg: RouterConfig, datastore: Datastore,
                  dl_runtime: DataLayerRuntime, *, host: str = "127.0.0.1",
-                 port: int = 8081):
+                 port: int = 8081, grpc_health_port: int | None = None):
         self.cfg = cfg
         self.datastore = datastore
         self.dl_runtime = dl_runtime
@@ -122,10 +122,20 @@ class Gateway:
             web.get("/health", self.health),
             web.get("/v1/models", self.models),
             web.get("/debug/traces", self.traces),
+            web.get("/debug/profile", self.profile),
         ])
         self._runner: web.AppRunner | None = None
         self._client: httpx.AsyncClient | None = None
         self._flusher: asyncio.Task | None = None
+        self._profile_lock = asyncio.Lock()
+        self.grpc_health = None
+        if grpc_health_port is not None:
+            from .health_grpc import HealthServer
+
+            self.grpc_health = HealthServer(
+                ready_fn=lambda: (self.datastore.pool_ready
+                                  and bool(self.datastore.endpoint_list())),
+                host=host, port=grpc_health_port)
 
     # ---- lifecycle ------------------------------------------------------
 
@@ -146,12 +156,16 @@ class Gateway:
         site = web.TCPSite(self._runner, self.host, self.port)
         await site.start()
         self._flusher = asyncio.get_running_loop().create_task(self._flush_pool_gauges())
+        if self.grpc_health is not None:
+            await self.grpc_health.start()
         log.info("gateway listening on %s:%s (%d endpoints)",
                  self.host, self.port, len(self.datastore.endpoint_list()))
 
     async def stop(self):
         if self._flusher:
             self._flusher.cancel()
+        if self.grpc_health is not None:
+            await self.grpc_health.stop()
         if self.flow_controller is not None:
             await self.flow_controller.stop()
         if self._runner:
@@ -181,6 +195,34 @@ class Gateway:
         from .tracing import tracer
 
         return web.json_response({"spans": tracer.snapshot()})
+
+    async def profile(self, request: web.Request) -> web.Response:
+        """CPU profile of the router process for ?seconds=N (pprof analogue;
+        reference mounts pprof handlers behind --enable-pprof, SURVEY §5)."""
+        import cProfile
+        import io
+        import pstats
+
+        try:
+            seconds = min(float(request.query.get("seconds", "5")), 60.0)
+        except ValueError:
+            return web.json_response({"error": "seconds must be a number"},
+                                     status=400)
+        if self._profile_lock.locked():
+            return web.json_response(
+                {"error": "a profile is already running"}, status=409)
+        async with self._profile_lock:
+            prof = cProfile.Profile()
+            prof.enable()
+            try:
+                await asyncio.sleep(seconds)
+            finally:
+                # Cancellation/shutdown must not leave the C profile hook
+                # installed on the event-loop thread.
+                prof.disable()
+        buf = io.StringIO()
+        pstats.Stats(prof, stream=buf).sort_stats("cumulative").print_stats(40)
+        return web.Response(text=buf.getvalue(), content_type="text/plain")
 
     async def handle_inference(self, request: web.Request) -> web.StreamResponse:
         from .tracing import tracer
@@ -392,7 +434,8 @@ def _usage_from_sse(chunk: bytes) -> dict[str, int] | None:
 
 
 def build_gateway(config_text: str | None, *, host: str = "127.0.0.1",
-                  port: int = 8081, poll_interval: float = 0.05) -> Gateway:
+                  port: int = 8081, poll_interval: float = 0.05,
+                  grpc_health_port: int | None = None) -> Gateway:
     datastore = Datastore()
     dl_runtime = DataLayerRuntime(datastore, poll_interval=poll_interval)
     handle = Handle(datastore=datastore, dl_runtime=dl_runtime)
@@ -405,7 +448,8 @@ def build_gateway(config_text: str | None, *, host: str = "127.0.0.1",
     for plugin in cfg.plugins_by_name.values():
         if hasattr(plugin, "endpoint_added") or hasattr(plugin, "endpoint_removed"):
             dl_runtime.register_lifecycle(plugin)
-    return Gateway(cfg, datastore, dl_runtime, host=host, port=port)
+    return Gateway(cfg, datastore, dl_runtime, host=host, port=port,
+                   grpc_health_port=grpc_health_port)
 
 
 def main(argv: list[str] | None = None):
@@ -419,6 +463,8 @@ def main(argv: list[str] | None = None):
     p.add_argument("--endpoints", default=None,
                    help="comma-separated host:port[:role] static pool "
                         "(overrides config pool)")
+    p.add_argument("--grpc-health-port", type=int, default=None,
+                   help="serve grpc.health.v1.Health on this port")
     args = p.parse_args(argv)
 
     text = args.config_text
@@ -426,7 +472,8 @@ def main(argv: list[str] | None = None):
         with open(args.config_file) as f:
             text = f.read()
 
-    gw = build_gateway(text, host=args.host, port=args.port)
+    gw = build_gateway(text, host=args.host, port=args.port,
+                       grpc_health_port=args.grpc_health_port)
     if args.endpoints:
         from .framework.datalayer import EndpointMetadata
         metas = []

@@ -1,0 +1,121 @@
+"""gRPC health service (grpc.health.v1.Health) for the gateway.
+
+Reference parity: cmd/epp/runner/health.go — a gRPC health endpoint whose
+overall status tracks pool readiness, with a per-service check for
+`envoy.service.ext_proc.v3.ExternalProcessor`.
+
+The image ships grpcio but not grpcio-health-checking, and the health/v1
+proto is two one-field messages — so the wire format is encoded by hand:
+  HealthCheckRequest  { string service = 1; }          (field 1, len-delim)
+  HealthCheckResponse { ServingStatus status = 1; }    (field 1, varint)
+"""
+
+from __future__ import annotations
+
+import logging
+
+import grpc
+import grpc.aio
+
+log = logging.getLogger("router.health_grpc")
+
+SERVICE_NAME = "grpc.health.v1.Health"
+EXT_PROC_SERVICE = "envoy.service.ext_proc.v3.ExternalProcessor"
+
+UNKNOWN, SERVING, NOT_SERVING, SERVICE_UNKNOWN = 0, 1, 2, 3
+
+
+def _encode_varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def parse_request(data: bytes) -> str:
+    """Extract `service` (field 1, wire type 2) from HealthCheckRequest."""
+    i = 0
+    service = ""
+    while i < len(data):
+        tag = data[i]
+        i += 1
+        field, wire = tag >> 3, tag & 0x7
+        if wire == 2:  # length-delimited
+            ln = 0
+            shift = 0
+            while True:
+                b = data[i]
+                i += 1
+                ln |= (b & 0x7F) << shift
+                shift += 7
+                if not b & 0x80:
+                    break
+            payload = data[i:i + ln]
+            i += ln
+            if field == 1:
+                service = payload.decode("utf-8", errors="replace")
+        elif wire == 0:  # varint: skip
+            while data[i] & 0x80:
+                i += 1
+            i += 1
+        else:  # unsupported wire type: stop parsing defensively
+            break
+    return service
+
+
+def serialize_response(status: int) -> bytes:
+    return b"\x08" + _encode_varint(status)
+
+
+class HealthServer:
+    """Serves Check/Watch; status derives from a readiness callback."""
+
+    def __init__(self, ready_fn, host: str = "127.0.0.1", port: int = 0):
+        self.ready_fn = ready_fn
+        self.host, self.port = host, port
+        self._server: grpc.aio.Server | None = None
+
+    def _status_for(self, service: str) -> int:
+        if service not in ("", EXT_PROC_SERVICE):
+            return SERVICE_UNKNOWN
+        return SERVING if self.ready_fn() else NOT_SERVING
+
+    async def _check(self, request: str, context) -> int:
+        return self._status_for(request)
+
+    async def _watch(self, request: str, context):
+        # Minimal Watch: emit the current status once, then updates on change.
+        import asyncio
+
+        last = None
+        while True:
+            status = self._status_for(request)
+            if status != last:
+                yield status
+                last = status
+            await asyncio.sleep(1.0)
+
+    async def start(self) -> int:
+        self._server = grpc.aio.server()
+        handlers = grpc.method_handlers_generic_handler(SERVICE_NAME, {
+            "Check": grpc.unary_unary_rpc_method_handler(
+                self._check,
+                request_deserializer=parse_request,
+                response_serializer=serialize_response),
+            "Watch": grpc.unary_stream_rpc_method_handler(
+                self._watch,
+                request_deserializer=parse_request,
+                response_serializer=serialize_response),
+        })
+        self._server.add_generic_rpc_handlers((handlers,))
+        self.port = self._server.add_insecure_port(f"{self.host}:{self.port}")
+        await self._server.start()
+        log.info("gRPC health on %s:%d", self.host, self.port)
+        return self.port
+
+    async def stop(self):
+        if self._server:
+            await self._server.stop(grace=0.5)

@@ -13,6 +13,7 @@ from . import (  # noqa: F401
     reporter,
     saturation,
     scorers,
+    testing,
 )
 
 from .attributes import PrefixCacheMatchInfo, PREFIX_ATTRIBUTE_KEY, INFLIGHT_ATTRIBUTE_KEY

@@ -1,0 +1,52 @@
+"""Conformance/test-only plugins.
+
+Reference parity: `header-based-testing-filter` (scheduling/test/filter) and
+`destination-endpoint-served-verifier` (test/responsereceived) exist solely
+for conformance suites — they let CI steer scheduling decisions via a header
+and assert that the served endpoint matches the scheduled one
+(registered at runner.go:496-499).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ..framework.plugin import PluginBase, register_plugin
+from ..requestcontrol.director import H_DESTINATION, H_DESTINATION_SERVED
+
+log = logging.getLogger("router.testing")
+
+TEST_HEADER = "test-epp-endpoint-selection"
+
+
+@register_plugin("header-based-testing-filter")
+class HeaderBasedTestingFilter(PluginBase):
+    """Keep only the endpoint named by the test header (conformance steering)."""
+
+    def filter(self, ctx, state, request, endpoints):
+        want = request.headers.get(TEST_HEADER)
+        if not want:
+            return endpoints
+        chosen = [ep for ep in endpoints if ep.metadata.address_port == want]
+        return chosen or endpoints  # fail open if the named endpoint is absent
+
+
+@register_plugin("destination-endpoint-served-verifier")
+class DestinationEndpointServedVerifier(PluginBase):
+    """ResponseReceived verifier: the endpoint that served must be the one
+    scheduling picked; mismatches are counted and logged."""
+
+    def __init__(self, name: str | None = None):
+        super().__init__(name)
+        self.mismatches = 0
+        self.checked = 0
+
+    def response_received(self, ctx, request, endpoint, status: int) -> None:
+        scheduled = request.headers.get(H_DESTINATION, "")
+        served = (endpoint.metadata.address_port if endpoint is not None
+                  else request.headers.get(H_DESTINATION_SERVED, ""))
+        self.checked += 1
+        if scheduled and served and served not in scheduled.split(","):
+            self.mismatches += 1
+            log.error("served endpoint %s not among scheduled %s (request %s)",
+                      served, scheduled, request.request_id)

@@ -1,0 +1,55 @@
+"""gRPC health service: wire codec, readiness semantics, live Check calls."""
+
+import asyncio
+
+import grpc
+import grpc.aio
+
+from llm_d_inference_scheduler_tpu.router.health_grpc import (
+    EXT_PROC_SERVICE,
+    NOT_SERVING,
+    SERVICE_UNKNOWN,
+    SERVING,
+    HealthServer,
+    parse_request,
+    serialize_response,
+)
+
+
+def test_wire_codec_roundtrip():
+    # encode a HealthCheckRequest by hand: field 1, len-delim
+    svc = EXT_PROC_SERVICE.encode()
+    req = b"\x0a" + bytes([len(svc)]) + svc
+    assert parse_request(req) == EXT_PROC_SERVICE
+    assert parse_request(b"") == ""
+    assert serialize_response(SERVING) == b"\x08\x01"
+    assert serialize_response(SERVICE_UNKNOWN) == b"\x08\x03"
+
+
+def test_health_check_over_real_grpc():
+    async def body():
+        ready = {"v": False}
+        server = HealthServer(ready_fn=lambda: ready["v"])
+        port = await server.start()
+        try:
+            async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as ch:
+                check = ch.unary_unary(
+                    "/grpc.health.v1.Health/Check",
+                    request_serializer=lambda s: (
+                        b"\x0a" + bytes([len(s)]) + s.encode() if s else b""),
+                    response_deserializer=lambda b: b,
+                )
+                resp = await check("")
+                assert resp == serialize_response(NOT_SERVING)
+
+                ready["v"] = True
+                resp = await check("")
+                assert resp == serialize_response(SERVING)
+                resp = await check(EXT_PROC_SERVICE)
+                assert resp == serialize_response(SERVING)
+                resp = await check("some.other.Service")
+                assert resp == serialize_response(SERVICE_UNKNOWN)
+        finally:
+            await server.stop()
+
+    asyncio.run(body())

@@ -248,3 +248,24 @@ def test_vertexai_parser():
 
     res = p.parse(b'{"no": "instances"}', {})
     assert res.error
+
+
+def test_header_based_testing_filter_and_served_verifier():
+    from llm_d_inference_scheduler_tpu.router.plugins.testing import (
+        DestinationEndpointServedVerifier, HeaderBasedTestingFilter)
+
+    eps = [ep("a"), ep("b"), ep("c")]
+    f = HeaderBasedTestingFilter("t")
+    out = f.filter(None, None, req(headers={"test-epp-endpoint-selection": "b:8200"}), eps)
+    assert [e.metadata.address_port for e in out] == ["b:8200"]
+    assert f.filter(None, None, req(), eps) == eps  # no header: pass-through
+    # unknown endpoint named: fail open
+    out = f.filter(None, None, req(headers={"test-epp-endpoint-selection": "zz:1"}), eps)
+    assert out == eps
+
+    v = DestinationEndpointServedVerifier("v")
+    r1 = req(headers={"x-gateway-destination-endpoint": "a:8200,b:8200"})
+    v.response_received(None, r1, eps[0], 200)   # served a -> ok
+    assert v.mismatches == 0
+    v.response_received(None, r1, eps[2], 200)   # served c -> mismatch
+    assert v.mismatches == 1
