@@ -203,11 +203,15 @@ class Gateway:
         import io
         import pstats
 
+        import math
+
         try:
             seconds = min(float(request.query.get("seconds", "5")), 60.0)
         except ValueError:
-            return web.json_response({"error": "seconds must be a number"},
-                                     status=400)
+            seconds = float("nan")
+        if not math.isfinite(seconds) or seconds <= 0:
+            return web.json_response(
+                {"error": "seconds must be a positive finite number"}, status=400)
         if self._profile_lock.locked():
             return web.json_response(
                 {"error": "a profile is already running"}, status=409)
@@ -288,13 +292,37 @@ class Gateway:
         # Register for mid-flight eviction: sheddable in-flight requests can be
         # cancelled to admit higher-priority work (reference eviction channel →
         # ImmediateResponse(429), handlers/server.go:266-284).
+        # DP rank routing: when a profile handler picked a rank, route to the
+        # pod's rank-specific listener (what Envoy does with the reference's
+        # x-data-parallel-host-port) after validating it belongs to the pod.
+        from .plugins.disagg import DataParallelProfileHandler
+        from .requestcontrol.director import H_DATA_PARALLEL
+
+        override = None
+        dp_target = ireq.headers.get(H_DATA_PARALLEL)
+        if dp_target:
+            try:
+                host, _, port = dp_target.rpartition(":")
+                port = int(port)
+                dp_size = int(target.metadata.labels.get(
+                    DataParallelProfileHandler.DP_SIZE_LABEL, "1"))
+            except ValueError:
+                host, port, dp_size = "", -1, 1
+            if (host == target.metadata.address
+                    and target.metadata.port <= port < target.metadata.port + dp_size):
+                override = f"http://{host}:{port}"
+                # Consumed for routing; the rank listener itself encodes the
+                # rank, so don't forward the header downstream.
+                ireq.headers.pop(H_DATA_PARALLEL, None)
+
         task = asyncio.current_task()
         self.evictor.register(ireq.request_id, ireq.objectives.priority, task.cancel)
         stream_state = {"started": False}
         try:
             return await self._proxy(request, ireq, target, body_out, ireq.headers,
                                      t_start, original_model=original_model,
-                                     stream_state=stream_state)
+                                     stream_state=stream_state,
+                                     url_override=override)
         except asyncio.CancelledError:
             if self.evictor.was_evicted(ireq.request_id) and not stream_state["started"]:
                 from .flowcontrol.eviction import EVICTED_REASON
@@ -312,8 +340,9 @@ class Gateway:
     async def _proxy(self, request: web.Request, ireq: InferenceRequest | None,
                      endpoint, body: bytes, headers: dict[str, str],
                      t_start: float, original_model: str,
-                     stream_state: dict | None = None) -> web.StreamResponse:
-        url = endpoint.metadata.url + request.path
+                     stream_state: dict | None = None,
+                     url_override: str | None = None) -> web.StreamResponse:
+        url = (url_override or endpoint.metadata.url) + request.path
         fwd = {k: v for k, v in headers.items() if k in FORWARD_HEADERS}
         fwd["content-type"] = "application/json"
         model_label = (ireq.target_model if ireq else "") or "unknown"

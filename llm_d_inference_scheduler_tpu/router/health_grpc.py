@@ -36,7 +36,15 @@ def _encode_varint(n: int) -> bytes:
 
 
 def parse_request(data: bytes) -> str:
-    """Extract `service` (field 1, wire type 2) from HealthCheckRequest."""
+    """Extract `service` (field 1, wire type 2) from HealthCheckRequest.
+    Truncated/malformed input degrades to "" (the overall-health check)."""
+    try:
+        return _parse_request(data)
+    except IndexError:
+        return ""
+
+
+def _parse_request(data: bytes) -> str:
     i = 0
     service = ""
     while i < len(data):

@@ -26,7 +26,7 @@ from ..framework.scheduling import (
     SchedulingResult,
 )
 from ..metrics import DISAGG_DECISION_TOTAL
-from ..requestcontrol.director import H_ENCODERS, H_PREFILLER
+from ..requestcontrol.director import H_DATA_PARALLEL, H_ENCODERS, H_PREFILLER
 from .attributes import PREFIX_ATTRIBUTE_KEY, PrefixCacheMatchInfo, estimate_input_tokens
 from .profile_handlers import SchedulingError
 
@@ -80,6 +80,49 @@ class AlwaysDisaggMultimodalDecider(PluginBase):
                     if isinstance(block, dict) and block.get("type") in self.MM_TYPES:
                         return True
         return False
+
+
+@register_plugin("data-parallel-profile-handler")
+class DataParallelProfileHandler(PluginBase):
+    """DP-rank routing (reference profilehandler/dataparallel/
+    dp_profile_handler.go:21-40, deprecated there in favor of Istio ≥1.28.1
+    but kept for inventory parity): a single profile picks the pod; this
+    handler then selects a DP rank and writes x-data-parallel-host-port so
+    the sidecar's per-rank listener (port+rank) dispatches to that rank's
+    engine. Rank count comes from the pod label llm-d.ai/dp-size."""
+
+    DP_SIZE_LABEL = "llm-d.ai/dp-size"
+
+    def __init__(self, name: str | None = None):
+        super().__init__(name)
+        self._rr = 0
+
+    def pick_profiles(self, ctx, request, profiles, results):
+        return {} if results else profiles
+
+    def process_results(self, ctx, request, results):
+        ok = {n: r for n, r in results.items() if r is not None}
+        if not ok:
+            raise SchedulingError("no profile produced a target endpoint")
+        return SchedulingResult(profile_results=ok,
+                                primary_profile_name=next(iter(ok)))
+
+    def pre_request(self, ctx, request: InferenceRequest,
+                    result: SchedulingResult) -> None:
+        targets = result.primary().target_endpoints
+        if not targets:
+            return
+        ep = targets[0]
+        try:
+            dp_size = int(ep.metadata.labels.get(self.DP_SIZE_LABEL, "1"))
+        except ValueError:
+            dp_size = 1
+        if dp_size <= 1:
+            return
+        rank = self._rr % dp_size
+        self._rr += 1
+        request.headers[H_DATA_PARALLEL] = (
+            f"{ep.metadata.address}:{ep.metadata.port + rank}")
 
 
 @register_plugin("disagg-profile-handler", "pd-profile-handler")

@@ -31,7 +31,7 @@ from typing import Any
 import httpx
 from aiohttp import web
 
-from ..requestcontrol.director import H_ENCODERS, H_PREFILLER
+from ..requestcontrol.director import H_DATA_PARALLEL, H_ENCODERS, H_PREFILLER
 
 log = logging.getLogger("router.sidecar")
 
@@ -70,6 +70,26 @@ class Sidecar:
         self._runner: web.AppRunner | None = None
         self._client: httpx.AsyncClient | None = None
         self._dp_children: list["Sidecar"] = []
+
+    def _dp_header_url(self, request: web.Request) -> str | None:
+        """Legacy x-data-parallel-host-port dispatch (data_parallel.go:19-88):
+        honored only when it names one of THIS decoder's rank ports."""
+        hp = request.headers.get(H_DATA_PARALLEL)
+        if not hp:
+            return None
+        from urllib.parse import urlsplit
+
+        parts = urlsplit(self.cfg.decoder_url)
+        try:
+            host, _, port = hp.rpartition(":")
+            port = int(port)
+        except ValueError:
+            return None
+        if (host == parts.hostname and parts.port is not None
+                and parts.port <= port < parts.port + max(self.cfg.data_parallel_size, 1)):
+            return f"{parts.scheme}://{host}:{port}"
+        log.warning("ignoring out-of-range %s: %s", H_DATA_PARALLEL, hp)
+        return None
 
     def _rank_url(self) -> str:
         """decoder URL shifted by this listener's DP rank (data_parallel.go:39-88)."""
@@ -269,9 +289,11 @@ class Sidecar:
                      and "kv_transfer_params" not in body
                      and int(body.get("max_tokens") or 16) > 0
                      and ("messages" in body or isinstance(body.get("prompt"), str)))
+        base_url = self._dp_header_url(request) or self._rank_url()
         if chunkable:
-            return await self._chunked_decode(request, body, extra_headers)
-        url = self._rank_url() + request.path
+            return await self._chunked_decode(request, body, extra_headers,
+                                              base_url)
+        url = base_url + request.path
         try:
             upstream = self._client.build_request(
                 "POST", url, json=body, headers={"content-type": "application/json"})
@@ -297,8 +319,8 @@ class Sidecar:
             await resp.aclose()
 
     async def _chunked_decode(self, request: web.Request, body: dict[str, Any],
-                              extra_headers: dict[str, str] | None
-                              ) -> web.StreamResponse:
+                              extra_headers: dict[str, str] | None,
+                              base_url: str | None = None) -> web.StreamResponse:
         """Bounded decode slices (reference decode.go:62-444): issue decode in
         max_tokens=chunk steps, re-appending the generated text between steps
         (chat uses the continue-final-message pattern)."""
@@ -320,8 +342,8 @@ class Sidecar:
                 step_body["messages"] = msgs
             else:
                 step_body["prompt"] = body["prompt"] + acc_text
-            r = await self._client.post(self._rank_url() + request.path,
-                                        json=step_body)
+            r = await self._client.post(
+                (base_url or self._rank_url()) + request.path, json=step_body)
             if r.status_code != 200:
                 return web.Response(body=r.content, status=r.status_code,
                                     content_type="application/json")

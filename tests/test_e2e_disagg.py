@@ -280,3 +280,64 @@ def test_shared_storage_connector():
             await dec.stop()
 
     asyncio.run(body())
+
+
+def test_data_parallel_profile_handler():
+    """DP handler writes x-data-parallel-host-port from the dp-size label; the
+    sidecar dispatches to that rank's engine; out-of-range targets ignored."""
+    GW4, SC4, E0, E1 = 18440, 18441, 18445, 18446
+
+    cfg = f"""
+pool:
+  endpoints:
+    - {{address: 127.0.0.1, port: {SC4},
+       labels: {{llm-d.ai/role: decode, llm-d.ai/dp-size: "2"}}}}
+plugins:
+  - {{type: queue-scorer}}
+  - {{type: data-parallel-profile-handler}}
+schedulingProfiles:
+  - name: default
+    plugins:
+      - {{pluginRef: queue-scorer}}
+"""
+
+    async def body():
+        engines = [EngineServer(EngineConfig(backend="sim", model="tiny", port=p))
+                   for p in (E0, E1)]
+        for e in engines:
+            await e.start()
+        sc = Sidecar(SidecarConfig(port=SC4, decoder_url=f"http://127.0.0.1:{E0}",
+                                   data_parallel_size=2))
+        await sc.start()
+        gw = build_gateway(cfg, port=GW4, poll_interval=0.02)
+        await gw.start()
+        try:
+            async with httpx.AsyncClient(timeout=60) as c:
+                served_ranks = set()
+                for _ in range(4):
+                    r = await c.post(f"http://127.0.0.1:{GW4}/v1/completions",
+                                     json={"model": "tiny", "prompt": "x",
+                                           "max_tokens": 2})
+                    assert r.status_code == 200
+                # round-robin must have touched both rank engines
+                m0 = (await c.get(f"http://127.0.0.1:{E0}/metrics")).text
+                m1 = (await c.get(f"http://127.0.0.1:{E1}/metrics")).text
+                for m in (m0, m1):
+                    for line in m.splitlines():
+                        if line.startswith("jetstream:generation_tokens_total "):
+                            served_ranks.add(float(line.split()[-1]) > 0)
+                assert served_ranks == {True}
+
+                # out-of-range header at the sidecar -> ignored, still served
+                r = await c.post(f"http://127.0.0.1:{SC4}/v1/completions",
+                                 json={"prompt": "x", "max_tokens": 1},
+                                 headers={"x-data-parallel-host-port":
+                                          "127.0.0.1:9"})
+                assert r.status_code == 200
+        finally:
+            await gw.stop()
+            await sc.stop()
+            for e in engines:
+                await e.stop()
+
+    asyncio.run(body())

@@ -53,3 +53,10 @@ def test_health_check_over_real_grpc():
             await server.stop()
 
     asyncio.run(body())
+
+
+def test_parse_request_truncated_input():
+    # truncated length byte / unterminated varint must degrade to "" not raise
+    assert parse_request(b"\x0a") == ""
+    assert parse_request(b"\x0a\x80") == ""
+    assert parse_request(b"\x08\x80") == ""
