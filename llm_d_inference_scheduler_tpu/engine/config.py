@@ -28,6 +28,7 @@ class EngineConfig:
     engine_id: str = ""
     checkpoint_path: str = ""     # orbax dir; empty = random init (dev/bench)
     enable_prefix_caching: bool = True  # automatic prefix caching (block reuse)
+    warmup: bool = False          # compile prefill/decode/sample before serving
     pallas_attention: bool = False  # Pallas paged-attention decode kernel (TPU)
     pallas_interpret: bool = False  # interpret the kernel (CPU testing only)
     # KV cache event stream (ZMQ PUB) feeding the router's precise prefix

@@ -104,6 +104,7 @@ class TpuEngine:
         self.k_pages = jnp.zeros(kshape, dtype)
         self.v_pages = jnp.zeros(kshape, dtype)
 
+        self.warming = cfg.warmup  # cleared by the engine thread post-compile
         self.slots: list[_Slot | None] = [None] * cfg.max_batch
         self._waiting: list[tuple[EngineRequest, asyncio.Queue, asyncio.AbstractEventLoop]] = []
         self._import_ready: list[_PendingImport] = []
@@ -223,8 +224,29 @@ class TpuEngine:
             b *= 2
         return min(b, self.cfg.max_model_len)
 
+    def _warmup(self):
+        """Compile the hot jits before serving (smallest prefill bucket,
+        decode step, sampler) — all writes land in the trash block."""
+        t0 = time.monotonic()
+        B = self.cfg.max_batch
+        bucket = self._bucket(16)  # respects max_model_len < 16
+        row = jnp.zeros((1, self.max_blocks_per_seq), jnp.int32)
+        fn = self._prefill_fn(bucket)
+        logits, self.k_pages, self.v_pages = fn(
+            self.params, jnp.zeros((1, bucket), jnp.int32),
+            jnp.asarray([1], jnp.int32), self.k_pages, self.v_pages, row)
+        _ = self._sample(logits, [_DUMMY_REQ])
+        dl, self.k_pages, self.v_pages = self._jit_decode(
+            self.params, jnp.zeros((B,), jnp.int32), jnp.zeros((B,), jnp.int32),
+            self.k_pages, self.v_pages,
+            jnp.zeros((B, self.max_blocks_per_seq), jnp.int32))
+        _ = self._sample(dl, [_DUMMY_REQ] * B)
+        log.info("engine warm-up compiled prefill/decode/sample in %.1fs",
+                 time.monotonic() - t0)
+
     def _run(self):
         if self.kv_events is not None:
+            # Bind BEFORE warm-up: subscribers join during the compile window.
             try:
                 # Bind here so the PUB socket lives on the thread that uses it
                 # AND subscribers can join long before the first real event.
@@ -232,6 +254,21 @@ class TpuEngine:
             except Exception:
                 log.exception("kv event publisher bind failed; disabled")
                 self.kv_events = None
+        if self.cfg.warmup:
+            try:
+                self._warmup()
+            except Exception:
+                # Donated page buffers may already be invalidated mid-call:
+                # reallocate so the engine serves cold instead of poisoned.
+                log.exception("engine warm-up failed; reallocating pages, "
+                              "serving cold")
+                kshape = (self.mcfg.n_layers, self.n_blocks,
+                          self.mcfg.kv_block_size, self.mcfg.n_kv_heads,
+                          self.mcfg.head_dim)
+                dtype = jnp.dtype(self.mcfg.dtype)
+                self.k_pages = jnp.zeros(kshape, dtype)
+                self.v_pages = jnp.zeros(kshape, dtype)
+        self.warming = False
         while True:
             with self._cond:
                 while (not self._stop and not self._waiting and not self._import_ready

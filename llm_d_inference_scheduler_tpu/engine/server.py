@@ -346,10 +346,12 @@ class EngineServer:
                             content_type="text/plain", charset="utf-8")
 
     async def health(self, request: web.Request) -> web.Response:
+        warming = bool(getattr(self.engine, "warming", False))
         return web.json_response({
-            "status": "ok", "engine_id": self.engine.engine_id,
+            "status": "warming" if warming else "ok",
+            "engine_id": self.engine.engine_id,
             "model": self.engine.model_name, "role": self.cfg.role,
-        })
+        }, status=503 if warming else 200)
 
     # ---- KV handoff data path (P/D disaggregation) ---------------------
 
@@ -450,6 +452,8 @@ def main(argv: list[str] | None = None):
                    help="pin the JAX platform (e.g. 'cpu'); needed to run a second "
                         "engine process on a box whose TPU chip is already claimed")
     p.add_argument("--checkpoint", default="", help="orbax checkpoint dir to load")
+    p.add_argument("--warmup", action="store_true",
+                   help="compile prefill/decode before serving")
     args = p.parse_args(argv)
     if args.platform:
         import jax
@@ -458,7 +462,7 @@ def main(argv: list[str] | None = None):
                        host=args.host, max_batch=args.max_batch,
                        max_model_len=args.max_model_len, role=args.role,
                        served_model_name=args.served_model_name,
-                       checkpoint_path=args.checkpoint)
+                       checkpoint_path=args.checkpoint, warmup=args.warmup)
     logging.basicConfig(level=logging.INFO)
     asyncio.run(run_server(cfg))
 

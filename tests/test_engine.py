@@ -185,3 +185,25 @@ def test_pd_handoff_between_tpu_engines():
             await dec.stop()
 
     run(body())
+
+
+def test_engine_warmup_compiles_before_serving():
+    async def body():
+        from llm_d_inference_scheduler_tpu.engine.core import TpuEngine
+
+        eng = TpuEngine(_cfg("tpu", 0, warmup=True))
+        await eng.start()
+        try:
+            # warm-up must not corrupt state: a normal request still works and
+            # all blocks stay accounted for.
+            out = eng.submit(EngineRequest(request_id="w", prompt_token_ids=[1, 2, 3],
+                                           max_tokens=2, ignore_eos=True))
+            while True:
+                ev = await asyncio.wait_for(out.get(), timeout=60)
+                if ev.finish_reason is not None:
+                    break
+            assert ev.finish_reason is not None
+        finally:
+            await eng.stop()
+
+    run(body())
