@@ -98,11 +98,7 @@ class TpuEngine:
             self.params = load_params(cfg.checkpoint_path, self.mcfg)
         else:
             self.params = llama.init_params(self.mcfg, jax.random.key(cfg.seed))
-        kshape = (self.mcfg.n_layers, self.n_blocks, block,
-                  self.mcfg.n_kv_heads, self.mcfg.head_dim)
-        dtype = jnp.dtype(self.mcfg.dtype)
-        self.k_pages = jnp.zeros(kshape, dtype)
-        self.v_pages = jnp.zeros(kshape, dtype)
+        self.k_pages, self.v_pages = self._alloc_pages()
 
         self.warming = cfg.warmup  # cleared by the engine thread post-compile
         self.slots: list[_Slot | None] = [None] * cfg.max_batch
@@ -136,6 +132,13 @@ class TpuEngine:
             lambda kp, vp, blocks, k_new, v_new: (
                 kp.at[:, blocks].set(k_new), vp.at[:, blocks].set(v_new)),
             donate_argnums=(0, 1))
+
+    def _alloc_pages(self) -> tuple[jnp.ndarray, jnp.ndarray]:
+        """Fresh zeroed KV page buffers (init + warm-up failure recovery)."""
+        kshape = (self.mcfg.n_layers, self.n_blocks, self.mcfg.kv_block_size,
+                  self.mcfg.n_kv_heads, self.mcfg.head_dim)
+        dtype = jnp.dtype(self.mcfg.dtype)
+        return jnp.zeros(kshape, dtype), jnp.zeros(kshape, dtype)
 
     # ---- jitted bodies -------------------------------------------------
 
@@ -235,12 +238,14 @@ class TpuEngine:
         logits, self.k_pages, self.v_pages = fn(
             self.params, jnp.zeros((1, bucket), jnp.int32),
             jnp.asarray([1], jnp.int32), self.k_pages, self.v_pages, row)
+        saved_key = self._sample_key  # keep seeded outputs flag-independent
         _ = self._sample(logits, [_DUMMY_REQ])
         dl, self.k_pages, self.v_pages = self._jit_decode(
             self.params, jnp.zeros((B,), jnp.int32), jnp.zeros((B,), jnp.int32),
             self.k_pages, self.v_pages,
             jnp.zeros((B, self.max_blocks_per_seq), jnp.int32))
         _ = self._sample(dl, [_DUMMY_REQ] * B)
+        self._sample_key = saved_key
         log.info("engine warm-up compiled prefill/decode/sample in %.1fs",
                  time.monotonic() - t0)
 
@@ -262,12 +267,7 @@ class TpuEngine:
                 # reallocate so the engine serves cold instead of poisoned.
                 log.exception("engine warm-up failed; reallocating pages, "
                               "serving cold")
-                kshape = (self.mcfg.n_layers, self.n_blocks,
-                          self.mcfg.kv_block_size, self.mcfg.n_kv_heads,
-                          self.mcfg.head_dim)
-                dtype = jnp.dtype(self.mcfg.dtype)
-                self.k_pages = jnp.zeros(kshape, dtype)
-                self.v_pages = jnp.zeros(kshape, dtype)
+                self.k_pages, self.v_pages = self._alloc_pages()
         self.warming = False
         while True:
             with self._cond:

@@ -192,17 +192,24 @@ def test_engine_warmup_compiles_before_serving():
         from llm_d_inference_scheduler_tpu.engine.core import TpuEngine
 
         eng = TpuEngine(_cfg("tpu", 0, warmup=True))
+        assert eng.warming
         await eng.start()
         try:
-            # warm-up must not corrupt state: a normal request still works and
-            # all blocks stay accounted for.
+            # warm-up must complete and not corrupt state: a normal request
+            # works afterwards and all blocks stay accounted for.
             out = eng.submit(EngineRequest(request_id="w", prompt_token_ids=[1, 2, 3],
                                            max_tokens=2, ignore_eos=True))
             while True:
-                ev = await asyncio.wait_for(out.get(), timeout=60)
+                ev = await asyncio.wait_for(out.get(), timeout=120)
                 if ev.finish_reason is not None:
                     break
-            assert ev.finish_reason is not None
+            assert not eng.warming  # warm-up ran (serving happens after it)
+            assert ev.finish_reason.value == "length"
+            for _ in range(50):
+                if eng.allocator.free_blocks == eng.n_blocks - 1:
+                    break
+                await asyncio.sleep(0.05)
+            assert eng.allocator.free_blocks == eng.n_blocks - 1
         finally:
             await eng.stop()
 

@@ -1,0 +1,53 @@
+"""Batched sampler: greedy, temperature, top-k and top-p restriction."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llm_d_inference_scheduler_tpu.engine.sampling import sample_tokens
+
+
+def _run(logits, temps, top_k, top_p, n=300, seed=0):
+    keys = jax.random.split(jax.random.key(seed), n)
+    fn = jax.vmap(lambda k: sample_tokens(
+        jnp.asarray(logits), k, jnp.asarray(temps, jnp.float32),
+        jnp.asarray(top_k, jnp.int32), jnp.asarray(top_p, jnp.float32)))
+    return np.asarray(jax.jit(fn)(keys))  # [n, B]
+
+
+def test_greedy_is_argmax():
+    logits = np.array([[0.1, 3.0, -1.0, 0.5], [2.0, 0.0, 5.0, 1.0]], np.float32)
+    out = _run(logits, temps=[0.0, 0.0], top_k=[0, 0], top_p=[1.0, 1.0], n=3)
+    assert (out[:, 0] == 1).all() and (out[:, 1] == 2).all()
+
+
+def test_top_k_restricts_support():
+    # Row: top-2 tokens are ids 1 and 3; with top_k=2 nothing else may appear.
+    logits = np.array([[0.0, 4.0, 1.0, 3.0, 2.0]], np.float32)
+    out = _run(logits, temps=[1.0], top_k=[2], top_p=[1.0])
+    assert set(np.unique(out)) <= {1, 3}
+    assert {1, 3} <= set(np.unique(out))  # both actually sampled
+
+
+def test_top_p_keeps_minimal_prefix():
+    # Probabilities ~ [0.64, 0.23, 0.09, 0.03, ...]; p=0.5 keeps only the top
+    # token plus the one that crosses the boundary (prefix rule keeps token 1).
+    logits = np.array([[4.0, 3.0, 2.0, 1.0, 0.0]], np.float32)
+    out = _run(logits, temps=[1.0], top_k=[0], top_p=[0.5])
+    assert set(np.unique(out)) <= {0, 1}
+
+
+def test_per_row_independent_settings():
+    logits = np.array([[0.0, 5.0, 0.0], [5.0, 0.0, 4.9]], np.float32)
+    # Row 0 greedy; row 1 hot temperature with full support.
+    out = _run(logits, temps=[0.0, 2.0], top_k=[0, 0], top_p=[1.0, 1.0])
+    assert (out[:, 0] == 1).all()
+    assert len(np.unique(out[:, 1])) >= 2  # high temp explores
+
+
+def test_temperature_sharpness():
+    logits = np.array([[2.0, 1.0, 0.0]], np.float32)
+    cold = _run(logits, temps=[0.2], top_k=[0], top_p=[1.0])
+    hot = _run(logits, temps=[3.0], top_k=[0], top_p=[1.0], seed=1)
+    # Cold sampling should pick the mode far more often than hot.
+    assert (cold == 0).mean() > (hot == 0).mean() + 0.15
