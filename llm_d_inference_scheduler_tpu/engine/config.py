@@ -31,6 +31,10 @@ class EngineConfig:
     warmup: bool = False          # compile prefill/decode/sample before serving
     pallas_attention: bool = False  # Pallas paged-attention decode kernel (TPU)
     pallas_interpret: bool = False  # interpret the kernel (CPU testing only)
+    # Tensor parallelism: shard params (Megatron TP) + KV pages (kv-head axis)
+    # over a tp-sized mesh axis; remaining devices form the dp axis. 1 = the
+    # single-device layout (no mesh). BASELINE.md config 4 path.
+    tp_size: int = 1
     # KV cache event stream (ZMQ PUB) feeding the router's precise prefix
     # scorer; 0 disables, -1 = port + 1000.
     kv_events_port: int = -1

@@ -90,12 +90,36 @@ class TpuEngine:
                           else BlockAllocator(self.n_blocks, block))
         self.telemetry = EngineTelemetry(block_size=block, num_blocks=self.n_blocks)
 
-        if params is not None:
-            self.params = params
-        elif cfg.checkpoint_path:
-            from .checkpoint import load_params
+        # Optional TP-sharded serving: params follow Megatron TP pspecs, KV
+        # pages shard the kv-head axis (parallel/serve.py). tp_size=1 keeps
+        # the plain single-device layout. The mesh spans exactly tp_size
+        # devices (dp=1): the engine does not dp-shard its batch, so claiming
+        # more devices would only replicate the compute.
+        self.mesh = None
+        if cfg.tp_size > 1:
+            from ..parallel.serve import make_serve_mesh, validate_tp
 
-            self.params = load_params(cfg.checkpoint_path, self.mcfg)
+            validate_tp(self.mcfg, cfg.tp_size)
+            self.mesh = make_serve_mesh(jax.devices()[: cfg.tp_size],
+                                        tp=cfg.tp_size)
+
+        if params is not None or cfg.checkpoint_path:
+            if params is None:
+                from .checkpoint import load_params
+
+                params = load_params(cfg.checkpoint_path, self.mcfg)
+            if self.mesh is not None:
+                # Checkpoint-loaded / caller-passed params land unsharded.
+                from ..parallel.serve import serve_shardings
+
+                shardings, _ = serve_shardings(self.mcfg, self.mesh)
+                params = jax.device_put(params, shardings)
+            self.params = params
+        elif self.mesh is not None:
+            from ..parallel.serve import init_sharded_params
+
+            self.params = init_sharded_params(self.mcfg, self.mesh,
+                                              jax.random.key(cfg.seed))
         else:
             self.params = llama.init_params(self.mcfg, jax.random.key(cfg.seed))
         self.k_pages, self.v_pages = self._alloc_pages()
@@ -135,6 +159,10 @@ class TpuEngine:
 
     def _alloc_pages(self) -> tuple[jnp.ndarray, jnp.ndarray]:
         """Fresh zeroed KV page buffers (init + warm-up failure recovery)."""
+        if self.mesh is not None:
+            from ..parallel.serve import alloc_sharded_pages
+
+            return alloc_sharded_pages(self.mcfg, self.mesh, self.n_blocks)
         kshape = (self.mcfg.n_layers, self.n_blocks, self.mcfg.kv_block_size,
                   self.mcfg.n_kv_heads, self.mcfg.head_dim)
         dtype = jnp.dtype(self.mcfg.dtype)
@@ -274,6 +302,10 @@ class TpuEngine:
                 while (not self._stop and not self._waiting and not self._import_ready
                        and not self._abort_ids and not any(self.slots)):
                     self._cond.wait(timeout=0.1)
+                    # Keep the 1s KV snapshot cadence alive while idle: a
+                    # subscriber joining an idle-but-warm engine must still
+                    # learn its cache contents (PUB/SSE have no replay).
+                    self._publish_kv_snapshot()
                 if self._stop:
                     return
             try:

@@ -15,9 +15,16 @@ def sample_tokens(
     top_k: jnp.ndarray,    # [B] int32; 0 disables
     top_p: jnp.ndarray,    # [B] f32; >=1 disables
 ) -> jnp.ndarray:
-    """Per-row temperature/top-k/top-p sampling with greedy fallback."""
+    """Per-row temperature/top-k/top-p sampling with greedy fallback.
+
+    Temperature is applied BEFORE the nucleus truncation (vLLM/OpenAI
+    semantics: the kept top-p set is computed on the tempered distribution;
+    top-k is rank-based and unaffected by the scaling).
+    """
     B, V = logits.shape
     greedy = jnp.argmax(logits, axis=-1)
+    safe_t = jnp.maximum(temps, 1e-4)[:, None]
+    logits = logits / safe_t
 
     def restricted(logits):
         # Rank-based top-k: keep entries whose descending rank < k.
@@ -37,6 +44,5 @@ def sample_tokens(
     needs_restrict = jnp.any((top_k > 0) | (top_p < 1.0))
     logits = jax.lax.cond(needs_restrict, restricted, lambda l: l, logits)
 
-    safe_t = jnp.maximum(temps, 1e-4)[:, None]
-    sampled = jax.random.categorical(key, logits / safe_t, axis=-1)
+    sampled = jax.random.categorical(key, logits, axis=-1)
     return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)

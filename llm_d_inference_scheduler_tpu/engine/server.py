@@ -157,7 +157,10 @@ class EngineServer:
                 request_id=str(body.get("request_id") or f"req-{uuid.uuid4().hex[:12]}"),
                 prompt_token_ids=prompt_ids,
                 max_tokens=int(body.get("max_tokens") or 16),
-                temperature=float(body.get("temperature") or 0.0),
+                # OpenAI-compatible default: temperature 1.0 when absent
+                # (explicit 0/0.0 still means greedy).
+                temperature=(1.0 if body.get("temperature") is None
+                             else float(body["temperature"])),
                 top_k=int(body.get("top_k") or 0),
                 top_p=float(body.get("top_p") if body.get("top_p") is not None else 1.0),
                 stream=bool(body.get("stream", False)),
@@ -454,6 +457,9 @@ def main(argv: list[str] | None = None):
     p.add_argument("--checkpoint", default="", help="orbax checkpoint dir to load")
     p.add_argument("--warmup", action="store_true",
                    help="compile prefill/decode before serving")
+    p.add_argument("--tp-size", type=int, default=1,
+                   help="tensor-parallel degree: shard params + KV pages over "
+                        "this many devices (BASELINE config 4 path)")
     args = p.parse_args(argv)
     if args.platform:
         import jax
@@ -462,7 +468,8 @@ def main(argv: list[str] | None = None):
                        host=args.host, max_batch=args.max_batch,
                        max_model_len=args.max_model_len, role=args.role,
                        served_model_name=args.served_model_name,
-                       checkpoint_path=args.checkpoint, warmup=args.warmup)
+                       checkpoint_path=args.checkpoint, warmup=args.warmup,
+                       tp_size=args.tp_size)
     logging.basicConfig(level=logging.INFO)
     asyncio.run(run_server(cfg))
 

@@ -2,6 +2,13 @@ from .mesh import make_mesh, mesh_shape
 from .shardings import param_pspecs, ACT_SPEC
 from .ring_attention import ring_attention, make_ring_attention_fn
 from .train import make_train_state, make_train_step
+from .serve import (
+    make_serve_mesh,
+    serve_shardings,
+    init_sharded_params,
+    alloc_sharded_pages,
+    dryrun_serve,
+)
 
 __all__ = [
     "make_mesh",
@@ -12,4 +19,9 @@ __all__ = [
     "make_ring_attention_fn",
     "make_train_state",
     "make_train_step",
+    "make_serve_mesh",
+    "serve_shardings",
+    "init_sharded_params",
+    "alloc_sharded_pages",
+    "dryrun_serve",
 ]

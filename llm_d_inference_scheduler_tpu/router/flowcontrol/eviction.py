@@ -36,23 +36,33 @@ class _InFlight:
 
 
 class RequestEvictor:
-    """Tracks in-flight requests; evicts sheddable ones on demand."""
+    """Tracks in-flight requests; evicts sheddable ones on demand.
+
+    Entries are keyed by a server-generated unique key (returned from
+    ``register``), NOT the client-supplied x-request-id: two concurrent
+    requests reusing an id must stay independently trackable (the id is kept
+    only as a log label).
+    """
 
     def __init__(self):
         self._inflight: dict[str, _InFlight] = {}
         self._evicted: set[str] = set()
+        self._seq = 0
 
     def register(self, request_id: str, priority: int,
-                 cancel: Callable[[], None]) -> None:
-        self._inflight[request_id] = _InFlight(
+                 cancel: Callable[[], None]) -> str:
+        self._seq += 1
+        key = f"{request_id}#{self._seq}"
+        self._inflight[key] = _InFlight(
             request_id, priority, time.monotonic(), cancel)
+        return key
 
-    def deregister(self, request_id: str) -> None:
-        self._inflight.pop(request_id, None)
-        self._evicted.discard(request_id)
+    def deregister(self, key: str) -> None:
+        self._inflight.pop(key, None)
+        self._evicted.discard(key)
 
-    def was_evicted(self, request_id: str) -> bool:
-        return request_id in self._evicted
+    def was_evicted(self, key: str) -> bool:
+        return key in self._evicted
 
     @property
     def inflight_count(self) -> int:
@@ -64,12 +74,12 @@ class RequestEvictor:
         priority-then-time-eviction-order-policy + sheddable-eviction-filter).
         """
         sheddable = sorted(
-            (r for r in self._inflight.values() if r.priority < 0),
-            key=lambda r: (r.priority, r.start_time))
+            ((k, r) for k, r in self._inflight.items() if r.priority < 0),
+            key=lambda kv: (kv[1].priority, kv[1].start_time))
         evicted = 0
-        for rec in sheddable[:n]:
-            self._evicted.add(rec.request_id)
-            self._inflight.pop(rec.request_id, None)
+        for key, rec in sheddable[:n]:
+            self._evicted.add(key)
+            self._inflight.pop(key, None)
             try:
                 rec.cancel()
             except Exception:

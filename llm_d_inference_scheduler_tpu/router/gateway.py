@@ -316,7 +316,8 @@ class Gateway:
                 ireq.headers.pop(H_DATA_PARALLEL, None)
 
         task = asyncio.current_task()
-        self.evictor.register(ireq.request_id, ireq.objectives.priority, task.cancel)
+        evict_key = self.evictor.register(ireq.request_id,
+                                          ireq.objectives.priority, task.cancel)
         stream_state = {"started": False}
         try:
             return await self._proxy(request, ireq, target, body_out, ireq.headers,
@@ -324,7 +325,7 @@ class Gateway:
                                      stream_state=stream_state,
                                      url_override=override)
         except asyncio.CancelledError:
-            if self.evictor.was_evicted(ireq.request_id) and not stream_state["started"]:
+            if self.evictor.was_evicted(evict_key) and not stream_state["started"]:
                 from .flowcontrol.eviction import EVICTED_REASON
 
                 return web.json_response(
@@ -335,7 +336,7 @@ class Gateway:
             # connection, so propagate.
             raise
         finally:
-            self.evictor.deregister(ireq.request_id)
+            self.evictor.deregister(evict_key)
 
     async def _proxy(self, request: web.Request, ireq: InferenceRequest | None,
                      endpoint, body: bytes, headers: dict[str, str],

@@ -10,6 +10,12 @@ EndpointLifecycle hooks tear per-pod subscribers up and down.
 
 Engine side: engine/kv_events.py publishes stored/removed block-hash events
 on tcp://<pod>:<port+1000> using the shared hash chain (utils/hashing.py).
+
+Transports: the default "http" (SSE /kv_events) works both against direct
+engine endpoints and sidecar-fronted ones (the sidecar stream-proxies the
+route). The "zmq" transport requires DIRECT engine endpoints: the engine
+binds its serving-port+offset, which a sidecar-fronted endpoint's port does
+not resolve to (an HTTP sidecar cannot proxy ZMQ).
 """
 
 from __future__ import annotations

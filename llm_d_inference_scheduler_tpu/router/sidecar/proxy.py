@@ -66,6 +66,9 @@ class Sidecar:
             web.get("/metrics", self._proxy_get),
             web.get("/health", self._proxy_get),
             web.get("/v1/models", self._proxy_get),
+            # Streaming: the precise-prefix scorer's SSE subscriber must work
+            # against sidecar-fronted decode endpoints too (ADVICE r1).
+            web.get("/kv_events", self._proxy_get_stream),
         ])
         self._runner: web.AppRunner | None = None
         self._client: httpx.AsyncClient | None = None
@@ -377,6 +380,32 @@ class Sidecar:
                                                            "text/plain").split(";")[0])
         except Exception as e:
             return web.json_response({"error": str(e)}, status=502)
+
+    async def _proxy_get_stream(self, request: web.Request) -> web.StreamResponse:
+        """Long-lived streaming GET proxy (SSE /kv_events): bytes are relayed
+        as they arrive, no buffering — the KV index must see events live."""
+        url = self._rank_url() + request.path
+        try:
+            upstream = self._client.build_request(
+                "GET", url, headers={"accept": "text/event-stream"})
+            resp = await self._client.send(upstream, stream=True)
+        except Exception as e:
+            return web.json_response({"error": str(e)}, status=502)
+        ws = web.StreamResponse(status=resp.status_code, headers={
+            "content-type": resp.headers.get("content-type",
+                                             "text/event-stream")})
+        try:
+            await ws.prepare(request)
+            async for chunk in resp.aiter_bytes():
+                await ws.write(chunk)
+            await ws.write_eof()
+        except (ConnectionResetError, ConnectionError, httpx.HTTPError) as e:
+            # Routine subscriber teardown / engine restart mid-stream: not an
+            # error worth a traceback; the subscriber reconnects.
+            log.debug("kv_events relay ended: %s", e)
+        finally:
+            await resp.aclose()
+        return ws
 
 
 def main(argv: list[str] | None = None):

@@ -3,7 +3,15 @@ event stream — both sides MUST hash identically or precise prefix scores are
 garbage (SURVEY §7 "hard parts": block hashing must match the engine's).
 
 Scheme (reference approximateprefix/hashing.go:35-101): h_0 = xxh64(model);
-h_i = xxh64(h_{i-1} || block_i) over complete blocks only.
+h_i = xxh64(block_i || h_{i-1}) — block content first, then the previous hash,
+matching the reference byte order so a mixed fleet (reference-side indexers +
+this engine) shares one hash space for complete blocks.
+
+Intentional deviation: the reference also hashes the trailing PARTIAL block;
+we drop it. The TPU engine content-addresses only complete KV blocks (a
+partial block's hash changes with every appended token and can never be
+committed or matched by the allocator), so emitting it would only depress
+precise-prefix hit ratios for non-block-aligned prompts.
 """
 
 from __future__ import annotations
@@ -23,8 +31,9 @@ def chain_block_hashes(model: str, token_ids: list[int] | None, text: str,
                   for i in range(0, len(token_ids), block_size_tokens)]
         blocks = [b for b in blocks if len(b) == block_size_tokens]
         for b in blocks[:MAX_PREFIX_BLOCKS]:
-            data = h.to_bytes(8, "little") + b"".join(
-                t.to_bytes(4, "little", signed=False) for t in b)
+            data = b"".join(
+                t.to_bytes(4, "little", signed=False) for t in b
+            ) + h.to_bytes(8, "little")
             h = xxhash.xxh64(data).intdigest()
             out.append(h)
     else:
@@ -33,6 +42,6 @@ def chain_block_hashes(model: str, token_ids: list[int] | None, text: str,
         chunks = [raw[i:i + step] for i in range(0, len(raw), step)]
         chunks = [c for c in chunks if len(c) == step]
         for c in chunks[:MAX_PREFIX_BLOCKS]:
-            h = xxhash.xxh64(h.to_bytes(8, "little") + c).intdigest()
+            h = xxhash.xxh64(c + h.to_bytes(8, "little")).intdigest()
             out.append(h)
     return out

@@ -68,7 +68,8 @@ def test_disagg_path_end_to_end():
             async with httpx.AsyncClient(timeout=120) as c:
                 # Monolithic reference answer straight from the decode engine.
                 r = await c.post(f"http://127.0.0.1:{DEC}/v1/completions",
-                                 json={"prompt": LONG_PROMPT, "max_tokens": 6})
+                                 json={"prompt": LONG_PROMPT, "max_tokens": 6,
+                                       "temperature": 0})
                 mono_text = r.json()["choices"][0]["text"]
 
                 pre_prompt_tokens_before = _counter_value(
@@ -77,7 +78,7 @@ def test_disagg_path_end_to_end():
                 # Through the router: long prompt → P/D split.
                 r = await c.post(f"http://127.0.0.1:{GW}/v1/completions",
                                  json={"model": "tiny", "prompt": LONG_PROMPT,
-                                       "max_tokens": 6})
+                                       "max_tokens": 6, "temperature": 0})
                 assert r.status_code == 200
                 assert r.headers["x-gateway-destination-endpoint-served"] == \
                     f"127.0.0.1:{SC}"
@@ -339,5 +340,38 @@ schedulingProfiles:
             await sc.stop()
             for e in engines:
                 await e.stop()
+
+    asyncio.run(body())
+
+def test_sidecar_proxies_kv_events_stream():
+    """The precise-prefix SSE subscriber must work against sidecar-fronted
+    decode endpoints: GET /kv_events is stream-proxied (ADVICE r1)."""
+    DEC6, SC6 = 18375, 18376
+
+    async def body():
+        dec = _engine(DEC6, "decode")
+        await dec.start()
+        sc = Sidecar(SidecarConfig(port=SC6, decoder_url=f"http://127.0.0.1:{DEC6}"))
+        await sc.start()
+        try:
+            async with httpx.AsyncClient(timeout=30) as c:
+                # Generate so the engine publishes stored block hashes.
+                r = await c.post(f"http://127.0.0.1:{DEC6}/v1/completions",
+                                 json={"prompt": "hello " * 20, "max_tokens": 2})
+                assert r.status_code == 200
+
+                got_stored = False
+                async with c.stream(
+                        "GET", f"http://127.0.0.1:{SC6}/kv_events") as resp:
+                    assert resp.status_code == 200
+                    assert "text/event-stream" in resp.headers["content-type"]
+                    async for line in resp.aiter_lines():
+                        if line.startswith("data: ") and '"stored"' in line:
+                            got_stored = True
+                            break
+                assert got_stored
+        finally:
+            await sc.stop()
+            await dec.stop()
 
     asyncio.run(body())

@@ -153,7 +153,8 @@ def test_pd_handoff_between_tpu_engines():
         try:
             async with httpx.AsyncClient() as c:
                 r = await c.post("http://127.0.0.1:18311/v1/completions",
-                                 json={"prompt": prompt, "max_tokens": max_tokens},
+                                 json={"prompt": prompt, "max_tokens": max_tokens,
+                                       "temperature": 0},
                                  timeout=60)
                 mono_text = r.json()["choices"][0]["text"]
         finally:
@@ -167,6 +168,7 @@ def test_pd_handoff_between_tpu_engines():
             async with httpx.AsyncClient(timeout=60) as c:
                 r1 = await c.post("http://127.0.0.1:18312/v1/completions", json={
                     "prompt": prompt, "max_tokens": 1, "stream": False,
+                    "temperature": 0,
                     "kv_transfer_params": {"do_remote_decode": True}})
                 assert r1.status_code == 200
                 ktp = r1.json()["kv_transfer_params"]
@@ -174,7 +176,7 @@ def test_pd_handoff_between_tpu_engines():
 
                 r2 = await c.post("http://127.0.0.1:18313/v1/completions", json={
                     "prompt": prompt, "max_tokens": max_tokens,
-                    "kv_transfer_params": ktp})
+                    "temperature": 0, "kv_transfer_params": ktp})
                 assert r2.status_code == 200
                 disagg_text = r2.json()["choices"][0]["text"]
                 assert disagg_text == mono_text

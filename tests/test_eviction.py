@@ -16,7 +16,7 @@ def test_evictor_priority_then_time_order():
     cancelled = []
     ev.register("old-low", -2, lambda: cancelled.append("old-low"))
     ev.register("new-low", -2, lambda: cancelled.append("new-low"))
-    ev.register("mid", -1, lambda: cancelled.append("mid"))
+    mid_key = ev.register("mid", -1, lambda: cancelled.append("mid"))
     ev.register("normal", 0, lambda: cancelled.append("normal"))
 
     assert ev.evict_n(2) == 2
@@ -24,7 +24,21 @@ def test_evictor_priority_then_time_order():
     assert ev.evict_n(5) == 1  # only "mid" remains sheddable
     assert cancelled == ["old-low", "new-low", "mid"]
     assert "normal" not in cancelled  # non-sheddable never evicted
-    assert ev.was_evicted("mid")
+    assert ev.was_evicted(mid_key)
+
+
+def test_evictor_duplicate_request_ids_tracked_independently():
+    """Client-supplied ids can collide; each registration stays evictable."""
+    ev = RequestEvictor()
+    cancelled = []
+    k1 = ev.register("dup", -1, lambda: cancelled.append("first"))
+    k2 = ev.register("dup", -1, lambda: cancelled.append("second"))
+    assert k1 != k2
+    ev.deregister(k1)  # first finishes; second must remain tracked
+    assert ev.inflight_count == 1
+    assert ev.evict_n(1) == 1
+    assert cancelled == ["second"]
+    assert ev.was_evicted(k2) and not ev.was_evicted(k1)
 
 
 def test_gateway_evicts_inflight_sheddable_with_429():
@@ -95,14 +109,14 @@ def test_admission_capacity_retry_after_eviction():
             # Fill the single queue slot with a sheddable request.
             filler = asyncio.create_task(admission.admit(None, req("filler", -1), []))
             await asyncio.sleep(0.05)
-            evictor.register("victim", -1, lambda: None)  # a sheddable in-flight
+            victim_key = evictor.register("victim", -1, lambda: None)  # sheddable in-flight
 
             # Non-sheddable arrival: capacity-rejected -> sheds the QUEUED
             # filler (frees the slot), evicts the in-flight victim, and the
             # retry enqueues successfully.
             high = asyncio.create_task(admission.admit(None, req("high", 5), []))
             await asyncio.sleep(0.1)
-            assert evictor.was_evicted("victim")
+            assert evictor.was_evicted(victim_key)
             with pytest.raises(AdmissionError) as exc:
                 await filler  # shed from the queue -> 429
             assert exc.value.code == 429

@@ -1,0 +1,55 @@
+"""Pin the prefix-block hash chain's exact byte layout.
+
+The chain must stay byte-for-byte stable (reference scheme,
+approximateprefix/hashing.go:35-101: h_i = xxh64(block_i || h_{i-1}) with
+4-byte little-endian token encoding): router index, engine KV events, and any
+reference-side indexer in a mixed fleet all share this hash space. These
+golden vectors reconstruct the layout independently so a silent change to the
+concatenation order or token encoding fails CI.
+"""
+
+import xxhash
+
+from llm_d_inference_scheduler_tpu.utils.hashing import (
+    AVG_CHARS_PER_TOKEN,
+    chain_block_hashes,
+)
+
+
+def test_token_chain_matches_reference_layout():
+    model = "llama3-8b"
+    tokens = list(range(100, 140))  # 40 tokens → 2 complete blocks of 16
+    got = chain_block_hashes(model, tokens, "", 16)
+
+    h = xxhash.xxh64(model.encode()).intdigest()
+    expected = []
+    for start in (0, 16):
+        content = b"".join(t.to_bytes(4, "little") for t in tokens[start:start + 16])
+        h = xxhash.xxh64(content + h.to_bytes(8, "little")).intdigest()
+        expected.append(h)
+    assert got == expected
+    # Trailing partial block (tokens 32..39) is intentionally dropped.
+    assert len(got) == 2
+
+
+def test_token_chain_golden_digest():
+    # Hard-coded digest: any change to model-seed hashing, token byte width,
+    # endianness, or concatenation order changes this value.
+    got = chain_block_hashes("m", [1, 2, 3, 4], "", 4)
+    assert got == [15331926273878053439]
+
+
+def test_text_chain_matches_reference_layout():
+    model = "m"
+    text = "a" * (2 * 4 * AVG_CHARS_PER_TOKEN + 3)  # 2 complete chunks + tail
+    got = chain_block_hashes(model, None, text, 4)
+
+    h = xxhash.xxh64(model.encode()).intdigest()
+    step = 4 * AVG_CHARS_PER_TOKEN
+    raw = text.encode()
+    expected = []
+    for start in (0, step):
+        h = xxhash.xxh64(raw[start:start + step]
+                         + h.to_bytes(8, "little")).intdigest()
+        expected.append(h)
+    assert got == expected

@@ -51,3 +51,16 @@ def test_temperature_sharpness():
     hot = _run(logits, temps=[3.0], top_k=[0], top_p=[1.0], seed=1)
     # Cold sampling should pick the mode far more often than hot.
     assert (cold == 0).mean() > (hot == 0).mean() + 0.15
+
+
+def test_temperature_applied_before_top_p():
+    # Probabilities at T=1: [0.64, 0.23, 0.09, ...] — p=0.75 keeps {0, 1}.
+    # At T=2 the tempered distribution is flatter ([0.44, 0.27, 0.16, 0.10]),
+    # so the p=0.75 nucleus widens to {0, 1, 2} (vLLM/OpenAI semantics:
+    # truncation runs on the TEMPERED distribution).
+    logits = np.array([[4.0, 3.0, 2.0, 1.0]], np.float32)
+    cool = _run(logits, temps=[1.0], top_k=[0], top_p=[0.75])
+    hot = _run(logits, temps=[2.0], top_k=[0], top_p=[0.75], n=600)
+    assert set(np.unique(cool)) <= {0, 1}
+    assert 2 in set(np.unique(hot))
+    assert 3 not in set(np.unique(hot))
