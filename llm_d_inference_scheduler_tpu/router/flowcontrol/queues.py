@@ -42,6 +42,10 @@ class ListQueue:
         self.bytes -= item.size_bytes
         return True
 
+    def items(self) -> list[FlowControlRequest]:
+        """Snapshot of queued items (TTL sweep support)."""
+        return list(self._dq)
+
     def __len__(self):
         return len(self._dq)
 
@@ -91,6 +95,10 @@ class MaxMinHeap:
                 self._live -= 1
                 return True
         return False
+
+    def items(self) -> list[FlowControlRequest]:
+        """Snapshot of live queued items (TTL sweep support)."""
+        return [it for _, _, it in self._heap if id(it) not in self._removed]
 
     def __len__(self):
         return self._live

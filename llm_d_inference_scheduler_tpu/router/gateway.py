@@ -396,6 +396,10 @@ class Gateway:
             await resp.aclose()
             if ireq is not None:
                 self.director.handle_response_complete(None, ireq, endpoint, usage)
+                if self.flow_controller is not None:
+                    # Backend capacity freed: wake saturated dispatch shards
+                    # immediately instead of waiting out their backoff poll.
+                    self.flow_controller.notify_capacity()
                 REQUEST_DURATION.labels(model_label).observe(time.monotonic() - t_start)
                 if usage.get("prompt_tokens"):
                     INPUT_TOKENS.labels(model_label).observe(usage["prompt_tokens"])

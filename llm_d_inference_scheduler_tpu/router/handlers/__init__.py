@@ -1,3 +1,4 @@
 from .parsers import OpenAIParser, PassthroughParser, ParseResult, make_parser
+from . import vllmgrpc  # noqa: F401 (registers vllmgrpc-parser)
 
 __all__ = ["OpenAIParser", "PassthroughParser", "ParseResult", "make_parser"]

@@ -1,0 +1,208 @@
+"""vllmgrpc-parser: vLLM gRPC protobuf bodies (reference:
+framework/plugins/requesthandling/parsers/vllmgrpc — Generate/Embed paths of
+api/proto/vllm_engine.proto, gRPC length-prefixed framing).
+
+TPU-native redesign: the reference links ~2.5k lines of protoc-generated Go;
+here a ~100-line protobuf wire-format reader decodes exactly the fields the
+router needs (request id, prompt text/token ids, sampling knobs) — no codegen,
+no grpcio dependency, same wire bytes. Unknown paths → ParseResult.skip →
+random-endpoint fallback, matching the reference (vllmgrpc.go ParseRequest).
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Any, Iterator
+
+from ..framework.plugin import PluginBase, register_plugin
+from ..framework.scheduling import InferenceRequestBody
+from .parsers import ParseResult
+
+GENERATE_PATH = "/vllm.grpc.engine.VllmEngine/Generate"
+EMBED_PATH = "/vllm.grpc.engine.VllmEngine/Embed"
+METHOD_PATH_HEADER = ":path"  # H2C pseudo-header carrying the gRPC method
+
+
+# ---- minimal protobuf wire reader --------------------------------------
+
+
+def _read_varint(buf: bytes, pos: int) -> tuple[int, int]:
+    result = shift = 0
+    while True:
+        if pos >= len(buf):
+            raise ValueError("truncated varint")
+        b = buf[pos]
+        result |= (b & 0x7F) << shift
+        pos += 1
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 63:
+            raise ValueError("varint too long")
+
+
+def _fields(buf: bytes) -> Iterator[tuple[int, int, Any]]:
+    """Yields (field_number, wire_type, value) over a protobuf message."""
+    pos = 0
+    while pos < len(buf):
+        tag, pos = _read_varint(buf, pos)
+        field, wire = tag >> 3, tag & 0x7
+        if wire == 0:        # varint
+            value, pos = _read_varint(buf, pos)
+        elif wire == 1:      # fixed64
+            value = buf[pos:pos + 8]
+            if len(value) != 8:
+                raise ValueError("truncated fixed64 field")
+            pos += 8
+        elif wire == 2:      # length-delimited
+            ln, pos = _read_varint(buf, pos)
+            value = buf[pos:pos + ln]
+            if len(value) != ln:
+                raise ValueError("truncated length-delimited field")
+            pos += ln
+        elif wire == 5:      # fixed32
+            value = buf[pos:pos + 4]
+            if len(value) != 4:
+                raise ValueError("truncated fixed32 field")
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        yield field, wire, value
+
+
+def _packed_uint32(value: bytes | int, wire: int) -> list[int]:
+    if wire == 0:  # unpacked single element
+        return [int(value)]
+    out, pos = [], 0
+    while pos < len(value):
+        v, pos = _read_varint(value, pos)
+        out.append(v)
+    return out
+
+
+def _f32(value: bytes | int, wire: int) -> float:
+    if wire == 5:
+        return struct.unpack("<f", value)[0]
+    raise ValueError("expected fixed32 float")
+
+
+def parse_grpc_frame(body: bytes) -> bytes:
+    """Strip the gRPC length-prefixed frame: 1-byte compressed flag +
+    4-byte big-endian message length."""
+    if len(body) < 5:
+        raise ValueError("gRPC frame too short")
+    compressed = body[0]
+    if compressed:
+        raise ValueError("compressed gRPC frames are not supported")
+    (length,) = struct.unpack(">I", body[1:5])
+    msg = body[5:5 + length]
+    if len(msg) != length:
+        raise ValueError("truncated gRPC frame")
+    return msg
+
+
+def _parse_tokenized(buf: bytes) -> tuple[str, list[int]]:
+    text, ids = "", []
+    for field, wire, value in _fields(buf):
+        if field == 1:
+            text = value.decode("utf-8", "replace")
+        elif field == 2:
+            ids.extend(_packed_uint32(value, wire))
+    return text, ids
+
+
+def _parse_sampling(buf: bytes) -> dict[str, Any]:
+    out: dict[str, Any] = {}
+    stop: list[str] = []
+    stop_ids: list[int] = []
+    for field, wire, value in _fields(buf):
+        if field == 1:
+            out["temperature"] = _f32(value, wire)
+        elif field == 2:
+            out["top_p"] = _f32(value, wire)
+        elif field == 3:
+            out["top_k"] = int(value)
+        elif field == 8:
+            out["max_tokens"] = int(value)
+        elif field == 10:
+            stop.append(value.decode("utf-8", "replace"))
+        elif field == 11:
+            stop_ids.extend(_packed_uint32(value, wire))
+        elif field == 14:
+            out["ignore_eos"] = bool(value)
+    if stop:
+        out["stop"] = stop
+    if stop_ids:
+        out["stop_token_ids"] = stop_ids
+    return out
+
+
+def parse_generate_request(msg: bytes) -> dict[str, Any]:
+    """GenerateRequest (vllm_engine.proto): request_id=1, tokenized=2,
+    text=3, sampling_params=4, stream=5."""
+    doc: dict[str, Any] = {}
+    for field, wire, value in _fields(msg):
+        if field == 1:
+            doc["request_id"] = value.decode("utf-8", "replace")
+        elif field == 2:
+            text, ids = _parse_tokenized(value)
+            if ids:
+                doc["prompt_token_ids"] = ids
+            if text and "prompt" not in doc:
+                doc["prompt"] = text
+        elif field == 3:
+            doc["prompt"] = value.decode("utf-8", "replace")
+        elif field == 4:
+            doc.update(_parse_sampling(value))
+        elif field == 5:
+            doc["stream"] = bool(value)
+    return doc
+
+
+def parse_embed_request(msg: bytes) -> dict[str, Any]:
+    doc: dict[str, Any] = {}
+    for field, wire, value in _fields(msg):
+        if field == 1:
+            doc["request_id"] = value.decode("utf-8", "replace")
+        elif field == 2:
+            text, ids = _parse_tokenized(value)
+            if ids:
+                doc["input_token_ids"] = ids
+            if text:
+                doc["input"] = text
+    return doc
+
+
+@register_plugin("vllmgrpc-parser")
+class VllmGrpcParser(PluginBase):
+    """Parses gRPC-framed vLLM engine protobufs into the scheduler body."""
+
+    def parse(self, raw: bytes, headers: dict[str, str], path: str = "") -> ParseResult:
+        method = headers.get(METHOD_PATH_HEADER) or path
+        if method not in (GENERATE_PATH, EMBED_PATH):
+            return ParseResult(body=InferenceRequestBody(raw=raw), skip=True)
+        try:
+            msg = parse_grpc_frame(raw)
+            if method == EMBED_PATH:
+                doc = parse_embed_request(msg)
+                body = InferenceRequestBody(embeddings=doc, raw=raw)
+                if doc.get("input_token_ids"):
+                    body.tokenized_prompt = doc["input_token_ids"]
+            else:
+                doc = parse_generate_request(msg)
+                body = InferenceRequestBody(completions=doc, raw=raw)
+                if doc.get("prompt_token_ids"):
+                    body.tokenized_prompt = doc["prompt_token_ids"]
+            return ParseResult(body=body, model=str(doc.get("model", "")))
+        except (ValueError, struct.error) as e:
+            # struct.error belt-and-braces: _fields length-checks fixed-width
+            # slices, but attacker-supplied bytes must never 500 the gateway.
+            return ParseResult(body=None, error=f"invalid gRPC payload: {e}")
+
+    def serialize(self, body: InferenceRequestBody) -> bytes:
+        # The wire bytes are authoritative: the router never mutates protobuf
+        # bodies (no model rewrite on gRPC paths), so forward them untouched.
+        if body.raw is not None:
+            return body.raw
+        return json.dumps(body.payload or {}).encode()

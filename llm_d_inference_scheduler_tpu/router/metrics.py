@@ -60,3 +60,21 @@ FLOW_CONTROL_QUEUE_SECONDS = Histogram(
 PREFIX_HIT_RATIO = Histogram(
     "inference_extension_prefix_indexer_hit_ratio", "Prefix-cache hit ratio",
     registry=REGISTRY, buckets=(0, .1, .25, .5, .75, .9, 1))
+# Predicted-latency subsystem (reference metrics.go: predicted ttft/tpot +
+# slo-violation counters).
+PREDICTED_TTFT_MS = Histogram(
+    "inference_extension_predicted_time_to_first_token_ms",
+    "Predicted TTFT at scheduling time", registry=REGISTRY,
+    buckets=(1, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 10000))
+PREDICTED_TPOT_MS = Histogram(
+    "inference_extension_predicted_time_per_output_token_ms",
+    "Predicted TPOT at scheduling time", registry=REGISTRY,
+    buckets=(.1, .5, 1, 2.5, 5, 10, 25, 50, 100, 250))
+LATENCY_TRAINING_SAMPLES = Counter(
+    "inference_extension_latency_predictor_training_samples_total",
+    "Online latency-model training samples ingested",
+    ("kind",), registry=REGISTRY)  # kind: ttft | tpot
+SLO_VIOLATION_TOTAL = Counter(
+    "inference_extension_slo_violation_total",
+    "Completed requests whose observed latency violated the request SLO",
+    ("kind",), registry=REGISTRY)

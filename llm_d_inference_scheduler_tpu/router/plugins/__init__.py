@@ -7,6 +7,7 @@ registry; the config loader instantiates them by type name.
 from . import (  # noqa: F401
     disagg,
     filters,
+    latency,
     pickers,
     precise_prefix,
     profile_handlers,

@@ -7,6 +7,7 @@ import dataclasses
 
 PREFIX_ATTRIBUTE_KEY = "attribute/prefix"
 INFLIGHT_ATTRIBUTE_KEY = "attribute/concurrency"
+LATENCY_ATTRIBUTE_KEY = "attribute/latency"
 
 AVG_CHARS_PER_TOKEN = 4  # reference prefix_based_pd_decider.go:23
 
@@ -39,4 +40,32 @@ class InFlightLoad:
     tokens: int = 0
 
     def clone(self) -> "InFlightLoad":
+        return dataclasses.replace(self)
+
+
+@dataclasses.dataclass
+class LatencyPredictionInfo:
+    """Per-endpoint TTFT/TPOT prediction vs the request's SLO (reference:
+    framework/plugins/datalayer/attribute/latency — LatencyPredictionInfo).
+
+    Headroom = SLO − predicted, in ms: positive meets the SLO, negative
+    violates it. With no SLO header set, headroom = −predicted (always
+    negative), which makes downstream plugins rank by raw predicted latency.
+    """
+
+    ttft_ms: float = 0.0
+    tpot_ms: float = 0.0
+    ttft_headroom_ms: float = 0.0
+    tpot_headroom_ms: float = 0.0
+    ttft_valid: bool = False          # TTFT within SLO?
+    tpot_valid: bool = False          # TPOT within SLO (or neutralized)?
+    # Requests dispatched by THIS router instance (more current than the
+    # scraped running_requests_size).
+    dispatched: int = 0
+
+    @property
+    def is_valid(self) -> bool:
+        return self.ttft_valid and self.tpot_valid
+
+    def clone(self) -> "LatencyPredictionInfo":
         return dataclasses.replace(self)

@@ -86,3 +86,84 @@ class FreshMetricsFilter(PluginBase):
     def filter(self, ctx, state, request, endpoints):
         fresh = [ep for ep in endpoints if ep.metrics.fresh]
         return fresh or endpoints
+
+
+@register_plugin("prefix-cache-affinity-filter")
+class PrefixCacheAffinityFilter(PluginBase):
+    """Keep only endpoints whose prefix-cache score clears a stickiness
+    threshold (reference filter/prefixcacheaffinity/plugin.go):
+
+    - exploration: with probability explorationProbability the gate is
+      skipped entirely so cold endpoints still see traffic;
+    - no sticky endpoint → keep all;
+    - TTFT load gate: if the best sticky endpoint's predicted TTFT exceeds
+      the best non-sticky one's by more than maxTTFTPenaltyMs, stickiness is
+      broken (an overloaded cache holder shouldn't trap traffic).
+    """
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        import random
+
+        self.affinity_threshold = 0.80
+        self.exploration_probability = 0.01
+        self.max_ttft_penalty_ms = 5000.0
+        self._rng = random.Random()
+
+    def configure(self, params, handle):
+        self.affinity_threshold = float(
+            params.get("affinityThreshold", self.affinity_threshold))
+        self.exploration_probability = float(
+            params.get("explorationProbability", self.exploration_probability))
+        self.max_ttft_penalty_ms = float(
+            params.get("maxTTFTPenaltyMs", self.max_ttft_penalty_ms))
+        if self.affinity_threshold > 1.0:
+            raise ValueError("affinityThreshold must be <= 1.0")
+        if not 0.0 <= self.exploration_probability <= 1.0:
+            raise ValueError("explorationProbability must be in [0, 1]")
+        if self.max_ttft_penalty_ms < 0:
+            raise ValueError("maxTTFTPenaltyMs must be >= 0")
+
+    def consumes(self):
+        from .attributes import LATENCY_ATTRIBUTE_KEY, PREFIX_ATTRIBUTE_KEY
+
+        return [PREFIX_ATTRIBUTE_KEY, LATENCY_ATTRIBUTE_KEY]
+
+    @staticmethod
+    def _prefix_score(ep) -> float:
+        from .attributes import PREFIX_ATTRIBUTE_KEY
+
+        info = ep.attributes.get(PREFIX_ATTRIBUTE_KEY)
+        return info.hit_ratio if info is not None else 0.0
+
+    @staticmethod
+    def _best_ttft(endpoints) -> float:
+        from .attributes import LATENCY_ATTRIBUTE_KEY
+
+        best = float("inf")
+        for ep in endpoints:
+            info = ep.attributes.get(LATENCY_ATTRIBUTE_KEY)
+            if info is not None and info.ttft_ms < best:
+                best = info.ttft_ms
+        return best
+
+    def filter(self, ctx, state, request, endpoints):
+        if len(endpoints) <= 1 or self.affinity_threshold <= 0:
+            return endpoints
+        if self._rng.random() < self.exploration_probability:
+            return endpoints
+        sticky = [ep for ep in endpoints
+                  if self._prefix_score(ep) >= self.affinity_threshold]
+        if not sticky:
+            return endpoints
+        non_sticky = [ep for ep in endpoints if ep not in sticky]
+        if self.max_ttft_penalty_ms > 0 and non_sticky:
+            best_sticky = self._best_ttft(sticky)
+            best_non_sticky = self._best_ttft(non_sticky)
+            # Fail open (keep stickiness) when either group lacks predictions:
+            # an untrained endpoint is not known-overloaded, and breaking
+            # affinity during predictor warm-up scatters the cache build.
+            if (best_sticky != float("inf") and best_non_sticky != float("inf")
+                    and best_sticky - best_non_sticky > self.max_ttft_penalty_ms):
+                return endpoints
+        return sticky

@@ -1,0 +1,182 @@
+"""latency-scorer + slo-headroom-tier-filter: SLO-aware routing plugins.
+
+Reference: framework/plugins/scheduling/scorer/latency (plugin.go — headroom
+normalization/blending, idle preference, deficit bucketing, least/most
+strategies, composite fallback) and …/filter/sloheadroomtier (plugin.go —
+positive/negative tier split with epsilon exploration). Both consume the
+LatencyPredictionInfo attribute written by predicted-latency-producer.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any
+
+from ..framework.datalayer import Endpoint
+from ..framework.plugin import PluginBase, register_plugin
+from ..framework.scheduling import CycleState, InferenceRequest
+from .attributes import (
+    LATENCY_ATTRIBUTE_KEY,
+    PREFIX_ATTRIBUTE_KEY,
+    LatencyPredictionInfo,
+)
+
+
+def _info(ep: Endpoint) -> LatencyPredictionInfo | None:
+    return ep.attributes.get(LATENCY_ATTRIBUTE_KEY)
+
+
+@register_plugin("latency-scorer")
+class LatencyScorer(PluginBase):
+    """Scores endpoints by predicted-latency SLO headroom.
+
+    Semantics (reference scorer/latency README):
+    - positive-headroom endpoints outrank negative ones (negatives get 0 when
+      both kinds are present);
+    - all-negative: idle endpoints (dispatched == 0) are preferred; otherwise
+      deficit buckets rank only-TPOT-negative > only-TTFT-negative > both;
+    - within a group, headrooms are range-normalized and blended with
+      ttftWeight/tpotWeight (a zero-range dimension's weight renormalizes to
+      the other);
+    - strategy "least" favors the endpoint closest to the SLO boundary
+      (bin-packing); "most" favors maximum margin (positives only — for
+      negatives "most" would prefer the most overloaded endpoint);
+    - no predictions anywhere → composite fallback on KV utilization, queue
+      depth, and prefix-cache score.
+    """
+
+    def __init__(self, name: str | None = None):
+        super().__init__(name)
+        self.ttft_weight = 0.5
+        self.tpot_weight = 0.5
+        self.strategy = "least"  # "least" | "most"
+
+    def configure(self, params: dict[str, Any], handle: Any) -> None:
+        self.ttft_weight = float(params.get("ttftWeight", self.ttft_weight))
+        self.tpot_weight = float(params.get("tpotWeight", self.tpot_weight))
+        self.strategy = params.get("headroomStrategy", self.strategy)
+        if self.strategy not in ("least", "most"):
+            raise ValueError(f"headroomStrategy must be least|most, "
+                             f"got {self.strategy!r}")
+
+    def consumes(self) -> list[str]:
+        return [LATENCY_ATTRIBUTE_KEY]
+
+    def score(self, ctx: Any, state: CycleState, request: InferenceRequest,
+              endpoints: list[Endpoint]) -> dict[str, float]:
+        infos = {ep.metadata.address_port: _info(ep) for ep in endpoints}
+        if not any(infos.values()):
+            return self._composite_fallback(endpoints)
+
+        pos = [ep for ep in endpoints
+               if (i := infos[ep.metadata.address_port]) and i.is_valid]
+        if pos:
+            scores = self._headroom_scores(pos, infos, self.strategy)
+            return {ap: scores.get(ap, 0.0) for ap in infos}
+
+        # All negative (or prediction-less, which counts as negative).
+        neg = [ep for ep in endpoints if infos[ep.metadata.address_port]]
+        if not neg:
+            return self._composite_fallback(endpoints)
+        idle = [ep for ep in neg
+                if infos[ep.metadata.address_port].dispatched == 0]
+        if idle:
+            neg = idle
+        else:
+            neg = self._best_deficit_bucket(neg, infos)
+        # Negative headroom always scores "least" (closest to recovering).
+        scores = self._headroom_scores(neg, infos, "least")
+        return {ap: scores.get(ap, 0.0) for ap in infos}
+
+    def _best_deficit_bucket(self, endpoints, infos):
+        only_tpot, only_ttft, both = [], [], []
+        for ep in endpoints:
+            i = infos[ep.metadata.address_port]
+            if i.ttft_valid and not i.tpot_valid:
+                only_tpot.append(ep)
+            elif i.tpot_valid and not i.ttft_valid:
+                only_ttft.append(ep)
+            else:
+                both.append(ep)
+        return only_tpot or only_ttft or both
+
+    def _headroom_scores(self, endpoints, infos, strategy):
+        ttfts = [infos[ep.metadata.address_port].ttft_headroom_ms
+                 for ep in endpoints]
+        tpots = [infos[ep.metadata.address_port].tpot_headroom_ms
+                 for ep in endpoints]
+
+        def norm(vals):
+            lo, hi = min(vals), max(vals)
+            rng = hi - lo
+            if rng <= 0:
+                return None  # zero-range: dimension carries no signal
+            return [(v - lo) / rng for v in vals]
+
+        n_ttft, n_tpot = norm(ttfts), norm(tpots)
+        w_ttft, w_tpot = self.ttft_weight, self.tpot_weight
+        if n_ttft is None and n_tpot is None:
+            return {ep.metadata.address_port: 1.0 for ep in endpoints}
+        if n_ttft is None:
+            w_ttft, w_tpot = 0.0, 1.0
+            n_ttft = [0.0] * len(endpoints)
+        elif n_tpot is None:
+            w_ttft, w_tpot = 1.0, 0.0
+            n_tpot = [0.0] * len(endpoints)
+        total = (w_ttft + w_tpot) or 1.0
+        out = {}
+        for ep, a, b in zip(endpoints, n_ttft, n_tpot):
+            blended = (w_ttft * a + w_tpot * b) / total
+            # "least": closest to the SLO boundary wins → invert.
+            out[ep.metadata.address_port] = (1.0 - blended
+                                             if strategy == "least" else blended)
+        return out
+
+    def _composite_fallback(self, endpoints):
+        # Sidecar-down analogue: weighted KV-util + queue + prefix blend.
+        out = {}
+        for ep in endpoints:
+            m = ep.metrics
+            queue = 1.0 / (1.0 + m.waiting_queue_size)
+            kv = 1.0 - min(max(m.kv_cache_usage_percent, 0.0), 1.0)
+            prefix = ep.attributes.get(PREFIX_ATTRIBUTE_KEY)
+            hit = prefix.hit_ratio if prefix is not None else 0.0
+            out[ep.metadata.address_port] = 0.4 * kv + 0.3 * queue + 0.3 * hit
+        return out
+
+
+@register_plugin("slo-headroom-tier-filter")
+class SloHeadroomTierFilter(PluginBase):
+    """Probabilistic tier filter on SLO headroom (reference sloheadroomtier).
+
+    Positive tier: both headrooms ≥ 0. Endpoints without predictions fall in
+    the negative tier. When both tiers exist the negative tier is explored
+    with probability epsilonExploreNeg (default 1%) so recovering endpoints
+    still see traffic; no predictions at all → pass-through.
+    """
+
+    def __init__(self, name: str | None = None):
+        super().__init__(name)
+        self.epsilon = 0.01
+        self._rng = random.Random()
+
+    def configure(self, params: dict[str, Any], handle: Any) -> None:
+        self.epsilon = float(params.get("epsilonExploreNeg", self.epsilon))
+
+    def consumes(self) -> list[str]:
+        return [LATENCY_ATTRIBUTE_KEY]
+
+    def filter(self, ctx: Any, state: CycleState, request: InferenceRequest,
+               endpoints: list[Endpoint]) -> list[Endpoint]:
+        infos = {ep.metadata.address_port: _info(ep) for ep in endpoints}
+        if not any(infos.values()):
+            return endpoints
+        pos = [ep for ep in endpoints
+               if (i := infos[ep.metadata.address_port]) and i.is_valid]
+        neg = [ep for ep in endpoints
+               if not ((i := infos[ep.metadata.address_port]) and i.is_valid)]
+        if not pos:
+            return neg
+        if not neg:
+            return pos
+        return neg if self._rng.random() < self.epsilon else pos

@@ -1,5 +1,7 @@
 from .director import Director, RequestError
 from .admission import LegacyAdmissionController
 from . import producers  # noqa: F401 (registers plugins)
+from . import predicted_latency  # noqa: F401 (registers plugins)
+from . import admitters  # noqa: F401 (registers plugins)
 
 __all__ = ["Director", "RequestError", "LegacyAdmissionController"]

@@ -1,0 +1,113 @@
+"""AdmitRequest plugins: latency-slo-admitter + probabilistic-admitter.
+
+Reference: framework/plugins/requestcontrol/admitter/{latencyslo,
+probabilisticadmitter}/plugin.go. Both act only on sheddable requests
+(priority < 0) and fail open on missing signals.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any
+
+from ..framework.datalayer import Endpoint
+from ..framework.plugin import PluginBase, register_plugin
+from ..framework.scheduling import InferenceRequest
+from ..plugins.attributes import LATENCY_ATTRIBUTE_KEY
+from .predicted_latency import H_SLO_TPOT, H_SLO_TTFT
+
+
+@register_plugin("latency-slo-admitter")
+class LatencySloAdmitter(PluginBase):
+    """Rejects sheddable requests when no endpoint can meet the SLO.
+
+    Reject only when ALL hold (reference latencyslo/plugin.go:99-157):
+    an SLO header is set, predictions exist, no endpoint has a valid
+    (both-headrooms-positive) prediction, no endpoint is idle, and no
+    endpoint is cold (KV < 2%, predictions unreliable).
+    """
+
+    COLD_KV_THRESHOLD = 0.02
+
+    def consumes(self) -> list[str]:
+        return [LATENCY_ATTRIBUTE_KEY]
+
+    async def admit(self, ctx: Any, request: InferenceRequest,
+                    endpoints: list[Endpoint]) -> tuple[bool, str]:
+        if request.objectives.priority >= 0:
+            return True, ""
+        try:
+            has_slo = (float(request.headers.get(H_SLO_TTFT, "") or 0) > 0
+                       or float(request.headers.get(H_SLO_TPOT, "") or 0) > 0)
+        except ValueError:
+            has_slo = False
+        if not has_slo:
+            return True, ""
+
+        has_valid = has_cold = has_idle = has_predictions = False
+        for ep in endpoints:
+            m = ep.metrics
+            if m.kv_cache_usage_percent < self.COLD_KV_THRESHOLD:
+                has_cold = True
+            if m.running_requests_size == 0:
+                has_idle = True
+            info = ep.attributes.get(LATENCY_ATTRIBUTE_KEY)
+            if info is not None:
+                has_predictions = True
+                if info.is_valid:
+                    has_valid = True
+        if not has_predictions:
+            return True, ""  # fail open
+        if not has_valid and not has_idle and not has_cold:
+            return False, "no endpoint can serve the request within SLO"
+        return True, ""
+
+
+@register_plugin("probabilistic-admitter")
+class ProbabilisticAdmitter(PluginBase):
+    """Probabilistically sheds sheddable requests as pool saturation rises.
+
+    saturation = mean over endpoints of max(queue/queueThresh, kv/kvThresh);
+    P(reject) = min(saturation^power · k, 1) (reference
+    probabilisticadmitter/plugin.go).
+    """
+
+    def __init__(self, name: str | None = None):
+        super().__init__(name)
+        self.queue_depth_threshold = 5.0
+        self.kv_cache_util_threshold = 0.8
+        self.power = 5.0
+        self.k = 300.0
+        self._rng = random.Random()
+
+    def configure(self, params: dict[str, Any], handle: Any) -> None:
+        self.queue_depth_threshold = float(
+            params.get("queueDepthThreshold", self.queue_depth_threshold))
+        self.kv_cache_util_threshold = float(
+            params.get("kvCacheUtilThreshold", self.kv_cache_util_threshold))
+        self.power = float(params.get("power", self.power))
+        self.k = float(params.get("k", self.k))
+        for field, v in (("queueDepthThreshold", self.queue_depth_threshold),
+                         ("kvCacheUtilThreshold", self.kv_cache_util_threshold),
+                         ("power", self.power), ("k", self.k)):
+            if v <= 0:
+                raise ValueError(f"probabilistic-admitter: {field} must be > 0")
+
+    async def admit(self, ctx: Any, request: InferenceRequest,
+                    endpoints: list[Endpoint]) -> tuple[bool, str]:
+        if request.objectives.priority >= 0 or not endpoints:
+            return True, ""
+        sat = self._saturation(endpoints)
+        prob = min(sat ** self.power * self.k, 1.0)
+        if self._rng.random() < prob:
+            return False, (f"probabilistic-admitter: rejected, "
+                           f"saturation={sat:.3f} prob={prob:.2f}")
+        return True, ""
+
+    def _saturation(self, endpoints: list[Endpoint]) -> float:
+        total = 0.0
+        for ep in endpoints:
+            m = ep.metrics
+            total += max(m.waiting_queue_size / self.queue_depth_threshold,
+                         m.kv_cache_usage_percent / self.kv_cache_util_threshold)
+        return total / len(endpoints)

@@ -1,0 +1,299 @@
+"""predicted-latency-producer: online TTFT/TPOT prediction + SLO headroom.
+
+Reference: framework/plugins/requestcontrol/dataproducer/predictedlatency
+(plugin.go / training.go / prediction.go — bulk predictions in Produce,
+TTFT training on first token, TPOT training at EOS, per-request context with
+TTL, TPOT neutralization for prefill endpoints) plus latencypredictorclient.
+
+TPU-native redesign: the reference trains XGBoost/Bayesian-ridge models in an
+external Python sidecar reached over HTTP (latencypredictorclient, ~4k LoC of
+client plumbing). Here the predictor IS the in-process model: an
+exponentially-decayed online ridge regression (closed-form normal equations,
+d≈6 features, numpy solve) — no sidecar hop inside the 400ms producer budget,
+no model snapshot syncing, same signal set (queue depth, KV utilisation,
+running/dispatched requests, input/uncached token counts).
+
+SLO headers (reference latencyslo/plugin.go:38-40): ``x-slo-ttft-ms`` and
+``x-slo-tpot-ms``; headroom = SLO − predicted.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Any
+
+import numpy as np
+
+from ..framework.datalayer import ROLE_LABEL, Endpoint
+from ..framework.plugin import PluginBase, register_plugin
+from ..framework.scheduling import InferenceRequest, SchedulingResult
+from ..metrics import (
+    LATENCY_TRAINING_SAMPLES,
+    PREDICTED_TPOT_MS,
+    PREDICTED_TTFT_MS,
+    SLO_VIOLATION_TOTAL,
+)
+from ..plugins.attributes import (
+    LATENCY_ATTRIBUTE_KEY,
+    PREFIX_ATTRIBUTE_KEY,
+    LatencyPredictionInfo,
+    estimate_input_tokens,
+)
+
+log = logging.getLogger("router.predicted_latency")
+
+H_SLO_TTFT = "x-slo-ttft-ms"
+H_SLO_TPOT = "x-slo-tpot-ms"
+
+
+class OnlineRidge:
+    """Exponentially-decayed online ridge regression.
+
+    Keeps A = Σ λ^age · x xᵀ and b = Σ λ^age · x y; predict solves
+    (A + αI) w = b. With d ≈ 6 the solve is microseconds — cheap enough to
+    run per request without caching a fitted model.
+    """
+
+    def __init__(self, dim: int, alpha: float = 1.0, decay: float = 0.999):
+        self.dim = dim
+        self.alpha = alpha
+        self.decay = decay
+        self.n_samples = 0
+        self._A = np.zeros((dim, dim))
+        self._b = np.zeros(dim)
+        self._w: np.ndarray | None = None  # cache invalidated on update
+
+    def update(self, x: list[float], y: float) -> None:
+        xv = np.asarray(x, dtype=float)
+        self._A = self.decay * self._A + np.outer(xv, xv)
+        self._b = self.decay * self._b + xv * y
+        self.n_samples += 1
+        self._w = None
+
+    def predict(self, x: list[float]) -> float:
+        if self._w is None:
+            self._w = np.linalg.solve(
+                self._A + self.alpha * np.eye(self.dim), self._b)
+        return float(np.asarray(x, dtype=float) @ self._w)
+
+
+@dataclasses.dataclass
+class _RequestContext:
+    endpoint: str                 # address_port the request was dispatched to
+    start: float                  # dispatch time
+    ttft_features: list[float]
+    tpot_features: list[float]
+    streaming: bool
+    slo_ttft_ms: float = 0.0
+    slo_tpot_ms: float = 0.0
+    first_token_at: float | None = None
+    status: int | None = None     # upstream status from ResponseReceived
+    done: bool = False            # guards double-complete accounting
+
+
+# Contexts ride ON the request object (attribute below) rather than in an
+# id-keyed cache: client-supplied x-request-id values can collide (the same
+# bug class fixed in RequestEvictor), and the object's lifetime IS the
+# request's lifetime — no TTL sweep, no collision space. The reference needs
+# its TTL'd context cache only because Go hook signatures can't carry state.
+_CTX_ATTR = "_predicted_latency_ctx"
+
+
+@register_plugin("predicted-latency-producer")
+class PredictedLatencyProducer(PluginBase):
+    """DataProducer + PreRequest + ResponseStreaming + ResponseComplete."""
+
+    TTFT_DIM = 6
+    TPOT_DIM = 4
+    MIN_SAMPLES = 5  # fewer → no prediction attribute (fail-open downstream)
+
+    def __init__(self, name: str | None = None):
+        super().__init__(name)
+        self.slo_buffer_factor = 1.0
+        self.streaming_mode = True  # record TTFT on first chunk when streaming
+        self.predict_in_produce = True
+        self.role_label = ROLE_LABEL  # prefill pods get TPOT neutralized
+        # One model pair per endpoint: the per-endpoint intercept captures
+        # systematic slowness (hardware/config skew) that load features can't
+        # explain — the signal that lets routing steer AROUND a slow pod.
+        self._ttft_models: dict[str, OnlineRidge] = {}
+        self._tpot_models: dict[str, OnlineRidge] = {}
+        self._dispatched: dict[str, int] = {}  # address_port -> in-flight
+
+    def configure(self, params: dict[str, Any], handle: Any) -> None:
+        self.slo_buffer_factor = float(params.get("sloBufferFactor",
+                                                  self.slo_buffer_factor))
+        self.streaming_mode = bool(params.get("streamingMode",
+                                              self.streaming_mode))
+        self.predict_in_produce = bool(params.get("predictInProduce",
+                                                  self.predict_in_produce))
+        self.role_label = params.get("endpointRoleLabel", self.role_label)
+
+    def produces(self) -> list[str]:
+        return [LATENCY_ATTRIBUTE_KEY]
+
+    def consumes(self) -> list[str]:
+        return [PREFIX_ATTRIBUTE_KEY]
+
+    # ---- feature engineering -------------------------------------------
+
+    def _ttft_features(self, request: InferenceRequest, ep: Endpoint) -> list[float]:
+        tokens = estimate_input_tokens(request)
+        prefix = ep.attributes.get(PREFIX_ATTRIBUTE_KEY)
+        hit = prefix.hit_ratio if prefix is not None else 0.0
+        m = ep.metrics
+        return [1.0,
+                tokens / 1000.0,
+                tokens * (1.0 - hit) / 1000.0,   # uncached prefill work
+                float(m.waiting_queue_size),
+                float(m.kv_cache_usage_percent),
+                float(self._dispatched.get(ep.metadata.address_port, 0))]
+
+    def _tpot_features(self, ep: Endpoint) -> list[float]:
+        m = ep.metrics
+        return [1.0,
+                float(m.running_requests_size),
+                float(m.kv_cache_usage_percent),
+                float(self._dispatched.get(ep.metadata.address_port, 0))]
+
+    @staticmethod
+    def _slo(request: InferenceRequest, header: str) -> float:
+        try:
+            return float(request.headers.get(header, "") or 0.0)
+        except ValueError:
+            return 0.0
+
+    # ---- Produce: bulk predictions --------------------------------------
+
+    async def produce(self, ctx: Any, request: InferenceRequest,
+                      endpoints: list[Endpoint]) -> None:
+        if not self.predict_in_produce:
+            return
+        ttft_slo = self._slo(request, H_SLO_TTFT) * self.slo_buffer_factor
+        tpot_slo = self._slo(request, H_SLO_TPOT) * self.slo_buffer_factor
+        for ep in endpoints:
+            ap = ep.metadata.address_port
+            ttft_model = self._ttft_models.get(ap)
+            if ttft_model is None or ttft_model.n_samples < self.MIN_SAMPLES:
+                continue  # no attribute → downstream plugins fail open
+            tpot_model = self._tpot_models.get(ap)
+            tpot_trained = (tpot_model is not None
+                            and tpot_model.n_samples >= self.MIN_SAMPLES)
+            ttft = max(ttft_model.predict(self._ttft_features(request, ep)), 0.0)
+            tpot = (max(tpot_model.predict(self._tpot_features(ep)), 0.0)
+                    if tpot_trained else 0.0)
+            info = LatencyPredictionInfo(
+                ttft_ms=ttft, tpot_ms=tpot,
+                ttft_headroom_ms=ttft_slo - ttft,
+                tpot_headroom_ms=tpot_slo - tpot,
+                ttft_valid=ttft_slo - ttft >= 0,
+                tpot_valid=tpot_slo - tpot >= 0,
+                dispatched=self._dispatched.get(ep.metadata.address_port, 0))
+            if not tpot_trained or ep.metadata.labels.get(self.role_label) == "prefill":
+                # TPOT neutralization (reference prediction.go): prefill pods
+                # never decode; untrained TPOT must not poison tiering.
+                info.tpot_valid = True
+                info.tpot_headroom_ms = 0.0
+            ep.attributes.put(LATENCY_ATTRIBUTE_KEY, info)
+
+    # ---- training-sample hooks ------------------------------------------
+
+    def pre_request(self, ctx: Any, request: InferenceRequest,
+                    result: SchedulingResult) -> None:
+        targets = result.primary().target_endpoints
+        if not targets:
+            return
+        ep = targets[0]
+        key = ep.metadata.address_port
+        self._dispatched[key] = self._dispatched.get(key, 0) + 1
+        info = ep.attributes.get(LATENCY_ATTRIBUTE_KEY)
+        if info is not None:
+            PREDICTED_TTFT_MS.observe(info.ttft_ms)
+            PREDICTED_TPOT_MS.observe(info.tpot_ms)
+        setattr(request, _CTX_ATTR, _RequestContext(
+            endpoint=key, start=time.monotonic(),
+            ttft_features=self._ttft_features(request, ep),
+            tpot_features=self._tpot_features(ep),
+            streaming=request.body.stream(),
+            slo_ttft_ms=self._slo(request, H_SLO_TTFT),
+            slo_tpot_ms=self._slo(request, H_SLO_TPOT)))
+
+    def response_received(self, ctx: Any, request: InferenceRequest,
+                          endpoint: Endpoint | None, status: int) -> None:
+        rc = getattr(request, _CTX_ATTR, None)
+        if rc is not None:
+            rc.status = status
+
+    def response_streaming(self, ctx: Any, request: InferenceRequest,
+                           endpoint: Endpoint | None, chunk: bytes) -> None:
+        rc = getattr(request, _CTX_ATTR, None)
+        if rc is None or rc.first_token_at is not None:
+            return
+        rc.first_token_at = time.monotonic()
+        if self.streaming_mode and self._succeeded(rc):
+            self._ttft_model_for(rc.endpoint).update(
+                rc.ttft_features, (rc.first_token_at - rc.start) * 1e3)
+            LATENCY_TRAINING_SAMPLES.labels("ttft").inc()
+
+    @staticmethod
+    def _succeeded(rc: _RequestContext) -> bool:
+        """Train only on successful upstream responses: failed/cancelled
+        requests return in milliseconds and would teach the model that a
+        DEAD endpoint is the fastest one."""
+        return rc.status is not None and rc.status < 300
+
+    def response_complete(self, ctx: Any, request: InferenceRequest,
+                          endpoint: Endpoint | None,
+                          usage: dict[str, int]) -> None:
+        rc = getattr(request, _CTX_ATTR, None)
+        if rc is None or rc.done:
+            return
+        rc.done = True
+        n = self._dispatched.get(rc.endpoint, 0)
+        if n > 1:
+            self._dispatched[rc.endpoint] = n - 1
+        else:
+            self._dispatched.pop(rc.endpoint, None)
+        if not self._succeeded(rc):
+            return
+        now = time.monotonic()
+        observed_ttft_ms = ((rc.first_token_at or now) - rc.start) * 1e3
+        if rc.first_token_at is None or not self.streaming_mode:
+            # Non-streaming (or no chunk seen): TTFT sample is the e2e
+            # latency (reference default streamingMode=false behavior).
+            observed_ttft_ms = (now - rc.start) * 1e3
+            self._ttft_model_for(rc.endpoint).update(
+                rc.ttft_features, observed_ttft_ms)
+            LATENCY_TRAINING_SAMPLES.labels("ttft").inc()
+        if rc.slo_ttft_ms > 0 and observed_ttft_ms > rc.slo_ttft_ms:
+            SLO_VIOLATION_TOTAL.labels("ttft").inc()
+        completion = int(usage.get("completion_tokens") or 0)
+        if rc.first_token_at is not None and completion > 1:
+            per_tok = (now - rc.first_token_at) * 1e3 / (completion - 1)
+            self._tpot_model_for(rc.endpoint).update(rc.tpot_features, per_tok)
+            LATENCY_TRAINING_SAMPLES.labels("tpot").inc()
+            if rc.slo_tpot_ms > 0 and per_tok > rc.slo_tpot_ms:
+                SLO_VIOLATION_TOTAL.labels("tpot").inc()
+
+    def _ttft_model_for(self, endpoint: str) -> OnlineRidge:
+        model = self._ttft_models.get(endpoint)
+        if model is None:
+            model = self._ttft_models[endpoint] = OnlineRidge(self.TTFT_DIM)
+        return model
+
+    def _tpot_model_for(self, endpoint: str) -> OnlineRidge:
+        model = self._tpot_models.get(endpoint)
+        if model is None:
+            model = self._tpot_models[endpoint] = OnlineRidge(self.TPOT_DIM)
+        return model
+
+    def endpoint_added(self, endpoint: Endpoint) -> None:
+        pass
+
+    def endpoint_removed(self, endpoint: Endpoint) -> None:
+        ap = endpoint.metadata.address_port
+        self._ttft_models.pop(ap, None)
+        self._tpot_models.pop(ap, None)
+        self._dispatched.pop(ap, None)

@@ -43,8 +43,12 @@ class SidecarConfig:
     port: int = 8000
     host: str = "127.0.0.1"
     decoder_url: str = "http://127.0.0.1:8200"
-    connector: str = "tpu-dcn"         # "tpu-dcn" | "shared-storage" | "passthrough"
+    # "tpu-dcn" | "shared-storage" | "sglang" | "passthrough"
+    connector: str = "tpu-dcn"
     cache_hit_threshold: float = 0.8   # shared-storage decode-first probe
+    # sglang connector: engine-side KV bootstrap rendezvous port
+    # (reference connector_sglang.go init: SGLANG_BOOTSTRAP_PORT, default 8998).
+    bootstrap_port: int = 8998
     ssrf_allowlist: list[str] | None = None  # None disables SSRF protection
     prefill_timeout_s: float = 120.0
     decode_timeout_s: float = 300.0
@@ -73,6 +77,7 @@ class Sidecar:
         self._runner: web.AppRunner | None = None
         self._client: httpx.AsyncClient | None = None
         self._dp_children: list["Sidecar"] = []
+        self._bg_tasks: set = set()  # strong refs for fire-and-forget legs
 
     def _dp_header_url(self, request: web.Request) -> str | None:
         """Legacy x-data-parallel-host-port dispatch (data_parallel.go:19-88):
@@ -166,8 +171,55 @@ class Sidecar:
             if self.cfg.connector == "shared-storage":
                 return await self._run_shared_storage_protocol(request, body,
                                                                prefiller)
+            if self.cfg.connector == "sglang":
+                return await self._run_sglang_protocol(request, body, prefiller)
             return await self._run_pd_protocol(request, body, prefiller)
         return await self._dispatch_decode(request, body)
+
+    async def _run_sglang_protocol(self, request: web.Request,
+                                   body: dict[str, Any],
+                                   prefiller: str) -> web.StreamResponse:
+        """SGLang-style connector (reference connector_sglang.go:43-231):
+        inject bootstrap {host, port, room-id} into BOTH legs, fire the
+        prefill request asynchronously, and dispatch decode CONCURRENTLY —
+        the engines rendezvous on the bootstrap channel for the KV transfer
+        (no kv_transfer_params relay, no prefill-completion wait)."""
+        import asyncio
+        import random
+        import time as _time
+
+        from ..tracing import tracer
+
+        boot = dict(body)
+        boot["bootstrap_host"] = prefiller.rpartition(":")[0] or prefiller
+        boot["bootstrap_port"] = self.cfg.bootstrap_port
+        boot["bootstrap_room"] = _time.time_ns() + random.randint(0, 999)
+
+        with tracer.span("sidecar.sglang_protocol", prefiller=prefiller,
+                         room=boot["bootstrap_room"]) as span:
+            async def prefill_leg():
+                # Fire-and-forget with its own lifetime: the decode response
+                # finishing first must not cancel the prefill leg
+                # (connector_sglang.go uses context.WithoutCancel).
+                try:
+                    r = await self._client.post(
+                        f"http://{prefiller}{request.path}", json=boot,
+                        timeout=self.cfg.prefill_timeout_s)
+                    if r.status_code >= 300:
+                        log.warning("sglang prefill at %s returned %d",
+                                    prefiller, r.status_code)
+                except Exception as e:
+                    log.warning("sglang prefill at %s failed: %s", prefiller, e)
+
+            task = asyncio.get_running_loop().create_task(prefill_leg())
+            self._bg_tasks.add(task)
+            task.add_done_callback(self._bg_tasks.discard)
+            t0 = time.monotonic()
+            try:
+                return await self._dispatch_decode(request, boot)
+            finally:
+                span.set_attribute("decode_duration_ms",
+                                   round((time.monotonic() - t0) * 1e3, 1))
 
     async def _run_shared_storage_protocol(self, request: web.Request,
                                            body: dict[str, Any],
@@ -417,8 +469,10 @@ def main(argv: list[str] | None = None):
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--decoder", default="http://127.0.0.1:8200")
     p.add_argument("--connector", default="tpu-dcn",
-                   choices=["tpu-dcn", "shared-storage", "passthrough"])
+                   choices=["tpu-dcn", "shared-storage", "sglang", "passthrough"])
     p.add_argument("--cache-hit-threshold", type=float, default=0.8)
+    p.add_argument("--bootstrap-port", type=int, default=8998,
+                   help="sglang connector: engine KV bootstrap rendezvous port")
     p.add_argument("--allowlist", default=None,
                    help="comma-separated allowed prefill host:ports "
                         "(enables SSRF protection)")
@@ -432,7 +486,8 @@ def main(argv: list[str] | None = None):
         if args.allowlist else None,
         decode_chunk_size=args.decode_chunk_size,
         data_parallel_size=args.data_parallel_size,
-        cache_hit_threshold=args.cache_hit_threshold)
+        cache_hit_threshold=args.cache_hit_threshold,
+        bootstrap_port=args.bootstrap_port)
     logging.basicConfig(level=logging.INFO)
 
     async def run():

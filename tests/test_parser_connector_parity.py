@@ -1,0 +1,208 @@
+"""Parity sweep (VERDICT r1 item 9): vllmgrpc-parser, the SGLang-style
+concurrent-bootstrap sidecar connector, and prefix-cache-affinity-filter."""
+
+import asyncio
+import json
+import struct
+
+import httpx
+from aiohttp import web
+
+from llm_d_inference_scheduler_tpu.engine import EngineConfig
+from llm_d_inference_scheduler_tpu.engine.server import EngineServer
+from llm_d_inference_scheduler_tpu.router.framework.datalayer import (
+    Endpoint,
+    EndpointMetadata,
+)
+from llm_d_inference_scheduler_tpu.router.handlers.vllmgrpc import (
+    EMBED_PATH,
+    GENERATE_PATH,
+    VllmGrpcParser,
+)
+from llm_d_inference_scheduler_tpu.router.plugins.attributes import (
+    LATENCY_ATTRIBUTE_KEY,
+    PREFIX_ATTRIBUTE_KEY,
+    LatencyPredictionInfo,
+    PrefixCacheMatchInfo,
+)
+from llm_d_inference_scheduler_tpu.router.plugins.filters import (
+    PrefixCacheAffinityFilter,
+)
+from llm_d_inference_scheduler_tpu.router.sidecar import Sidecar, SidecarConfig
+
+
+# ---- protobuf encoding helpers (independent of the parser under test) ---
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _varint(v: int) -> bytes:
+    out = b""
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        out += bytes([b | (0x80 if v else 0)])
+        if not v:
+            return out
+
+
+def _ld(field: int, payload: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def _frame(msg: bytes) -> bytes:
+    return b"\x00" + struct.pack(">I", len(msg)) + msg
+
+
+def _generate_request() -> bytes:
+    tokenized = _ld(1, b"hello world") + _ld(2, b"".join(
+        _varint(t) for t in (5, 6, 7, 8)))  # packed input_ids
+    sampling = (
+        _tag(1, 5) + struct.pack("<f", 0.5)   # temperature
+        + _tag(3, 0) + _varint(40)            # top_k
+        + _tag(8, 0) + _varint(32)            # max_tokens
+        + _ld(10, b"END")                     # stop
+        + _tag(14, 0) + _varint(1)            # ignore_eos
+    )
+    msg = (_ld(1, b"req-42") + _ld(2, tokenized) + _ld(4, sampling)
+           + _tag(5, 0) + _varint(1))         # stream=true
+    return _frame(msg)
+
+
+def test_vllmgrpc_parses_generate_request():
+    res = VllmGrpcParser().parse(_generate_request(),
+                                 {":path": GENERATE_PATH})
+    assert res.error is None and not res.skip
+    doc = res.body.completions
+    assert doc["request_id"] == "req-42"
+    assert doc["prompt"] == "hello world"
+    assert res.body.tokenized_prompt == [5, 6, 7, 8]
+    assert doc["max_tokens"] == 32 and doc["top_k"] == 40
+    assert abs(doc["temperature"] - 0.5) < 1e-6
+    assert doc["stop"] == ["END"] and doc["ignore_eos"] is True
+    assert doc["stream"] is True
+    # serialize() must forward the original wire bytes untouched
+    assert VllmGrpcParser().serialize(res.body) == _generate_request()
+
+
+def test_vllmgrpc_parses_embed_request():
+    tokenized = _ld(1, b"embed me") + _ld(2, b"".join(_varint(t) for t in (9, 10)))
+    raw = _frame(_ld(1, b"e-1") + _ld(2, tokenized))
+    res = VllmGrpcParser().parse(raw, {":path": EMBED_PATH})
+    assert res.error is None
+    assert res.body.embeddings["input"] == "embed me"
+    assert res.body.tokenized_prompt == [9, 10]
+
+
+def test_vllmgrpc_skips_unknown_paths_and_rejects_garbage():
+    res = VllmGrpcParser().parse(b"\x00\x00\x00\x00\x00",
+                                 {":path": "/vllm.grpc.engine.VllmEngine/Abort"})
+    assert res.skip
+    res = VllmGrpcParser().parse(b"\x01garbage", {":path": GENERATE_PATH})
+    assert res.error is not None
+
+
+# ---- prefix-cache-affinity-filter --------------------------------------
+
+
+def _ep(port, hit=0.0, ttft=None) -> Endpoint:
+    ep = Endpoint(EndpointMetadata(name=f"e{port}", address="127.0.0.1", port=port))
+    ep.attributes.put(PREFIX_ATTRIBUTE_KEY, PrefixCacheMatchInfo(
+        match_blocks=int(hit * 10), total_blocks=10, block_size_tokens=16))
+    if ttft is not None:
+        ep.attributes.put(LATENCY_ATTRIBUTE_KEY, LatencyPredictionInfo(
+            ttft_ms=ttft, ttft_valid=True, tpot_valid=True))
+    return ep
+
+
+def _filter(**params) -> PrefixCacheAffinityFilter:
+    f = PrefixCacheAffinityFilter()
+    f.configure(params, None)
+    f._rng.random = lambda: 0.99  # exploration off unless overridden
+    return f
+
+
+def test_affinity_filter_narrows_to_sticky():
+    warm, cold = _ep(1, hit=0.9), _ep(2, hit=0.1)
+    assert _filter().filter(None, None, None, [warm, cold]) == [warm]
+
+
+def test_affinity_filter_keeps_all_without_sticky():
+    eps = [_ep(1, hit=0.3), _ep(2, hit=0.1)]
+    assert _filter().filter(None, None, None, eps) == eps
+
+
+def test_affinity_filter_exploration_skips_gate():
+    f = _filter()
+    f._rng.random = lambda: 0.0
+    eps = [_ep(1, hit=0.9), _ep(2, hit=0.1)]
+    assert f.filter(None, None, None, eps) == eps
+
+
+def test_affinity_filter_ttft_load_gate_breaks_stickiness():
+    overloaded_warm = _ep(1, hit=0.9, ttft=9000.0)
+    idle_cold = _ep(2, hit=0.1, ttft=50.0)
+    eps = [overloaded_warm, idle_cold]
+    assert _filter().filter(None, None, None, eps) == eps  # gate broken
+    # within the penalty budget, stickiness holds
+    assert _filter(maxTTFTPenaltyMs=20000).filter(
+        None, None, None, eps) == [overloaded_warm]
+
+
+# ---- sglang connector ---------------------------------------------------
+
+
+def test_sglang_connector_concurrent_bootstrap():
+    """Prefill and decode both receive the injected bootstrap triple; decode
+    is NOT blocked on prefill completing (concurrency is the point)."""
+    SC, DEC, PRE = 18651, 18652, 18653
+    seen = {"prefill": None, "prefill_at": None}
+    prefill_started = asyncio.Event()
+
+    async def body():
+        release_prefill = asyncio.Event()
+
+        async def prefill_handler(request: web.Request):
+            seen["prefill"] = await request.json()
+            prefill_started.set()
+            await release_prefill.wait()  # hold prefill OPEN past decode
+            return web.json_response({"ok": True})
+
+        app = web.Application()
+        app.add_routes([web.post("/v1/completions", prefill_handler)])
+        runner = web.AppRunner(app)
+        await runner.setup()
+        await web.TCPSite(runner, "127.0.0.1", PRE).start()
+
+        dec = EngineServer(EngineConfig(backend="sim", model="tiny", port=DEC,
+                                        sim_decode_ms_per_token=1.0))
+        await dec.start()
+        sc = Sidecar(SidecarConfig(port=SC, decoder_url=f"http://127.0.0.1:{DEC}",
+                                   connector="sglang", bootstrap_port=9333))
+        await sc.start()
+        try:
+            async with httpx.AsyncClient(timeout=30) as c:
+                r = await c.post(f"http://127.0.0.1:{SC}/v1/completions",
+                                 json={"prompt": "x", "max_tokens": 2},
+                                 headers={"x-prefiller-host-port":
+                                          f"127.0.0.1:{PRE}"})
+                # Decode completed while prefill is still in flight.
+                assert r.status_code == 200
+                assert r.json()["choices"][0]["text"]
+                await asyncio.wait_for(prefill_started.wait(), timeout=5)
+                release_prefill.set()
+                await asyncio.sleep(0.05)  # let the leg drain
+
+            boot = seen["prefill"]
+            assert boot["bootstrap_host"] == "127.0.0.1"
+            assert boot["bootstrap_port"] == 9333
+            assert isinstance(boot["bootstrap_room"], int)
+            assert boot["prompt"] == "x"
+        finally:
+            await sc.stop()
+            await dec.stop()
+            await runner.cleanup()
+
+    asyncio.run(body())

@@ -269,3 +269,17 @@ def test_header_based_testing_filter_and_served_verifier():
     assert v.mismatches == 0
     v.response_received(None, r1, eps[2], 200)   # served c -> mismatch
     assert v.mismatches == 1
+
+
+def test_example_configs_load():
+    """Every shipped examples/*.yaml must instantiate cleanly."""
+    import pathlib
+
+    ex_dir = pathlib.Path(__file__).resolve().parent.parent / "examples"
+    assert ex_dir.is_dir()
+    loaded = 0
+    for path in sorted(ex_dir.glob("*.yaml")):
+        cfg = load_config(path.read_text(), Handle())
+        assert cfg.scheduler is not None, path.name
+        loaded += 1
+    assert loaded >= 3  # monolithic, disagg, slo_aware
