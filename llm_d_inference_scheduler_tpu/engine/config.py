@@ -38,6 +38,12 @@ class EngineConfig:
     # KV cache event stream (ZMQ PUB) feeding the router's precise prefix
     # scorer; 0 disables, -1 = port + 1000.
     kv_events_port: int = -1
+    # P/D KV handoff data path: "device" = jax.experimental.transfer
+    # device-to-device pull (ICI same-slice / DCN cross-slice — the NIXL
+    # analogue), "host" = host-staged bytes over HTTP, "auto" = device when
+    # the transfer server starts, host otherwise. The HTTP path always
+    # remains as the cross-stack fallback.
+    kv_transfer: str = "auto"
 
     def resolved_kv_events_port(self) -> int:
         return self.port + 1000 if self.kv_events_port == -1 else self.kv_events_port

@@ -46,6 +46,23 @@ log = logging.getLogger("engine.core")
 
 KV_EXPORT_TTL_S = 60.0
 
+# One transfer server per process (shared by colocated engines): multiple
+# servers on one PJRT client abort in the aux socket layer, and production
+# runs one engine per chip/process anyway.
+_TRANSFER_SERVER = None
+_TRANSFER_SERVER_LOCK = threading.Lock()
+
+
+def _get_transfer_server():
+    global _TRANSFER_SERVER
+    with _TRANSFER_SERVER_LOCK:
+        if _TRANSFER_SERVER is None:
+            from jax.experimental import transfer as jax_transfer
+
+            _TRANSFER_SERVER = jax_transfer.start_transfer_server(
+                jax.devices()[0].client)
+        return _TRANSFER_SERVER
+
 
 @dataclasses.dataclass
 class _Slot:
@@ -69,6 +86,9 @@ class _PendingImport:
     loop: asyncio.AbstractEventLoop
     payload: bytes | None = None
     headers: dict[str, str] | None = None
+    # Device-to-device path: KV arrives as on-device arrays, no payload.
+    k_dev: Any = None
+    v_dev: Any = None
     error: str | None = None
 
 
@@ -149,6 +169,27 @@ class TpuEngine:
                                                   host=cfg.host)
             except Exception:
                 log.exception("kv-event publisher disabled (bind failed)")
+        # Device-to-device KV handoff (the NIXL-v2 analogue for TPU): a
+        # jax.experimental.transfer server stages prefilled KV on-device for
+        # the decode engine to pull over ICI/DCN — no host round-trip. The
+        # host-staged HTTP path stays as fallback (reference
+        # connector_nixlv2.go:109-253 control shape preserved).
+        self.kv_transfer_server = None
+        self._transfer_conns: dict[str, Any] = {}
+        self._transfer_lock = threading.Lock()
+        self.kv_import_device_count = 0  # diagnostics: pulls over ICI/DCN
+        self.kv_import_host_count = 0    # diagnostics: host-staged HTTP fetches
+        if cfg.kv_transfer in ("auto", "device") and self.mesh is None:
+            try:
+                self.kv_transfer_server = _get_transfer_server()
+            except Exception:
+                if cfg.kv_transfer == "device":
+                    raise
+                log.info("kv transfer server unavailable; host-staged "
+                         "HTTP handoff only", exc_info=True)
+        elif cfg.kv_transfer == "device" and self.mesh is not None:
+            raise ValueError("kv_transfer='device' is not yet supported with "
+                             "tp_size>1 (sharded pull specs)")
         self._prefill_fns: dict[int, Any] = {}
         self._jit_decode = jax.jit(self._decode_impl, donate_argnums=(3, 4))
         self._jit_sample = jax.jit(sample_tokens)
@@ -232,10 +273,58 @@ class TpuEngine:
             self._abort_ids.add(request_id)
             self._cond.notify()
 
-    def release_kv_export(self, request_id: str) -> None:
-        """Drop a staged P/D export once the decode side has pulled it."""
+    def _transfer_address(self) -> str:
+        """Advertised pull address: the server binds wildcard; peers dial the
+        engine host."""
+        port = self.kv_transfer_server.address().rsplit(":", 1)[1]
+        return f"{self.cfg.host}:{port}"
+
+    def _transfer_conn(self, address: str):
+        with self._transfer_lock:
+            conn = self._transfer_conns.get(address)
+            if conn is None:
+                conn = self.kv_transfer_server.connect(address)
+                self._transfer_conns[address] = conn
+            return conn
+
+    def release_kv_export(self, request_id: str, *,
+                          consumed: str = "host") -> None:
+        """Drop a staged P/D export once the decode side has pulled it.
+
+        ``consumed`` says HOW it was taken: "device" means the transfer-server
+        registration was already drained by the peer's pull; anything else
+        leaves the registration outstanding, so it is self-drained here (the
+        transfer API has no cancel — the server otherwise holds the staged
+        device arrays forever)."""
         with self._exports_lock:
-            self.kv_exports.pop(request_id, None)
+            rec = self.kv_exports.pop(request_id, None)
+        if rec is not None and consumed != "device":
+            self._drain_staged_transfer(rec)
+
+    def _drain_staged_transfer(self, rec: dict[str, Any]) -> None:
+        """Self-pull an un-pulled staged uuid to release the transfer
+        server's reference (loopback device copy; rare path)."""
+        tuid = rec.get("transfer_uuid")
+        if tuid is None or self.kv_transfer_server is None:
+            return
+
+        def drain():
+            try:
+                from jax.sharding import SingleDeviceSharding
+
+                k = rec["k"]
+                sds = jax.ShapeDtypeStruct(
+                    k.shape, k.dtype,
+                    sharding=SingleDeviceSharding(jax.devices()[0]))
+                conn = self._transfer_conn(self._transfer_address())
+                conn.pull(int(tuid), [sds, sds])
+            except Exception:
+                log.debug("staged-transfer drain failed", exc_info=True)
+
+        # Own (daemon) thread: a drain of an already-pulled uuid would block
+        # forever — only reachable if the peer pulled but its release signal
+        # was lost, which leaks one idle thread, not device memory.
+        threading.Thread(target=drain, name="kv-drain", daemon=True).start()
 
     def get_kv_export(self, request_id: str) -> dict[str, Any] | None:
         with self._exports_lock:
@@ -268,11 +357,21 @@ class TpuEngine:
             jnp.asarray([1], jnp.int32), self.k_pages, self.v_pages, row)
         saved_key = self._sample_key  # keep seeded outputs flag-independent
         _ = self._sample(logits, [_DUMMY_REQ])
-        dl, self.k_pages, self.v_pages = self._jit_decode(
-            self.params, jnp.zeros((B,), jnp.int32), jnp.zeros((B,), jnp.int32),
-            self.k_pages, self.v_pages,
-            jnp.zeros((B, self.max_blocks_per_seq), jnp.int32))
-        _ = self._sample(dl, [_DUMMY_REQ] * B)
+        # Compile EVERY decode bucket _batch_bucket can produce (1, 2, 4, …,
+        # max_batch): a gate-able warm-up must leave no lazy compile to stall
+        # the engine thread mid-serving.
+        buckets = []
+        b = 1
+        while b < B:
+            buckets.append(b)
+            b *= 2
+        buckets.append(B)
+        for nb in buckets:
+            dl, self.k_pages, self.v_pages = self._jit_decode(
+                self.params, jnp.zeros((nb,), jnp.int32),
+                jnp.zeros((nb,), jnp.int32), self.k_pages, self.v_pages,
+                jnp.zeros((nb, self.max_blocks_per_seq), jnp.int32))
+            _ = self._sample(dl, [_DUMMY_REQ] * nb)
         self._sample_key = saved_key
         log.info("engine warm-up compiled prefill/decode/sample in %.1fs",
                  time.monotonic() - t0)
@@ -376,11 +475,14 @@ class TpuEngine:
     def _sweep_exports(self):
         now = time.monotonic()
         with self._exports_lock:
-            expired = [r for r, rec in self.kv_exports.items()
+            expired = [(rid, rec) for rid, rec in self.kv_exports.items()
                        if now - rec["created"] > KV_EXPORT_TTL_S]
-            for rid in expired:
+            for rid, _ in expired:
                 log.warning("kv export %s expired unclaimed; dropping", rid)
                 self.kv_exports.pop(rid, None)
+        for _, rec in expired:
+            # Unclaimed = never pulled: safe to self-drain the registration.
+            self._drain_staged_transfer(rec)
 
     def _process_aborts(self):
         with self._cond:
@@ -578,13 +680,28 @@ class TpuEngine:
 
     def _start_kv_fetch(self, req, out, loop):
         """Fetch the prefiller's staged KV on a separate thread (the engine
-        thread must keep decoding while the network round-trip happens)."""
+        thread must keep decoding while the transfer happens). Device-first:
+        pull directly device-to-device via the transfer server when both
+        sides have one; fall back to the host-staged HTTP path."""
         pi = _PendingImport(req=req, out=out, loop=loop)
+        ktp = req.kv_transfer_params or {}
 
         def fetch():
+            if (ktp.get("transfer_address") and ktp.get("kv_shape")
+                    and self.kv_transfer_server is not None):
+                try:
+                    self._pull_device_kv(pi, ktp)
+                    self.kv_import_device_count += 1
+                    with self._cond:
+                        self._import_ready.append(pi)
+                        self._cond.notify()
+                    return
+                except Exception as e:
+                    log.warning("device kv pull from %s failed (%s); "
+                                "falling back to host path",
+                                ktp["transfer_address"], e)
             import httpx
 
-            ktp = req.kv_transfer_params or {}
             url = (f"http://{ktp['remote_host']}:{ktp['remote_port']}"
                    f"/kv/{ktp['remote_request_id']}")
             try:
@@ -592,6 +709,7 @@ class TpuEngine:
                 r.raise_for_status()
                 pi.payload = r.content
                 pi.headers = dict(r.headers)
+                self.kv_import_host_count += 1
                 try:
                     httpx.delete(url, timeout=5.0)
                 except Exception:
@@ -603,6 +721,38 @@ class TpuEngine:
                 self._cond.notify()
 
         threading.Thread(target=fetch, name="kv-fetch", daemon=True).start()
+
+    def _pull_device_kv(self, pi: _PendingImport, ktp: dict[str, Any]) -> None:
+        """Device-to-device pull: KV lands on this engine's device directly
+        (ICI same-slice, DCN cross-slice — runtime-routed)."""
+        import socket
+
+        from jax.sharding import SingleDeviceSharding
+
+        # TCP preflight: the transfer layer blocks indefinitely on an
+        # unreachable peer; fail fast here so the HTTP fallback engages.
+        host, _, port = ktp["transfer_address"].rpartition(":")
+        with socket.create_connection((host, int(port)), timeout=2.0):
+            pass
+
+        shape = tuple(int(d) for d in ktp["kv_shape"])
+        dtype = jnp.dtype(ktp["kv_dtype"])
+        dev = jax.devices()[0]
+        sds = jax.ShapeDtypeStruct(shape, dtype,
+                                   sharding=SingleDeviceSharding(dev))
+        conn = self._transfer_conn(ktp["transfer_address"])
+        pi.k_dev, pi.v_dev = conn.pull(int(ktp["transfer_uuid"]), [sds, sds])
+        pi.k_dev.block_until_ready()
+        # Release the prefiller's export record, flagging device consumption
+        # so it does NOT self-drain the (already pulled) staging uuid.
+        try:
+            import httpx
+
+            httpx.delete(f"http://{ktp['remote_host']}:{ktp['remote_port']}"
+                         f"/kv/{ktp['remote_request_id']}?consumed=device",
+                         timeout=5.0)
+        except Exception:
+            pass
 
     def _process_imports(self):
         while True:
@@ -649,13 +799,11 @@ class TpuEngine:
     def _strip_remote(req: EngineRequest) -> EngineRequest:
         return dataclasses.replace(req, kv_transfer_params=None)
 
-    def _import_into_slot(self, idx: int, pi: _PendingImport, blocks: list[int]):
-        """Validates and scatters a fetched KV payload; raises on any
-        malformed/mismatched import (caller falls back to local prefill)."""
-        req, headers = pi.req, pi.headers or {}
-        shape = tuple(int(x) for x in json.loads(headers["x-kv-shape"]))
-        seq_len = int(headers["x-kv-seq-len"])
-        dtype = jnp.dtype(headers["x-kv-dtype"])
+    def _validate_kv_geometry(self, shape, seq_len: int, real_nb: int,
+                              n_alloc: int):
+        """shape's block dim may be pow2-PADDED (staging pads so gather/
+        scatter compile counts stay bounded); real_nb is the un-padded count
+        that must fit the local allocation."""
         if len(shape) != 5:
             raise ValueError(f"bad kv shape {shape}")
         L, nb, block, Hkv, Dh = shape
@@ -664,30 +812,61 @@ class TpuEngine:
             raise ValueError(f"kv geometry mismatch: {shape} vs model "
                              f"(L={self.mcfg.n_layers}, block={self.mcfg.kv_block_size}, "
                              f"Hkv={self.mcfg.n_kv_heads}, Dh={self.mcfg.head_dim})")
-        if nb > self.max_blocks_per_seq or nb > len(blocks):
-            raise ValueError(f"{nb} exported blocks exceed budget "
-                             f"(maxB={self.max_blocks_per_seq}, alloc={len(blocks)})")
-        expected = 2 * int(np.prod(shape)) * dtype.itemsize
-        if len(pi.payload) != expected:
-            raise ValueError(f"kv payload size {len(pi.payload)} != expected {expected}")
-        if not (0 < seq_len <= nb * block):
+        if not (0 < real_nb <= nb):
+            raise ValueError(f"real block count {real_nb} outside padded {nb}")
+        if nb > self.max_blocks_per_seq or real_nb > n_alloc:
+            raise ValueError(f"{real_nb}/{nb} exported blocks exceed budget "
+                             f"(maxB={self.max_blocks_per_seq}, alloc={n_alloc})")
+        if not (0 < seq_len <= real_nb * block):
             raise ValueError(f"kv seq_len {seq_len} outside exported blocks")
-        nbytes = len(pi.payload) // 2
-        k_np = np.frombuffer(pi.payload[:nbytes], dtype=dtype).reshape(shape)
-        v_np = np.frombuffer(pi.payload[nbytes:], dtype=dtype).reshape(shape)
+        return L, nb, block, Hkv, Dh
 
-        # Pad to the fixed per-seq block budget so the scatter compiles once.
-        maxB = self.max_blocks_per_seq
-        k_pad = np.zeros((L, maxB, block, Hkv, Dh), dtype)
-        v_pad = np.zeros((L, maxB, block, Hkv, Dh), dtype)
-        k_pad[:, :nb], v_pad[:, :nb] = k_np, v_np
-        blocks_pad = np.zeros((maxB,), np.int32)  # padding lands in trash block 0
-        blocks_pad[:nb] = blocks[:nb]
-        self.k_pages, self.v_pages = self._jit_import(
-            self.k_pages, self.v_pages, jnp.asarray(blocks_pad),
-            jnp.asarray(k_pad), jnp.asarray(v_pad))
-
+    def _import_into_slot(self, idx: int, pi: _PendingImport, blocks: list[int]):
+        """Validates and scatters fetched KV — device arrays from the
+        transfer-server pull, or host bytes from the HTTP path; raises on any
+        malformed/mismatched import (caller falls back to local prefill)."""
+        req, headers = pi.req, pi.headers or {}
         ktp = req.kv_transfer_params or {}
+        if pi.k_dev is not None:
+            # Device path: already on this engine's device; scatter directly.
+            # The staging side pow2-pads the block dim, so the per-shape jit
+            # cache stays at log2(max_blocks)+1 entries; padding rows scatter
+            # into the trash block 0.
+            shape = tuple(int(d) for d in pi.k_dev.shape)
+            seq_len = int(ktp["remote_seq_len"])
+            real_nb = int(ktp.get("remote_num_blocks") or shape[1])
+            _, nb, *_ = self._validate_kv_geometry(shape, seq_len, real_nb,
+                                                   len(blocks))
+            padded_blocks = np.zeros((nb,), np.int32)  # tail → trash block 0
+            padded_blocks[:real_nb] = blocks[:real_nb]
+            self.k_pages, self.v_pages = self._jit_import(
+                self.k_pages, self.v_pages, jnp.asarray(padded_blocks),
+                pi.k_dev, pi.v_dev)
+        else:
+            shape = tuple(int(x) for x in json.loads(headers["x-kv-shape"]))
+            seq_len = int(headers["x-kv-seq-len"])
+            dtype = jnp.dtype(headers["x-kv-dtype"])
+            real_nb = int(headers.get("x-kv-real-blocks") or shape[1])
+            L, nb, block, Hkv, Dh = self._validate_kv_geometry(
+                shape, seq_len, real_nb, len(blocks))
+            expected = 2 * int(np.prod(shape)) * dtype.itemsize
+            if len(pi.payload) != expected:
+                raise ValueError(f"kv payload size {len(pi.payload)} != expected {expected}")
+            nbytes = len(pi.payload) // 2
+            k_np = np.frombuffer(pi.payload[:nbytes], dtype=dtype).reshape(shape)
+            v_np = np.frombuffer(pi.payload[nbytes:], dtype=dtype).reshape(shape)
+
+            # Pad to the fixed per-seq block budget so the scatter compiles once.
+            maxB = self.max_blocks_per_seq
+            k_pad = np.zeros((L, maxB, block, Hkv, Dh), dtype)
+            v_pad = np.zeros((L, maxB, block, Hkv, Dh), dtype)
+            k_pad[:, :nb], v_pad[:, :nb] = k_np, v_np
+            blocks_pad = np.zeros((maxB,), np.int32)  # padding lands in trash block 0
+            blocks_pad[:real_nb] = blocks[:real_nb]
+            self.k_pages, self.v_pages = self._jit_import(
+                self.k_pages, self.v_pages, jnp.asarray(blocks_pad),
+                jnp.asarray(k_pad), jnp.asarray(v_pad))
+
         first = int(ktp.get("remote_first_token")
                     if ktp.get("remote_first_token") is not None
                     else headers["x-kv-first-token"])
@@ -725,27 +904,39 @@ class TpuEngine:
         top_p = np.array([r.top_p for r in reqs], np.float32)
         return np.asarray(self._jit_sample(logits, sub, temps, top_k, top_p))
 
+    def _batch_bucket(self, n: int) -> int:
+        """Smallest power-of-two lane count covering n active slots: a lone
+        stream decodes at B=1 instead of paying full-batch compute (compile
+        cache stays bounded at log2(max_batch)+1 decode variants)."""
+        b = 1
+        while b < n:
+            b *= 2
+        return min(b, self.cfg.max_batch)
+
     def _decode_once(self):
-        B = self.cfg.max_batch
+        active = [i for i, s in enumerate(self.slots) if s is not None]
+        B = self._batch_bucket(len(active))
         tokens = np.zeros((B,), np.int32)
         positions = np.zeros((B,), np.int32)
         tables = np.zeros((B, self.max_blocks_per_seq), np.int32)
-        active = [i for i, s in enumerate(self.slots) if s is not None]
-        for i in active:
+        # Compact active slots into the low lanes; padding lanes keep their
+        # block table at the trash block 0 (their KV writes land there).
+        for lane, i in enumerate(active):
             s = self.slots[i]
-            tokens[i] = s.last_token
-            positions[i] = s.position
-            tables[i, : len(s.blocks)] = s.blocks
+            tokens[lane] = s.last_token
+            positions[lane] = s.position
+            tables[lane, : len(s.blocks)] = s.blocks
 
         logits, self.k_pages, self.v_pages = self._jit_decode(
             self.params, jnp.asarray(tokens), jnp.asarray(positions),
             self.k_pages, self.v_pages, jnp.asarray(tables))
 
-        reqs = [self.slots[i].req if self.slots[i] else _DUMMY_REQ for i in range(B)]
+        reqs = [self.slots[i].req for i in active]
+        reqs += [_DUMMY_REQ] * (B - len(reqs))
         sampled = self._sample(logits, reqs)
-        for i in active:
+        for lane, i in enumerate(active):
             s = self.slots[i]
-            tok = int(sampled[i])
+            tok = int(sampled[lane])
             s.position += 1
             s.generated.append(tok)
             s.last_token = tok
@@ -783,19 +974,23 @@ class TpuEngine:
         self.slots[idx] = None
         kv_params = None
         if retain_for_transfer:
-            # Host-stage the prefilled KV (DCN handoff path): copy the slot's
-            # pages out synchronously so device blocks free immediately and the
-            # HTTP thread never touches live (donated) page buffers. The ICI
-            # fast path (device-to-device) replaces this copy for same-slice
-            # prefill/decode pairs.
-            with self._exports_lock:
-                self.kv_exports[s.req.request_id] = {
-                    "k": np.asarray(self.k_pages[:, s.blocks]),
-                    "v": np.asarray(self.v_pages[:, s.blocks]),
-                    "seq_len": s.position,  # prompt tokens in cache
-                    "first_token": first_token,
-                    "created": time.monotonic(),
-                }
+            # Stage the prefilled KV for pickup. Device path: gather the
+            # slot's pages into fresh device arrays (the gather breaks the
+            # alias to the donated page buffers, so blocks free immediately)
+            # and register them with the transfer server for a direct
+            # device-to-device pull. The same arrays back the HTTP /kv route
+            # (converted lazily), so a host-only decode peer still works.
+            # Block count pads to a power-of-two bucket (tail → trash block 0)
+            # so gather here and scatter on the decode side each compile at
+            # most log2(max_blocks)+1 variants, not one per prompt length.
+            bucket = 1
+            while bucket < len(s.blocks):
+                bucket *= 2
+            bucket = min(bucket, self.max_blocks_per_seq)
+            padded = list(s.blocks) + [0] * (bucket - len(s.blocks))
+            idx = jnp.asarray(np.asarray(padded, np.int32))
+            k_stage = self.k_pages[:, idx]
+            v_stage = self.v_pages[:, idx]
             kv_params = {
                 "remote_engine_id": self.engine_id,
                 "remote_request_id": s.req.request_id,
@@ -805,6 +1000,28 @@ class TpuEngine:
                 "remote_host": self.cfg.host,
                 "remote_port": self.cfg.port,
             }
+            if self.kv_transfer_server is not None:
+                tuid = uuid.uuid4().int & ((1 << 63) - 1)
+                try:
+                    self.kv_transfer_server.await_pull(tuid, [k_stage, v_stage])
+                    kv_params.update({
+                        "transfer_address": self._transfer_address(),
+                        "transfer_uuid": tuid,
+                        "kv_shape": [int(d) for d in k_stage.shape],
+                        "kv_dtype": str(k_stage.dtype),
+                    })
+                except Exception:
+                    log.exception("kv await_pull failed; host path only")
+            with self._exports_lock:
+                self.kv_exports[s.req.request_id] = {
+                    "k": k_stage,
+                    "v": v_stage,
+                    "num_blocks": len(s.blocks),  # real (un-padded) count
+                    "seq_len": s.position,  # prompt tokens in cache
+                    "first_token": first_token,
+                    "transfer_uuid": kv_params.get("transfer_uuid"),
+                    "created": time.monotonic(),
+                }
         with self._cond:
             self.allocator.free(s.blocks)
             self.telemetry.kv_usage.set(self.allocator.used_fraction)

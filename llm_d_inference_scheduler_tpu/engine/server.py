@@ -371,11 +371,16 @@ class EngineServer:
             raise web.HTTPNotFound(text=f"no kv export for {rid}")
         if "k" not in rec:
             raise web.HTTPNotImplemented(text="sim engine holds no real KV")
-        k, v = rec["k"], rec["v"]
+        # Exports may be staged as device arrays (transfer-server path);
+        # convert lazily for host-path peers.
+        import numpy as np
+
+        k, v = np.asarray(rec["k"]), np.asarray(rec["v"])
         payload = k.tobytes() + v.tobytes()
         return web.Response(body=payload, content_type="application/octet-stream", headers={
             "x-kv-seq-len": str(rec["seq_len"]),
             "x-kv-num-blocks": str(k.shape[1]),
+            "x-kv-real-blocks": str(rec.get("num_blocks", k.shape[1])),
             "x-kv-dtype": str(k.dtype),
             "x-kv-shape": json.dumps(list(k.shape)),
             "x-kv-first-token": str(rec.get("first_token")),
@@ -383,7 +388,11 @@ class EngineServer:
 
     async def kv_release(self, request: web.Request) -> web.Response:
         rid = request.match_info["request_id"]
-        self.engine.release_kv_export(rid)
+        consumed = request.query.get("consumed", "host")
+        try:
+            self.engine.release_kv_export(rid, consumed=consumed)
+        except TypeError:  # sim engine's simpler signature
+            self.engine.release_kv_export(rid)
         return web.json_response({"released": rid})
 
     async def kv_events_stream(self, request: web.Request) -> web.StreamResponse:

@@ -1,0 +1,247 @@
+"""Control plane, standalone-first: reconcilers + leader election.
+
+Reference: pkg/epp/controller/*.go (InferencePool/Pod/InferenceObjective/
+InferenceModelRewrite reconcilers driving the datastore) and
+cmd/epp/runner/runner.go:306-316 + server/controller_manager.go:81-90
+(lease-based leader election, readiness coupled to leadership,
+health.go:52-104).
+
+TPU-native standalone redesign: no kube-apiserver in the loop, so the watch
+sources are files —
+
+- ``ConfigReconciler`` polls the EndpointPickerConfig YAML's mtime and
+  resyncs pool endpoints / objectives / model rewrites into the datastore on
+  change (the CRD-watch analogue: same converge-to-declared-state semantics,
+  deletes included, datastore.go:405 podResyncAll).
+- ``LeaseElector`` elects a leader through an atomically-replaced lease file
+  shared by replicas on a host/NFS (the Lease-object analogue: holder id +
+  expiry, renew loop, takeover after expiry; acquisition races resolve by
+  re-reading after write, the file-system analogue of the resourceVersion
+  conflict check). Readiness gates on leadership exactly like the reference:
+  followers report not-ready so the fronting LB only routes to the leader.
+
+When k8s IS present, these interfaces are where a client-go-style binding
+slots in; the datastore contract (resync/objective_set/rewrite_set) is
+already the same one the reference reconcilers drive.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import logging
+import os
+import random
+import time
+import uuid
+from typing import Any, Callable
+
+log = logging.getLogger("router.controlplane")
+
+
+# ---- leader election ----------------------------------------------------
+
+
+@dataclasses.dataclass
+class LeaseConfig:
+    path: str
+    holder_id: str = ""
+    lease_duration_s: float = 5.0
+    renew_interval_s: float = 1.0
+
+    def __post_init__(self):
+        if not self.holder_id:
+            self.holder_id = f"epp-{uuid.uuid4().hex[:8]}"
+
+
+class LeaseElector:
+    """File-lease leader election with graceful release and expiry takeover."""
+
+    def __init__(self, cfg: LeaseConfig,
+                 on_started_leading: Callable[[], None] | None = None,
+                 on_stopped_leading: Callable[[], None] | None = None):
+        self.cfg = cfg
+        self.is_leader = False
+        self.on_started_leading = on_started_leading
+        self.on_stopped_leading = on_stopped_leading
+        self._task: asyncio.Task | None = None
+        self._rng = random.Random()
+
+    # -- lease file primitives (atomic via tmp + os.replace) --
+
+    def _read(self) -> dict[str, Any] | None:
+        try:
+            with open(self.cfg.path) as f:
+                return json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError):
+            return None
+
+    def _write(self, record: dict[str, Any]) -> None:
+        tmp = f"{self.cfg.path}.tmp.{self.cfg.holder_id}"
+        with open(tmp, "w") as f:
+            json.dump(record, f)
+        os.replace(tmp, self.cfg.path)
+
+    def _try_acquire_or_renew(self) -> bool:
+        now = time.time()
+        rec = self._read()
+        if (rec is not None and rec.get("holder") != self.cfg.holder_id
+                and float(rec.get("expires", 0)) > now):
+            return False  # live foreign lease
+        self._write({"holder": self.cfg.holder_id,
+                     "expires": now + self.cfg.lease_duration_s})
+        # Confirm ownership after the write: two expired-lease claimants can
+        # race os.replace; the survivor is whoever the file names (the
+        # file-system analogue of the k8s resourceVersion conflict).
+        rec = self._read()
+        return rec is not None and rec.get("holder") == self.cfg.holder_id
+
+    def release(self) -> None:
+        """Graceful handoff: zero the expiry so followers take over now."""
+        rec = self._read()
+        if rec is not None and rec.get("holder") == self.cfg.holder_id:
+            self._write({"holder": self.cfg.holder_id, "expires": 0})
+        self._set_leader(False)
+
+    def _set_leader(self, leading: bool) -> None:
+        if leading and not self.is_leader:
+            self.is_leader = True
+            log.info("leader election: %s started leading", self.cfg.holder_id)
+            if self.on_started_leading:
+                self.on_started_leading()
+        elif not leading and self.is_leader:
+            self.is_leader = False
+            log.warning("leader election: %s stopped leading", self.cfg.holder_id)
+            if self.on_stopped_leading:
+                self.on_stopped_leading()
+
+    async def _run(self):
+        try:
+            while True:
+                try:
+                    self._set_leader(self._try_acquire_or_renew())
+                except OSError:
+                    log.exception("lease file I/O failure; demoting")
+                    self._set_leader(False)
+                # Followers jitter their polls so expired-lease claims don't
+                # repeatedly collide.
+                delay = self.cfg.renew_interval_s
+                if not self.is_leader:
+                    delay += self._rng.uniform(0, self.cfg.renew_interval_s / 2)
+                await asyncio.sleep(delay)
+        except asyncio.CancelledError:
+            pass
+
+    async def start(self):
+        self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self, *, graceful: bool = True):
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+        if graceful:
+            try:
+                self.release()
+            except OSError:
+                pass
+
+
+# ---- config reconciler --------------------------------------------------
+
+
+class ConfigReconciler:
+    """Converges the datastore to the declared state of the config file.
+
+    The standalone analogue of the reference's four reconcilers
+    (pkg/epp/controller): pool endpoints resync (adds, updates, deletes),
+    objectives and model rewrites set/delete. Watch = mtime polling.
+    """
+
+    def __init__(self, path: str, datastore: Any, poll_interval_s: float = 1.0):
+        self.path = path
+        self.datastore = datastore
+        self.poll_interval_s = poll_interval_s
+        self._mtime: float | None = None
+        self._task: asyncio.Task | None = None
+
+    def reconcile_once(self) -> bool:
+        """Reload + resync if the file changed; returns True when applied."""
+        try:
+            mtime = os.stat(self.path).st_mtime
+        except OSError:
+            return False
+        if self._mtime is not None and mtime == self._mtime:
+            return False
+        try:
+            with open(self.path) as f:
+                text = f.read()
+            self._apply(text)
+        except Exception:
+            log.exception("config reconcile failed; keeping last good state")
+            return False
+        self._mtime = mtime
+        return True
+
+    def _apply(self, text: str) -> None:
+        from .config.loader import _endpoint_meta, load_raw_config
+        from .datalayer.datastore import (
+            InferenceModelRewrite,
+            InferenceObjective,
+            ModelRewriteTarget,
+        )
+
+        raw = load_raw_config(text)
+        metas = [_endpoint_meta(e) for e in raw.pool.get("endpoints") or []]
+        self.datastore.resync(metas)
+
+        declared_obj = {o["name"] for o in raw.objectives}
+        for o in raw.objectives:
+            self.datastore.objective_set(
+                InferenceObjective(name=o["name"],
+                                   priority=int(o.get("priority", 0))))
+        for name in [n for n in self.datastore.objective_names()
+                     if n not in declared_obj]:
+            self.datastore.objective_delete(name)
+
+        declared_rw = {rw["source"] for rw in raw.model_rewrites}
+        for rw in raw.model_rewrites:
+            self.datastore.rewrite_set(InferenceModelRewrite(
+                name=rw.get("name") or rw["source"],
+                source_model=rw["source"],
+                targets=[ModelRewriteTarget(model=t["model"],
+                                            weight=int(t.get("weight", 1)))
+                         for t in rw.get("targets") or []]))
+        for source in [s for s in self.datastore.rewrite_sources()
+                       if s not in declared_rw]:
+            self.datastore.rewrite_delete(source)
+        log.info("config reconciled: %d endpoints, %d objectives, %d rewrites",
+                 len(metas), len(declared_obj), len(declared_rw))
+
+    async def _run(self):
+        try:
+            while True:
+                await asyncio.sleep(self.poll_interval_s)
+                self.reconcile_once()
+        except asyncio.CancelledError:
+            pass
+
+    async def start(self):
+        # Prime the mtime so the initial (already-loaded) config isn't
+        # re-applied; subsequent edits reconcile.
+        try:
+            self._mtime = os.stat(self.path).st_mtime
+        except OSError:
+            self._mtime = None
+        self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self):
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass

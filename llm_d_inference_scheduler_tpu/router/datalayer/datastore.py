@@ -127,6 +127,9 @@ class Datastore:
     def objective_get(self, name: str) -> InferenceObjective | None:
         return self._objectives.get(name)
 
+    def objective_names(self) -> list[str]:
+        return list(self._objectives)
+
     def rewrite_set(self, rw: InferenceModelRewrite) -> None:
         self._rewrites[rw.source_model] = rw
 
@@ -135,3 +138,6 @@ class Datastore:
 
     def rewrite_for(self, source_model: str) -> InferenceModelRewrite | None:
         return self._rewrites.get(source_model)
+
+    def rewrite_sources(self) -> list[str]:
+        return list(self._rewrites)

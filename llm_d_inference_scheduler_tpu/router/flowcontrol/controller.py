@@ -209,13 +209,20 @@ class _Shard:
                     item.resolve(QueueOutcome.EVICTED_SHED)
 
     def _bounded_backoff(self, backoff: float) -> float:
-        """Cap the saturated sleep at the earliest queued TTL deadline so
-        expired items are evicted on schedule, not when saturation lifts."""
+        """Cap the saturated sleep near the earliest queued TTL deadline so
+        expired items are evicted on schedule, not when saturation lifts.
+
+        O(flows), not O(backlog): only queue HEADS are consulted (exact for
+        EDF/SLO ordering and for FIFO with uniform TTLs; a deeper earlier
+        deadline under mixed-TTL FIFO is still caught by the rate-limited
+        full sweep within backoff+SWEEP_INTERVAL_S)."""
         now = time.monotonic()
-        next_deadline = min(
-            (it.deadline for q in self.queues.values() for it in q.items()
-             if it.deadline is not None),
-            default=None)
+        next_deadline = None
+        for q in self.queues.values():
+            head = q.peek()
+            if head is not None and head.deadline is not None:
+                if next_deadline is None or head.deadline < next_deadline:
+                    next_deadline = head.deadline
         if next_deadline is None:
             return backoff
         return max(min(backoff, next_deadline - now), 0.001)

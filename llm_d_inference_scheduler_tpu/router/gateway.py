@@ -66,7 +66,10 @@ ROUTER_OWNED_HEADERS = ("x-prefiller-host-port", "x-encoder-hosts-ports",
 class Gateway:
     def __init__(self, cfg: RouterConfig, datastore: Datastore,
                  dl_runtime: DataLayerRuntime, *, host: str = "127.0.0.1",
-                 port: int = 8081, grpc_health_port: int | None = None):
+                 port: int = 8081, grpc_health_port: int | None = None,
+                 grpc_ext_proc_port: int | None = None,
+                 lease_path: str | None = None,
+                 config_watch_path: str | None = None):
         self.cfg = cfg
         self.datastore = datastore
         self.dl_runtime = dl_runtime
@@ -133,9 +136,27 @@ class Gateway:
             from .health_grpc import HealthServer
 
             self.grpc_health = HealthServer(
-                ready_fn=lambda: (self.datastore.pool_ready
-                                  and bool(self.datastore.endpoint_list())),
-                host=host, port=grpc_health_port)
+                ready_fn=self._ready, host=host, port=grpc_health_port)
+        # HA leader election + config reconciliation (controlplane.py —
+        # reference runner.go:306-316 lease election with readiness coupling,
+        # pkg/epp/controller reconcilers).
+        self.elector = None
+        if lease_path is not None:
+            from .controlplane import LeaseConfig, LeaseElector
+
+            self.elector = LeaseElector(LeaseConfig(path=lease_path))
+        self.reconciler = None
+        if config_watch_path is not None:
+            from .controlplane import ConfigReconciler
+
+            self.reconciler = ConfigReconciler(config_watch_path, datastore)
+        self.grpc_ext_proc = None
+        if grpc_ext_proc_port is not None:
+            from .handlers.extproc_grpc import ExtProcServer
+
+            self.grpc_ext_proc = ExtProcServer(
+                self.director, self.parser, evictor=self.evictor,
+                host=host, port=grpc_ext_proc_port)
 
     # ---- lifecycle ------------------------------------------------------
 
@@ -158,6 +179,12 @@ class Gateway:
         self._flusher = asyncio.get_running_loop().create_task(self._flush_pool_gauges())
         if self.grpc_health is not None:
             await self.grpc_health.start()
+        if self.grpc_ext_proc is not None:
+            await self.grpc_ext_proc.start()
+        if self.elector is not None:
+            await self.elector.start()
+        if self.reconciler is not None:
+            await self.reconciler.start()
         log.info("gateway listening on %s:%s (%d endpoints)",
                  self.host, self.port, len(self.datastore.endpoint_list()))
 
@@ -166,6 +193,12 @@ class Gateway:
             self._flusher.cancel()
         if self.grpc_health is not None:
             await self.grpc_health.stop()
+        if self.grpc_ext_proc is not None:
+            await self.grpc_ext_proc.stop()
+        if self.reconciler is not None:
+            await self.reconciler.stop()
+        if self.elector is not None:
+            await self.elector.stop()
         if self.flow_controller is not None:
             await self.flow_controller.stop()
         if self._runner:
@@ -364,6 +397,10 @@ class Gateway:
             H_DESTINATION_SERVED: endpoint.metadata.address_port,
             "content-type": resp.headers.get("content-type", "application/json"),
         }
+        if ireq is not None and "x-session-token" in ireq.headers:
+            # Session stickiness: return the (scheduling-stamped) encoded
+            # token to the client (reference session_affinity.go ResponseBody).
+            out_headers["x-session-token"] = ireq.headers["x-session-token"]
         streaming = "text/event-stream" in resp.headers.get("content-type", "")
         usage: dict[str, int] = {}
         first_byte_at: float | None = None
@@ -410,10 +447,18 @@ class Gateway:
         return web.Response(body=generate_latest(REGISTRY),
                             content_type="text/plain", charset="utf-8")
 
+    def _ready(self) -> bool:
+        """Readiness couples to leadership (reference health.go:52-104): a
+        follower replica reports not-ready so the LB routes to the leader."""
+        if self.elector is not None and not self.elector.is_leader:
+            return False
+        return self.datastore.pool_ready and bool(self.datastore.endpoint_list())
+
     async def health(self, request: web.Request) -> web.Response:
-        ready = self.datastore.pool_ready and bool(self.datastore.endpoint_list())
+        ready = self._ready()
+        follower = self.elector is not None and not self.elector.is_leader
         return web.json_response(
-            {"status": "ok" if ready else "not-ready",
+            {"status": "ok" if ready else ("follower" if follower else "not-ready"),
              "endpoints": len(self.datastore.endpoint_list())},
             status=200 if ready else 503)
 
@@ -469,7 +514,10 @@ def _usage_from_sse(chunk: bytes) -> dict[str, int] | None:
 
 def build_gateway(config_text: str | None, *, host: str = "127.0.0.1",
                   port: int = 8081, poll_interval: float = 0.05,
-                  grpc_health_port: int | None = None) -> Gateway:
+                  grpc_health_port: int | None = None,
+                  grpc_ext_proc_port: int | None = None,
+                  lease_path: str | None = None,
+                  config_watch_path: str | None = None) -> Gateway:
     datastore = Datastore()
     dl_runtime = DataLayerRuntime(datastore, poll_interval=poll_interval)
     handle = Handle(datastore=datastore, dl_runtime=dl_runtime)
@@ -483,7 +531,10 @@ def build_gateway(config_text: str | None, *, host: str = "127.0.0.1",
         if hasattr(plugin, "endpoint_added") or hasattr(plugin, "endpoint_removed"):
             dl_runtime.register_lifecycle(plugin)
     return Gateway(cfg, datastore, dl_runtime, host=host, port=port,
-                   grpc_health_port=grpc_health_port)
+                   grpc_health_port=grpc_health_port,
+                   grpc_ext_proc_port=grpc_ext_proc_port,
+                   lease_path=lease_path,
+                   config_watch_path=config_watch_path)
 
 
 def main(argv: list[str] | None = None):
@@ -497,8 +548,17 @@ def main(argv: list[str] | None = None):
     p.add_argument("--endpoints", default=None,
                    help="comma-separated host:port[:role] static pool "
                         "(overrides config pool)")
+    p.add_argument("--grpc-ext-proc-port", type=int, default=None,
+                   help="serve the Envoy ext-proc FULL_DUPLEX_STREAMED gRPC "
+                        "service on this port (the EPP wire surface)")
     p.add_argument("--grpc-health-port", type=int, default=None,
                    help="serve grpc.health.v1.Health on this port")
+    p.add_argument("--ha-lease-path", default=None,
+                   help="enable leader election via this shared lease file; "
+                        "followers report not-ready until they take over")
+    p.add_argument("--watch-config", action="store_true",
+                   help="reconcile pool/objectives/rewrites live when "
+                        "--config-file changes on disk")
     args = p.parse_args(argv)
 
     text = args.config_text
@@ -507,7 +567,11 @@ def main(argv: list[str] | None = None):
             text = f.read()
 
     gw = build_gateway(text, host=args.host, port=args.port,
-                       grpc_health_port=args.grpc_health_port)
+                       grpc_health_port=args.grpc_health_port,
+                       grpc_ext_proc_port=args.grpc_ext_proc_port,
+                       lease_path=args.ha_lease_path,
+                       config_watch_path=(args.config_file
+                                          if args.watch_config else None))
     if args.endpoints:
         from .framework.datalayer import EndpointMetadata
         metas = []

@@ -119,6 +119,7 @@ class ExtProcSession:
         self.original_model = ""
         self.target_endpoint = None
         self.usage: dict[str, int] = {}
+        self._scheduled = False  # handle_request succeeded (hooks armed)
 
     # ---- request phase -------------------------------------------------
 
@@ -171,6 +172,7 @@ class ExtProcSession:
                 body=json.dumps({"error": e.reason}).encode())
 
         self.target_endpoint = result.primary().target_endpoints[0]
+        self._scheduled = True
         body_out = raw
         payload = self.request.body.payload
         if payload is not None and self.request.target_model != self.original_model:
@@ -206,6 +208,11 @@ class ExtProcSession:
         mutation = HeaderMutation(set_headers={
             H_DESTINATION_SERVED: (self.target_endpoint.metadata.address_port
                                    if self.target_endpoint else "")})
+        if self.request is not None and "x-session-token" in self.request.headers:
+            # Return the scheduling-stamped session token to the client
+            # (reference session_affinity.go ResponseBody).
+            mutation.set_headers["x-session-token"] = \
+                self.request.headers["x-session-token"]
         return CommonResponse(phase="response_headers", header_mutation=mutation)
 
     async def on_response_body(self, msg: ResponseBody):
@@ -225,6 +232,17 @@ class ExtProcSession:
             return CommonResponse(phase="response_body", body=body,
                                   dynamic_metadata={"usage": self.usage})
         return CommonResponse(phase="response_body", body=body)
+
+    def abandon(self) -> None:
+        """Stream ended without a terminal response body (client reset, Envoy
+        abort): run forced completion (reference server.go:232-254 defer) so
+        director-side per-request state — streaming-plugin workers, dispatch
+        counters — tears down instead of leaking. Idempotent."""
+        if (self._scheduled and self.request is not None
+                and self.state is not StreamState.COMPLETE):
+            self.state = StreamState.COMPLETE
+            self.director.handle_response_complete(
+                None, self.request, self.target_endpoint, self.usage)
 
     # ---- helpers -------------------------------------------------------
 

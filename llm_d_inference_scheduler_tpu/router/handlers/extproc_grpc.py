@@ -1,0 +1,347 @@
+"""Envoy ext-proc gRPC wire binding (FULL_DUPLEX_STREAMED).
+
+Reference: /root/reference/pkg/epp/handlers/server.go:168-287 — the EPP's
+actual product surface is `envoy.service.ext_proc.v3.ExternalProcessor/
+Process`, a bidirectional gRPC stream of ProcessingRequest/ProcessingResponse.
+This module is a pure codec + transport layer over the wire-agnostic state
+machine in handlers/extproc.py: the image ships grpcio but no generated Envoy
+protobufs, so the v3 messages are encoded/decoded by hand against the stable
+published schema (envoy/service/ext_proc/v3/external_processor.proto field
+numbers cited inline), the same approach as router/health_grpc.py.
+
+Mid-stream eviction mirrors the reference's armed evict channel
+(server.go:266-284, 353-356): after scheduling, the stream loop waits on
+{next frame, evict event} and answers an eviction with ImmediateResponse(429)
++ x-removal-reason.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, AsyncIterator
+
+import grpc
+import grpc.aio
+
+from ..flowcontrol.eviction import EVICTED_REASON
+from ..requestcontrol.admission import X_REMOVAL_REASON
+from .extproc import (
+    CommonResponse,
+    ExtProcSession,
+    HeaderMutation,
+    ImmediateResponse,
+    ProtocolError,
+    RequestBody,
+    RequestHeaders,
+    RequestTrailers,
+    ResponseBody,
+    ResponseHeaders,
+)
+from .vllmgrpc import _fields, _read_varint  # shared protobuf wire reader
+
+log = logging.getLogger("router.extproc_grpc")
+
+EXT_PROC_SERVICE = "envoy.service.ext_proc.v3.ExternalProcessor"
+
+# ProcessingRequest oneof request field numbers — NOTE the interleaved
+# request/response pairing of the published envoy schema
+# (external_processor.proto): headers 2/3, bodies 4/5, trailers 6/7.
+REQ_REQUEST_HEADERS = 2
+REQ_RESPONSE_HEADERS = 3
+REQ_REQUEST_BODY = 4
+REQ_RESPONSE_BODY = 5
+REQ_REQUEST_TRAILERS = 6
+REQ_RESPONSE_TRAILERS = 7
+
+# ProcessingResponse oneof response field numbers (same interleaving).
+RESP_REQUEST_HEADERS = 1
+RESP_RESPONSE_HEADERS = 2
+RESP_REQUEST_BODY = 3
+RESP_RESPONSE_BODY = 4
+RESP_REQUEST_TRAILERS = 5
+RESP_RESPONSE_TRAILERS = 6
+RESP_IMMEDIATE = 7
+RESP_DYNAMIC_METADATA = 8
+
+
+# ---- protobuf writer helpers -------------------------------------------
+
+
+def _varint(v: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        out.append(b | (0x80 if v else 0))
+        if not v:
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _ld(field: int, payload: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def _vi(field: int, value: int) -> bytes:
+    return _tag(field, 0) + _varint(value)
+
+
+# ---- HeaderMap / HeaderMutation codec ----------------------------------
+
+
+def _decode_header_map(buf: bytes) -> dict[str, str]:
+    """config.core.v3.HeaderMap { repeated HeaderValue headers = 1; }
+    HeaderValue { string key = 1; string value = 2; bytes raw_value = 3; }"""
+    out: dict[str, str] = {}
+    for field, wire, value in _fields(buf):
+        if field == 1 and wire == 2:
+            key = val = raw = None
+            for f2, w2, v2 in _fields(value):
+                if f2 == 1:
+                    key = v2.decode("utf-8", "replace")
+                elif f2 == 2:
+                    val = v2.decode("utf-8", "replace")
+                elif f2 == 3:
+                    raw = v2.decode("utf-8", "replace")
+            if key is not None:
+                out[key] = raw if raw is not None else (val or "")
+    return out
+
+
+def _encode_header_value(key: str, value: str) -> bytes:
+    # raw_value (3) is what Envoy expects from modern ext-proc servers.
+    return _ld(1, key.encode()) + _ld(3, value.encode())
+
+
+def _encode_header_mutation(m: HeaderMutation) -> bytes:
+    """HeaderMutation { repeated HeaderValueOption set_headers = 1;
+    repeated string remove_headers = 2; }; HeaderValueOption.header = 1
+    (default append_action OVERWRITE_IF_EXISTS_OR_ADD)."""
+    out = b""
+    for k, v in m.set_headers.items():
+        out += _ld(1, _ld(1, _encode_header_value(k, v)))
+    for k in m.remove_headers:
+        out += _ld(2, k.encode())
+    return out
+
+
+# ---- google.protobuf.Struct codec (dynamic_metadata) --------------------
+
+
+def _encode_value(v: Any) -> bytes:
+    """google.protobuf.Value: null=1, number=2(double), string=3, bool=4,
+    struct=5, list=6."""
+    import struct as _s
+
+    if v is None:
+        return _vi(1, 0)
+    if isinstance(v, bool):
+        return _vi(4, int(v))
+    if isinstance(v, (int, float)):
+        return _tag(2, 1) + _s.pack("<d", float(v))
+    if isinstance(v, str):
+        return _ld(3, v.encode())
+    if isinstance(v, dict):
+        return _ld(5, _encode_struct(v))
+    if isinstance(v, (list, tuple)):
+        payload = b"".join(_ld(1, _encode_value(x)) for x in v)
+        return _ld(6, payload)
+    return _ld(3, str(v).encode())
+
+
+def _encode_struct(d: dict[str, Any]) -> bytes:
+    """Struct { map<string, Value> fields = 1; } — map entries are nested
+    messages {key=1, value=2}."""
+    out = b""
+    for k, v in d.items():
+        entry = _ld(1, str(k).encode()) + _ld(2, _encode_value(v))
+        out += _ld(1, entry)
+    return out
+
+
+# ---- ProcessingRequest decode ------------------------------------------
+
+
+def decode_processing_request(data: bytes):
+    """Returns the extproc.py dataclass for the request's set oneof member."""
+    for field, wire, value in _fields(data):
+        if field in (REQ_REQUEST_HEADERS, REQ_RESPONSE_HEADERS) and wire == 2:
+            headers: dict[str, str] = {}
+            eos = False
+            for f2, w2, v2 in _fields(value):
+                if f2 == 1 and w2 == 2:      # HeaderMap
+                    headers = _decode_header_map(v2)
+                elif f2 == 3 and w2 == 0:    # end_of_stream
+                    eos = bool(v2)
+            if field == REQ_REQUEST_HEADERS:
+                return RequestHeaders(headers=headers, end_of_stream=eos,
+                                      path=headers.get(":path", "/v1/completions"))
+            try:
+                status = int(headers.get(":status", "200"))
+            except ValueError:
+                status = 200
+            return ResponseHeaders(headers=headers, status=status)
+        if field in (REQ_REQUEST_BODY, REQ_RESPONSE_BODY) and wire == 2:
+            body, eos = b"", False
+            for f2, w2, v2 in _fields(value):
+                if f2 == 1 and w2 == 2:
+                    body = v2
+                elif f2 == 2 and w2 == 0:
+                    eos = bool(v2)
+            cls = RequestBody if field == REQ_REQUEST_BODY else ResponseBody
+            return cls(chunk=body, end_of_stream=eos)
+        if field == REQ_REQUEST_TRAILERS and wire == 2:
+            trailers = {}
+            for f2, w2, v2 in _fields(value):
+                if f2 == 1 and w2 == 2:
+                    trailers = _decode_header_map(v2)
+            return RequestTrailers(trailers=trailers)
+        if field == REQ_RESPONSE_TRAILERS and wire == 2:
+            return RequestTrailers(trailers={})  # no-op phase; ack only
+    return None  # unknown/empty frame
+
+
+# ---- ProcessingResponse encode -----------------------------------------
+
+_PHASE_TO_FIELD = {
+    "request_headers": RESP_REQUEST_HEADERS,
+    "request_body": RESP_REQUEST_BODY,
+    "request_trailers": RESP_REQUEST_TRAILERS,
+    "response_headers": RESP_RESPONSE_HEADERS,
+    "response_body": RESP_RESPONSE_BODY,
+}
+
+
+def encode_processing_response(resp: CommonResponse | ImmediateResponse) -> bytes:
+    if isinstance(resp, ImmediateResponse):
+        # ImmediateResponse { HttpStatus status = 1 {code=1}; HeaderMutation
+        # headers = 2; body = 3; }
+        payload = _ld(1, _vi(1, resp.status))
+        if resp.headers:
+            payload += _ld(2, _encode_header_mutation(
+                HeaderMutation(set_headers=dict(resp.headers))))
+        if resp.body:
+            payload += _ld(3, resp.body)
+        return _ld(RESP_IMMEDIATE, payload)
+
+    # CommonResponse { status = 1 (CONTINUE=0); header_mutation = 2;
+    # body_mutation = 3 { body = 1 }; }
+    common = b""
+    if resp.header_mutation is not None:
+        common += _ld(2, _encode_header_mutation(resp.header_mutation))
+    if resp.body is not None:
+        common += _ld(3, _ld(1, resp.body))
+    field = _PHASE_TO_FIELD[resp.phase]
+    if field == RESP_REQUEST_TRAILERS:
+        # TrailersResponse { HeaderMutation header_mutation = 1; }
+        out = _ld(field, b"")
+    else:
+        # HeadersResponse/BodyResponse { CommonResponse response = 1; }
+        out = _ld(field, _ld(1, common))
+    if resp.dynamic_metadata:
+        out += _ld(RESP_DYNAMIC_METADATA, _encode_struct(resp.dynamic_metadata))
+    return out
+
+
+# ---- the gRPC service ---------------------------------------------------
+
+
+class ExtProcServer:
+    """Serves ExternalProcessor/Process: one ExtProcSession per stream."""
+
+    def __init__(self, director: Any, parser: Any, *, evictor: Any = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.director = director
+        self.parser = parser
+        self.evictor = evictor
+        self.host, self.port = host, port
+        self._server: grpc.aio.Server | None = None
+
+    async def _process(self, request_iterator: AsyncIterator[bytes], context):
+        session = ExtProcSession(self.director, self.parser)
+        evicted = asyncio.Event()
+        evict_key = None
+        it = request_iterator.__aiter__()
+        try:
+            while True:
+                recv = asyncio.ensure_future(it.__anext__())
+                waiters = [recv]
+                evict_waiter = None
+                if evict_key is not None:
+                    evict_waiter = asyncio.ensure_future(evicted.wait())
+                    waiters.append(evict_waiter)
+                done, pending = await asyncio.wait(
+                    waiters, return_when=asyncio.FIRST_COMPLETED)
+                if evict_waiter is not None and evict_waiter in done and not recv.done():
+                    # Mid-stream eviction (server.go:266-284): 429 + reason.
+                    recv.cancel()
+                    yield encode_processing_response(ImmediateResponse(
+                        status=429, headers={X_REMOVAL_REASON: EVICTED_REASON},
+                        body=b'{"error": "evicted"}'))
+                    return
+                if evict_waiter is not None and not evict_waiter.done():
+                    evict_waiter.cancel()
+                try:
+                    data = recv.result()
+                except StopAsyncIteration:
+                    return
+                msg = decode_processing_request(data)
+                if msg is None:
+                    continue  # ignore unknown frames (forward-compat)
+                try:
+                    if isinstance(msg, RequestHeaders):
+                        resp = await session.on_request_headers(msg)
+                    elif isinstance(msg, RequestBody):
+                        resp = await session.on_request_body(msg)
+                        if (self.evictor is not None and evict_key is None
+                                and session.request is not None
+                                and isinstance(resp, CommonResponse)):
+                            evict_key = self.evictor.register(
+                                session.request.request_id,
+                                session.request.objectives.priority,
+                                evicted.set)
+                    elif isinstance(msg, RequestTrailers):
+                        resp = await session.on_request_trailers(msg)
+                    elif isinstance(msg, ResponseHeaders):
+                        resp = await session.on_response_headers(msg)
+                    else:
+                        resp = await session.on_response_body(msg)
+                except ProtocolError as e:
+                    await context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                                        f"ext-proc protocol violation: {e}")
+                    return
+                yield encode_processing_response(resp)
+                if isinstance(resp, ImmediateResponse):
+                    return
+        finally:
+            if evict_key is not None and self.evictor is not None:
+                self.evictor.deregister(evict_key)
+            # Streams that end without a terminal response (reset mid-flight)
+            # still tear down director state (forced completion).
+            try:
+                session.abandon()
+            except Exception:
+                log.exception("session abandon failed")
+
+    async def start(self) -> int:
+        self._server = grpc.aio.server()
+        handlers = grpc.method_handlers_generic_handler(EXT_PROC_SERVICE, {
+            "Process": grpc.stream_stream_rpc_method_handler(
+                self._process,
+                request_deserializer=lambda b: b,    # codec handled above
+                response_serializer=lambda b: b),
+        })
+        self._server.add_generic_rpc_handlers((handlers,))
+        self.port = self._server.add_insecure_port(f"{self.host}:{self.port}")
+        await self._server.start()
+        log.info("ext-proc gRPC (FULL_DUPLEX_STREAMED) on %s:%d",
+                 self.host, self.port)
+        return self.port
+
+    async def stop(self):
+        if self._server:
+            await self._server.stop(grace=0.5)

@@ -105,9 +105,9 @@ def parse_grpc_frame(body: bytes) -> bytes:
 def _parse_tokenized(buf: bytes) -> tuple[str, list[int]]:
     text, ids = "", []
     for field, wire, value in _fields(buf):
-        if field == 1:
+        if field == 1 and wire == 2:
             text = value.decode("utf-8", "replace")
-        elif field == 2:
+        elif field == 2 and wire in (0, 2):
             ids.extend(_packed_uint32(value, wire))
     return text, ids
 
@@ -117,19 +117,19 @@ def _parse_sampling(buf: bytes) -> dict[str, Any]:
     stop: list[str] = []
     stop_ids: list[int] = []
     for field, wire, value in _fields(buf):
-        if field == 1:
+        if field == 1 and wire == 5:
             out["temperature"] = _f32(value, wire)
-        elif field == 2:
+        elif field == 2 and wire == 5:
             out["top_p"] = _f32(value, wire)
-        elif field == 3:
+        elif field == 3 and wire == 0:
             out["top_k"] = int(value)
-        elif field == 8:
+        elif field == 8 and wire == 0:
             out["max_tokens"] = int(value)
-        elif field == 10:
+        elif field == 10 and wire == 2:
             stop.append(value.decode("utf-8", "replace"))
-        elif field == 11:
+        elif field == 11 and wire in (0, 2):
             stop_ids.extend(_packed_uint32(value, wire))
-        elif field == 14:
+        elif field == 14 and wire == 0:
             out["ignore_eos"] = bool(value)
     if stop:
         out["stop"] = stop
@@ -143,19 +143,19 @@ def parse_generate_request(msg: bytes) -> dict[str, Any]:
     text=3, sampling_params=4, stream=5."""
     doc: dict[str, Any] = {}
     for field, wire, value in _fields(msg):
-        if field == 1:
+        if field == 1 and wire == 2:
             doc["request_id"] = value.decode("utf-8", "replace")
-        elif field == 2:
+        elif field == 2 and wire == 2:
             text, ids = _parse_tokenized(value)
             if ids:
                 doc["prompt_token_ids"] = ids
             if text and "prompt" not in doc:
                 doc["prompt"] = text
-        elif field == 3:
+        elif field == 3 and wire == 2:
             doc["prompt"] = value.decode("utf-8", "replace")
-        elif field == 4:
+        elif field == 4 and wire == 2:
             doc.update(_parse_sampling(value))
-        elif field == 5:
+        elif field == 5 and wire == 0:
             doc["stream"] = bool(value)
     return doc
 
@@ -163,9 +163,9 @@ def parse_generate_request(msg: bytes) -> dict[str, Any]:
 def parse_embed_request(msg: bytes) -> dict[str, Any]:
     doc: dict[str, Any] = {}
     for field, wire, value in _fields(msg):
-        if field == 1:
+        if field == 1 and wire == 2:
             doc["request_id"] = value.decode("utf-8", "replace")
-        elif field == 2:
+        elif field == 2 and wire == 2:
             text, ids = _parse_tokenized(value)
             if ids:
                 doc["input_token_ids"] = ids
@@ -195,9 +195,10 @@ class VllmGrpcParser(PluginBase):
                 if doc.get("prompt_token_ids"):
                     body.tokenized_prompt = doc["prompt_token_ids"]
             return ParseResult(body=body, model=str(doc.get("model", "")))
-        except (ValueError, struct.error) as e:
-            # struct.error belt-and-braces: _fields length-checks fixed-width
-            # slices, but attacker-supplied bytes must never 500 the gateway.
+        except (ValueError, struct.error, TypeError, AttributeError) as e:
+            # Broad by design: attacker-supplied bytes must never 500 the
+            # gateway — wire types are validated per field above, and any
+            # residual decode mismatch degrades to a parse error (400).
             return ParseResult(body=None, error=f"invalid gRPC payload: {e}")
 
     def serialize(self, body: InferenceRequestBody) -> bytes:

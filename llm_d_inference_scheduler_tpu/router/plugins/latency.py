@@ -84,8 +84,12 @@ class LatencyScorer(PluginBase):
             neg = idle
         else:
             neg = self._best_deficit_bucket(neg, infos)
-        # Negative headroom always scores "least" (closest to recovering).
-        scores = self._headroom_scores(neg, infos, "least")
+        # Negative headroom ranks by "closest to the SLO boundary" — the
+        # LEAST-negative value, i.e. the highest headroom, must win (the
+        # reference's always-least rule for negatives). In normalized terms
+        # that is the NON-inverted blend ("most"); inverting here would steer
+        # traffic onto the deepest violator.
+        scores = self._headroom_scores(neg, infos, "most")
         return {ap: scores.get(ap, 0.0) for ap in infos}
 
     def _best_deficit_bucket(self, endpoints, infos):

@@ -146,22 +146,42 @@ class LoraAffinityScorer(PluginBase):
 
 @register_plugin("session-affinity-scorer")
 class SessionAffinityScorer(PluginBase):
-    """Sticky routing via a session token header (reference
-    scorer/sessionaffinity): the PreRequest hook stamps the chosen endpoint
-    into the session token; subsequent requests with the token prefer it."""
+    """Sticky routing via an encoded session token (reference
+    scorer/sessionaffinity: base64 pod identity, session_affinity.go).
+    The token is stamped after scheduling and returned to the client on the
+    response (x-session-token); a client presenting it on a later request
+    scores its previous endpoint 1.0. Tokens that don't decode or don't name
+    a live endpoint simply score nothing (fresh placement)."""
 
     SESSION_HEADER = "x-session-token"
 
+    @staticmethod
+    def _encode(address_port: str) -> str:
+        import base64
+
+        return base64.standard_b64encode(address_port.encode()).decode()
+
+    @staticmethod
+    def _decode(token: str) -> str:
+        import base64
+        import binascii
+
+        try:
+            return base64.standard_b64decode(token.encode()).decode()
+        except (binascii.Error, UnicodeDecodeError, ValueError):
+            return ""
+
     def score(self, ctx, state, request, endpoints):
-        token = request.headers.get(self.SESSION_HEADER, "")
+        target = self._decode(request.headers.get(self.SESSION_HEADER, ""))
         return {ep.metadata.address_port:
-                (1.0 if token and token == ep.metadata.address_port else 0.0)
+                (1.0 if target and target == ep.metadata.address_port else 0.0)
                 for ep in endpoints}
 
     def pre_request(self, ctx, request, result) -> None:
         primary = result.primary().target_endpoints
         if primary:
-            request.headers[self.SESSION_HEADER] = primary[0].metadata.address_port
+            request.headers[self.SESSION_HEADER] = self._encode(
+                primary[0].metadata.address_port)
 
 
 @register_plugin("no-hit-lru-scorer")

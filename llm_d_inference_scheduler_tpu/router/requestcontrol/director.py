@@ -27,6 +27,7 @@ from .admission import AdmissionError
 log = logging.getLogger("router.director")
 
 PRODUCER_BUDGET_S = 0.4  # reference director.go:55
+_COMPLETE = object()  # stream-worker sentinel carrying the final usage
 
 # Wire contract headers (reference pkg/epp/metadata/metadata.go:38-61,
 # pkg/common/routing/common.go:11-17).
@@ -180,15 +181,58 @@ class Director:
                 log.exception("response_received plugin failure")
 
     def handle_response_streaming(self, ctx, request, endpoint, chunk: bytes) -> None:
-        for p in self.response_streaming:
-            try:
-                p.response_streaming(ctx, request, endpoint, chunk)
-            except Exception:
-                log.exception("response_streaming plugin failure")
+        """Streaming chunks run plugins on a per-request async worker
+        (reference director.go:92-134): a slow plugin must not add per-chunk
+        latency to the hot proxy path. The queue rides the request object —
+        torn down by handle_response_complete."""
+        if not self.response_streaming:
+            return
+        state = getattr(request, "_stream_plugin_state", None)
+        if state is None:
+            queue: asyncio.Queue = asyncio.Queue(maxsize=256)
+
+            async def worker():
+                while True:
+                    item = await queue.get()
+                    if item is None:
+                        return
+                    if isinstance(item, tuple) and item[0] is _COMPLETE:
+                        # Ordered completion: all queued chunks were processed
+                        # first (the reference's final-chunk-sync semantics).
+                        self._run_complete_plugins(ctx, request, endpoint, item[1])
+                        return
+                    for p in self.response_streaming:
+                        try:
+                            p.response_streaming(ctx, request, endpoint, item)
+                        except Exception:
+                            log.exception("response_streaming plugin failure")
+
+            task = asyncio.get_running_loop().create_task(worker())
+            state = (queue, task)
+            setattr(request, "_stream_plugin_state", state)
+        try:
+            state[0].put_nowait(chunk)
+        except asyncio.QueueFull:
+            log.warning("response-streaming plugin queue full; dropping chunk "
+                        "for %s", request.request_id)
 
     def handle_response_complete(self, ctx, request, endpoint,
                                  usage: dict[str, int]) -> None:
         RUNNING_REQUESTS.labels(request.target_model).dec()
+        state = getattr(request, "_stream_plugin_state", None)
+        if state is not None:
+            # Route completion through the worker so it runs AFTER every
+            # queued chunk (chunk → complete ordering must hold for plugins
+            # like the latency producer's first-token timestamping).
+            try:
+                state[0].put_nowait((_COMPLETE, usage))
+                return
+            except asyncio.QueueFull:
+                state[1].cancel()  # fall through to inline completion
+        self._run_complete_plugins(ctx, request, endpoint, usage)
+
+    def _run_complete_plugins(self, ctx, request, endpoint,
+                              usage: dict[str, int]) -> None:
         for p in self.response_complete:
             try:
                 p.response_complete(ctx, request, endpoint, usage)

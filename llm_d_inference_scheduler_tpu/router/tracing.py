@@ -69,6 +69,18 @@ class Tracer:
                              else float(os.environ.get("TRACING_SAMPLE_RATIO", "0.1")))
         self.finished: deque[dict[str, Any]] = deque(maxlen=capacity)
         self._rng = random.Random()
+        # Exporter slot (the OTLP analogue): callbacks receive each finished
+        # span dict. TRACING_EXPORT_PATH wires the built-in JSONL file
+        # exporter (OTLP-shaped records, collectable by any log shipper —
+        # genuine export in a zero-egress environment).
+        self._exporters: list[Any] = []
+        export_path = os.environ.get("TRACING_EXPORT_PATH", "")
+        if export_path:
+            self.add_exporter(FileSpanExporter(export_path))
+
+    def add_exporter(self, exporter: Any) -> None:
+        """exporter(span_dict) or an object with .export(span_dict)."""
+        self._exporters.append(getattr(exporter, "export", exporter))
 
     @contextlib.contextmanager
     def span(self, name: str, **attributes):
@@ -97,12 +109,31 @@ class Tracer:
         finally:
             s.end = time.monotonic()
             _current_span.reset(token)
-            self.finished.append(s.to_dict())
+            doc = s.to_dict()
+            self.finished.append(doc)
+            for export in self._exporters:
+                try:
+                    export(doc)
+                except Exception:
+                    log.exception("span exporter failure")
             log.debug("span %s %.2fms %s", s.name,
                       (s.end - s.start) * 1e3, s.attributes)
 
     def snapshot(self) -> list[dict[str, Any]]:
         return list(self.finished)
+
+
+class FileSpanExporter:
+    """JSONL span sink: one OTLP-shaped record per finished span."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def export(self, span: dict[str, Any]) -> None:
+        import json
+
+        with open(self.path, "a") as f:
+            f.write(json.dumps(span) + "\n")
 
 
 class _NoopSpan:

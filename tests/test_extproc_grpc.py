@@ -1,0 +1,343 @@
+"""Envoy ext-proc gRPC wire binding: real FULL_DUPLEX_STREAMED frames over a
+live grpc.aio channel (VERDICT r1 item 5 — the header-mutation and
+ImmediateResponse semantics of reference handlers/server.go:202-414)."""
+
+import asyncio
+import json
+
+import grpc
+import grpc.aio
+import pytest
+
+from llm_d_inference_scheduler_tpu.engine import EngineConfig
+from llm_d_inference_scheduler_tpu.engine.server import EngineServer
+from llm_d_inference_scheduler_tpu.router.gateway import build_gateway
+
+METHOD = "/envoy.service.ext_proc.v3.ExternalProcessor/Process"
+
+
+# ---- independent protobuf encoding (pins the wire format) ---------------
+
+
+def _vi(v: int) -> bytes:
+    out = b""
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        out += bytes([b | (0x80 if v else 0)])
+        if not v:
+            return out
+
+
+def _tag(f: int, w: int) -> bytes:
+    return _vi((f << 3) | w)
+
+
+def _ld(f: int, p: bytes) -> bytes:
+    return _tag(f, 2) + _vi(len(p)) + p
+
+
+def _header_map(headers: dict[str, str]) -> bytes:
+    out = b""
+    for k, v in headers.items():
+        out += _ld(1, _ld(1, k.encode()) + _ld(2, v.encode()))
+    return out
+
+
+def req_headers_frame(headers: dict[str, str], eos: bool = False) -> bytes:
+    msg = _ld(1, _header_map(headers))
+    if eos:
+        msg += _tag(3, 0) + _vi(1)
+    return _ld(2, msg)  # ProcessingRequest.request_headers = 2
+
+
+def req_body_frame(body: bytes, eos: bool = True) -> bytes:
+    msg = _ld(1, body)
+    if eos:
+        msg += _tag(2, 0) + _vi(1)
+    return _ld(4, msg)  # ProcessingRequest.request_body = 4 (interleaved!)
+
+
+def resp_headers_frame(headers: dict[str, str]) -> bytes:
+    return _ld(3, _ld(1, _header_map(headers)))  # response_headers = 3
+
+
+def resp_body_frame(body: bytes, eos: bool = True) -> bytes:
+    msg = _ld(1, body)
+    if eos:
+        msg += _tag(2, 0) + _vi(1)
+    return _ld(5, msg)  # response_body = 5
+
+
+# ---- minimal response decoding ------------------------------------------
+
+
+def _fields(buf: bytes):
+    pos = 0
+    while pos < len(buf):
+        tag = 0
+        shift = 0
+        while True:
+            b = buf[pos]
+            pos += 1
+            tag |= (b & 0x7F) << shift
+            if not b & 0x80:
+                break
+            shift += 7
+        field, wire = tag >> 3, tag & 0x7
+        if wire == 0:
+            v = 0
+            shift = 0
+            while True:
+                b = buf[pos]
+                pos += 1
+                v |= (b & 0x7F) << shift
+                if not b & 0x80:
+                    break
+                shift += 7
+            yield field, wire, v
+        elif wire == 2:
+            ln = 0
+            shift = 0
+            while True:
+                b = buf[pos]
+                pos += 1
+                ln |= (b & 0x7F) << shift
+                if not b & 0x80:
+                    break
+                shift += 7
+            yield field, wire, buf[pos:pos + ln]
+            pos += ln
+        else:
+            raise AssertionError(f"unexpected wire type {wire}")
+
+
+def decode_response(data: bytes) -> dict:
+    """Flattens a ProcessingResponse into {oneof, set_headers, body, status}."""
+    out = {"oneof": None, "set_headers": {}, "body": None, "status": None,
+           "has_dynamic_metadata": False}
+    names = {1: "request_headers", 2: "response_headers", 3: "request_body",
+             4: "response_body", 5: "request_trailers", 6: "response_trailers",
+             7: "immediate"}
+
+    def walk_common(buf):
+        for f, w, v in _fields(buf):
+            if f == 2 and w == 2:  # header_mutation
+                walk_mutation(v)
+            elif f == 3 and w == 2:  # body_mutation
+                for f2, w2, v2 in _fields(v):
+                    if f2 == 1:
+                        out["body"] = v2
+
+    def walk_mutation(buf):
+        for f, w, v in _fields(buf):
+            if f == 1 and w == 2:  # HeaderValueOption
+                for f2, w2, v2 in _fields(v):
+                    if f2 == 1 and w2 == 2:  # HeaderValue
+                        key = raw = val = None
+                        for f3, w3, v3 in _fields(v2):
+                            if f3 == 1:
+                                key = v3.decode()
+                            elif f3 == 2:
+                                val = v3.decode()
+                            elif f3 == 3:
+                                raw = v3.decode()
+                        if key:
+                            out["set_headers"][key] = raw or val or ""
+
+    for field, wire, value in _fields(data):
+        if field in names and wire == 2:
+            out["oneof"] = names[field]
+            if field == 7:  # ImmediateResponse
+                for f, w, v in _fields(value):
+                    if f == 1 and w == 2:  # HttpStatus
+                        for f2, w2, v2 in _fields(v):
+                            if f2 == 1:
+                                out["status"] = v2
+                    elif f == 2 and w == 2:
+                        walk_mutation(v)
+                    elif f == 3 and w == 2:
+                        out["body"] = v
+            else:
+                for f, w, v in _fields(value):
+                    if f == 1 and w == 2:  # CommonResponse
+                        walk_common(v)
+        elif field == 8 and wire == 2:
+            out["has_dynamic_metadata"] = True
+    return out
+
+
+async def _call(channel, frames):
+    call = channel.stream_stream(
+        METHOD,
+        request_serializer=lambda b: b,
+        response_deserializer=lambda b: b)
+    out = []
+    stream = call(iter_frames(frames))
+    async for raw in stream:
+        out.append(decode_response(raw))
+    return out
+
+
+async def iter_frames(frames):
+    for f in frames:
+        yield f
+
+
+ENG, GW = 18671, 18670
+
+
+def test_ext_proc_grpc_full_stream():
+    async def body():
+        eng = EngineServer(EngineConfig(backend="sim", model="tiny", port=ENG,
+                                        sim_decode_ms_per_token=1.0))
+        await eng.start()
+        gw = build_gateway(f"""
+pool:
+  endpoints:
+    - {{address: 127.0.0.1, port: {ENG}}}
+modelRewrites:
+  - {{source: alias-model, targets: [{{model: tiny, weight: 1}}]}}
+""", port=GW, poll_interval=0.02, grpc_ext_proc_port=0)
+        await gw.start()
+        try:
+            port = gw.grpc_ext_proc.port
+            async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as ch:
+                req = json.dumps({"model": "alias-model", "prompt": "hi",
+                                  "max_tokens": 2}).encode()
+                resps = await _call(ch, [
+                    req_headers_frame({":path": "/v1/completions",
+                                       "content-type": "application/json"}),
+                    req_body_frame(req),
+                    resp_headers_frame({":status": "200"}),
+                    resp_body_frame(json.dumps(
+                        {"model": "tiny", "usage": {"completion_tokens": 2}}
+                    ).encode()),
+                ])
+            assert [r["oneof"] for r in resps] == [
+                "request_headers", "request_body",
+                "response_headers", "response_body"]
+            body_resp = resps[1]
+            assert body_resp["set_headers"][
+                "x-gateway-destination-endpoint"] == f"127.0.0.1:{ENG}"
+            assert body_resp["has_dynamic_metadata"]
+            # model rewrite applied on the way in...
+            assert json.loads(body_resp["body"])["model"] == "tiny"
+            # ...and un-rewritten on the way out (server.go:471-485)
+            assert resps[2]["set_headers"][
+                "x-gateway-destination-endpoint-served"] == f"127.0.0.1:{ENG}"
+            assert json.loads(resps[3]["body"])["model"] == "alias-model"
+        finally:
+            await gw.stop()
+            await eng.stop()
+
+    asyncio.run(body())
+
+
+def test_ext_proc_grpc_immediate_response_on_bad_body():
+    async def body():
+        eng = EngineServer(EngineConfig(backend="sim", model="tiny", port=ENG,
+                                        sim_decode_ms_per_token=1.0))
+        await eng.start()
+        gw = build_gateway(f"""
+pool:
+  endpoints:
+    - {{address: 127.0.0.1, port: {ENG}}}
+""", port=GW, poll_interval=0.02, grpc_ext_proc_port=0)
+        await gw.start()
+        try:
+            async with grpc.aio.insecure_channel(
+                    f"127.0.0.1:{gw.grpc_ext_proc.port}") as ch:
+                resps = await _call(ch, [
+                    req_headers_frame({":path": "/v1/completions"}),
+                    req_body_frame(b"this is not json"),
+                ])
+            assert resps[-1]["oneof"] == "immediate"
+            assert resps[-1]["status"] == 400
+            assert "x-removal-reason" in resps[-1]["set_headers"]
+        finally:
+            await gw.stop()
+            await eng.stop()
+
+    asyncio.run(body())
+
+
+def test_ext_proc_grpc_bodyless_fallback():
+    async def body():
+        eng = EngineServer(EngineConfig(backend="sim", model="tiny", port=ENG,
+                                        sim_decode_ms_per_token=1.0))
+        await eng.start()
+        gw = build_gateway(f"""
+pool:
+  endpoints:
+    - {{address: 127.0.0.1, port: {ENG}}}
+""", port=GW, poll_interval=0.02, grpc_ext_proc_port=0)
+        await gw.start()
+        try:
+            async with grpc.aio.insecure_channel(
+                    f"127.0.0.1:{gw.grpc_ext_proc.port}") as ch:
+                resps = await _call(ch, [
+                    req_headers_frame({":path": "/v1/completions"}, eos=True),
+                ])
+            # Bodyless → random-endpoint fallback (request.go:40-47).
+            assert resps[0]["oneof"] == "request_headers"
+            assert resps[0]["set_headers"][
+                "x-gateway-destination-endpoint"] == f"127.0.0.1:{ENG}"
+        finally:
+            await gw.stop()
+            await eng.stop()
+
+    asyncio.run(body())
+
+
+def test_ext_proc_grpc_mid_stream_eviction():
+    async def body():
+        eng = EngineServer(EngineConfig(backend="sim", model="tiny", port=ENG,
+                                        sim_decode_ms_per_token=1.0))
+        await eng.start()
+        gw = build_gateway(f"""
+objectives:
+  - {{name: batch, priority: -1}}
+pool:
+  endpoints:
+    - {{address: 127.0.0.1, port: {ENG}}}
+""", port=GW, poll_interval=0.02, grpc_ext_proc_port=0)
+        await gw.start()
+        try:
+            async with grpc.aio.insecure_channel(
+                    f"127.0.0.1:{gw.grpc_ext_proc.port}") as ch:
+                call = ch.stream_stream(METHOD,
+                                        request_serializer=lambda b: b,
+                                        response_deserializer=lambda b: b)
+                send_q: asyncio.Queue = asyncio.Queue()
+
+                async def frames():
+                    while True:
+                        f = await send_q.get()
+                        if f is None:
+                            return
+                        yield f
+
+                stream = call(frames())
+                req = json.dumps({"model": "tiny", "prompt": "x",
+                                  "max_tokens": 50}).encode()
+                await send_q.put(req_headers_frame({
+                    ":path": "/v1/completions",
+                    "x-gateway-inference-objective": "batch"}))
+                await send_q.put(req_body_frame(req))
+                r1 = decode_response(await stream.read())
+                r2 = decode_response(await stream.read())
+                assert r2["oneof"] == "request_body"
+                # The scheduled sheddable request is now registered; evict it.
+                assert gw.evictor.inflight_count == 1
+                assert gw.evictor.evict_n(1) == 1
+                r3 = decode_response(await stream.read())
+                assert r3["oneof"] == "immediate"
+                assert r3["status"] == 429
+                assert "x-removal-reason" in r3["set_headers"]
+                await send_q.put(None)
+        finally:
+            await gw.stop()
+            await eng.stop()
+
+    asyncio.run(body())

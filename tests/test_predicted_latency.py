@@ -265,3 +265,12 @@ def test_slo_routing_steers_around_slow_endpoint():
             await fast.stop()
 
     asyncio.run(body())
+
+
+def test_scorer_all_negative_prefers_least_violating():
+    """Among busy negative-headroom endpoints, the one CLOSEST to the SLO
+    boundary (least negative) must win — not the deepest violator."""
+    deep = _ep(1, info=_info(-400, -5, dispatched=2))
+    near = _ep(2, info=_info(-10, -5, dispatched=2))
+    scores = LatencyScorer().score(None, None, _req(), [deep, near])
+    assert scores["127.0.0.1:2"] > scores["127.0.0.1:1"]

@@ -131,11 +131,23 @@ schedulingProfiles:
     chosen = result.primary().target_endpoints[0].metadata.address_port
     for p in cfg.pre_request_plugins:
         p.pre_request(None, r1, result)
-    assert r1.headers["x-session-token"] == chosen
-    # A follow-up with the token sticks even if the other endpoint is less loaded.
-    r2 = req(headers={"x-session-token": "b:8200"})
+    import base64
+
+    # The stamped token is OPAQUE (base64 endpoint identity, reference
+    # session_affinity.go), not a raw address echo.
+    token = r1.headers["x-session-token"]
+    assert token != chosen
+    assert base64.standard_b64decode(token).decode() == chosen
+    # A follow-up presenting the token sticks even if the other endpoint is
+    # less loaded.
+    r2 = req(headers={"x-session-token":
+                      base64.standard_b64encode(b"b:8200").decode()})
     result2 = cfg.scheduler.schedule(None, r2, eps)
     assert result2.primary().target_endpoints[0].metadata.address_port == "b:8200"
+    # Garbage tokens degrade to fresh placement, not errors.
+    r3 = req(headers={"x-session-token": "!!not-base64!!"})
+    result3 = cfg.scheduler.schedule(None, r3, eps)
+    assert result3.primary().target_endpoints[0].metadata.address_port == "a:8200"
 
 
 def test_extractor_parses_jetstream_and_vllm():
@@ -283,3 +295,55 @@ def test_example_configs_load():
         assert cfg.scheduler is not None, path.name
         loaded += 1
     assert loaded >= 3  # monolithic, disagg, slo_aware
+
+
+def test_response_streaming_plugins_run_async_but_ordered():
+    """Streaming plugins run off the hot path on a per-request worker
+    (reference director.go:92-134), and completion runs strictly AFTER all
+    queued chunks."""
+    import asyncio
+
+    from llm_d_inference_scheduler_tpu.router.requestcontrol.director import (
+        Director,
+    )
+
+    events = []
+
+    class SlowStreamPlugin:
+        def typed_name(self):
+            return ("t", "slow")
+
+        def response_streaming(self, ctx, request, endpoint, chunk):
+            events.append(("chunk", chunk))
+
+        def response_complete(self, ctx, request, endpoint, usage):
+            events.append(("complete", usage.get("n")))
+
+    async def body():
+        plugin = SlowStreamPlugin()
+        d = Director(Datastore(), None, admission=None,
+                     response_streaming=[plugin], response_complete=[plugin])
+        r = req()
+        t0 = __import__("time").monotonic()
+        for i in range(5):
+            d.handle_response_streaming(None, r, None, f"c{i}".encode())
+        # Enqueue is non-blocking regardless of plugin cost.
+        assert __import__("time").monotonic() - t0 < 0.05
+        d.handle_response_complete(None, r, None, {"n": 7})
+        await asyncio.sleep(0.1)  # worker drains
+        assert events == [("chunk", b"c0"), ("chunk", b"c1"), ("chunk", b"c2"),
+                          ("chunk", b"c3"), ("chunk", b"c4"), ("complete", 7)]
+
+    asyncio.run(body())
+
+
+def test_decode_batch_bucket():
+    from llm_d_inference_scheduler_tpu.engine.config import EngineConfig
+    from llm_d_inference_scheduler_tpu.engine.core import TpuEngine
+
+    eng = TpuEngine(EngineConfig(model="tiny", max_batch=8, kv_events_port=0))
+    assert eng._batch_bucket(1) == 1
+    assert eng._batch_bucket(2) == 2
+    assert eng._batch_bucket(3) == 4
+    assert eng._batch_bucket(5) == 8
+    assert eng._batch_bucket(8) == 8
