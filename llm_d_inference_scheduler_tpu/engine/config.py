@@ -35,6 +35,9 @@ class EngineConfig:
     # over a tp-sized mesh axis; remaining devices form the dp axis. 1 = the
     # single-device layout (no mesh). BASELINE.md config 4 path.
     tp_size: int = 1
+    # Expert parallelism (MoE models): shard the experts axis over ep_size
+    # devices (composes with tp_size; total devices = tp_size * ep_size).
+    ep_size: int = 1
     # KV cache event stream (ZMQ PUB) feeding the router's precise prefix
     # scorer; 0 disables, -1 = port + 1000.
     kv_events_port: int = -1

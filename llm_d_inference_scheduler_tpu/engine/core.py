@@ -116,12 +116,13 @@ class TpuEngine:
         # devices (dp=1): the engine does not dp-shard its batch, so claiming
         # more devices would only replicate the compute.
         self.mesh = None
-        if cfg.tp_size > 1:
+        if cfg.tp_size > 1 or cfg.ep_size > 1:
             from ..parallel.serve import make_serve_mesh, validate_tp
 
-            validate_tp(self.mcfg, cfg.tp_size)
-            self.mesh = make_serve_mesh(jax.devices()[: cfg.tp_size],
-                                        tp=cfg.tp_size)
+            validate_tp(self.mcfg, cfg.tp_size, cfg.ep_size)
+            n_model = cfg.tp_size * cfg.ep_size
+            self.mesh = make_serve_mesh(jax.devices()[:n_model],
+                                        tp=cfg.tp_size, ep=cfg.ep_size)
 
         if params is not None or cfg.checkpoint_path:
             if params is None:
@@ -228,6 +229,25 @@ class TpuEngine:
                 return last, k_pages, v_pages
             self._prefill_fns[bucket] = jax.jit(impl, donate_argnums=(3, 4))
         return self._prefill_fns[bucket]
+
+    def _mm_prefill_fn(self, bucket: int, mm_bucket: int):
+        """Prefill with multimodal embedding injection (E/P/D phase 2):
+        encoder vectors overwrite the placeholder-token embeddings; padding
+        entries point out of range and are dropped by the scatter."""
+        key = ("mm", bucket, mm_bucket)
+        if key not in self._prefill_fns:
+            def impl(params, tokens, seq_len, mm_embeds, mm_positions,
+                     k_pages, v_pages, block_table_row):
+                logits, (k_new, v_new) = llama.forward(
+                    params, self.mcfg, tokens, want_kv=True,
+                    mm_embeds=mm_embeds, mm_positions=mm_positions)
+                k_pages, v_pages = llama.write_prefill_kv(
+                    k_pages, v_pages, k_new, v_new, block_table_row, seq_len)
+                last = jnp.take_along_axis(
+                    logits, (seq_len - 1)[:, None, None], axis=1)[:, 0]
+                return last, k_pages, v_pages
+            self._prefill_fns[key] = jax.jit(impl, donate_argnums=(5, 6))
+        return self._prefill_fns[key]
 
     def _prefix_prefill_fn(self, suffix_bucket: int, prefix_bucket: int):
         """Jitted prefill continuing from cached prefix KV, keyed on
@@ -553,8 +573,15 @@ class TpuEngine:
         prompt = req.prompt_token_ids[: self.cfg.max_model_len - 1]
         block = self.mcfg.kv_block_size
         caching_enabled = isinstance(self.allocator, PrefixCachingAllocator)
+        if req.mm_embeds is not None:
+            # Multimodal prompts are NOT content-addressable by token ids:
+            # identical placeholder tokens can carry different images, so
+            # prefix caching and KV-event publication are disabled for them.
+            caching_enabled = False
         hashes = (chain_block_hashes(self.model_name, prompt, "", block)
-                  if caching_enabled or self.kv_events is not None else [])
+                  if caching_enabled or
+                  (self.kv_events is not None and req.mm_embeds is None)
+                  else [])
 
         # Automatic prefix caching: reuse the longest cached run of complete
         # prompt blocks (keeping ≥1 suffix token so logits can be computed).
@@ -647,6 +674,30 @@ class TpuEngine:
 
     def _run_prefill_compute(self, req, prompt, suffix, cached_tokens,
                              matched_bids, row) -> int:
+        if req.mm_embeds is not None:
+            bucket = self._bucket(len(prompt))
+            tokens = np.zeros((1, bucket), np.int32)
+            tokens[0, : len(prompt)] = prompt
+            mm = np.asarray(req.mm_embeds, np.float32)
+            mm_bucket = 1
+            while mm_bucket < mm.shape[0]:
+                mm_bucket *= 2
+            mm_pad = np.zeros((1, mm_bucket, mm.shape[1]), np.float32)
+            mm_pad[0, : mm.shape[0]] = mm
+            # Padding positions land out of range → dropped by the scatter.
+            # Missing/short mm_positions default to an image-first layout.
+            positions = list(req.mm_positions or [])
+            while len(positions) < mm.shape[0]:
+                positions.append(len(positions))
+            pos_pad = np.full((1, mm_bucket), bucket, np.int32)
+            pos_pad[0, : mm.shape[0]] = positions[: mm.shape[0]]
+            fn = self._mm_prefill_fn(bucket, mm_bucket)
+            logits, self.k_pages, self.v_pages = fn(
+                self.params, jnp.asarray(tokens),
+                jnp.asarray([len(prompt)], jnp.int32),
+                jnp.asarray(mm_pad), jnp.asarray(pos_pad),
+                self.k_pages, self.v_pages, jnp.asarray(row))
+            return int(self._sample(logits, [req])[0])
         if matched_bids:
             bucket = self._bucket(len(suffix))
             prefix_bucket = 1

@@ -34,6 +34,10 @@ class EngineRequest:
     # P/D disaggregation handshake (mirrors the reference's kv_transfer_params
     # relay, /root/reference pkg/sidecar/proxy/connector_nixlv2.go:109-131):
     kv_transfer_params: dict[str, Any] | None = None
+    # Multimodal prefill (E/P/D phase 2): encoder output vectors [M, D] to
+    # splice in at prompt positions mm_positions (placeholder tokens).
+    mm_embeds: Any = None          # np.ndarray [M, D] | None
+    mm_positions: list[int] | None = None
     arrival_time: float = dataclasses.field(default_factory=time.monotonic)
 
 

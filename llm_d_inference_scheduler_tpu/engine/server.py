@@ -108,17 +108,20 @@ class EngineServer:
             web.get("/kv/{request_id}", self.kv_fetch),
             web.delete("/kv/{request_id}", self.kv_release),
             web.post("/v1/encode", self.encode),
+            web.get("/ec/{request_id}", self.ec_fetch),
             web.get("/kv_events", self.kv_events_stream),
         ])
-        # E/PD encode-primer store: request_id -> encoded multimodal items
+        # E/PD encode store: request_id -> staged encoder output
+        # {"embeds": float32 [rows, D], "indices": global item indices}
         # (the reference reads these engine-side via an EC connector;
         # SURVEY §2.10 connector_epd_shared_storage.go). Bounded LRU so
-        # unclaimed primers can't grow host memory without limit.
+        # unclaimed embeddings can't grow host memory without limit.
         from collections import OrderedDict
 
-        self.ec_store: "OrderedDict[str, int]" = OrderedDict()
+        self.ec_store: "OrderedDict[str, dict[str, Any]]" = OrderedDict()
         self._ec_capacity = 1024
         self._runner: web.AppRunner | None = None
+        self._ec_client = None  # long-lived client for /ec pulls
 
     # ---- lifecycle ----------------------------------------------------
 
@@ -140,6 +143,8 @@ class EngineServer:
     async def stop(self):
         if self._runner:
             await self._runner.cleanup()
+        if self._ec_client is not None:
+            await self._ec_client.aclose()
         await self.engine.stop()
 
     # ---- request plumbing ---------------------------------------------
@@ -151,7 +156,56 @@ class EngineServer:
             return prompt
         raise web.HTTPBadRequest(text="prompt must be a string or a list of token ids")
 
-    def _build_request(self, body: dict[str, Any], prompt_ids: list[int]) -> EngineRequest:
+    async def _resolve_multimodal(self, body: dict[str, Any],
+                                  prompt_ids: list[int]):
+        """E/P/D phase 2: pull staged encoder embeddings from the ec_sources
+        the sidecar primed, and splice placeholder positions into the prompt
+        (image-first layout: embedding tokens precede the text)."""
+        sources = body.get("ec_sources") or []
+        if not sources:
+            return prompt_ids, None, None
+        rid = str(body.get("request_id") or "")
+        import httpx
+
+        if self._ec_client is None:
+            self._ec_client = httpx.AsyncClient(timeout=10)
+
+        async def fetch(host):
+            try:
+                r = await self._ec_client.get(f"http://{host}/ec/{rid}")
+                r.raise_for_status()
+                return r.json()
+            except Exception as e:
+                log.warning("ec fetch from %s for %s failed: %s", host, rid, e)
+                return None
+
+        docs = [d for d in await asyncio.gather(*[fetch(h) for h in sources])
+                if d and d.get("embeddings")]
+        # Restore the ORIGINAL item order across the sidecar's round-robin
+        # fan-out: each host reports which global items it encoded; every
+        # item contributes an equal row count (n_patches), so split, tag,
+        # and re-sort.
+        tagged = []
+        for doc in docs:
+            arr = np.asarray(doc["embeddings"], np.float32)
+            indices = doc.get("item_indices") or [0]
+            per = arr.shape[0] // max(len(indices), 1)
+            for j, idx in enumerate(indices):
+                tagged.append((int(idx), arr[j * per:(j + 1) * per]))
+        if not tagged:
+            return prompt_ids, None, None
+        tagged.sort(key=lambda t: t[0])
+        mm = np.concatenate([rows for _, rows in tagged], axis=0)
+        d_model = getattr(getattr(self.engine, "mcfg", None), "d_model", None)
+        if d_model is not None and mm.shape[1] != d_model:
+            log.warning("encoder dim %d != model d_model %d; ignoring "
+                        "multimodal embeddings", mm.shape[1], d_model)
+            return prompt_ids, None, None
+        m = mm.shape[0]
+        return [0] * m + prompt_ids, mm, list(range(m))
+
+    def _build_request(self, body: dict[str, Any], prompt_ids: list[int],
+                       mm_embeds=None, mm_positions=None) -> EngineRequest:
         try:
             return EngineRequest(
                 request_id=str(body.get("request_id") or f"req-{uuid.uuid4().hex[:12]}"),
@@ -170,6 +224,8 @@ class EngineServer:
                                      if body.get("cache_hit_threshold") is not None
                                      else None),
                 kv_transfer_params=body.get("kv_transfer_params"),
+                mm_embeds=mm_embeds,
+                mm_positions=mm_positions,
             )
         except (TypeError, ValueError) as e:
             raise web.HTTPBadRequest(text=f"invalid sampling/limit parameter: {e}")
@@ -293,7 +349,9 @@ class EngineServer:
     async def completions(self, request: web.Request) -> web.StreamResponse:
         body = await _json_body(request)
         prompt_ids = self._tokenize_prompt(body.get("prompt", ""))
-        req = self._build_request(body, prompt_ids)
+        prompt_ids, mm, mm_pos = await self._resolve_multimodal(body, prompt_ids)
+        req = self._build_request(body, prompt_ids, mm_embeds=mm,
+                                  mm_positions=mm_pos)
         stops = self._stop_strings(body)
         out = self.engine.submit(req)
         try:
@@ -309,7 +367,9 @@ class EngineServer:
         messages = body.get("messages", [])
         prompt_ids = self.engine.tokenizer.encode(_chat_to_prompt(
             messages, continue_final_message=bool(body.get("continue_final_message"))))
-        req = self._build_request(body, prompt_ids)
+        prompt_ids, mm, mm_pos = await self._resolve_multimodal(body, prompt_ids)
+        req = self._build_request(body, prompt_ids, mm_embeds=mm,
+                                  mm_positions=mm_pos)
         stops = self._stop_strings(body)
         out = self.engine.submit(req)
         try:
@@ -420,22 +480,97 @@ class EngineServer:
             pub.hub.unsubscribe(q)
         return resp
 
+    def _vision(self):
+        """Lazy vision tower (encode workers; BASELINE config 5 CPU encode).
+
+        The projection width follows the SERVED model's d_model (deploy
+        encode workers with the same --model as the serving fleet), so the
+        embeddings splice into prefill without a dim mismatch."""
+        if not hasattr(self, "_vision_state"):
+            import dataclasses as _dc
+
+            import jax
+
+            from ..models.vision import (
+                VIT_TINY,
+                encode_image,
+                init_vision_params,
+            )
+
+            vcfg = _dc.replace(VIT_TINY,
+                               out_dim=self.cfg.model_config.d_model)
+            params = init_vision_params(vcfg, jax.random.key(self.cfg.seed))
+            fn = jax.jit(lambda px: encode_image(params, vcfg, px))
+            self._vision_state = (vcfg, fn)
+        return self._vision_state
+
+    def _item_pixels(self, item: dict[str, Any], vcfg) -> "np.ndarray":
+        """Pixels for one multimodal item: inline `pixels` (H, W, C floats)
+        are used directly (resized/cropped to the tower's square input);
+        URL-style items get deterministic pseudo-pixels derived from the URL
+        (zero-egress environment — the tower still runs end-to-end and two
+        different URLs produce different embeddings)."""
+        px = item.get("pixels")
+        if px is not None:
+            arr = np.asarray(px, np.float32)
+            if arr.ndim == 2:
+                arr = arr[..., None]
+            out = np.zeros((vcfg.image_size, vcfg.image_size, vcfg.channels),
+                           np.float32)
+            h = min(arr.shape[0], vcfg.image_size)
+            w = min(arr.shape[1], vcfg.image_size)
+            c = min(arr.shape[2], vcfg.channels)
+            out[:h, :w, :c] = arr[:h, :w, :c]
+            return out
+        import hashlib
+
+        digest = hashlib.sha256(json.dumps(item, sort_keys=True).encode()).digest()
+        rng = np.random.default_rng(int.from_bytes(digest[:8], "little"))
+        return rng.standard_normal(
+            (vcfg.image_size, vcfg.image_size, vcfg.channels)).astype(np.float32)
+
     async def encode(self, request: web.Request) -> web.Response:
-        """E/PD encoder-primer endpoint: accept multimodal items and stage
-        their embeddings for the prefill/decode engines (sidecar fan-out
-        target; reference connector_epd_shared_storage.go:38-211). Real
-        vision towers land behind this surface; the protocol contract is
-        item receipt + ack keyed by request id."""
+        """E/PD encoder endpoint: run the vision tower over the request's
+        multimodal items and stage the embeddings for the prefill/decode
+        engines to pull via GET /ec/{request_id} (sidecar fan-out target;
+        reference connector_epd_shared_storage.go:38-211 — 'shared storage'
+        here is the encode worker's own store)."""
         body = await _json_body(request)
         rid = str(body.get("request_id") or f"enc-{uuid.uuid4().hex[:8]}")
         items = body.get("items") or []
         if not isinstance(items, list):
             raise web.HTTPBadRequest(text="items must be a list")
-        self.ec_store[rid] = len(items)
+        indices = body.get("item_indices")
+        if not isinstance(indices, list) or len(indices) != len(items):
+            indices = list(range(len(items)))
+        if items:
+            vcfg, fn = self._vision()
+            pixels = np.stack([self._item_pixels(it, vcfg) for it in items])
+            embeds = np.asarray(fn(pixels))          # [N, n_patches, out_dim]
+            embeds = embeds.reshape(-1, embeds.shape[-1])  # [N*patches, D]
+        else:
+            embeds = np.zeros((0, 0), np.float32)
+        self.ec_store[rid] = {"embeds": embeds,
+                              "indices": [int(i) for i in indices]}
         self.ec_store.move_to_end(rid)
         while len(self.ec_store) > self._ec_capacity:
             self.ec_store.popitem(last=False)
-        return web.json_response({"request_id": rid, "encoded_items": len(items)})
+        return web.json_response({"request_id": rid, "encoded_items": len(items),
+                                  "embedding_tokens": int(embeds.shape[0])})
+
+    async def ec_fetch(self, request: web.Request) -> web.Response:
+        """Serve staged encoder embeddings to the prefill/decode engine."""
+        rid = request.match_info["request_id"]
+        rec = self.ec_store.get(rid)
+        if not isinstance(rec, dict) or "embeds" not in rec:
+            raise web.HTTPNotFound(text=f"no encoded embeddings for {rid}")
+        embeds = rec["embeds"]
+        return web.json_response({
+            "request_id": rid,
+            "dim": int(embeds.shape[1]) if embeds.size else 0,
+            "item_indices": rec["indices"],
+            "embeddings": embeds.tolist(),
+        })
 
 
 async def run_server(cfg: EngineConfig):
@@ -469,6 +604,9 @@ def main(argv: list[str] | None = None):
     p.add_argument("--tp-size", type=int, default=1,
                    help="tensor-parallel degree: shard params + KV pages over "
                         "this many devices (BASELINE config 4 path)")
+    p.add_argument("--ep-size", type=int, default=1,
+                   help="expert-parallel degree for MoE models (composes "
+                        "with --tp-size)")
     args = p.parse_args(argv)
     if args.platform:
         import jax
@@ -478,7 +616,7 @@ def main(argv: list[str] | None = None):
                        max_model_len=args.max_model_len, role=args.role,
                        served_model_name=args.served_model_name,
                        checkpoint_path=args.checkpoint, warmup=args.warmup,
-                       tp_size=args.tp_size)
+                       tp_size=args.tp_size, ep_size=args.ep_size)
     logging.basicConfig(level=logging.INFO)
     asyncio.run(run_server(cfg))
 

@@ -25,6 +25,9 @@ class ModelConfig:
     dtype: str = "bfloat16"
     # Paged KV cache geometry (engine half).
     kv_block_size: int = 16
+    # Mixture-of-experts (Mixtral-family): n_experts == 0 means dense FFN.
+    n_experts: int = 0
+    experts_per_token: int = 2
 
     @property
     def head_dim(self) -> int:
@@ -80,7 +83,37 @@ LLAMA3_1B = ModelConfig(
     d_ff=8192,
 )
 
-_REGISTRY = {c.name: c for c in (LLAMA3_8B, LLAMA3_70B, LLAMA3_1B, TINY)}
+# Mixtral-family MoE (public 8x7B architecture card).
+MIXTRAL_8X7B = ModelConfig(
+    name="mixtral-8x7b",
+    vocab_size=32_000,
+    d_model=4096,
+    n_layers=32,
+    n_heads=32,
+    n_kv_heads=8,
+    d_ff=14336,
+    rope_theta=1_000_000.0,
+    n_experts=8,
+    experts_per_token=2,
+)
+
+# Small MoE config for CI tests and the expert-parallel dry run.
+TINY_MOE = ModelConfig(
+    name="tiny-moe",
+    vocab_size=512,
+    d_model=128,
+    n_layers=2,
+    n_heads=4,
+    n_kv_heads=2,
+    d_ff=256,
+    max_seq_len=256,
+    rope_theta=10_000.0,
+    n_experts=4,
+    experts_per_token=2,
+)
+
+_REGISTRY = {c.name: c for c in (LLAMA3_8B, LLAMA3_70B, LLAMA3_1B, TINY,
+                                 MIXTRAL_8X7B, TINY_MOE)}
 
 
 def get_config(name: str) -> ModelConfig:

@@ -31,31 +31,76 @@ Params = dict[str, Any]
 
 
 def init_params(cfg: ModelConfig, key: jax.Array, dtype: jnp.dtype | None = None) -> Params:
-    """Random-init parameters (stacked-layer layout)."""
+    """Random-init parameters (stacked-layer layout).
+
+    MoE configs (cfg.n_experts > 0, Mixtral family) stack the FFN weights
+    with an extra experts axis [L, E, D, F] plus a per-layer router; the FFN
+    hook (:func:`_ffn`) dispatches on the pytree structure at trace time, so
+    every downstream path (train forward, prefill, paged decode) serves both
+    families unchanged."""
     dtype = dtype or jnp.dtype(cfg.dtype)
     L, D, F, V = cfg.n_layers, cfg.d_model, cfg.d_ff, cfg.vocab_size
     Hq, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    ks = jax.random.split(key, 9)
+    E = cfg.n_experts
+    ks = jax.random.split(key, 10)
 
     def w(k, shape, fan_in):
         return (jax.random.normal(k, shape, jnp.float32) * (fan_in ** -0.5)).astype(dtype)
 
+    ffn_shape = (L, E, D, F) if E else (L, D, F)
+    down_shape = (L, E, F, D) if E else (L, F, D)
+    layers = {
+        "wq": w(ks[1], (L, D, Hq * Dh), D),
+        "wk": w(ks[2], (L, D, Hkv * Dh), D),
+        "wv": w(ks[3], (L, D, Hkv * Dh), D),
+        "wo": w(ks[4], (L, Hq * Dh, D), Hq * Dh),
+        "w1": w(ks[5], ffn_shape, D),
+        "w2": w(ks[6], down_shape, F),
+        "w3": w(ks[7], ffn_shape, D),
+        "ln_attn": jnp.ones((L, D), dtype),
+        "ln_mlp": jnp.ones((L, D), dtype),
+    }
+    if E:
+        layers["router"] = w(ks[9], (L, D, E), D)
     return {
         "embed": w(ks[0], (V, D), D),
-        "layers": {
-            "wq": w(ks[1], (L, D, Hq * Dh), D),
-            "wk": w(ks[2], (L, D, Hkv * Dh), D),
-            "wv": w(ks[3], (L, D, Hkv * Dh), D),
-            "wo": w(ks[4], (L, Hq * Dh, D), Hq * Dh),
-            "w1": w(ks[5], (L, D, F), D),
-            "w2": w(ks[6], (L, F, D), F),
-            "w3": w(ks[7], (L, D, F), D),
-            "ln_attn": jnp.ones((L, D), dtype),
-            "ln_mlp": jnp.ones((L, D), dtype),
-        },
+        "layers": layers,
         "final_norm": jnp.ones((D,), dtype),
         "lm_head": w(ks[8], (D, V), D),
     }
+
+
+def _moe_ffn(cfg: ModelConfig, lp: Params, h: jnp.ndarray) -> jnp.ndarray:
+    """Top-k mixture-of-experts FFN (Mixtral-style), dense-over-experts.
+
+    Compute is formulated as batched einsums over the experts axis — static
+    shapes, MXU-tiled, and shardable: with the experts dim of w1/w2/w3 laid
+    out on the ``ep`` mesh axis each device computes its local experts and
+    XLA reduces the weighted combine with one psum. (At production scale the
+    dense form trades FLOPs for regularity; a Pallas grouped-matmul drops in
+    behind this same signature.)
+    """
+    logits = (h @ lp["router"]).astype(jnp.float32)          # [B, S, E]
+    top_vals, top_idx = jax.lax.top_k(logits, cfg.experts_per_token)
+    gates = jax.nn.softmax(top_vals, axis=-1)                # [B, S, k]
+    onehot = jax.nn.one_hot(top_idx, cfg.n_experts, dtype=h.dtype)  # [B,S,k,E]
+    weights = jnp.einsum("bske,bsk->bse", onehot, gates.astype(h.dtype))
+
+    up = jnp.einsum("bsd,edf->bsef", h, lp["w1"])
+    gate = jnp.einsum("bsd,edf->bsef", h, lp["w3"])
+    out = jnp.einsum("bsef,efd->bsed", jax.nn.silu(up) * gate, lp["w2"])
+    return jnp.einsum("bsed,bse->bsd", out, weights)
+
+
+def _ffn(cfg: ModelConfig, lp: Params, h: jnp.ndarray) -> jnp.ndarray:
+    """Dense or MoE FFN — dispatched on pytree structure at trace time."""
+    if "router" in lp:
+        squeeze = h.ndim == 2  # decode step: [B, D]
+        if squeeze:
+            h = h[:, None]
+        y = _moe_ffn(cfg, lp, h)
+        return y[:, 0] if squeeze else y
+    return (jax.nn.silu(h @ lp["w1"]) * (h @ lp["w3"])) @ lp["w2"]
 
 
 def _layer(
@@ -82,7 +127,7 @@ def _layer(
     x = x + attn.reshape(B, S, -1) @ lp["wo"]
 
     h = rms_norm(x, lp["ln_mlp"], cfg.norm_eps)
-    x = x + (jax.nn.silu(h @ lp["w1"]) * (h @ lp["w3"])) @ lp["w2"]
+    x = x + _ffn(cfg, lp, h)
     return x, k, v
 
 
@@ -95,10 +140,16 @@ def forward(
     want_kv: bool = False,
     attention_fn: Callable[..., jnp.ndarray] = causal_attention,
     kv_valid: jnp.ndarray | None = None,  # [B, S] padding mask
+    mm_embeds: jnp.ndarray | None = None,     # [B, M, D] multimodal vectors
+    mm_positions: jnp.ndarray | None = None,  # [B, M] target positions
 ) -> tuple[jnp.ndarray, tuple[jnp.ndarray, jnp.ndarray] | None]:
     """Full-sequence forward (training / prefill).
 
-    Returns (logits [B, S, V] f32, (K, V) each [L, B, S, Hkv, Dh] if want_kv).
+    Multimodal prefill (E/P/D phase 2): ``mm_embeds`` replace the token
+    embeddings at ``mm_positions`` (encoder outputs spliced in at placeholder
+    tokens; padding entries use out-of-range positions, dropped by the
+    scatter). Returns (logits [B, S, V] f32, (K, V) each [L, B, S, Hkv, Dh]
+    if want_kv).
     """
     B, S = tokens.shape
     if positions is None:
@@ -106,6 +157,9 @@ def forward(
     cos, sin = rope_table(positions, cfg.head_dim, cfg.rope_theta)
 
     x = params["embed"][tokens]  # [B, S, D]
+    if mm_embeds is not None:
+        x = x.at[jnp.arange(B)[:, None], mm_positions].set(
+            mm_embeds.astype(x.dtype), mode="drop")
     attn_kwargs = dict(q_positions=positions, kv_positions=positions, kv_valid=kv_valid)
 
     def body(x, lp):
@@ -171,7 +225,7 @@ def decode_step(
                                           cur_k=k, cur_v=v)
         x = x + attn.reshape(B, -1) @ lp["wo"]
         h = rms_norm(x, lp["ln_mlp"], cfg.norm_eps)
-        x = x + (jax.nn.silu(h @ lp["w1"]) * (h @ lp["w3"])) @ lp["w2"]
+        x = x + _ffn(cfg, lp, h)
         return x, (k, v)
 
     x, (k_cur, v_cur) = jax.lax.scan(body, x, (params["layers"], k_pages, v_pages))
@@ -241,7 +295,7 @@ def prefill_with_prefix(
                                 kv_positions=kv_positions, kv_valid=kv_valid)
         x = x + attn.reshape(1, S, -1) @ lp["wo"]
         h = rms_norm(x, lp["ln_mlp"], cfg.norm_eps)
-        x = x + (jax.nn.silu(h @ lp["w1"]) * (h @ lp["w3"])) @ lp["w2"]
+        x = x + _ffn(cfg, lp, h)
         return x, (k, v)
 
     x, (k_new, v_new) = jax.lax.scan(body, x, (params["layers"], k_pages, v_pages))

@@ -6,6 +6,8 @@ Axis convention (used across the engine and train paths):
   attention rotates KV chunks over this axis via ``ppermute`` (ICI neighbors).
 - ``tp``: tensor parallel — hidden/head dims of weight matrices; XLA inserts
   all-reduce/reduce-scatter over it from the shardings.
+- ``ep``: expert parallel — the experts axis of MoE FFN weights; the gated
+  combine reduces over it (size 1 for dense models).
 """
 
 from __future__ import annotations
@@ -14,24 +16,33 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXES = ("dp", "sp", "tp")
+AXES = ("dp", "sp", "tp", "ep")
 
 
-def mesh_shape(n_devices: int, tp: int | None = None, sp: int | None = None) -> tuple[int, int, int]:
-    """Factor n_devices into (dp, sp, tp); powers of two get all three axes."""
+def mesh_shape(n_devices: int, tp: int | None = None, sp: int | None = None,
+               ep: int | None = None) -> tuple[int, int, int, int]:
+    """Factor n_devices into (dp, sp, tp, ep); powers of two get the model
+    axes first, the remainder lands on dp."""
+    if ep is None:
+        ep = 1
+    if n_devices % ep:
+        raise ValueError(f"{n_devices} devices not divisible by ep={ep}")
+    rem = n_devices // ep
     if tp is None:
-        tp = 2 if n_devices % 2 == 0 else 1
-    rem = n_devices // tp
+        tp = 2 if rem % 2 == 0 else 1
+    rem //= tp
     if sp is None:
         sp = 2 if rem % 2 == 0 else 1
     dp = rem // sp
-    if dp * sp * tp != n_devices:
-        raise ValueError(f"cannot factor {n_devices} into (dp,sp,tp)=({dp},{sp},{tp})")
-    return dp, sp, tp
+    if dp * sp * tp * ep != n_devices:
+        raise ValueError(f"cannot factor {n_devices} into "
+                         f"(dp,sp,tp,ep)=({dp},{sp},{tp},{ep})")
+    return dp, sp, tp, ep
 
 
-def make_mesh(devices=None, tp: int | None = None, sp: int | None = None) -> Mesh:
+def make_mesh(devices=None, tp: int | None = None, sp: int | None = None,
+              ep: int | None = None) -> Mesh:
     devices = devices if devices is not None else jax.devices()
-    dp, sp_, tp_ = mesh_shape(len(devices), tp=tp, sp=sp)
-    arr = np.array(devices).reshape(dp, sp_, tp_)
+    dp, sp_, tp_, ep_ = mesh_shape(len(devices), tp=tp, sp=sp, ep=ep)
+    arr = np.array(devices).reshape(dp, sp_, tp_, ep_)
     return Mesh(arr, AXES)

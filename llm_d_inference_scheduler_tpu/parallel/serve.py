@@ -23,32 +23,40 @@ from ..models import llama
 from ..models.configs import ModelConfig
 from .shardings import param_pspecs
 
-SERVE_AXES = ("dp", "tp")
+SERVE_AXES = ("dp", "tp", "ep")
 
 # KV pages [L, N_blocks, block, Hkv, Dh]: shard kv heads over tp, replicate the
-# block pool over dp (any lane may reference any block).
+# block pool over dp/ep (any lane may reference any block; attention has no
+# experts axis).
 KV_PAGE_SPEC = P(None, None, None, "tp", None)
 
 
-def make_serve_mesh(devices=None, tp: int = 1) -> Mesh:
+def make_serve_mesh(devices=None, tp: int = 1, ep: int = 1) -> Mesh:
     devices = list(devices if devices is not None else jax.devices())
-    if len(devices) % tp:
-        raise ValueError(f"{len(devices)} devices not divisible by tp={tp}")
-    arr = np.array(devices).reshape(len(devices) // tp, tp)
+    if len(devices) % (tp * ep):
+        raise ValueError(f"{len(devices)} devices not divisible by "
+                         f"tp*ep={tp}*{ep}")
+    arr = np.array(devices).reshape(len(devices) // (tp * ep), tp, ep)
     return Mesh(arr, SERVE_AXES)
 
 
-def validate_tp(cfg: ModelConfig, tp: int) -> None:
-    """TP must divide every sharded dim (kv heads bound the paged-KV shard)."""
+def validate_tp(cfg: ModelConfig, tp: int, ep: int = 1) -> None:
+    """TP must divide every sharded dim (kv heads bound the paged-KV shard);
+    EP must divide the expert count."""
     for dim, name in ((cfg.n_kv_heads, "n_kv_heads"), (cfg.n_heads, "n_heads"),
                       (cfg.d_ff, "d_ff"), (cfg.vocab_size, "vocab_size")):
         if dim % tp:
             raise ValueError(f"tp={tp} does not divide {name}={dim}")
+    if ep > 1:
+        if not cfg.n_experts:
+            raise ValueError("ep>1 requires an MoE config (n_experts > 0)")
+        if cfg.n_experts % ep:
+            raise ValueError(f"ep={ep} does not divide n_experts={cfg.n_experts}")
 
 
 def serve_shardings(cfg: ModelConfig, mesh: Mesh):
     """(param shardings pytree, kv-page sharding) for an engine on `mesh`."""
-    validate_tp(cfg, mesh.shape["tp"])
+    validate_tp(cfg, mesh.shape["tp"], mesh.shape.get("ep", 1))
     params = jax.tree.map(lambda s: NamedSharding(mesh, s), param_pspecs(cfg))
     pages = NamedSharding(mesh, KV_PAGE_SPEC)
     return params, pages
@@ -72,15 +80,15 @@ def alloc_sharded_pages(cfg: ModelConfig, mesh: Mesh, n_blocks: int, dtype=None)
     return zeros(), zeros()
 
 
-def dryrun_serve(cfg: ModelConfig, devices, tp: int = 2, decode_steps: int = 3,
-                 atol: float = 2e-3) -> None:
-    """Prefill + N decode steps with TP-sharded params/pages and a dp-sharded
-    batch; asserts logits match the unsharded single-device path.
+def dryrun_serve(cfg: ModelConfig, devices, tp: int = 2, ep: int = 1,
+                 decode_steps: int = 3, atol: float = 2e-3) -> None:
+    """Prefill + N decode steps with TP/EP-sharded params/pages and a
+    dp-sharded batch; asserts logits match the unsharded single-device path.
 
     Driver-facing stepping stone to BASELINE.md config 4 (70B TP-sharded
     decode): proves the serving jits compile and execute SPMD over a mesh.
     """
-    mesh = make_serve_mesh(devices, tp=tp)
+    mesh = make_serve_mesh(devices, tp=tp, ep=ep)
     dp = mesh.shape["dp"]
     B = max(2, dp)
     block = cfg.kv_block_size

@@ -1,9 +1,14 @@
-"""PartitionSpecs for the stacked-layer Llama pytree (models/llama.py).
+"""PartitionSpecs for the stacked-layer Llama/Mixtral pytree (models/llama.py).
 
 Megatron-style TP: column-parallel wq/wk/wv/w1/w3 (output dim on ``tp``),
 row-parallel wo/w2 (input dim on ``tp``) so each block needs one all-reduce,
 which XLA inserts from these shardings. Embedding/lm_head shard the vocab dim.
 Layer-stacked arrays carry a leading unsharded L axis.
+
+MoE configs (n_experts > 0) lay the experts axis of w1/w2/w3 on ``ep``
+(expert parallelism): each device computes its local experts in the
+dense-over-experts einsum and XLA reduces the gated combine with one psum
+over ``ep``. The router is small and replicated.
 """
 
 from __future__ import annotations
@@ -16,20 +21,32 @@ from jax.sharding import PartitionSpec as P
 ACT_SPEC = P("dp", "sp", None)
 
 
-def param_pspecs(_cfg=None) -> dict[str, Any]:
+def param_pspecs(cfg=None) -> dict[str, Any]:
+    moe = bool(getattr(cfg, "n_experts", 0))
+    if moe:
+        # Experts over ep; within an expert, shard the FFN hidden dim over tp
+        # (same column/row split as the dense path, one extra axis out front).
+        w1 = w3 = P(None, "ep", None, "tp")
+        w2 = P(None, "ep", "tp", None)
+    else:
+        w1 = w3 = P(None, None, "tp")
+        w2 = P(None, "tp", None)
+    layers = {
+        "wq": P(None, None, "tp"),
+        "wk": P(None, None, "tp"),
+        "wv": P(None, None, "tp"),
+        "wo": P(None, "tp", None),
+        "w1": w1,
+        "w2": w2,
+        "w3": w3,
+        "ln_attn": P(None, None),
+        "ln_mlp": P(None, None),
+    }
+    if moe:
+        layers["router"] = P(None, None, None)
     return {
         "embed": P("tp", None),
-        "layers": {
-            "wq": P(None, None, "tp"),
-            "wk": P(None, None, "tp"),
-            "wv": P(None, None, "tp"),
-            "wo": P(None, "tp", None),
-            "w1": P(None, None, "tp"),
-            "w2": P(None, "tp", None),
-            "w3": P(None, None, "tp"),
-            "ln_attn": P(None, None),
-            "ln_mlp": P(None, None),
-        },
+        "layers": layers,
         "final_norm": P(None),
         "lm_head": P(None, "tp"),
     }

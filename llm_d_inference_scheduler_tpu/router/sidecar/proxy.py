@@ -278,22 +278,33 @@ class Sidecar:
         items = self._multimodal_items(body)
         if not items or not hosts:
             return None
-        rid = body.get("request_id") or request.headers.get("x-request-id", "")
+        rid = (body.get("request_id")
+               or request.headers.get("x-request-id")
+               or f"epd-{id(body):x}")
         shares: list[list[dict[str, Any]]] = [[] for _ in hosts]
+        share_indices: list[list[int]] = [[] for _ in hosts]
         for i, item in enumerate(items):
             shares[i % len(hosts)].append(item)
+            share_indices[i % len(hosts)].append(i)
         try:
             import asyncio as _aio
 
+            primed = [(h, share, idxs) for h, share, idxs
+                      in zip(hosts, shares, share_indices) if share]
             results = await _aio.gather(*[
                 self._client.post(f"http://{h}/v1/encode",
-                                  json={"request_id": rid, "items": share})
-                for h, share in zip(hosts, shares) if share])
+                                  json={"request_id": rid, "items": share,
+                                        "item_indices": idxs})
+                for h, share, idxs in primed])
             for r in results:
                 if r.status_code != 200:
                     return f"encoder returned {r.status_code}"
         except Exception as e:
             return str(e)
+        # Tell the downstream engines where to pull the staged embeddings
+        # (the EC-connector config of reference engines, here per-request).
+        body["request_id"] = rid
+        body["ec_sources"] = [h for h, _, _ in primed]
         return None
 
     async def _run_pd_protocol(self, request: web.Request, body: dict[str, Any],
