@@ -9,6 +9,12 @@ from .serve import (
     alloc_sharded_pages,
     dryrun_serve,
 )
+from .pipeline import (
+    make_pp_mesh,
+    make_pp_forward,
+    shard_params_pp,
+    dryrun_pipeline,
+)
 
 __all__ = [
     "make_mesh",
@@ -24,4 +30,8 @@ __all__ = [
     "init_sharded_params",
     "alloc_sharded_pages",
     "dryrun_serve",
+    "make_pp_mesh",
+    "make_pp_forward",
+    "shard_params_pp",
+    "dryrun_pipeline",
 ]

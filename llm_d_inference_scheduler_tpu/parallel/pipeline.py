@@ -1,0 +1,160 @@
+"""Pipeline parallelism (pp): GPipe-style microbatch pipeline over stages.
+
+The reference has no tensor sharding at all (SURVEY §2.12); this is the
+TPU-native pipeline axis for models whose layer stack exceeds one chip/slice
+even under TP. Design (scaling-book pipelining recipe, shard_map form):
+
+- The stacked layer axis [L, ...] is split across a ``pp`` mesh axis: each
+  stage owns a contiguous slab of L/P layers (embedding + lm_head are small
+  and replicated; stage 0 applies the embedding, the last stage the head).
+- The batch is cut into M microbatches. A ``lax.fori_loop`` runs M+P-1
+  ticks; each tick every stage computes its slab on its current microbatch
+  and hands the activations to the next stage with a single ``ppermute``
+  (neighbor ICI hop — the canonical pipeline transfer). Bubble fraction is
+  (P-1)/(M+P-1), the GPipe schedule.
+- Everything is static-shaped: microbatch validity is handled with
+  ``jnp.where`` masks, not control flow, so XLA overlaps the ppermute with
+  the next tick's compute.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..models import llama
+from ..models.configs import ModelConfig
+from ..ops import rms_norm
+
+
+def make_pp_mesh(devices=None, pp: int | None = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    pp = pp or len(devices)
+    return Mesh(np.array(devices[:pp]).reshape(pp), ("pp",))
+
+
+def shard_params_pp(params, cfg: ModelConfig, mesh: Mesh):
+    """Layer-stacked weights split over pp; embedding/head replicated."""
+    P_ = mesh.shape["pp"]
+    if cfg.n_layers % P_:
+        raise ValueError(f"pp={P_} does not divide n_layers={cfg.n_layers}")
+    specs = {
+        "embed": P(),
+        "layers": jax.tree.map(lambda _: P("pp"), params["layers"]),
+        "final_norm": P(),
+        "lm_head": P(),
+    }
+    shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                             is_leaf=lambda x: isinstance(x, P))
+    return jax.device_put(params, shardings)
+
+
+def make_pp_forward(cfg: ModelConfig, mesh: Mesh, n_microbatches: int):
+    """Returns jitted forward(params, tokens[B, S]) -> logits [B, S, V].
+
+    B must divide into n_microbatches equal microbatches; layers must divide
+    into mesh.shape['pp'] equal stage slabs.
+    """
+    P_ = mesh.shape["pp"]
+    M = n_microbatches
+    perm = [(i, i + 1) for i in range(P_ - 1)]
+
+    def pp_forward(params, tokens):
+        from ..ops import rope_table
+
+        B, S = tokens.shape
+        mb = B // M
+        stage = jax.lax.axis_index("pp")
+        positions = jnp.broadcast_to(
+            jnp.arange(S, dtype=jnp.int32)[None, :], (mb, S))
+        # Loop-invariant: rope tables computed once, closed over by the ticks.
+        cos, sin = rope_table(positions, cfg.head_dim, cfg.rope_theta)
+        D = params["embed"].shape[1]
+
+        def stage_apply(x):
+            """Run this stage's layer slab (scan over the local L/P layers)."""
+            def body(x, lp):
+                x, _, _ = llama._layer(cfg, lp, x, cos, sin,
+                                       llama.causal_attention,
+                                       dict(q_positions=positions,
+                                            kv_positions=positions))
+                return x, None
+
+            x, _ = jax.lax.scan(body, x, params["layers"])
+            return x
+
+        # Initial carries are marked varying over pp (lax.pvary): the loop
+        # body mixes them with stage-dependent values, and shard_map's
+        # varying-axis type checking requires carry in/out types to agree.
+        x = jax.lax.pvary(jnp.zeros((mb, S, D), params["embed"].dtype), "pp")
+        # Accumulate the LAST stage's hidden states only; the vocab-sized
+        # head matmul runs once per microbatch AFTER the loop, not per tick.
+        hidden = jax.lax.pvary(
+            jnp.zeros((M, mb, S, D), params["embed"].dtype), "pp")
+
+        def tick(step, carry):
+            x, hidden = carry
+            # Receive the previous stage's activations (stage 0 gets zeros,
+            # then overwrites with its microbatch embedding).
+            x = jax.lax.ppermute(x, "pp", perm)
+            mb_idx = jnp.clip(step, 0, M - 1)
+            fresh = params["embed"][
+                jax.lax.dynamic_slice_in_dim(tokens, mb_idx * mb, mb, 0)]
+            x = jnp.where(stage == 0, fresh, x)
+            x = stage_apply(x)
+            # Last stage finishes microbatch (step - (P-1)) at this tick.
+            done_idx = step - (P_ - 1)
+            slot = jnp.clip(done_idx, 0, M - 1)
+            valid = (stage == P_ - 1) & (done_idx >= 0)
+            hidden = jax.lax.dynamic_update_index_in_dim(
+                hidden, jnp.where(valid, x, hidden[slot]), slot, 0)
+            return x, hidden
+
+        x, hidden = jax.lax.fori_loop(0, M + P_ - 1, tick, (x, hidden))
+        # Only the last stage holds real activations; replicate, then apply
+        # the head once over all microbatches.
+        hidden = jax.lax.psum(
+            jnp.where(stage == P_ - 1, hidden, jnp.zeros_like(hidden)), "pp")
+        h = rms_norm(hidden.reshape(B, S, D), params["final_norm"], cfg.norm_eps)
+        return (h @ params["lm_head"]).astype(jnp.float32)
+
+    fwd = shard_map(
+        pp_forward, mesh=mesh,
+        in_specs=({"embed": P(),
+                   "layers": jax.tree.map(lambda _: P("pp"),
+                                          _layer_tree_template(cfg)),
+                   "final_norm": P(),
+                   "lm_head": P()}, P()),
+        out_specs=P())
+    return jax.jit(fwd)
+
+
+def _layer_tree_template(cfg: ModelConfig):
+    keys = ["wq", "wk", "wv", "wo", "w1", "w2", "w3", "ln_attn", "ln_mlp"]
+    if cfg.n_experts:
+        keys.append("router")
+    return {k: 0 for k in keys}
+
+
+def dryrun_pipeline(cfg: ModelConfig, devices, pp: int = 2,
+                    n_microbatches: int = 2, atol: float = 2e-3) -> None:
+    """Asserts the pipelined forward matches the single-device forward."""
+    mesh = make_pp_mesh(devices, pp=pp)
+    params = llama.init_params(cfg, jax.random.key(0), dtype=jnp.float32)
+    B, S = 2 * n_microbatches, 16
+    tokens = np.random.default_rng(0).integers(
+        1, cfg.vocab_size, (B, S)).astype(np.int32)
+
+    ref_logits, _ = llama.forward(params, cfg, jnp.asarray(tokens))
+
+    pp_params = shard_params_pp(params, cfg, mesh)
+    fwd = make_pp_forward(cfg, mesh, n_microbatches)
+    with jax.set_mesh(mesh):
+        got = fwd(pp_params, jnp.asarray(tokens))
+    if not np.allclose(np.asarray(got), np.asarray(ref_logits),
+                       atol=atol, rtol=atol):
+        diff = float(np.max(np.abs(np.asarray(got) - np.asarray(ref_logits))))
+        raise AssertionError(f"pipeline logits diverge: max|Δ|={diff}")

@@ -123,3 +123,26 @@ def test_validate_ep_constraints():
     with pytest.raises(ValueError):
         validate_tp(TINY_MOE, 1, ep=3)   # 4 experts % 3 != 0
     validate_tp(TINY_MOE, 2, ep=2)       # ok
+
+
+def test_pipeline_forward_matches_single_device():
+    from llm_d_inference_scheduler_tpu.parallel.pipeline import dryrun_pipeline
+
+    dryrun_pipeline(TINY, jax.devices()[:2], pp=2, n_microbatches=4)
+
+
+def test_pipeline_moe_and_bad_layer_split():
+    from llm_d_inference_scheduler_tpu.models import llama
+    from llm_d_inference_scheduler_tpu.models.configs import TINY_MOE
+    from llm_d_inference_scheduler_tpu.parallel.pipeline import (
+        dryrun_pipeline,
+        make_pp_mesh,
+        shard_params_pp,
+    )
+
+    dryrun_pipeline(TINY_MOE, jax.devices()[:2], pp=2, n_microbatches=2)
+    # TINY has 2 layers: a 4-stage pipeline cannot split them evenly.
+    mesh4 = make_pp_mesh(jax.devices()[:4], pp=4)
+    params = llama.init_params(TINY, jax.random.key(0))
+    with pytest.raises(ValueError):
+        shard_params_pp(params, TINY, mesh4)
