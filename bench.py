@@ -43,13 +43,18 @@ def child(model: str) -> None:
     from llm_d_inference_scheduler_tpu.engine import EngineConfig, EngineRequest
     from llm_d_inference_scheduler_tpu.engine.core import TpuEngine
 
-    max_batch = int(os.environ.get("BENCH_BATCH", "8"))
+    max_batch = int(os.environ.get("BENCH_BATCH", "16"))
     prompt_len = int(os.environ.get("BENCH_PROMPT", "120"))
     gen_tokens = int(os.environ.get("BENCH_GEN", "64"))
-    n_requests = int(os.environ.get("BENCH_REQUESTS", "16"))
+    n_requests = int(os.environ.get("BENCH_REQUESTS", "32"))
+    decode_chunk = int(os.environ.get("BENCH_CHUNK", "16"))
 
+    # warmup=True compiles every decode bucket + the smallest prefill bucket
+    # before serving, so the measured window holds no lazy compiles (the
+    # warmup request below covers the measured prefill bucket).
     cfg = EngineConfig(model=model, backend="tpu", max_batch=max_batch,
-                       max_model_len=512)
+                       max_model_len=512, decode_chunk=decode_chunk,
+                       warmup=True)
 
     async def run():
         eng = TpuEngine(cfg)

@@ -29,8 +29,23 @@ class EngineConfig:
     checkpoint_path: str = ""     # orbax dir; empty = random init (dev/bench)
     enable_prefix_caching: bool = True  # automatic prefix caching (block reuse)
     warmup: bool = False          # compile prefill/decode/sample before serving
-    pallas_attention: bool = False  # Pallas paged-attention decode kernel (TPU)
+    # Decode steps fused into one device dispatch (lax.scan over the decode
+    # step + sampler on device). Amortizes per-dispatch latency — decisive
+    # when the chip sits behind a network tunnel — at the cost of bursty
+    # token streaming and up-to-(chunk-1) wasted steps for sequences that
+    # hit a stop condition mid-chunk. TTFT is unaffected (prefill emits the
+    # first token). 1 = classic per-step decode.
+    decode_chunk: int = 8
+    # Pallas paged-attention decode kernel. None = auto: enabled on a real
+    # TPU backend for unsharded engines whose head_dim is lane-aligned
+    # (head_dim % 128 == 0 — Mosaic DMA slice constraint); measured 1.76×
+    # faster than the XLA gather path at llama3-8b shapes on v5e.
+    pallas_attention: bool | None = None
     pallas_interpret: bool = False  # interpret the kernel (CPU testing only)
+    # Pallas grouped-matmul MoE FFN (ops/pallas_moe.py) for n_experts>0
+    # models; single-device only (the ep-sharded path stays dense inside its
+    # shard_map). Interpreted when pallas_interpret is set.
+    pallas_moe: bool = False
     # Tensor parallelism: shard params (Megatron TP) + KV pages (kv-head axis)
     # over a tp-sized mesh axis; remaining devices form the dp axis. 1 = the
     # single-device layout (no mesh). BASELINE.md config 4 path.

@@ -2,11 +2,20 @@
 
 ByteTokenizer is the default for tests/sim/bench: ids are raw UTF-8 bytes
 offset past the specials, so it round-trips any text, needs no vocab files,
-and incremental decode is prefix-safe. A HuggingFace tokenizer can be swapped
-in behind the same interface when real checkpoints are served.
+and incremental decode is prefix-safe. HFTokenizer serves real checkpoints:
+it loads a HuggingFace fast-tokenizer directory (tokenizer.json BPE vocab +
+specials) behind the same encode/decode interface — select it with
+``tokenizer: hf:/path/to/dir`` in the engine config.
+
+The reference delegates tokenization to the vLLM render endpoints
+(/root/reference pkg/epp/framework/plugins/requestcontrol/dataproducer/tokenizer);
+here the engine half owns the vocab and the router's token-producer calls our
+/render endpoints the same way.
 """
 
 from __future__ import annotations
+
+import os
 
 
 class ByteTokenizer:
@@ -33,7 +42,50 @@ class ByteTokenizer:
         return data.decode("utf-8", errors="replace")
 
 
+class HFTokenizer:
+    """HuggingFace fast-tokenizer adapter (byte-level BPE et al.).
+
+    Loads from a local directory (tokenizer.json + tokenizer_config.json) —
+    no network. Per-token ``decode([id])`` streams byte-level pieces; a token
+    that ends mid-UTF-8-sequence decodes with replacement chars, full-sequence
+    decode round-trips exactly.
+    """
+
+    def __init__(self, path: str):
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
+        self.vocab_size = len(self._tok)
+        self.bos_id = self._tok.bos_token_id
+        self.eos_id = self._tok.eos_token_id
+        if self.eos_id is None:
+            raise ValueError(f"tokenizer at {path} defines no EOS token")
+        self.pad_id = self._tok.pad_token_id
+        if self.pad_id is None:
+            self.pad_id = self.eos_id
+
+    def encode(self, text: str, add_bos: bool = True) -> list[int]:
+        ids = self._tok.encode(text, add_special_tokens=False)
+        if add_bos and self.bos_id is not None:
+            return [self.bos_id] + ids
+        return ids
+
+    def decode(self, ids: list[int]) -> str:
+        return self._tok.decode(ids, skip_special_tokens=True)
+
+
 def get_tokenizer(name: str, vocab_size: int):
     if name == "byte":
         return ByteTokenizer(vocab_size)
+    if name.startswith("hf:"):
+        name = name[3:]
+    if os.path.isdir(name) or name.endswith("tokenizer.json"):
+        if name.endswith("tokenizer.json"):
+            name = os.path.dirname(name) or "."
+        tok = HFTokenizer(name)
+        if tok.vocab_size > vocab_size:
+            raise ValueError(
+                f"tokenizer vocab ({tok.vocab_size}) exceeds model vocab "
+                f"({vocab_size})")
+        return tok
     raise ValueError(f"unknown tokenizer {name!r}")

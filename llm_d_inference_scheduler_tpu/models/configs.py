@@ -28,6 +28,11 @@ class ModelConfig:
     # Mixture-of-experts (Mixtral-family): n_experts == 0 means dense FFN.
     n_experts: int = 0
     experts_per_token: int = 2
+    # MoE FFN implementation: "dense" (dense-over-experts einsums — the
+    # correctness baseline, required under expert-parallel shard_map) |
+    # "grouped" (Pallas grouped-matmul, ops/pallas_moe.py) |
+    # "grouped_interpret" (same kernel, interpreter — CPU tests).
+    moe_impl: str = "dense"
 
     @property
     def head_dim(self) -> int:
@@ -83,6 +88,18 @@ LLAMA3_1B = ModelConfig(
     d_ff=8192,
 )
 
+# Public Llama-3.2-3B architecture card: head_dim 128 (lane-aligned → the
+# Pallas paged-attention kernel applies), ~6.4 GB bf16 — fits one v5e chip.
+LLAMA3_3B = ModelConfig(
+    name="llama3-3b",
+    vocab_size=128_256,
+    d_model=3072,
+    n_layers=28,
+    n_heads=24,
+    n_kv_heads=8,
+    d_ff=8192,
+)
+
 # Mixtral-family MoE (public 8x7B architecture card).
 MIXTRAL_8X7B = ModelConfig(
     name="mixtral-8x7b",
@@ -112,12 +129,25 @@ TINY_MOE = ModelConfig(
     experts_per_token=2,
 )
 
-_REGISTRY = {c.name: c for c in (LLAMA3_8B, LLAMA3_70B, LLAMA3_1B, TINY,
-                                 MIXTRAL_8X7B, TINY_MOE)}
+_REGISTRY = {c.name: c for c in (LLAMA3_8B, LLAMA3_70B, LLAMA3_1B, LLAMA3_3B,
+                                 TINY, MIXTRAL_8X7B, TINY_MOE)}
 
 
 def get_config(name: str) -> ModelConfig:
     try:
         return _REGISTRY[name]
     except KeyError:
-        raise ValueError(f"unknown model config {name!r}; have {sorted(_REGISTRY)}") from None
+        pass
+    # A converted-checkpoint directory (models/convert_hf.py writes
+    # model_config.json next to the Orbax weights) is a valid model name:
+    # serve real HF checkpoints without registering them here.
+    import json
+    import os
+
+    cand = os.path.join(name, "model_config.json")
+    if os.path.isfile(cand):
+        with open(cand) as f:
+            fields = json.load(f)
+        known = set(ModelConfig.__dataclass_fields__)
+        return ModelConfig(**{k: v for k, v in fields.items() if k in known})
+    raise ValueError(f"unknown model config {name!r}; have {sorted(_REGISTRY)}")

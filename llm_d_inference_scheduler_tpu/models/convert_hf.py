@@ -1,0 +1,186 @@
+"""HuggingFace checkpoint → stacked-layer JAX params conversion.
+
+The reference router serves whatever weights its external vLLM pods loaded;
+our engine half owns weight loading, so real checkpoints (Llama/Mixtral
+families in HF layout) need a mapping onto :mod:`.llama`'s stacked pytree:
+
+- HF ``nn.Linear.weight`` is ``[out, in]`` applied as ``x @ W.T``; our params
+  are ``[in, out]`` applied as ``x @ W`` — every projection transposes.
+- Per-layer weights stack on a leading L axis (``lax.scan`` layout).
+- HF Llama checkpoints already use the rotate-half RoPE layout (the
+  interleaved→half permutation happened at Meta→HF conversion), which is
+  exactly :func:`..ops.rope.apply_rope`'s convention — weights copy straight
+  through, verified by the logits-parity test (tests/test_hf_convert.py).
+- Mixtral's ``block_sparse_moe`` maps to the experts axis: HF per-expert
+  w1/w3 (gate/up) and w2 (down) stack to ``[L, E, D, F]`` / ``[L, E, F, D]``;
+  the router gate maps to ``[L, D, E]``.
+
+Use :func:`convert_state_dict` in-process (tests) or the CLI
+(``python -m llm_d_inference_scheduler_tpu.models.convert_hf``) to write an
+Orbax checkpoint the engine restores via ``--checkpoint-path``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .configs import ModelConfig
+
+__all__ = ["config_from_hf", "convert_state_dict", "main"]
+
+
+def config_from_hf(hf_config, name: str = "converted") -> ModelConfig:
+    """Map a transformers LlamaConfig/MixtralConfig to our ModelConfig."""
+    n_experts = getattr(hf_config, "num_local_experts", 0) or 0
+    return ModelConfig(
+        name=name,
+        vocab_size=hf_config.vocab_size,
+        d_model=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=getattr(hf_config, "num_key_value_heads",
+                           hf_config.num_attention_heads),
+        d_ff=hf_config.intermediate_size,
+        rope_theta=getattr(hf_config, "rope_theta", 10_000.0),
+        max_seq_len=getattr(hf_config, "max_position_embeddings", 8192),
+        norm_eps=hf_config.rms_norm_eps,
+        n_experts=n_experts,
+        experts_per_token=getattr(hf_config, "num_experts_per_tok", 2),
+    )
+
+
+def _t(w) -> np.ndarray:
+    """torch/np tensor → float32 numpy, linear-layout transposed to [in, out]."""
+    if hasattr(w, "detach"):
+        w = w.detach().to("cpu").float().numpy()
+    return np.asarray(w, dtype=np.float32).T
+
+
+def _vec(w) -> np.ndarray:
+    if hasattr(w, "detach"):
+        w = w.detach().to("cpu").float().numpy()
+    return np.asarray(w, dtype=np.float32)
+
+
+def convert_state_dict(state_dict: dict, cfg: ModelConfig,
+                       dtype: str | None = None):
+    """HF Llama/Mixtral state dict → stacked params pytree (jnp arrays)."""
+    import jax.numpy as jnp
+
+    out_dtype = jnp.dtype(dtype or cfg.dtype)
+    L, E = cfg.n_layers, cfg.n_experts
+
+    def get(key):
+        if key not in state_dict:
+            raise KeyError(f"checkpoint missing {key!r}")
+        return state_dict[key]
+
+    def stack(fn):
+        return np.stack([fn(i) for i in range(L)])
+
+    p = f"model.layers.{{i}}."
+    layers = {
+        "wq": stack(lambda i: _t(get(p.format(i=i) + "self_attn.q_proj.weight"))),
+        "wk": stack(lambda i: _t(get(p.format(i=i) + "self_attn.k_proj.weight"))),
+        "wv": stack(lambda i: _t(get(p.format(i=i) + "self_attn.v_proj.weight"))),
+        "wo": stack(lambda i: _t(get(p.format(i=i) + "self_attn.o_proj.weight"))),
+        "ln_attn": stack(lambda i: _vec(get(p.format(i=i) + "input_layernorm.weight"))),
+        "ln_mlp": stack(lambda i: _vec(get(p.format(i=i) + "post_attention_layernorm.weight"))),
+    }
+    if E:
+        moe = "block_sparse_moe."
+        layers["router"] = stack(
+            lambda i: _t(get(p.format(i=i) + moe + "gate.weight")))
+        layers["w1"] = stack(lambda i: np.stack(
+            [_t(get(p.format(i=i) + moe + f"experts.{e}.w1.weight")) for e in range(E)]))
+        layers["w3"] = stack(lambda i: np.stack(
+            [_t(get(p.format(i=i) + moe + f"experts.{e}.w3.weight")) for e in range(E)]))
+        layers["w2"] = stack(lambda i: np.stack(
+            [_t(get(p.format(i=i) + moe + f"experts.{e}.w2.weight")) for e in range(E)]))
+    else:
+        layers["w1"] = stack(lambda i: _t(get(p.format(i=i) + "mlp.gate_proj.weight")))
+        layers["w3"] = stack(lambda i: _t(get(p.format(i=i) + "mlp.up_proj.weight")))
+        layers["w2"] = stack(lambda i: _t(get(p.format(i=i) + "mlp.down_proj.weight")))
+
+    embed = _vec(get("model.embed_tokens.weight"))
+    if "lm_head.weight" in state_dict:
+        lm_head = _t(state_dict["lm_head.weight"])
+    else:  # tied embeddings
+        lm_head = embed.T
+
+    params = {
+        "embed": embed,
+        "layers": layers,
+        "final_norm": _vec(get("model.norm.weight")),
+        "lm_head": lm_head,
+    }
+    return _cast(params, out_dtype)
+
+
+def _cast(tree, dtype):
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree.map(lambda a: jnp.asarray(a, dtype=dtype), tree)
+
+
+def load_hf_state_dict(src: str) -> dict:
+    """Load an HF checkpoint directory's tensors (safetensors or torch bins)."""
+    import glob
+    import os
+
+    st_files = sorted(glob.glob(os.path.join(src, "*.safetensors")))
+    if st_files:
+        from safetensors import safe_open
+
+        sd = {}
+        for f in st_files:
+            with safe_open(f, framework="np") as fh:
+                for k in fh.keys():
+                    sd[k] = fh.get_tensor(k)
+        return sd
+    import torch
+
+    bins = sorted(glob.glob(os.path.join(src, "pytorch_model*.bin")))
+    if not bins:
+        raise FileNotFoundError(f"no safetensors or torch bins under {src}")
+    sd = {}
+    for f in bins:
+        sd.update(torch.load(f, map_location="cpu", weights_only=True))
+    return sd
+
+
+def main(argv: list[str] | None = None) -> None:
+    import argparse
+    import json
+    import os
+
+    ap = argparse.ArgumentParser(
+        description="Convert an HF Llama/Mixtral checkpoint to an Orbax "
+                    "checkpoint in the engine's stacked layout.")
+    ap.add_argument("src", help="HF checkpoint dir (config.json + weights)")
+    ap.add_argument("out", help="output Orbax checkpoint dir")
+    ap.add_argument("--dtype", default=None, help="override param dtype")
+    args = ap.parse_args(argv)
+
+    from transformers import AutoConfig
+
+    from ..engine.checkpoint import save_params
+
+    import dataclasses
+
+    hf_cfg = AutoConfig.from_pretrained(args.src, local_files_only=True)
+    cfg = config_from_hf(hf_cfg)
+    if args.dtype:
+        cfg = dataclasses.replace(cfg, dtype=args.dtype)
+    params = convert_state_dict(load_hf_state_dict(args.src), cfg)
+    save_params(args.out, params)
+    with open(os.path.join(args.out, "model_config.json"), "w") as f:
+        json.dump({k: getattr(cfg, k) for k in cfg.__dataclass_fields__}, f,
+                  indent=2)
+    print(f"wrote {args.out} ({cfg.n_layers}L d{cfg.d_model} "
+          f"{'moe' if cfg.n_experts else 'dense'})")
+
+
+if __name__ == "__main__":
+    main()

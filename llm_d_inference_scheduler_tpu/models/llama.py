@@ -98,7 +98,13 @@ def _ffn(cfg: ModelConfig, lp: Params, h: jnp.ndarray) -> jnp.ndarray:
         squeeze = h.ndim == 2  # decode step: [B, D]
         if squeeze:
             h = h[:, None]
-        y = _moe_ffn(cfg, lp, h)
+        if cfg.moe_impl.startswith("grouped"):
+            from ..ops.pallas_moe import moe_ffn_grouped
+
+            y = moe_ffn_grouped(lp, h, cfg.n_experts, cfg.experts_per_token,
+                                interpret=cfg.moe_impl == "grouped_interpret")
+        else:
+            y = _moe_ffn(cfg, lp, h)
         return y[:, 0] if squeeze else y
     return (jax.nn.silu(h @ lp["w1"]) * (h @ lp["w3"])) @ lp["w2"]
 
@@ -231,8 +237,8 @@ def decode_step(
     x, (k_cur, v_cur) = jax.lax.scan(body, x, (params["layers"], k_pages, v_pages))
     # One fused scatter of all layers' current-token KV: [L, B, Hkv, Dh] into
     # pages at (layer, blk_idx[b], slot[b]).
-    k_pages = k_pages.at[:, blk_idx, slot].set(k_cur)
-    v_pages = v_pages.at[:, blk_idx, slot].set(v_cur)
+    k_pages = k_pages.at[:, blk_idx, slot].set(k_cur.astype(k_pages.dtype))
+    v_pages = v_pages.at[:, blk_idx, slot].set(v_cur.astype(v_pages.dtype))
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = (x @ params["lm_head"]).astype(jnp.float32)
@@ -307,8 +313,8 @@ def prefill_with_prefix(
     blk_for_t = jnp.where(valid, block_table_row[0, tgt // block], 0)
     slot_for_t = jnp.where(valid, tgt % block, 0)
     L = cfg.n_layers
-    k_flat = k_new.reshape(L, S, cfg.n_kv_heads, Dh)
-    v_flat = v_new.reshape(L, S, cfg.n_kv_heads, Dh)
+    k_flat = k_new.reshape(L, S, cfg.n_kv_heads, Dh).astype(k_pages.dtype)
+    v_flat = v_new.reshape(L, S, cfg.n_kv_heads, Dh).astype(v_pages.dtype)
     k_pages = k_pages.at[:, blk_for_t, slot_for_t].set(k_flat)
     v_pages = v_pages.at[:, blk_for_t, slot_for_t].set(v_flat)
 
@@ -342,8 +348,8 @@ def write_prefill_kv(
 
     bidx = blk_for_t.reshape(-1)   # [B*S]
     sidx = slot_for_t.reshape(-1)
-    k_flat = k_new.reshape(L, B * S, Hkv, Dh)
-    v_flat = v_new.reshape(L, B * S, Hkv, Dh)
+    k_flat = k_new.reshape(L, B * S, Hkv, Dh).astype(k_pages.dtype)
+    v_flat = v_new.reshape(L, B * S, Hkv, Dh).astype(v_pages.dtype)
     k_pages = k_pages.at[:, bidx, sidx].set(k_flat)
     v_pages = v_pages.at[:, bidx, sidx].set(v_flat)
     return k_pages, v_pages

@@ -65,7 +65,10 @@ def _kernel(bt_ref, sl_ref,            # scalar prefetch: [B*maxB], [B]
             pos = j * block + jax.lax.broadcasted_iota(
                 jnp.int32, (1, block), 1)               # [1, bs]
             valid = pos < cached_len                    # [1, bs]
-            for g in range(n_kv):                       # static unroll
+            # Static unroll over KV-head groups, rebuilt with stacks (no
+            # .at[].set — Mosaic has no scatter lowering).
+            ms, ls, accs = [], [], []
+            for g in range(n_kv):
                 logits = jnp.dot(q[g], k[:, g, :].T,
                                  preferred_element_type=jnp.float32)  # [qpk, bs]
                 logits = jnp.where(valid, logits, NEG_INF)
@@ -73,12 +76,11 @@ def _kernel(bt_ref, sl_ref,            # scalar prefetch: [B*maxB], [B]
                 new_m = jnp.maximum(m[g], blk_max)
                 p = jnp.exp(logits - new_m) * valid     # re-mask fully-masked rows
                 corr = jnp.exp(m[g] - new_m)
-                l = l.at[g].set(l[g] * corr + jnp.sum(p, axis=-1, keepdims=True))
-                acc = acc.at[g].set(
-                    acc[g] * corr + jnp.dot(p, v[:, g, :],
-                                            preferred_element_type=jnp.float32))
-                m = m.at[g].set(new_m)
-            return m, l, acc
+                ls.append(l[g] * corr + jnp.sum(p, axis=-1, keepdims=True))
+                accs.append(acc[g] * corr + jnp.dot(
+                    p, v[:, g, :], preferred_element_type=jnp.float32))
+                ms.append(new_m)
+            return jnp.stack(ms), jnp.stack(ls), jnp.stack(accs)
 
         return jax.lax.cond(j * block < cached_len,
                             lambda: compute(m, l, acc),
@@ -89,14 +91,17 @@ def _kernel(bt_ref, sl_ref,            # scalar prefetch: [B*maxB], [B]
     # Current token's KV: always-visible extra column.
     cur_k = cur_k_ref[0].astype(jnp.float32)          # [G, D]
     cur_v = cur_v_ref[0].astype(jnp.float32)
+    ls, accs = [], []
     for g in range(n_kv):
         logits = jnp.dot(q[g], cur_k[g][:, None],
                          preferred_element_type=jnp.float32)  # [qpk, 1]
         new_m = jnp.maximum(m[g], logits)
         p = jnp.exp(logits - new_m)
         corr = jnp.exp(m[g] - new_m)
-        l = l.at[g].set(l[g] * corr + p)
-        acc = acc.at[g].set(acc[g] * corr + p * cur_v[g][None, :])
+        ls.append(l[g] * corr + p)
+        accs.append(acc[g] * corr + p * cur_v[g][None, :])
+    l = jnp.stack(ls)
+    acc = jnp.stack(accs)
 
     out = acc / l                                      # [G, qpk, D]
     out_ref[0] = out.reshape(H, head_dim).astype(out_ref.dtype)
