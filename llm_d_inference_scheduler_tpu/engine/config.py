@@ -53,6 +53,16 @@ class EngineConfig:
     # Expert parallelism (MoE models): shard the experts axis over ep_size
     # devices (composes with tp_size; total devices = tp_size * ep_size).
     ep_size: int = 1
+    # Multi-host serving (engine/multihost.py): when dist_coordinator is set
+    # ("host:port" of the jax.distributed coordinator), all dist_num_processes
+    # engine processes form ONE global mesh (tp_size*ep_size must equal the
+    # global device count / dp replicas). Process 0 serves; others replay
+    # device ops from the leader's instruction channel on dist_instr_port.
+    dist_coordinator: str = ""
+    dist_num_processes: int = 1
+    dist_process_id: int = 0
+    dist_instr_port: int = 8790
+    dist_instr_host: str = ""     # leader bind / follower dial; default host
     # KV cache event stream (ZMQ PUB) feeding the router's precise prefix
     # scorer; 0 disables, -1 = port + 1000.
     kv_events_port: int = -1

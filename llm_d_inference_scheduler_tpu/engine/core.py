@@ -128,8 +128,9 @@ class TpuEngine:
             if cfg.tp_size > 1 or cfg.ep_size > 1:
                 raise ValueError("pallas_moe requires tp_size=ep_size=1 "
                                  "(the sharded path stays dense)")
-            if not any(self.mcfg.d_ff % t == 0
-                       for t in range(128, min(512, self.mcfg.d_ff) + 1, 128)):
+            from ..ops.pallas_moe import pick_tile_divisor
+
+            if pick_tile_divisor(self.mcfg.d_ff) is None:
                 raise ValueError(
                     f"pallas_moe: d_ff={self.mcfg.d_ff} has no 128-aligned "
                     "tile divisor; use the dense path")
@@ -149,17 +150,32 @@ class TpuEngine:
 
         # Optional TP-sharded serving: params follow Megatron TP pspecs, KV
         # pages shard the kv-head axis (parallel/serve.py). tp_size=1 keeps
-        # the plain single-device layout. The mesh spans exactly tp_size
-        # devices (dp=1): the engine does not dp-shard its batch, so claiming
-        # more devices would only replicate the compute.
+        # the plain single-device layout. Single-process meshes span exactly
+        # tp*ep devices (dp=1); multi-host (dist_*) meshes span ALL global
+        # devices — the dp axis holds the remainder as replicas (host inputs
+        # are fed fully-replicated, see _put).
+        self._dist = bool(cfg.dist_coordinator) and cfg.dist_num_processes > 1
+        self._instr_channel = None
+        if self._dist:
+            # jax.distributed.initialize must already have run (server main /
+            # multihost.maybe_init_distributed) — jax.devices() is global here.
+            from .multihost import InstructionChannel
+
+            self._instr_channel = InstructionChannel(
+                leader=cfg.dist_process_id == 0,
+                host=cfg.dist_instr_host or cfg.host,
+                port=cfg.dist_instr_port,
+                n_followers=cfg.dist_num_processes - 1)
         self.mesh = None
-        if cfg.tp_size > 1 or cfg.ep_size > 1:
+        if cfg.tp_size > 1 or cfg.ep_size > 1 or self._dist:
             from ..parallel.serve import make_serve_mesh, validate_tp
 
             validate_tp(self.mcfg, cfg.tp_size, cfg.ep_size)
             n_model = cfg.tp_size * cfg.ep_size
-            self.mesh = make_serve_mesh(jax.devices()[:n_model],
-                                        tp=cfg.tp_size, ep=cfg.ep_size)
+            devices = jax.devices() if self._dist \
+                else jax.devices()[:n_model]
+            self.mesh = make_serve_mesh(devices, tp=cfg.tp_size,
+                                        ep=cfg.ep_size)
 
         if params is not None or cfg.checkpoint_path:
             if params is None:
@@ -343,6 +359,12 @@ class TpuEngine:
             self._cond.notify()
         if self._thread:
             self._thread.join(timeout=10)
+        if self._instr_channel is not None and self._instr_channel.leader:
+            try:
+                self._instr_channel.broadcast(("stop",), {})
+            except Exception:
+                log.exception("failed to release followers")
+            self._instr_channel.close()
         if self.kv_events is not None:
             self.kv_events.close()
 
@@ -439,13 +461,11 @@ class TpuEngine:
         t0 = time.monotonic()
         B = self.cfg.max_batch
         bucket = self._bucket(16)  # respects max_model_len < 16
-        row = jnp.zeros((1, self.max_blocks_per_seq), jnp.int32)
-        saved_key = self._sample_key  # keep seeded outputs flag-independent
-        fn = self._prefill_fn(bucket)
-        _, self.k_pages, self.v_pages = fn(
-            self.params, jnp.zeros((1, bucket), jnp.int32),
-            jnp.asarray([1], jnp.int32), self.k_pages, self.v_pages, row,
-            *self._sample_args([_DUMMY_REQ]))
+        self._device_call(("prefill", bucket), dict(
+            tokens=np.zeros((1, bucket), np.int32),
+            seq_len=np.asarray([1], np.int32),
+            row=np.zeros((1, self.max_blocks_per_seq), np.int32),
+            warm=True, **self._sample_np([_DUMMY_REQ])))
         # Compile EVERY decode bucket _batch_bucket can produce (1, 2, 4, …,
         # max_batch): a gate-able warm-up must leave no lazy compile to stall
         # the engine thread mid-serving.
@@ -456,12 +476,11 @@ class TpuEngine:
             b *= 2
         buckets.append(B)
         for nb in buckets:
-            _, self.k_pages, self.v_pages = self._jit_decode_chunk(
-                self.params, jnp.zeros((nb,), jnp.int32),
-                jnp.zeros((nb,), jnp.int32), self.k_pages, self.v_pages,
-                jnp.zeros((nb, self.max_blocks_per_seq), jnp.int32),
-                *self._sample_args([_DUMMY_REQ] * nb))
-        self._sample_key = saved_key
+            self._device_call(("decode",), dict(
+                tokens=np.zeros((nb,), np.int32),
+                positions=np.zeros((nb,), np.int32),
+                tables=np.zeros((nb, self.max_blocks_per_seq), np.int32),
+                warm=True, **self._sample_np([_DUMMY_REQ] * nb)))
         log.info("engine warm-up compiled prefill/decode/sample in %.1fs",
                  time.monotonic() - t0)
 
@@ -645,6 +664,19 @@ class TpuEngine:
     # ---- prefill -------------------------------------------------------
 
     def _prefill_into_slot(self, idx, req, out, loop, need: int):
+        if self._dist and (req.kv_transfer_params or {}).get("do_remote_decode"):
+            # P/D KV staging gathers pages OUTSIDE the replayed op stream
+            # (_finish_slot retain_for_transfer) — on a multi-host mesh that
+            # leader-only collective would deadlock the slice. Reject loudly;
+            # multi-host engines serve monolithic or decode-side roles.
+            log.warning("rejecting do_remote_decode request %s: P/D KV "
+                        "staging is not supported in multi-host mode",
+                        req.request_id)
+            self._emit_to(out, loop, TokenEvent(
+                request_id=req.request_id, token_id=None,
+                finish_reason=FinishReason.ABORT,
+                prompt_tokens=len(req.prompt_token_ids)))
+            return
         prompt = req.prompt_token_ids[: self.cfg.max_model_len - 1]
         block = self.mcfg.kv_block_size
         caching_enabled = isinstance(self.allocator, PrefixCachingAllocator)
@@ -786,15 +818,10 @@ class TpuEngine:
                 positions.append(len(positions))
             pos_pad = np.full((1, mm_bucket), bucket, np.int32)
             pos_pad[0, : mm.shape[0]] = positions[: mm.shape[0]]
-            fn = self._mm_prefill_fn(bucket, mm_bucket)
-            tok, self.k_pages, self.v_pages = fn(
-                self.params, jnp.asarray(tokens),
-                jnp.asarray([len(prompt)], jnp.int32),
-                jnp.asarray(mm_pad), jnp.asarray(pos_pad),
-                self.k_pages, self.v_pages, jnp.asarray(row),
-                *self._sample_args([req]))
-            tok.copy_to_host_async()
-            return tok
+            return self._device_call(("mm_prefill", bucket, mm_bucket), dict(
+                tokens=tokens, seq_len=np.asarray([len(prompt)], np.int32),
+                mm_pad=mm_pad, pos_pad=pos_pad, row=row,
+                **self._sample_np([req])))
         if matched_bids:
             bucket = self._bucket(len(suffix))
             prefix_bucket = 1
@@ -805,25 +832,20 @@ class TpuEngine:
             prior[0, : len(matched_bids)] = matched_bids
             tokens = np.zeros((1, bucket), np.int32)
             tokens[0, : len(suffix)] = suffix
-            fn = self._prefix_prefill_fn(bucket, prefix_bucket)
-            tok, self.k_pages, self.v_pages = fn(
-                self.params, jnp.asarray(tokens),
-                jnp.asarray([len(suffix)], jnp.int32),
-                jnp.asarray([cached_tokens], jnp.int32),
-                self.k_pages, self.v_pages, jnp.asarray(row),
-                jnp.asarray(prior), *self._sample_args([req]))
+            tok = self._device_call(("prefix_prefill", bucket, prefix_bucket),
+                                    dict(tokens=tokens,
+                                         suffix_len=np.asarray([len(suffix)], np.int32),
+                                         prefix_len=np.asarray([cached_tokens], np.int32),
+                                         row=row, prior=prior,
+                                         **self._sample_np([req])))
             self.telemetry.prefix_cached_tokens.inc(cached_tokens)
         else:
             bucket = self._bucket(len(prompt))
             tokens = np.zeros((1, bucket), np.int32)
             tokens[0, : len(prompt)] = prompt
-            fn = self._prefill_fn(bucket)
-            tok, self.k_pages, self.v_pages = fn(
-                self.params, jnp.asarray(tokens),
-                jnp.asarray([len(prompt)], jnp.int32),
-                self.k_pages, self.v_pages, jnp.asarray(row),
-                *self._sample_args([req]))
-        tok.copy_to_host_async()
+            tok = self._device_call(("prefill", bucket), dict(
+                tokens=tokens, seq_len=np.asarray([len(prompt)], np.int32),
+                row=row, **self._sample_np([req])))
         return tok
 
     # ---- P/D import (decode side) --------------------------------------
@@ -1013,9 +1035,8 @@ class TpuEngine:
             k_pad[:, :nb], v_pad[:, :nb] = k_np, v_np
             blocks_pad = np.zeros((maxB,), np.int32)  # padding lands in trash block 0
             blocks_pad[:real_nb] = blocks[:real_nb]
-            self.k_pages, self.v_pages = self._jit_import(
-                self.k_pages, self.v_pages, jnp.asarray(blocks_pad),
-                jnp.asarray(k_pad), jnp.asarray(v_pad))
+            self._device_call(("import",), dict(
+                blocks_pad=blocks_pad, k_pad=k_pad, v_pad=v_pad))
 
         first = int(ktp.get("remote_first_token")
                     if ktp.get("remote_first_token") is not None
@@ -1047,14 +1068,118 @@ class TpuEngine:
 
     # ---- decode --------------------------------------------------------
 
-    def _sample_args(self, reqs):
-        """(fresh subkey, temps, top_k, top_p) for a batch of requests —
-        the argument tail shared by the fused prefill/decode-chunk jits."""
+    def _sample_np(self, reqs) -> dict[str, np.ndarray]:
+        """Host-side sampling knobs for a batch of requests (shipped to
+        followers verbatim; the PRNG key is NOT shipped — every process
+        derives it from the same seeded stream inside the op)."""
+        return {
+            "temps": np.array([r.temperature for r in reqs], np.float32),
+            "top_k": np.array([r.top_k for r in reqs], np.int32),
+            "top_p": np.array([r.top_p for r in reqs], np.float32),
+        }
+
+    def _next_key(self, warm: bool):
+        """Next sampling subkey. warm=True uses a fixed throwaway key so
+        warm-up compiles consume nothing from the seeded stream (keeps
+        outputs warmup-flag-independent AND leader/follower streams in
+        lockstep without a restore op)."""
+        if warm:
+            return self._put_key(jax.random.key(0xC0FFEE))
         self._sample_key, sub = jax.random.split(self._sample_key)
-        temps = np.array([r.temperature for r in reqs], np.float32)
-        top_k = np.array([r.top_k for r in reqs], np.int32)
-        top_p = np.array([r.top_p for r in reqs], np.float32)
-        return sub, jnp.asarray(temps), jnp.asarray(top_k), jnp.asarray(top_p)
+        return self._put_key(sub)
+
+    def _put(self, x):
+        """Host input → device. Multi-host: fully-replicated global array on
+        the mesh (every process feeds identical bytes — device_put can't
+        target non-addressable devices, so this goes through
+        make_array_from_process_local_data); otherwise a plain local
+        transfer."""
+        if self._dist:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            return jax.make_array_from_process_local_data(
+                NamedSharding(self.mesh, PartitionSpec()), np.asarray(x))
+        return jnp.asarray(x)
+
+    def _put_key(self, key):
+        """Typed PRNG keys can't round-trip through numpy: globalize the raw
+        key data and re-wrap."""
+        if self._dist:
+            kd = self._put(np.asarray(jax.random.key_data(key)))
+            return jax.random.wrap_key_data(kd)
+        return key
+
+    # ---- device ops (multihost-replayable) -----------------------------
+    # Every device call the engine loop makes goes through _device_call so
+    # follower processes (engine/multihost.py) can replay the identical jit
+    # sequence. Op args are plain numpy/int — never device arrays.
+
+    def _device_call(self, op: tuple, args: dict):
+        if self._instr_channel is not None and self._instr_channel.leader:
+            self._instr_channel.broadcast(op, args)
+        return self._exec_op(op, args)
+
+    def _exec_op(self, op: tuple, args: dict):
+        kind = op[0]
+        if kind == "decode":
+            return self._op_decode(**args)
+        if kind == "prefill":
+            return self._op_prefill(op[1], **args)
+        if kind == "prefix_prefill":
+            return self._op_prefix_prefill(op[1], op[2], **args)
+        if kind == "mm_prefill":
+            return self._op_mm_prefill(op[1], op[2], **args)
+        if kind == "import":
+            return self._op_import(**args)
+        raise ValueError(f"unknown device op {op!r}")
+
+    def _op_decode(self, tokens, positions, tables, temps, top_k, top_p,
+                   warm=False):
+        toks, self.k_pages, self.v_pages = self._jit_decode_chunk(
+            self.params, self._put(tokens), self._put(positions),
+            self.k_pages, self.v_pages, self._put(tables),
+            self._next_key(warm), self._put(temps), self._put(top_k),
+            self._put(top_p))
+        return toks
+
+    def _op_prefill(self, bucket, tokens, seq_len, row, temps, top_k, top_p,
+                    warm=False):
+        fn = self._prefill_fn(bucket)
+        tok, self.k_pages, self.v_pages = fn(
+            self.params, self._put(tokens), self._put(seq_len),
+            self.k_pages, self.v_pages, self._put(row),
+            self._next_key(warm), self._put(temps), self._put(top_k),
+            self._put(top_p))
+        tok.copy_to_host_async()
+        return tok
+
+    def _op_prefix_prefill(self, suffix_bucket, prefix_bucket, tokens,
+                           suffix_len, prefix_len, row, prior, temps, top_k,
+                           top_p):
+        fn = self._prefix_prefill_fn(suffix_bucket, prefix_bucket)
+        tok, self.k_pages, self.v_pages = fn(
+            self.params, self._put(tokens), self._put(suffix_len),
+            self._put(prefix_len), self.k_pages, self.v_pages,
+            self._put(row), self._put(prior), self._next_key(False),
+            self._put(temps), self._put(top_k), self._put(top_p))
+        tok.copy_to_host_async()
+        return tok
+
+    def _op_mm_prefill(self, bucket, mm_bucket, tokens, seq_len, mm_pad,
+                       pos_pad, row, temps, top_k, top_p):
+        fn = self._mm_prefill_fn(bucket, mm_bucket)
+        tok, self.k_pages, self.v_pages = fn(
+            self.params, self._put(tokens), self._put(seq_len),
+            self._put(mm_pad), self._put(pos_pad), self.k_pages,
+            self.v_pages, self._put(row), self._next_key(False),
+            self._put(temps), self._put(top_k), self._put(top_p))
+        tok.copy_to_host_async()
+        return tok
+
+    def _op_import(self, blocks_pad, k_pad, v_pad):
+        self.k_pages, self.v_pages = self._jit_import(
+            self.k_pages, self.v_pages, self._put(blocks_pad),
+            self._put(k_pad), self._put(v_pad))
 
     def _batch_bucket(self, n: int) -> int:
         """Smallest power-of-two lane count covering n active slots: a lone
@@ -1082,10 +1207,9 @@ class TpuEngine:
 
         reqs = [self.slots[i].req for i in active]
         reqs += [_DUMMY_REQ] * (B - len(reqs))
-        toks, self.k_pages, self.v_pages = self._jit_decode_chunk(
-            self.params, jnp.asarray(tokens), jnp.asarray(positions),
-            self.k_pages, self.v_pages, jnp.asarray(tables),
-            *self._sample_args(reqs))
+        toks = self._device_call(("decode",), dict(
+            tokens=tokens, positions=positions, tables=tables,
+            **self._sample_np(reqs)))
         sampled = np.asarray(toks)  # [K, B] — ONE readback per chunk
 
         for lane, i in enumerate(active):

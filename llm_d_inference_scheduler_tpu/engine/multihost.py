@@ -1,0 +1,145 @@
+"""Multi-host serving: leader fan-in over a jax.distributed global mesh.
+
+BASELINE.md config 4 at real scale needs engines whose mesh spans hosts
+(e.g. 70B TP-sharded over a v5e-16 multi-host slice). JAX is
+multi-controller SPMD: EVERY process must enter the same jitted computation
+in the same order. The reference has no analogue (its engines are external
+vLLM processes); the shape here is JetStream-style:
+
+- Process 0 (leader) runs the full engine: HTTP server, continuous-batching
+  loop, allocator, prefix cache. Followers (process_id > 0) construct the
+  same TpuEngine (joint sharded init — itself a collective) and then sit in
+  :func:`run_follower`, replaying device ops.
+- Every device call the engine makes is an *op*: a named method plus a dict
+  of host numpy arrays (core.py `_OPS`). The leader broadcasts (op, args)
+  over a TCP instruction channel before executing locally; followers decode
+  and execute the same op. PRNG keys are never shipped: each process derives
+  them from the same seeded stream, so replay order keeps them identical.
+- Host inputs are device_put with a fully-replicated NamedSharding on the
+  global mesh (every process feeds the same bytes), params/KV pages stay in
+  their TP shards; XLA inserts the psums over ICI/DCN.
+
+The channel carries pickled tuples on a cluster-internal port — same trust
+domain as the reference's engine-to-engine ZMQ/NIXL side channels.
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+import socket
+import struct
+import threading
+import time
+from typing import Any
+
+log = logging.getLogger("engine.multihost")
+
+_LEN = struct.Struct(">I")
+
+
+def maybe_init_distributed(cfg) -> bool:
+    """jax.distributed.initialize from EngineConfig dist_* fields. Must run
+    before first device use. Returns True when distributed mode is on."""
+    if not cfg.dist_coordinator:
+        return False
+    import jax
+
+    if cfg.dist_num_processes < 2:
+        raise ValueError("dist_coordinator set but dist_num_processes < 2")
+    jax.distributed.initialize(cfg.dist_coordinator,
+                               num_processes=cfg.dist_num_processes,
+                               process_id=cfg.dist_process_id)
+    return True
+
+
+class InstructionChannel:
+    """Length-prefixed pickle fan-out: leader → all followers."""
+
+    def __init__(self, *, leader: bool, host: str, port: int,
+                 n_followers: int = 0, connect_timeout: float = 60.0):
+        self.leader = leader
+        self._lock = threading.Lock()
+        if leader:
+            self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._srv.bind((host, port))
+            self._srv.listen(n_followers)
+            self._peers: list[socket.socket] = []
+            deadline = time.monotonic() + connect_timeout
+            self._srv.settimeout(connect_timeout)
+            while len(self._peers) < n_followers:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"only {len(self._peers)}/{n_followers} followers "
+                        "connected to the instruction channel")
+                conn, addr = self._srv.accept()
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                log.info("follower connected from %s", addr)
+                self._peers.append(conn)
+        else:
+            deadline = time.monotonic() + connect_timeout
+            last_err: Exception | None = None
+            while True:
+                try:
+                    self._sock = socket.create_connection((host, port),
+                                                          timeout=5.0)
+                    break
+                except OSError as e:
+                    last_err = e
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(
+                            f"could not reach instruction channel: {e}") from e
+                    time.sleep(0.2)
+            self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._sock.settimeout(None)
+
+    def broadcast(self, op: tuple, args: dict[str, Any]) -> None:
+        payload = pickle.dumps((op, args), protocol=pickle.HIGHEST_PROTOCOL)
+        msg = _LEN.pack(len(payload)) + payload
+        with self._lock:
+            for peer in self._peers:
+                peer.sendall(msg)
+
+    def recv(self) -> tuple[tuple, dict[str, Any]]:
+        hdr = self._recv_exact(_LEN.size)
+        (ln,) = _LEN.unpack(hdr)
+        return pickle.loads(self._recv_exact(ln))
+
+    def _recv_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("instruction channel closed")
+            buf += chunk
+        return buf
+
+    def close(self) -> None:
+        if self.leader:
+            for peer in self._peers:
+                peer.close()
+            self._srv.close()
+        else:
+            self._sock.close()
+
+
+def run_follower(engine) -> None:
+    """Replay loop for process_id > 0: executes the leader's device ops in
+    order until the ("stop",) instruction arrives."""
+    chan = engine._instr_channel
+    log.info("follower %d ready (mesh %s)", engine.cfg.dist_process_id,
+             engine.mesh.shape if engine.mesh else None)
+    while True:
+        op, args = chan.recv()
+        if op[0] == "stop":
+            log.info("follower stopping")
+            return
+        try:
+            engine._exec_op(op, args)
+        except Exception:
+            # A follower that falls out of lockstep cannot recover (every
+            # subsequent collective would deadlock) — crash loudly so the
+            # deployment restarts the pod set.
+            log.exception("follower op %s failed; aborting", op)
+            raise

@@ -607,6 +607,17 @@ def main(argv: list[str] | None = None):
     p.add_argument("--ep-size", type=int, default=1,
                    help="expert-parallel degree for MoE models (composes "
                         "with --tp-size)")
+    p.add_argument("--dist-coordinator", default="",
+                   help="jax.distributed coordinator host:port — enables "
+                        "multi-host serving (engine/multihost.py): one global "
+                        "mesh across all engine processes")
+    p.add_argument("--dist-num-processes", type=int, default=1)
+    p.add_argument("--dist-process-id", type=int, default=0)
+    p.add_argument("--dist-instr-port", type=int, default=8790)
+    p.add_argument("--dist-instr-host", default="",
+                   help="instruction-channel address: leader bind / follower "
+                        "dial (the leader's reachable address on real "
+                        "multi-host slices); defaults to --host")
     args = p.parse_args(argv)
     if args.platform:
         import jax
@@ -616,8 +627,23 @@ def main(argv: list[str] | None = None):
                        max_model_len=args.max_model_len, role=args.role,
                        served_model_name=args.served_model_name,
                        checkpoint_path=args.checkpoint, warmup=args.warmup,
-                       tp_size=args.tp_size, ep_size=args.ep_size)
+                       tp_size=args.tp_size, ep_size=args.ep_size,
+                       dist_coordinator=args.dist_coordinator,
+                       dist_num_processes=args.dist_num_processes,
+                       dist_process_id=args.dist_process_id,
+                       dist_instr_port=args.dist_instr_port,
+                       dist_instr_host=args.dist_instr_host)
     logging.basicConfig(level=logging.INFO)
+    from .multihost import maybe_init_distributed, run_follower
+
+    maybe_init_distributed(cfg)
+    if cfg.dist_process_id > 0:
+        # Follower host: no HTTP surface — construct the engine (joint
+        # sharded init) and replay the leader's device ops until released.
+        from .core import TpuEngine
+
+        run_follower(TpuEngine(cfg))
+        return
     asyncio.run(run_server(cfg))
 
 

@@ -34,6 +34,15 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
+def pick_tile_divisor(d_ff: int, tf: int = 512) -> int | None:
+    """Largest lane-aligned (multiple-of-128) tile ≤ tf that divides d_ff;
+    None when no such tile exists (the grouped kernel then can't serve this
+    geometry — single source of truth for callers that gate on it)."""
+    candidates = [t for t in range(128, min(tf, d_ff) + 1, 128)
+                  if d_ff % t == 0]
+    return candidates[-1] if candidates else None
+
+
 def _ffn_kernel(tile_expert, x_ref, w1_ref, w3_ref, w2_ref, out_ref, acc_ref):
     """One (row_tile, f_tile) grid step: fused SwiGLU partial for one expert.
 
@@ -94,26 +103,36 @@ def _grouped_ffn_call(x_pad, tile_expert, w1, w3, w2, *, tm: int, tf: int,
 
 
 def moe_ffn_grouped(lp, x, n_experts: int, experts_per_token: int,
-                    *, tm: int = 16, tf: int = 512,
+                    *, tm: int | None = None, tf: int = 512,
                     interpret: bool = False) -> jnp.ndarray:
     """Drop-in for models.llama._moe_ffn's compute (same math, grouped).
 
     lp: layer params with router/w1/w3/w2 ([E,D,F]/[E,F,D] stacked experts).
     x: [B, S, D]. Returns [B, S, D] in x.dtype.
+
+    Measured on v5e (d=1024, f=4096): vs the dense-over-experts einsums this
+    wins where routing is sparse relative to the expert count — E=64 prefill
+    1.27× faster, E=8 decode 1.2× — and loses where every expert is hit
+    anyway (E=8 prefill: dense streams all experts once at ~70% MXU). Dense
+    stays the engine default; enable via pallas_moe for fine-grained-expert
+    models. tm=None picks the row tile by shape: 128 (MXU-height) for
+    prefill-scale token counts, 16 (bf16 sublane floor) for decode.
     """
     B, S, D = x.shape
     E, k = n_experts, experts_per_token
     T = B * S
+    if tm is None:
+        tm = 128 if T * k >= 1024 else 16
     F = lp["w1"].shape[2]
     # tf must divide F (the grid truncates otherwise — tail columns would be
     # silently dropped) and be lane-aligned. Pick the largest conforming tile
     # no bigger than the requested one.
-    candidates = [t for t in range(128, min(tf, F) + 1, 128) if F % t == 0]
-    if not candidates:
+    chosen = pick_tile_divisor(F, tf)
+    if chosen is None:
         raise ValueError(
             f"d_ff={F} has no 128-aligned tile divisor ≤ {tf}; "
             "use the dense MoE path for this geometry")
-    tf = candidates[-1]
+    tf = chosen
     xt = x.reshape(T, D)
 
     logits = (xt @ lp["router"]).astype(jnp.float32)            # [T, E]

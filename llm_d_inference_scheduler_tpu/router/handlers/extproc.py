@@ -273,14 +273,19 @@ class ExtProcSession:
             pass
         return body
 
-    @staticmethod
-    def _extract_usage(body: bytes) -> dict[str, int] | None:
-        try:
-            doc = json.loads(body)
-            u = doc.get("usage")
-            return u if isinstance(u, dict) else None
-        except Exception:
-            return None
+    def _extract_usage(self, body: bytes) -> dict[str, int] | None:
+        # The configured parser owns the response wire format (the reference's
+        # Parser.ParseResponse, vllmgrpc.go:122-170); JSON usage extraction is
+        # the default for OpenAI-shaped bodies.
+        pr = getattr(self.parser, "parse_response", None)
+        if pr is not None:
+            try:
+                return pr(body, self.headers, end_of_stream=True)
+            except Exception:
+                return None
+        from .parsers import parse_json_usage
+
+        return parse_json_usage(body)
 
 
 class ProtocolError(Exception):

@@ -24,6 +24,18 @@ class ParseResult:
     error: str | None = None
 
 
+def parse_json_usage(raw: bytes) -> dict[str, int] | None:
+    """Default ParseResponse behavior: OpenAI-shaped JSON usage extraction
+    (reference ParsedResponse.Usage, framework/interface/requesthandling).
+    Parser plugins override ``parse_response`` for non-JSON wire formats."""
+    try:
+        doc = json.loads(raw)
+        u = doc.get("usage")
+        return u if isinstance(u, dict) else None
+    except Exception:
+        return None
+
+
 @register_plugin("openai-parser")
 class OpenAIParser(PluginBase):
     """OpenAI /v1/completions + /v1/chat/completions (+ SSE stream awareness)."""

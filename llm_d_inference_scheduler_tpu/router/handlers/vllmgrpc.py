@@ -102,6 +102,22 @@ def parse_grpc_frame(body: bytes) -> bytes:
     return msg
 
 
+def iter_grpc_frames(body: bytes) -> Iterator[bytes]:
+    """All length-prefixed messages in a (possibly coalesced) DATA buffer —
+    a streamed response's final body chunk routinely carries several frames
+    ([token chunk][final chunk with counts])."""
+    pos = 0
+    while pos + 5 <= len(body):
+        if body[pos]:
+            raise ValueError("compressed gRPC frames are not supported")
+        (length,) = struct.unpack(">I", body[pos + 1:pos + 5])
+        msg = body[pos + 5:pos + 5 + length]
+        if len(msg) != length:
+            raise ValueError("truncated gRPC frame")
+        yield msg
+        pos += 5 + length
+
+
 def _parse_tokenized(buf: bytes) -> tuple[str, list[int]]:
     text, ids = "", []
     for field, wire, value in _fields(buf):
@@ -160,6 +176,47 @@ def parse_generate_request(msg: bytes) -> dict[str, Any]:
     return doc
 
 
+def parse_generate_response(msg: bytes) -> dict[str, int] | None:
+    """GenerateResponse (vllm_engine.proto:159-179): oneof chunk=1 |
+    complete=2. Usage is populated only when the message carries token
+    counts (streaming chunks leave them empty until the last one) —
+    reference vllmgrpc.go:146-170."""
+    for field, wire, value in _fields(msg):
+        if field == 1 and wire == 2:      # GenerateStreamChunk
+            counts = {2: 0, 3: 0, 4: 0}   # prompt, completion, cached
+        elif field == 2 and wire == 2:    # GenerateComplete
+            counts = {3: 0, 4: 0, 5: 0}
+        else:
+            continue
+        keys = sorted(counts)
+        for f2, w2, v2 in _fields(value):
+            if f2 in counts and w2 == 0:
+                counts[f2] = int(v2)
+        prompt, completion, cached = (counts[k] for k in keys)
+        if prompt <= 0 and completion <= 0:
+            return None
+        return {
+            "prompt_tokens": prompt,
+            "completion_tokens": completion,
+            "total_tokens": prompt + completion,
+            "prompt_tokens_details": {"cached_tokens": cached},
+        }
+    return None
+
+
+def parse_embed_response(msg: bytes) -> dict[str, int] | None:
+    """EmbedResponse (vllm_engine.proto:190-194): embedding=1 (packed
+    floats), prompt_tokens=2."""
+    prompt_tokens = 0
+    for field, wire, value in _fields(msg):
+        if field == 2 and wire == 0:
+            prompt_tokens = int(value)
+    if prompt_tokens <= 0:
+        return None
+    return {"prompt_tokens": prompt_tokens, "completion_tokens": 0,
+            "total_tokens": prompt_tokens}
+
+
 def parse_embed_request(msg: bytes) -> dict[str, Any]:
     doc: dict[str, Any] = {}
     for field, wire, value in _fields(msg):
@@ -200,6 +257,25 @@ class VllmGrpcParser(PluginBase):
             # gateway — wire types are validated per field above, and any
             # residual decode mismatch degrades to a parse error (400).
             return ParseResult(body=None, error=f"invalid gRPC payload: {e}")
+
+    def parse_response(self, raw: bytes, headers: dict[str, str],
+                       end_of_stream: bool = True) -> dict[str, int] | None:
+        """Usage extraction from gRPC response frames (the reference's
+        Parser.ParseResponse, vllmgrpc.go:122-170): GenerateResponse
+        chunk/complete first, EmbedResponse fallback. Walks every frame in
+        the buffer and keeps the LAST usage seen — streamed responses leave
+        counts empty until the final chunk."""
+        usage = None
+        try:
+            for msg in iter_grpc_frames(raw):
+                u = parse_generate_response(msg)
+                if u is None:
+                    u = parse_embed_response(msg)
+                if u is not None:
+                    usage = u
+        except (ValueError, struct.error, TypeError):
+            pass
+        return usage
 
     def serialize(self, body: InferenceRequestBody) -> bytes:
         # The wire bytes are authoritative: the router never mutates protobuf

@@ -33,14 +33,15 @@ _current_span: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
 
 class Span:
     __slots__ = ("name", "trace_id", "span_id", "parent_id", "start", "end",
-                 "attributes", "status")
+                 "start_unix_ns", "attributes", "status")
 
     def __init__(self, name: str, trace_id: str, parent_id: str | None):
         self.name = name
         self.trace_id = trace_id
         self.span_id = uuid.uuid4().hex[:16]
         self.parent_id = parent_id
-        self.start = time.monotonic()
+        self.start = time.monotonic()       # duration measurement
+        self.start_unix_ns = time.time_ns()  # exporter wall-clock anchor
         self.end: float | None = None
         self.attributes: dict[str, Any] = {}
         self.status = "ok"
@@ -55,6 +56,7 @@ class Span:
             "span_id": self.span_id,
             "parent_id": self.parent_id,
             "duration_ms": round(((self.end or time.monotonic()) - self.start) * 1e3, 3),
+            "start_unix_ns": self.start_unix_ns,
             "attributes": self.attributes,
             "status": self.status,
         }
@@ -77,6 +79,13 @@ class Tracer:
         export_path = os.environ.get("TRACING_EXPORT_PATH", "")
         if export_path:
             self.add_exporter(FileSpanExporter(export_path))
+        # OTLP/HTTP export via OTEL_EXPORTER_OTLP_ENDPOINT (reference:
+        # telemetry/tracing.go:52-129 env-configured OTLP exporter).
+        from .otlp import maybe_start_otlp_exporter
+
+        otlp = maybe_start_otlp_exporter()
+        if otlp is not None:
+            self.add_exporter(otlp)
 
     def add_exporter(self, exporter: Any) -> None:
         """exporter(span_dict) or an object with .export(span_dict)."""

@@ -1,0 +1,179 @@
+#!/usr/bin/env python
+"""Chart renderer: `helm template` analogue for deploy/charts (the reference
+ships helm charts at config/charts/{epplib,standalone}; this environment has
+no helm binary, so the chart format here is a deliberately small, dependency-
+free subset).
+
+Template language (processed line-contextually, order of application):
+- ``{{ path.to.value }}``      — insert a value from the merged values tree.
+- ``{{#if path}} … {{/if}}``   — keep the block iff the value is truthy
+                                 (blocks nest; ``{{#if !path}}`` negates).
+- ``{{#repeat path as name}} … {{/repeat}}``
+                               — repeat the block value-times with
+                                 ``{{ name }}`` bound to 0..n-1 (arithmetic
+                                 ``{{ name + K }}`` supported).
+- ``{{ path | indent N }}``    — multi-line value spliced in with every line
+                                 indented N spaces (must be alone on its
+                                 line; for ConfigMap payload embedding).
+
+Usage:
+  python scripts/render_chart.py deploy/charts/tpu-stack \
+      [-f overrides.yaml] [--set decode.replicas=8] [-o out.yaml]
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+from pathlib import Path
+
+import yaml
+
+_VAR = re.compile(r"\{\{\s*([a-zA-Z0-9_.]+)(\s*\+\s*(\d+))?\s*\}\}")
+_INDENT = re.compile(r"^\s*\{\{\s*([a-zA-Z0-9_.]+)\s*\|\s*indent\s+(\d+)\s*\}\}\s*$")
+_IF = re.compile(r"^\s*\{\{#if\s+(!?)([a-zA-Z0-9_.]+)\s*\}\}\s*$")
+_ENDIF = re.compile(r"^\s*\{\{/if\}\}\s*$")
+_REPEAT = re.compile(r"^\s*\{\{#repeat\s+([a-zA-Z0-9_.]+)\s+as\s+(\w+)\s*\}\}\s*$")
+_ENDREPEAT = re.compile(r"^\s*\{\{/repeat\}\}\s*$")
+
+
+def lookup(values: dict, path: str):
+    node = values
+    for part in path.split("."):
+        if not isinstance(node, dict) or part not in node:
+            raise KeyError(f"value {path!r} not found (missing {part!r})")
+        node = node[part]
+    return node
+
+
+def deep_merge(base: dict, overlay: dict) -> dict:
+    out = dict(base)
+    for k, v in overlay.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def _set_path(values: dict, dotted: str, raw: str) -> None:
+    try:
+        val = yaml.safe_load(raw)
+    except yaml.YAMLError:
+        val = raw
+    node = values
+    parts = dotted.split(".")
+    for p in parts[:-1]:
+        node = node.setdefault(p, {})
+    node[parts[-1]] = val
+
+
+def render_lines(lines: list[str], values: dict) -> list[str]:
+    out: list[str] = []
+    i = 0
+    while i < len(lines):
+        line = lines[i]
+        m = _IF.match(line)
+        if m:
+            depth, j = 1, i + 1
+            while j < len(lines) and depth:
+                if _IF.match(lines[j]):
+                    depth += 1
+                elif _ENDIF.match(lines[j]):
+                    depth -= 1
+                j += 1
+            if depth:
+                raise ValueError(f"unterminated {{#if}} at line {i + 1}")
+            body = lines[i + 1:j - 1]
+            truthy = bool(lookup(values, m.group(2)))
+            if m.group(1) == "!":
+                truthy = not truthy
+            if truthy:
+                out.extend(render_lines(body, values))
+            i = j
+            continue
+        m = _REPEAT.match(line)
+        if m:
+            depth, j = 1, i + 1
+            while j < len(lines) and depth:
+                if _REPEAT.match(lines[j]):
+                    depth += 1
+                elif _ENDREPEAT.match(lines[j]):
+                    depth -= 1
+                j += 1
+            if depth:
+                raise ValueError(f"unterminated {{#repeat}} at line {i + 1}")
+            body = lines[i + 1:j - 1]
+            count = int(lookup(values, m.group(1)))
+            var = m.group(2)
+            for n in range(count):
+                out.extend(render_lines(body, deep_merge(values, {var: n})))
+            i = j
+            continue
+
+        m = _INDENT.match(line)
+        if m:
+            pad = " " * int(m.group(2))
+            for body_line in str(lookup(values, m.group(1))).splitlines():
+                out.append(pad + body_line if body_line.strip() else "")
+            i += 1
+            continue
+
+        def sub(mv: re.Match) -> str:
+            val = lookup(values, mv.group(1))
+            if mv.group(3) is not None:
+                val = int(val) + int(mv.group(3))
+            return str(val)
+
+        out.append(_VAR.sub(sub, line))
+        i += 1
+    return out
+
+
+def render_chart(chart_dir: str | Path, overrides: dict | None = None) -> str:
+    chart = Path(chart_dir)
+    meta = yaml.safe_load((chart / "Chart.yaml").read_text())
+    values = yaml.safe_load((chart / "values.yaml").read_text()) or {}
+    values = deep_merge(values, overrides or {})
+    values.setdefault("chart", {})["name"] = meta.get("name", chart.name)
+
+    docs: list[str] = []
+    for tmpl in sorted((chart / "templates").glob("*.yaml")):
+        rendered = "\n".join(render_lines(
+            tmpl.read_text().splitlines(), values)).strip()
+        if rendered:
+            docs.append(f"# Source: {meta.get('name')}/templates/{tmpl.name}\n"
+                        + rendered)
+    text = "\n---\n".join(docs) + "\n"
+    list(yaml.safe_load_all(text))  # fail loudly on invalid output
+    return text
+
+
+def main(argv: list[str] | None = None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("chart", help="chart directory (Chart.yaml + values.yaml "
+                                  "+ templates/)")
+    ap.add_argument("-f", "--values", action="append", default=[],
+                    help="override values file(s), merged in order")
+    ap.add_argument("--set", action="append", default=[], metavar="K=V",
+                    help="inline override, e.g. decode.replicas=8")
+    ap.add_argument("-o", "--output", default="-")
+    args = ap.parse_args(argv)
+
+    overrides: dict = {}
+    for f in args.values:
+        overrides = deep_merge(overrides, yaml.safe_load(Path(f).read_text()) or {})
+    for kv in args.set:
+        key, _, raw = kv.partition("=")
+        _set_path(overrides, key, raw)
+
+    text = render_chart(args.chart, overrides)
+    if args.output == "-":
+        sys.stdout.write(text)
+    else:
+        Path(args.output).write_text(text)
+
+
+if __name__ == "__main__":
+    main()

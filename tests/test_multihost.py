@@ -1,0 +1,111 @@
+"""Multi-host serving: 2 processes × 2 CPU devices = one global tp=2 mesh.
+
+Real jax.distributed (gloo collectives), real instruction channel: the
+leader process serves a request through the full continuous-batching engine
+while the follower replays device ops in lockstep (engine/multihost.py).
+Greedy tokens must match a single-process tp=2 engine exactly — the same
+SPMD program, just split across controllers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import multiprocessing as mp
+import os
+
+import pytest
+
+COORD = "127.0.0.1:19811"
+INSTR_PORT = 19812
+PROMPT = [1, 5, 9, 13, 27]
+N_GEN = 6
+
+
+def _engine_cfg(**kw):
+    from llm_d_inference_scheduler_tpu.engine import EngineConfig
+
+    base = dict(model="tiny", backend="tpu", max_batch=2, max_model_len=64,
+                tp_size=2, decode_chunk=4, kv_events_port=0, seed=3,
+                warmup=True)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+async def _serve_one(eng):
+    from llm_d_inference_scheduler_tpu.engine import EngineRequest
+
+    await eng.start()
+    try:
+        req = EngineRequest(request_id="mh", prompt_token_ids=list(PROMPT),
+                            max_tokens=N_GEN, temperature=0.0,
+                            ignore_eos=True)
+        out = eng.submit(req)
+        got = []
+        while True:
+            ev = await out.get()
+            if ev.token_id is not None:
+                got.append(ev.token_id)
+            if ev.finish_reason is not None:
+                break
+        return got
+    finally:
+        await eng.stop()
+
+
+def _dist_worker(pid: int, q) -> None:
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    try:
+        from llm_d_inference_scheduler_tpu.engine.core import TpuEngine
+        from llm_d_inference_scheduler_tpu.engine.multihost import (
+            maybe_init_distributed,
+            run_follower,
+        )
+
+        cfg = _engine_cfg(dist_coordinator=COORD, dist_num_processes=2,
+                          dist_process_id=pid, dist_instr_port=INSTR_PORT)
+        maybe_init_distributed(cfg)
+        assert len(jax.devices()) == 4  # global view spans both processes
+        eng = TpuEngine(cfg)
+        if pid == 0:
+            tokens = asyncio.run(_serve_one(eng))
+            q.put(("leader", tokens))
+        else:
+            run_follower(eng)
+            q.put(("follower", "released"))
+    except Exception as e:  # surface child tracebacks in the parent
+        import traceback
+
+        q.put(("error", f"pid{pid}: {e}\n{traceback.format_exc()[-2000:]}"))
+
+
+def test_multihost_serving_matches_single_process():
+    # Reference: single-process tp=2 engine on the local virtual devices.
+    from llm_d_inference_scheduler_tpu.engine.core import TpuEngine
+
+    expected = asyncio.run(_serve_one(TpuEngine(_engine_cfg())))
+    assert len(expected) == N_GEN
+
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_dist_worker, args=(pid, q), daemon=True)
+             for pid in range(2)]
+    for p in procs:
+        p.start()
+    results = {}
+    try:
+        for _ in range(2):
+            kind, payload = q.get(timeout=420)
+            assert kind != "error", payload
+            results[kind] = payload
+    finally:
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.terminate()
+
+    assert results["follower"] == "released"
+    assert results["leader"] == expected

@@ -96,6 +96,36 @@ def test_vllmgrpc_parses_embed_request():
     assert res.body.tokenized_prompt == [9, 10]
 
 
+def test_vllmgrpc_parses_generate_response_usage():
+    p = VllmGrpcParser()
+    # Complete (oneof field 2): prompt=3, completion=4, cached=5.
+    complete = (_tag(3, 0) + _varint(11) + _tag(4, 0) + _varint(7)
+                + _tag(5, 0) + _varint(4))
+    usage = p.parse_response(_frame(_ld(2, complete)), {})
+    assert usage == {"prompt_tokens": 11, "completion_tokens": 7,
+                     "total_tokens": 18,
+                     "prompt_tokens_details": {"cached_tokens": 4}}
+    # Streaming chunk (field 1): prompt=2, completion=3, cached=4.
+    chunk = _tag(2, 0) + _varint(5) + _tag(3, 0) + _varint(2)
+    usage = p.parse_response(_frame(_ld(1, chunk)), {})
+    assert usage["total_tokens"] == 7
+    # Token-less mid-stream chunk → no usage (reference vllmgrpc.go:150-156).
+    empty = _ld(1, b"".join(_varint(t) for t in (1, 2)))  # token_ids only
+    assert p.parse_response(_frame(_ld(1, empty)), {}) is None
+    # EmbedResponse fallback: prompt_tokens=2.
+    usage = p.parse_response(_frame(_tag(2, 0) + _varint(9)), {})
+    assert usage == {"prompt_tokens": 9, "completion_tokens": 0,
+                     "total_tokens": 9}
+    # Garbage → None, never raises.
+    assert p.parse_response(b"\x01junk", {}) is None
+    # Coalesced stream buffer: [token-only chunk][final chunk with counts]
+    # — usage must come from the LAST frame.
+    final = _tag(2, 0) + _varint(6) + _tag(3, 0) + _varint(4)
+    coalesced = _frame(_ld(1, empty)) + _frame(_ld(1, final))
+    usage = p.parse_response(coalesced, {})
+    assert usage["prompt_tokens"] == 6 and usage["completion_tokens"] == 4
+
+
 def test_vllmgrpc_skips_unknown_paths_and_rejects_garbage():
     res = VllmGrpcParser().parse(b"\x00\x00\x00\x00\x00",
                                  {":path": "/vllm.grpc.engine.VllmEngine/Abort"})

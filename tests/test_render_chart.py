@@ -1,0 +1,76 @@
+"""Chart renderer + tpu-stack chart: rendered manifests must be valid k8s
+YAML with the right topology under value overrides (the reference's helm
+`template` behavior, config/charts/)."""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+
+import yaml
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1] / "scripts"))
+from render_chart import render_chart  # noqa: E402
+
+CHART = pathlib.Path(__file__).resolve().parents[1] / "deploy/charts/tpu-stack"
+
+
+def _docs(overrides=None):
+    return [d for d in yaml.safe_load_all(render_chart(CHART, overrides)) if d]
+
+
+def _by_kind_name(docs):
+    return {(d["kind"], d["metadata"]["name"]): d for d in docs}
+
+
+def test_default_render_topology():
+    docs = _by_kind_name(_docs())
+    assert ("Deployment", "tpu-pool-epp") in docs
+    assert ("Deployment", "tpu-pool-decode") in docs
+    assert ("Deployment", "tpu-pool-prefill") in docs
+    assert ("ConfigMap", "tpu-pool-epp-config") in docs
+    assert ("PersistentVolumeClaim", "tpu-pool-epp-lease") in docs
+    assert ("Deployment", "tpu-pool-encode") not in docs  # disabled default
+    # Embedded EndpointPickerConfig is itself valid YAML.
+    cfg = yaml.safe_load(
+        docs[("ConfigMap", "tpu-pool-epp-config")]["data"]["endpointpicker.yaml"])
+    assert any(p["type"] == "prefix-cache-scorer" for p in cfg["plugins"])
+    # Decode pod: sidecar + one engine.
+    names = [c["name"] for c in docs[("Deployment", "tpu-pool-decode")]
+             ["spec"]["template"]["spec"]["containers"]]
+    assert names == ["routing-sidecar", "engine-0"]
+
+
+def test_overrides_and_dp_ranks():
+    docs = _by_kind_name(_docs({
+        "poolName": "prod",
+        "decode": {"replicas": 8, "dp": 4},
+        "prefill": {"enabled": False},
+        "encode": {"enabled": True},
+        "gateway": {"ha": False},
+    }))
+    assert ("Deployment", "prod-prefill") not in docs
+    assert ("Deployment", "prod-encode") in docs
+    assert ("PersistentVolumeClaim", "prod-epp-lease") not in docs  # ha off
+    dec = docs[("Deployment", "prod-decode")]
+    assert dec["spec"]["replicas"] == 8
+    containers = dec["spec"]["template"]["spec"]["containers"]
+    assert [c["name"] for c in containers] == [
+        "routing-sidecar", "engine-0", "engine-1", "engine-2", "engine-3"]
+    # Rank port arithmetic: engine i listens on 8200+i.
+    ports = [c["args"] for c in containers[1:]]
+    assert ["--port=8203" in a for a in ports][3]
+    # epp args drop the lease flag when HA is off.
+    epp = docs[("Deployment", "prod-epp")]
+    args = epp["spec"]["template"]["spec"]["containers"][0]["args"]
+    assert not any("ha-lease-path" in a for a in args)
+
+
+def test_cli_set_overrides(tmp_path, capsys):
+    from render_chart import main
+
+    out = tmp_path / "o.yaml"
+    main([str(CHART), "--set", "decode.replicas=5",
+          "--set", "poolName=x", "-o", str(out)])
+    docs = _by_kind_name(list(yaml.safe_load_all(out.read_text())))
+    assert docs[("Deployment", "x-decode")]["spec"]["replicas"] == 5
