@@ -53,6 +53,11 @@ class EngineConfig:
     # Expert parallelism (MoE models): shard the experts axis over ep_size
     # devices (composes with tp_size; total devices = tp_size * ep_size).
     ep_size: int = 1
+    # Pipeline parallelism for serving (parallel/pp_serve.py): shard the
+    # layer stack + KV pages over pp_size stages; decode/prefill run the
+    # stage ring. Mutually exclusive with tp/ep in this version; forces
+    # prefix caching off (prefix-prefill rings: future work).
+    pp_size: int = 1
     # Multi-host serving (engine/multihost.py): when dist_coordinator is set
     # ("host:port" of the jax.distributed coordinator), all dist_num_processes
     # engine processes form ONE global mesh (tp_size*ep_size must equal the

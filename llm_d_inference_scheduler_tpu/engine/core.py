@@ -143,6 +143,10 @@ class TpuEngine:
         block = self.mcfg.kv_block_size
         self.n_blocks = max(cfg.num_kv_blocks(), 2)  # ≥ trash + 1 usable
         self.max_blocks_per_seq = -(-cfg.max_model_len // block)
+        if cfg.pp_size > 1 and cfg.enable_prefix_caching:
+            log.info("pp serving: prefix caching disabled (prefix-ring "
+                     "prefill not implemented)")
+            cfg.enable_prefix_caching = False
         self.allocator = (PrefixCachingAllocator(self.n_blocks, block)
                           if cfg.enable_prefix_caching
                           else BlockAllocator(self.n_blocks, block))
@@ -167,6 +171,18 @@ class TpuEngine:
                 port=cfg.dist_instr_port,
                 n_followers=cfg.dist_num_processes - 1)
         self.mesh = None
+        self.pp_mesh = None
+        if cfg.pp_size > 1:
+            if cfg.tp_size > 1 or cfg.ep_size > 1 or self._dist:
+                raise ValueError("pp_size composes with tp/ep/multi-host in "
+                                 "a later version; use pp alone")
+            if self.mcfg.n_layers % cfg.pp_size:
+                raise ValueError(f"pp_size={cfg.pp_size} does not divide "
+                                 f"n_layers={self.mcfg.n_layers}")
+            from ..parallel.pp_serve import make_pp_mesh
+
+            self.pp_mesh = make_pp_mesh(jax.devices()[:cfg.pp_size],
+                                        cfg.pp_size)
         if cfg.tp_size > 1 or cfg.ep_size > 1 or self._dist:
             from ..parallel.serve import make_serve_mesh, validate_tp
 
@@ -188,12 +204,21 @@ class TpuEngine:
 
                 shardings, _ = serve_shardings(self.mcfg, self.mesh)
                 params = jax.device_put(params, shardings)
+            elif self.pp_mesh is not None:
+                from ..parallel.pipeline import shard_params_pp
+
+                params = shard_params_pp(params, self.mcfg, self.pp_mesh)
             self.params = params
         elif self.mesh is not None:
             from ..parallel.serve import init_sharded_params
 
             self.params = init_sharded_params(self.mcfg, self.mesh,
                                               jax.random.key(cfg.seed))
+        elif self.pp_mesh is not None:
+            from ..parallel.pp_serve import init_pp_params
+
+            self.params = init_pp_params(self.mcfg, self.pp_mesh,
+                                         jax.random.key(cfg.seed))
         else:
             self.params = llama.init_params(self.mcfg, jax.random.key(cfg.seed))
         self.k_pages, self.v_pages = self._alloc_pages()
@@ -233,7 +258,8 @@ class TpuEngine:
         self._transfer_lock = threading.Lock()
         self.kv_import_device_count = 0  # diagnostics: pulls over ICI/DCN
         self.kv_import_host_count = 0    # diagnostics: host-staged HTTP fetches
-        if cfg.kv_transfer in ("auto", "device") and self.mesh is None:
+        if cfg.kv_transfer in ("auto", "device") and self.mesh is None \
+                and self.pp_mesh is None:
             try:
                 self.kv_transfer_server = _get_transfer_server()
             except Exception:
@@ -245,8 +271,14 @@ class TpuEngine:
             raise ValueError("kv_transfer='device' is not yet supported with "
                              "tp_size>1 (sharded pull specs)")
         self._prefill_fns: dict[int, Any] = {}
-        self._jit_decode_chunk = jax.jit(self._decode_chunk_impl,
-                                         donate_argnums=(3, 4))
+        if self.pp_mesh is not None:
+            from ..parallel.pp_serve import make_pp_decode_chunk
+
+            self._jit_decode_chunk = make_pp_decode_chunk(
+                self.mcfg, self.pp_mesh, cfg.decode_chunk)
+        else:
+            self._jit_decode_chunk = jax.jit(self._decode_chunk_impl,
+                                             donate_argnums=(3, 4))
         self._jit_import = jax.jit(
             lambda kp, vp, blocks, k_new, v_new: (
                 kp.at[:, blocks].set(k_new), vp.at[:, blocks].set(v_new)),
@@ -254,6 +286,10 @@ class TpuEngine:
 
     def _alloc_pages(self) -> tuple[jnp.ndarray, jnp.ndarray]:
         """Fresh zeroed KV page buffers (init + warm-up failure recovery)."""
+        if self.pp_mesh is not None:
+            from ..parallel.pp_serve import alloc_pp_pages
+
+            return alloc_pp_pages(self.mcfg, self.pp_mesh, self.n_blocks)
         if self.mesh is not None:
             from ..parallel.serve import alloc_sharded_pages
 
@@ -297,6 +333,11 @@ class TpuEngine:
         """Per-bucket jitted prefill: forward + KV scatter + fused first-token
         sample (one dispatch covers prefill AND the first token — no separate
         sampler round-trip on the TTFT path)."""
+        if bucket not in self._prefill_fns and self.pp_mesh is not None:
+            from ..parallel.pp_serve import make_pp_prefill
+
+            self._prefill_fns[bucket] = make_pp_prefill(self.mcfg,
+                                                        self.pp_mesh, bucket)
         if bucket not in self._prefill_fns:
             def impl(params, tokens, seq_len, k_pages, v_pages, block_table_row,
                      key, temps, top_k, top_p):
@@ -315,6 +356,9 @@ class TpuEngine:
         encoder vectors overwrite the placeholder-token embeddings; padding
         entries point out of range and are dropped by the scatter."""
         key = ("mm", bucket, mm_bucket)
+        if self.pp_mesh is not None:
+            raise ValueError("multimodal prefill is not supported with "
+                             "pp_size > 1")
         if key not in self._prefill_fns:
             def impl(params, tokens, seq_len, mm_embeds, mm_positions,
                      k_pages, v_pages, block_table_row,
@@ -664,6 +708,16 @@ class TpuEngine:
     # ---- prefill -------------------------------------------------------
 
     def _prefill_into_slot(self, idx, req, out, loop, need: int):
+        if self.pp_mesh is not None and req.mm_embeds is not None:
+            # No multimodal prefill ring yet — reject THIS request; a raise
+            # here would take down every in-flight request via _abort_all.
+            log.warning("rejecting multimodal request %s: not supported "
+                        "with pp_size > 1", req.request_id)
+            self._emit_to(out, loop, TokenEvent(
+                request_id=req.request_id, token_id=None,
+                finish_reason=FinishReason.ABORT,
+                prompt_tokens=len(req.prompt_token_ids)))
+            return
         if self._dist and (req.kv_transfer_params or {}).get("do_remote_decode"):
             # P/D KV staging gathers pages OUTSIDE the replayed op stream
             # (_finish_slot retain_for_transfer) — on a multi-host mesh that

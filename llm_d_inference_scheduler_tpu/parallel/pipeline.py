@@ -86,14 +86,15 @@ def make_pp_forward(cfg: ModelConfig, mesh: Mesh, n_microbatches: int):
             x, _ = jax.lax.scan(body, x, params["layers"])
             return x
 
-        # Initial carries are marked varying over pp (lax.pvary): the loop
+        # Initial carries are marked varying over pp (lax.pcast): the loop
         # body mixes them with stage-dependent values, and shard_map's
         # varying-axis type checking requires carry in/out types to agree.
-        x = jax.lax.pvary(jnp.zeros((mb, S, D), params["embed"].dtype), "pp")
+        x = jax.lax.pcast(jnp.zeros((mb, S, D), params["embed"].dtype), 'pp', to='varying')
         # Accumulate the LAST stage's hidden states only; the vocab-sized
         # head matmul runs once per microbatch AFTER the loop, not per tick.
-        hidden = jax.lax.pvary(
-            jnp.zeros((M, mb, S, D), params["embed"].dtype), "pp")
+        hidden = jax.lax.pcast(
+            jnp.zeros((M, mb, S, D), params["embed"].dtype), "pp",
+            to="varying")
 
         def tick(step, carry):
             x, hidden = carry

@@ -1,0 +1,232 @@
+"""Pipeline-parallel SERVING: paged decode + prefill over a ``pp`` mesh.
+
+Models too deep for one chip/slice even under TP serve through a stage ring
+(the reference delegates intra-engine parallelism to vLLM — SURVEY §2.12;
+this is the TPU-native engine-half equivalent, composing with the GPipe
+training pipeline in parallel/pipeline.py):
+
+- The stacked layer axis of params AND paged KV buffers shards over ``pp``:
+  stage s owns layers [s·L/P, (s+1)·L/P) and exactly those layers' pages.
+- One decode step = P ring turns inside a ``lax.fori_loop``. Every stage
+  applies its layer slab each turn (SPMD), but only the stage whose turn it
+  is holds real activations; off-turn KV writes are redirected to the trash
+  block 0 (cheap index select — no page-buffer masking). A single
+  ``ppermute`` moves activations to the next stage; the ring wrap returns
+  the final hidden state to stage 0, a psum-select replicates it, and the
+  (replicated) head + sampler run everywhere so the sampled token is
+  identical on all stages — decode stays closed under the ring.
+- Latency per token is inherently stage-serial (P slab times + P hops);
+  throughput comes from the decode batch riding each turn. Prefill uses the
+  same ring at [1, S] shapes with per-slab KV scatters.
+
+Engine integration (engine/core.py): with ``pp_size > 1`` the engine swaps
+its decode-chunk / prefill jits for these — same signatures, so the
+device-op layer (multihost replay included) is unchanged.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..engine.sampling import sample_tokens
+from ..models import llama
+from ..models.configs import ModelConfig
+from ..ops import paged_decode_attention, rms_norm, rope_table
+from .pipeline import _layer_tree_template, make_pp_mesh, shard_params_pp
+
+__all__ = ["make_pp_mesh", "shard_params_pp", "pp_page_sharding",
+           "make_pp_decode_chunk", "make_pp_prefill"]
+
+
+def pp_page_sharding(mesh: Mesh) -> NamedSharding:
+    """KV pages [L, N, block, Hkv, Dh]: layer axis follows the stage split."""
+    return NamedSharding(mesh, P("pp"))
+
+
+def _param_specs(cfg: ModelConfig):
+    return {"embed": P(), "layers": jax.tree.map(lambda _: P("pp"),
+                                                 _layer_tree_template(cfg)),
+            "final_norm": P(), "lm_head": P()}
+
+
+def _ring_decode_step(cfg: ModelConfig, n_stages: int, perm, stage,
+                      params, tokens, positions, k_pages, v_pages,
+                      block_tables):
+    """One token for all lanes through the stage ring. Local (per-shard)
+    views: params.layers / pages carry L/P layers. Returns (logits
+    replicated, pages)."""
+    B = tokens.shape[0]
+    block = k_pages.shape[2]
+    Dh = cfg.head_dim
+    cos, sin = rope_table(positions, Dh, cfg.rope_theta)
+    seq_lens = positions + 1
+    blk_idx = block_tables[jnp.arange(B), positions // block]
+    slot = positions % block
+
+    x0 = params["embed"][tokens]                       # [B, D]
+    zero = jnp.zeros_like(x0)
+
+    def slab(x, k_pages, v_pages, active):
+        """This stage's layers on x; KV writes trash-redirected off-turn."""
+        eff_blk = jnp.where(active, blk_idx, 0)
+
+        def body(x, layer_in):
+            lp, kp, vp = layer_in
+            h = rms_norm(x, lp["ln_attn"], cfg.norm_eps)
+            q = (h @ lp["wq"]).reshape(B, cfg.n_heads, Dh)
+            k = (h @ lp["wk"]).reshape(B, cfg.n_kv_heads, Dh)
+            v = (h @ lp["wv"]).reshape(B, cfg.n_kv_heads, Dh)
+            q = llama.apply_rope(q[:, None], cos[:, None], sin[:, None])[:, 0]
+            k = llama.apply_rope(k[:, None], cos[:, None], sin[:, None])[:, 0]
+            attn = paged_decode_attention(q, kp, vp, block_tables, seq_lens,
+                                          cur_k=k, cur_v=v)
+            x = x + attn.reshape(B, -1) @ lp["wo"]
+            h = rms_norm(x, lp["ln_mlp"], cfg.norm_eps)
+            x = x + llama._ffn(cfg, lp, h)
+            return x, (k, v)
+
+        x, (k_cur, v_cur) = jax.lax.scan(body, x,
+                                         (params["layers"], k_pages, v_pages))
+        k_pages = k_pages.at[:, eff_blk, slot].set(
+            k_cur.astype(k_pages.dtype))
+        v_pages = v_pages.at[:, eff_blk, slot].set(
+            v_cur.astype(v_pages.dtype))
+        return x, k_pages, v_pages
+
+    def turn(t, carry):
+        x, k_pages, v_pages = carry
+        x = jnp.where(stage == 0, jnp.where(t == 0, x0, x), x)
+        x, k_pages, v_pages = slab(x, k_pages, v_pages, active=stage == t)
+        x = jax.lax.ppermute(x, "pp", perm)
+        return x, k_pages, v_pages
+
+    x = jax.lax.pcast(zero, 'pp', to='varying')
+    x, k_pages, v_pages = jax.lax.fori_loop(
+        0, n_stages, turn, (x, k_pages, v_pages))
+    # Ring wrap parked the final activations back on stage 0; replicate.
+    x = jax.lax.psum(jnp.where(stage == 0, x, jnp.zeros_like(x)), "pp")
+    h = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (h @ params["lm_head"]).astype(jnp.float32)
+    return logits, k_pages, v_pages
+
+
+def make_pp_decode_chunk(cfg: ModelConfig, mesh: Mesh, decode_chunk: int):
+    """Drop-in for TpuEngine._decode_chunk_impl under pp: same signature,
+    K fused decode+sample ring steps per dispatch."""
+    n_stages = mesh.shape["pp"]
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def chunk(params, tokens, positions, k_pages, v_pages, block_tables,
+              key, temps, top_k, top_p):
+        stage = jax.lax.axis_index("pp")
+        keys = jax.random.split(key, decode_chunk)
+
+        def step(carry, k_step):
+            tokens, positions, k_pages, v_pages = carry
+            logits, k_pages, v_pages = _ring_decode_step(
+                cfg, n_stages, perm, stage, params, tokens, positions,
+                k_pages, v_pages, block_tables)
+            nxt = sample_tokens(logits, k_step, temps, top_k, top_p)
+            return (nxt, positions + 1, k_pages, v_pages), nxt
+
+        (_, _, k_pages, v_pages), toks = jax.lax.scan(
+            step, (tokens, positions, k_pages, v_pages), keys)
+        return toks, k_pages, v_pages
+
+    sharded = shard_map(
+        chunk, mesh=mesh,
+        in_specs=(_param_specs(cfg), P(), P(), P("pp"), P("pp"), P(),
+                  P(), P(), P(), P()),
+        out_specs=(P(), P("pp"), P("pp")))
+    return jax.jit(sharded, donate_argnums=(3, 4))
+
+
+def make_pp_prefill(cfg: ModelConfig, mesh: Mesh, bucket: int):
+    """Drop-in for TpuEngine._prefill_fn(bucket) under pp: ring prefill with
+    per-stage KV scatter + fused first-token sampling."""
+    n_stages = mesh.shape["pp"]
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def prefill(params, tokens, seq_len, k_pages, v_pages, block_table_row,
+                key, temps, top_k, top_p):
+        stage = jax.lax.axis_index("pp")
+        S = tokens.shape[1]
+        assert S == bucket, f"prefill traced at S={S}, keyed as bucket={bucket}"
+        block = k_pages.shape[2]
+        Dh = cfg.head_dim
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :],
+                                     (1, S))
+        cos, sin = rope_table(positions, Dh, cfg.rope_theta)
+        t = jnp.arange(S, dtype=jnp.int32)
+        valid_t = t < seq_len[0]
+        blk_for_t = jnp.where(valid_t, block_table_row[0, t // block], 0)
+        slot_for_t = jnp.where(valid_t, t % block, 0)
+
+        x0 = params["embed"][tokens]                    # [1, S, D]
+        zero = jnp.zeros_like(x0)
+
+        def slab(x, k_pages, v_pages, active):
+            def body(x, layer_in):
+                lp, kp, vp = layer_in
+                x, k, v = llama._layer(
+                    cfg, lp, x, cos, sin, llama.causal_attention,
+                    dict(q_positions=positions, kv_positions=positions))
+                return x, (k, v)
+
+            x, (k_new, v_new) = jax.lax.scan(
+                body, x, (params["layers"], k_pages, v_pages))
+            eff_blk = jnp.where(active, blk_for_t, 0)
+            Lp = k_new.shape[0]
+            k_flat = k_new.reshape(Lp, S, cfg.n_kv_heads, Dh)
+            v_flat = v_new.reshape(Lp, S, cfg.n_kv_heads, Dh)
+            k_pages = k_pages.at[:, eff_blk, slot_for_t].set(
+                k_flat.astype(k_pages.dtype))
+            v_pages = v_pages.at[:, eff_blk, slot_for_t].set(
+                v_flat.astype(v_pages.dtype))
+            return x, k_pages, v_pages
+
+        def turn(tn, carry):
+            x, k_pages, v_pages = carry
+            x = jnp.where(stage == 0, jnp.where(tn == 0, x0, x), x)
+            x, k_pages, v_pages = slab(x, k_pages, v_pages, active=stage == tn)
+            x = jax.lax.ppermute(x, "pp", perm)
+            return x, k_pages, v_pages
+
+        x = jax.lax.pcast(zero, 'pp', to='varying')
+        x, k_pages, v_pages = jax.lax.fori_loop(
+            0, n_stages, turn, (x, k_pages, v_pages))
+        x = jax.lax.psum(jnp.where(stage == 0, x, jnp.zeros_like(x)), "pp")
+        x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+        last = jnp.take_along_axis(x, (seq_len - 1)[:, None, None],
+                                   axis=1)[:, 0]
+        logits = (last @ params["lm_head"]).astype(jnp.float32)
+        tok = sample_tokens(logits, key, temps, top_k, top_p)
+        return tok, k_pages, v_pages
+
+    sharded = shard_map(
+        prefill, mesh=mesh,
+        in_specs=(_param_specs(cfg), P(), P(), P("pp"), P("pp"), P(),
+                  P(), P(), P(), P()),
+        out_specs=(P(), P("pp"), P("pp")))
+    return jax.jit(sharded, donate_argnums=(3, 4))
+
+
+def alloc_pp_pages(cfg: ModelConfig, mesh: Mesh, n_blocks: int):
+    shape = (cfg.n_layers, n_blocks, cfg.kv_block_size, cfg.n_kv_heads,
+             cfg.head_dim)
+    dtype = jnp.dtype(cfg.dtype)
+    zeros = jax.jit(lambda: jnp.zeros(shape, dtype),
+                    out_shardings=pp_page_sharding(mesh))
+    return zeros(), zeros()
+
+
+def init_pp_params(cfg: ModelConfig, mesh: Mesh, key, dtype=None):
+    specs = _param_specs(cfg)
+    shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                             is_leaf=lambda x: isinstance(x, P))
+    return jax.jit(lambda k: llama.init_params(cfg, k, dtype=dtype),
+                   out_shardings=shardings)(key)
