@@ -267,9 +267,10 @@ class TpuEngine:
                     raise
                 log.info("kv transfer server unavailable; host-staged "
                          "HTTP handoff only", exc_info=True)
-        elif cfg.kv_transfer == "device" and self.mesh is not None:
+        elif cfg.kv_transfer == "device":
             raise ValueError("kv_transfer='device' is not yet supported with "
-                             "tp_size>1 (sharded pull specs)")
+                             "tp/ep/pp-sharded or multi-host pages "
+                             "(sharded pull specs)")
         self._prefill_fns: dict[int, Any] = {}
         if self.pp_mesh is not None:
             from ..parallel.pp_serve import make_pp_decode_chunk

@@ -11,6 +11,16 @@ Reference shape (pkg/epp/flowcontrol/{controller,registry} — SURVEY §2.6):
 - Dispatch is gated by a saturation signal: items drain while the pool has
   headroom, pause while saturated (the reference's saturation-detector
   coupling), with a small poll interval.
+- Connection-leasing note: the reference registry pins flows with
+  reference-counted leases (registry/leasing.go) because its enqueue path
+  and GC run on different goroutines. Here each shard is a single-owner
+  asyncio actor — enqueue, dispatch and GC all mutate shard state on the
+  shard's own task, and GC only collects EMPTY queues idle past the window,
+  so the lease ceremony is structurally unnecessary (same guarantee, no
+  refcounts). Dynamic priority bands are likewise implicit: band state is
+  derived per-priority from live queues, so an idle band vanishes with its
+  last flow (the reference needs a second 10-min GC for its materialized
+  band objects, config.go:48-60).
 - Per-priority-band byte capacity (default 1 GB) and optional global caps
   (registry/config.go:40-125).
 """

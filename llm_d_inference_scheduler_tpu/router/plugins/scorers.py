@@ -186,15 +186,24 @@ class SessionAffinityScorer(PluginBase):
 
 @register_plugin("no-hit-lru-scorer")
 class NoHitLruScorer(PluginBase):
-    """For cold requests (no prefix hit on any endpoint), favor the endpoint
-    least-recently chosen for a cold request, spreading cache growth across
-    the pool (reference scorer/nohitlru). Neutral when any endpoint has a hit.
+    """For cold requests (no prefix hit on any endpoint), rank endpoints by
+    how recently they last received a cold request, spreading cache growth
+    across the pool (reference scorer/nohitlru/no_hit_lru.go:180-321):
+
+    - cache hit anywhere → flat neutral 0.5;
+    - cold → never-cold-routed endpoints outrank all others (1 - i/(N-1) in
+      candidate order), then LRU-ordered ones (rank = neverUsed + lruPos,
+      pos 0 = oldest), clamped ≥ 0; single candidate scores 1.0;
+    - the cold decision is recorded at score time and consumed in
+      pre_request, which moves the PRIMARY profile's pick AND the "prefill"
+      profile's pick to the LRU front (both grow cache on a P/D split).
     """
 
-    def __init__(self, name: str | None = None):
+    def __init__(self, name: str | None = None, lru_size: int = 1024):
         super().__init__(name)
-        self._last_cold: dict[str, float] = {}  # address_port -> monotonic ts
-        self._counter = 0.0
+        self._lru: dict[str, None] = {}   # insertion-ordered; front = oldest
+        self._lru_size = lru_size
+        self._cold_ids: set[str] = set()  # request ids whose score-pass was cold
 
     def consumes(self) -> list[str]:
         return [PREFIX_ATTRIBUTE_KEY]
@@ -207,21 +216,49 @@ class NoHitLruScorer(PluginBase):
         return False
 
     def score(self, ctx, state, request, endpoints):
-        if self._any_hit(endpoints):
+        cold = not self._any_hit(endpoints)
+        if not cold:
+            self._cold_ids.discard(request.request_id)
             return {ep.metadata.address_port: 0.5 for ep in endpoints}
-        return _normalized_inverse(
-            {ep.metadata.address_port: self._last_cold.get(ep.metadata.address_port, 0.0)
-             for ep in endpoints})
+        if len(self._cold_ids) > 4096:
+            # Cold requests that never reached pre_request (rejected
+            # post-schedule) would otherwise accumulate.
+            self._cold_ids.clear()
+        self._cold_ids.add(request.request_id)
+        n = len(endpoints)
+        if n == 1:
+            return {endpoints[0].metadata.address_port: 1.0}
+        # LRU positions RESTRICTED to the candidate set: entries for
+        # endpoints no longer in the pool must not inflate ranks.
+        addrs = {ep.metadata.address_port for ep in endpoints}
+        pos = {addr: i for i, addr in
+               enumerate(a for a in self._lru if a in addrs)}  # 0 = oldest
+        never = [ep for ep in endpoints
+                 if ep.metadata.address_port not in pos]
+        out: dict[str, float] = {}
+        for i, ep in enumerate(never):
+            out[ep.metadata.address_port] = 1.0 - i / (n - 1)
+        for ep in endpoints:
+            addr = ep.metadata.address_port
+            if addr in pos:
+                rank = len(never) + pos[addr]
+                out[addr] = max(0.0, 1.0 - rank / (n - 1))
+        return out
+
+    def _touch(self, addr: str) -> None:
+        self._lru.pop(addr, None)
+        self._lru[addr] = None           # most-recent at the back
+        while len(self._lru) > self._lru_size:
+            self._lru.pop(next(iter(self._lru)))
 
     def pre_request(self, ctx, request, result) -> None:
-        info = None
-        primary = result.primary().target_endpoints
-        if primary:
-            info = primary[0].attributes.get(PREFIX_ATTRIBUTE_KEY)
-        if info is None or info.match_blocks == 0:
-            self._counter += 1.0
-            for ep in primary[:1]:
-                self._last_cold[ep.metadata.address_port] = self._counter
+        if request.request_id not in self._cold_ids:
+            return
+        self._cold_ids.discard(request.request_id)
+        for profile in (result.primary_profile_name, "prefill"):
+            pr = result.profile_results.get(profile)
+            if pr is not None and pr.target_endpoints:
+                self._touch(pr.target_endpoints[0].metadata.address_port)
 
 
 @register_plugin("context-length-aware-scorer", "context-length-aware")

@@ -224,18 +224,45 @@ def test_no_hit_lru_scorer_spreads_cold_traffic():
     for e in eps:
         e.attributes.put(PREFIX_ATTRIBUTE_KEY, PrefixCacheMatchInfo(0, 10, 16))
 
-    # all cold, no history: everyone ties at 1.0
-    scores = s.score(None, None, req(), eps)
-    assert set(scores.values()) == {1.0}
+    # All cold, no history: never-cold endpoints rank by candidate order
+    # (reference no_hit_lru.go:197-206: 1 - i/(N-1)).
+    r1 = req()
+    scores = s.score(None, None, r1, eps)
+    assert scores["a:8200"] == 1.0
+    assert scores["b:8200"] == 0.5
+    assert scores["c:8200"] == 0.0
 
-    # record a cold route to "a": next cold request must prefer b/c over a
+    # Record a cold route to "a" (same request whose score marked it cold):
+    # "a" becomes most-recently-cold → lowest score; b/c (never used) lead.
     res = SchedulingResult({"default": ProfileRunResult([eps[0]])}, "default")
-    s.pre_request(None, req(), res)
+    s.pre_request(None, r1, res)
     scores = s.score(None, None, req(), eps)
+    assert scores["b:8200"] == 1.0
+    assert scores["c:8200"] == 0.5
     assert scores["a:8200"] == 0.0
-    assert scores["b:8200"] == scores["c:8200"] == 1.0
 
-    # with a prefix hit somewhere, the scorer goes neutral
+    # Cold-route "b" too: LRU order now a (older) then b → a outranks b.
+    r2 = req()
+    s.score(None, None, r2, eps)
+    s.pre_request(None, r2, SchedulingResult(
+        {"default": ProfileRunResult([eps[1]])}, "default"))
+    scores = s.score(None, None, req(), eps)
+    assert scores["c:8200"] == 1.0          # never cold-routed
+    assert scores["a:8200"] == 0.5          # oldest cold route
+    assert scores["b:8200"] == 0.0          # most recent cold route
+
+    # A "prefill" profile pick also counts as cache growth (P/D split).
+    r3 = req()
+    s.score(None, None, r3, eps)
+    s.pre_request(None, r3, SchedulingResult(
+        {"default": ProfileRunResult([eps[1]]),
+         "prefill": ProfileRunResult([eps[2]])}, "default"))
+    scores = s.score(None, None, req(), eps)
+    assert scores["a:8200"] == 1.0          # now the least-recently cold
+    assert scores["b:8200"] == 0.5
+    assert scores["c:8200"] == 0.0
+
+    # With a prefix hit somewhere, the scorer goes neutral.
     eps[1].attributes.put(PREFIX_ATTRIBUTE_KEY, PrefixCacheMatchInfo(5, 10, 16))
     scores = s.score(None, None, req(), eps)
     assert set(scores.values()) == {0.5}
