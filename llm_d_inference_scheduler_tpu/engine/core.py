@@ -173,17 +173,16 @@ class TpuEngine:
         self.mesh = None
         self.pp_mesh = None
         if cfg.pp_size > 1:
-            if cfg.tp_size > 1 or cfg.ep_size > 1 or self._dist:
-                raise ValueError("pp_size composes with tp/ep/multi-host in "
-                                 "a later version; use pp alone")
-            if self.mcfg.n_layers % cfg.pp_size:
-                raise ValueError(f"pp_size={cfg.pp_size} does not divide "
-                                 f"n_layers={self.mcfg.n_layers}")
-            from ..parallel.pp_serve import make_pp_mesh
+            if cfg.ep_size > 1 or self._dist:
+                raise ValueError("pp_size composes with ep/multi-host in "
+                                 "a later version; use pp (optionally ×tp)")
+            from ..parallel.pp_serve import make_pp_mesh, validate_pp
 
-            self.pp_mesh = make_pp_mesh(jax.devices()[:cfg.pp_size],
-                                        cfg.pp_size)
-        if cfg.tp_size > 1 or cfg.ep_size > 1 or self._dist:
+            validate_pp(self.mcfg, cfg.pp_size, cfg.tp_size)
+            n_model = cfg.pp_size * cfg.tp_size
+            self.pp_mesh = make_pp_mesh(jax.devices()[:n_model],
+                                        cfg.pp_size, tp=cfg.tp_size)
+        elif cfg.tp_size > 1 or cfg.ep_size > 1 or self._dist:
             from ..parallel.serve import make_serve_mesh, validate_tp
 
             validate_tp(self.mcfg, cfg.tp_size, cfg.ep_size)
@@ -205,7 +204,7 @@ class TpuEngine:
                 shardings, _ = serve_shardings(self.mcfg, self.mesh)
                 params = jax.device_put(params, shardings)
             elif self.pp_mesh is not None:
-                from ..parallel.pipeline import shard_params_pp
+                from ..parallel.pp_serve import shard_params_pp
 
                 params = shard_params_pp(params, self.mcfg, self.pp_mesh)
             self.params = params
@@ -275,6 +274,10 @@ class TpuEngine:
         if self.pp_mesh is not None:
             from ..parallel.pp_serve import make_pp_decode_chunk
 
+            # Dispatches per traced batch bucket: lane-group interleave
+            # (no (P-1)/P wasted slab work / KV reads) whenever the bucket
+            # splits evenly into stage groups, broadcast ring otherwise
+            # (e.g. the B=1 single-stream bucket).
             self._jit_decode_chunk = make_pp_decode_chunk(
                 self.mcfg, self.pp_mesh, cfg.decode_chunk)
         else:

@@ -1,4 +1,4 @@
-"""Pipeline-parallel SERVING: paged decode + prefill over a ``pp`` mesh.
+"""Pipeline-parallel SERVING: paged decode + prefill over a ``pp``(×``tp``) mesh.
 
 Models too deep for one chip/slice even under TP serve through a stage ring
 (the reference delegates intra-engine parallelism to vLLM — SURVEY §2.12;
@@ -19,6 +19,17 @@ training pipeline in parallel/pipeline.py):
   throughput comes from the decode batch riding each turn. Prefill uses the
   same ring at [1, S] shapes with per-slab KV scatters.
 
+**TP composition** (``tp > 1``): the mesh is 2-D ``(pp, tp)``. Within each
+stage's slab the layer math is Megatron-TP — column-parallel wq/wk/wv/w1/w3,
+row-parallel wo/w2 (shardings.param_pspecs), one ``psum`` over ``tp`` after
+the attention output projection and one after the FFN, riding ICI inside the
+stage while ``ppermute`` hops between stages. KV pages shard over BOTH axes:
+layers on ``pp``, kv-heads on ``tp`` (the paged gather/scatter stays
+collective-free — GQA group mapping is shard-local because tp divides
+n_kv_heads). Embedding shards the model dim and lm_head the vocab dim over
+``tp``; both are re-assembled with a psum-scatter (invariant output, so the
+sampled token is bit-identical on every device).
+
 Engine integration (engine/core.py): with ``pp_size > 1`` the engine swaps
 its decode-chunk / prefill jits for these — same signatures, so the
 device-op layer (multihost replay included) is unchanged.
@@ -36,66 +47,124 @@ from ..engine.sampling import sample_tokens
 from ..models import llama
 from ..models.configs import ModelConfig
 from ..ops import paged_decode_attention, rms_norm, rope_table
-from .pipeline import _layer_tree_template, make_pp_mesh, shard_params_pp
+from .serve import validate_tp
+from .shardings import param_pspecs
 
 __all__ = ["make_pp_mesh", "shard_params_pp", "pp_page_sharding",
            "make_pp_decode_chunk", "make_pp_prefill"]
 
+PP_SERVE_AXES = ("pp", "tp")
+
+
+def make_pp_mesh(devices=None, pp: int | None = None, tp: int = 1) -> Mesh:
+    """(pp, tp) serving mesh. tp=1 keeps the pure stage ring (the tp axis is
+    size 1 and every tp collective is an XLA-elided identity)."""
+    devices = list(devices if devices is not None else jax.devices())
+    pp = pp or (len(devices) // tp)
+    if pp * tp > len(devices):
+        raise ValueError(f"pp*tp={pp}*{tp} exceeds {len(devices)} devices")
+    arr = np.array(devices[: pp * tp]).reshape(pp, tp)
+    return Mesh(arr, PP_SERVE_AXES)
+
 
 def pp_page_sharding(mesh: Mesh) -> NamedSharding:
-    """KV pages [L, N, block, Hkv, Dh]: layer axis follows the stage split."""
-    return NamedSharding(mesh, P("pp"))
+    """KV pages [L, N, block, Hkv, Dh]: layer axis follows the stage split,
+    kv-head axis follows tp."""
+    return NamedSharding(mesh, P("pp", None, None, "tp", None))
 
 
 def _param_specs(cfg: ModelConfig):
-    return {"embed": P(), "layers": jax.tree.map(lambda _: P("pp"),
-                                                 _layer_tree_template(cfg)),
-            "final_norm": P(), "lm_head": P()}
+    """Stage split on the stacked-L axis composed with Megatron TP specs.
+
+    The per-layer TP dims come from shardings.param_pspecs with the leading
+    (unsharded) L entry replaced by "pp"; the ep axis (absent from this mesh)
+    maps to None — experts replicate, their FFN hidden dim still shards on tp.
+    Embedding shards the model dim, lm_head the vocab dim (re-assembled with
+    _tp_full in the bodies).
+    """
+    tp_layers = param_pspecs(cfg)["layers"]
+
+    def stage(spec: P) -> P:
+        return P("pp", *[a if a != "ep" else None for a in spec[1:]])
+
+    return {"embed": P(None, "tp"),
+            "layers": {k: stage(v) for k, v in tp_layers.items()},
+            "final_norm": P(), "lm_head": P(None, "tp")}
 
 
-def _ring_decode_step(cfg: ModelConfig, n_stages: int, perm, stage,
-                      params, tokens, positions, k_pages, v_pages,
-                      block_tables):
-    """One token for all lanes through the stage ring. Local (per-shard)
-    views: params.layers / pages carry L/P layers. Returns (logits
-    replicated, pages)."""
-    B = tokens.shape[0]
-    block = k_pages.shape[2]
+def _tp_full(x, n_tp: int, axis: int):
+    """Re-assemble a tp-sharded axis into the full (replicated, invariant)
+    array: scatter the local shard at its offset and psum over tp. Identity
+    when tp == 1 (psum over a size-1 axis), but always emitted so the value's
+    varying-axes type drops ``tp`` and sampling stays replicated.
+
+    A tiled ``all_gather`` would move half the bytes, but its output stays
+    *varying* over tp in shard_map's replication typing (no invariant
+    all_gather / pcast-to-invariant exists in this JAX), which would poison
+    every downstream out_spec; the psum form is typed invariant. The arrays
+    here ([B, D] embeds / [B, V] logits) are activation-sized — the extra
+    half-pass is noise next to the per-turn weight traffic."""
+    size = x.shape[axis]
+    i = jax.lax.axis_index("tp")
+    shape = x.shape[:axis] + (size * n_tp,) + x.shape[axis + 1:]
+    full = jax.lax.dynamic_update_slice_in_dim(
+        jnp.zeros(shape, x.dtype), x, i * size, axis)
+    return jax.lax.psum(full, "tp")
+
+
+def _decode_slab(cfg: ModelConfig, params, x, k_pages, v_pages, tables,
+                 positions, eff_blk):
+    """One stage's layer slab for one decode token (shard_map-local view:
+    L/P layers, Hkv/tp kv-heads) with Megatron-TP collectives: psum over tp
+    after the attention output projection and after the FFN. KV for the new
+    token scatters into ``eff_blk`` (the caller trash-redirects off-turn
+    writes). Shared by the broadcast ring and the lane-group interleave."""
+    B = x.shape[0]
     Dh = cfg.head_dim
     cos, sin = rope_table(positions, Dh, cfg.rope_theta)
     seq_lens = positions + 1
-    blk_idx = block_tables[jnp.arange(B), positions // block]
-    slot = positions % block
+    slot = positions % k_pages.shape[2]
 
-    x0 = params["embed"][tokens]                       # [B, D]
+    def body(x, layer_in):
+        lp, kp, vp = layer_in
+        h = rms_norm(x, lp["ln_attn"], cfg.norm_eps)
+        q = (h @ lp["wq"]).reshape(B, -1, Dh)               # local heads
+        k = (h @ lp["wk"]).reshape(B, -1, Dh)
+        v = (h @ lp["wv"]).reshape(B, -1, Dh)
+        q = llama.apply_rope(q[:, None], cos[:, None], sin[:, None])[:, 0]
+        k = llama.apply_rope(k[:, None], cos[:, None], sin[:, None])[:, 0]
+        attn = paged_decode_attention(q, kp, vp, tables, seq_lens,
+                                      cur_k=k, cur_v=v)
+        x = x + jax.lax.psum(attn.reshape(B, -1) @ lp["wo"], "tp")
+        h = rms_norm(x, lp["ln_mlp"], cfg.norm_eps)
+        x = x + jax.lax.psum(llama._ffn(cfg, lp, h), "tp")
+        return x, (k, v)
+
+    x, (k_cur, v_cur) = jax.lax.scan(body, x,
+                                     (params["layers"], k_pages, v_pages))
+    k_pages = k_pages.at[:, eff_blk, slot].set(k_cur.astype(k_pages.dtype))
+    v_pages = v_pages.at[:, eff_blk, slot].set(v_cur.astype(v_pages.dtype))
+    return x, k_pages, v_pages
+
+
+def _ring_decode_step(cfg: ModelConfig, n_stages: int, n_tp: int, perm, stage,
+                      params, tokens, positions, k_pages, v_pages,
+                      block_tables):
+    """One token for all lanes through the stage ring. Local (per-shard)
+    views: params.layers / pages carry L/P layers and Hkv/tp kv-heads.
+    Returns (logits replicated, pages)."""
+    B = tokens.shape[0]
+    block = k_pages.shape[2]
+    blk_idx = block_tables[jnp.arange(B), positions // block]
+
+    x0 = _tp_full(params["embed"][tokens], n_tp, axis=1)    # [B, D]
     zero = jnp.zeros_like(x0)
 
     def slab(x, k_pages, v_pages, active):
         """This stage's layers on x; KV writes trash-redirected off-turn."""
         eff_blk = jnp.where(active, blk_idx, 0)
-
-        def body(x, layer_in):
-            lp, kp, vp = layer_in
-            h = rms_norm(x, lp["ln_attn"], cfg.norm_eps)
-            q = (h @ lp["wq"]).reshape(B, cfg.n_heads, Dh)
-            k = (h @ lp["wk"]).reshape(B, cfg.n_kv_heads, Dh)
-            v = (h @ lp["wv"]).reshape(B, cfg.n_kv_heads, Dh)
-            q = llama.apply_rope(q[:, None], cos[:, None], sin[:, None])[:, 0]
-            k = llama.apply_rope(k[:, None], cos[:, None], sin[:, None])[:, 0]
-            attn = paged_decode_attention(q, kp, vp, block_tables, seq_lens,
-                                          cur_k=k, cur_v=v)
-            x = x + attn.reshape(B, -1) @ lp["wo"]
-            h = rms_norm(x, lp["ln_mlp"], cfg.norm_eps)
-            x = x + llama._ffn(cfg, lp, h)
-            return x, (k, v)
-
-        x, (k_cur, v_cur) = jax.lax.scan(body, x,
-                                         (params["layers"], k_pages, v_pages))
-        k_pages = k_pages.at[:, eff_blk, slot].set(
-            k_cur.astype(k_pages.dtype))
-        v_pages = v_pages.at[:, eff_blk, slot].set(
-            v_cur.astype(v_pages.dtype))
-        return x, k_pages, v_pages
+        return _decode_slab(cfg, params, x, k_pages, v_pages, block_tables,
+                            positions, eff_blk)
 
     def turn(t, carry):
         x, k_pages, v_pages = carry
@@ -110,45 +179,187 @@ def _ring_decode_step(cfg: ModelConfig, n_stages: int, perm, stage,
     # Ring wrap parked the final activations back on stage 0; replicate.
     x = jax.lax.psum(jnp.where(stage == 0, x, jnp.zeros_like(x)), "pp")
     h = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = (h @ params["lm_head"]).astype(jnp.float32)
+    logits = _tp_full((h @ params["lm_head"]).astype(jnp.float32),
+                      n_tp, axis=1)
     return logits, k_pages, v_pages
 
 
-def make_pp_decode_chunk(cfg: ModelConfig, mesh: Mesh, decode_chunk: int):
-    """Drop-in for TpuEngine._decode_chunk_impl under pp: same signature,
-    K fused decode+sample ring steps per dispatch."""
+def _broadcast_chunk_body(cfg, n_stages, n_tp, perm, decode_chunk,
+                          params, tokens, positions, k_pages, v_pages,
+                          block_tables, key, temps, top_k, top_p):
+    """K fused decode+sample broadcast-ring steps (all lanes every turn)."""
+    stage = jax.lax.axis_index("pp")
+    keys = jax.random.split(key, decode_chunk)
+
+    def step(carry, k_step):
+        tokens, positions, k_pages, v_pages = carry
+        logits, k_pages, v_pages = _ring_decode_step(
+            cfg, n_stages, n_tp, perm, stage, params, tokens, positions,
+            k_pages, v_pages, block_tables)
+        nxt = sample_tokens(logits, k_step, temps, top_k, top_p)
+        return (nxt, positions + 1, k_pages, v_pages), nxt
+
+    (_, _, k_pages, v_pages), toks = jax.lax.scan(
+        step, (tokens, positions, k_pages, v_pages), keys)
+    return toks, k_pages, v_pages
+
+
+def make_pp_decode_chunk(cfg: ModelConfig, mesh: Mesh, decode_chunk: int,
+                         interleave: bool | str = "auto"):
+    """Drop-in for TpuEngine._decode_chunk_impl under pp(+tp): same
+    signature, K fused decode+sample ring steps per dispatch.
+
+    Two schedules, chosen per traced batch shape (the engine's decode batch
+    bucketing retraces per PoW2 batch, so a single returned callable serves
+    both): the **broadcast ring** runs every stage on ALL B lanes every turn
+    with only one stage holding real activations — (P-1)/P of the slab
+    compute and KV reads are garbage; the **lane-group interleave** splits
+    the batch into P groups of B/P and keeps the pipeline full: at turn t
+    stage s works group (t-s) mod P, so each stage touches B/P real lanes
+    per turn and one group's token completes per turn in steady state.
+    Group g's token j enters stage 0 at turn g+jP (the ring wrap carries its
+    previous final hidden back to stage 0, where the head + sampler +
+    embedding run — real only on stage 0, and the schedule-driven position
+    bookkeeping is stage-invariant so every stage's copy agrees). A chunk of
+    K tokens/lane takes K·P + P turns; the P-turn fill/drain is amortized
+    over K·P. Trade-off: the lm_head weights are read every turn instead of
+    every P turns — negligible for the deep models pp exists for (head ≪
+    layer stack), and divided by tp. ``interleave="auto"`` picks the
+    interleave whenever the traced batch splits evenly into stage groups
+    (B % P == 0), falling back to the broadcast ring for small/ragged
+    batches (e.g. the engine's B=1 single-stream bucket).
+    """
     n_stages = mesh.shape["pp"]
+    n_tp = mesh.shape.get("tp", 1)
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
     def chunk(params, tokens, positions, k_pages, v_pages, block_tables,
               key, temps, top_k, top_p):
-        stage = jax.lax.axis_index("pp")
-        keys = jax.random.split(key, decode_chunk)
+        B = tokens.shape[0]
+        use_il = interleave is True or (
+            interleave == "auto" and B % n_stages == 0)
+        if use_il and B % n_stages:
+            raise ValueError(f"interleaved pp decode needs batch divisible "
+                             f"by pp={n_stages}, got {B}")
+        body = _interleaved_chunk_body if use_il else _broadcast_chunk_body
+        return body(cfg, n_stages, n_tp, perm, decode_chunk,
+                    params, tokens, positions, k_pages, v_pages,
+                    block_tables, key, temps, top_k, top_p)
 
-        def step(carry, k_step):
-            tokens, positions, k_pages, v_pages = carry
-            logits, k_pages, v_pages = _ring_decode_step(
-                cfg, n_stages, perm, stage, params, tokens, positions,
-                k_pages, v_pages, block_tables)
-            nxt = sample_tokens(logits, k_step, temps, top_k, top_p)
-            return (nxt, positions + 1, k_pages, v_pages), nxt
-
-        (_, _, k_pages, v_pages), toks = jax.lax.scan(
-            step, (tokens, positions, k_pages, v_pages), keys)
-        return toks, k_pages, v_pages
-
+    page_spec = P("pp", None, None, "tp", None)
     sharded = shard_map(
         chunk, mesh=mesh,
-        in_specs=(_param_specs(cfg), P(), P(), P("pp"), P("pp"), P(),
+        in_specs=(_param_specs(cfg), P(), P(), page_spec, page_spec, P(),
                   P(), P(), P(), P()),
-        out_specs=(P(), P("pp"), P("pp")))
+        out_specs=(P(), page_spec, page_spec))
     return jax.jit(sharded, donate_argnums=(3, 4))
 
 
+def make_pp_decode_chunk_interleaved(cfg: ModelConfig, mesh: Mesh,
+                                     decode_chunk: int):
+    """make_pp_decode_chunk with the lane-group interleave forced (the
+    traced batch must divide by pp; group size derives from the traced
+    shape)."""
+    return make_pp_decode_chunk(cfg, mesh, decode_chunk, interleave=True)
+
+
+def _interleaved_chunk_body(cfg, n_stages, n_tp, perm, decode_chunk,
+                            params, tokens, positions, k_pages, v_pages,
+                            block_tables, key, temps, top_k, top_p):
+    K = decode_chunk
+    stage = jax.lax.axis_index("pp")
+    B = tokens.shape[0]
+    Bg = B // n_stages
+    block = k_pages.shape[2]
+    keys = jax.random.split(key, n_stages * K)
+
+    def grp(arr, g):
+        return jax.lax.dynamic_slice_in_dim(arr, g * Bg, Bg, 0)
+
+    def put(arr, val, g):
+        return jax.lax.dynamic_update_slice_in_dim(arr, val, g * Bg, 0)
+
+    def turn(t, carry):
+        x, k_pages, v_pages, toks_out, cur_tok, pos = carry
+        # -- stage-0 block: head + sample the incoming group's previous
+        # token, then embed its next input (real on stage 0 only; the
+        # pos update is schedule-driven, identical on every stage).
+        g0 = t % n_stages
+        j = t // n_stages
+        do_sample = j >= 1
+        h = rms_norm(x, params["final_norm"], cfg.norm_eps)
+        logits = _tp_full((h @ params["lm_head"]).astype(jnp.float32),
+                          n_tp, axis=1)
+        key_idx = jnp.clip(g0 * K + (j - 1), 0, n_stages * K - 1)
+        tok = sample_tokens(logits, keys[key_idx], grp(temps, g0),
+                            grp(top_k, g0), grp(top_p, g0))
+        row_idx = jnp.clip(j - 1, 0, K - 1)
+        row = jax.lax.dynamic_slice(
+            toks_out, (row_idx, g0 * Bg), (1, Bg))[0]
+        toks_out = jax.lax.dynamic_update_slice(
+            toks_out, jnp.where(do_sample, tok, row)[None],
+            (row_idx, g0 * Bg))
+        cur_g = jnp.where(do_sample, tok, grp(tokens, g0))
+        cur_tok = put(cur_tok, cur_g, g0)
+        pos = jnp.where(do_sample, put(pos, grp(pos, g0) + 1, g0), pos)
+        x_in = _tp_full(params["embed"][grp(cur_tok, g0)], n_tp, axis=1)
+        x = jnp.where(stage == 0, x_in, x)
+        # -- slab: this stage's current group.
+        gs = jnp.mod(t - stage, n_stages)
+        i_s = (t - stage) // n_stages
+        active = (t >= stage) & (i_s < K)
+        pos_g = grp(pos, gs)
+        tables_g = grp(block_tables, gs)
+        blk_idx = tables_g[jnp.arange(Bg), pos_g // block]
+        eff_blk = jnp.where(active, blk_idx, 0)
+
+        x, k_pages, v_pages = _decode_slab(cfg, params, x, k_pages, v_pages,
+                                           tables_g, pos_g, eff_blk)
+        x = jax.lax.ppermute(x, "pp", perm)
+        return x, k_pages, v_pages, toks_out, cur_tok, pos
+
+    zero = jnp.zeros((Bg, params["embed"].shape[1] * n_tp),
+                     params["embed"].dtype)
+    x = jax.lax.pcast(zero, 'pp', to='varying')
+    toks_out = jax.lax.pcast(jnp.zeros((K, B), jnp.int32), 'pp',
+                             to='varying')
+    cur_tok = jax.lax.pcast(tokens, 'pp', to='varying')
+    pos = positions
+    x, k_pages, v_pages, toks_out, _, _ = jax.lax.fori_loop(
+        0, K * n_stages + n_stages, turn,
+        (x, k_pages, v_pages, toks_out, cur_tok, pos))
+    toks_out = jax.lax.psum(
+        jnp.where(stage == 0, toks_out, jnp.zeros_like(toks_out)), "pp")
+    return toks_out, k_pages, v_pages
+
+
+
+def _tp_block(cfg: ModelConfig, lp, x, cos, sin, positions):
+    """llama._layer with the TP collectives explicit (shard_map body form):
+    local head slices, psum over tp after wo and after the FFN. Returns
+    (x, k, v) with k/v carrying the LOCAL kv-head slice (pages are tp-sharded
+    on that axis)."""
+    B, S, _ = x.shape
+    Dh = cfg.head_dim
+    h = rms_norm(x, lp["ln_attn"], cfg.norm_eps)
+    q = (h @ lp["wq"]).reshape(B, S, -1, Dh)
+    k = (h @ lp["wk"]).reshape(B, S, -1, Dh)
+    v = (h @ lp["wv"]).reshape(B, S, -1, Dh)
+    q = llama.apply_rope(q, cos, sin)
+    k = llama.apply_rope(k, cos, sin)
+    attn = llama.causal_attention(q, k, v, q_positions=positions,
+                                  kv_positions=positions)
+    x = x + jax.lax.psum(attn.reshape(B, S, -1) @ lp["wo"], "tp")
+    h = rms_norm(x, lp["ln_mlp"], cfg.norm_eps)
+    x = x + jax.lax.psum(llama._ffn(cfg, lp, h), "tp")
+    return x, k, v
+
+
 def make_pp_prefill(cfg: ModelConfig, mesh: Mesh, bucket: int):
-    """Drop-in for TpuEngine._prefill_fn(bucket) under pp: ring prefill with
-    per-stage KV scatter + fused first-token sampling."""
+    """Drop-in for TpuEngine._prefill_fn(bucket) under pp(+tp): ring prefill
+    with per-stage KV scatter + fused first-token sampling."""
     n_stages = mesh.shape["pp"]
+    n_tp = mesh.shape.get("tp", 1)
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
     def prefill(params, tokens, seq_len, k_pages, v_pages, block_table_row,
@@ -166,23 +377,21 @@ def make_pp_prefill(cfg: ModelConfig, mesh: Mesh, bucket: int):
         blk_for_t = jnp.where(valid_t, block_table_row[0, t // block], 0)
         slot_for_t = jnp.where(valid_t, t % block, 0)
 
-        x0 = params["embed"][tokens]                    # [1, S, D]
+        x0 = _tp_full(params["embed"][tokens], n_tp, axis=2)  # [1, S, D]
         zero = jnp.zeros_like(x0)
 
         def slab(x, k_pages, v_pages, active):
             def body(x, layer_in):
                 lp, kp, vp = layer_in
-                x, k, v = llama._layer(
-                    cfg, lp, x, cos, sin, llama.causal_attention,
-                    dict(q_positions=positions, kv_positions=positions))
+                x, k, v = _tp_block(cfg, lp, x, cos, sin, positions)
                 return x, (k, v)
 
             x, (k_new, v_new) = jax.lax.scan(
                 body, x, (params["layers"], k_pages, v_pages))
             eff_blk = jnp.where(active, blk_for_t, 0)
             Lp = k_new.shape[0]
-            k_flat = k_new.reshape(Lp, S, cfg.n_kv_heads, Dh)
-            v_flat = v_new.reshape(Lp, S, cfg.n_kv_heads, Dh)
+            k_flat = k_new.reshape(Lp, S, -1, Dh)           # local kv heads
+            v_flat = v_new.reshape(Lp, S, -1, Dh)
             k_pages = k_pages.at[:, eff_blk, slot_for_t].set(
                 k_flat.astype(k_pages.dtype))
             v_pages = v_pages.at[:, eff_blk, slot_for_t].set(
@@ -203,15 +412,17 @@ def make_pp_prefill(cfg: ModelConfig, mesh: Mesh, bucket: int):
         x = rms_norm(x, params["final_norm"], cfg.norm_eps)
         last = jnp.take_along_axis(x, (seq_len - 1)[:, None, None],
                                    axis=1)[:, 0]
-        logits = (last @ params["lm_head"]).astype(jnp.float32)
+        logits = _tp_full((last @ params["lm_head"]).astype(jnp.float32),
+                          n_tp, axis=1)
         tok = sample_tokens(logits, key, temps, top_k, top_p)
         return tok, k_pages, v_pages
 
+    page_spec = P("pp", None, None, "tp", None)
     sharded = shard_map(
         prefill, mesh=mesh,
-        in_specs=(_param_specs(cfg), P(), P(), P("pp"), P("pp"), P(),
+        in_specs=(_param_specs(cfg), P(), P(), page_spec, page_spec, P(),
                   P(), P(), P(), P()),
-        out_specs=(P(), P("pp"), P("pp")))
+        out_specs=(P(), page_spec, page_spec))
     return jax.jit(sharded, donate_argnums=(3, 4))
 
 
@@ -224,9 +435,28 @@ def alloc_pp_pages(cfg: ModelConfig, mesh: Mesh, n_blocks: int):
     return zeros(), zeros()
 
 
+def validate_pp(cfg: ModelConfig, pp: int, tp: int = 1) -> None:
+    if cfg.n_layers % pp:
+        raise ValueError(f"pp_size={pp} does not divide "
+                         f"n_layers={cfg.n_layers}")
+    if tp > 1:
+        validate_tp(cfg, tp)
+        if cfg.d_model % tp:  # embed shards the model dim under pp×tp
+            raise ValueError(f"tp={tp} does not divide d_model={cfg.d_model}")
+
+
+def pp_param_shardings(cfg: ModelConfig, mesh: Mesh):
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), _param_specs(cfg),
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def shard_params_pp(params, cfg: ModelConfig, mesh: Mesh):
+    """Lay unsharded params onto the (pp, tp) serving mesh."""
+    validate_pp(cfg, mesh.shape["pp"], mesh.shape.get("tp", 1))
+    return jax.device_put(params, pp_param_shardings(cfg, mesh))
+
+
 def init_pp_params(cfg: ModelConfig, mesh: Mesh, key, dtype=None):
-    specs = _param_specs(cfg)
-    shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
-                             is_leaf=lambda x: isinstance(x, P))
+    validate_pp(cfg, mesh.shape["pp"], mesh.shape.get("tp", 1))
     return jax.jit(lambda k: llama.init_params(cfg, k, dtype=dtype),
-                   out_shardings=shardings)(key)
+                   out_shardings=pp_param_shardings(cfg, mesh))(key)

@@ -203,7 +203,11 @@ class NoHitLruScorer(PluginBase):
         super().__init__(name)
         self._lru: dict[str, None] = {}   # insertion-ordered; front = oldest
         self._lru_size = lru_size
-        self._cold_ids: set[str] = set()  # request ids whose score-pass was cold
+        # request id -> profile names whose score-pass was cold. Tracked per
+        # profile (not a single flag) so one scorer instance shared across
+        # profiles can't have a warm profile pass erase another profile's
+        # cold decision (last-writer-wins would be run-order dependent).
+        self._cold: dict[str, set[str]] = {}
 
     def consumes(self) -> list[str]:
         return [PREFIX_ATTRIBUTE_KEY]
@@ -216,15 +220,19 @@ class NoHitLruScorer(PluginBase):
         return False
 
     def score(self, ctx, state, request, endpoints):
+        profile = state.read("current_profile", "") if state else ""
         cold = not self._any_hit(endpoints)
         if not cold:
-            self._cold_ids.discard(request.request_id)
+            profiles = self._cold.get(request.request_id)
+            if profiles is not None:
+                profiles.discard(profile)
             return {ep.metadata.address_port: 0.5 for ep in endpoints}
-        if len(self._cold_ids) > 4096:
+        while len(self._cold) > 4096:
             # Cold requests that never reached pre_request (rejected
-            # post-schedule) would otherwise accumulate.
-            self._cold_ids.clear()
-        self._cold_ids.add(request.request_id)
+            # post-schedule) would otherwise accumulate; evict the OLDEST
+            # entries (insertion order) so in-flight requests keep theirs.
+            self._cold.pop(next(iter(self._cold)))
+        self._cold.setdefault(request.request_id, set()).add(profile)
         n = len(endpoints)
         if n == 1:
             return {endpoints[0].metadata.address_port: 1.0}
@@ -252,10 +260,20 @@ class NoHitLruScorer(PluginBase):
             self._lru.pop(next(iter(self._lru)))
 
     def pre_request(self, ctx, request, result) -> None:
-        if request.request_id not in self._cold_ids:
+        profiles_cold = self._cold.pop(request.request_id, None)
+        if not profiles_cold:
             return
-        self._cold_ids.discard(request.request_id)
-        for profile in (result.primary_profile_name, "prefill"):
+        # Reference semantics: the primary (decode) profile's decision wins
+        # when that profile was scored by this plugin; otherwise any cold
+        # pass counts. A cold route touches BOTH the primary and prefill
+        # picks (both grow cache on a P/D split, no_hit_lru.go:180-321).
+        primary = result.primary_profile_name
+        pr_primary = result.profile_results.get(primary)
+        scored_primary = (pr_primary is not None
+                          and str(self.typed_name()) in pr_primary.raw_scores)
+        if scored_primary and primary not in profiles_cold:
+            return
+        for profile in (primary, "prefill"):
             pr = result.profile_results.get(profile)
             if pr is not None and pr.target_endpoints:
                 self._touch(pr.target_endpoints[0].metadata.address_port)

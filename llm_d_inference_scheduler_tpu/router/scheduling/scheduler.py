@@ -44,6 +44,10 @@ class SchedulerProfile:
 
     def run(self, ctx: Any, request: InferenceRequest, state: CycleState,
             endpoints: list[Endpoint]) -> ProfileRunResult | None:
+        # Plugins shared across profiles (one instance per pluginRef) can
+        # read which profile pass they are scoring (e.g. no-hit-lru records
+        # its cold decision per profile).
+        state.write("current_profile", self.name)
         candidates = endpoints
         for f in self.filters:
             t0 = time.monotonic()

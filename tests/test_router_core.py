@@ -268,6 +268,50 @@ def test_no_hit_lru_scorer_spreads_cold_traffic():
     assert set(scores.values()) == {0.5}
 
 
+def test_no_hit_lru_cold_flag_not_erased_across_profiles():
+    """A warm pass in one profile must not wipe a cold decision recorded by
+    another profile's pass (one scorer instance shared via pluginRef), and
+    the primary profile's decision wins when it scored."""
+    from llm_d_inference_scheduler_tpu.router.framework.scheduling import (
+        CycleState, ProfileRunResult, SchedulingResult)
+    from llm_d_inference_scheduler_tpu.router.plugins.scorers import NoHitLruScorer
+
+    cold_eps = [ep("a"), ep("b")]
+    for e in cold_eps:
+        e.attributes.put(PREFIX_ATTRIBUTE_KEY, PrefixCacheMatchInfo(0, 10, 16))
+    warm_eps = [ep("c")]
+    warm_eps[0].attributes.put(PREFIX_ATTRIBUTE_KEY,
+                               PrefixCacheMatchInfo(4, 10, 16))
+
+    def run(primary_warm: bool, order):
+        s = NoHitLruScorer("lru")
+        r = req()
+        state = CycleState()
+        name = str(s.typed_name())
+        raw = {}
+        for profile in order:
+            state.write("current_profile", profile)
+            eps_for = warm_eps if (profile == "default") == primary_warm \
+                else cold_eps
+            raw[profile] = s.score(None, state, r, eps_for)
+        res = SchedulingResult(
+            {"default": ProfileRunResult([cold_eps[0]],
+                                         raw_scores={name: raw["default"]}),
+             "prefill": ProfileRunResult([cold_eps[1]],
+                                         raw_scores={name: raw["prefill"]})},
+            "default")
+        s.pre_request(None, r, res)
+        return list(s._lru)
+
+    # Primary warm (hit), prefill cold — primary decision wins: no touch,
+    # regardless of which profile scored last.
+    assert run(primary_warm=True, order=["prefill", "default"]) == []
+    assert run(primary_warm=True, order=["default", "prefill"]) == []
+    # Primary cold, prefill warm — cold decision survives a later warm pass.
+    assert run(primary_warm=False, order=["default", "prefill"]) \
+        == ["a:8200", "b:8200"]
+
+
 def test_vertexai_parser():
     from llm_d_inference_scheduler_tpu.router.handlers.parsers import VertexAIParser
     import json
