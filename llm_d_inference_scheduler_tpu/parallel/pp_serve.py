@@ -67,10 +67,13 @@ def make_pp_mesh(devices=None, pp: int | None = None, tp: int = 1) -> Mesh:
     return Mesh(arr, PP_SERVE_AXES)
 
 
+# KV pages [L, N, block, Hkv, Dh]: layer axis follows the stage split,
+# kv-head axis follows tp.
+PAGE_SPEC = P("pp", None, None, "tp", None)
+
+
 def pp_page_sharding(mesh: Mesh) -> NamedSharding:
-    """KV pages [L, N, block, Hkv, Dh]: layer axis follows the stage split,
-    kv-head axis follows tp."""
-    return NamedSharding(mesh, P("pp", None, None, "tp", None))
+    return NamedSharding(mesh, PAGE_SPEC)
 
 
 def _param_specs(cfg: ModelConfig):
@@ -246,7 +249,7 @@ def make_pp_decode_chunk(cfg: ModelConfig, mesh: Mesh, decode_chunk: int,
                     params, tokens, positions, k_pages, v_pages,
                     block_tables, key, temps, top_k, top_p)
 
-    page_spec = P("pp", None, None, "tp", None)
+    page_spec = PAGE_SPEC
     sharded = shard_map(
         chunk, mesh=mesh,
         in_specs=(_param_specs(cfg), P(), P(), page_spec, page_spec, P(),
@@ -417,7 +420,7 @@ def make_pp_prefill(cfg: ModelConfig, mesh: Mesh, bucket: int):
         tok = sample_tokens(logits, key, temps, top_k, top_p)
         return tok, k_pages, v_pages
 
-    page_spec = P("pp", None, None, "tp", None)
+    page_spec = PAGE_SPEC
     sharded = shard_map(
         prefill, mesh=mesh,
         in_specs=(_param_specs(cfg), P(), P(), page_spec, page_spec, P(),

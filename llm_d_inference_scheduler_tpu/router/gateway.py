@@ -69,7 +69,8 @@ class Gateway:
                  port: int = 8081, grpc_health_port: int | None = None,
                  grpc_ext_proc_port: int | None = None,
                  lease_path: str | None = None,
-                 config_watch_path: str | None = None):
+                 config_watch_path: str | None = None,
+                 kube_binding=None):
         self.cfg = cfg
         self.datastore = datastore
         self.dl_runtime = dl_runtime
@@ -150,6 +151,9 @@ class Gateway:
             from .controlplane import ConfigReconciler
 
             self.reconciler = ConfigReconciler(config_watch_path, datastore)
+        # k8s list+watch binding (router/kube.py) — replaces the static
+        # pool / file reconciler when the gateway runs against an API server.
+        self.kube_binding = kube_binding
         self.grpc_ext_proc = None
         if grpc_ext_proc_port is not None:
             from .handlers.extproc_grpc import ExtProcServer
@@ -185,6 +189,8 @@ class Gateway:
             await self.elector.start()
         if self.reconciler is not None:
             await self.reconciler.start()
+        if self.kube_binding is not None:
+            await self.kube_binding.start()
         log.info("gateway listening on %s:%s (%d endpoints)",
                  self.host, self.port, len(self.datastore.endpoint_list()))
 
@@ -195,6 +201,8 @@ class Gateway:
             await self.grpc_health.stop()
         if self.grpc_ext_proc is not None:
             await self.grpc_ext_proc.stop()
+        if self.kube_binding is not None:
+            await self.kube_binding.stop()
         if self.reconciler is not None:
             await self.reconciler.stop()
         if self.elector is not None:
@@ -517,7 +525,8 @@ def build_gateway(config_text: str | None, *, host: str = "127.0.0.1",
                   grpc_health_port: int | None = None,
                   grpc_ext_proc_port: int | None = None,
                   lease_path: str | None = None,
-                  config_watch_path: str | None = None) -> Gateway:
+                  config_watch_path: str | None = None,
+                  kube: dict | None = None) -> Gateway:
     datastore = Datastore()
     dl_runtime = DataLayerRuntime(datastore, poll_interval=poll_interval)
     handle = Handle(datastore=datastore, dl_runtime=dl_runtime)
@@ -530,9 +539,25 @@ def build_gateway(config_text: str | None, *, host: str = "127.0.0.1",
     for plugin in cfg.plugins_by_name.values():
         if hasattr(plugin, "endpoint_added") or hasattr(plugin, "endpoint_removed"):
             dl_runtime.register_lifecycle(plugin)
+    kube_binding = None
+    if kube:
+        from .kube import KubeApiClient, KubeBinding
+
+        if config_watch_path is not None:
+            # Two writers calling datastore.resync() would flap the endpoint
+            # set between the file pool and the k8s pool on every event.
+            log.warning("--watch-config ignored: the k8s binding owns the "
+                        "endpoint set when --kube-api-url is given")
+            config_watch_path = None
+        client = KubeApiClient(kube["api_url"],
+                               token_path=kube.get("token_path"))
+        kube_binding = KubeBinding(datastore, client,
+                                   kube.get("namespace", "default"),
+                                   pool_name=kube.get("pool_name"))
     return Gateway(cfg, datastore, dl_runtime, host=host, port=port,
                    grpc_health_port=grpc_health_port,
                    grpc_ext_proc_port=grpc_ext_proc_port,
+                   kube_binding=kube_binding,
                    lease_path=lease_path,
                    config_watch_path=config_watch_path)
 
@@ -559,6 +584,16 @@ def main(argv: list[str] | None = None):
     p.add_argument("--watch-config", action="store_true",
                    help="reconcile pool/objectives/rewrites live when "
                         "--config-file changes on disk")
+    p.add_argument("--kube-api-url", default=None,
+                   help="k8s API server base URL; enables the list+watch "
+                        "binding (pods + llm-d.ai CRDs) instead of a static "
+                        "pool")
+    p.add_argument("--kube-namespace", default="default")
+    p.add_argument("--kube-pool-name", default=None,
+                   help="InferencePool name to watch for selector/ports")
+    p.add_argument("--kube-token-path", default=None,
+                   help="bearer token file (defaults to the in-cluster "
+                        "service-account path when unset)")
     args = p.parse_args(argv)
 
     text = args.config_text
@@ -566,12 +601,21 @@ def main(argv: list[str] | None = None):
         with open(args.config_file) as f:
             text = f.read()
 
+    from .kube import DEFAULT_TOKEN_PATH
+
+    kube = None
+    if args.kube_api_url:
+        kube = {"api_url": args.kube_api_url,
+                "namespace": args.kube_namespace,
+                "pool_name": args.kube_pool_name,
+                "token_path": args.kube_token_path or DEFAULT_TOKEN_PATH}
     gw = build_gateway(text, host=args.host, port=args.port,
                        grpc_health_port=args.grpc_health_port,
                        grpc_ext_proc_port=args.grpc_ext_proc_port,
                        lease_path=args.ha_lease_path,
                        config_watch_path=(args.config_file
-                                          if args.watch_config else None))
+                                          if args.watch_config else None),
+                       kube=kube)
     if args.endpoints:
         from .framework.datalayer import EndpointMetadata
         metas = []

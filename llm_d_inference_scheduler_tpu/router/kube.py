@@ -1,0 +1,371 @@
+"""Kubernetes API binding: list+watch informers driving the datastore.
+
+The client-go analogue for the reference's controller layer
+(/root/reference/pkg/epp/controller/{pod,pool,objective,modelrewrite}_reconciler.go,
+wired by cmd/epp/runner/runner.go + server/controller_manager.go's
+namespace-scoped caches). The reference leans on controller-runtime:
+informer caches fed by the API server's list+watch protocol, reconcilers
+converging the EPP datastore. Python has no client-go, so this module
+implements the same protocol directly against the REST API:
+
+- ``KubeApiClient``: GET list (items + resourceVersion) and GET
+  ``watch=true`` streaming newline-delimited JSON watch events, with
+  in-cluster auth convention (bearer token file) or explicit base URL.
+- ``Informer``: the list→watch→relist loop. A watch picks up from the
+  list's resourceVersion; disconnects resume from the last seen version;
+  ``410 Gone`` (version too old) forces a fresh list — exactly client-go's
+  Reflector behavior. BOOKMARK events advance the version without data.
+- ``KubeBinding``: four informers converging the datastore the same way
+  the reference's four reconcilers do — InferencePool (selector + target
+  port), Pods (filtered by the pool selector → endpoint resync),
+  InferenceObjective and InferenceModelRewrite custom resources
+  (group ``llm-d.ai/v1alpha2``, mirroring apix/v1alpha2).
+
+Standalone mode (static endpoints / ConfigReconciler file watching,
+router/controlplane.py) remains the default; this binding activates with
+``--kube-api-url`` on the gateway CLI.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import logging
+from typing import Any, Callable
+
+log = logging.getLogger("router.kube")
+
+DEFAULT_TOKEN_PATH = "/var/run/secrets/kubernetes.io/serviceaccount/token"
+CRD_GROUP = "llm-d.ai"
+CRD_VERSION = "v1alpha2"
+
+
+class WatchRelist(Exception):
+    """Watch stream invalidated (410 Gone / decode error) — relist needed."""
+
+
+class KubeApiClient:
+    """Minimal k8s REST client: list + watch with bearer-token auth."""
+
+    def __init__(self, base_url: str, token: str | None = None,
+                 token_path: str | None = None):
+        self.base_url = base_url.rstrip("/")
+        if token is None and token_path:
+            try:
+                with open(token_path) as f:
+                    token = f.read().strip()
+            except OSError:
+                token = None
+        self._token = token
+        self._session = None
+
+    async def _ensure_session(self):
+        if self._session is None:
+            import aiohttp
+
+            headers = {}
+            if self._token:
+                headers["Authorization"] = f"Bearer {self._token}"
+            self._session = aiohttp.ClientSession(headers=headers)
+        return self._session
+
+    async def close(self):
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+    async def list(self, path: str,
+                   label_selector: str | None = None) -> tuple[list[dict], str]:
+        """GET a collection; returns (items, list resourceVersion)."""
+        session = await self._ensure_session()
+        params = {}
+        if label_selector:
+            params["labelSelector"] = label_selector
+        async with session.get(self.base_url + path, params=params) as resp:
+            resp.raise_for_status()
+            body = await resp.json()
+        rv = str((body.get("metadata") or {}).get("resourceVersion") or "")
+        return list(body.get("items") or []), rv
+
+    async def watch(self, path: str, resource_version: str,
+                    label_selector: str | None = None,
+                    on_event: Callable[[str, dict], None] | None = None,
+                    timeout_s: float = 300.0) -> str:
+        """Stream watch events, invoking ``on_event(type, object)``.
+
+        Returns the last seen resourceVersion on clean stream end; raises
+        WatchRelist when the server reports 410 Gone or the stream is
+        undecodable (client-go Reflector semantics).
+        """
+        import aiohttp
+
+        session = await self._ensure_session()
+        params = {"watch": "true", "resourceVersion": resource_version,
+                  "allowWatchBookmarks": "true"}
+        if label_selector:
+            params["labelSelector"] = label_selector
+        rv = resource_version
+        # Connection/auth failures (refused, 401/403/5xx) must PROPAGATE so
+        # the informer's outer loop backs off and logs — only mid-stream
+        # disconnects after a successful open are swallowed (resume from the
+        # last seen version, client-go Reflector semantics).
+        async with session.get(
+                self.base_url + path, params=params,
+                timeout=aiohttp.ClientTimeout(total=None,
+                                              sock_read=timeout_s)) as resp:
+            if resp.status == 410:
+                raise WatchRelist("HTTP 410 Gone")
+            resp.raise_for_status()
+            try:
+                async for raw in resp.content:
+                    line = raw.strip()
+                    if not line:
+                        continue
+                    try:
+                        event = json.loads(line)
+                    except json.JSONDecodeError as e:
+                        raise WatchRelist(f"undecodable watch frame: {e}")
+                    etype = event.get("type", "")
+                    obj = event.get("object") or {}
+                    if etype == "ERROR":
+                        code = (obj.get("code") or 0)
+                        if code == 410:
+                            raise WatchRelist("ERROR event 410 Gone")
+                        raise WatchRelist(f"watch ERROR event: {obj}")
+                    new_rv = ((obj.get("metadata") or {})
+                              .get("resourceVersion"))
+                    if new_rv:
+                        rv = str(new_rv)
+                    if etype == "BOOKMARK":
+                        continue
+                    if on_event is not None:
+                        on_event(etype, obj)
+            except (aiohttp.ClientError, asyncio.TimeoutError):
+                pass  # mid-stream hiccup: resume from rv
+        return rv
+
+
+def _key(obj: dict) -> str:
+    meta = obj.get("metadata") or {}
+    return f"{meta.get('namespace', '')}/{meta.get('name', '')}"
+
+
+class Informer:
+    """client-go Reflector analogue: list, sync the cache, then watch;
+    resume on disconnect, relist on 410."""
+
+    def __init__(self, client: KubeApiClient, path: str,
+                 on_sync: Callable[[dict[str, dict]], None],
+                 on_change: Callable[[dict[str, dict]], None],
+                 label_selector: str | None = None,
+                 relist_backoff_s: float = 1.0):
+        self.client = client
+        self.path = path
+        self.label_selector = label_selector
+        self.on_sync = on_sync          # full cache after (re)list
+        self.on_change = on_change      # full cache after each watch event
+        self.relist_backoff_s = relist_backoff_s
+        self.cache: dict[str, dict] = {}
+        self.synced = asyncio.Event()
+        self._task: asyncio.Task | None = None
+
+    def _apply_event(self, etype: str, obj: dict) -> None:
+        key = _key(obj)
+        if etype == "DELETED":
+            self.cache.pop(key, None)
+        elif etype in ("ADDED", "MODIFIED"):
+            self.cache[key] = obj
+        else:
+            return
+        self.on_change(dict(self.cache))
+
+    async def _run(self):
+        backoff = self.relist_backoff_s
+        while True:
+            try:
+                items, rv = await self.client.list(self.path,
+                                                   self.label_selector)
+                self.cache = {_key(o): o for o in items}
+                self.on_sync(dict(self.cache))
+                self.synced.set()
+                backoff = self.relist_backoff_s
+                while True:
+                    rv = await self.client.watch(
+                        self.path, rv, self.label_selector,
+                        on_event=self._apply_event)
+            except WatchRelist as e:
+                log.info("informer %s: relist (%s)", self.path, e)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("informer %s: list/watch failed; retrying",
+                              self.path)
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 30.0)
+
+    async def start(self):
+        self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self):
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+
+@dataclasses.dataclass
+class PoolSpec:
+    """InferencePool essentials (selector + ports), from the CR or flags."""
+
+    selector: dict[str, str] = dataclasses.field(default_factory=dict)
+    target_port: int = 8000
+    metrics_port: int | None = None
+
+
+class KubeBinding:
+    """Converges the datastore from the k8s API — the reference's
+    reconciler set, standalone-binding edition.
+
+    Pods are watched namespace-wide and filtered client-side against the
+    pool selector (so a pool selector change re-filters the existing cache
+    without restarting the watch — the reference achieves the same with a
+    pool-scoped informer restart, pool_reconciler.go)."""
+
+    def __init__(self, datastore: Any, client: KubeApiClient, namespace: str,
+                 pool_name: str | None = None,
+                 pool: PoolSpec | None = None):
+        self.datastore = datastore
+        self.client = client
+        self.namespace = namespace
+        self.pool_name = pool_name
+        self.pool = pool or PoolSpec()
+        # With a named pool, endpoint resync is gated until the pool CR has
+        # been observed: the zero-value selector matches EVERY pod in the
+        # namespace, which would route inference traffic to arbitrary
+        # workloads during startup (or forever, if the name is wrong).
+        self._pool_seen = pool_name is None
+        ns = namespace
+        self._informers: list[Informer] = []
+        if pool_name:
+            self._informers.append(Informer(
+                client, f"/apis/{CRD_GROUP}/{CRD_VERSION}/namespaces/{ns}/"
+                        "inferencepools",
+                self._pools_changed, self._pools_changed))
+        self._pod_informer = Informer(
+            client, f"/api/v1/namespaces/{ns}/pods",
+            self._pods_changed, self._pods_changed)
+        self._informers.append(self._pod_informer)
+        self._informers.append(Informer(
+            client, f"/apis/{CRD_GROUP}/{CRD_VERSION}/namespaces/{ns}/"
+                    "inferenceobjectives",
+            self._objectives_changed, self._objectives_changed))
+        self._informers.append(Informer(
+            client, f"/apis/{CRD_GROUP}/{CRD_VERSION}/namespaces/{ns}/"
+                    "inferencemodelrewrites",
+            self._rewrites_changed, self._rewrites_changed))
+
+    # ---- reconcile callbacks (run on the event loop) --------------------
+
+    def _pools_changed(self, cache: dict[str, dict]) -> None:
+        obj = cache.get(f"{self.namespace}/{self.pool_name}")
+        if obj is None:
+            return
+        self._pool_seen = True
+        spec = obj.get("spec") or {}
+        sel = (spec.get("selector") or {}).get("matchLabels") or {}
+        self.pool = PoolSpec(
+            selector=dict(sel),
+            target_port=int(spec.get("targetPort")
+                            or spec.get("targetPortNumber") or 8000),
+            metrics_port=(int(spec["metricsPort"])
+                          if spec.get("metricsPort") else None))
+        # Re-filter the current pod cache under the new selector.
+        self._pods_changed(dict(self._pod_informer.cache))
+
+    def _pod_matches(self, pod: dict) -> bool:
+        labels = (pod.get("metadata") or {}).get("labels") or {}
+        return all(labels.get(k) == v for k, v in self.pool.selector.items())
+
+    def _pods_changed(self, cache: dict[str, dict]) -> None:
+        from .framework.datalayer import EndpointMetadata
+
+        if not self._pool_seen:
+            return
+        metas = []
+        for pod in cache.values():
+            meta = pod.get("metadata") or {}
+            status = pod.get("status") or {}
+            ip = status.get("podIP")
+            if not ip or status.get("phase") not in (None, "Running"):
+                continue  # pending/terminated pods carry no routable address
+            if meta.get("deletionTimestamp"):
+                continue
+            if not self._pod_matches(pod):
+                continue
+            metas.append(EndpointMetadata(
+                name=meta.get("name") or ip,
+                address=ip,
+                port=self.pool.target_port,
+                metrics_port=self.pool.metrics_port,
+                labels=dict(meta.get("labels") or {})))
+        self.datastore.resync(metas)
+
+    def _objectives_changed(self, cache: dict[str, dict]) -> None:
+        from .datalayer.datastore import InferenceObjective
+
+        declared = set()
+        for obj in cache.values():
+            name = (obj.get("metadata") or {}).get("name")
+            if not name:
+                continue
+            declared.add(name)
+            spec = obj.get("spec") or {}
+            self.datastore.objective_set(InferenceObjective(
+                name=name, priority=int(spec.get("priority", 0))))
+        for name in [n for n in self.datastore.objective_names()
+                     if n not in declared]:
+            self.datastore.objective_delete(name)
+
+    def _rewrites_changed(self, cache: dict[str, dict]) -> None:
+        from .datalayer.datastore import (
+            InferenceModelRewrite,
+            ModelRewriteTarget,
+        )
+
+        declared = set()
+        for obj in cache.values():
+            meta = obj.get("metadata") or {}
+            spec = obj.get("spec") or {}
+            source = spec.get("sourceModel") or spec.get("source")
+            if not source:
+                continue
+            declared.add(source)
+            self.datastore.rewrite_set(InferenceModelRewrite(
+                name=meta.get("name") or source,
+                source_model=source,
+                targets=[ModelRewriteTarget(model=t["model"],
+                                            weight=int(t.get("weight", 1)))
+                         for t in spec.get("targets") or []]))
+        for source in [s for s in self.datastore.rewrite_sources()
+                       if s not in declared]:
+            self.datastore.rewrite_delete(source)
+
+    # ---- lifecycle ------------------------------------------------------
+
+    async def start(self):
+        for inf in self._informers:
+            await inf.start()
+
+    async def wait_synced(self, timeout_s: float = 30.0):
+        await asyncio.wait_for(
+            asyncio.gather(*(inf.synced.wait() for inf in self._informers)),
+            timeout_s)
+
+    async def stop(self):
+        for inf in self._informers:
+            await inf.stop()
+        await self.client.close()
