@@ -38,6 +38,7 @@ from ..models import llama
 from ..utils.hashing import chain_block_hashes
 from .blocks import BlockAllocator, PrefixCachingAllocator
 from .config import EngineConfig
+from .multihost import ChannelBroken
 from .request import EngineRequest, FinishReason, TokenEvent
 from .sampling import sample_tokens
 from .telemetry import EngineTelemetry
@@ -170,6 +171,8 @@ class TpuEngine:
                 host=cfg.dist_instr_host or cfg.host,
                 port=cfg.dist_instr_port,
                 n_followers=cfg.dist_num_processes - 1)
+            if self._instr_channel.leader:
+                self._instr_channel.on_peer_lost = self._on_follower_lost
         self.mesh = None
         self.pp_mesh = None
         if cfg.pp_size > 1:
@@ -223,6 +226,11 @@ class TpuEngine:
         self.k_pages, self.v_pages = self._alloc_pages()
 
         self.warming = cfg.warmup  # cleared by the engine thread post-compile
+        # Multi-host degrade latch: set when a follower dies (peer monitor)
+        # or the instruction channel breaks mid-broadcast. Issuing further
+        # collectives would deadlock, so the engine aborts everything and
+        # refuses work; /health reports 503 for the restart controller.
+        self.dist_degraded = False
         self.slots: list[_Slot | None] = [None] * cfg.max_batch
         self._waiting: list[tuple[EngineRequest, asyncio.Queue, asyncio.AbstractEventLoop]] = []
         self._import_ready: list[_PendingImport] = []
@@ -563,8 +571,16 @@ class TpuEngine:
                     self._publish_kv_snapshot()
                 if self._stop:
                     return
+            if self.dist_degraded:
+                # Drain everything (queued work included) without touching
+                # the device — any collective would hang on the dead peer.
+                self._abort_all("multi-host peer lost")
+                continue
             try:
                 self._step()
+            except ChannelBroken:
+                log.error("instruction channel broken; degrading")
+                self.dist_degraded = True
             except Exception:
                 log.exception("engine loop failure; aborting in-flight requests")
                 self._abort_all("engine loop failure")
@@ -589,6 +605,14 @@ class TpuEngine:
                     # Head-of-line can't be placed yet (no free blocks / no slot
                     # / fetch in flight): sleep until something changes.
                     self._cond.wait(timeout=0.05)
+
+    def _on_follower_lost(self, idx: int, why: str) -> None:
+        """Peer-monitor callback (runs on the channel's watch thread)."""
+        log.error("follower %d lost (%s): engine degrading — coordinated "
+                  "restart required", idx, why)
+        self.dist_degraded = True
+        with self._cond:
+            self._cond.notify()
 
     def _abort_all(self, reason: str):
         for i, s in enumerate(self.slots):

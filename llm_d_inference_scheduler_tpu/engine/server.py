@@ -410,11 +410,14 @@ class EngineServer:
 
     async def health(self, request: web.Request) -> web.Response:
         warming = bool(getattr(self.engine, "warming", False))
+        degraded = bool(getattr(self.engine, "dist_degraded", False))
+        status = ("degraded" if degraded
+                  else "warming" if warming else "ok")
         return web.json_response({
-            "status": "warming" if warming else "ok",
+            "status": status,
             "engine_id": self.engine.engine_id,
             "model": self.engine.model_name, "role": self.cfg.role,
-        }, status=503 if warming else 200)
+        }, status=200 if status == "ok" else 503)
 
     # ---- KV handoff data path (P/D disaggregation) ---------------------
 
