@@ -185,6 +185,12 @@ class InstructionChannel:
                 pass  # on_peer_lost already fired; keep pinging survivors
 
     def broadcast(self, op: tuple, args: dict[str, Any]) -> None:
+        if op[0] == "stop":
+            # Mark closed BEFORE the bytes leave: a follower may exit (EOF
+            # on its socket) the instant it decodes stop, and _watch_peer
+            # must not report that normal exit as a lost peer.
+            with self._state_lock:
+                self._closed = True
         payload = pickle.dumps((op, args), protocol=pickle.HIGHEST_PROTOCOL)
         msg = _LEN.pack(len(payload)) + payload
         broken: list[int] = []

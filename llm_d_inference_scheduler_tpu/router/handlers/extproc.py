@@ -86,6 +86,16 @@ class CommonResponse:
     phase: str  # request_headers | request_body | response_headers | response_body
     header_mutation: HeaderMutation | None = None
     body: bytes | None = None  # replacement body (request_body/response_body)
+    # Whether the replacement body completes the stream direction. The wire
+    # binding stamps it onto the final StreamedBodyResponse chunk: request
+    # bodies are always complete once scheduled (reference
+    # envoy/request.go:25-27 setEos=true); response bodies carry the
+    # incoming chunk's end_of_stream through (handlers/response.go:91-92).
+    body_eos: bool = False
+    # Destination header changed after Envoy computed its route — the
+    # headers response that carries x-gateway-destination-endpoint sets this
+    # (reference request.go:100 ClearRouteCache: true).
+    clear_route_cache: bool = False
     dynamic_metadata: dict[str, Any] = dataclasses.field(default_factory=dict)
 
 
@@ -124,6 +134,12 @@ class ExtProcSession:
     # ---- request phase -------------------------------------------------
 
     async def on_request_headers(self, msg: RequestHeaders):
+        """Returns None when a body follows: the reference defers the
+        request-headers response until the body is complete and scheduled
+        (server.go:314 breaks with no send; reqHeaderResp is generated at
+        body EOS, server.go:362). In FULL_DUPLEX_STREAMED mode Envoy holds
+        the request until the headers response arrives, so answering early
+        would route before a destination is chosen."""
         if self.state is not StreamState.AWAITING_REQUEST:
             raise ProtocolError("request headers after request phase started")
         self.state = StreamState.REQUEST_HEADERS_DONE
@@ -137,15 +153,21 @@ class ExtProcSession:
         if msg.end_of_stream:
             # Bodyless request: random-endpoint fallback (request.go:40-47).
             self.state = StreamState.REQUEST_BODY_DONE
-            return self._fallback_response("request_headers")
-        return CommonResponse(phase="request_headers")
+            return self._fallback_response()
+        return None
 
     async def on_request_body(self, msg: RequestBody):
+        """Mid-stream chunks are buffered with no response (server.go:
+        315-318). The terminal chunk parses + schedules and returns TWO
+        responses — the deferred headers response (destination header
+        mutation + dynamic metadata, clear_route_cache) followed by the
+        mutated body (server.go:362-363); the wire binding re-chunks the
+        body to ≤62 KB frames."""
         if self.state is not StreamState.REQUEST_HEADERS_DONE:
             raise ProtocolError("request body before headers / after EOS")
         self._body.extend(msg.chunk)
         if not msg.end_of_stream:
-            return CommonResponse(phase="request_body")
+            return None
         self.state = StreamState.REQUEST_BODY_DONE
 
         raw = bytes(self._body)
@@ -155,7 +177,7 @@ class ExtProcSession:
                 status=400, headers={X_REMOVAL_REASON: parse.error},
                 body=json.dumps({"error": parse.error}).encode())
         if parse.skip:
-            return self._fallback_response("request_body", body=raw)
+            return self._fallback_response(body=raw)
 
         self.request = InferenceRequest(
             request_id=self.headers[H_REQUEST_ID],
@@ -182,16 +204,22 @@ class ExtProcSession:
 
         mutation = HeaderMutation(set_headers={
             H_DESTINATION: self.request.headers[H_DESTINATION],
+            # Body mutation changes the length (request.go:120-129).
+            "content-length": str(len(body_out)),
             **{h: self.request.headers[h] for h in (
                 "x-prefiller-host-port", "x-encoder-hosts-ports",
                 "x-data-parallel-host-port") if h in self.request.headers},
         })
-        return CommonResponse(
-            phase="request_body",
-            header_mutation=mutation,
-            body=body_out,
-            dynamic_metadata={"envoy.lb": {
-                H_DESTINATION: self.request.headers[H_DESTINATION]}})
+        return [
+            CommonResponse(
+                phase="request_headers",
+                header_mutation=mutation,
+                clear_route_cache=True,
+                dynamic_metadata={"envoy.lb": {
+                    H_DESTINATION: self.request.headers[H_DESTINATION]}}),
+            CommonResponse(phase="request_body", body=body_out,
+                           body_eos=True),
+        ]
 
     async def on_request_trailers(self, msg: RequestTrailers):
         return CommonResponse(phase="request_trailers")
@@ -230,6 +258,7 @@ class ExtProcSession:
                 self.director.handle_response_complete(
                     None, self.request, self.target_endpoint, self.usage)
             return CommonResponse(phase="response_body", body=body,
+                                  body_eos=True,
                                   dynamic_metadata={"usage": self.usage})
         return CommonResponse(phase="response_body", body=body)
 
@@ -246,19 +275,26 @@ class ExtProcSession:
 
     # ---- helpers -------------------------------------------------------
 
-    def _fallback_response(self, phase: str, body: bytes | None = None):
+    def _fallback_response(self, body: bytes | None = None):
+        """Random-endpoint fallback (request.go:69-84): a headers response
+        carrying the destination, plus the unmodified body when one was
+        buffered (skip-parse path)."""
         ep = self.director.get_random_endpoint()
         if ep is None:
             return ImmediateResponse(
                 status=503, headers={X_REMOVAL_REASON: "no ready endpoints"},
                 body=b'{"error": "no ready endpoints"}')
         self.target_endpoint = ep
-        return CommonResponse(
-            phase=phase,
+        headers_resp = CommonResponse(
+            phase="request_headers",
             header_mutation=HeaderMutation(
                 set_headers={H_DESTINATION: ep.metadata.address_port}),
-            body=body,
+            clear_route_cache=True,
             dynamic_metadata={"envoy.lb": {H_DESTINATION: ep.metadata.address_port}})
+        if body is None:
+            return headers_resp
+        return [headers_resp,
+                CommonResponse(phase="request_body", body=body, body_eos=True)]
 
     def _rewrite_model(self, body: bytes) -> bytes:
         if (self.request is None or not self.original_model

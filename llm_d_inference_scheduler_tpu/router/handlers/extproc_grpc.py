@@ -64,6 +64,11 @@ RESP_RESPONSE_TRAILERS = 6
 RESP_IMMEDIATE = 7
 RESP_DYNAMIC_METADATA = 8
 
+# Max bytes per streamed body chunk. Envoy caps streamed chunks at 64 KB;
+# the reference stays deliberately under it (pkg/common/envoy/chunking.go:
+# 24-27 BodyByteLimit) so a mutated body never gets rejected on the wire.
+BODY_BYTE_LIMIT = 62000
+
 
 # ---- protobuf writer helpers -------------------------------------------
 
@@ -216,6 +221,46 @@ _PHASE_TO_FIELD = {
 }
 
 
+def _encode_streamed_body_mutation(chunk: bytes, eos: bool) -> bytes:
+    """BodyMutation { StreamedBodyResponse streamed_response = 3
+    { bytes body = 1; bool end_of_stream = 2; } } — the mutation shape Envoy
+    requires in FULL_DUPLEX_STREAMED mode (reference chunking.go:40-46)."""
+    streamed = _ld(1, chunk)
+    if eos:
+        streamed += _vi(2, 1)
+    return _ld(3, _ld(3, streamed))
+
+
+def encode_processing_responses(
+        resp: CommonResponse | ImmediateResponse) -> list[bytes]:
+    """Encode one logical response as the wire frames to send, splitting a
+    mutated body into ≤BODY_BYTE_LIMIT streamed chunks (reference
+    chunking.go:29-58, handlers/response.go:91-110): the header mutation
+    rides the first frame, end_of_stream + dynamic metadata the last."""
+    if (isinstance(resp, ImmediateResponse) or resp.body is None
+            or len(resp.body) <= BODY_BYTE_LIMIT):
+        return [encode_processing_response(resp)]
+    field = _PHASE_TO_FIELD[resp.phase]
+    chunks = [resp.body[i:i + BODY_BYTE_LIMIT]
+              for i in range(0, len(resp.body), BODY_BYTE_LIMIT)]
+    frames = []
+    for i, chunk in enumerate(chunks):
+        last = i == len(chunks) - 1
+        common = b""
+        if i == 0 and resp.header_mutation is not None:
+            common += _ld(2, _encode_header_mutation(resp.header_mutation))
+        common += _encode_streamed_body_mutation(chunk,
+                                                 resp.body_eos and last)
+        if i == 0 and resp.clear_route_cache:
+            common += _vi(5, 1)
+        frame = _ld(field, _ld(1, common))
+        if last and resp.dynamic_metadata:
+            frame += _ld(RESP_DYNAMIC_METADATA,
+                         _encode_struct(resp.dynamic_metadata))
+        frames.append(frame)
+    return frames
+
+
 def encode_processing_response(resp: CommonResponse | ImmediateResponse) -> bytes:
     if isinstance(resp, ImmediateResponse):
         # ImmediateResponse { HttpStatus status = 1 {code=1}; HeaderMutation
@@ -229,12 +274,14 @@ def encode_processing_response(resp: CommonResponse | ImmediateResponse) -> byte
         return _ld(RESP_IMMEDIATE, payload)
 
     # CommonResponse { status = 1 (CONTINUE=0); header_mutation = 2;
-    # body_mutation = 3 { body = 1 }; }
+    # body_mutation = 3; trailers = 4; clear_route_cache = 5; }
     common = b""
     if resp.header_mutation is not None:
         common += _ld(2, _encode_header_mutation(resp.header_mutation))
     if resp.body is not None:
-        common += _ld(3, _ld(1, resp.body))
+        common += _encode_streamed_body_mutation(resp.body, resp.body_eos)
+    if resp.clear_route_cache:
+        common += _vi(5, 1)
     field = _PHASE_TO_FIELD[resp.phase]
     if field == RESP_REQUEST_TRAILERS:
         # TrailersResponse { HeaderMutation header_mutation = 1; }
@@ -299,7 +346,7 @@ class ExtProcServer:
                         resp = await session.on_request_body(msg)
                         if (self.evictor is not None and evict_key is None
                                 and session.request is not None
-                                and isinstance(resp, CommonResponse)):
+                                and not isinstance(resp, ImmediateResponse)):
                             evict_key = self.evictor.register(
                                 session.request.request_id,
                                 session.request.objectives.priority,
@@ -314,8 +361,14 @@ class ExtProcServer:
                     await context.abort(grpc.StatusCode.FAILED_PRECONDITION,
                                         f"ext-proc protocol violation: {e}")
                     return
-                yield encode_processing_response(resp)
-                if isinstance(resp, ImmediateResponse):
+                # A handler may defer (None — buffering), answer once, or
+                # emit several logical responses (deferred headers + body).
+                responses = (resp if isinstance(resp, list)
+                             else [resp] if resp is not None else [])
+                for r in responses:
+                    for frame in encode_processing_responses(r):
+                        yield frame
+                if any(isinstance(r, ImmediateResponse) for r in responses):
                     return
         finally:
             if evict_key is not None and self.evictor is not None:

@@ -67,7 +67,11 @@ class KubeApiClient:
             headers = {}
             if self._token:
                 headers["Authorization"] = f"Bearer {self._token}"
-            self._session = aiohttp.ClientSession(headers=headers)
+            # Watch frames for real pods (managedFields etc.) routinely
+            # exceed aiohttp's default 64 KiB line buffer; a small buffer
+            # turns every large event into a permanent relist loop.
+            self._session = aiohttp.ClientSession(headers=headers,
+                                                  read_bufsize=2 ** 22)
         return self._session
 
     async def close(self):
@@ -117,32 +121,45 @@ class KubeApiClient:
             if resp.status == 410:
                 raise WatchRelist("HTTP 410 Gone")
             resp.raise_for_status()
-            try:
-                async for raw in resp.content:
-                    line = raw.strip()
-                    if not line:
-                        continue
-                    try:
-                        event = json.loads(line)
-                    except json.JSONDecodeError as e:
-                        raise WatchRelist(f"undecodable watch frame: {e}")
-                    etype = event.get("type", "")
-                    obj = event.get("object") or {}
-                    if etype == "ERROR":
-                        code = (obj.get("code") or 0)
-                        if code == 410:
-                            raise WatchRelist("ERROR event 410 Gone")
-                        raise WatchRelist(f"watch ERROR event: {obj}")
-                    new_rv = ((obj.get("metadata") or {})
-                              .get("resourceVersion"))
-                    if new_rv:
-                        rv = str(new_rv)
-                    if etype == "BOOKMARK":
-                        continue
-                    if on_event is not None:
-                        on_event(etype, obj)
-            except (aiohttp.ClientError, asyncio.TimeoutError):
-                pass  # mid-stream hiccup: resume from rv
+            it = resp.content.__aiter__()
+            while True:
+                # The stream read gets its own narrow exception scope: only
+                # transport errors map to resume/relist — a ValueError
+                # raised by an on_event callback (bad CR field) must surface
+                # as the data error it is, not as a frame problem.
+                try:
+                    raw = await it.__anext__()
+                except StopAsyncIteration:
+                    break
+                except ValueError as e:
+                    # aiohttp raises ValueError ("Chunk too big") when a
+                    # frame exceeds read_bufsize: the stream is no longer
+                    # line-aligned, so relist instead of looping forever.
+                    raise WatchRelist(f"oversize watch frame: {e}")
+                except (aiohttp.ClientError, asyncio.TimeoutError):
+                    break  # mid-stream hiccup: resume from rv
+                line = raw.strip()
+                if not line:
+                    continue
+                try:
+                    event = json.loads(line)
+                except json.JSONDecodeError as e:
+                    raise WatchRelist(f"undecodable watch frame: {e}")
+                etype = event.get("type", "")
+                obj = event.get("object") or {}
+                if etype == "ERROR":
+                    code = (obj.get("code") or 0)
+                    if code == 410:
+                        raise WatchRelist("ERROR event 410 Gone")
+                    raise WatchRelist(f"watch ERROR event: {obj}")
+                new_rv = ((obj.get("metadata") or {})
+                          .get("resourceVersion"))
+                if new_rv:
+                    rv = str(new_rv)
+                if etype == "BOOKMARK":
+                    continue
+                if on_event is not None:
+                    on_event(etype, obj)
         return rv
 
 
@@ -290,6 +307,15 @@ class KubeBinding:
         labels = (pod.get("metadata") or {}).get("labels") or {}
         return all(labels.get(k) == v for k, v in self.pool.selector.items())
 
+    @staticmethod
+    def _pod_ready(pod: dict) -> bool:
+        """PodReady condition True — a Running pod still loading weights or
+        failing its readiness probe must not receive inference traffic
+        (reference pod_reconciler.go:92 → util/pod.go IsPodReady)."""
+        conditions = (pod.get("status") or {}).get("conditions") or []
+        return any(c.get("type") == "Ready" and c.get("status") == "True"
+                   for c in conditions)
+
     def _pods_changed(self, cache: dict[str, dict]) -> None:
         from .framework.datalayer import EndpointMetadata
 
@@ -303,6 +329,8 @@ class KubeBinding:
             if not ip or status.get("phase") not in (None, "Running"):
                 continue  # pending/terminated pods carry no routable address
             if meta.get("deletionTimestamp"):
+                continue
+            if not self._pod_ready(pod):
                 continue
             if not self._pod_matches(pod):
                 continue
@@ -357,6 +385,13 @@ class KubeBinding:
     # ---- lifecycle ------------------------------------------------------
 
     async def start(self):
+        # Mirror the --watch-config warning: once the binding is active it
+        # owns endpoints/objectives/rewrites — statically-configured entries
+        # (--config-file / --endpoints) are replaced on the first sync.
+        log.warning(
+            "kube binding active: endpoints, objectives and model rewrites "
+            "are now owned by the cluster API — entries from --config-file/"
+            "--endpoints will be overwritten on sync")
         for inf in self._informers:
             await inf.start()
 

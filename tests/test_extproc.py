@@ -46,16 +46,24 @@ def run(coro):
 def test_full_stream_happy_path():
     async def body():
         sess, _ = make_session()
+        # Headers + mid-body chunks are buffered silently; the response is
+        # deferred until scheduling (reference server.go:314-318, 362-363).
         r = await sess.on_request_headers(RequestHeaders(headers={"X-Foo": "1"}))
-        assert isinstance(r, CommonResponse) and r.phase == "request_headers"
+        assert r is None
 
         payload = json.dumps({"model": "m", "prompt": "hello"}).encode()
         r = await sess.on_request_body(RequestBody(payload[:5]))
-        assert r.phase == "request_body" and r.header_mutation is None
+        assert r is None
         r = await sess.on_request_body(RequestBody(payload[5:], end_of_stream=True))
-        dest = r.header_mutation.set_headers["x-gateway-destination-endpoint"]
+        assert isinstance(r, list) and len(r) == 2
+        hdr, body_resp = r
+        assert hdr.phase == "request_headers" and hdr.clear_route_cache
+        dest = hdr.header_mutation.set_headers["x-gateway-destination-endpoint"]
         assert dest.startswith("10.0.0.")
-        assert r.dynamic_metadata["envoy.lb"]["x-gateway-destination-endpoint"] == dest
+        assert hdr.dynamic_metadata["envoy.lb"]["x-gateway-destination-endpoint"] == dest
+        assert body_resp.phase == "request_body" and body_resp.body_eos
+        assert body_resp.body == payload
+        assert hdr.header_mutation.set_headers["content-length"] == str(len(payload))
 
         r = await sess.on_response_headers(ResponseHeaders(headers={}, status=200))
         assert r.header_mutation.set_headers[
@@ -86,7 +94,8 @@ def test_ordering_violations_raise():
         with pytest.raises(ProtocolError):
             await sess.on_request_body(RequestBody(b"x", end_of_stream=True))
         sess2, _ = make_session()
-        await sess2.on_request_headers(RequestHeaders(headers={}))
+        assert await sess2.on_request_headers(
+            RequestHeaders(headers={})) is None
         with pytest.raises(ProtocolError):
             await sess2.on_response_headers(ResponseHeaders(headers={}))
 
@@ -124,6 +133,6 @@ def test_client_injected_routing_header_stripped():
         r = await sess.on_request_body(
             RequestBody(json.dumps({"model": "m", "prompt": "x"}).encode(),
                         end_of_stream=True))
-        assert "x-prefiller-host-port" not in r.header_mutation.set_headers
+        assert "x-prefiller-host-port" not in r[0].header_mutation.set_headers
 
     run(body())

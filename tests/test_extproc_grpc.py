@@ -115,7 +115,7 @@ def _fields(buf: bytes):
 def decode_response(data: bytes) -> dict:
     """Flattens a ProcessingResponse into {oneof, set_headers, body, status}."""
     out = {"oneof": None, "set_headers": {}, "body": None, "status": None,
-           "has_dynamic_metadata": False}
+           "has_dynamic_metadata": False, "body_eos": None}
     names = {1: "request_headers", 2: "response_headers", 3: "request_body",
              4: "response_body", 5: "request_trailers", 6: "response_trailers",
              7: "immediate"}
@@ -126,8 +126,17 @@ def decode_response(data: bytes) -> dict:
                 walk_mutation(v)
             elif f == 3 and w == 2:  # body_mutation
                 for f2, w2, v2 in _fields(v):
-                    if f2 == 1:
+                    if f2 == 1:          # body (buffered mode)
                         out["body"] = v2
+                    elif f2 == 3:        # streamed_response (duplex mode)
+                        chunk, eos = b"", False
+                        for f3, w3, v3 in _fields(v2):
+                            if f3 == 1:
+                                chunk = v3
+                            elif f3 == 2:
+                                eos = bool(v3)
+                        out["body"] = (out["body"] or b"") + chunk
+                        out["body_eos"] = eos
 
     def walk_mutation(buf):
         for f, w, v in _fields(buf):
@@ -217,10 +226,13 @@ modelRewrites:
             assert [r["oneof"] for r in resps] == [
                 "request_headers", "request_body",
                 "response_headers", "response_body"]
-            body_resp = resps[1]
-            assert body_resp["set_headers"][
+            # Deferred headers response carries the destination mutation +
+            # dynamic metadata (server.go:362); the body response carries
+            # the mutated body.
+            hdr_resp, body_resp = resps[0], resps[1]
+            assert hdr_resp["set_headers"][
                 "x-gateway-destination-endpoint"] == f"127.0.0.1:{ENG}"
-            assert body_resp["has_dynamic_metadata"]
+            assert hdr_resp["has_dynamic_metadata"]
             # model rewrite applied on the way in...
             assert json.loads(body_resp["body"])["model"] == "tiny"
             # ...and un-rewritten on the way out (server.go:471-485)
@@ -283,6 +295,62 @@ pool:
             assert resps[0]["oneof"] == "request_headers"
             assert resps[0]["set_headers"][
                 "x-gateway-destination-endpoint"] == f"127.0.0.1:{ENG}"
+        finally:
+            await gw.stop()
+            await eng.stop()
+
+    asyncio.run(body())
+
+
+def test_ext_proc_grpc_body_chunking_round_trip():
+    """A mutated body >64 KB must reach Envoy as ≤62000-byte streamed chunks
+    (Envoy rejects larger streamed chunks; reference chunking.go:24-58):
+    header mutation on the first frame, end_of_stream + dynamic metadata on
+    the last, reassembly byte-identical."""
+    from llm_d_inference_scheduler_tpu.router.handlers.extproc_grpc import (
+        BODY_BYTE_LIMIT,
+    )
+
+    async def body():
+        eng = EngineServer(EngineConfig(backend="sim", model="tiny", port=ENG,
+                                        sim_decode_ms_per_token=1.0))
+        await eng.start()
+        gw = build_gateway(f"""
+pool:
+  endpoints:
+    - {{address: 127.0.0.1, port: {ENG}}}
+""", port=GW, poll_interval=0.02, grpc_ext_proc_port=0)
+        await gw.start()
+        try:
+            req = json.dumps({"model": "tiny",
+                              "prompt": "long " * 30000,   # ~150 KB
+                              "max_tokens": 1}).encode()
+            assert len(req) > 2 * BODY_BYTE_LIMIT
+            # Inbound side is chunked too (Envoy streams the request body).
+            in_chunks = [req[i:i + BODY_BYTE_LIMIT]
+                         for i in range(0, len(req), BODY_BYTE_LIMIT)]
+            frames = [req_headers_frame({":path": "/v1/completions"})]
+            frames += [req_body_frame(c, eos=False) for c in in_chunks[:-1]]
+            frames.append(req_body_frame(in_chunks[-1], eos=True))
+            async with grpc.aio.insecure_channel(
+                    f"127.0.0.1:{gw.grpc_ext_proc.port}") as ch:
+                resps = await _call(ch, frames)
+            # Deferred headers response first (destination + metadata)...
+            assert resps[0]["oneof"] == "request_headers"
+            assert resps[0]["set_headers"][
+                "x-gateway-destination-endpoint"] == f"127.0.0.1:{ENG}"
+            assert resps[0]["has_dynamic_metadata"]
+            # ...then the mutated body as ≤62000-byte streamed chunks.
+            body_frames = [r for r in resps if r["oneof"] == "request_body"
+                           and r["body"] is not None]
+            assert len(body_frames) == len(in_chunks) >= 3
+            assert all(not f["set_headers"] for f in body_frames)
+            # end_of_stream on the last chunk only.
+            assert [f["body_eos"] for f in body_frames] == \
+                [False] * (len(body_frames) - 1) + [True]
+            # Chunk sizes respect the limit; reassembly is byte-identical.
+            assert all(len(f["body"]) <= BODY_BYTE_LIMIT for f in body_frames)
+            assert b"".join(f["body"] for f in body_frames) == req
         finally:
             await gw.stop()
             await eng.stop()

@@ -111,9 +111,13 @@ class FakeKube:
             await self.runner.cleanup()
 
 
-def pod(name: str, ip: str, labels: dict, phase: str = "Running") -> dict:
+def pod(name: str, ip: str, labels: dict, phase: str = "Running",
+        ready: bool = True) -> dict:
     return {"metadata": {"name": name, "labels": labels},
-            "status": {"podIP": ip, "phase": phase}}
+            "status": {"podIP": ip, "phase": phase,
+                       "conditions": [{"type": "Ready",
+                                       "status": "True" if ready
+                                       else "False"}]}}
 
 
 async def eventually(predicate, timeout=5.0, what=""):
@@ -144,6 +148,10 @@ def test_kube_binding_converges_and_tracks_watches(fake):
         fake.upsert(PODS, pod("other", "10.9.9.9", {"app": "unrelated"}))
         fake.upsert(PODS, pod("pending", "", {"app": "llmd"},
                               phase="Pending"))
+        # Running but NOT Ready (still loading weights / failing its
+        # readiness probe) — must not receive traffic (pod_reconciler.go:92).
+        fake.upsert(PODS, pod("warming", "10.0.0.7", {"app": "llmd"},
+                              ready=False))
         fake.upsert(OBJS, {"metadata": {"name": "premium"},
                            "spec": {"priority": 10}})
         fake.upsert(REWRITES, {
@@ -167,7 +175,14 @@ def test_kube_binding_converges_and_tracks_watches(fake):
             assert ds.objective_get("premium").priority == 10
             assert ds.rewrite_for("base") is not None
 
-            # Watch: pod add / delete propagate.
+            # Watch: pod add / delete propagate; a pod turning Ready joins.
+            fake.upsert(PODS, pod("warming", "10.0.0.7", {"app": "llmd"}))
+            await eventually(lambda: len(ds.endpoint_list()) == 3,
+                             what="pod turning Ready via watch")
+            fake.upsert(PODS, pod("warming", "10.0.0.7", {"app": "llmd"},
+                                  ready=False))
+            await eventually(lambda: len(ds.endpoint_list()) == 2,
+                             what="pod turning unready via watch")
             fake.upsert(PODS, pod("d2", "10.0.0.3", {"app": "llmd"}))
             await eventually(lambda: len(ds.endpoint_list()) == 3,
                              what="pod add via watch")
