@@ -70,7 +70,7 @@ class Gateway:
                  grpc_ext_proc_port: int | None = None,
                  lease_path: str | None = None,
                  config_watch_path: str | None = None,
-                 kube_binding=None):
+                 kube_binding=None, kube_elector=None):
         self.cfg = cfg
         self.datastore = datastore
         self.dl_runtime = dl_runtime
@@ -142,7 +142,11 @@ class Gateway:
         # reference runner.go:306-316 lease election with readiness coupling,
         # pkg/epp/controller reconcilers).
         self.elector = None
-        if lease_path is not None:
+        if kube_elector is not None:
+            # coordination.k8s.io/v1 Lease election (reference
+            # controller_manager.go:84-91) — no shared volume required.
+            self.elector = kube_elector
+        elif lease_path is not None:
             from .controlplane import LeaseConfig, LeaseElector
 
             self.elector = LeaseElector(LeaseConfig(path=lease_path))
@@ -540,25 +544,42 @@ def build_gateway(config_text: str | None, *, host: str = "127.0.0.1",
         if hasattr(plugin, "endpoint_added") or hasattr(plugin, "endpoint_removed"):
             dl_runtime.register_lifecycle(plugin)
     kube_binding = None
-    if kube:
+    # Endpoint discovery needs a pool to scope the pod selector; a kube dict
+    # without one is lease-only (HA election against the API server while
+    # endpoints still come from the config file).
+    if kube and (kube.get("pool_name") or kube.get("discover_pods")):
         from .kube import KubeApiClient, KubeBinding
 
         if config_watch_path is not None:
             # Two writers calling datastore.resync() would flap the endpoint
             # set between the file pool and the k8s pool on every event.
             log.warning("--watch-config ignored: the k8s binding owns the "
-                        "endpoint set when --kube-api-url is given")
+                        "endpoint set when --kube-pool-name is given")
             config_watch_path = None
         client = KubeApiClient(kube["api_url"],
                                token_path=kube.get("token_path"))
         kube_binding = KubeBinding(datastore, client,
                                    kube.get("namespace", "default"),
                                    pool_name=kube.get("pool_name"))
+    kube_elector = None
+    if kube and kube.get("lease_name"):
+        from .kube import KubeApiClient, KubeLeaseElector
+
+        if lease_path is not None:
+            log.warning("--ha-lease-path ignored: Lease-object election "
+                        "active (--kube-lease-name)")
+            lease_path = None
+        # Separate client: the elector must keep renewing even when the
+        # informers' connection pool is saturated mid-relist.
+        kube_elector = KubeLeaseElector(
+            KubeApiClient(kube["api_url"], token_path=kube.get("token_path")),
+            kube.get("namespace", "default"), kube["lease_name"])
     return Gateway(cfg, datastore, dl_runtime, host=host, port=port,
                    grpc_health_port=grpc_health_port,
                    grpc_ext_proc_port=grpc_ext_proc_port,
                    kube_binding=kube_binding,
                    lease_path=lease_path,
+                   kube_elector=kube_elector,
                    config_watch_path=config_watch_path)
 
 
@@ -594,6 +615,11 @@ def main(argv: list[str] | None = None):
     p.add_argument("--kube-token-path", default=None,
                    help="bearer token file (defaults to the in-cluster "
                         "service-account path when unset)")
+    p.add_argument("--kube-lease-name", default=None,
+                   help="coordination.k8s.io/v1 Lease name for HA leader "
+                        "election (reference id shape: "
+                        "epp-<ns>-<pool>.llm-d.ai); requires --kube-api-url "
+                        "and supersedes --ha-lease-path")
     args = p.parse_args(argv)
 
     text = args.config_text
@@ -608,7 +634,10 @@ def main(argv: list[str] | None = None):
         kube = {"api_url": args.kube_api_url,
                 "namespace": args.kube_namespace,
                 "pool_name": args.kube_pool_name,
+                "lease_name": args.kube_lease_name,
                 "token_path": args.kube_token_path or DEFAULT_TOKEN_PATH}
+    elif args.kube_lease_name:
+        p.error("--kube-lease-name requires --kube-api-url")
     gw = build_gateway(text, host=args.host, port=args.port,
                        grpc_health_port=args.grpc_health_port,
                        grpc_ext_proc_port=args.grpc_ext_proc_port,

@@ -30,13 +30,19 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import datetime
 import json
 import logging
+import os
+import random
+import time
+import uuid
 from typing import Any, Callable
 
 log = logging.getLogger("router.kube")
 
 DEFAULT_TOKEN_PATH = "/var/run/secrets/kubernetes.io/serviceaccount/token"
+DEFAULT_CA_PATH = "/var/run/secrets/kubernetes.io/serviceaccount/ca.crt"
 CRD_GROUP = "llm-d.ai"
 CRD_VERSION = "v1alpha2"
 
@@ -49,7 +55,7 @@ class KubeApiClient:
     """Minimal k8s REST client: list + watch with bearer-token auth."""
 
     def __init__(self, base_url: str, token: str | None = None,
-                 token_path: str | None = None):
+                 token_path: str | None = None, ca_path: str | None = None):
         self.base_url = base_url.rstrip("/")
         if token is None and token_path:
             try:
@@ -58,6 +64,13 @@ class KubeApiClient:
             except OSError:
                 token = None
         self._token = token
+        # In-cluster API servers present a cert signed by the cluster CA,
+        # which is NOT in the system trust store — it is mounted beside the
+        # service-account token. Without loading it every https request
+        # fails certificate verification.
+        if ca_path is None and os.path.exists(DEFAULT_CA_PATH):
+            ca_path = DEFAULT_CA_PATH
+        self._ca_path = ca_path
         self._session = None
 
     async def _ensure_session(self):
@@ -67,10 +80,17 @@ class KubeApiClient:
             headers = {}
             if self._token:
                 headers["Authorization"] = f"Bearer {self._token}"
+            connector = None
+            if self.base_url.startswith("https") and self._ca_path:
+                import ssl
+
+                connector = aiohttp.TCPConnector(
+                    ssl=ssl.create_default_context(cafile=self._ca_path))
             # Watch frames for real pods (managedFields etc.) routinely
             # exceed aiohttp's default 64 KiB line buffer; a small buffer
             # turns every large event into a permanent relist loop.
             self._session = aiohttp.ClientSession(headers=headers,
+                                                  connector=connector,
                                                   read_bufsize=2 ** 22)
         return self._session
 
@@ -78,6 +98,36 @@ class KubeApiClient:
         if self._session is not None:
             await self._session.close()
             self._session = None
+
+    # ---- object verbs (lease election + future writes) ------------------
+
+    async def get(self, path: str) -> tuple[int, dict | None]:
+        """GET a single object; returns (status, body-or-None)."""
+        session = await self._ensure_session()
+        async with session.get(self.base_url + path) as resp:
+            if resp.status == 404:
+                return 404, None
+            resp.raise_for_status()
+            return resp.status, await resp.json()
+
+    async def create(self, path: str, obj: dict) -> tuple[int, dict | None]:
+        """POST to a collection; 409 means the object already exists."""
+        session = await self._ensure_session()
+        async with session.post(self.base_url + path, json=obj) as resp:
+            if resp.status == 409:
+                return 409, None
+            resp.raise_for_status()
+            return resp.status, await resp.json()
+
+    async def replace(self, path: str, obj: dict) -> tuple[int, dict | None]:
+        """PUT an object; 409 means the resourceVersion precondition failed
+        (another writer won — k8s optimistic concurrency)."""
+        session = await self._ensure_session()
+        async with session.put(self.base_url + path, json=obj) as resp:
+            if resp.status in (404, 409):
+                return resp.status, None
+            resp.raise_for_status()
+            return resp.status, await resp.json()
 
     async def list(self, path: str,
                    label_selector: str | None = None) -> tuple[list[dict], str]:
@@ -403,4 +453,210 @@ class KubeBinding:
     async def stop(self):
         for inf in self._informers:
             await inf.stop()
+        await self.client.close()
+
+
+# ---- coordination.k8s.io/v1 Lease leader election -----------------------
+
+
+def _micro_time(ts: float) -> str:
+    """k8s MicroTime format (RFC3339 with microseconds, UTC)."""
+    return (datetime.datetime.fromtimestamp(ts, datetime.timezone.utc)
+            .strftime("%Y-%m-%dT%H:%M:%S.%fZ"))
+
+
+def _parse_micro_time(s: str) -> float:
+    try:
+        return (datetime.datetime
+                .strptime(s, "%Y-%m-%dT%H:%M:%S.%fZ")
+                .replace(tzinfo=datetime.timezone.utc).timestamp())
+    except ValueError:
+        try:
+            return (datetime.datetime
+                    .strptime(s, "%Y-%m-%dT%H:%M:%SZ")
+                    .replace(tzinfo=datetime.timezone.utc).timestamp())
+        except ValueError:
+            return 0.0
+
+
+class KubeLeaseElector:
+    """Leader election over a coordination.k8s.io/v1 Lease object — the
+    reference's election backend (controller_manager.go:84-91: lease id
+    ``epp-<ns>-<name>.llm-d.ai``, leader-elect resource lock). Replaces the
+    file-based LeaseElector when a kube API is available, removing the
+    RWX-volume deployment constraint.
+
+    client-go LeaderElector semantics (leaderelection.go): acquire creates
+    the Lease (POST; 409 → someone else won); renew PUTs renewTime
+    periodically; takeover rewrites holderIdentity + bumps leaseTransitions
+    once ``renewTime + leaseDurationSeconds`` has passed; every write is
+    guarded by the object's resourceVersion so concurrent claimants race
+    safely; graceful release shortens the lease so followers take over
+    immediately.
+    """
+
+    def __init__(self, client: KubeApiClient, namespace: str, name: str,
+                 holder_id: str | None = None,
+                 lease_duration_s: float = 5.0,
+                 renew_interval_s: float = 1.0,
+                 renew_deadline_s: float | None = None,
+                 on_started_leading: Callable[[], None] | None = None,
+                 on_stopped_leading: Callable[[], None] | None = None):
+        self.client = client
+        self.namespace = namespace
+        self.name = name
+        self.holder_id = holder_id or os.environ.get(
+            "POD_NAME") or f"epp-{uuid.uuid4().hex[:8]}"
+        self.lease_duration_s = lease_duration_s
+        self.renew_interval_s = renew_interval_s
+        # How long a leader keeps leading through failed renews before
+        # demoting (client-go RenewDeadline, default 2/3 of the lease): one
+        # transient apiserver error must not flip the whole pair unready.
+        self.renew_deadline_s = (renew_deadline_s
+                                 if renew_deadline_s is not None
+                                 else lease_duration_s * 2 / 3)
+        self.is_leader = False
+        self.on_started_leading = on_started_leading
+        self.on_stopped_leading = on_stopped_leading
+        self._task: asyncio.Task | None = None
+        self._rng = random.Random()
+        # Local observation clock for foreign leases (client-go
+        # observedTime): expiry is timed from when WE last saw the lease
+        # record change, never by comparing the remote renewTime timestamp
+        # against the local wall clock — node clock skew larger than the
+        # lease duration would otherwise cause spurious takeover.
+        self._observed_record: tuple | None = None
+        self._observed_at: float = 0.0
+        self._last_renew_ok: float = 0.0
+        self._path = (f"/apis/coordination.k8s.io/v1/namespaces/"
+                      f"{namespace}/leases/{name}")
+        self._collection = (f"/apis/coordination.k8s.io/v1/namespaces/"
+                            f"{namespace}/leases")
+
+    def _spec(self, *, acquire: bool, transitions: int,
+              now: float) -> dict[str, Any]:
+        spec = {"holderIdentity": self.holder_id,
+                "leaseDurationSeconds": int(self.lease_duration_s),
+                "renewTime": _micro_time(now),
+                "leaseTransitions": transitions}
+        if acquire:
+            spec["acquireTime"] = _micro_time(now)
+        return spec
+
+    async def _try_acquire_or_renew(self) -> bool:
+        now = time.time()
+        status, lease = await self.client.get(self._path)
+        if lease is None:
+            status, created = await self.client.create(self._collection, {
+                "apiVersion": "coordination.k8s.io/v1",
+                "kind": "Lease",
+                "metadata": {"name": self.name, "namespace": self.namespace},
+                "spec": self._spec(acquire=True, transitions=0, now=now)})
+            return created is not None  # 409 → lost the creation race
+
+        spec = lease.get("spec") or {}
+        holder = spec.get("holderIdentity")
+        transitions = int(spec.get("leaseTransitions") or 0)
+        if holder == self.holder_id:
+            lease["spec"].update(self._spec(acquire=False,
+                                            transitions=transitions, now=now))
+            status, updated = await self.client.replace(self._path, lease)
+            # 409: our snapshot is stale (e.g. a takeover stole the lease
+            # after our expiry) — demote and re-read next tick.
+            return updated is not None
+
+        duration = float(spec.get("leaseDurationSeconds")
+                         or self.lease_duration_s)
+        record = (holder, spec.get("renewTime"), spec.get("acquireTime"))
+        mono = time.monotonic()
+        if record != self._observed_record:
+            # The holder is renewing — restart OUR observation clock.
+            self._observed_record = record
+            self._observed_at = mono
+            return False
+        if mono - self._observed_at < duration:
+            return False  # live foreign lease (locally-observed freshness)
+        # No renew observed for a full lease duration: take over.
+        # resourceVersion rides along, so if another claimant got there
+        # first the PUT 409s and we stay a follower.
+        lease["spec"].update(self._spec(acquire=True,
+                                        transitions=transitions + 1, now=now))
+        status, updated = await self.client.replace(self._path, lease)
+        if updated is not None:
+            log.info("lease %s: took over from expired holder %s",
+                     self.name, holder)
+        return updated is not None
+
+    def _set_leader(self, leading: bool) -> None:
+        if leading and not self.is_leader:
+            self.is_leader = True
+            log.info("lease %s: %s started leading", self.name, self.holder_id)
+            if self.on_started_leading:
+                self.on_started_leading()
+        elif not leading and self.is_leader:
+            self.is_leader = False
+            log.warning("lease %s: %s stopped leading", self.name,
+                        self.holder_id)
+            if self.on_stopped_leading:
+                self.on_stopped_leading()
+
+    async def release(self) -> None:
+        """Graceful handoff (client-go release): keep holderIdentity but
+        shrink the lease to one second in the past so any follower's next
+        tick sees it expired."""
+        try:
+            status, lease = await self.client.get(self._path)
+            if lease is not None and (lease.get("spec") or {}).get(
+                    "holderIdentity") == self.holder_id:
+                lease["spec"]["renewTime"] = _micro_time(time.time() - 1.0)
+                lease["spec"]["leaseDurationSeconds"] = 1
+                await self.client.replace(self._path, lease)
+        except Exception:
+            log.exception("lease release failed (followers will take over "
+                          "after expiry)")
+        self._set_leader(False)
+
+    async def _run(self):
+        try:
+            while True:
+                try:
+                    leading = await self._try_acquire_or_renew()
+                    if leading:
+                        self._last_renew_ok = time.monotonic()
+                    self._set_leader(leading)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    # API unreachable. A leader retries within the renew
+                    # deadline (a transient apiserver blip must not flip
+                    # the pair unready); past it, demote — a follower may
+                    # legally take over once the lease expires.
+                    if (self.is_leader and time.monotonic()
+                            - self._last_renew_ok < self.renew_deadline_s):
+                        log.warning("lease %s: renew failed; retrying "
+                                    "within renew deadline", self.name)
+                    else:
+                        log.exception("lease %s: renew/acquire failed; "
+                                      "demoting", self.name)
+                        self._set_leader(False)
+                delay = self.renew_interval_s
+                if not self.is_leader:
+                    delay += self._rng.uniform(0, self.renew_interval_s / 2)
+                await asyncio.sleep(delay)
+        except asyncio.CancelledError:
+            pass
+
+    async def start(self):
+        self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self, *, graceful: bool = True):
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        if graceful:
+            await self.release()
         await self.client.close()

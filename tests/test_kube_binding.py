@@ -37,8 +37,12 @@ class FakeKube:
         self.force_gone: set[str] = set()
         self.app = web.Application()
         self.app.router.add_get("/{tail:.*}", self.handle)
+        self.app.router.add_post("/{tail:.*}", self.handle_create)
+        self.app.router.add_put("/{tail:.*}", self.handle_replace)
         self.runner = None
         self.port = None
+        # Optional failure injection for write verbs (lease tests).
+        self.fail_writes = False
 
     def _bump(self) -> int:
         self.rv += 1
@@ -67,9 +71,51 @@ class FakeKube:
         for q in self.subscribers.get(path, []):
             q.put_nowait((rv, etype, obj))
 
+    async def handle_create(self, request: web.Request) -> web.Response:
+        """POST to a collection: 409 when the named object exists (k8s
+        AlreadyExists), else store with a fresh resourceVersion."""
+        if self.fail_writes:
+            return web.Response(status=500)
+        path = "/" + request.match_info["tail"]
+        obj = await request.json()
+        name = (obj.get("metadata") or {}).get("name")
+        if name in self.store.get(path, {}):
+            return web.json_response({"reason": "AlreadyExists"}, status=409)
+        self.upsert(path, obj)
+        return web.json_response(self.store[path][name], status=201)
+
+    async def handle_replace(self, request: web.Request) -> web.Response:
+        """PUT an object: resourceVersion must match the stored one (k8s
+        optimistic concurrency), else 409 Conflict."""
+        if self.fail_writes:
+            return web.Response(status=500)
+        tail = request.match_info["tail"]
+        path, _, name = ("/" + tail).rpartition("/")
+        obj = await request.json()
+        current = self.store.get(path, {}).get(name)
+        if current is None:
+            return web.Response(status=404)
+        sent_rv = (obj.get("metadata") or {}).get("resourceVersion")
+        if sent_rv != current["metadata"]["resourceVersion"]:
+            return web.json_response({"reason": "Conflict"}, status=409)
+        self.upsert(path, obj)
+        return web.json_response(self.store[path][name])
+
+    # Paths whose LAST segment is one of these are collection list/watch
+    # requests; anything deeper is a single-object GET.
+    COLLECTIONS = ("pods", "inferencepools", "inferenceobjectives",
+                   "inferencemodelrewrites", "leases")
+
     async def handle(self, request: web.Request) -> web.StreamResponse:
         path = "/" + request.match_info["tail"]
         if request.query.get("watch") != "true":
+            if path.rsplit("/", 1)[-1] not in self.COLLECTIONS:
+                # Single-object GET (e.g. …/leases/<name>).
+                coll, _, name = path.rpartition("/")
+                obj = self.store.get(coll, {}).get(name)
+                if obj is None:
+                    return web.Response(status=404)
+                return web.json_response(obj)
             items = list(self.store.get(path, {}).values())
             return web.json_response({
                 "items": items,
@@ -307,5 +353,231 @@ def test_gateway_routes_to_kube_discovered_endpoints(fake):
             await gw.stop()
             await fake.stop()
             await eng.stop()
+
+    asyncio.run(run())
+
+
+# ---- coordination.k8s.io/v1 Lease leader election -----------------------
+
+
+def make_lease_elector(fake, holder, **kw):
+    from llm_d_inference_scheduler_tpu.router.kube import KubeLeaseElector
+
+    client = KubeApiClient(f"http://127.0.0.1:{fake.port}")
+    return KubeLeaseElector(client, NS, "epp-llmd-pool.llm-d.ai",
+                            holder_id=holder,
+                            lease_duration_s=kw.pop("lease_duration_s", 0.6),
+                            renew_interval_s=kw.pop("renew_interval_s", 0.1),
+                            **kw)
+
+
+LEASES = f"/apis/coordination.k8s.io/v1/namespaces/{NS}/leases"
+
+
+def test_kube_lease_acquire_renew_and_follower(fake):
+    """First claimant creates the Lease and leads; a second stays follower
+    while the lease is live; renewTime advances on the wire."""
+    async def run():
+        await fake.start()
+        a = make_lease_elector(fake, "epp-a")
+        b = make_lease_elector(fake, "epp-b")
+        try:
+            await a.start()
+            await eventually(lambda: a.is_leader, what="a acquires")
+            lease = fake.store[LEASES]["epp-llmd-pool.llm-d.ai"]
+            assert lease["spec"]["holderIdentity"] == "epp-a"
+            assert lease["spec"]["leaseTransitions"] == 0
+            first_renew = lease["spec"]["renewTime"]
+            await b.start()
+            await asyncio.sleep(0.4)
+            assert not b.is_leader and a.is_leader
+            lease = fake.store[LEASES]["epp-llmd-pool.llm-d.ai"]
+            assert lease["spec"]["renewTime"] > first_renew  # renewing
+        finally:
+            await a.stop()
+            await b.stop()
+            await fake.stop()
+
+    asyncio.run(run())
+
+
+def test_kube_lease_expiry_takeover_and_transitions(fake):
+    """Killing the leader non-gracefully lets the follower take over after
+    leaseDurationSeconds, bumping leaseTransitions (client-go takeover)."""
+    async def run():
+        await fake.start()
+        a = make_lease_elector(fake, "epp-a")
+        b = make_lease_elector(fake, "epp-b")
+        try:
+            await a.start()
+            await eventually(lambda: a.is_leader, what="a acquires")
+            await b.start()
+            await asyncio.sleep(0.25)
+            assert not b.is_leader
+            # Crash a: no graceful release — b must wait out the expiry.
+            await a.stop(graceful=False)
+            await eventually(lambda: b.is_leader, timeout=5.0,
+                             what="takeover after expiry")
+            lease = fake.store[LEASES]["epp-llmd-pool.llm-d.ai"]
+            assert lease["spec"]["holderIdentity"] == "epp-b"
+            assert lease["spec"]["leaseTransitions"] == 1
+        finally:
+            await a.stop()
+            await b.stop()
+            await fake.stop()
+
+    asyncio.run(run())
+
+
+def test_kube_lease_graceful_release_fast_handoff(fake):
+    """Graceful stop shortens the lease so the follower takes over on its
+    next tick instead of waiting a full leaseDuration."""
+    async def run():
+        await fake.start()
+        a = make_lease_elector(fake, "epp-a", lease_duration_s=30.0)
+        b = make_lease_elector(fake, "epp-b", lease_duration_s=30.0)
+        try:
+            await a.start()
+            await eventually(lambda: a.is_leader, what="a acquires")
+            await b.start()
+            await asyncio.sleep(0.25)
+            assert not b.is_leader
+            await a.stop(graceful=True)  # release: 30 s lease would block b
+            await eventually(lambda: b.is_leader, timeout=3.0,
+                             what="fast handoff after release")
+        finally:
+            await a.stop()
+            await b.stop()
+            await fake.stop()
+
+    asyncio.run(run())
+
+
+def test_kube_lease_demotes_when_api_unreachable(fake):
+    """A leader that cannot renew must drop leadership (its lease may have
+    been taken over) — readiness flips, the pair cannot split-brain."""
+    async def run():
+        await fake.start()
+        a = make_lease_elector(fake, "epp-a")
+        try:
+            await a.start()
+            await eventually(lambda: a.is_leader, what="a acquires")
+            fake.fail_writes = True
+            await eventually(lambda: not a.is_leader, timeout=3.0,
+                             what="demote on renew failure")
+            fake.fail_writes = False
+            await eventually(lambda: a.is_leader, timeout=3.0,
+                             what="re-acquire after API recovers")
+        finally:
+            await a.stop()
+            await fake.stop()
+
+    asyncio.run(run())
+
+
+def test_gateway_ha_pair_via_kube_lease(fake):
+    """Two gateways with lease-only kube config (endpoints from static
+    config): only the Lease holder reports ready; killing it promotes the
+    follower — the reference's HA disruption semantics without any shared
+    volume (controller_manager.go:84-91)."""
+    from llm_d_inference_scheduler_tpu.router.gateway import build_gateway
+    from llm_d_inference_scheduler_tpu.router.kube import KubeLeaseElector
+
+    async def run():
+        await fake.start()
+        cfg = """
+pool:
+  endpoints:
+    - {address: 127.0.0.1, port: 19999}
+"""
+        gws = []
+        for port in (18880, 18881):
+            gw = build_gateway(
+                cfg, port=port, poll_interval=0.05,
+                kube={"api_url": f"http://127.0.0.1:{fake.port}",
+                      "namespace": NS,
+                      "lease_name": "epp-llmd-pool.llm-d.ai"})
+            assert isinstance(gw.elector, KubeLeaseElector)
+            assert gw.kube_binding is None  # lease-only: config owns pool
+            gw.elector.lease_duration_s = 0.6
+            gw.elector.renew_interval_s = 0.1
+            await gw.start()
+            gws.append(gw)
+        try:
+            import aiohttp
+
+            async def ready(port):
+                async with aiohttp.ClientSession() as s:
+                    async with s.get(f"http://127.0.0.1:{port}/health") as r:
+                        return r.status == 200
+
+            await eventually(
+                lambda: sum(gw.elector.is_leader for gw in gws) == 1,
+                what="exactly one leader")
+            leader = next(gw for gw in gws if gw.elector.is_leader)
+            follower = next(gw for gw in gws if not gw.elector.is_leader)
+            assert await ready(leader.port)
+            assert not await ready(follower.port)
+            # Disruption: leader dies without a graceful release.
+            await leader.elector.stop(graceful=False)
+            leader.elector = None  # detach so gw.stop() doesn't double-stop
+            await eventually(lambda: follower.elector.is_leader, timeout=5.0,
+                             what="follower promoted after leader loss")
+            assert await ready(follower.port)
+        finally:
+            for gw in gws:
+                await gw.stop()
+            await fake.stop()
+
+    asyncio.run(run())
+
+
+def test_kube_lease_skewed_holder_clock_no_spurious_takeover(fake):
+    """A live holder whose wall clock is far behind (renewTime 'expired' by
+    local reckoning) must NOT be stolen from while its renews keep landing:
+    expiry is timed from the local observation of lease changes (client-go
+    observedTime), not from comparing remote timestamps to the local
+    clock."""
+    import time as _time
+
+    from llm_d_inference_scheduler_tpu.router.kube import _micro_time
+
+    async def run():
+        await fake.start()
+        name = "epp-llmd-pool.llm-d.ai"
+        skew = -3600.0  # holder's clock is an hour behind
+
+        def skewed_renew():
+            lease = fake.store.get(LEASES, {}).get(name)
+            spec = {"holderIdentity": "epp-skewed",
+                    "leaseDurationSeconds": 1,
+                    "renewTime": _micro_time(_time.time() + skew),
+                    "leaseTransitions": 0}
+            if lease is None:
+                fake.upsert(LEASES, {"metadata": {"name": name},
+                                     "spec": spec})
+            else:
+                lease["spec"].update(spec)
+                fake.upsert(LEASES, lease)
+
+        skewed_renew()
+        b = make_lease_elector(fake, "epp-b", lease_duration_s=1.0,
+                               renew_interval_s=0.1)
+        try:
+            await b.start()
+            # Keep the skewed holder renewing faster than its 1 s lease.
+            for _ in range(10):
+                await asyncio.sleep(0.2)
+                skewed_renew()
+                assert not b.is_leader, "stole a live (skewed) lease"
+            holder = fake.store[LEASES][name]["spec"]["holderIdentity"]
+            assert holder == "epp-skewed"
+            # Once the skewed holder really stops, b takes over on the
+            # locally-observed expiry.
+            await eventually(lambda: b.is_leader, timeout=5.0,
+                             what="takeover after real death")
+        finally:
+            await b.stop()
+            await fake.stop()
 
     asyncio.run(run())

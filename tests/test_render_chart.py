@@ -29,7 +29,18 @@ def test_default_render_topology():
     assert ("Deployment", "tpu-pool-decode") in docs
     assert ("Deployment", "tpu-pool-prefill") in docs
     assert ("ConfigMap", "tpu-pool-epp-config") in docs
-    assert ("PersistentVolumeClaim", "tpu-pool-epp-lease") in docs
+    # HA via coordination.k8s.io Lease: RBAC instead of a shared volume.
+    assert ("Role", "tpu-pool-epp") in docs
+    assert ("RoleBinding", "tpu-pool-epp") in docs
+    assert ("PersistentVolumeClaim", "tpu-pool-epp-lease") not in docs
+    epp = docs[("Deployment", "tpu-pool-epp")]
+    args = epp["spec"]["template"]["spec"]["containers"][0]["args"]
+    assert any("--kube-lease-name=epp-" in a for a in args)
+    assert not any(v.get("persistentVolumeClaim") for v in
+                   epp["spec"]["template"]["spec"].get("volumes", []))
+    lease_rule = next(r for r in docs[("Role", "tpu-pool-epp")]["rules"]
+                      if "leases" in r["resources"])
+    assert set(lease_rule["verbs"]) == {"get", "create", "update"}
     assert ("Deployment", "tpu-pool-encode") not in docs  # disabled default
     # Embedded EndpointPickerConfig is itself valid YAML.
     cfg = yaml.safe_load(
@@ -51,7 +62,7 @@ def test_overrides_and_dp_ranks():
     }))
     assert ("Deployment", "prod-prefill") not in docs
     assert ("Deployment", "prod-encode") in docs
-    assert ("PersistentVolumeClaim", "prod-epp-lease") not in docs  # ha off
+    assert ("Role", "prod-epp") not in docs  # ha off → no lease RBAC
     dec = docs[("Deployment", "prod-decode")]
     assert dec["spec"]["replicas"] == 8
     containers = dec["spec"]["template"]["spec"]["containers"]
@@ -60,10 +71,10 @@ def test_overrides_and_dp_ranks():
     # Rank port arithmetic: engine i listens on 8200+i.
     ports = [c["args"] for c in containers[1:]]
     assert ["--port=8203" in a for a in ports][3]
-    # epp args drop the lease flag when HA is off.
+    # epp args drop the lease flags when HA is off.
     epp = docs[("Deployment", "prod-epp")]
     args = epp["spec"]["template"]["spec"]["containers"][0]["args"]
-    assert not any("ha-lease-path" in a for a in args)
+    assert not any("kube-lease-name" in a for a in args)
 
 
 def test_cli_set_overrides(tmp_path, capsys):
