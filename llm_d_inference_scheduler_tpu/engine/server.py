@@ -92,6 +92,27 @@ def _chat_to_prompt(messages: list[dict[str, Any]], *,
     return "\n".join(parts)
 
 
+def _responses_input_to_messages(body: dict[str, Any]) -> list[dict[str, Any]]:
+    """Map a Responses API body to chat messages: ``instructions`` is the
+    system turn; ``input`` is a user string or an array of message items
+    (reference ResponsesRequest, types.go:326-343 — Input is string|items)."""
+    messages: list[dict[str, Any]] = []
+    instructions = body.get("instructions")
+    if isinstance(instructions, str) and instructions:
+        messages.append({"role": "system", "content": instructions})
+    inp = body.get("input")
+    if isinstance(inp, str):
+        messages.append({"role": "user", "content": inp})
+    elif isinstance(inp, list):
+        for item in inp:
+            if isinstance(item, str):
+                messages.append({"role": "user", "content": item})
+            elif isinstance(item, dict) and item.get("type") in (None, "message"):
+                messages.append({"role": item.get("role", "user"),
+                                 "content": item.get("content") or ""})
+    return messages
+
+
 class EngineServer:
     def __init__(self, cfg: EngineConfig, engine=None):
         self.cfg = cfg
@@ -100,6 +121,7 @@ class EngineServer:
         self.app.add_routes([
             web.post("/v1/completions", self.completions),
             web.post("/v1/chat/completions", self.chat_completions),
+            web.post("/v1/responses", self.responses),
             web.post("/v1/completions/render", self.render_completions),
             web.post("/v1/chat/completions/render", self.render_chat),
             web.get("/v1/models", self.models),
@@ -383,6 +405,93 @@ class EngineServer:
         text = resp["choices"][0].pop("text")
         resp["choices"][0]["message"] = {"role": "assistant", "content": text}
         return web.json_response(resp)
+
+    async def responses(self, request: web.Request) -> web.StreamResponse:
+        """OpenAI Responses API (/v1/responses). The reference's engines are
+        vLLM, which serves this natively and the sidecar routes it through
+        the disagg protocol with ``max_output_tokens`` in place of
+        ``max_tokens`` (reference proxy.go:48,391-408); this engine accepts
+        the same surface: string-or-item-array ``input``, ``instructions``,
+        P/D ``kv_transfer_params`` relay, and a Responses-shaped reply with
+        input/output token usage."""
+        body = await _json_body(request)
+        messages = _responses_input_to_messages(body)
+        prompt_ids = self.engine.tokenizer.encode(_chat_to_prompt(messages))
+        gen_body = dict(body)
+        if body.get("max_output_tokens") is not None:
+            gen_body["max_tokens"] = body["max_output_tokens"]
+        req = self._build_request(gen_body, prompt_ids)
+        out = self.engine.submit(req)
+        try:
+            if req.stream:
+                return await self._stream_responses_api(request, req, out)
+            resp = await self._collect(req, out, [])
+        except (asyncio.CancelledError, ConnectionResetError):
+            self.engine.abort(req.request_id)
+            raise
+        usage = resp["usage"]
+        finish = resp["choices"][0]["finish_reason"]
+        wrapped: dict[str, Any] = {
+            "id": f"resp_{req.request_id}",
+            "object": "response",
+            "created_at": resp["created"],
+            "status": ("incomplete" if finish in ("length", "cache_threshold")
+                       else "completed"),
+            "model": self.engine.model_name,
+            "output": [{
+                "type": "message", "id": f"msg_{req.request_id}",
+                "status": "completed", "role": "assistant",
+                "content": [{"type": "output_text", "annotations": [],
+                             "text": resp["choices"][0]["text"]}],
+            }],
+            "usage": {"input_tokens": usage["prompt_tokens"],
+                      "output_tokens": usage["completion_tokens"],
+                      "total_tokens": usage["total_tokens"]},
+        }
+        if wrapped["status"] == "incomplete":
+            # The sidecar's shared-storage probe reads the truncation cause
+            # from here (a Responses body has no choices[].finish_reason).
+            wrapped["incomplete_details"] = {
+                "reason": ("max_output_tokens" if finish == "length"
+                           else finish)}
+        if "kv_transfer_params" in resp:
+            wrapped["kv_transfer_params"] = resp["kv_transfer_params"]
+        return web.json_response(wrapped)
+
+    async def _stream_responses_api(self, request: web.Request,
+                                    req: EngineRequest,
+                                    out: asyncio.Queue) -> web.StreamResponse:
+        """Responses API streaming: semantic SSE events
+        (response.output_text.delta … response.completed)."""
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+        })
+        await resp.prepare(request)
+        n_prompt = len(req.prompt_token_ids)
+        while True:
+            ev: TokenEvent = await out.get()
+            if ev.token_id is not None and ev.text:
+                frame = {"type": "response.output_text.delta",
+                         "delta": ev.text}
+                await resp.write(f"data: {json.dumps(frame)}\n\n".encode())
+            if ev.finish_reason is not None:
+                prompt_tokens = ev.prompt_tokens or n_prompt
+                status = ("incomplete"
+                          if ev.finish_reason == FinishReason.LENGTH
+                          else "completed")
+                done = {"type": "response.completed", "response": {
+                    "id": f"resp_{req.request_id}", "object": "response",
+                    "status": status, "model": self.engine.model_name,
+                    "usage": {"input_tokens": prompt_tokens,
+                              "output_tokens": ev.completion_tokens,
+                              "total_tokens": (prompt_tokens
+                                               + ev.completion_tokens)}}}
+                await resp.write(f"data: {json.dumps(done)}\n\n".encode())
+                await resp.write(b"data: [DONE]\n\n")
+                break
+        await resp.write_eof()
+        return resp
 
     async def render_completions(self, request: web.Request) -> web.Response:
         body = await _json_body(request)

@@ -177,11 +177,30 @@ def instantiate(raw: RawConfig, handle: Handle,
     resp_streaming = [p for p in plugins_by_name.values() if hasattr(p, "response_streaming")]
     resp_complete = [p for p in plugins_by_name.values() if hasattr(p, "response_complete")]
 
-    # Data layer defaults: metrics source + core extractor unless disabled.
-    inject_dl = (raw.data_layer.get("injectDefaults", True)
-                 if isinstance(raw.data_layer, dict) else True)
-    if handle.dl_runtime is not None and inject_dl:
-        if not handle.dl_runtime.sources:
+    # Data layer: wire declared source→extractor pairs (reference
+    # dataLayer.sources, configloader.go), register every declared data
+    # source plugin, then inject the default metrics source unless disabled.
+    if handle.dl_runtime is not None:
+        dl_spec = raw.data_layer if isinstance(raw.data_layer, dict) else {}
+        for src_spec in dl_spec.get("sources") or []:
+            src = plugins_by_name.get(src_spec.get("pluginRef"))
+            if src is None:
+                raise ValueError(f"dataLayer source references unknown plugin "
+                                 f"{src_spec.get('pluginRef')!r}")
+            for ex_ref in src_spec.get("extractors") or []:
+                ex_name = (ex_ref.get("pluginRef")
+                           if isinstance(ex_ref, dict) else ex_ref)
+                ex = plugins_by_name.get(ex_name)
+                if ex is None:
+                    raise ValueError(f"dataLayer extractor references unknown "
+                                     f"plugin {ex_name!r}")
+                src.add_extractor(ex)
+        for plugin in plugins_by_name.values():
+            if hasattr(plugin, "collect") and hasattr(plugin, "extractors"):
+                handle.dl_runtime.register_source(plugin)
+        inject_dl = dl_spec.get("injectDefaults", True)
+        if inject_dl and not any(isinstance(s, MetricsDataSource)
+                                 for s in handle.dl_runtime.sources):
             src = MetricsDataSource("metrics-data-source")
             src.add_extractor(CoreMetricsExtractor("core-metrics-extractor"))
             handle.dl_runtime.register_source(src)

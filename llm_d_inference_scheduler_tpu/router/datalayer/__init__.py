@@ -1,8 +1,14 @@
 from .datastore import Datastore, EndpointPool
 from .runtime import DataLayerRuntime
 from .metrics_source import MetricsDataSource
+from .models_source import (
+    MODELS_ATTRIBUTE_KEY,
+    ModelsDataExtractor,
+    ModelsDataSource,
+)
 from .extractor import CoreMetricsExtractor, MappingRegistry
 from .data_graph import validate_and_order_producers
 
 __all__ = ["Datastore", "EndpointPool", "DataLayerRuntime", "MetricsDataSource",
+           "ModelsDataSource", "ModelsDataExtractor", "MODELS_ATTRIBUTE_KEY",
            "CoreMetricsExtractor", "MappingRegistry", "validate_and_order_producers"]

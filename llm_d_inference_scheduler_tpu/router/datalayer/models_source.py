@@ -1,0 +1,115 @@
+"""models-data-source / models-data-extractor: poll each endpoint's
+/v1/models into an endpoint attribute for model-aware routing.
+
+Reference: framework/plugins/datalayer/source/models (GET
+<scheme>://<endpoint>/<path> per collector cycle, README.md:8-13) paired
+with extractor/models (attribute key ``/v1/models`` holding
+[{id, parent}] ModelData entries, extractor.go:15,106). The attribute is
+the bus to model-aware consumers: the gateway's /v1/models union and the
+model-serving-filter read it.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from typing import Any
+
+import httpx
+
+from ..framework.datalayer import Endpoint
+from ..framework.plugin import PluginBase, register_plugin
+
+log = logging.getLogger("router.datalayer.models")
+
+# Attribute key contract (reference extractor.go:15).
+MODELS_ATTRIBUTE_KEY = "/v1/models"
+
+
+@register_plugin("models-data-source")
+class ModelsDataSource(PluginBase):
+    TYPE = "models-data-source"
+
+    def __init__(self, name: str | None = None):
+        super().__init__(name)
+        self._extractors: list[Any] = []
+        self._scheme = "http"
+        self._path = "/v1/models"
+        self._timeout = 2.0
+        # The model list changes on the order of deploys, not tokens:
+        # refresh every few seconds instead of every 50 ms collector tick.
+        self._refresh_s = 5.0
+        # Reference default (source/models/README.md:22): in-cluster model
+        # servers typically present pod-local certs.
+        self._insecure_skip_verify = True
+        self._last_poll: dict[str, float] = {}
+        self._client: httpx.AsyncClient | None = None
+
+    def configure(self, params: dict[str, Any], handle: Any) -> None:
+        self._scheme = str(params.get("scheme", self._scheme))
+        self._path = str(params.get("path", self._path))
+        self._timeout = float(params.get("timeoutSeconds", self._timeout))
+        self._refresh_s = float(params.get("refreshSeconds", self._refresh_s))
+        self._insecure_skip_verify = bool(
+            params.get("insecureSkipVerify", self._insecure_skip_verify))
+
+    def add_extractor(self, ex: Any) -> None:
+        self._extractors.append(ex)
+
+    def extractors(self) -> list[Any]:
+        if not self._extractors:
+            # Default pairing (the reference wires this via data: sources:;
+            # a bare source without extractors would collect into the void).
+            self._extractors.append(ModelsDataExtractor("models-data-extractor"))
+        return list(self._extractors)
+
+    async def collect(self, endpoint: Endpoint) -> str | None:
+        key = endpoint.metadata.address_port
+        now = time.monotonic()
+        if now - self._last_poll.get(key, -1e9) < self._refresh_s:
+            return None  # fresh enough; extractor treats None as no-op
+        self._last_poll[key] = now
+        if self._client is None:
+            self._client = httpx.AsyncClient(
+                timeout=self._timeout,
+                verify=not self._insecure_skip_verify)
+        url = (f"{self._scheme}://{endpoint.metadata.address}:"
+               f"{endpoint.metadata.port}{self._path}")
+        try:
+            r = await self._client.get(url)
+            r.raise_for_status()
+            return r.text
+        except Exception as e:
+            log.debug("models poll failed for %s: %s", key, e)
+            return None
+
+    async def close(self):
+        if self._client is not None:
+            await self._client.aclose()
+            self._client = None
+
+
+@register_plugin("models-data-extractor")
+class ModelsDataExtractor(PluginBase):
+    TYPE = "models-data-extractor"
+
+    def extract(self, raw: str | None, endpoint: Endpoint) -> None:
+        if raw is None:
+            return
+        try:
+            doc = json.loads(raw)
+            data = doc.get("data") or []
+            models = [{"id": str(m.get("id", "")),
+                       "parent": str(m.get("parent") or "")}
+                      for m in data if isinstance(m, dict) and m.get("id")]
+        except Exception as e:
+            log.debug("unparseable /v1/models body for %s: %s",
+                      endpoint.metadata.address_port, e)
+            return
+        endpoint.attributes.put(MODELS_ATTRIBUTE_KEY, models)
+
+
+def endpoint_models(endpoint: Endpoint) -> list[dict[str, str]] | None:
+    """The endpoint's served-model list, or None when not yet polled."""
+    return endpoint.attributes.get(MODELS_ATTRIBUTE_KEY)

@@ -19,19 +19,30 @@ class Objectives:
 
 @dataclasses.dataclass
 class InferenceRequestBody:
-    """Parsed request body; exactly one of the payload fields is set."""
+    """Parsed request body; exactly one of the payload fields is set
+    (reference InferenceRequestBody, interface/requesthandling/types.go:
+    64-88 — Completions | ChatCompletions | Responses | Conversations |
+    Embeddings)."""
 
     completions: dict[str, Any] | None = None
     chat_completions: dict[str, Any] | None = None
+    responses: dict[str, Any] | None = None
+    conversations: dict[str, Any] | None = None
     embeddings: dict[str, Any] | None = None
     raw: bytes | None = None
     tokenized_prompt: list[int] | None = None
 
     @property
     def payload(self) -> dict[str, Any] | None:
-        return self.completions if self.completions is not None else self.chat_completions
+        for p in (self.completions, self.chat_completions, self.responses,
+                  self.conversations):
+            if p is not None:
+                return p
+        return None
 
     def prompt_text(self) -> str:
+        """Plain-text prompt for scoring (reference PromptText(),
+        types.go:117-147)."""
         if self.completions is not None:
             p = self.completions.get("prompt", "")
             if isinstance(p, list):
@@ -45,6 +56,26 @@ class InferenceRequestBody:
                     c = " ".join(x.get("text", "") for x in c if isinstance(x, dict))
                 parts.append(f"{m.get('role', 'user')}: {c}")
             return "\n".join(parts)
+        if self.responses is not None:
+            inp = self.responses.get("input", "")
+            if isinstance(inp, str):
+                return inp
+            import json as _json
+
+            return _json.dumps(inp)
+        if self.conversations is not None:
+            import json as _json
+
+            return _json.dumps(self.conversations.get("items", []))
+        return ""
+
+    def cache_salt(self) -> str:
+        """Prefix-cache isolation salt (reference CacheSalt(),
+        types.go:166-184)."""
+        for p in (self.conversations, self.responses, self.chat_completions,
+                  self.completions, self.embeddings):
+            if p is not None:
+                return str(p.get("cache_salt") or "")
         return ""
 
     def stream(self) -> bool:

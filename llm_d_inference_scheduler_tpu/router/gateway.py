@@ -122,6 +122,7 @@ class Gateway:
         self.app.add_routes([
             web.post("/v1/completions", self.handle_inference),
             web.post("/v1/chat/completions", self.handle_inference),
+            web.post("/v1/responses", self.handle_inference),
             web.get("/metrics", self.metrics),
             web.get("/health", self.health),
             web.get("/v1/models", self.models),
@@ -130,6 +131,7 @@ class Gateway:
         ])
         self._runner: web.AppRunner | None = None
         self._client: httpx.AsyncClient | None = None
+        self._models_fallback_cache: tuple[float, list] = (0.0, [])
         self._flusher: asyncio.Task | None = None
         self._profile_lock = asyncio.Lock()
         self.grpc_health = None
@@ -475,15 +477,49 @@ class Gateway:
             status=200 if ready else 503)
 
     async def models(self, request: web.Request) -> web.Response:
-        # aggregate across one endpoint (homogeneous pools)
+        """Union of served models across the pool. Prefer the datastore's
+        models-data-source attribute (heterogeneous pools serve different
+        models — reading one endpoint under-reports); fall back to live
+        fetches from every endpoint when the source isn't configured."""
+        from .datalayer.models_source import endpoint_models
+
         eps = self.datastore.endpoint_list()
-        if not eps:
-            return web.json_response({"object": "list", "data": []})
-        try:
-            r = await self._client.get(eps[0].metadata.url + "/v1/models")
-            return web.json_response(r.json())
-        except Exception:
-            return web.json_response({"object": "list", "data": []})
+        merged: dict[str, dict] = {}
+        unpolled = []
+        for ep in eps:
+            models = endpoint_models(ep)
+            if models is None:
+                unpolled.append(ep)
+                continue
+            for m in models:
+                merged.setdefault(m["id"], {"id": m["id"], "object": "model",
+                                            **({"parent": m["parent"]}
+                                               if m.get("parent") else {})})
+        if unpolled:
+            # Live-fetch fallback (models-data-source not configured). The
+            # fan-out is pool-wide, so cache it briefly: a client polling
+            # /v1/models must not multiply into N upstream requests/s.
+            now = time.monotonic()
+            expiry, cached = self._models_fallback_cache
+            if now >= expiry:
+                import asyncio as _aio
+
+                async def fetch(ep):
+                    try:
+                        r = await self._client.get(ep.metadata.url + "/v1/models")
+                        return (r.json().get("data") or []) if r.status_code == 200 else []
+                    except Exception:
+                        return []
+
+                cached = [m for data in
+                          await _aio.gather(*[fetch(ep) for ep in unpolled])
+                          for m in data if isinstance(m, dict) and m.get("id")]
+                self._models_fallback_cache = (now + 5.0, cached)
+            for m in cached:
+                merged.setdefault(str(m["id"]), m)
+        return web.json_response({"object": "list",
+                                  "data": sorted(merged.values(),
+                                                 key=lambda m: m["id"])})
 
 
 def _rewrite_model_name(data: bytes, ireq: InferenceRequest | None,
@@ -546,8 +582,9 @@ def build_gateway(config_text: str | None, *, host: str = "127.0.0.1",
     kube_binding = None
     # Endpoint discovery needs a pool to scope the pod selector; a kube dict
     # without one is lease-only (HA election against the API server while
-    # endpoints still come from the config file).
-    if kube and (kube.get("pool_name") or kube.get("discover_pods")):
+    # endpoints still come from the config file). The CLI rejects an
+    # api-url with neither pool nor lease, so nothing silently no-ops.
+    if kube and kube.get("pool_name"):
         from .kube import KubeApiClient, KubeBinding
 
         if config_watch_path is not None:
@@ -606,9 +643,10 @@ def main(argv: list[str] | None = None):
                    help="reconcile pool/objectives/rewrites live when "
                         "--config-file changes on disk")
     p.add_argument("--kube-api-url", default=None,
-                   help="k8s API server base URL; enables the list+watch "
-                        "binding (pods + llm-d.ai CRDs) instead of a static "
-                        "pool")
+                   help="k8s API server base URL; combine with "
+                        "--kube-pool-name for the list+watch endpoint "
+                        "binding and/or --kube-lease-name for Lease-object "
+                        "HA election")
     p.add_argument("--kube-namespace", default="default")
     p.add_argument("--kube-pool-name", default=None,
                    help="InferencePool name to watch for selector/ports")
@@ -631,6 +669,9 @@ def main(argv: list[str] | None = None):
 
     kube = None
     if args.kube_api_url:
+        if not (args.kube_pool_name or args.kube_lease_name):
+            p.error("--kube-api-url needs --kube-pool-name (endpoint "
+                    "discovery) and/or --kube-lease-name (HA election)")
         kube = {"api_url": args.kube_api_url,
                 "namespace": args.kube_namespace,
                 "pool_name": args.kube_pool_name,

@@ -48,10 +48,19 @@ class OpenAIParser(PluginBase):
         if not isinstance(doc, dict):
             return ParseResult(body=None, error="body must be a JSON object")
         model = str(doc.get("model", ""))
-        if "messages" in doc:
+        # Path first: /v1/responses bodies carry "input" exactly like
+        # /v1/embeddings, so shape alone cannot distinguish them
+        # (reference routes by API path, types.go:64-88).
+        if "responses" in path:
+            body = InferenceRequestBody(responses=doc, raw=raw)
+        elif "conversations" in path:
+            body = InferenceRequestBody(conversations=doc, raw=raw)
+        elif "messages" in doc:
             body = InferenceRequestBody(chat_completions=doc, raw=raw)
         elif "prompt" in doc or "completions" in path:
             body = InferenceRequestBody(completions=doc, raw=raw)
+        elif "input" in doc and ("instructions" in doc or "tools" in doc):
+            body = InferenceRequestBody(responses=doc, raw=raw)
         elif "input" in doc:
             body = InferenceRequestBody(embeddings=doc, raw=raw)
         else:

@@ -465,20 +465,6 @@ def _micro_time(ts: float) -> str:
             .strftime("%Y-%m-%dT%H:%M:%S.%fZ"))
 
 
-def _parse_micro_time(s: str) -> float:
-    try:
-        return (datetime.datetime
-                .strptime(s, "%Y-%m-%dT%H:%M:%S.%fZ")
-                .replace(tzinfo=datetime.timezone.utc).timestamp())
-    except ValueError:
-        try:
-            return (datetime.datetime
-                    .strptime(s, "%Y-%m-%dT%H:%M:%SZ")
-                    .replace(tzinfo=datetime.timezone.utc).timestamp())
-        except ValueError:
-            return 0.0
-
-
 class KubeLeaseElector:
     """Leader election over a coordination.k8s.io/v1 Lease object — the
     reference's election backend (controller_manager.go:84-91: lease id

@@ -167,3 +167,30 @@ class PrefixCacheAffinityFilter(PluginBase):
                     and best_sticky - best_non_sticky > self.max_ttft_penalty_ms):
                 return endpoints
         return sticky
+
+
+@register_plugin("model-serving-filter")
+class ModelServingFilter(PluginBase):
+    """Keep endpoints whose polled /v1/models list contains the requested
+    model — the model-aware consumer of models-data-source (reference
+    source/models/README.md:11: routing on served-model data; the reference
+    ships the data plumbing, this filter closes the loop for heterogeneous
+    pools). Fail-open per endpoint until its first poll lands, and for the
+    whole set when no endpoint matches (scheduling must not brick on stale
+    model lists)."""
+
+    def filter(self, ctx, state, request, endpoints):
+        from ..datalayer.models_source import endpoint_models
+
+        model = request.target_model
+        if not model:
+            return endpoints
+        kept = []
+        for ep in endpoints:
+            models = endpoint_models(ep)
+            if models is None:  # not polled yet: don't exclude
+                kept.append(ep)
+            elif any(m.get("id") == model or m.get("parent") == model
+                     for m in models):
+                kept.append(ep)
+        return kept or endpoints

@@ -239,14 +239,21 @@ class Sidecar:
             probe_body = dict(body)
             probe_body["cache_hit_threshold"] = self.cfg.cache_hit_threshold
             probe_body["stream"] = False
-            probe_body["max_tokens"] = 1
+            probe_body[self._max_tokens_field(request.path)] = 1
             warm = False
             try:
                 r = await self._client.post(self._rank_url() + request.path,
                                             json=probe_body)
                 if r.status_code == 200:
                     doc = r.json()
-                    finish = (doc.get("choices") or [{}])[0].get("finish_reason")
+                    if doc.get("object") == "response":
+                        # Responses bodies carry truncation cause in
+                        # incomplete_details, not choices[].finish_reason.
+                        finish = (doc.get("incomplete_details")
+                                  or {}).get("reason")
+                    else:
+                        finish = (doc.get("choices")
+                                  or [{}])[0].get("finish_reason")
                     warm = finish != "cache_threshold"
             except Exception as e:
                 log.warning("shared-storage probe failed (%s); running P/D", e)
@@ -317,12 +324,22 @@ class Sidecar:
         with tracer.span("sidecar.pd_protocol", prefiller=prefiller) as span:
             return await self._run_pd_protocol_inner(request, body, prefiller, span)
 
+    @staticmethod
+    def _max_tokens_field(path: str) -> str:
+        """The Responses API bounds output with ``max_output_tokens``
+        (reference proxy.go:48); the other OpenAI surfaces use
+        ``max_tokens``."""
+        return ("max_output_tokens" if path.endswith("/responses")
+                else "max_tokens")
+
     async def _run_pd_protocol_inner(self, request, body, prefiller, span):
         t0 = time.monotonic()
         prefill_body = dict(body)
         prefill_body["kv_transfer_params"] = {"do_remote_decode": True}
         prefill_body["stream"] = False
-        prefill_body["max_tokens"] = 1  # connector_nixlv2.go:109-131
+        # connector_nixlv2.go:109-131: prefill generates exactly one token;
+        # the decode leg keeps the caller's original limit (or absence).
+        prefill_body[self._max_tokens_field(request.path)] = 1
 
         ktp = None
         try:

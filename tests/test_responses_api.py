@@ -1,0 +1,189 @@
+"""OpenAI Responses API (/v1/responses) end-to-end: parser body model,
+engine surface, and the disagg path with max_output_tokens semantics
+(reference proxy.go:48,391-408, types.go:326-343)."""
+
+import asyncio
+import json
+
+import httpx
+
+from llm_d_inference_scheduler_tpu.engine import EngineConfig
+from llm_d_inference_scheduler_tpu.engine.server import EngineServer
+from llm_d_inference_scheduler_tpu.router.gateway import build_gateway
+from llm_d_inference_scheduler_tpu.router.handlers.parsers import OpenAIParser
+from llm_d_inference_scheduler_tpu.router.sidecar import Sidecar, SidecarConfig
+
+GW, SC, DEC, PRE = 18460, 18461, 18462, 18463
+
+
+def test_parser_responses_and_conversations_shapes():
+    p = OpenAIParser("p")
+    body = {"model": "m", "input": "hello world", "instructions": "be brief",
+            "max_output_tokens": 5, "cache_salt": "tenant-a"}
+    r = p.parse(json.dumps(body).encode(), {}, path="/v1/responses")
+    assert r.body.responses is not None and r.model == "m"
+    assert r.body.prompt_text() == "hello world"
+    assert r.body.cache_salt() == "tenant-a"
+    assert r.body.payload["model"] == "m"  # model rewrite works on payload
+
+    # Item-array input serializes for scoring.
+    r = p.parse(json.dumps({"model": "m", "input": [
+        {"type": "message", "role": "user", "content": "q1"}]}).encode(),
+        {}, path="/v1/responses")
+    assert "q1" in r.body.prompt_text()
+
+    r = p.parse(json.dumps({"model": "m", "items": [
+        {"type": "message", "content": "ctx"}]}).encode(),
+        {}, path="/v1/conversations")
+    assert r.body.conversations is not None
+    assert "ctx" in r.body.prompt_text()
+
+    # Shape-based detection without a path: input+instructions → responses,
+    # bare input stays embeddings.
+    r = p.parse(json.dumps({"input": "x", "instructions": "y"}).encode(), {})
+    assert r.body.responses is not None
+    r = p.parse(json.dumps({"input": "x"}).encode(), {})
+    assert r.body.embeddings is not None
+
+
+def _engine(port, role="decode"):
+    return EngineServer(EngineConfig(backend="tpu", model="tiny", port=port,
+                                     max_batch=4, max_model_len=256, role=role))
+
+
+def test_engine_responses_surface_matches_chat():
+    """/v1/responses renders instructions+input through the same template as
+    chat, so greedy outputs agree; the reply is Responses-shaped with
+    input/output token usage and honors max_output_tokens."""
+    async def body():
+        eng = _engine(DEC)
+        await eng.start()
+        try:
+            async with httpx.AsyncClient(timeout=120) as c:
+                chat = await c.post(
+                    f"http://127.0.0.1:{DEC}/v1/chat/completions",
+                    json={"messages": [{"role": "system", "content": "sys"},
+                                       {"role": "user", "content": "tell me"}],
+                          "max_tokens": 5, "temperature": 0})
+                r = await c.post(
+                    f"http://127.0.0.1:{DEC}/v1/responses",
+                    json={"input": "tell me", "instructions": "sys",
+                          "max_output_tokens": 5, "temperature": 0})
+                assert r.status_code == 200
+                doc = r.json()
+                assert doc["object"] == "response"
+                msg = doc["output"][0]
+                assert msg["type"] == "message" and msg["role"] == "assistant"
+                text = msg["content"][0]["text"]
+                assert text == chat.json()["choices"][0]["message"]["content"]
+                u = doc["usage"]
+                assert u["output_tokens"] <= 5
+                assert u["total_tokens"] == u["input_tokens"] + u["output_tokens"]
+
+                # Streaming: semantic delta events reassemble to the same text.
+                async with c.stream(
+                        "POST", f"http://127.0.0.1:{DEC}/v1/responses",
+                        json={"input": "tell me", "instructions": "sys",
+                              "max_output_tokens": 5, "temperature": 0,
+                              "stream": True}) as s:
+                    acc, completed = "", False
+                    async for line in s.aiter_lines():
+                        if not line.startswith("data: ") or line == "data: [DONE]":
+                            continue
+                        ev = json.loads(line[6:])
+                        if ev["type"] == "response.output_text.delta":
+                            acc += ev["delta"]
+                        elif ev["type"] == "response.completed":
+                            completed = True
+                assert completed and acc == text
+        finally:
+            await eng.stop()
+
+    asyncio.run(body())
+
+
+CFG = f"""
+pool:
+  endpoints:
+    - {{address: 127.0.0.1, port: {SC}, labels: {{llm-d.ai/role: decode}}}}
+    - {{address: 127.0.0.1, port: {PRE}, labels: {{llm-d.ai/role: prefill}}}}
+plugins:
+  - {{type: decode-filter}}
+  - {{type: prefill-filter}}
+  - {{type: queue-scorer}}
+  - {{type: approx-prefix-cache-producer}}
+  - {{type: prefix-cache-scorer}}
+  - type: disagg-profile-handler
+    parameters:
+      pdDecider:
+        type: prefix-based-pd-decider
+        parameters: {{thresholdTokens: 16}}
+schedulingProfiles:
+  - name: decode
+    plugins:
+      - {{pluginRef: decode-filter}}
+      - {{pluginRef: prefix-cache-scorer, weight: 3}}
+      - {{pluginRef: queue-scorer, weight: 2}}
+  - name: prefill
+    plugins:
+      - {{pluginRef: prefill-filter}}
+      - {{pluginRef: queue-scorer}}
+"""
+
+LONG_INPUT = "summarise this very important document carefully please: " * 4
+
+
+def _counter_value(server, name) -> float:
+    text = server.engine.telemetry.render().decode()
+    for line in text.splitlines():
+        if line.startswith(name + " ") or line.startswith(name + "{"):
+            return float(line.rsplit(" ", 1)[1])
+    return 0.0
+
+
+def test_responses_through_disagg():
+    """/v1/responses through gateway → sidecar P/D: the prefill leg runs
+    with max_output_tokens=1 (not max_tokens), the decode leg restores the
+    caller's limit, and the answer matches the monolithic engine."""
+    async def body():
+        dec = _engine(DEC, "decode")
+        pre = _engine(PRE, "prefill")
+        await dec.start()
+        await pre.start()
+        sc = Sidecar(SidecarConfig(port=SC, decoder_url=f"http://127.0.0.1:{DEC}",
+                                   ssrf_allowlist=[f"127.0.0.1:{PRE}"]))
+        await sc.start()
+        gw = build_gateway(CFG, port=GW, poll_interval=0.02)
+        await gw.start()
+        try:
+            async with httpx.AsyncClient(timeout=120) as c:
+                mono = await c.post(f"http://127.0.0.1:{DEC}/v1/responses",
+                                    json={"input": LONG_INPUT,
+                                          "max_output_tokens": 6,
+                                          "temperature": 0})
+                mono_text = mono.json()["output"][0]["content"][0]["text"]
+
+                pre_before = _counter_value(pre, "jetstream:prompt_tokens_total")
+                r = await c.post(f"http://127.0.0.1:{GW}/v1/responses",
+                                 json={"model": "tiny", "input": LONG_INPUT,
+                                       "max_output_tokens": 6,
+                                       "temperature": 0})
+                assert r.status_code == 200
+                assert r.headers["x-gateway-destination-endpoint-served"] == \
+                    f"127.0.0.1:{SC}"
+                doc = r.json()
+                assert doc["object"] == "response"
+                text = doc["output"][0]["content"][0]["text"]
+                assert text == mono_text
+                # Decode leg kept the caller's limit (6 tokens, not 1).
+                assert doc["usage"]["output_tokens"] == 6
+                # The prefill engine really prefilled.
+                assert _counter_value(pre, "jetstream:prompt_tokens_total") > \
+                    pre_before
+        finally:
+            await gw.stop()
+            await sc.stop()
+            await pre.stop()
+            await dec.stop()
+
+    asyncio.run(body())
