@@ -48,6 +48,17 @@ log = logging.getLogger("engine.core")
 
 KV_EXPORT_TTL_S = 60.0
 
+
+def _tcp_preflight(address: str, timeout: float = 2.0) -> None:
+    """The transfer layer blocks indefinitely on an unreachable peer; fail
+    fast so fallbacks engage (and, for coordinated multi-host pulls, so the
+    leader never broadcasts a pull op that would wedge the followers)."""
+    import socket
+
+    host, _, port = address.rpartition(":")
+    with socket.create_connection((host, int(port)), timeout=timeout):
+        pass
+
 # One transfer server per process (shared by colocated engines): multiple
 # servers on one PJRT client abort in the aux socket layer, and production
 # runs one engine per chip/process anyway.
@@ -98,6 +109,9 @@ class _PendingImport:
     # Device-to-device path: KV arrives as on-device arrays, no payload.
     k_dev: Any = None
     v_dev: Any = None
+    # Multi-host path: the pull is a coordinated op executed on the engine
+    # thread (every process participates); the fetch thread only preflights.
+    dist_pull: bool = False
     error: str | None = None
 
 
@@ -144,10 +158,6 @@ class TpuEngine:
         block = self.mcfg.kv_block_size
         self.n_blocks = max(cfg.num_kv_blocks(), 2)  # ≥ trash + 1 usable
         self.max_blocks_per_seq = -(-cfg.max_model_len // block)
-        if cfg.pp_size > 1 and cfg.enable_prefix_caching:
-            log.info("pp serving: prefix caching disabled (prefix-ring "
-                     "prefill not implemented)")
-            cfg.enable_prefix_caching = False
         self.allocator = (PrefixCachingAllocator(self.n_blocks, block)
                           if cfg.enable_prefix_caching
                           else BlockAllocator(self.n_blocks, block))
@@ -160,6 +170,23 @@ class TpuEngine:
         # devices — the dp axis holds the remainder as replicas (host inputs
         # are fed fully-replicated, see _put).
         self._dist = bool(cfg.dist_coordinator) and cfg.dist_num_processes > 1
+        # jax.experimental.transfer server: stages prefilled KV on-device for
+        # direct device-to-device pulls (ICI/DCN). Created BEFORE the
+        # instruction channel so a follower's one-time hello can announce its
+        # transfer address (sharded exports address every process's server).
+        self.kv_transfer_server = None
+        self._transfer_conns: dict[str, Any] = {}
+        self._transfer_lock = threading.Lock()
+        self.kv_import_device_count = 0  # diagnostics: pulls over ICI/DCN
+        self.kv_import_host_count = 0    # diagnostics: host-staged HTTP fetches
+        if cfg.kv_transfer in ("auto", "device"):
+            try:
+                self.kv_transfer_server = _get_transfer_server()
+            except Exception:
+                if cfg.kv_transfer == "device":
+                    raise
+                log.info("kv transfer server unavailable; host-staged "
+                         "HTTP handoff only", exc_info=True)
         self._instr_channel = None
         if self._dist:
             # jax.distributed.initialize must already have run (server main /
@@ -170,7 +197,11 @@ class TpuEngine:
                 leader=cfg.dist_process_id == 0,
                 host=cfg.dist_instr_host or cfg.host,
                 port=cfg.dist_instr_port,
-                n_followers=cfg.dist_num_processes - 1)
+                n_followers=cfg.dist_num_processes - 1,
+                hello={"process_id": cfg.dist_process_id,
+                       "transfer_address":
+                           (self._transfer_address()
+                            if self.kv_transfer_server is not None else None)})
             if self._instr_channel.leader:
                 self._instr_channel.on_peer_lost = self._on_follower_lost
         self.mesh = None
@@ -256,28 +287,12 @@ class TpuEngine:
             except Exception:
                 log.exception("kv-event publisher disabled (bind failed)")
         # Device-to-device KV handoff (the NIXL-v2 analogue for TPU): a
-        # jax.experimental.transfer server stages prefilled KV on-device for
-        # the decode engine to pull over ICI/DCN — no host round-trip. The
-        # host-staged HTTP path stays as fallback (reference
+        # Sharded engines stage/pull KV per unique page shard (kv_shards.py,
+        # the NIXL multi-rank-descriptor analogue); the host-staged HTTP path
+        # stays as fallback for single-process engines (reference
         # connector_nixlv2.go:109-253 control shape preserved).
-        self.kv_transfer_server = None
-        self._transfer_conns: dict[str, Any] = {}
-        self._transfer_lock = threading.Lock()
-        self.kv_import_device_count = 0  # diagnostics: pulls over ICI/DCN
-        self.kv_import_host_count = 0    # diagnostics: host-staged HTTP fetches
-        if cfg.kv_transfer in ("auto", "device") and self.mesh is None \
-                and self.pp_mesh is None:
-            try:
-                self.kv_transfer_server = _get_transfer_server()
-            except Exception:
-                if cfg.kv_transfer == "device":
-                    raise
-                log.info("kv transfer server unavailable; host-staged "
-                         "HTTP handoff only", exc_info=True)
-        elif cfg.kv_transfer == "device":
-            raise ValueError("kv_transfer='device' is not yet supported with "
-                             "tp/ep/pp-sharded or multi-host pages "
-                             "(sharded pull specs)")
+        self._jit_stage = None
+        self._release_reqs: list[tuple[str, str]] = []
         self._prefill_fns: dict[int, Any] = {}
         if self.pp_mesh is not None:
             from ..parallel.pp_serve import make_pp_decode_chunk
@@ -391,6 +406,11 @@ class TpuEngine:
         """Jitted prefill continuing from cached prefix KV, keyed on
         (suffix, prefix) pow2 buckets so a hit costs O(prefix)."""
         key = ("prefix", suffix_bucket, prefix_bucket)
+        if key not in self._prefill_fns and self.pp_mesh is not None:
+            from ..parallel.pp_serve import make_pp_prefill_with_prefix
+
+            self._prefill_fns[key] = make_pp_prefill_with_prefix(
+                self.mcfg, self.pp_mesh, suffix_bucket, prefix_bucket)
         if key not in self._prefill_fns:
             def impl(params, tokens, suffix_len, prefix_len, k_pages, v_pages,
                      block_table_row, prior_table_row,
@@ -462,7 +482,20 @@ class TpuEngine:
         registration was already drained by the peer's pull; anything else
         leaves the registration outstanding, so it is self-drained here (the
         transfer API has no cancel — the server otherwise holds the staged
-        device arrays forever)."""
+        device arrays forever).
+
+        Multi-host: every process registered its own shards, so the release
+        must reach every process — it is queued here (callers run on the
+        HTTP event loop or the engine thread) and broadcast as a
+        release_kv_export op by the engine loop."""
+        if self._dist:
+            with self._cond:
+                self._release_reqs.append((request_id, consumed))
+                self._cond.notify()
+            return
+        self._release_export_local(request_id, consumed)
+
+    def _release_export_local(self, request_id: str, consumed: str) -> None:
         with self._exports_lock:
             rec = self.kv_exports.pop(request_id, None)
         if rec is not None and consumed != "device":
@@ -472,19 +505,20 @@ class TpuEngine:
         """Self-pull an un-pulled staged uuid to release the transfer
         server's reference (loopback device copy; rare path)."""
         tuid = rec.get("transfer_uuid")
-        if tuid is None or self.kv_transfer_server is None:
+        shards = rec.get("staged_shards")
+        if tuid is None or not shards or self.kv_transfer_server is None:
             return
 
         def drain():
             try:
                 from jax.sharding import SingleDeviceSharding
 
-                k = rec["k"]
-                sds = jax.ShapeDtypeStruct(
-                    k.shape, k.dtype,
-                    sharding=SingleDeviceSharding(jax.devices()[0]))
+                sds = [jax.ShapeDtypeStruct(
+                    a.shape, a.dtype,
+                    sharding=SingleDeviceSharding(list(a.devices())[0]))
+                    for a in shards]
                 conn = self._transfer_conn(self._transfer_address())
-                conn.pull(int(tuid), [sds, sds])
+                conn.pull(int(tuid), sds)
             except Exception:
                 log.debug("staged-transfer drain failed", exc_info=True)
 
@@ -492,6 +526,19 @@ class TpuEngine:
         # forever — only reachable if the peer pulled but its release signal
         # was lost, which leaks one idle thread, not device memory.
         threading.Thread(target=drain, name="kv-drain", daemon=True).start()
+
+    def _page_layout(self):
+        """(mesh, partition spec) of the page buffers; (None, None) when the
+        engine is single-device (unsharded pages)."""
+        if self.pp_mesh is not None:
+            from ..parallel.pp_serve import PAGE_SPEC
+
+            return self.pp_mesh, PAGE_SPEC
+        if self.mesh is not None:
+            from ..parallel.serve import KV_PAGE_SPEC
+
+            return self.mesh, KV_PAGE_SPEC
+        return None, None
 
     def get_kv_export(self, request_id: str) -> dict[str, Any] | None:
         with self._exports_lock:
@@ -586,6 +633,7 @@ class TpuEngine:
                 self._abort_all("engine loop failure")
 
     def _step(self):
+        self._drain_release_reqs()
         self._sweep_exports()
         self._publish_kv_snapshot()
         self._process_aborts()
@@ -658,11 +706,30 @@ class TpuEngine:
         if hashes:
             self.kv_events.stored(hashes)
 
+    def _drain_release_reqs(self):
+        """Multi-host release fan-out: queued by release_kv_export (HTTP
+        event loop / sweep), broadcast here so every process drops its own
+        shard registrations in op order."""
+        with self._cond:
+            reqs, self._release_reqs = self._release_reqs, []
+        for rid, consumed in reqs:
+            self._device_call(("release_kv_export",),
+                              dict(request_id=rid, consumed=consumed))
+
     def _sweep_exports(self):
         now = time.monotonic()
         with self._exports_lock:
             expired = [(rid, rec) for rid, rec in self.kv_exports.items()
                        if now - rec["created"] > KV_EXPORT_TTL_S]
+        if self._dist:
+            # Followers must drop their shard registrations too: route the
+            # expiry through the broadcast release op.
+            for rid, _ in expired:
+                log.warning("kv export %s expired unclaimed; dropping", rid)
+                self._device_call(("release_kv_export",),
+                                  dict(request_id=rid, consumed="expired"))
+            return
+        with self._exports_lock:
             for rid, _ in expired:
                 log.warning("kv export %s expired unclaimed; dropping", rid)
                 self.kv_exports.pop(rid, None)
@@ -746,13 +813,14 @@ class TpuEngine:
                 finish_reason=FinishReason.ABORT,
                 prompt_tokens=len(req.prompt_token_ids)))
             return
-        if self._dist and (req.kv_transfer_params or {}).get("do_remote_decode"):
-            # P/D KV staging gathers pages OUTSIDE the replayed op stream
-            # (_finish_slot retain_for_transfer) — on a multi-host mesh that
-            # leader-only collective would deadlock the slice. Reject loudly;
-            # multi-host engines serve monolithic or decode-side roles.
-            log.warning("rejecting do_remote_decode request %s: P/D KV "
-                        "staging is not supported in multi-host mode",
+        if (self._dist and self.kv_transfer_server is None
+                and (req.kv_transfer_params or {}).get("do_remote_decode")):
+            # Multi-host staging is shard-registered on every process's
+            # transfer server (stage_kv op); without one there is no host
+            # fallback either (global pages are not fully addressable), so
+            # reject instead of staging an unclaimable export.
+            log.warning("rejecting do_remote_decode request %s: no KV "
+                        "transfer server in multi-host mode",
                         req.request_id)
             self._emit_to(out, loop, TokenEvent(
                 request_id=req.request_id, token_id=None,
@@ -941,7 +1009,33 @@ class TpuEngine:
         ktp = req.kv_transfer_params or {}
 
         def fetch():
+            if (ktp.get("transfer_shards") and ktp.get("kv_mesh")
+                    and self.kv_transfer_server is not None):
+                # Sharded exporter. Multi-host importer: only preflight here
+                # (the pull is a coordinated engine-thread op); single-proc
+                # importer pulls every shard from the one exporter address.
+                try:
+                    self._check_shard_geometry(ktp)
+                    if self._dist:
+                        for addr in ktp["transfer_shards"]:
+                            _tcp_preflight(addr)
+                        pi.dist_pull = True
+                        with self._cond:
+                            self._import_ready.append(pi)
+                            self._cond.notify()
+                        return
+                    self._pull_device_kv_sharded(pi, ktp)
+                    self.kv_import_device_count += 1
+                    with self._cond:
+                        self._import_ready.append(pi)
+                        self._cond.notify()
+                    return
+                except Exception as e:
+                    log.warning("sharded kv pull (%s) failed (%s); "
+                                "host-path fallback",
+                                ktp.get("transfer_shards"), e)
             if (ktp.get("transfer_address") and ktp.get("kv_shape")
+                    and not self._dist
                     and self.kv_transfer_server is not None):
                 try:
                     self._pull_device_kv(pi, ktp)
@@ -954,6 +1048,14 @@ class TpuEngine:
                     log.warning("device kv pull from %s failed (%s); "
                                 "falling back to host path",
                                 ktp["transfer_address"], e)
+            if self._dist:
+                # No host path on a multi-host mesh (pages are not fully
+                # addressable): degrade to local prefill directly.
+                pi.error = "no usable sharded transfer route"
+                with self._cond:
+                    self._import_ready.append(pi)
+                    self._cond.notify()
+                return
             import httpx
 
             url = (f"http://{ktp['remote_host']}:{ktp['remote_port']}"
@@ -976,18 +1078,55 @@ class TpuEngine:
 
         threading.Thread(target=fetch, name="kv-fetch", daemon=True).start()
 
+    def _check_shard_geometry(self, ktp: dict[str, Any]) -> None:
+        """A sharded pull needs identical page-sharding geometry on both
+        sides (symmetric P/D deployment); mismatch falls back."""
+        from .kv_shards import mesh_descriptor
+
+        mesh, spec = self._page_layout()
+        if mesh is None:
+            raise ValueError("importer is unsharded; exporter pages are "
+                             "sharded — host path required")
+        mine = mesh_descriptor(mesh, spec)
+        theirs = ktp["kv_mesh"]
+        if mine != theirs:
+            raise ValueError(f"page sharding mismatch: {theirs} vs {mine}")
+        if len(ktp["transfer_shards"]) != int(theirs["n_procs"]):
+            raise ValueError("shard descriptor count != exporter processes")
+
+    def _pull_device_kv_sharded(self, pi: _PendingImport,
+                                ktp: dict[str, Any]) -> None:
+        """Single-process importer, sharded exporter/importer pages: pull
+        every unique shard from the exporter and assemble under the local
+        page sharding."""
+        addr = ktp["transfer_shards"][0]
+        _tcp_preflight(addr)
+        pi.k_dev, pi.v_dev = self._pull_sharded_arrays(
+            addr, int(ktp["transfer_uuid"]),
+            tuple(int(d) for d in ktp["kv_shape"]),
+            jnp.dtype(ktp["kv_dtype"]))
+        self._release_remote_export(ktp)
+
+    def _release_remote_export(self, ktp: dict[str, Any]) -> None:
+        """Best-effort: tell the exporter its staged copy was consumed
+        device-side so it drops the record without self-draining."""
+        try:
+            import httpx
+
+            httpx.delete(f"http://{ktp['remote_host']}:{ktp['remote_port']}"
+                         f"/kv/{ktp['remote_request_id']}?consumed=device",
+                         timeout=5.0)
+        except Exception:
+            pass  # exporter TTL sweep reclaims
+
     def _pull_device_kv(self, pi: _PendingImport, ktp: dict[str, Any]) -> None:
         """Device-to-device pull: KV lands on this engine's device directly
         (ICI same-slice, DCN cross-slice — runtime-routed)."""
-        import socket
-
         from jax.sharding import SingleDeviceSharding
 
         # TCP preflight: the transfer layer blocks indefinitely on an
         # unreachable peer; fail fast here so the HTTP fallback engages.
-        host, _, port = ktp["transfer_address"].rpartition(":")
-        with socket.create_connection((host, int(port)), timeout=2.0):
-            pass
+        _tcp_preflight(ktp["transfer_address"])
 
         shape = tuple(int(d) for d in ktp["kv_shape"])
         dtype = jnp.dtype(ktp["kv_dtype"])
@@ -999,14 +1138,7 @@ class TpuEngine:
         pi.k_dev.block_until_ready()
         # Release the prefiller's export record, flagging device consumption
         # so it does NOT self-drain the (already pulled) staging uuid.
-        try:
-            import httpx
-
-            httpx.delete(f"http://{ktp['remote_host']}:{ktp['remote_port']}"
-                         f"/kv/{ktp['remote_request_id']}?consumed=device",
-                         timeout=5.0)
-        except Exception:
-            pass
+        self._release_remote_export(ktp)
 
     def _process_imports(self):
         while True:
@@ -1081,7 +1213,25 @@ class TpuEngine:
         malformed/mismatched import (caller falls back to local prefill)."""
         req, headers = pi.req, pi.headers or {}
         ktp = req.kv_transfer_params or {}
-        if pi.k_dev is not None:
+        if pi.dist_pull:
+            # Coordinated multi-host pull: every process fetches its shards
+            # from its counterpart prefill process and scatters, in lockstep.
+            shape = tuple(int(d) for d in ktp["kv_shape"])
+            seq_len = int(ktp["remote_seq_len"])
+            real_nb = int(ktp.get("remote_num_blocks") or shape[1])
+            _, nb, *_ = self._validate_kv_geometry(shape, seq_len, real_nb,
+                                                   len(blocks))
+            padded_blocks = np.zeros((nb,), np.int32)
+            padded_blocks[:real_nb] = blocks[:real_nb]
+            self._device_call(("pull_kv_import",), dict(
+                blocks_pad=padded_blocks,
+                addresses=list(ktp["transfer_shards"]),
+                tuid=int(ktp["transfer_uuid"]),
+                shape=[int(d) for d in shape],
+                dtype=str(ktp["kv_dtype"])))
+            self.kv_import_device_count += 1
+            self._release_remote_export(ktp)
+        elif pi.k_dev is not None:
             # Device path: already on this engine's device; scatter directly.
             # The staging side pow2-pads the block dim, so the per-shape jit
             # cache stays at log2(max_blocks)+1 entries; padding rows scatter
@@ -1213,7 +1363,117 @@ class TpuEngine:
             return self._op_mm_prefill(op[1], op[2], **args)
         if kind == "import":
             return self._op_import(**args)
+        if kind == "stage_kv":
+            return self._op_stage_kv(**args)
+        if kind == "release_kv_export":
+            return self._op_release_export(**args)
+        if kind == "pull_kv_import":
+            return self._op_pull_kv_import(**args)
         raise ValueError(f"unknown device op {op!r}")
+
+    def _shard_addresses(self) -> list[str]:
+        """Per-process transfer addresses in process order (self first when
+        leading): a sharded importer pulls its shards from its counterpart
+        process. Single-process: just this engine's address."""
+        addrs = [self._transfer_address()]
+        if self._instr_channel is not None and self._instr_channel.leader:
+            for pid in range(1, self.cfg.dist_num_processes):
+                hello = self._instr_channel.hellos.get(pid) or {}
+                addrs.append(hello.get("transfer_address") or "")
+        return addrs
+
+    def _op_stage_kv(self, request_id: str, idx: np.ndarray, tuid: int):
+        """Gather the export's blocks out of the (possibly sharded) pages
+        and register this process's unique shards under ``tuid``. Runs on
+        every process under dist (the gather is a collective program on
+        global arrays). Unsharded engines degenerate to the legacy [k, v]
+        registration."""
+        from .kv_shards import local_unique_shards, staged_sharding
+
+        mesh, spec = self._page_layout()
+        idx_dev = self._put(idx)
+        if mesh is not None:
+            if self._jit_stage is None:
+                out_sh = staged_sharding(mesh, spec)
+                self._jit_stage = jax.jit(
+                    lambda kp, vp, i: (kp[:, i], vp[:, i]),
+                    out_shardings=(out_sh, out_sh))
+            k_stage, v_stage = self._jit_stage(self.k_pages, self.v_pages,
+                                               idx_dev)
+        else:
+            k_stage = self.k_pages[:, idx_dev]
+            v_stage = self.v_pages[:, idx_dev]
+        staged_shards = None
+        registered = None
+        if self.kv_transfer_server is not None:
+            try:
+                shards = (local_unique_shards(k_stage)
+                          + local_unique_shards(v_stage))
+                self.kv_transfer_server.await_pull(tuid, shards)
+                staged_shards = shards
+                registered = tuid
+            except Exception:
+                if (self._instr_channel is not None
+                        and not self._instr_channel.leader):
+                    # A follower whose registration is missing would HANG the
+                    # importer's pull — crash loudly (run_follower exits,
+                    # the group restarts) instead of wedging the peer slice.
+                    raise
+                log.exception("kv await_pull failed; host path only")
+        rec = {"k": k_stage, "v": v_stage, "transfer_uuid": registered,
+               "staged_shards": staged_shards, "created": time.monotonic()}
+        with self._exports_lock:
+            self.kv_exports[request_id] = rec
+        return rec
+
+    def _op_release_export(self, request_id: str, consumed: str):
+        self._release_export_local(request_id, consumed)
+
+    def _op_pull_kv_import(self, blocks_pad: np.ndarray, addresses: list[str],
+                           tuid: int, shape: tuple, dtype: str):
+        """Coordinated sharded pull + scatter (dist decode side): every
+        process pulls its unique page shards from its counterpart prefill
+        process, assembles the global staged array, and runs the same
+        scatter op as a local import. A process whose pull fails raises —
+        under dist that is a group-restart fault (the other processes are
+        already inside the op)."""
+        k_dev, v_dev = self._pull_sharded_arrays(
+            addresses[jax.process_index()], tuid, tuple(shape),
+            jnp.dtype(dtype))
+        self.k_pages, self.v_pages = self._jit_import(
+            self.k_pages, self.v_pages, self._put(blocks_pad), k_dev, v_dev)
+
+    def _pull_sharded_arrays(self, address: str, tuid: int,
+                             shape: tuple, dtype) -> tuple[Any, Any]:
+        """Pull this process's unique shards of a staged [k, v] pair from
+        ``address`` and assemble the global arrays under the local page
+        sharding (replica devices get device_put copies)."""
+        from jax.sharding import SingleDeviceSharding
+
+        from .kv_shards import local_shard_groups, staged_sharding
+
+        mesh, spec = self._page_layout()
+        sharding = staged_sharding(mesh, spec)
+        groups = local_shard_groups(sharding, shape)
+        shard_shape = sharding.shard_shape(shape)
+        sds = [jax.ShapeDtypeStruct(shard_shape, dtype,
+                                    sharding=SingleDeviceSharding(devs[0]))
+               for _, devs in groups]
+        conn = self._transfer_conn(address)
+        pulled = conn.pull(int(tuid), sds + sds)
+        k_shards, v_shards = pulled[:len(groups)], pulled[len(groups):]
+
+        def assemble(shards):
+            arrays = []
+            for (_, devs), arr in zip(groups, shards):
+                arrays.append(arr)
+                arrays.extend(jax.device_put(arr, d) for d in devs[1:])
+            return jax.make_array_from_single_device_arrays(
+                shape, sharding, arrays)
+
+        k_dev, v_dev = assemble(k_shards), assemble(v_shards)
+        k_dev.block_until_ready()
+        return k_dev, v_dev
 
     def _op_decode(self, tokens, positions, tables, temps, top_k, top_p,
                    warm=False):
@@ -1340,20 +1600,26 @@ class TpuEngine:
             # Stage the prefilled KV for pickup. Device path: gather the
             # slot's pages into fresh device arrays (the gather breaks the
             # alias to the donated page buffers, so blocks free immediately)
-            # and register them with the transfer server for a direct
-            # device-to-device pull. The same arrays back the HTTP /kv route
-            # (converted lazily), so a host-only decode peer still works.
-            # Block count pads to a power-of-two bucket (tail → trash block 0)
-            # so gather here and scatter on the decode side each compile at
-            # most log2(max_blocks)+1 variants, not one per prompt length.
+            # and register their unique shards with the transfer server for a
+            # direct device-to-device pull (one descriptor per process — the
+            # NIXL multi-rank analogue, connector_nixlv2.go:191-253). The
+            # same arrays back the HTTP /kv route (converted lazily), so a
+            # host-only decode peer still works against single-process
+            # exporters. Block count pads to a power-of-two bucket (tail →
+            # trash block 0) so gather here and scatter on the decode side
+            # each compile at most log2(max_blocks)+1 variants.
             bucket = 1
             while bucket < len(s.blocks):
                 bucket *= 2
             bucket = min(bucket, self.max_blocks_per_seq)
-            padded = list(s.blocks) + [0] * (bucket - len(s.blocks))
-            idx = jnp.asarray(np.asarray(padded, np.int32))
-            k_stage = self.k_pages[:, idx]
-            v_stage = self.v_pages[:, idx]
+            padded = np.asarray(list(s.blocks)
+                                + [0] * (bucket - len(s.blocks)), np.int32)
+            tuid = uuid.uuid4().int & ((1 << 63) - 1)
+            # Under dist the gather runs on EVERY process (global pages) and
+            # each process registers its local shards — a leader-only gather
+            # would deadlock the mesh, so it rides the replayed op stream.
+            rec = self._device_call(("stage_kv",), dict(
+                request_id=s.req.request_id, idx=padded, tuid=tuid))
             kv_params = {
                 "remote_engine_id": self.engine_id,
                 "remote_request_id": s.req.request_id,
@@ -1363,28 +1629,28 @@ class TpuEngine:
                 "remote_host": self.cfg.host,
                 "remote_port": self.cfg.port,
             }
-            if self.kv_transfer_server is not None:
-                tuid = uuid.uuid4().int & ((1 << 63) - 1)
-                try:
-                    self.kv_transfer_server.await_pull(tuid, [k_stage, v_stage])
-                    kv_params.update({
-                        "transfer_address": self._transfer_address(),
-                        "transfer_uuid": tuid,
-                        "kv_shape": [int(d) for d in k_stage.shape],
-                        "kv_dtype": str(k_stage.dtype),
-                    })
-                except Exception:
-                    log.exception("kv await_pull failed; host path only")
             with self._exports_lock:
-                self.kv_exports[s.req.request_id] = {
-                    "k": k_stage,
-                    "v": v_stage,
+                rec.update({
                     "num_blocks": len(s.blocks),  # real (un-padded) count
-                    "seq_len": s.position,  # prompt tokens in cache
+                    "seq_len": s.position,        # prompt tokens in cache
                     "first_token": first_token,
-                    "transfer_uuid": kv_params.get("transfer_uuid"),
-                    "created": time.monotonic(),
-                }
+                })
+            if rec.get("transfer_uuid") is not None:
+                kv_params.update({
+                    "transfer_uuid": rec["transfer_uuid"],
+                    "kv_shape": [int(d) for d in rec["k"].shape],
+                    "kv_dtype": str(rec["k"].dtype),
+                })
+                mesh, spec = self._page_layout()
+                if mesh is None:
+                    # Legacy single-device contract: one address, one
+                    # [k, v] pull.
+                    kv_params["transfer_address"] = self._transfer_address()
+                else:
+                    from .kv_shards import mesh_descriptor
+
+                    kv_params["kv_mesh"] = mesh_descriptor(mesh, spec)
+                    kv_params["transfer_shards"] = self._shard_addresses()
         with self._cond:
             self.allocator.free(s.blocks)
             self.telemetry.kv_usage.set(self.allocator.used_fraction)

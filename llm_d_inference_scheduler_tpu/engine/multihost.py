@@ -30,8 +30,9 @@ pods): a process group is an SPMD unit — losing ANY member makes every
 subsequent collective a deadlock, so recovery is always a coordinated
 restart of the whole group, never an in-place rejoin.
 
-- The leader watches each follower socket (followers never send, so a
-  readable socket means EOF/death) and pings the group every
+- The leader watches each follower socket (after the one-time connect
+  hello, followers never send, so a readable socket means EOF/death) and
+  pings the group every
   ``PING_INTERVAL_S`` so followers can distinguish an idle leader from a
   dead one. Loss of a follower fires ``on_peer_lost``: the engine aborts
   all in-flight requests, refuses new ones, and reports degraded on
@@ -96,13 +97,20 @@ class InstructionChannel:
     def __init__(self, *, leader: bool, host: str, port: int,
                  n_followers: int = 0, connect_timeout: float = 60.0,
                  ping_interval: float = PING_INTERVAL_S,
-                 recv_timeout: float = RECV_TIMEOUT_S):
+                 recv_timeout: float = RECV_TIMEOUT_S,
+                 hello: dict[str, Any] | None = None):
         self.leader = leader
         self._lock = threading.Lock()
         self._state_lock = threading.Lock()
         self._closed = False
         self._lost: set[int] = set()
         self.on_peer_lost: Callable[[int, str], None] | None = None
+        # One-time follower→leader handshake: each follower announces itself
+        # (process_id, KV transfer address) right after connecting — the only
+        # bytes a follower ever sends. Keyed by process_id so sharded KV
+        # exports can address per-process transfer servers
+        # (core.py stage_kv op).
+        self.hellos: dict[int, dict[str, Any]] = {}
         if leader:
             self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
             self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -119,6 +127,16 @@ class InstructionChannel:
                 conn, addr = self._srv.accept()
                 conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 log.info("follower connected from %s", addr)
+                conn.settimeout(connect_timeout)
+                try:
+                    info = self._recv_one(conn)
+                except (OSError, ConnectionError) as e:
+                    conn.close()
+                    raise ConnectionError(
+                        f"follower at {addr} sent no hello: {e}") from e
+                conn.settimeout(None)
+                pid = int(info.get("process_id", len(self._peers) + 1))
+                self.hellos[pid] = info
                 self._peers.append(conn)
             self._threads = [
                 threading.Thread(target=self._watch_peer, args=(i,),
@@ -146,6 +164,27 @@ class InstructionChannel:
                     time.sleep(0.2)
             self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._sock.settimeout(recv_timeout)
+            payload = pickle.dumps(dict(hello or {}),
+                                   protocol=pickle.HIGHEST_PROTOCOL)
+            self._sock.sendall(_LEN.pack(len(payload)) + payload)
+
+    @staticmethod
+    def _recv_one(sock: socket.socket) -> dict[str, Any]:
+        """Read one length-prefixed pickled message from ``sock``."""
+        buf = b""
+        while len(buf) < _LEN.size:
+            chunk = sock.recv(_LEN.size - len(buf))
+            if not chunk:
+                raise ConnectionError("closed during hello")
+            buf += chunk
+        (ln,) = _LEN.unpack(buf)
+        data = b""
+        while len(data) < ln:
+            chunk = sock.recv(ln - len(data))
+            if not chunk:
+                raise ConnectionError("closed during hello")
+            data += chunk
+        return pickle.loads(data)
 
     # ---- leader side ----------------------------------------------------
 

@@ -543,6 +543,12 @@ class EngineServer:
             raise web.HTTPNotFound(text=f"no kv export for {rid}")
         if "k" not in rec:
             raise web.HTTPNotImplemented(text="sim engine holds no real KV")
+        if not getattr(rec["k"], "is_fully_addressable", True):
+            # Multi-host export: this process only holds its page shards —
+            # importers must use the sharded device pull (transfer_shards).
+            raise web.HTTPNotImplemented(
+                text="multi-host export has no host-staged body; "
+                     "pull via transfer_shards")
         # Exports may be staged as device arrays (transfer-server path);
         # convert lazily for host-path peers.
         import numpy as np

@@ -51,7 +51,8 @@ from .serve import validate_tp
 from .shardings import param_pspecs
 
 __all__ = ["make_pp_mesh", "shard_params_pp", "pp_page_sharding",
-           "make_pp_decode_chunk", "make_pp_prefill"]
+           "make_pp_decode_chunk", "make_pp_prefill",
+           "make_pp_prefill_with_prefix"]
 
 PP_SERVE_AXES = ("pp", "tp")
 
@@ -427,6 +428,113 @@ def make_pp_prefill(cfg: ModelConfig, mesh: Mesh, bucket: int):
                   P(), P(), P(), P()),
         out_specs=(P(), page_spec, page_spec))
     return jax.jit(sharded, donate_argnums=(3, 4))
+
+
+def make_pp_prefill_with_prefix(cfg: ModelConfig, mesh: Mesh,
+                                suffix_bucket: int, prefix_bucket: int):
+    """Drop-in for TpuEngine._prefix_prefill_fn under pp(+tp): ring prefill
+    continuing from cached prefix KV (llama.prefill_with_prefix:250-324, the
+    automatic-prefix-caching hit path), so pp engines keep the prefix cache
+    instead of disabling it (VERDICT r2 missing #7).
+
+    Each stage's slab gathers ITS layers' cached prefix from its local page
+    shard (layer axis on ``pp``, kv heads on ``tp`` — the gather is
+    collective-free), the suffix attends to prefix+itself causally, and the
+    suffix KV scatters at offset positions with the usual off-turn
+    trash-redirect. The prior window is bounded by ``prefix_bucket`` blocks
+    so a hit costs O(prefix)."""
+    n_stages = mesh.shape["pp"]
+    n_tp = mesh.shape.get("tp", 1)
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def prefill(params, tokens, suffix_len, prefix_len, k_pages, v_pages,
+                block_table_row, prior_table_row, key, temps, top_k, top_p):
+        stage = jax.lax.axis_index("pp")
+        S = tokens.shape[1]
+        assert S == suffix_bucket, (
+            f"prefix prefill traced at S={S}, keyed as bucket={suffix_bucket}")
+        block = k_pages.shape[2]
+        T = prior_table_row.shape[1] * block
+        Dh = cfg.head_dim
+
+        positions = (prefix_len[:, None]
+                     + jnp.arange(S, dtype=jnp.int32)[None, :])      # [1,S]
+        cos, sin = rope_table(positions, Dh, cfg.rope_theta)
+        suffix_valid = jnp.arange(S)[None, :] < suffix_len[:, None]
+        prior_pos = jnp.broadcast_to(
+            jnp.arange(T, dtype=jnp.int32)[None, :], (1, T))
+        prior_valid = prior_pos < prefix_len[:, None]
+        kv_positions = jnp.concatenate([prior_pos, positions], axis=1)
+        kv_valid = jnp.concatenate([prior_valid, suffix_valid], axis=1)
+
+        t = jnp.arange(S, dtype=jnp.int32)
+        tgt = prefix_len[0] + t
+        valid_t = t < suffix_len[0]
+        blk_for_t = jnp.where(valid_t, block_table_row[0, tgt // block], 0)
+        slot_for_t = jnp.where(valid_t, tgt % block, 0)
+
+        x0 = _tp_full(params["embed"][tokens], n_tp, axis=2)  # [1, S, D]
+        zero = jnp.zeros_like(x0)
+
+        def slab(x, k_pages, v_pages, active):
+            def body(x, layer_in):
+                lp, kp, vp = layer_in
+                h = rms_norm(x, lp["ln_attn"], cfg.norm_eps)
+                q = (h @ lp["wq"]).reshape(1, S, -1, Dh)      # local heads
+                k = (h @ lp["wk"]).reshape(1, S, -1, Dh)
+                v = (h @ lp["wv"]).reshape(1, S, -1, Dh)
+                q = llama.apply_rope(q, cos, sin)
+                k = llama.apply_rope(k, cos, sin)
+                k_prior = kp[prior_table_row].reshape(1, T, -1, Dh)
+                v_prior = vp[prior_table_row].reshape(1, T, -1, Dh)
+                attn = llama.causal_attention(
+                    q, jnp.concatenate([k_prior, k], axis=1),
+                    jnp.concatenate([v_prior, v], axis=1),
+                    q_positions=positions, kv_positions=kv_positions,
+                    kv_valid=kv_valid)
+                x = x + jax.lax.psum(attn.reshape(1, S, -1) @ lp["wo"], "tp")
+                h = rms_norm(x, lp["ln_mlp"], cfg.norm_eps)
+                x = x + jax.lax.psum(llama._ffn(cfg, lp, h), "tp")
+                return x, (k, v)
+
+            x, (k_new, v_new) = jax.lax.scan(
+                body, x, (params["layers"], k_pages, v_pages))
+            eff_blk = jnp.where(active, blk_for_t, 0)
+            Lp = k_new.shape[0]
+            k_flat = k_new.reshape(Lp, S, -1, Dh)
+            v_flat = v_new.reshape(Lp, S, -1, Dh)
+            k_pages = k_pages.at[:, eff_blk, slot_for_t].set(
+                k_flat.astype(k_pages.dtype))
+            v_pages = v_pages.at[:, eff_blk, slot_for_t].set(
+                v_flat.astype(v_pages.dtype))
+            return x, k_pages, v_pages
+
+        def turn(tn, carry):
+            x, k_pages, v_pages = carry
+            x = jnp.where(stage == 0, jnp.where(tn == 0, x0, x), x)
+            x, k_pages, v_pages = slab(x, k_pages, v_pages, active=stage == tn)
+            x = jax.lax.ppermute(x, "pp", perm)
+            return x, k_pages, v_pages
+
+        x = jax.lax.pcast(zero, 'pp', to='varying')
+        x, k_pages, v_pages = jax.lax.fori_loop(
+            0, n_stages, turn, (x, k_pages, v_pages))
+        x = jax.lax.psum(jnp.where(stage == 0, x, jnp.zeros_like(x)), "pp")
+        x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+        last = jnp.take_along_axis(x, (suffix_len - 1)[:, None, None],
+                                   axis=1)[:, 0]
+        logits = _tp_full((last @ params["lm_head"]).astype(jnp.float32),
+                          n_tp, axis=1)
+        tok = sample_tokens(logits, key, temps, top_k, top_p)
+        return tok, k_pages, v_pages
+
+    page_spec = PAGE_SPEC
+    sharded = shard_map(
+        prefill, mesh=mesh,
+        in_specs=(_param_specs(cfg), P(), P(), P(), page_spec, page_spec,
+                  P(), P(), P(), P(), P(), P()),
+        out_specs=(P(), page_spec, page_spec))
+    return jax.jit(sharded, donate_argnums=(4, 5))
 
 
 def alloc_pp_pages(cfg: ModelConfig, mesh: Mesh, n_blocks: int):

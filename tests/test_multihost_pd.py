@@ -1,0 +1,208 @@
+"""Multi-host sharded KV handoff (VERDICT r2 missing #6): a 2-process
+prefill group stages per-process shard descriptors; a 2-process decode
+group runs the leader-coordinated pull op — every process fetches its page
+shards from its counterpart and scatters in lockstep. Greedy tokens must
+match a single-process tp=2 monolithic engine.
+
+Reference analogue: NIXL multi-rank transfer descriptors relayed through
+kv_transfer_params (connector_nixlv2.go:191-253).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import multiprocessing as mp
+import os
+
+PROMPT = [1] + [(i * 7) % 350 + 3 for i in range(40)]
+N_GEN = 6
+
+COORD_PRE = "127.0.0.1:19911"
+COORD_DEC = "127.0.0.1:19913"
+INSTR_PRE = 19912
+INSTR_DEC = 19914
+
+
+def _cfg(**kw):
+    from llm_d_inference_scheduler_tpu.engine import EngineConfig
+
+    base = dict(model="tiny", backend="tpu", max_batch=2, max_model_len=64,
+                tp_size=2, decode_chunk=4, kv_events_port=0, seed=3,
+                warmup=False)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+async def _collect(eng, req):
+    out = eng.submit(req)
+    toks, ktp = [], None
+    while True:
+        ev = await asyncio.wait_for(out.get(), timeout=300)
+        if ev.token_id is not None:
+            toks.append(ev.token_id)
+        if ev.finish_reason is not None:
+            return toks, ev.kv_transfer_params
+
+
+def _child_env():
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+
+def _prefill_worker(pid, ktp_q, done_ev, err_q):
+    _child_env()
+    try:
+        from llm_d_inference_scheduler_tpu.engine import EngineRequest
+        from llm_d_inference_scheduler_tpu.engine.core import TpuEngine
+        from llm_d_inference_scheduler_tpu.engine.multihost import (
+            maybe_init_distributed,
+            run_follower,
+        )
+
+        # The decode group compiles for minutes on the single-core CI box;
+        # the default 60 s export TTL would expire (and drain) the staged
+        # shards first, and a pull of a drained uuid blocks forever.
+        import llm_d_inference_scheduler_tpu.engine.core as core
+
+        core.KV_EXPORT_TTL_S = 1200.0
+
+        cfg = _cfg(dist_coordinator=COORD_PRE, dist_num_processes=2,
+                   dist_process_id=pid, dist_instr_port=INSTR_PRE)
+        maybe_init_distributed(cfg)
+        eng = TpuEngine(cfg)
+
+        if pid != 0:
+            run_follower(eng)
+            return
+
+        async def lead():
+            await eng.start()
+            req = EngineRequest(
+                request_id="pd-pre", prompt_token_ids=list(PROMPT),
+                max_tokens=1, temperature=0.0, ignore_eos=True,
+                kv_transfer_params={"do_remote_decode": True})
+            toks, ktp = await _collect(eng, req)
+            ktp_q.put(ktp)
+            # Keep the staged export alive until the decode group pulled it.
+            await asyncio.get_running_loop().run_in_executor(
+                None, done_ev.wait, 240)
+            await eng.stop()
+
+        asyncio.run(lead())
+    except Exception as e:
+        import traceback
+
+        err_q.put(f"prefill pid{pid}: {e}\n{traceback.format_exc()[-2000:]}")
+
+
+def _decode_worker(pid, ktp_q, tok_q, err_q):
+    _child_env()
+    try:
+        from llm_d_inference_scheduler_tpu.engine import EngineRequest
+        from llm_d_inference_scheduler_tpu.engine.core import TpuEngine
+        from llm_d_inference_scheduler_tpu.engine.multihost import (
+            maybe_init_distributed,
+            run_follower,
+        )
+
+        cfg = _cfg(dist_coordinator=COORD_DEC, dist_num_processes=2,
+                   dist_process_id=pid, dist_instr_port=INSTR_DEC)
+        maybe_init_distributed(cfg)
+        eng = TpuEngine(cfg)
+
+        if pid != 0:
+            run_follower(eng)
+            return
+
+        async def lead():
+            await eng.start()
+            ktp = ktp_q.get(timeout=240)
+            req = EngineRequest(
+                request_id="pd-dec", prompt_token_ids=list(PROMPT),
+                max_tokens=N_GEN, temperature=0.0, ignore_eos=True,
+                kv_transfer_params=ktp)
+            toks, _ = await _collect(eng, req)
+            tok_q.put({"tokens": toks,
+                       "device_imports": eng.kv_import_device_count,
+                       "host_imports": eng.kv_import_host_count})
+            await eng.stop()
+
+        asyncio.run(lead())
+    except Exception as e:
+        import traceback
+
+        err_q.put(f"decode pid{pid}: {e}\n{traceback.format_exc()[-2000:]}")
+
+
+def test_dist_pd_sharded_handoff_matches_monolithic():
+    # Reference tokens: single-process tp=2 monolithic engine.
+    from llm_d_inference_scheduler_tpu.engine import EngineRequest
+    from llm_d_inference_scheduler_tpu.engine.core import TpuEngine
+
+    async def mono():
+        eng = TpuEngine(_cfg())
+        await eng.start()
+        try:
+            toks, _ = await _collect(eng, EngineRequest(
+                request_id="mono", prompt_token_ids=list(PROMPT),
+                max_tokens=N_GEN, temperature=0.0, ignore_eos=True))
+            return toks
+        finally:
+            await eng.stop()
+
+    expected = asyncio.run(mono())
+    assert len(expected) == N_GEN
+
+    ctx = mp.get_context("spawn")
+    ktp_q, tok_q, err_q = ctx.Queue(), ctx.Queue(), ctx.Queue()
+    done_ev = ctx.Event()
+    ktp_relay = ctx.Queue()
+    pre_procs = [
+        ctx.Process(target=_prefill_worker, args=(pid, ktp_q, done_ev, err_q),
+                    daemon=True) for pid in range(2)]
+    dec_procs = [
+        ctx.Process(target=_decode_worker, args=(pid, ktp_relay, tok_q, err_q),
+                    daemon=True) for pid in range(2)]
+    procs = pre_procs + dec_procs
+
+    import queue as _queue
+
+    def wait_for(q, what, seconds):
+        for _ in range(seconds):
+            try:
+                return q.get(timeout=1)
+            except _queue.Empty:
+                if not err_q.empty():
+                    raise AssertionError(err_q.get())
+        raise AssertionError(f"timed out waiting for {what}")
+
+    for p in pre_procs:
+        p.start()
+    try:
+        ktp = wait_for(ktp_q, "prefill kv_transfer_params", 600)
+        # Per-process shard descriptors are on the wire.
+        assert len(ktp.get("transfer_shards") or []) == 2
+        assert all(a for a in ktp["transfer_shards"])
+        assert ktp["kv_mesh"]["n_procs"] == 2
+
+        # Stagger the decode group AFTER the export exists: halves peak
+        # compile contention on the single-core CI box (the prefill pair
+        # idles, keeping the staged shards alive).
+        for p in dec_procs:
+            p.start()
+        ktp_relay.put(ktp)
+        result = wait_for(tok_q, "decode tokens", 600)
+        done_ev.set()
+        assert result["device_imports"] == 1
+        assert result["host_imports"] == 0
+        assert result["tokens"] == expected
+    finally:
+        done_ev.set()
+        for p in procs:
+            p.join(timeout=60)
+            if p.is_alive():
+                p.terminate()
+    assert err_q.empty(), err_q.get() if not err_q.empty() else ""
