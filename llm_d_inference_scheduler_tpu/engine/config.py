@@ -68,6 +68,16 @@ class EngineConfig:
     dist_process_id: int = 0
     dist_instr_port: int = 8790
     dist_instr_host: str = ""     # leader bind / follower dial; default host
+    # Follower liveness deadline: no instruction/ping within this window →
+    # LeaderLost (exit for group restart). Production default 30 s; raise on
+    # contended CI boxes where compile bursts starve the ping thread.
+    dist_recv_timeout_s: float = 30.0
+    # Wire for dist sharded KV handoff: "device" = jax.experimental.transfer
+    # pulls (ICI/DCN), "host" = per-process TCP shard servers
+    # (engine/shard_wire.py), "auto" = host on the cpu backend (whose
+    # transfer backend cannot carry same-host cross-process pulls — see
+    # shard_wire.py docstring), device otherwise.
+    kv_wire: str = "auto"
     # KV cache event stream (ZMQ PUB) feeding the router's precise prefix
     # scorer; 0 disables, -1 = port + 1000.
     kv_events_port: int = -1

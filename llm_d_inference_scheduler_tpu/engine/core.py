@@ -187,6 +187,23 @@ class TpuEngine:
                     raise
                 log.info("kv transfer server unavailable; host-staged "
                          "HTTP handoff only", exc_info=True)
+        # Host-staged shard wire (engine/shard_wire.py): the cross-process
+        # transport for sharded exports when the jax transfer backend can't
+        # carry them. kv_wire "auto" resolves to "host" on the cpu backend —
+        # jax.experimental.transfer's cpu backend fatally crashes (local bulk
+        # transport) or hangs (socket transport) on same-host cross-process
+        # pulls — and to "device" on real TPU meshes.
+        self.kv_shard_wire = None
+        self._kv_wire = cfg.kv_wire
+        if self._kv_wire == "auto":
+            self._kv_wire = ("host" if jax.default_backend() == "cpu"
+                             else "device")
+        if self._dist and self._kv_wire == "host":
+            # Only the active wire runs a server — on device-wire TPU meshes
+            # nothing would ever pull from (or register on) the host wire.
+            from .shard_wire import ShardWireServer
+
+            self.kv_shard_wire = ShardWireServer(cfg.host)
         self._instr_channel = None
         if self._dist:
             # jax.distributed.initialize must already have run (server main /
@@ -198,7 +215,11 @@ class TpuEngine:
                 host=cfg.dist_instr_host or cfg.host,
                 port=cfg.dist_instr_port,
                 n_followers=cfg.dist_num_processes - 1,
+                recv_timeout=cfg.dist_recv_timeout_s,
                 hello={"process_id": cfg.dist_process_id,
+                       "shard_wire_address":
+                           (self.kv_shard_wire.address()
+                            if self.kv_shard_wire is not None else None),
                        "transfer_address":
                            (self._transfer_address()
                             if self.kv_transfer_server is not None else None)})
@@ -443,6 +464,8 @@ class TpuEngine:
             self._instr_channel.close()
         if self.kv_events is not None:
             self.kv_events.close()
+        if self.kv_shard_wire is not None:
+            self.kv_shard_wire.close()
 
     def submit(self, req: EngineRequest) -> asyncio.Queue:
         """Thread-safe enqueue; returns the per-request event queue."""
@@ -498,7 +521,11 @@ class TpuEngine:
     def _release_export_local(self, request_id: str, consumed: str) -> None:
         with self._exports_lock:
             rec = self.kv_exports.pop(request_id, None)
-        if rec is not None and consumed != "device":
+        if rec is None:
+            return
+        if self.kv_shard_wire is not None and rec.get("shard_wire_uuid") is not None:
+            self.kv_shard_wire.unregister(rec["shard_wire_uuid"])
+        if consumed != "device":
             self._drain_staged_transfer(rec)
 
     def _drain_staged_transfer(self, rec: dict[str, Any]) -> None:
@@ -1010,14 +1037,21 @@ class TpuEngine:
 
         def fetch():
             if (ktp.get("transfer_shards") and ktp.get("kv_mesh")
-                    and self.kv_transfer_server is not None):
+                    and (self.kv_transfer_server is not None
+                         or self.kv_shard_wire is not None)):
                 # Sharded exporter. Multi-host importer: only preflight here
                 # (the pull is a coordinated engine-thread op); single-proc
                 # importer pulls every shard from the one exporter address.
                 try:
                     self._check_shard_geometry(ktp)
                     if self._dist:
-                        for addr in ktp["transfer_shards"]:
+                        wire_addrs = (ktp.get("shard_wire_addrs")
+                                      if self._kv_wire == "host"
+                                      else ktp["transfer_shards"])
+                        if not wire_addrs or not all(wire_addrs):
+                            raise ValueError(
+                                f"no usable {self._kv_wire} wire addresses")
+                        for addr in wire_addrs:
                             _tcp_preflight(addr)
                         pi.dist_pull = True
                         with self._cond:
@@ -1226,10 +1260,14 @@ class TpuEngine:
             self._device_call(("pull_kv_import",), dict(
                 blocks_pad=padded_blocks,
                 addresses=list(ktp["transfer_shards"]),
+                shard_addrs=list(ktp.get("shard_wire_addrs") or []),
                 tuid=int(ktp["transfer_uuid"]),
                 shape=[int(d) for d in shape],
                 dtype=str(ktp["kv_dtype"])))
-            self.kv_import_device_count += 1
+            if self._kv_wire == "host":
+                self.kv_import_host_count += 1
+            else:
+                self.kv_import_device_count += 1
             self._release_remote_export(ktp)
         elif pi.k_dev is not None:
             # Device path: already on this engine's device; scatter directly.
@@ -1374,12 +1412,26 @@ class TpuEngine:
     def _shard_addresses(self) -> list[str]:
         """Per-process transfer addresses in process order (self first when
         leading): a sharded importer pulls its shards from its counterpart
-        process. Single-process: just this engine's address."""
-        addrs = [self._transfer_address()]
+        process. Single-process: just this engine's address. "" marks a
+        process with no transfer server (host-wire deployments) — the
+        importer's all()-guard rejects the device wire then."""
+        addrs = [self._transfer_address()
+                 if self.kv_transfer_server is not None else ""]
         if self._instr_channel is not None and self._instr_channel.leader:
             for pid in range(1, self.cfg.dist_num_processes):
                 hello = self._instr_channel.hellos.get(pid) or {}
                 addrs.append(hello.get("transfer_address") or "")
+        return addrs
+
+    def _shard_wire_addresses(self) -> list[str]:
+        """Per-process host shard-wire addresses, process order (dist only)."""
+        if self.kv_shard_wire is None:
+            return []
+        addrs = [self.kv_shard_wire.address()]
+        if self._instr_channel is not None and self._instr_channel.leader:
+            for pid in range(1, self.cfg.dist_num_processes):
+                hello = self._instr_channel.hellos.get(pid) or {}
+                addrs.append(hello.get("shard_wire_address") or "")
         return addrs
 
     def _op_stage_kv(self, request_id: str, idx: np.ndarray, tuid: int):
@@ -1405,10 +1457,22 @@ class TpuEngine:
             v_stage = self.v_pages[:, idx_dev]
         staged_shards = None
         registered = None
-        if self.kv_transfer_server is not None:
+        wire_uuid = None
+        shards = None
+        if self.kv_transfer_server is not None or self.kv_shard_wire is not None:
+            shards = (local_unique_shards(k_stage)
+                      + local_unique_shards(v_stage))
+        if self.kv_shard_wire is not None:
+            # Host shard wire: every process serves its own shard list; the
+            # registry holds the device arrays, D2H happens at pull time.
+            self.kv_shard_wire.register(tuid, shards)
+            wire_uuid = tuid
+        if (self.kv_transfer_server is not None
+                and not (self._dist and self._kv_wire == "host")):
+            # Skip the transfer-server registration when the resolved wire is
+            # host-staged (cpu backend): nothing would ever pull it, and the
+            # release path would have to self-drain every export.
             try:
-                shards = (local_unique_shards(k_stage)
-                          + local_unique_shards(v_stage))
                 self.kv_transfer_server.await_pull(tuid, shards)
                 staged_shards = shards
                 registered = tuid
@@ -1420,7 +1484,12 @@ class TpuEngine:
                     # the group restarts) instead of wedging the peer slice.
                     raise
                 log.exception("kv await_pull failed; host path only")
-        rec = {"k": k_stage, "v": v_stage, "transfer_uuid": registered,
+        # transfer_uuid is the wire-advertised pull id whichever wire carried
+        # the registration; staged_shards stays None unless the transfer
+        # server holds a registration (it gates the self-drain on release).
+        rec = {"k": k_stage, "v": v_stage,
+               "transfer_uuid": registered if registered is not None else wire_uuid,
+               "shard_wire_uuid": wire_uuid,
                "staged_shards": staged_shards, "created": time.monotonic()}
         with self._exports_lock:
             self.kv_exports[request_id] = rec
@@ -1430,16 +1499,23 @@ class TpuEngine:
         self._release_export_local(request_id, consumed)
 
     def _op_pull_kv_import(self, blocks_pad: np.ndarray, addresses: list[str],
-                           tuid: int, shape: tuple, dtype: str):
+                           tuid: int, shape: tuple, dtype: str,
+                           shard_addrs: list[str] | None = None):
         """Coordinated sharded pull + scatter (dist decode side): every
         process pulls its unique page shards from its counterpart prefill
-        process, assembles the global staged array, and runs the same
-        scatter op as a local import. A process whose pull fails raises —
-        under dist that is a group-restart fault (the other processes are
-        already inside the op)."""
-        k_dev, v_dev = self._pull_sharded_arrays(
-            addresses[jax.process_index()], tuid, tuple(shape),
-            jnp.dtype(dtype))
+        process — over the device transfer server or the host shard wire,
+        per the resolved kv_wire — assembles the global staged array, and
+        runs the same scatter op as a local import. A process whose pull
+        fails raises — under dist that is a group-restart fault (the other
+        processes are already inside the op)."""
+        if self._kv_wire == "host" and shard_addrs:
+            k_dev, v_dev = self._pull_sharded_arrays_host(
+                shard_addrs[jax.process_index()], tuid, tuple(shape),
+                jnp.dtype(dtype))
+        else:
+            k_dev, v_dev = self._pull_sharded_arrays(
+                addresses[jax.process_index()], tuid, tuple(shape),
+                jnp.dtype(dtype))
         self.k_pages, self.v_pages = self._jit_import(
             self.k_pages, self.v_pages, self._put(blocks_pad), k_dev, v_dev)
 
@@ -1472,6 +1548,43 @@ class TpuEngine:
                 shape, sharding, arrays)
 
         k_dev, v_dev = assemble(k_shards), assemble(v_shards)
+        k_dev.block_until_ready()
+        return k_dev, v_dev
+
+    def _pull_sharded_arrays_host(self, address: str, tuid: int,
+                                  shape: tuple, dtype) -> tuple[Any, Any]:
+        """Host shard wire variant of :meth:`_pull_sharded_arrays`: fetch
+        this process's shard bytes from its counterpart's ShardWireServer
+        and assemble the global arrays under the local page sharding. Shard
+        order on the wire is the exporter's canonical
+        local_unique_shards(k) + local_unique_shards(v) — the same order the
+        importer's local_shard_groups produces under symmetric geometry
+        (enforced by _check_shard_geometry)."""
+        from .kv_shards import local_shard_groups, staged_sharding
+        from .shard_wire import pull_shards
+
+        mesh, spec = self._page_layout()
+        sharding = staged_sharding(mesh, spec)
+        groups = local_shard_groups(sharding, shape)
+        shard_shape = sharding.shard_shape(shape)
+        arrs = pull_shards(address, int(tuid))
+        if len(arrs) != 2 * len(groups):
+            raise ValueError(f"shard wire returned {len(arrs)} shards, "
+                             f"expected {2 * len(groups)}")
+        for a in arrs:
+            if tuple(a.shape) != tuple(shard_shape):
+                raise ValueError(f"shard shape {a.shape} != {shard_shape}")
+
+        def assemble(shards_np):
+            arrays = []
+            for (_, devs), np_arr in zip(groups, shards_np):
+                np_arr = np_arr.astype(dtype, copy=False)
+                arrays.extend(jax.device_put(np_arr, d) for d in devs)
+            return jax.make_array_from_single_device_arrays(
+                shape, sharding, arrays)
+
+        k_dev = assemble(arrs[:len(groups)])
+        v_dev = assemble(arrs[len(groups):])
         k_dev.block_until_ready()
         return k_dev, v_dev
 
@@ -1651,6 +1764,9 @@ class TpuEngine:
 
                     kv_params["kv_mesh"] = mesh_descriptor(mesh, spec)
                     kv_params["transfer_shards"] = self._shard_addresses()
+                    if self.kv_shard_wire is not None:
+                        kv_params["shard_wire_addrs"] = (
+                            self._shard_wire_addresses())
         with self._cond:
             self.allocator.free(s.blocks)
             self.telemetry.kv_usage.set(self.allocator.used_fraction)

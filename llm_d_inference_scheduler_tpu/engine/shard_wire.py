@@ -1,0 +1,163 @@
+"""Host-staged shard wire: per-process TCP transport for sharded KV handoff.
+
+The primary wire for P/D KV movement between sharded engines is
+``jax.experimental.transfer`` (device-to-device over ICI/DCN). Its CPU
+backend, however, cannot serve cross-process pulls on one machine: the
+same-host transport negotiation selects the in-process "local bulk
+transport" and the exporter dies on a fatal
+``Check failed: it != local_bulk_transports_.end()`` (observed with a
+minimal two-process repro; forcing socket transport addresses instead makes
+the pull block forever). So CPU meshes — the test substrate for every
+multi-host path in this repo, and any cpu-backend deployment — need a wire
+that actually moves bytes.
+
+This module is that wire: one tiny TCP server thread per process serving
+this process's staged shard list by uuid, and a client that fetches them.
+The protocol is length-prefixed and self-describing:
+
+    request:  8-byte big-endian uuid
+    response: 4-byte count (0xFFFFFFFF = unknown uuid), then per shard:
+              4-byte header length, header JSON {"dtype", "shape"},
+              8-byte payload length, raw array bytes (C order)
+
+Shards are stored as device arrays and converted to host bytes only when a
+peer actually pulls (one D2H per shard at pull time — the same staging cost
+as the single-device host path). The engine selects this wire automatically
+when running on the cpu backend (``EngineConfig.kv_wire = "auto"``); real
+TPU meshes keep the device transfer path.
+
+Reference analogue: the NIXL side-channel handshake relays opaque transfer
+descriptors the engines resolve rank-by-rank (connector_nixlv2.go:191-253);
+here the descriptor is (address, uuid) per process.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import struct
+import threading
+from typing import Any
+
+import numpy as np
+
+log = logging.getLogger("engine.shard_wire")
+
+_UUID = struct.Struct(">Q")
+_U32 = struct.Struct(">I")
+_U64 = struct.Struct(">Q")
+_UNKNOWN = 0xFFFFFFFF
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("shard wire peer closed")
+        buf += chunk
+    return buf
+
+
+class ShardWireServer:
+    """Serves this process's staged shards by uuid on a daemon thread."""
+
+    def __init__(self, host: str):
+        self._host = host
+        self._registry: dict[int, list[Any]] = {}
+        self._lock = threading.Lock()
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, 0))
+        self._srv.listen(16)
+        self._port = self._srv.getsockname()[1]
+        self._closed = False
+        self._thread = threading.Thread(target=self._serve, name="shard-wire",
+                                        daemon=True)
+        self._thread.start()
+
+    def address(self) -> str:
+        return f"{self._host}:{self._port}"
+
+    def register(self, tuid: int, shards: list[Any]) -> None:
+        with self._lock:
+            self._registry[int(tuid)] = list(shards)
+
+    def unregister(self, tuid: int) -> None:
+        with self._lock:
+            self._registry.pop(int(tuid), None)
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+    # ---- server loop ----------------------------------------------------
+
+    def _serve(self) -> None:
+        while not self._closed:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return  # closed
+            threading.Thread(target=self._handle, args=(conn,),
+                             name="shard-wire-conn", daemon=True).start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        try:
+            with conn:
+                conn.settimeout(60.0)
+                (tuid,) = _UUID.unpack(_recv_exact(conn, _UUID.size))
+                with self._lock:
+                    shards = self._registry.get(tuid)
+                if shards is None:
+                    conn.sendall(_U32.pack(_UNKNOWN))
+                    return
+                conn.sendall(_U32.pack(len(shards)))
+                for arr in shards:
+                    # D2H at pull time; staged arrays stay on device until a
+                    # peer actually wants the bytes.
+                    np_arr = np.asarray(arr)
+                    hdr = json.dumps({"dtype": str(np_arr.dtype),
+                                      "shape": list(np_arr.shape)}).encode()
+                    payload = np_arr.tobytes(order="C")
+                    conn.sendall(_U32.pack(len(hdr)) + hdr
+                                 + _U64.pack(len(payload)))
+                    conn.sendall(payload)
+        except Exception:
+            if not self._closed:
+                log.debug("shard wire connection failed", exc_info=True)
+
+
+def pull_shards(address: str, tuid: int,
+                timeout: float = 120.0) -> list[np.ndarray]:
+    """Fetch the shard list registered under ``tuid`` at ``address``."""
+    host, _, port = address.rpartition(":")
+    with socket.create_connection((host, int(port)), timeout=timeout) as conn:
+        conn.settimeout(timeout)
+        conn.sendall(_UUID.pack(int(tuid)))
+        (count,) = _U32.unpack(_recv_exact(conn, _U32.size))
+        if count == _UNKNOWN:
+            raise KeyError(f"uuid {tuid} not staged at {address}")
+        out: list[np.ndarray] = []
+        for _ in range(count):
+            (hl,) = _U32.unpack(_recv_exact(conn, _U32.size))
+            hdr = json.loads(_recv_exact(conn, hl))
+            (pl,) = _U64.unpack(_recv_exact(conn, _U64.size))
+            data = _recv_exact(conn, pl)
+            out.append(np.frombuffer(data, dtype=_np_dtype(hdr["dtype"]))
+                       .reshape(hdr["shape"]))
+        return out
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """np.dtype lookup that understands the ml_dtypes names (bfloat16 …)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))

@@ -46,22 +46,40 @@ def _kernel(bt_ref, sl_ref,            # scalar prefetch: [B*maxB], [B]
     l0 = jnp.zeros((n_kv, q_per_kv, 1), jnp.float32)
     acc0 = jnp.zeros((n_kv, q_per_kv, head_dim), jnp.float32)
 
+    # Double-buffered page pipeline: page j+1's HBM→VMEM DMA is in flight
+    # while page j is computed, so the grid's B sequential programs pay DMA
+    # latency once per program instead of once per page (the serial
+    # start/wait version was the decode wall at large batch: B × pages ×
+    # layers blocking latencies per step).
+    def _copies(j, slot):
+        blk = bt_ref[b * max_blocks + j]
+        return (pltpu.make_async_copy(k_hbm.at[blk], k_scratch.at[slot],
+                                      sem_k.at[slot]),
+                pltpu.make_async_copy(v_hbm.at[blk], v_scratch.at[slot],
+                                      sem_v.at[slot]))
+
+    @pl.when(0 < cached_len)
+    def _prologue():
+        ck, cv = _copies(0, 0)
+        ck.start()
+        cv.start()
+
     def block_body(j, carry):
         m, l, acc = carry
+        slot = jax.lax.rem(j, 2)
 
-        @pl.when(j * block < cached_len)
-        def _fetch():
-            blk = bt_ref[b * max_blocks + j]
-            ck = pltpu.make_async_copy(k_hbm.at[blk], k_scratch, sem_k)
-            cv = pltpu.make_async_copy(v_hbm.at[blk], v_scratch, sem_v)
+        @pl.when((j + 1) * block < cached_len)
+        def _prefetch_next():
+            ck, cv = _copies(j + 1, jax.lax.rem(j + 1, 2))
             ck.start()
             cv.start()
-            ck.wait()
-            cv.wait()
 
         def compute(m, l, acc):
-            k = k_scratch[:].astype(jnp.float32)       # [bs, G, D]
-            v = v_scratch[:].astype(jnp.float32)
+            ck, cv = _copies(j, slot)
+            ck.wait()
+            cv.wait()
+            k = k_scratch[slot].astype(jnp.float32)    # [bs, G, D]
+            v = v_scratch[slot].astype(jnp.float32)
             pos = j * block + jax.lax.broadcasted_iota(
                 jnp.int32, (1, block), 1)               # [1, bs]
             valid = pos < cached_len                    # [1, bs]
@@ -140,10 +158,10 @@ def paged_decode_attention_pallas(
         ],
         out_specs=pl.BlockSpec((1, H, D), lambda b, *_: (b, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((block, n_kv, D), k_pages.dtype),
-            pltpu.VMEM((block, n_kv, D), v_pages.dtype),
-            pltpu.SemaphoreType.DMA(()),
-            pltpu.SemaphoreType.DMA(()),
+            pltpu.VMEM((2, block, n_kv, D), k_pages.dtype),
+            pltpu.VMEM((2, block, n_kv, D), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
         ],
     )
     return pl.pallas_call(

@@ -425,10 +425,18 @@ class Gateway:
                 if stream_state is not None:
                     stream_state["started"] = True
                 await ws.prepare(request)
+                sse_carry = b""
                 async for chunk in resp.aiter_bytes():
+                    # TTFT counts the first *token-bearing* event: a role-only
+                    # chat delta (no content) would otherwise flatter the
+                    # metric. Events split across transport chunks are
+                    # reassembled via the carry; unparseable events count
+                    # (fail-open).
                     if first_byte_at is None:
-                        first_byte_at = time.monotonic()
-                        TTFT_SECONDS.labels(model_label).observe(first_byte_at - t_start)
+                        found, sse_carry = _sse_scan_for_token(sse_carry, chunk)
+                        if found:
+                            first_byte_at = time.monotonic()
+                            TTFT_SECONDS.labels(model_label).observe(first_byte_at - t_start)
                     if ireq is not None:
                         self.director.handle_response_streaming(None, ireq, endpoint, chunk)
                     usage = _usage_from_sse(chunk) or usage
@@ -545,6 +553,39 @@ def _usage_from_json(data: bytes) -> dict[str, int] | None:
         return u if isinstance(u, dict) else None
     except Exception:
         return None
+
+
+def _sse_scan_for_token(carry: bytes, chunk: bytes) -> tuple[bool, bytes]:
+    """Scan complete SSE lines in ``carry + chunk`` for generated output
+    (completion text or a chat delta with content) — role-only/handshake
+    deltas don't count toward TTFT. Returns (saw_token, new_carry) where
+    new_carry is the trailing partial line, so events split across transport
+    chunks are reassembled instead of misclassified. Complete-but-unparseable
+    data lines count, so unknown engines keep the old first-byte semantics."""
+    data = carry + chunk
+    lines = data.split(b"\n")
+    carry = lines.pop()  # trailing partial line ('' when chunk ends on \n)
+    if len(carry) > 1 << 20:
+        # A megabyte with no newline is not an SSE event stream; fail open
+        # rather than buffer unboundedly.
+        return True, b""
+    for line in lines:
+        line = line.rstrip(b"\r")
+        if not line.startswith(b"data: ") or line == b"data: [DONE]":
+            continue
+        try:
+            doc = json.loads(line[6:])
+        except Exception:
+            return True, carry
+        for choice in doc.get("choices") or []:
+            if choice.get("text"):
+                return True, carry
+            delta = choice.get("delta") or {}
+            if delta.get("content") or delta.get("tool_calls"):
+                return True, carry
+        if "choices" not in doc:
+            return True, carry  # not an OpenAI chunk shape — fail open
+    return False, carry
 
 
 def _usage_from_sse(chunk: bytes) -> dict[str, int] | None:

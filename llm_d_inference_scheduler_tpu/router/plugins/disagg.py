@@ -125,6 +125,40 @@ class DataParallelProfileHandler(PluginBase):
             f"{ep.metadata.address}:{ep.metadata.port + rank}")
 
 
+@register_plugin("disagg-headers-handler", "prefill-header-handler")
+class DisaggHeadersHandler(PluginBase):
+    """Header-only PreRequest wiring for externally-orchestrated disagg
+    profiles (reference disagg_headers_handler.go — deprecated there in
+    favor of disagg-profile-handler's native PreRequest, kept for config
+    compatibility): reads the named prefill/encode profile results off the
+    SchedulingResult and writes x-prefiller-host-port /
+    x-encoder-hosts-ports, clearing any stale values first."""
+
+    def __init__(self, name: str | None = None):
+        super().__init__(name)
+        self.prefill_profile = "prefill"
+        self.encode_profile = "encode"
+
+    def configure(self, params: dict[str, Any], handle: Any) -> None:
+        self.prefill_profile = params.get("prefillProfile", self.prefill_profile)
+        self.encode_profile = params.get("encodeProfile", self.encode_profile)
+
+    def pre_request(self, ctx, request: InferenceRequest,
+                    result: SchedulingResult) -> None:
+        if result is None:
+            return
+        request.headers.pop(H_PREFILLER, None)
+        prefill = result.profile_results.get(self.prefill_profile)
+        if prefill and prefill.target_endpoints:
+            request.headers[H_PREFILLER] = (
+                prefill.target_endpoints[0].metadata.address_port)
+        request.headers.pop(H_ENCODERS, None)
+        encode = result.profile_results.get(self.encode_profile)
+        if encode and encode.target_endpoints:
+            request.headers[H_ENCODERS] = ",".join(
+                ep.metadata.address_port for ep in encode.target_endpoints)
+
+
 @register_plugin("disagg-profile-handler", "pd-profile-handler")
 class DisaggProfileHandler(PluginBase):
     """Unified D / P-D (E-stages reserved) profile orchestration."""
@@ -193,9 +227,14 @@ class DisaggProfileHandler(PluginBase):
 
     def pre_request(self, ctx, request: InferenceRequest,
                     result: SchedulingResult) -> None:
+        # Delete-then-set (reference disagg_profile_handler.go PreRequest):
+        # ingress already strips client-supplied routing headers, but an
+        # earlier plugin in the PreRequest chain may have written them.
+        request.headers.pop(H_PREFILLER, None)
         prefill = result.profile_results.get(self.PREFILL)
         if prefill and prefill.target_endpoints:
             request.headers[H_PREFILLER] = prefill.target_endpoints[0].metadata.address_port
+        request.headers.pop(H_ENCODERS, None)
         encode = result.profile_results.get(self.ENCODE)
         if encode and encode.target_endpoints:
             request.headers[H_ENCODERS] = ",".join(

@@ -1,0 +1,140 @@
+"""CRD schema definitions (deploy/crds/) stay honest.
+
+The reference ships controller-gen CRDs (config/crd/bases/); ours are
+hand-written against exactly the fields router/kube.py's KubeBinding reads.
+These tests (a) pin group/version/plural to the binding's watch paths,
+(b) validate realistic CR fixtures against the openAPIV3Schema with a
+minimal structural validator, and (c) reject malformed CRs, so a schema or
+binding drift fails loudly.
+"""
+
+import glob
+import os
+
+import yaml
+
+from llm_d_inference_scheduler_tpu.router.kube import CRD_GROUP, CRD_VERSION
+
+CRD_DIR = os.path.join(os.path.dirname(__file__), "..", "deploy", "crds")
+
+
+def load_crds() -> dict[str, dict]:
+    out = {}
+    for path in glob.glob(os.path.join(CRD_DIR, "*.yaml")):
+        with open(path) as f:
+            doc = yaml.safe_load(f)
+        assert doc["kind"] == "CustomResourceDefinition"
+        out[doc["spec"]["names"]["plural"]] = doc
+    return out
+
+
+def validate(schema: dict, value, path="$") -> list[str]:
+    """Minimal openAPIV3Schema structural validator: type, required,
+    properties, additionalProperties, items, enum, bounds, lengths."""
+    errs: list[str] = []
+    t = schema.get("type")
+    type_map = {"object": dict, "array": list, "string": str,
+                "integer": int, "number": (int, float), "boolean": bool}
+    if t and not isinstance(value, type_map[t]):
+        return [f"{path}: expected {t}, got {type(value).__name__}"]
+    if t == "integer" and isinstance(value, bool):
+        return [f"{path}: expected integer, got bool"]
+    if "enum" in schema and value not in schema["enum"]:
+        errs.append(f"{path}: {value!r} not in enum")
+    if t == "object":
+        for req in schema.get("required", []):
+            if req not in value:
+                errs.append(f"{path}.{req}: required")
+        props = schema.get("properties", {})
+        addl = schema.get("additionalProperties")
+        for k, v in value.items():
+            if k in props:
+                errs += validate(props[k], v, f"{path}.{k}")
+            elif isinstance(addl, dict):
+                errs += validate(addl, v, f"{path}.{k}")
+    if t == "array":
+        if "minItems" in schema and len(value) < schema["minItems"]:
+            errs.append(f"{path}: fewer than {schema['minItems']} items")
+        for i, v in enumerate(value):
+            errs += validate(schema.get("items", {}), v, f"{path}[{i}]")
+    if t == "integer" and not isinstance(value, bool):
+        if "minimum" in schema and value < schema["minimum"]:
+            errs.append(f"{path}: {value} < minimum")
+        if "maximum" in schema and value > schema["maximum"]:
+            errs.append(f"{path}: {value} > maximum")
+    if t == "string":
+        if "minLength" in schema and len(value) < schema["minLength"]:
+            errs.append(f"{path}: shorter than minLength")
+        if "maxLength" in schema and len(value) > schema["maxLength"]:
+            errs.append(f"{path}: longer than maxLength")
+    return errs
+
+
+def crd_schema(crd: dict) -> dict:
+    versions = crd["spec"]["versions"]
+    assert len(versions) == 1 and versions[0]["storage"]
+    return versions[0]["schema"]["openAPIV3Schema"]
+
+
+def test_crds_match_kube_binding_watch_paths():
+    crds = load_crds()
+    # KubeBinding watches these collections under /apis/<group>/<version>/
+    # (router/kube.py:322-335); the CRDs must declare the same coordinates.
+    assert set(crds) == {"inferencepools", "inferenceobjectives",
+                        "inferencemodelrewrites"}
+    for plural, crd in crds.items():
+        assert crd["spec"]["group"] == CRD_GROUP
+        assert crd["spec"]["versions"][0]["name"] == CRD_VERSION
+        assert crd["metadata"]["name"] == f"{plural}.{CRD_GROUP}"
+        assert crd["spec"]["scope"] == "Namespaced"
+
+
+def test_valid_fixtures_pass():
+    crds = load_crds()
+    fixtures = {
+        "inferencepools": {
+            "apiVersion": f"{CRD_GROUP}/{CRD_VERSION}",
+            "kind": "InferencePool",
+            "metadata": {"name": "pool"},
+            "spec": {"selector": {"matchLabels": {"app": "engine"}},
+                     "targetPort": 8200, "metricsPort": 8201},
+        },
+        "inferenceobjectives": {
+            "apiVersion": f"{CRD_GROUP}/{CRD_VERSION}",
+            "kind": "InferenceObjective",
+            "metadata": {"name": "batch"},
+            "spec": {"priority": -1, "poolRef": {"name": "pool"}},
+        },
+        "inferencemodelrewrites": {
+            "apiVersion": f"{CRD_GROUP}/{CRD_VERSION}",
+            "kind": "InferenceModelRewrite",
+            "metadata": {"name": "canary"},
+            "spec": {"sourceModel": "llama3", "targets": [
+                {"model": "llama3-prod", "weight": 9},
+                {"model": "llama3-canary", "weight": 1}]},
+        },
+    }
+    for plural, obj in fixtures.items():
+        errs = validate(crd_schema(crds[plural]), obj)
+        assert not errs, f"{plural}: {errs}"
+
+
+def test_malformed_fixtures_fail():
+    crds = load_crds()
+    bad = {
+        # missing required spec.selector
+        "inferencepools": {"spec": {"targetPort": 8200}},
+        # priority must be an integer
+        "inferenceobjectives": {"spec": {"priority": "high"}},
+        # targets requires >= 1 item with model set
+        "inferencemodelrewrites": {"spec": {"sourceModel": "m", "targets": []}},
+    }
+    for plural, obj in bad.items():
+        errs = validate(crd_schema(crds[plural]), obj)
+        assert errs, f"{plural}: malformed object passed validation"
+
+
+def test_pool_port_bounds():
+    crds = load_crds()
+    obj = {"spec": {"selector": {}, "targetPort": 70000}}
+    assert validate(crd_schema(crds["inferencepools"]), obj)

@@ -28,7 +28,12 @@ def _cfg(**kw):
 
     base = dict(model="tiny", backend="tpu", max_batch=2, max_model_len=64,
                 tp_size=2, decode_chunk=4, kv_events_port=0, seed=3,
-                warmup=False)
+                warmup=False,
+                # 4 processes share one CI core: a compile burst can starve
+                # a ping thread past the 30 s production deadline, killing
+                # the prefill follower (and its staged KV shard server)
+                # before the decode group pulls.
+                dist_recv_timeout_s=600.0)
     base.update(kw)
     return EngineConfig(**base)
 
@@ -52,7 +57,7 @@ def _child_env():
     jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
 
-def _prefill_worker(pid, ktp_q, done_ev, err_q):
+def _prefill_worker(pid, ktp_q, done_q, err_q):
     _child_env()
     try:
         from llm_d_inference_scheduler_tpu.engine import EngineRequest
@@ -86,9 +91,19 @@ def _prefill_worker(pid, ktp_q, done_ev, err_q):
                 kv_transfer_params={"do_remote_decode": True})
             toks, ktp = await _collect(eng, req)
             ktp_q.put(ktp)
+
             # Keep the staged export alive until the decode group pulled it.
-            await asyncio.get_running_loop().run_in_executor(
-                None, done_ev.wait, 240)
+            # A Queue, not an mp.Event: only the parent ever writes it, so a
+            # crashed reader can never leave the write path's lock held —
+            # an Event.set() in the parent deadlocked forever when a child
+            # died inside Event.wait() holding the shared condition lock.
+            def _await_done():
+                try:
+                    done_q.get(timeout=240)
+                except Exception:
+                    pass
+
+            await asyncio.get_running_loop().run_in_executor(None, _await_done)
             await eng.stop()
 
         asyncio.run(lead())
@@ -158,10 +173,10 @@ def test_dist_pd_sharded_handoff_matches_monolithic():
 
     ctx = mp.get_context("spawn")
     ktp_q, tok_q, err_q = ctx.Queue(), ctx.Queue(), ctx.Queue()
-    done_ev = ctx.Event()
+    done_q = ctx.Queue()
     ktp_relay = ctx.Queue()
     pre_procs = [
-        ctx.Process(target=_prefill_worker, args=(pid, ktp_q, done_ev, err_q),
+        ctx.Process(target=_prefill_worker, args=(pid, ktp_q, done_q, err_q),
                     daemon=True) for pid in range(2)]
     dec_procs = [
         ctx.Process(target=_decode_worker, args=(pid, ktp_relay, tok_q, err_q),
@@ -195,14 +210,63 @@ def test_dist_pd_sharded_handoff_matches_monolithic():
             p.start()
         ktp_relay.put(ktp)
         result = wait_for(tok_q, "decode tokens", 600)
-        done_ev.set()
-        assert result["device_imports"] == 1
-        assert result["host_imports"] == 0
+        done_q.put(True)
+        # kv_wire auto resolves to the host shard wire on the cpu backend:
+        # jax.experimental.transfer cannot carry same-host cross-process
+        # pulls there (fatal local-transport check / socket-transport hang —
+        # engine/shard_wire.py docstring). The coordinated sharded pull op,
+        # descriptors, and lockstep scatter are identical for both wires;
+        # the device wire itself is exercised by test_kv_device_transfer
+        # (same-process) and on real TPU meshes.
+        assert result["device_imports"] == 0
+        assert result["host_imports"] == 1
         assert result["tokens"] == expected
     finally:
-        done_ev.set()
+        done_q.put(True)  # idempotent release; put never blocks here
         for p in procs:
             p.join(timeout=60)
             if p.is_alive():
                 p.terminate()
+        for p in procs:
+            if p.is_alive():
+                p.join(timeout=10)
+                if p.is_alive():
+                    p.kill()
     assert err_q.empty(), err_q.get() if not err_q.empty() else ""
+
+
+def test_shard_wire_roundtrip():
+    """ShardWireServer protocol: register → pull → byte-exact arrays,
+    unknown uuid errors, unregister drops."""
+    import numpy as np
+    import pytest
+
+    from llm_d_inference_scheduler_tpu.engine.shard_wire import (
+        ShardWireServer,
+        pull_shards,
+    )
+
+    srv = ShardWireServer("127.0.0.1")
+    try:
+        a = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        b = np.arange(6, dtype=np.int32).reshape(3, 2)
+        srv.register(42, [a, b])
+        got = pull_shards(srv.address(), 42)
+        assert len(got) == 2
+        np.testing.assert_array_equal(got[0], a)
+        np.testing.assert_array_equal(got[1], b)
+
+        # bfloat16 shards survive the dtype header roundtrip
+        import ml_dtypes
+
+        c = np.arange(8, dtype=np.float32).astype(ml_dtypes.bfloat16)
+        srv.register(43, [c])
+        np.testing.assert_array_equal(pull_shards(srv.address(), 43)[0], c)
+
+        with pytest.raises(KeyError):
+            pull_shards(srv.address(), 999)
+        srv.unregister(42)
+        with pytest.raises(KeyError):
+            pull_shards(srv.address(), 42)
+    finally:
+        srv.close()

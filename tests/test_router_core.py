@@ -418,3 +418,78 @@ def test_decode_batch_bucket():
     assert eng._batch_bucket(3) == 4
     assert eng._batch_bucket(5) == 8
     assert eng._batch_bucket(8) == 8
+
+
+def test_disagg_headers_handler_prerequest_wiring():
+    """Deprecated header-only PreRequest variant (reference
+    disagg_headers_handler.go): writes/clears the disagg routing headers from
+    named profile results without orchestrating the profiles itself."""
+    from llm_d_inference_scheduler_tpu.router.framework.plugin import global_registry
+    from llm_d_inference_scheduler_tpu.router.framework.scheduling import (
+        ProfileRunResult,
+        SchedulingResult,
+    )
+    from llm_d_inference_scheduler_tpu.router.requestcontrol.director import (
+        H_ENCODERS,
+        H_PREFILLER,
+    )
+
+    h = global_registry.instantiate(
+        "disagg-headers-handler", "h", {"prefillProfile": "pf"}, Handle())
+    r = req(headers={H_PREFILLER: "stale:1", H_ENCODERS: "stale:2"})
+    res = SchedulingResult(
+        profile_results={
+            "decode": ProfileRunResult(target_endpoints=[ep("d")]),
+            "pf": ProfileRunResult(target_endpoints=[ep("p")]),
+            "encode": ProfileRunResult(target_endpoints=[ep("e1"), ep("e2")]),
+        },
+        primary_profile_name="decode")
+    h.pre_request(None, r, res)
+    assert r.headers[H_PREFILLER] == "p:8200"
+    assert r.headers[H_ENCODERS] == "e1:8200,e2:8200"
+
+    # No prefill/encode results: stale headers are cleared, not preserved.
+    r2 = req(headers={H_PREFILLER: "stale:1", H_ENCODERS: "stale:2"})
+    h.pre_request(None, r2, SchedulingResult(
+        profile_results={"decode": ProfileRunResult(target_endpoints=[ep("d")])},
+        primary_profile_name="decode"))
+    assert H_PREFILLER not in r2.headers
+    assert H_ENCODERS not in r2.headers
+
+    # prefill-header-handler is a registered alias.
+    alias = global_registry.instantiate("prefill-header-handler", "a", {}, Handle())
+    assert alias is not None
+
+
+def test_sse_has_token_classifier():
+    """Gateway TTFT must ignore token-free chunks (role-only chat deltas)."""
+    from llm_d_inference_scheduler_tpu.router.gateway import _sse_scan_for_token
+
+    def has_token(chunk):
+        found, _ = _sse_scan_for_token(b"", chunk)
+        return found
+
+    role_only = (b'data: {"choices": [{"delta": {"role": "assistant"}}]}\n\n')
+    content = (b'data: {"choices": [{"delta": {"content": "hi"}}]}\n\n')
+    completion = b'data: {"choices": [{"text": "hi"}]}\n\n'
+    done = b"data: [DONE]\n\n"
+    unparseable = b"data: not-json\n\n"
+    assert not has_token(role_only)
+    assert not has_token(done)
+    assert has_token(content)
+    assert has_token(completion)
+    assert has_token(unparseable)  # fail open
+    assert has_token(role_only + content)  # mixed chunk counts
+
+    # Events split across transport chunks reassemble via the carry instead
+    # of misclassifying (truncated role-only must NOT fail open mid-event).
+
+    first, second = role_only[:20], role_only[20:]
+    found, carry = _sse_scan_for_token(b"", first)
+    assert not found and carry  # partial line buffered, not counted
+    found, carry = _sse_scan_for_token(carry, second)
+    assert not found  # reassembled role-only delta still token-free
+    found, carry = _sse_scan_for_token(carry, content[:15])
+    assert not found
+    found, _ = _sse_scan_for_token(carry, content[15:])
+    assert found  # reassembled content delta counts
