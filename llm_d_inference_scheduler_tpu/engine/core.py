@@ -855,6 +855,13 @@ class TpuEngine:
                 prompt_tokens=len(req.prompt_token_ids)))
             return
         prompt = req.prompt_token_ids[: self.cfg.max_model_len - 1]
+        if len(prompt) < len(req.prompt_token_ids):
+            # Last-resort guard for direct submit() callers; the HTTP surface
+            # rejects over-context prompts with 400 before reaching here.
+            log.warning("request %s: prompt truncated %d -> %d tokens "
+                        "(max_model_len %d)", req.request_id,
+                        len(req.prompt_token_ids), len(prompt),
+                        self.cfg.max_model_len)
         block = self.mcfg.kv_block_size
         caching_enabled = isinstance(self.allocator, PrefixCachingAllocator)
         if req.mm_embeds is not None:

@@ -228,6 +228,20 @@ class EngineServer:
 
     def _build_request(self, body: dict[str, Any], prompt_ids: list[int],
                        mm_embeds=None, mm_positions=None) -> EngineRequest:
+        # An over-context PROMPT is a client error — serving a silently
+        # truncated prompt would return confidently wrong completions (the
+        # engine-level submit() truncates as a last-resort guard, core.py).
+        # The +1 reserves the first generated position. Note this is weaker
+        # than vLLM's joint prompt+max_tokens validation: a max_tokens that
+        # overruns the context is CLAMPED instead (finish_reason "length",
+        # honest usage counts) so the sidecar's chunked-decode loop — which
+        # re-sends growing prompts with fixed-size chunks — ends with a
+        # short final chunk rather than a mid-stream 400.
+        if len(prompt_ids) + 1 > self.cfg.max_model_len:
+            raise web.HTTPBadRequest(
+                text=f"prompt is {len(prompt_ids)} tokens; this engine's "
+                     f"maximum context length is {self.cfg.max_model_len} "
+                     "(including at least one generated token)")
         try:
             return EngineRequest(
                 request_id=str(body.get("request_id") or f"req-{uuid.uuid4().hex[:12]}"),

@@ -33,10 +33,14 @@ class ModelConfig:
     # "grouped" (Pallas grouped-matmul, ops/pallas_moe.py) |
     # "grouped_interpret" (same kernel, interpreter — CPU tests).
     moe_impl: str = "dense"
+    # Qwen3 family: explicit head_dim decoupled from d_model/n_heads, and
+    # per-head RMSNorm on q/k before RoPE.
+    head_dim_override: int = 0
+    qk_norm: bool = False
 
     @property
     def head_dim(self) -> int:
-        return self.d_model // self.n_heads
+        return self.head_dim_override or self.d_model // self.n_heads
 
     @property
     def q_per_kv(self) -> int:
@@ -100,6 +104,54 @@ LLAMA3_3B = ModelConfig(
     d_ff=8192,
 )
 
+# Qwen3 family (public architecture cards): per-head QK-norm, explicit
+# head_dim 128 (lane-aligned → Pallas decode kernel), rope 1M, eps 1e-6.
+# Qwen3-32B is the model the reference's own benchmark harness targets
+# (config/manifests/benchmark/benchmark.yaml:19-47: Qwen/Qwen3-32B).
+QWEN3_32B = ModelConfig(
+    name="qwen3-32b",
+    vocab_size=151_936,
+    d_model=5120,
+    n_layers=64,
+    n_heads=64,
+    n_kv_heads=8,
+    d_ff=25_600,
+    rope_theta=1_000_000.0,
+    norm_eps=1e-6,
+    head_dim_override=128,
+    qk_norm=True,
+)
+
+QWEN3_4B = ModelConfig(
+    name="qwen3-4b",
+    vocab_size=151_936,
+    d_model=2560,
+    n_layers=36,
+    n_heads=32,
+    n_kv_heads=8,
+    d_ff=9728,
+    rope_theta=1_000_000.0,
+    norm_eps=1e-6,
+    head_dim_override=128,
+    qk_norm=True,
+)
+
+# Small Qwen3-shaped config for CI tests (QK-norm + head_dim override live).
+TINY_QWEN = ModelConfig(
+    name="tiny-qwen",
+    vocab_size=512,
+    d_model=128,
+    n_layers=2,
+    n_heads=4,
+    n_kv_heads=2,
+    d_ff=256,
+    max_seq_len=256,
+    rope_theta=10_000.0,
+    norm_eps=1e-6,
+    head_dim_override=48,
+    qk_norm=True,
+)
+
 # Mixtral-family MoE (public 8x7B architecture card).
 MIXTRAL_8X7B = ModelConfig(
     name="mixtral-8x7b",
@@ -130,7 +182,8 @@ TINY_MOE = ModelConfig(
 )
 
 _REGISTRY = {c.name: c for c in (LLAMA3_8B, LLAMA3_70B, LLAMA3_1B, LLAMA3_3B,
-                                 TINY, MIXTRAL_8X7B, TINY_MOE)}
+                                 TINY, MIXTRAL_8X7B, TINY_MOE,
+                                 QWEN3_32B, QWEN3_4B, TINY_QWEN)}
 
 
 def get_config(name: str) -> ModelConfig:

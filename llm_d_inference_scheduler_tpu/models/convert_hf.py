@@ -30,8 +30,11 @@ __all__ = ["config_from_hf", "convert_state_dict", "main"]
 
 
 def config_from_hf(hf_config, name: str = "converted") -> ModelConfig:
-    """Map a transformers LlamaConfig/MixtralConfig to our ModelConfig."""
+    """Map a transformers Llama/Mixtral/Qwen3 config to our ModelConfig."""
     n_experts = getattr(hf_config, "num_local_experts", 0) or 0
+    qk_norm = getattr(hf_config, "model_type", "") == "qwen3"
+    explicit_hd = getattr(hf_config, "head_dim", None) or 0
+    default_hd = hf_config.hidden_size // hf_config.num_attention_heads
     return ModelConfig(
         name=name,
         vocab_size=hf_config.vocab_size,
@@ -46,6 +49,8 @@ def config_from_hf(hf_config, name: str = "converted") -> ModelConfig:
         norm_eps=hf_config.rms_norm_eps,
         n_experts=n_experts,
         experts_per_token=getattr(hf_config, "num_experts_per_tok", 2),
+        head_dim_override=(explicit_hd if explicit_hd != default_hd else 0),
+        qk_norm=qk_norm,
     )
 
 
@@ -87,6 +92,12 @@ def convert_state_dict(state_dict: dict, cfg: ModelConfig,
         "ln_attn": stack(lambda i: _vec(get(p.format(i=i) + "input_layernorm.weight"))),
         "ln_mlp": stack(lambda i: _vec(get(p.format(i=i) + "post_attention_layernorm.weight"))),
     }
+    if cfg.qk_norm:
+        # Qwen3 per-head RMSNorm weights, [head_dim] per layer.
+        layers["q_norm"] = stack(
+            lambda i: _vec(get(p.format(i=i) + "self_attn.q_norm.weight")))
+        layers["k_norm"] = stack(
+            lambda i: _vec(get(p.format(i=i) + "self_attn.k_norm.weight")))
     if E:
         moe = "block_sparse_moe."
         layers["router"] = stack(

@@ -60,6 +60,9 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype: jnp.dtype | None = None
         "ln_attn": jnp.ones((L, D), dtype),
         "ln_mlp": jnp.ones((L, D), dtype),
     }
+    if cfg.qk_norm:
+        layers["q_norm"] = jnp.ones((L, Dh), dtype)
+        layers["k_norm"] = jnp.ones((L, Dh), dtype)
     if E:
         layers["router"] = w(ks[9], (L, D, E), D)
     return {
@@ -90,6 +93,18 @@ def _moe_ffn(cfg: ModelConfig, lp: Params, h: jnp.ndarray) -> jnp.ndarray:
     gate = jnp.einsum("bsd,edf->bsef", h, lp["w3"])
     out = jnp.einsum("bsef,efd->bsed", jax.nn.silu(up) * gate, lp["w2"])
     return jnp.einsum("bsed,bse->bsd", out, weights)
+
+
+def qk_normed(cfg: ModelConfig, lp: Params, q: jnp.ndarray,
+              k: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Qwen3-family per-head RMSNorm on q/k before RoPE — dispatched on the
+    pytree (no-op for checkpoints without q_norm/k_norm), so every serving
+    path (prefill, paged decode, prefix prefill, pp stages) covers both
+    families through the one hook."""
+    if "q_norm" in lp:
+        q = rms_norm(q, lp["q_norm"], cfg.norm_eps)
+        k = rms_norm(k, lp["k_norm"], cfg.norm_eps)
+    return q, k
 
 
 def _ffn(cfg: ModelConfig, lp: Params, h: jnp.ndarray) -> jnp.ndarray:
@@ -126,6 +141,7 @@ def _layer(
     q = (h @ lp["wq"]).reshape(B, S, cfg.n_heads, Dh)
     k = (h @ lp["wk"]).reshape(B, S, cfg.n_kv_heads, Dh)
     v = (h @ lp["wv"]).reshape(B, S, cfg.n_kv_heads, Dh)
+    q, k = qk_normed(cfg, lp, q, k)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 
@@ -219,6 +235,7 @@ def decode_step(
         q = (h @ lp["wq"]).reshape(B, cfg.n_heads, Dh)
         k = (h @ lp["wk"]).reshape(B, cfg.n_kv_heads, Dh)
         v = (h @ lp["wv"]).reshape(B, cfg.n_kv_heads, Dh)
+        q, k = qk_normed(cfg, lp, q, k)
         q = apply_rope(q[:, None], cos[:, None], sin[:, None])[:, 0]
         k = apply_rope(k[:, None], cos[:, None], sin[:, None])[:, 0]
 
@@ -290,6 +307,7 @@ def prefill_with_prefix(
         q = (h @ lp["wq"]).reshape(1, S, cfg.n_heads, Dh)
         k = (h @ lp["wk"]).reshape(1, S, cfg.n_kv_heads, Dh)
         v = (h @ lp["wv"]).reshape(1, S, cfg.n_kv_heads, Dh)
+        q, k = qk_normed(cfg, lp, q, k)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
 

@@ -10,9 +10,19 @@ VMEM. Pattern follows the ragged/paged attention design used by TPU serving
 stacks (PAPERS.md: Ragged Paged Attention, arXiv 2604.15464).
 
 Grid: one program per batch row. Per block: async HBM→VMEM copies of the
-K and V pages, then per-KV-head-group MXU matmuls with f32 accumulation.
+K and V pages (double-buffered: page j+1's DMA is in flight while page j is
+computed), then per-KV-head-group MXU matmuls with f32 accumulation.
 The current token's K/V arrives as a separate operand (the engine scatters it
 into the pages after the layer scan — see models/llama.py decode_step).
+
+Measured invocation floor (v5e via the axon tunnel, jaxlib 0.9.0): any
+pallas_call with ≥2 input operands costs ~0.5 ms per call REGARDLESS of
+batch, page count, operand dtype/shape/memory-space, grid size, or
+scan-vs-unrolled call sites (bisect: 1-input kernel 2 µs; +1 unused input —
+bf16/i32/f32, VMEM/SMEM/ANY — ~475-505 µs). With 28 layers × 1 call/step
+that floor is ~14 ms/step, the dominant decode cost at large batch; it is a
+platform pathology, not addressable inside the kernel (attention needs
+q + pages + tables at minimum). Tracked in NEXT.md with the bisect recipe.
 """
 
 from __future__ import annotations

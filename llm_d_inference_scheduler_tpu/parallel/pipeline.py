@@ -135,6 +135,8 @@ def make_pp_forward(cfg: ModelConfig, mesh: Mesh, n_microbatches: int):
 
 def _layer_tree_template(cfg: ModelConfig):
     keys = ["wq", "wk", "wv", "wo", "w1", "w2", "w3", "ln_attn", "ln_mlp"]
+    if cfg.qk_norm:
+        keys += ["q_norm", "k_norm"]
     if cfg.n_experts:
         keys.append("router")
     return {k: 0 for k in keys}

@@ -135,6 +135,7 @@ def _decode_slab(cfg: ModelConfig, params, x, k_pages, v_pages, tables,
         q = (h @ lp["wq"]).reshape(B, -1, Dh)               # local heads
         k = (h @ lp["wk"]).reshape(B, -1, Dh)
         v = (h @ lp["wv"]).reshape(B, -1, Dh)
+        q, k = llama.qk_normed(cfg, lp, q, k)
         q = llama.apply_rope(q[:, None], cos[:, None], sin[:, None])[:, 0]
         k = llama.apply_rope(k[:, None], cos[:, None], sin[:, None])[:, 0]
         attn = paged_decode_attention(q, kp, vp, tables, seq_lens,
@@ -349,6 +350,7 @@ def _tp_block(cfg: ModelConfig, lp, x, cos, sin, positions):
     q = (h @ lp["wq"]).reshape(B, S, -1, Dh)
     k = (h @ lp["wk"]).reshape(B, S, -1, Dh)
     v = (h @ lp["wv"]).reshape(B, S, -1, Dh)
+    q, k = llama.qk_normed(cfg, lp, q, k)
     q = llama.apply_rope(q, cos, sin)
     k = llama.apply_rope(k, cos, sin)
     attn = llama.causal_attention(q, k, v, q_positions=positions,
@@ -483,6 +485,7 @@ def make_pp_prefill_with_prefix(cfg: ModelConfig, mesh: Mesh,
                 q = (h @ lp["wq"]).reshape(1, S, -1, Dh)      # local heads
                 k = (h @ lp["wk"]).reshape(1, S, -1, Dh)
                 v = (h @ lp["wv"]).reshape(1, S, -1, Dh)
+                q, k = llama.qk_normed(cfg, lp, q, k)
                 q = llama.apply_rope(q, cos, sin)
                 k = llama.apply_rope(k, cos, sin)
                 k_prior = kp[prior_table_row].reshape(1, T, -1, Dh)

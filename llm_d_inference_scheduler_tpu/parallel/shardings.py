@@ -42,6 +42,11 @@ def param_pspecs(cfg=None) -> dict[str, Any]:
         "ln_attn": P(None, None),
         "ln_mlp": P(None, None),
     }
+    if getattr(cfg, "qk_norm", False):
+        # Per-head [L, Dh] norms are replicated (applied after the tp-local
+        # head reshape; Dh is within one head, never sharded).
+        layers["q_norm"] = P(None, None)
+        layers["k_norm"] = P(None, None)
     if moe:
         layers["router"] = P(None, None, None)
     return {

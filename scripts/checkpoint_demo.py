@@ -39,6 +39,8 @@ def main(argv: list[str] | None = None) -> int:
         "benchmarks", "CHECKPOINT_DEMO.json"))
     ap.add_argument("--tpu", action="store_true",
                     help="serve on the real chip instead of CPU")
+    ap.add_argument("--family", choices=("llama", "qwen3"), default="llama",
+                    help="HF architecture to materialise and serve")
     args = ap.parse_args(argv)
 
     if not args.tpu:
@@ -50,7 +52,6 @@ def main(argv: list[str] | None = None) -> int:
 
     import numpy as np
     import torch
-    from transformers import LlamaConfig, LlamaForCausalLM
 
     from llm_d_inference_scheduler_tpu.engine import EngineConfig
     from llm_d_inference_scheduler_tpu.engine.server import EngineServer
@@ -58,13 +59,26 @@ def main(argv: list[str] | None = None) -> int:
 
     t0 = time.monotonic()
     torch.manual_seed(7)
-    hf_cfg = LlamaConfig(
-        vocab_size=2048, hidden_size=256, intermediate_size=512,
-        num_hidden_layers=4, num_attention_heads=8, num_key_value_heads=4,
-        tie_word_embeddings=False, attention_bias=False, mlp_bias=False,
-        rope_theta=10_000.0,
-    )
-    model = LlamaForCausalLM(hf_cfg).eval().float()
+    if args.family == "qwen3":
+        from transformers import Qwen3Config, Qwen3ForCausalLM
+
+        hf_cfg = Qwen3Config(
+            vocab_size=2048, hidden_size=256, intermediate_size=512,
+            num_hidden_layers=4, num_attention_heads=8,
+            num_key_value_heads=4, head_dim=48, rms_norm_eps=1e-6,
+            tie_word_embeddings=False, rope_theta=10_000.0,
+        )
+        model = Qwen3ForCausalLM(hf_cfg).eval().float()
+    else:
+        from transformers import LlamaConfig, LlamaForCausalLM
+
+        hf_cfg = LlamaConfig(
+            vocab_size=2048, hidden_size=256, intermediate_size=512,
+            num_hidden_layers=4, num_attention_heads=8, num_key_value_heads=4,
+            tie_word_embeddings=False, attention_bias=False, mlp_bias=False,
+            rope_theta=10_000.0,
+        )
+        model = LlamaForCausalLM(hf_cfg).eval().float()
 
     with tempfile.TemporaryDirectory() as tmp:
         src = os.path.join(tmp, "hf")
@@ -121,6 +135,7 @@ def main(argv: list[str] | None = None) -> int:
 
         artifact = {
             "demo": "hf-checkpoint-serving",
+            "family": args.family,
             "backend": "tpu-chip" if args.tpu else "cpu",
             "hf_config": {"hidden_size": 256, "layers": 4, "vocab": 2048},
             "convert_seconds": round(t_convert, 2),

@@ -43,6 +43,12 @@ async def one_request(client: httpx.AsyncClient, url: str, prompt: str,
                     "POST", url + "/v1/completions",
                     json={"model": "bench", "prompt": prompt, "stream": True,
                           "max_tokens": output_tokens, "ignore_eos": True}) as r:
+                if r.status_code != 200:
+                    # Surface HTTP failures in error_samples (an error body
+                    # has no SSE lines, which would otherwise count as a
+                    # silent no-ttft row).
+                    results.append({"error": r.status_code})
+                    return
                 async for line in r.aiter_lines():
                     if line.startswith("data: ") and line != "data: [DONE]":
                         if ttft is None:
@@ -67,16 +73,24 @@ async def one_request(client: httpx.AsyncClient, url: str, prompt: str,
 
 
 async def run_rate(url: str, rate: float, duration: float, input_tokens: int,
-                   output_tokens: int, stream: bool) -> dict:
-    rng = random.Random(0)
+                   output_tokens: int, stream: bool,
+                   chars_per_token: float = 1.0) -> dict:
+    # Per-rate seed: a shared seed would replay the previous phase's exact
+    # prompts, turning the next phase into 100% prefix-cache hits (and a
+    # cold compile of the cache-hit prefill path mid-load).
+    rng = random.Random(0xB135 ^ int(rate * 1000))
     words = ["alpha", "beta", "gamma", "delta", "epsilon", "zeta", "eta"]
 
     def prompt():
-        # ~4 chars/token heuristic with a unique head so prefix caching
-        # reflects realistic partial overlap
+        # Size the prompt to ~input_tokens under the target's tokenizer:
+        # chars_per_token=1 matches this repo's byte-tokenizer engines
+        # (default); pass ~4 for BPE backends. Unique head so prefix caching
+        # reflects realistic partial overlap.
         head = f"req-{rng.randint(0, 1 << 30)} "
-        return head + " ".join(rng.choice(words)
-                               for _ in range(max(input_tokens - 4, 1)))
+        target_chars = max(int(input_tokens * chars_per_token) - len(head), 1)
+        body = " ".join(rng.choice(words)
+                        for _ in range(max(target_chars // 5, 1)))
+        return (head + body)[: max(len(head) + 1, int(input_tokens * chars_per_token))]
 
     results: list[dict] = []
     tasks = []
@@ -96,7 +110,14 @@ async def run_rate(url: str, rate: float, duration: float, input_tokens: int,
 
     ok = [r for r in results if "ttft" in r and r["ttft"] is not None]
     errors = len(results) - len(ok)
+    err_samples: dict[str, int] = {}
+    for r in results:
+        if "error" in r:
+            key = str(r["error"])[:120]
+            err_samples[key] = err_samples.get(key, 0) + 1
+    extra = {"error_samples": err_samples} if err_samples else {}
     return {
+        **extra,
         "rate_rps": rate,
         "sent": n,
         "completed": len(ok),
@@ -118,6 +139,9 @@ def main():
     p.add_argument("--duration", type=float, default=30.0)
     p.add_argument("--input-tokens", type=int, default=128)
     p.add_argument("--output-tokens", type=int, default=64)
+    p.add_argument("--chars-per-token", type=float, default=1.0,
+                   help="prompt sizing: 1 for byte-tokenizer engines "
+                        "(default), ~4 for BPE backends")
     p.add_argument("--stream", action="store_true")
     args = p.parse_args()
 
@@ -125,7 +149,8 @@ def main():
     for rate in [float(r) for r in args.rates.split(",")]:
         row = asyncio.run(run_rate(args.url, rate, args.duration,
                                    args.input_tokens, args.output_tokens,
-                                   args.stream))
+                                   args.stream,
+                                   chars_per_token=args.chars_per_token))
         rows.append(row)
         print(json.dumps(row), flush=True)
     best = max(rows, key=lambda r: r["output_tokens_per_sec"])

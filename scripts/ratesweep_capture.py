@@ -66,6 +66,16 @@ pool:
                 await asyncio.sleep(0.1)
 
         url = f"http://127.0.0.1:{gport}"
+        # Warm the measured prefill bucket + decode chain before the sweep:
+        # a cold 3b prefill-bucket compile costs minutes over the tunnel and
+        # would shed the whole first rate.
+        async with httpx.AsyncClient(timeout=600) as warm:
+            r = await warm.post(url + "/v1/completions", json={
+                "model": args.model,
+                "prompt": "w" * max(args.input_tokens - 1, 1),
+                "max_tokens": args.output_tokens, "ignore_eos": True})
+            r.raise_for_status()
+
         rows = []
         for rate in [float(r) for r in args.rates.split(",")]:
             row = await run_rate(url, rate, args.duration, args.input_tokens,

@@ -193,3 +193,29 @@ def test_stream_stop_string_across_token_boundary():
             await server.stop()
 
     run(body())
+
+
+def test_over_context_prompt_rejected_400():
+    """OpenAI/vLLM contract: a prompt that cannot fit the model context with
+    at least one generated token is a 400, not a silently truncated serve."""
+    async def body():
+        srv = EngineServer(_cfg("tpu", 18467))
+        await srv.start()
+        try:
+            async with httpx.AsyncClient(timeout=60) as c:
+                r = await c.post("http://127.0.0.1:18467/v1/completions",
+                                 json={"prompt": list(range(3, 131)),
+                                       "max_tokens": 4})
+                assert r.status_code == 400
+                assert "maximum context length" in r.text
+
+                # At the boundary (prompt + 1 generated == max_model_len): ok.
+                r = await c.post("http://127.0.0.1:18467/v1/completions",
+                                 json={"prompt": list(range(3, 130)),
+                                       "max_tokens": 4, "ignore_eos": True})
+                assert r.status_code == 200
+                assert r.json()["usage"]["completion_tokens"] == 1
+        finally:
+            await srv.stop()
+
+    run(body())

@@ -218,3 +218,81 @@ def test_hf_tokenizer_registry(tmp_path):
     # Vocab larger than the model's is rejected.
     with pytest.raises(ValueError):
         get_tokenizer(f"hf:{d}", vocab_size=10)
+
+
+def test_qwen3_logits_parity():
+    """Qwen3 family: per-head QK-norm + explicit head_dim (the reference's
+    own benchmark harness targets Qwen/Qwen3-32B —
+    config/manifests/benchmark/benchmark.yaml:19-47)."""
+    from transformers import Qwen3Config, Qwen3ForCausalLM
+
+    torch.manual_seed(5)
+    hf_cfg = Qwen3Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=24,  # decoupled from hidden/heads = 16
+        rms_norm_eps=1e-6, rope_theta=10_000.0, max_position_embeddings=128,
+        tie_word_embeddings=False, attention_bias=False,
+    )
+    model = Qwen3ForCausalLM(hf_cfg).eval().float()
+    cfg = config_from_hf(hf_cfg)
+    assert cfg.qk_norm and cfg.head_dim == 24
+    tokens = np.random.default_rng(2).integers(0, 256, size=(2, 7), dtype=np.int64)
+    _parity(model, hf_cfg, tokens)
+
+
+def test_qwen3_engine_serves_token_exact(tmp_path):
+    """Greedy decode through the full engine (paged KV, QK-norm in the
+    decode-step scan) matches HF generate on a converted Qwen3 checkpoint."""
+    import asyncio
+
+    from transformers import Qwen3Config, Qwen3ForCausalLM
+
+    torch.manual_seed(6)
+    hf_cfg = Qwen3Config(
+        vocab_size=300, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, rms_norm_eps=1e-6, rope_theta=10_000.0,
+        tie_word_embeddings=True,
+    )
+    model = Qwen3ForCausalLM(hf_cfg).eval().float()
+    src = tmp_path / "hf"
+    model.save_pretrained(src, safe_serialization=True)
+
+    from llm_d_inference_scheduler_tpu.models.convert_hf import main
+
+    out = tmp_path / "orbax"
+    main([str(src), str(out), "--dtype", "float32"])
+
+    prompt = [5, 17, 42, 99, 7, 211]
+    n_gen = 6
+    with torch.no_grad():
+        ref = model.generate(
+            torch.tensor([prompt]), max_new_tokens=n_gen, do_sample=False,
+            pad_token_id=0)[0, len(prompt):].tolist()
+
+    from llm_d_inference_scheduler_tpu.engine import EngineConfig, EngineRequest
+    from llm_d_inference_scheduler_tpu.engine.core import TpuEngine
+
+    cfg = EngineConfig(model=str(out), backend="tpu", max_batch=2,
+                       max_model_len=64, decode_chunk=4)
+
+    async def run():
+        eng = TpuEngine(cfg)
+        await eng.start()
+        try:
+            outq = eng.submit(EngineRequest(
+                request_id="qwen-e2e", prompt_token_ids=prompt,
+                max_tokens=n_gen, temperature=0.0, ignore_eos=True))
+            got = []
+            while True:
+                ev = await outq.get()
+                if ev.token_id is not None:
+                    got.append(ev.token_id)
+                if ev.finish_reason is not None:
+                    break
+            return got
+        finally:
+            await eng.stop()
+
+    assert asyncio.run(run()) == ref

@@ -146,3 +146,36 @@ def test_pipeline_moe_and_bad_layer_split():
     params = llama.init_params(TINY, jax.random.key(0))
     with pytest.raises(ValueError):
         shard_params_pp(params, TINY, mesh4)
+
+
+def test_engine_tp_sharded_qwen_decode():
+    """tiny-qwen (QK-norm + head_dim override) through a tp=2 engine: the
+    q_norm/k_norm params shard (replicated) and the decode-step hook runs
+    under the tp shard_map."""
+
+    async def run(tp_size: int) -> list[int]:
+        cfg = EngineConfig(model="tiny-qwen", max_batch=2, max_model_len=128,
+                           tp_size=tp_size, enable_prefix_caching=False,
+                           kv_events_port=0)
+        eng = TpuEngine(cfg)
+        await eng.start()
+        try:
+            req = EngineRequest(
+                request_id="tp-qwen",
+                prompt_token_ids=[1] + [(i * 5) % 400 + 3 for i in range(24)],
+                max_tokens=6, temperature=0.0, ignore_eos=True)
+            out = eng.submit(req)
+            toks = []
+            while True:
+                ev = await asyncio.wait_for(out.get(), timeout=120)
+                if ev.token_id is not None:
+                    toks.append(ev.token_id)
+                if ev.finish_reason is not None:
+                    return toks
+        finally:
+            await eng.stop()
+
+    sharded = asyncio.run(run(2))
+    plain = asyncio.run(run(1))
+    assert len(sharded) == 6 and len(plain) == 6
+    assert sharded[0] == plain[0]  # see bf16 tie-flip note above
