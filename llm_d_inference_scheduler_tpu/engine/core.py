@@ -229,8 +229,12 @@ class TpuEngine:
         self.pp_mesh = None
         if cfg.pp_size > 1:
             if cfg.ep_size > 1 or self._dist:
-                raise ValueError("pp_size composes with ep/multi-host in "
-                                 "a later version; use pp (optionally ×tp)")
+                # pp serves MoE models with REPLICATED experts today
+                # (tested: pp×tiny-moe token-parity); sharding the experts
+                # axis (ep>1) or spanning hosts under pp is future work.
+                raise ValueError("pp_size composes with ep>1/multi-host in "
+                                 "a later version; use pp (optionally ×tp; "
+                                 "MoE runs with replicated experts)")
             from ..parallel.pp_serve import make_pp_mesh, validate_pp
 
             validate_pp(self.mcfg, cfg.pp_size, cfg.tp_size)
@@ -313,6 +317,7 @@ class TpuEngine:
         # stays as fallback for single-process engines (reference
         # connector_nixlv2.go:109-253 control shape preserved).
         self._jit_stage = None
+        self._embed_fns: dict[int, Any] = {}
         self._release_reqs: list[tuple[str, str]] = []
         self._prefill_fns: dict[int, Any] = {}
         if self.pp_mesh is not None:
@@ -404,9 +409,11 @@ class TpuEngine:
         encoder vectors overwrite the placeholder-token embeddings; padding
         entries point out of range and are dropped by the scatter."""
         key = ("mm", bucket, mm_bucket)
-        if self.pp_mesh is not None:
-            raise ValueError("multimodal prefill is not supported with "
-                             "pp_size > 1")
+        if key not in self._prefill_fns and self.pp_mesh is not None:
+            from ..parallel.pp_serve import make_pp_prefill
+
+            self._prefill_fns[key] = make_pp_prefill(self.mcfg, self.pp_mesh,
+                                                     bucket, mm=True)
         if key not in self._prefill_fns:
             def impl(params, tokens, seq_len, mm_embeds, mm_positions,
                      k_pages, v_pages, block_table_row,
@@ -584,6 +591,39 @@ class TpuEngine:
         while b < n:
             b *= 2
         return min(b, self.cfg.max_model_len)
+
+    def embed(self, ids: list[int]) -> np.ndarray:
+        """Mean-pooled final-hidden-state embedding of a prompt — the
+        /v1/embeddings surface (the reference routes OpenAI embeddings
+        bodies to vLLM embedding pods; this is the engine-half equivalent).
+
+        Stateless w.r.t. the batching loop (no KV pages/slots touched), so
+        it dispatches directly from the caller's thread; the device
+        serializes it against in-flight decode work. Pow2 prompt buckets
+        bound the compile cache. Padding tokens sit AFTER the valid prompt,
+        so causal attention never lets a valid query attend them; the mask
+        excludes them from the mean."""
+        if self.pp_mesh is not None or self._dist:
+            raise ValueError("embeddings are served by tp/single-device "
+                             "engines (pp/multi-host: route to a dense "
+                             "replica)")
+        bucket = self._bucket(max(len(ids), 1))
+        fn = self._embed_fns.get(bucket)
+        if fn is None:
+            def impl(params, tokens, seq_len):
+                hidden, _ = llama.forward(params, self.mcfg, tokens,
+                                          want_hidden=True)
+                mask = (jnp.arange(tokens.shape[1]) < seq_len[0])[None, :, None]
+                pooled = (hidden * mask).sum(axis=1) / seq_len[0]
+                return pooled[0]
+
+            fn = jax.jit(impl)
+            self._embed_fns[bucket] = fn
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, : len(ids)] = ids
+        vec = fn(self.params, self._put(tokens),
+                 self._put(np.asarray([max(len(ids), 1)], np.int32)))
+        return np.asarray(vec)
 
     def _warmup(self):
         """Compile the hot jits before serving (smallest prefill bucket,
@@ -830,16 +870,6 @@ class TpuEngine:
     # ---- prefill -------------------------------------------------------
 
     def _prefill_into_slot(self, idx, req, out, loop, need: int):
-        if self.pp_mesh is not None and req.mm_embeds is not None:
-            # No multimodal prefill ring yet — reject THIS request; a raise
-            # here would take down every in-flight request via _abort_all.
-            log.warning("rejecting multimodal request %s: not supported "
-                        "with pp_size > 1", req.request_id)
-            self._emit_to(out, loop, TokenEvent(
-                request_id=req.request_id, token_id=None,
-                finish_reason=FinishReason.ABORT,
-                prompt_tokens=len(req.prompt_token_ids)))
-            return
         if (self._dist and self.kv_transfer_server is None
                 and (req.kv_transfer_params or {}).get("do_remote_decode")):
             # Multi-host staging is shard-registered on every process's

@@ -122,6 +122,7 @@ class EngineServer:
             web.post("/v1/completions", self.completions),
             web.post("/v1/chat/completions", self.chat_completions),
             web.post("/v1/responses", self.responses),
+            web.post("/v1/embeddings", self.embeddings),
             web.post("/v1/completions/render", self.render_completions),
             web.post("/v1/chat/completions/render", self.render_chat),
             web.get("/v1/models", self.models),
@@ -419,6 +420,60 @@ class EngineServer:
         text = resp["choices"][0].pop("text")
         resp["choices"][0]["message"] = {"role": "assistant", "content": text}
         return web.json_response(resp)
+
+    async def embeddings(self, request: web.Request) -> web.Response:
+        """OpenAI /v1/embeddings: mean-pooled final-hidden-state vectors
+        (the reference routes embeddings bodies — its body model's
+        EmbeddingsRequest, types.go:74-75 — to vLLM embedding pods; this
+        engine serves the surface itself via TpuEngine.embed)."""
+        body = await _json_body(request)
+        raw_input = body.get("input")
+        if raw_input is None or raw_input == [] or raw_input == "":
+            raise web.HTTPBadRequest(text="'input' must be a non-empty "
+                                          "string, list, or token ids")
+        # str | [str] | [ids] | [[ids]] → list of prompts
+        if isinstance(raw_input, str):
+            items = [raw_input]
+        elif isinstance(raw_input, list) and raw_input and all(
+                isinstance(t, int) for t in raw_input):
+            items = [raw_input]
+        elif isinstance(raw_input, list):
+            items = raw_input
+        else:
+            raise web.HTTPBadRequest(text="'input' must be a string, a list "
+                                          "of strings, or token ids")
+        embed = getattr(self.engine, "embed", None)
+        if embed is None:
+            raise web.HTTPNotImplemented(text="engine has no embeddings path")
+
+        loop = asyncio.get_running_loop()
+        data = []
+        total = 0
+        for i, item in enumerate(items):
+            if item == "" or item == []:
+                raise web.HTTPBadRequest(text=f"input {i} is empty")
+            ids = self._tokenize_prompt(item)
+            if not ids:
+                raise web.HTTPBadRequest(
+                    text=f"input {i} tokenizes to zero tokens")
+            if len(ids) > self.cfg.max_model_len:
+                raise web.HTTPBadRequest(
+                    text=f"input {i} is {len(ids)} tokens; maximum context "
+                         f"length is {self.cfg.max_model_len}")
+            total += len(ids)
+            try:
+                # Executor: the first call per bucket compiles.
+                vec = await loop.run_in_executor(None, embed, ids)
+            except ValueError as e:
+                raise web.HTTPNotImplemented(text=str(e))
+            data.append({"object": "embedding", "index": i,
+                         "embedding": [float(x) for x in vec]})
+        return web.json_response({
+            "object": "list",
+            "data": data,
+            "model": self.engine.model_name,
+            "usage": {"prompt_tokens": total, "total_tokens": total},
+        })
 
     async def responses(self, request: web.Request) -> web.StreamResponse:
         """OpenAI Responses API (/v1/responses). The reference's engines are

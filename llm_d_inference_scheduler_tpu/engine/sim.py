@@ -46,6 +46,18 @@ class SimEngine:
     async def stop(self):
         pass
 
+    def embed(self, ids: list[int]):
+        """Deterministic unit vector from the token ids (llm-d-inference-sim
+        analogue for /v1/embeddings e2e tests)."""
+        import zlib
+
+        import numpy as np
+
+        seed = zlib.crc32(np.asarray(ids, np.int64).tobytes())
+        rng = np.random.default_rng(seed)
+        v = rng.normal(size=64).astype(np.float32)
+        return v / max(float(np.linalg.norm(v)), 1e-6)
+
     def _update_gauges(self):
         self._sweep_exports()
         self.telemetry.waiting.set(self._waiting)

@@ -160,6 +160,7 @@ def forward(
     positions: jnp.ndarray | None = None,  # [B, S]
     *,
     want_kv: bool = False,
+    want_hidden: bool = False,
     attention_fn: Callable[..., jnp.ndarray] = causal_attention,
     kv_valid: jnp.ndarray | None = None,  # [B, S] padding mask
     mm_embeds: jnp.ndarray | None = None,     # [B, M, D] multimodal vectors
@@ -190,6 +191,11 @@ def forward(
 
     x, kv = jax.lax.scan(body, x, params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    if want_hidden:
+        # Embeddings surface: final-norm hidden states, lm head skipped
+        # (reference analogue: vLLM embedding models behind /v1/embeddings,
+        # routed by the EPP's embeddings body shape — types.go:74-75).
+        return x.astype(jnp.float32), kv
     logits = (x @ params["lm_head"]).astype(jnp.float32)
     return logits, kv
 

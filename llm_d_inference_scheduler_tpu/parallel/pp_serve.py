@@ -361,15 +361,21 @@ def _tp_block(cfg: ModelConfig, lp, x, cos, sin, positions):
     return x, k, v
 
 
-def make_pp_prefill(cfg: ModelConfig, mesh: Mesh, bucket: int):
+def make_pp_prefill(cfg: ModelConfig, mesh: Mesh, bucket: int,
+                    mm: bool = False):
     """Drop-in for TpuEngine._prefill_fn(bucket) under pp(+tp): ring prefill
-    with per-stage KV scatter + fused first-token sampling."""
+    with per-stage KV scatter + fused first-token sampling. With ``mm``,
+    takes (mm_embeds, mm_positions) after seq_len and splices the encoder
+    vectors over the placeholder-token embeddings before the ring (the
+    multimodal injection of llama.forward:182-185, replicated on every
+    stage — the splice is part of the embedding, which all stages compute
+    identically)."""
     n_stages = mesh.shape["pp"]
     n_tp = mesh.shape.get("tp", 1)
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
     def prefill(params, tokens, seq_len, k_pages, v_pages, block_table_row,
-                key, temps, top_k, top_p):
+                key, temps, top_k, top_p, mm_embeds=None, mm_positions=None):
         stage = jax.lax.axis_index("pp")
         S = tokens.shape[1]
         assert S == bucket, f"prefill traced at S={S}, keyed as bucket={bucket}"
@@ -384,6 +390,9 @@ def make_pp_prefill(cfg: ModelConfig, mesh: Mesh, bucket: int):
         slot_for_t = jnp.where(valid_t, t % block, 0)
 
         x0 = _tp_full(params["embed"][tokens], n_tp, axis=2)  # [1, S, D]
+        if mm_embeds is not None:
+            x0 = x0.at[jnp.arange(1)[:, None], mm_positions].set(
+                mm_embeds.astype(x0.dtype), mode="drop")
         zero = jnp.zeros_like(x0)
 
         def slab(x, k_pages, v_pages, active):
@@ -424,6 +433,21 @@ def make_pp_prefill(cfg: ModelConfig, mesh: Mesh, bucket: int):
         return tok, k_pages, v_pages
 
     page_spec = PAGE_SPEC
+    if mm:
+        def prefill_mm(params, tokens, seq_len, mm_embeds, mm_positions,
+                       k_pages, v_pages, block_table_row, key, temps, top_k,
+                       top_p):
+            # Engine mm calling convention (core.py _op_mm_prefill).
+            return prefill(params, tokens, seq_len, k_pages, v_pages,
+                           block_table_row, key, temps, top_k, top_p,
+                           mm_embeds, mm_positions)
+
+        sharded = shard_map(
+            prefill_mm, mesh=mesh,
+            in_specs=(_param_specs(cfg), P(), P(), P(), P(), page_spec,
+                      page_spec, P(), P(), P(), P(), P()),
+            out_specs=(P(), page_spec, page_spec))
+        return jax.jit(sharded, donate_argnums=(5, 6))
     sharded = shard_map(
         prefill, mesh=mesh,
         in_specs=(_param_specs(cfg), P(), P(), page_spec, page_spec, P(),

@@ -222,9 +222,11 @@ def instantiate(raw: RawConfig, handle: Handle,
 
     objectives = [InferenceObjective(name=o["name"], priority=int(o.get("priority", 0)))
                   for o in raw.objectives]
+    # "sourceModel" matches the CRD schema (deploy/crds/) and the kube
+    # binding; "source" is the original file-config key — accept both.
     rewrites = [InferenceModelRewrite(
-        name=rw.get("name") or rw["source"],
-        source_model=rw["source"],
+        name=rw.get("name") or rw.get("sourceModel") or rw["source"],
+        source_model=rw.get("sourceModel") or rw["source"],
         targets=[ModelRewriteTarget(model=t["model"], weight=int(t.get("weight", 1)))
                  for t in rw.get("targets") or []])
         for rw in raw.model_rewrites]

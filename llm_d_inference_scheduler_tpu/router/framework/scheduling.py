@@ -35,7 +35,7 @@ class InferenceRequestBody:
     @property
     def payload(self) -> dict[str, Any] | None:
         for p in (self.completions, self.chat_completions, self.responses,
-                  self.conversations):
+                  self.conversations, self.embeddings):
             if p is not None:
                 return p
         return None
@@ -67,6 +67,18 @@ class InferenceRequestBody:
             import json as _json
 
             return _json.dumps(self.conversations.get("items", []))
+        if self.embeddings is not None:
+            # Reference PlainText() of EmbeddingsRequest.Input
+            # (types.go:139-140): string, list of strings, or token ids —
+            # the size estimate and prefix hash must see the real input,
+            # not an empty prompt.
+            inp = self.embeddings.get("input", "")
+            if isinstance(inp, str):
+                return inp
+            if isinstance(inp, list):
+                return " ".join(
+                    x if isinstance(x, str) else str(x) for x in inp)
+            return str(inp)
         return ""
 
     def cache_salt(self) -> str:

@@ -123,6 +123,7 @@ class Gateway:
             web.post("/v1/completions", self.handle_inference),
             web.post("/v1/chat/completions", self.handle_inference),
             web.post("/v1/responses", self.handle_inference),
+            web.post("/v1/embeddings", self.handle_inference),
             web.get("/metrics", self.metrics),
             web.get("/health", self.health),
             web.get("/v1/models", self.models),
@@ -589,6 +590,10 @@ def _sse_scan_for_token(carry: bytes, chunk: bytes) -> tuple[bool, bytes]:
 
 
 def _usage_from_sse(chunk: bytes) -> dict[str, int] | None:
+    if b'"usage"' not in chunk:
+        # Hot-path fast exit: only the final SSE chunk carries usage;
+        # json-parsing every token chunk is measurable at high fan-out.
+        return None
     for line in chunk.split(b"\n"):
         if line.startswith(b"data: ") and line != b"data: [DONE]":
             try:

@@ -67,6 +67,10 @@ class Sidecar:
         self.app = web.Application()
         self.app.add_routes([web.post(p, self.handle_generate) for p in GEN_PATHS])
         self.app.add_routes([
+            # Embeddings carry no KV state → no disagg protocol; straight
+            # passthrough to the local engine (the reference proxies
+            # non-generate OpenAI surfaces the same way).
+            web.post("/v1/embeddings", self._proxy_post),
             web.get("/metrics", self._proxy_get),
             web.get("/health", self._proxy_get),
             web.get("/v1/models", self._proxy_get),
@@ -451,6 +455,18 @@ class Sidecar:
         headers = {"content-type": "application/json"}
         headers.update(extra_headers or {})
         return web.Response(body=json.dumps(doc).encode(), headers=headers)
+
+    async def _proxy_post(self, request: web.Request) -> web.Response:
+        try:
+            r = await self._client.post(
+                self._rank_url() + request.path, content=await request.read(),
+                headers={"content-type": "application/json"})
+            return web.Response(body=r.content, status=r.status_code,
+                                content_type=r.headers.get(
+                                    "content-type",
+                                    "application/json").split(";")[0])
+        except Exception as e:
+            return web.json_response({"error": str(e)}, status=502)
 
     async def _proxy_get(self, request: web.Request) -> web.Response:
         try:

@@ -1,0 +1,190 @@
+"""/v1/embeddings surface: engine pooling, HTTP endpoint, gateway routing.
+
+Reference parity: the EPP's body model carries EmbeddingsRequest
+(types.go:74-75) and routes it like any OpenAI body; the serving half there
+is a vLLM embedding pod. Here the engine itself serves mean-pooled
+final-hidden-state vectors (TpuEngine.embed)."""
+
+import asyncio
+
+import httpx
+import numpy as np
+
+from llm_d_inference_scheduler_tpu.engine import EngineConfig
+from llm_d_inference_scheduler_tpu.engine.core import TpuEngine
+from llm_d_inference_scheduler_tpu.engine.server import EngineServer
+from llm_d_inference_scheduler_tpu.router.gateway import build_gateway
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_engine_embed_deterministic_and_padding_invariant():
+    async def body():
+        eng = TpuEngine(EngineConfig(model="tiny", backend="tpu", max_batch=2,
+                                     max_model_len=64, kv_events_port=0))
+        await eng.start()
+        try:
+            ids = [1, 5, 9, 13]
+            v1 = eng.embed(ids)
+            v2 = eng.embed(ids)
+            assert v1.shape == (eng.mcfg.d_model,)
+            np.testing.assert_array_equal(v1, v2)  # jit determinism
+            # Different input → different vector.
+            v3 = eng.embed([1, 5, 9, 14])
+            assert not np.allclose(v1, v3)
+            # Bucket padding must not leak into the pooled mean: the same
+            # prompt through two bucket sizes (16 vs 32) pools identically.
+            long_ids = list(range(3, 3 + 17))   # bucket 32
+            short = eng.embed(long_ids[:4])     # bucket 16
+            ref = eng.embed(long_ids[:4] + long_ids[4:])  # bucket 32 path hot
+            v4 = eng.embed(long_ids[:4])
+            np.testing.assert_allclose(short, v4, rtol=0, atol=0)
+            assert ref.shape == short.shape
+        finally:
+            await eng.stop()
+
+    run(body())
+
+
+def test_engine_http_embeddings_endpoint():
+    async def body():
+        srv = EngineServer(EngineConfig(model="tiny", backend="tpu",
+                                        max_batch=2, max_model_len=64,
+                                        kv_events_port=0, port=18471))
+        await srv.start()
+        try:
+            async with httpx.AsyncClient(timeout=120) as c:
+                r = await c.post("http://127.0.0.1:18471/v1/embeddings",
+                                 json={"model": "tiny",
+                                       "input": ["hello", "world"]})
+                assert r.status_code == 200
+                doc = r.json()
+                assert doc["object"] == "list" and len(doc["data"]) == 2
+                assert doc["data"][0]["index"] == 0
+                assert len(doc["data"][0]["embedding"]) == 128  # tiny d_model
+                assert doc["usage"]["prompt_tokens"] > 0
+
+                # token-id input shape
+                r = await c.post("http://127.0.0.1:18471/v1/embeddings",
+                                 json={"input": [3, 4, 5]})
+                assert r.status_code == 200
+                assert len(r.json()["data"]) == 1
+
+                # over-context input → 400
+                r = await c.post("http://127.0.0.1:18471/v1/embeddings",
+                                 json={"input": "x" * 100})
+                assert r.status_code == 400
+        finally:
+            await srv.stop()
+
+    run(body())
+
+
+def test_gateway_routes_embeddings_to_sim_pool():
+    CFG = """
+pool:
+  endpoints:
+    - {address: 127.0.0.1, port: 18473}
+    - {address: 127.0.0.1, port: 18474}
+"""
+
+    async def body():
+        engines = []
+        for port in (18473, 18474):
+            s = EngineServer(EngineConfig(backend="sim", model="tiny",
+                                          port=port, max_batch=4))
+            await s.start()
+            engines.append(s)
+        gw = build_gateway(CFG, port=18472, poll_interval=0.02)
+        await gw.start()
+        try:
+            await asyncio.sleep(0.2)
+            async with httpx.AsyncClient(timeout=30) as c:
+                r = await c.post("http://127.0.0.1:18472/v1/embeddings",
+                                 json={"model": "tiny", "input": "hello"})
+                assert r.status_code == 200
+                assert r.headers["x-gateway-destination-endpoint-served"] in (
+                    "127.0.0.1:18473", "127.0.0.1:18474")
+                doc = r.json()
+                assert len(doc["data"]) == 1
+                assert len(doc["data"][0]["embedding"]) == 64  # sim vectors
+        finally:
+            await gw.stop()
+            for s in engines:
+                await s.stop()
+
+    run(body())
+
+
+def test_embeddings_empty_input_rejected():
+    async def body():
+        srv = EngineServer(EngineConfig(model="tiny", backend="tpu",
+                                        max_batch=2, max_model_len=64,
+                                        kv_events_port=0, port=18475))
+        await srv.start()
+        try:
+            async with httpx.AsyncClient(timeout=60) as c:
+                for bad in ({"input": []}, {"input": ""},
+                            {"input": ["ok", ""]}, {}):
+                    r = await c.post("http://127.0.0.1:18475/v1/embeddings",
+                                     json=bad)
+                    assert r.status_code == 400, bad
+        finally:
+            await srv.stop()
+
+    run(body())
+
+
+def test_embeddings_body_scheduling_surface():
+    """The router sees the real input: prompt_text feeds size estimates and
+    prefix hashing (review finding: embeddings scheduled on an empty
+    prompt), and payload() makes model rewrites repackage the body."""
+    from llm_d_inference_scheduler_tpu.router.framework.scheduling import (
+        InferenceRequestBody,
+    )
+
+    b = InferenceRequestBody(embeddings={"model": "m", "input": "hello world"})
+    assert b.prompt_text() == "hello world"
+    assert b.payload is not None and b.payload["model"] == "m"
+
+    b2 = InferenceRequestBody(embeddings={"input": ["a", "b"]})
+    assert b2.prompt_text() == "a b"
+
+    b3 = InferenceRequestBody(embeddings={"input": [3, 4, 5]})
+    assert "3" in b3.prompt_text()
+
+
+def test_gateway_rewrites_embeddings_model():
+    """Weighted model rewrite must reach the upstream body for /v1/embeddings
+    too (payload() now includes embeddings)."""
+    CFG = """
+pool:
+  endpoints:
+    - {address: 127.0.0.1, port: 18477}
+modelRewrites:
+  - sourceModel: alias-model
+    targets:
+      - {model: tiny, weight: 1}
+"""
+
+    async def body():
+        s = EngineServer(EngineConfig(backend="sim", model="tiny",
+                                      port=18477, max_batch=4))
+        await s.start()
+        gw = build_gateway(CFG, port=18476, poll_interval=0.02)
+        await gw.start()
+        try:
+            await asyncio.sleep(0.2)
+            async with httpx.AsyncClient(timeout=30) as c:
+                r = await c.post("http://127.0.0.1:18476/v1/embeddings",
+                                 json={"model": "alias-model", "input": "hi"})
+                assert r.status_code == 200
+                # Response model name is rewritten back to the client alias.
+                assert r.json()["model"] == "alias-model"
+        finally:
+            await gw.stop()
+            await s.stop()
+
+    run(body())
