@@ -29,6 +29,14 @@ class EngineConfig:
     checkpoint_path: str = ""     # orbax dir; empty = random init (dev/bench)
     enable_prefix_caching: bool = True  # automatic prefix caching (block reuse)
     warmup: bool = False          # compile prefill/decode/sample before serving
+    # Pow2 context buckets for the decode block table: narrow the traced
+    # table width to the live context instead of always max_model_len —
+    # the XLA gather attention path's HBM traffic is O(table width), so
+    # head_dim-64 models gain materially. Opt-in: enabling multiplies the
+    # decode compile matrix by the width count (warmup covers the FULL
+    # batch×width matrix to keep its no-lazy-compile guarantee, which can
+    # take minutes on a cold cache).
+    decode_ctx_buckets: bool = False
     # Decode steps fused into one device dispatch (lax.scan over the decode
     # step + sampler on device). Amortizes per-dispatch latency — decisive
     # when the chip sits behind a network tunnel — at the cost of bursty

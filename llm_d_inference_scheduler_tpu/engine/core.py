@@ -645,12 +645,18 @@ class TpuEngine:
             buckets.append(b)
             b *= 2
         buckets.append(B)
+        # With context buckets on, warm the FULL batch×width matrix — the
+        # no-lazy-compile guarantee is the point of a gated warmup (cold
+        # cache cost is why decode_ctx_buckets is opt-in).
+        widths = (self._ctx_widths() if self.cfg.decode_ctx_buckets
+                  else [self.max_blocks_per_seq])
         for nb in buckets:
-            self._device_call(("decode",), dict(
-                tokens=np.zeros((nb,), np.int32),
-                positions=np.zeros((nb,), np.int32),
-                tables=np.zeros((nb, self.max_blocks_per_seq), np.int32),
-                warm=True, **self._sample_np([_DUMMY_REQ] * nb)))
+            for w in widths:
+                self._device_call(("decode",), dict(
+                    tokens=np.zeros((nb,), np.int32),
+                    positions=np.zeros((nb,), np.int32),
+                    tables=np.zeros((nb, w), np.int32),
+                    warm=True, **self._sample_np([_DUMMY_REQ] * nb)))
         log.info("engine warm-up compiled prefill/decode/sample in %.1fs",
                  time.monotonic() - t0)
 
@@ -1682,13 +1688,43 @@ class TpuEngine:
             b *= 2
         return min(b, self.cfg.max_batch)
 
+    def _ctx_widths(self) -> list[int]:
+        """The pow2 table widths _ctx_bucket can produce, ascending — the
+        single source for both bucketing and the warmup compile matrix."""
+        widths = []
+        w = 4
+        while w < self.max_blocks_per_seq:
+            widths.append(w)
+            w *= 2
+        widths.append(self.max_blocks_per_seq)
+        return widths
+
+    def _ctx_bucket(self, n_blocks: int) -> int:
+        """Pow2 block-table width covering the busiest active slot. The XLA
+        gather decode path materialises [B, width*block] KV rows per layer —
+        O(width) HBM traffic regardless of true context — so narrowing the
+        table to the live context (e.g. 16 of 32 blocks at bench geometry)
+        halves its gather bytes. The Pallas kernel already bounds page DMAs
+        by seq_len; a narrower table is free there. Chunk-overshoot scatter
+        indices past the width clamp (XLA gather/scatter clamp semantics) to
+        the row's tail entry — the sequence's own last block or the trash
+        block — never another row. Opt-in via decode_ctx_buckets."""
+        if not self.cfg.decode_ctx_buckets:
+            return self.max_blocks_per_seq
+        for w in self._ctx_widths():
+            if n_blocks <= w:
+                return w
+        return self.max_blocks_per_seq
+
     def _decode_once(self):
         active = [i for i, s in enumerate(self.slots)
                   if s is not None and s.pending_tok is None]
         B = self._batch_bucket(len(active))
+        W = self._ctx_bucket(max((len(self.slots[i].blocks) for i in active),
+                                 default=1))
         tokens = np.zeros((B,), np.int32)
         positions = np.zeros((B,), np.int32)
-        tables = np.zeros((B, self.max_blocks_per_seq), np.int32)
+        tables = np.zeros((B, W), np.int32)
         # Compact active slots into the low lanes; padding lanes keep their
         # block table at the trash block 0 (their KV writes land there).
         for lane, i in enumerate(active):

@@ -68,8 +68,7 @@ class OpenAIParser(PluginBase):
         return ParseResult(body=body, model=model)
 
     def serialize(self, body: InferenceRequestBody) -> bytes:
-        payload = body.payload if body.payload is not None else (
-            body.embeddings if body.embeddings is not None else None)
+        payload = body.payload  # includes embeddings (scheduling.py payload)
         if payload is None:
             return body.raw or b""
         return json.dumps(payload).encode()
