@@ -99,6 +99,8 @@ def child(model: str, batch: int) -> None:
                        decode_chunk=decode_chunk,
                        pallas_attention=(None if pallas_env == "auto"
                                          else pallas_env == "1"),
+                       decode_ctx_buckets=os.environ.get(
+                           "BENCH_CTX_BUCKETS", "0") == "1",
                        warmup=True)
 
     async def run():
