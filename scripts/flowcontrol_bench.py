@@ -10,9 +10,12 @@ the asyncio actor design:
   payload entropy via the Knuth multiplicative hash.
 - **mass cancellation**: a saturated backlog where 90% of items expire at
   once — measures eviction latency and that survivors dispatch cleanly.
-- Topology churn is intentionally absent: shard topology here is static
-  (single-owner asyncio actors; the reference churns goroutine shards at
-  runtime, controller.py module docstring).
+- **topology churn**: every request arrives on a brand-new FlowKey, so each
+  enqueue pays flow registration/provisioning (the reference's
+  TopologyChurn measures exactly this registry write pressure,
+  benchmark_test.go:166-225; the *shard* topology here is static by design
+  — single-owner asyncio actors, controller.py module docstring — so flow
+  churn is the analogue that exists).
 
 Run: ``python scripts/flowcontrol_bench.py [--quick]`` — prints one JSON
 document; CI-pinned smoke coverage lives in tests/test_flowcontrol.py.
@@ -37,6 +40,12 @@ from llm_d_inference_scheduler_tpu.router.flowcontrol.types import (  # noqa: E4
     FlowKey,
     QueueOutcome,
 )
+
+
+def _pct(sorted_waits: list[float], p: float) -> float:
+    """Percentile of a sorted wait list, in ms."""
+    return sorted_waits[min(int(len(sorted_waits) * p),
+                            len(sorted_waits) - 1)] * 1e3
 
 
 def _zipf_indices(n_flows: int, size: int) -> list[int]:
@@ -112,17 +121,14 @@ async def run_matrix_point(*, limit: int, priorities: int, flows: int,
     await fc.stop()
     waits.sort()
 
-    def pct(p):
-        return waits[min(int(len(waits) * p), len(waits) - 1)] * 1e3
-
     return {
         "limit": limit, "priorities": priorities, "flows": flows,
         "concurrency": concurrency, "n_requests": n_requests,
         "dispatched": outcomes[QueueOutcome.DISPATCHED],
         "rejected": outcomes[QueueOutcome.REJECTED_CAPACITY],
         "throughput_rps": round(n_requests / elapsed, 1),
-        "queue_wait_ms": {"p50": round(pct(0.50), 3),
-                          "p99": round(pct(0.99), 3)},
+        "queue_wait_ms": {"p50": round(_pct(waits, 0.50), 3),
+                          "p99": round(_pct(waits, 0.99), 3)},
     }
 
 
@@ -174,6 +180,52 @@ async def run_mass_cancellation(n: int = 5000, cancel_frac: float = 0.9) -> dict
     }
 
 
+async def run_topology_churn(n: int = 5000, concurrency: int = 100) -> dict:
+    """Every request registers a NOVEL flow (fresh FlowKey), measuring
+    dynamic flow provisioning + GC-side bookkeeping under dispatch load —
+    the reference's TopologyChurn registry write-lock pressure
+    (benchmark_test.go:166-225). Free-flow dispatch (no saturation); the
+    timed span is the full enqueue→dispatch under continuous novel-flow
+    registration — i.e. the churn pressure on the dispatch cycle (fairness
+    scans over an ever-growing flow set), not the isolated sub-microsecond
+    dict insert."""
+    fc = FlowController(FlowControlConfig(default_ttl_s=120.0),
+                        saturation_fn=lambda: 0.0)
+    await fc.start()
+    sem = asyncio.Semaphore(concurrency)
+    waits: list[float] = []
+    dispatched = 0
+    t0 = time.perf_counter()
+
+    async def one(i: int):
+        nonlocal dispatched
+        async with sem:
+            item = FlowControlRequest(
+                request_id=f"c{i}",
+                flow_key=FlowKey(flow_id=f"novel-flow-{i}", priority=0),
+                size_bytes=1024)
+            t = time.perf_counter()
+            out = await fc.enqueue_and_wait(item)
+            waits.append(time.perf_counter() - t)
+            if out is QueueOutcome.DISPATCHED:
+                dispatched += 1
+
+    await asyncio.gather(*[one(i) for i in range(n)])
+    elapsed = time.perf_counter() - t0
+    n_flows_live = sum(len(s.queues) for s in fc.shards)
+    await fc.stop()
+    waits.sort()
+    return {
+        "n_novel_flows": n,
+        "dispatched": dispatched,
+        "throughput_rps": round(n / elapsed, 1),
+        "enqueue_to_dispatch_ms": {
+            "p50": round(_pct(waits, 0.50), 3),
+            "p99": round(_pct(waits, 0.99), 3)},
+        "flows_live_at_end": n_flows_live,
+    }
+
+
 async def main(quick: bool) -> dict:
     n_req = 2000 if quick else 20000
     points = []
@@ -189,7 +241,9 @@ async def main(quick: bool) -> dict:
                         limit=limit, priorities=priorities, flows=flows,
                         concurrency=concurrency, n_requests=n_req))
     mass = await run_mass_cancellation(1000 if quick else 5000)
-    return {"performance_matrix": points, "mass_cancellation": mass}
+    churn = await run_topology_churn(1000 if quick else 5000)
+    return {"performance_matrix": points, "mass_cancellation": mass,
+            "topology_churn": churn}
 
 
 if __name__ == "__main__":

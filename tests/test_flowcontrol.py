@@ -343,3 +343,31 @@ def test_idle_flow_gc():
             await fc.stop()
 
     run(body())
+
+
+def test_flowcontrol_bench_scenarios_smoke():
+    """Pin the three reference bench scenarios (perf matrix point, mass
+    cancellation, topology churn — benchmark_test.go:38-225) at smoke scale
+    so the recorded benchmarks/BENCH_flowcontrol.json stays reproducible."""
+    import asyncio
+    import sys
+
+    sys.path.insert(0, str(__import__("pathlib").Path(__file__).parent.parent))
+    from scripts.flowcontrol_bench import (
+        run_mass_cancellation,
+        run_matrix_point,
+        run_topology_churn,
+    )
+
+    pt = asyncio.run(run_matrix_point(limit=8, priorities=2, flows=10,
+                                      concurrency=32, n_requests=200))
+    assert pt["dispatched"] + pt["rejected"] <= 200
+    assert pt["dispatched"] > 0
+
+    mass = asyncio.run(run_mass_cancellation(n=200, cancel_frac=0.5))
+    assert mass["evicted"] == 100
+    assert mass["survivors_dispatched"] == 100
+
+    churn = asyncio.run(run_topology_churn(n=200, concurrency=32))
+    assert churn["dispatched"] == 200
+    assert churn["flows_live_at_end"] == 200  # each request registered a flow
