@@ -235,6 +235,128 @@ def test_dist_pd_sharded_handoff_matches_monolithic():
     assert err_q.empty(), err_q.get() if not err_q.empty() else ""
 
 
+COORD_DEG = "127.0.0.1:19921"
+INSTR_DEG = 19922
+
+
+def _decode_degrade_worker(pid, tok_q, err_q):
+    """Decode group on the HOST wire receives a mixed-wire ktp: sharded
+    descriptors from a device-wire-only exporter (no shard_wire_addrs).
+    The fetch preflight must reject it and degrade to local prefill —
+    reference fallback-to-decode semantics (connector_nixlv2.go:160-177)."""
+    _child_env()
+    try:
+        from llm_d_inference_scheduler_tpu.engine import EngineRequest
+        from llm_d_inference_scheduler_tpu.engine.core import TpuEngine
+        from llm_d_inference_scheduler_tpu.engine.kv_shards import (
+            mesh_descriptor,
+        )
+        from llm_d_inference_scheduler_tpu.engine.multihost import (
+            maybe_init_distributed,
+            run_follower,
+        )
+
+        cfg = _cfg(dist_coordinator=COORD_DEG, dist_num_processes=2,
+                   dist_process_id=pid, dist_instr_port=INSTR_DEG)
+        maybe_init_distributed(cfg)
+        eng = TpuEngine(cfg)
+
+        if pid != 0:
+            run_follower(eng)
+            return
+
+        async def lead():
+            await eng.start()
+            # The ktp a device-wire exporter with matching page geometry
+            # would relay: transfer_shards present, shard_wire_addrs ABSENT.
+            # This decode group's wire is host (kv_wire=auto on cpu), so the
+            # preflight has no usable addresses and must not touch
+            # transfer_shards (port 1 would refuse anyway).
+            mesh, spec = eng._page_layout()
+            assert mesh is not None and eng._kv_wire == "host"
+            ktp = {
+                "remote_host": "127.0.0.1", "remote_port": 1,
+                "remote_request_id": "degrade-src",
+                "transfer_uuid": 7,
+                "kv_mesh": mesh_descriptor(mesh, spec),
+                "transfer_shards": ["127.0.0.1:1", "127.0.0.1:1"],
+            }
+            req = EngineRequest(
+                request_id="pd-degrade", prompt_token_ids=list(PROMPT),
+                max_tokens=N_GEN, temperature=0.0, ignore_eos=True,
+                kv_transfer_params=ktp)
+            toks, _ = await _collect(eng, req)
+            tok_q.put({"tokens": toks,
+                       "device_imports": eng.kv_import_device_count,
+                       "host_imports": eng.kv_import_host_count})
+            await eng.stop()
+
+        asyncio.run(lead())
+    except Exception as e:
+        import traceback
+
+        err_q.put(f"degrade pid{pid}: {e}\n{traceback.format_exc()[-2000:]}")
+
+
+def test_dist_pd_mixed_wire_degrades_to_local_prefill():
+    """VERDICT r4 weak #7 / NEXT item 6: a host-wire decode group handed a
+    ktp without shard_wire_addrs must fall back to local prefill — no wire
+    traffic, no deadlock, tokens identical to a monolithic engine."""
+    from llm_d_inference_scheduler_tpu.engine import EngineRequest
+    from llm_d_inference_scheduler_tpu.engine.core import TpuEngine
+
+    async def mono():
+        eng = TpuEngine(_cfg())
+        await eng.start()
+        try:
+            toks, _ = await _collect(eng, EngineRequest(
+                request_id="mono-deg", prompt_token_ids=list(PROMPT),
+                max_tokens=N_GEN, temperature=0.0, ignore_eos=True))
+            return toks
+        finally:
+            await eng.stop()
+
+    expected = asyncio.run(mono())
+    assert len(expected) == N_GEN
+
+    ctx = mp.get_context("spawn")
+    tok_q, err_q = ctx.Queue(), ctx.Queue()
+    procs = [ctx.Process(target=_decode_degrade_worker,
+                         args=(pid, tok_q, err_q), daemon=True)
+             for pid in range(2)]
+
+    import queue as _queue
+
+    for p in procs:
+        p.start()
+    try:
+        result = None
+        for _ in range(600):
+            try:
+                result = tok_q.get(timeout=1)
+                break
+            except _queue.Empty:
+                if not err_q.empty():
+                    raise AssertionError(err_q.get())
+        assert result is not None, "timed out waiting for degraded decode"
+        # Zero imports on either wire: the request was served by local
+        # prefill, not a transfer.
+        assert result["device_imports"] == 0
+        assert result["host_imports"] == 0
+        assert result["tokens"] == expected
+    finally:
+        for p in procs:
+            p.join(timeout=60)
+            if p.is_alive():
+                p.terminate()
+        for p in procs:
+            if p.is_alive():
+                p.join(timeout=10)
+                if p.is_alive():
+                    p.kill()
+    assert err_q.empty(), err_q.get() if not err_q.empty() else ""
+
+
 def test_shard_wire_roundtrip():
     """ShardWireServer protocol: register → pull → byte-exact arrays,
     unknown uuid errors, unregister drops."""
