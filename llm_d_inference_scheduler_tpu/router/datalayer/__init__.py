@@ -8,7 +8,9 @@ from .models_source import (
 )
 from .extractor import CoreMetricsExtractor, MappingRegistry
 from .data_graph import validate_and_order_producers
+from .http_source import HttpDataExtractor, HttpDataSource
 
 __all__ = ["Datastore", "EndpointPool", "DataLayerRuntime", "MetricsDataSource",
            "ModelsDataSource", "ModelsDataExtractor", "MODELS_ATTRIBUTE_KEY",
-           "CoreMetricsExtractor", "MappingRegistry", "validate_and_order_producers"]
+           "CoreMetricsExtractor", "MappingRegistry", "validate_and_order_producers",
+           "HttpDataSource", "HttpDataExtractor"]

@@ -1,0 +1,126 @@
+"""Generic HTTP data source: poll an arbitrary path on every endpoint into
+an endpoint attribute.
+
+Reference: framework/plugins/datalayer/source/http/{datasource.go,client.go}
+— a reusable HTTP/HTTPS poller (scheme + path + skip-verify + pluggable
+parser) that specific sources build on; the metrics source is its main
+embedder, but it is also registrable standalone so deployments can scrape
+any engine endpoint (e.g. /server_info) into the datastore without writing
+a plugin. The parser here is the paired http-data-extractor: JSON when the
+body parses, raw text otherwise, stored under a configurable attribute key.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from typing import Any
+
+import httpx
+
+from ..framework.datalayer import Endpoint
+from ..framework.plugin import PluginBase, register_plugin
+
+log = logging.getLogger("router.datalayer.http")
+
+
+@register_plugin("http-data-source")
+class HttpDataSource(PluginBase):
+    TYPE = "http-data-source"
+
+    def __init__(self, name: str | None = None):
+        super().__init__(name)
+        self._extractors: list[Any] = []
+        self._scheme = "http"
+        self._path = "/"
+        self._timeout = 10.0  # reference client.go timeout
+        self._insecure_skip_verify = False
+        # 0 = poll every collector cycle (the reference polls each cycle);
+        # raise for slow-moving data to keep scrape load down.
+        self._refresh_s = 0.0
+        self._last_poll: dict[str, float] = {}
+        self._client: httpx.AsyncClient | None = None
+
+    def configure(self, params: dict[str, Any], handle: Any) -> None:
+        scheme = str(params.get("scheme", self._scheme))
+        if scheme not in ("http", "https"):
+            # Reference datasource.go:46 rejects anything else.
+            raise ValueError(f"unsupported scheme: {scheme}")
+        self._scheme = scheme
+        self._path = str(params.get("path", self._path))
+        if not self._path.startswith("/"):
+            self._path = "/" + self._path
+        self._timeout = float(params.get("timeoutSeconds", self._timeout))
+        self._refresh_s = float(params.get("refreshSeconds", self._refresh_s))
+        self._insecure_skip_verify = bool(
+            params.get("insecureSkipVerify", self._insecure_skip_verify))
+
+    def add_extractor(self, ex: Any) -> None:
+        self._extractors.append(ex)
+
+    def extractors(self) -> list[Any]:
+        if not self._extractors:
+            ex = HttpDataExtractor("http-data-extractor")
+            ex.configure({"attributeKey": self._path}, None)
+            self._extractors.append(ex)
+        return list(self._extractors)
+
+    async def collect(self, endpoint: Endpoint) -> str | None:
+        key = endpoint.metadata.address_port
+        now = time.monotonic()
+        if self._refresh_s > 0 and now - self._last_poll.get(key, -1e9) < self._refresh_s:
+            return None
+        self._last_poll[key] = now
+        if self._client is None:
+            self._client = httpx.AsyncClient(
+                timeout=self._timeout,
+                verify=not self._insecure_skip_verify)
+        # Reference polls the metrics host (client.go GetMetricsHost).
+        port = endpoint.metadata.metrics_port or endpoint.metadata.port
+        url = f"{self._scheme}://{endpoint.metadata.address}:{port}{self._path}"
+        try:
+            r = await self._client.get(url)
+            r.raise_for_status()
+            return r.text
+        except Exception as e:
+            log.debug("http poll failed for %s%s: %s", key, self._path, e)
+            return None
+
+    async def close(self):
+        if self._client is not None:
+            await self._client.aclose()
+            self._client = None
+
+
+@register_plugin("http-data-extractor")
+class HttpDataExtractor(PluginBase):
+    TYPE = "http-data-extractor"
+
+    def __init__(self, name: str | None = None):
+        super().__init__(name)
+        self._attribute_key = "http-data"
+        self._format = "auto"  # auto | json | text
+
+    def configure(self, params: dict[str, Any], handle: Any) -> None:
+        self._attribute_key = str(params.get("attributeKey",
+                                             self._attribute_key))
+        fmt = str(params.get("format", self._format))
+        if fmt not in ("auto", "json", "text"):
+            raise ValueError(f"unsupported format: {fmt}")
+        self._format = fmt
+
+    def extract(self, raw: str | None, endpoint: Endpoint) -> None:
+        if raw is None:
+            return
+        value: Any = raw
+        if self._format in ("auto", "json"):
+            try:
+                value = json.loads(raw)
+            except Exception:
+                if self._format == "json":
+                    log.debug("unparseable JSON body for %s (key %s)",
+                              endpoint.metadata.address_port,
+                              self._attribute_key)
+                    return
+        endpoint.attributes.put(self._attribute_key, value)

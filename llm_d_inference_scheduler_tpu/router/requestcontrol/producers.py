@@ -132,6 +132,11 @@ class TokenProducer(PluginBase):
     /v1/chat/completions/render over HTTP (tokenizer/vllm_http.go); here the
     TPU engines expose the same endpoints. An LRU keyed by (model, prompt)
     keeps repeat tokenizations off the producer budget.
+
+    With ``udsPath`` set, the render calls go to a node-local tokenizer
+    service over a unix-domain socket instead of the scheduled endpoint —
+    the reference's UdsTokenizer transport (dataproducer/tokenizer/uds.go),
+    which avoids a network hop for every admission-path tokenization.
     """
 
     TOKENIZED_KEY = "request/tokenized"
@@ -140,12 +145,14 @@ class TokenProducer(PluginBase):
         super().__init__(name)
         self.timeout_s = 0.35  # must fit the director's 400ms producer budget
         self.cache_capacity = 2048
+        self.uds_path: str | None = None
         self._cache: OrderedDict[tuple[str, str], list[int]] = OrderedDict()
         self._client = None
 
     def configure(self, params: dict[str, Any], handle: Any) -> None:
         self.timeout_s = float(params.get("timeoutSeconds", self.timeout_s))
         self.cache_capacity = int(params.get("cacheCapacity", self.cache_capacity))
+        self.uds_path = params.get("udsPath", self.uds_path) or None
 
     def produces(self) -> list[str]:
         return [self.TOKENIZED_KEY]
@@ -167,13 +174,21 @@ class TokenProducer(PluginBase):
         import httpx
 
         if self._client is None:
-            self._client = httpx.AsyncClient(timeout=self.timeout_s)
-        ep = endpoints[0]
+            if self.uds_path:
+                self._client = httpx.AsyncClient(
+                    timeout=self.timeout_s,
+                    transport=httpx.AsyncHTTPTransport(uds=self.uds_path))
+            else:
+                self._client = httpx.AsyncClient(timeout=self.timeout_s)
         path = "/v1/chat/completions/render" if chat else "/v1/completions/render"
+        # UDS: the authority part is ignored by the socket transport but
+        # required by the URL grammar (uds.go targets a fixed local service).
+        base = ("http://tokenizer" if self.uds_path
+                else endpoints[0].metadata.url)
         payload = (request.body.chat_completions if chat
                    else request.body.completions) or {}
         try:
-            r = await self._client.post(ep.metadata.url + path, json=payload)
+            r = await self._client.post(base + path, json=payload)
             r.raise_for_status()
             ids = r.json().get("token_ids")
         except Exception:

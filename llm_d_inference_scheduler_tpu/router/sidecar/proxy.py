@@ -58,12 +58,22 @@ class SidecarConfig:
     # Data parallelism (reference data_parallel.go:19-88): one extra listener
     # per DP rank; rank i listens on port+i and dispatches to decoderPort+i.
     data_parallel_size: int = 1
+    # Prefiller sampling (reference chat_completions.go:79-95): when the
+    # router supplies MULTIPLE prefill candidates (repeated header values or
+    # one comma-separated value), pick one uniformly at random instead of
+    # always the first — spreads prefill load when the scheduler returns a
+    # candidate set rather than a single pick.
+    enable_prefiller_sampling: bool = False
 
 
 class Sidecar:
     def __init__(self, cfg: SidecarConfig, *, dp_rank: int = 0):
+        import random
+
         self.cfg = cfg
         self.dp_rank = dp_rank
+        # Injectable for tests (reference prefillSamplerFn).
+        self._prefill_sampler = random.randrange
         self.app = web.Application()
         self.app.add_routes([web.post(p, self.handle_generate) for p in GEN_PATHS])
         self.app.add_routes([
@@ -153,7 +163,7 @@ class Sidecar:
 
         # Disagg headers are consumed here and never forwarded downstream
         # (upstream dispatch builds its own header set).
-        prefiller = request.headers.get(H_PREFILLER)
+        prefiller = self._pick_prefiller(request)
         encoders = request.headers.get(H_ENCODERS)
 
         if encoders and self.cfg.connector != "passthrough":
@@ -179,6 +189,21 @@ class Sidecar:
                 return await self._run_sglang_protocol(request, body, prefiller)
             return await self._run_pd_protocol(request, body, prefiller)
         return await self._dispatch_decode(request, body)
+
+    def _pick_prefiller(self, request: web.Request) -> str | None:
+        """Resolve the prefill target from the routing header
+        (chat_completions.go:79-95): the router may send repeated header
+        values or one comma-separated value; with sampling enabled pick
+        uniformly at random, else the first candidate."""
+        values = request.headers.getall(H_PREFILLER, [])
+        if len(values) == 1:
+            values = values[0].split(",")
+        hosts = [v.strip() for v in values if v.strip()]
+        if not hosts:
+            return None
+        if self.cfg.enable_prefiller_sampling:
+            return hosts[self._prefill_sampler(len(hosts))]
+        return hosts[0]
 
     async def _run_sglang_protocol(self, request: web.Request,
                                    body: dict[str, Any],
@@ -522,6 +547,9 @@ def main(argv: list[str] | None = None):
                         "(enables SSRF protection)")
     p.add_argument("--decode-chunk-size", type=int, default=0)
     p.add_argument("--data-parallel-size", type=int, default=1)
+    p.add_argument("--enable-prefiller-sampling", action="store_true",
+                   help="sample a random prefiller from the candidate list "
+                        "instead of the first (chat_completions.go:89)")
     args = p.parse_args(argv)
     cfg = SidecarConfig(
         port=args.port, host=args.host, decoder_url=args.decoder,
@@ -531,7 +559,8 @@ def main(argv: list[str] | None = None):
         decode_chunk_size=args.decode_chunk_size,
         data_parallel_size=args.data_parallel_size,
         cache_hit_threshold=args.cache_hit_threshold,
-        bootstrap_port=args.bootstrap_port)
+        bootstrap_port=args.bootstrap_port,
+        enable_prefiller_sampling=args.enable_prefiller_sampling)
     logging.basicConfig(level=logging.INFO)
 
     async def run():

@@ -1,0 +1,208 @@
+"""Reference-parity tail (VERDICT r4 missing #2-#4): prefiller sampling,
+the generic HTTP datalayer source, and the tokenizer UDS transport."""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import make_mocked_request
+from multidict import CIMultiDict
+
+from llm_d_inference_scheduler_tpu.router.sidecar.proxy import (
+    Sidecar,
+    SidecarConfig,
+)
+
+
+def _req(headers: list[tuple[str, str]]):
+    return make_mocked_request("POST", "/v1/completions",
+                               headers=CIMultiDict(headers))
+
+
+def test_prefiller_sampling():
+    """chat_completions.go:79-95: repeated header values and comma lists are
+    both candidate sets; sampling picks uniformly, default picks first."""
+    first = Sidecar(SidecarConfig())
+    # Default: first candidate, comma-separated form.
+    r = _req([("x-prefiller-host-port", "a:1, b:2 ,c:3")])
+    assert first._pick_prefiller(r) == "a:1"
+    # Repeated header values.
+    r = _req([("x-prefiller-host-port", "a:1"),
+              ("x-prefiller-host-port", "b:2")])
+    assert first._pick_prefiller(r) == "a:1"
+    # No header → no prefiller.
+    assert first._pick_prefiller(_req([])) is None
+    # Empty-ish values are dropped.
+    r = _req([("x-prefiller-host-port", " , ,x:9")])
+    assert first._pick_prefiller(r) == "x:9"
+
+    sampling = Sidecar(SidecarConfig(enable_prefiller_sampling=True))
+    picks = []
+    sampling._prefill_sampler = lambda n: picks.append(n) or (n - 1)
+    r = _req([("x-prefiller-host-port", "a:1,b:2,c:3")])
+    assert sampling._pick_prefiller(r) == "c:3"
+    assert picks == [3]  # sampler sees the full candidate count
+
+    # Statistical spread with the real sampler: over many draws every
+    # candidate appears (uniform over 3, 60 draws: miss odds ~3e-11).
+    real = Sidecar(SidecarConfig(enable_prefiller_sampling=True))
+    seen = {real._pick_prefiller(r) for _ in range(60)}
+    assert seen == {"a:1", "b:2", "c:3"}
+
+
+def test_http_data_source_polls_into_attribute():
+    """framework/plugins/datalayer/source/http: generic poller stores the
+    parsed body under a configurable attribute key."""
+    from llm_d_inference_scheduler_tpu.router.datalayer.datastore import (
+        Datastore,
+    )
+    from llm_d_inference_scheduler_tpu.router.datalayer.http_source import (
+        HttpDataExtractor,
+        HttpDataSource,
+    )
+    from llm_d_inference_scheduler_tpu.router.framework.datalayer import (
+        EndpointMetadata,
+    )
+
+    PORT = 18571
+
+    async def body():
+        calls = {"n": 0}
+
+        async def server_info(request):
+            calls["n"] += 1
+            return web.json_response({"engine": "tpu", "n": calls["n"]})
+
+        async def plain(request):
+            return web.Response(text="not json at all")
+
+        app = web.Application()
+        app.add_routes([web.get("/server_info", server_info),
+                        web.get("/plain", plain)])
+        runner = web.AppRunner(app)
+        await runner.setup()
+        await web.TCPSite(runner, "127.0.0.1", PORT).start()
+
+        ds = Datastore()
+        ep = ds.endpoint_add_or_update(EndpointMetadata(
+            name="e1", address="127.0.0.1", port=PORT))
+        try:
+            src = HttpDataSource("http-data-source")
+            src.configure({"path": "server_info"}, None)  # leading / added
+            # Default extractor pairing keys by path.
+            exs = src.extractors()
+            raw = await src.collect(ep)
+            assert raw is not None
+            for ex in exs:
+                ex.extract(raw, ep)
+            assert ep.attributes.get("/server_info") == {"engine": "tpu",
+                                                         "n": 1}
+
+            # Explicit extractor with custom key + text format.
+            src2 = HttpDataSource("src2")
+            src2.configure({"path": "/plain"}, None)
+            ex2 = HttpDataExtractor("ex2")
+            ex2.configure({"attributeKey": "info/plain", "format": "text"},
+                          None)
+            src2.add_extractor(ex2)
+            raw2 = await src2.collect(ep)
+            ex2.extract(raw2, ep)
+            assert ep.attributes.get("info/plain") == "not json at all"
+
+            # format=json on an unparseable body stores nothing.
+            ex3 = HttpDataExtractor("ex3")
+            ex3.configure({"attributeKey": "info/strict", "format": "json"},
+                          None)
+            ex3.extract(raw2, ep)
+            assert ep.attributes.get("info/strict") is None
+
+            # refreshSeconds throttles: a second collect inside the window
+            # is a no-op (None), not another GET.
+            src3 = HttpDataSource("src3")
+            src3.configure({"path": "/server_info", "refreshSeconds": 30},
+                           None)
+            assert await src3.collect(ep) is not None
+            n_after_first = calls["n"]
+            assert await src3.collect(ep) is None
+            assert calls["n"] == n_after_first
+
+            await src.close()
+            await src2.close()
+            await src3.close()
+        finally:
+            await runner.cleanup()
+
+    asyncio.run(body())
+
+    # Scheme validation (datasource.go:46).
+    from llm_d_inference_scheduler_tpu.router.datalayer.http_source import (
+        HttpDataSource as S,
+    )
+
+    with pytest.raises(ValueError, match="unsupported scheme"):
+        S("bad").configure({"scheme": "ftp"}, None)
+
+
+def test_token_producer_uds_transport(tmp_path):
+    """dataproducer/tokenizer/uds.go: with udsPath set, render calls ride a
+    unix socket to a node-local tokenizer, not the scheduled endpoint."""
+    from llm_d_inference_scheduler_tpu.router.datalayer.datastore import (
+        Datastore,
+    )
+    from llm_d_inference_scheduler_tpu.router.framework.datalayer import (
+        EndpointMetadata,
+    )
+    from llm_d_inference_scheduler_tpu.router.framework.scheduling import (
+        InferenceRequest,
+        InferenceRequestBody,
+    )
+    from llm_d_inference_scheduler_tpu.router.requestcontrol.producers import (
+        TokenProducer,
+    )
+
+    sock = str(tmp_path / "tokenizer.sock")
+
+    async def body():
+        async def render(request):
+            doc = await request.json()
+            toks = [len(w) for w in (doc.get("prompt") or "").split()]
+            return web.json_response({"token_ids": toks})
+
+        app = web.Application()
+        app.add_routes([web.post("/v1/completions/render", render)])
+        runner = web.AppRunner(app)
+        await runner.setup()
+        await web.UnixSite(runner, sock).start()
+
+        ds = Datastore()
+        # Deliberately unreachable endpoint: proves the socket carried the
+        # render call, not the endpoint URL.
+        ep = ds.endpoint_add_or_update(EndpointMetadata(
+            name="e1", address="127.0.0.1", port=1))
+        try:
+            tp = TokenProducer("token-producer")
+            tp.configure({"udsPath": sock}, None)
+            req = InferenceRequest(
+                request_id="r1", target_model="m",
+                body=InferenceRequestBody(
+                    completions={"prompt": "alpha bb cccc"}))
+            await tp.produce(None, req, [ep])
+            assert req.body.tokenized_prompt == [5, 2, 4]
+            # Cached on repeat (no socket needed).
+            req2 = InferenceRequest(
+                request_id="r2", target_model="m",
+                body=InferenceRequestBody(
+                    completions={"prompt": "alpha bb cccc"}))
+            await runner.cleanup()
+            await tp.produce(None, req2, [ep])
+            assert req2.body.tokenized_prompt == [5, 2, 4]
+            if tp._client is not None:
+                await tp._client.aclose()
+        finally:
+            try:
+                await runner.cleanup()
+            except Exception:
+                pass
+
+    asyncio.run(body())
