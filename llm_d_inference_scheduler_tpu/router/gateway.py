@@ -70,8 +70,19 @@ class Gateway:
                  grpc_ext_proc_port: int | None = None,
                  lease_path: str | None = None,
                  config_watch_path: str | None = None,
-                 kube_binding=None, kube_elector=None):
+                 kube_binding=None, kube_elector=None,
+                 secure_serving: bool = False,
+                 cert_path: str | None = None,
+                 enable_cert_reload: bool = False):
         self.cfg = cfg
+        # Secure serving (reference runserver.go:136-171): one identity for
+        # the HTTP listener and the ext-proc gRPC port; self-signed fallback
+        # when no cert dir is mounted.
+        self.tls = None
+        if secure_serving:
+            from .tlsutil import TlsServing
+
+            self.tls = TlsServing(cert_path, enable_cert_reload)
         self.datastore = datastore
         self.dl_runtime = dl_runtime
         self.host, self.port = host, port
@@ -167,7 +178,7 @@ class Gateway:
 
             self.grpc_ext_proc = ExtProcServer(
                 self.director, self.parser, evictor=self.evictor,
-                host=host, port=grpc_ext_proc_port)
+                host=host, port=grpc_ext_proc_port, tls=self.tls)
 
     # ---- lifecycle ------------------------------------------------------
 
@@ -185,7 +196,9 @@ class Gateway:
         self._client = httpx.AsyncClient(timeout=httpx.Timeout(300.0, connect=5.0))
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, self.host, self.port)
+        site = web.TCPSite(self._runner, self.host, self.port,
+                           ssl_context=self.tls.ssl_context
+                           if self.tls else None)
         await site.start()
         self._flusher = asyncio.get_running_loop().create_task(self._flush_pool_gauges())
         if self.grpc_health is not None:
@@ -221,6 +234,8 @@ class Gateway:
         if self._client:
             await self._client.aclose()
         await self.dl_runtime.stop()
+        if self.tls is not None:
+            self.tls.close()
 
     async def _flush_pool_gauges(self):
         # reference: periodic pool-gauge flusher (datalayer/logger.go:38-124)
@@ -612,7 +627,10 @@ def build_gateway(config_text: str | None, *, host: str = "127.0.0.1",
                   grpc_ext_proc_port: int | None = None,
                   lease_path: str | None = None,
                   config_watch_path: str | None = None,
-                  kube: dict | None = None) -> Gateway:
+                  kube: dict | None = None,
+                  secure_serving: bool = False,
+                  cert_path: str | None = None,
+                  enable_cert_reload: bool = False) -> Gateway:
     datastore = Datastore()
     dl_runtime = DataLayerRuntime(datastore, poll_interval=poll_interval)
     handle = Handle(datastore=datastore, dl_runtime=dl_runtime)
@@ -663,7 +681,10 @@ def build_gateway(config_text: str | None, *, host: str = "127.0.0.1",
                    kube_binding=kube_binding,
                    lease_path=lease_path,
                    kube_elector=kube_elector,
-                   config_watch_path=config_watch_path)
+                   config_watch_path=config_watch_path,
+                   secure_serving=secure_serving,
+                   cert_path=cert_path,
+                   enable_cert_reload=enable_cert_reload)
 
 
 def main(argv: list[str] | None = None):
@@ -704,6 +725,16 @@ def main(argv: list[str] | None = None):
                         "election (reference id shape: "
                         "epp-<ns>-<pool>.llm-d.ai); requires --kube-api-url "
                         "and supersedes --ha-lease-path")
+    p.add_argument("--secure-serving", action="store_true",
+                   help="serve HTTP and ext-proc gRPC over TLS; without "
+                        "--cert-path a self-signed certificate is minted "
+                        "(runserver.go:136-171)")
+    p.add_argument("--cert-path", default=None,
+                   help="directory holding tls.crt + tls.key (the "
+                        "kubernetes.io/tls Secret mount layout)")
+    p.add_argument("--enable-cert-reload", action="store_true",
+                   help="re-read --cert-path on change so cert-manager "
+                        "rotations apply without a restart (certs.go)")
     args = p.parse_args(argv)
 
     text = args.config_text
@@ -731,7 +762,10 @@ def main(argv: list[str] | None = None):
                        lease_path=args.ha_lease_path,
                        config_watch_path=(args.config_file
                                           if args.watch_config else None),
-                       kube=kube)
+                       kube=kube,
+                       secure_serving=args.secure_serving,
+                       cert_path=args.cert_path,
+                       enable_cert_reload=args.enable_cert_reload)
     if args.endpoints:
         from .framework.datalayer import EndpointMetadata
         metas = []

@@ -301,11 +301,14 @@ class ExtProcServer:
     """Serves ExternalProcessor/Process: one ExtProcSession per stream."""
 
     def __init__(self, director: Any, parser: Any, *, evictor: Any = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0, tls: Any = None):
         self.director = director
         self.parser = parser
         self.evictor = evictor
         self.host, self.port = host, port
+        # Secure serving (runserver.go:136-171): a TlsServing identity —
+        # cert dir or self-signed fallback, optional per-handshake reload.
+        self.tls = tls
         self._server: grpc.aio.Server | None = None
 
     async def _process(self, request_iterator: AsyncIterator[bytes], context):
@@ -389,10 +392,15 @@ class ExtProcServer:
                 response_serializer=lambda b: b),
         })
         self._server.add_generic_rpc_handlers((handlers,))
-        self.port = self._server.add_insecure_port(f"{self.host}:{self.port}")
+        addr = f"{self.host}:{self.port}"
+        if self.tls is not None:
+            self.port = self._server.add_secure_port(
+                addr, self.tls.grpc_server_credentials())
+        else:
+            self.port = self._server.add_insecure_port(addr)
         await self._server.start()
-        log.info("ext-proc gRPC (FULL_DUPLEX_STREAMED) on %s:%d",
-                 self.host, self.port)
+        log.info("ext-proc gRPC (FULL_DUPLEX_STREAMED) on %s:%d%s",
+                 self.host, self.port, " (TLS)" if self.tls else "")
         return self.port
 
     async def stop(self):

@@ -64,6 +64,19 @@ class SidecarConfig:
     # always the first — spreads prefill load when the scheduler returns a
     # candidate set rather than a single pick.
     enable_prefiller_sampling: bool = False
+    # Secure serving + per-leg TLS (reference proxy.go:153-170): the sidecar
+    # itself can serve HTTPS (cert dir or self-signed fallback), and each
+    # outbound leg independently chooses TLS + verification — in-cluster
+    # engines usually present pod-local certs, so skip-verify is per-leg.
+    secure_serving: bool = False
+    cert_path: str | None = None
+    enable_cert_reload: bool = False
+    use_tls_for_prefiller: bool = False
+    use_tls_for_decoder: bool = False
+    use_tls_for_encoder: bool = False
+    insecure_skip_verify_prefiller: bool = False
+    insecure_skip_verify_decoder: bool = False
+    insecure_skip_verify_encoder: bool = False
 
 
 class Sidecar:
@@ -89,9 +102,23 @@ class Sidecar:
             web.get("/kv_events", self._proxy_get_stream),
         ])
         self._runner: web.AppRunner | None = None
-        self._client: httpx.AsyncClient | None = None
+        self._client: httpx.AsyncClient | None = None       # decode leg
+        self._prefill_client: httpx.AsyncClient | None = None
+        self._encode_client: httpx.AsyncClient | None = None
+        self._tls = None          # TlsServing; rank 0 owns, children borrow
+        self._tls_owned = False
         self._dp_children: list["Sidecar"] = []
         self._bg_tasks: set = set()  # strong refs for fire-and-forget legs
+
+    # ---- per-leg TLS (reference proxy.go:153-166) -----------------------
+
+    def _prefill_base(self, prefiller: str) -> str:
+        scheme = "https" if self.cfg.use_tls_for_prefiller else "http"
+        return f"{scheme}://{prefiller}"
+
+    def _encode_base(self, host: str) -> str:
+        scheme = "https" if self.cfg.use_tls_for_encoder else "http"
+        return f"{scheme}://{host}"
 
     def _dp_header_url(self, request: web.Request) -> str | None:
         """Legacy x-data-parallel-host-port dispatch (data_parallel.go:19-88):
@@ -114,24 +141,44 @@ class Sidecar:
         return None
 
     def _rank_url(self) -> str:
-        """decoder URL shifted by this listener's DP rank (data_parallel.go:39-88)."""
-        if self.dp_rank == 0:
-            return self.cfg.decoder_url
+        """decoder URL shifted by this listener's DP rank (data_parallel.go:39-88);
+        use_tls_for_decoder upgrades the scheme (proxy.go:155)."""
         from urllib.parse import urlsplit
 
         parts = urlsplit(self.cfg.decoder_url)
+        scheme = "https" if self.cfg.use_tls_for_decoder else parts.scheme
+        if self.dp_rank == 0:
+            netloc = parts.netloc
+            return f"{scheme}://{netloc}"
         if parts.port is None:
             raise ValueError(
                 f"decoder URL {self.cfg.decoder_url!r} needs an explicit port "
                 f"for data-parallel rank dispatch")
-        return f"{parts.scheme}://{parts.hostname}:{parts.port + self.dp_rank}"
+        return f"{scheme}://{parts.hostname}:{parts.port + self.dp_rank}"
 
     async def start(self):
+        from ..tlsutil import client_verify
+
         self._client = httpx.AsyncClient(
-            timeout=httpx.Timeout(self.cfg.decode_timeout_s, connect=5.0))
+            timeout=httpx.Timeout(self.cfg.decode_timeout_s, connect=5.0),
+            verify=client_verify(self.cfg.insecure_skip_verify_decoder))
+        self._prefill_client = httpx.AsyncClient(
+            timeout=httpx.Timeout(self.cfg.prefill_timeout_s, connect=5.0),
+            verify=client_verify(self.cfg.insecure_skip_verify_prefiller))
+        self._encode_client = httpx.AsyncClient(
+            timeout=httpx.Timeout(self.cfg.prefill_timeout_s, connect=5.0),
+            verify=client_verify(self.cfg.insecure_skip_verify_encoder))
+        if self.cfg.secure_serving and self._tls is None:
+            from ..tlsutil import TlsServing
+
+            self._tls = TlsServing(self.cfg.cert_path,
+                                   self.cfg.enable_cert_reload)
+            self._tls_owned = True
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, self.cfg.host, self.cfg.port + self.dp_rank)
+        site = web.TCPSite(self._runner, self.cfg.host, self.cfg.port + self.dp_rank,
+                           ssl_context=self._tls.ssl_context
+                           if self._tls else None)
         await site.start()
         log.info("sidecar on %s:%s -> decoder %s (connector=%s, dp_rank=%d)",
                  self.cfg.host, self.cfg.port + self.dp_rank, self._rank_url(),
@@ -139,6 +186,7 @@ class Sidecar:
         if self.dp_rank == 0 and self.cfg.data_parallel_size > 1:
             for rank in range(1, self.cfg.data_parallel_size):
                 child = Sidecar(self.cfg, dp_rank=rank)
+                child._tls = self._tls  # one serving identity per pod
                 child._rank_url()  # fail fast on port-less decoder URLs
                 await child.start()
                 self._dp_children.append(child)
@@ -149,8 +197,11 @@ class Sidecar:
         self._dp_children.clear()
         if self._runner:
             await self._runner.cleanup()
-        if self._client:
-            await self._client.aclose()
+        for c in (self._client, self._prefill_client, self._encode_client):
+            if c is not None:
+                await c.aclose()
+        if self._tls is not None and self._tls_owned:
+            self._tls.close()
 
     # ---- request handling ------------------------------------------------
 
@@ -231,9 +282,9 @@ class Sidecar:
                 # finishing first must not cancel the prefill leg
                 # (connector_sglang.go uses context.WithoutCancel).
                 try:
-                    r = await self._client.post(
-                        f"http://{prefiller}{request.path}", json=boot,
-                        timeout=self.cfg.prefill_timeout_s)
+                    r = await self._prefill_client.post(
+                        self._prefill_base(prefiller) + request.path,
+                        json=boot, timeout=self.cfg.prefill_timeout_s)
                     if r.status_code >= 300:
                         log.warning("sglang prefill at %s returned %d",
                                     prefiller, r.status_code)
@@ -328,9 +379,10 @@ class Sidecar:
             primed = [(h, share, idxs) for h, share, idxs
                       in zip(hosts, shares, share_indices) if share]
             results = await _aio.gather(*[
-                self._client.post(f"http://{h}/v1/encode",
-                                  json={"request_id": rid, "items": share,
-                                        "item_indices": idxs})
+                self._encode_client.post(self._encode_base(h) + "/v1/encode",
+                                         json={"request_id": rid,
+                                               "items": share,
+                                               "item_indices": idxs})
                 for h, share, idxs in primed])
             for r in results:
                 if r.status_code != 200:
@@ -372,9 +424,9 @@ class Sidecar:
 
         ktp = None
         try:
-            r = await self._client.post(
-                f"http://{prefiller}{request.path}", json=prefill_body,
-                timeout=self.cfg.prefill_timeout_s)
+            r = await self._prefill_client.post(
+                self._prefill_base(prefiller) + request.path,
+                json=prefill_body, timeout=self.cfg.prefill_timeout_s)
             if r.status_code == 200:
                 ktp = r.json().get("kv_transfer_params")
             else:
@@ -550,6 +602,18 @@ def main(argv: list[str] | None = None):
     p.add_argument("--enable-prefiller-sampling", action="store_true",
                    help="sample a random prefiller from the candidate list "
                         "instead of the first (chat_completions.go:89)")
+    p.add_argument("--secure-serving", action="store_true",
+                   help="serve HTTPS; without --cert-path a self-signed "
+                        "certificate is minted (proxy_helpers.go:55-100)")
+    p.add_argument("--cert-path", default=None,
+                   help="directory holding tls.crt + tls.key")
+    p.add_argument("--enable-cert-reload", action="store_true",
+                   help="re-read --cert-path when it changes")
+    for leg in ("prefiller", "decoder", "encoder"):
+        p.add_argument(f"--use-tls-for-{leg}", action="store_true",
+                       help=f"send {leg} requests over https (proxy.go:155)")
+        p.add_argument(f"--insecure-skip-verify-{leg}", action="store_true",
+                       help=f"skip TLS verification on the {leg} leg")
     args = p.parse_args(argv)
     cfg = SidecarConfig(
         port=args.port, host=args.host, decoder_url=args.decoder,
@@ -560,7 +624,16 @@ def main(argv: list[str] | None = None):
         data_parallel_size=args.data_parallel_size,
         cache_hit_threshold=args.cache_hit_threshold,
         bootstrap_port=args.bootstrap_port,
-        enable_prefiller_sampling=args.enable_prefiller_sampling)
+        enable_prefiller_sampling=args.enable_prefiller_sampling,
+        secure_serving=args.secure_serving,
+        cert_path=args.cert_path,
+        enable_cert_reload=args.enable_cert_reload,
+        use_tls_for_prefiller=args.use_tls_for_prefiller,
+        use_tls_for_decoder=args.use_tls_for_decoder,
+        use_tls_for_encoder=args.use_tls_for_encoder,
+        insecure_skip_verify_prefiller=args.insecure_skip_verify_prefiller,
+        insecure_skip_verify_decoder=args.insecure_skip_verify_decoder,
+        insecure_skip_verify_encoder=args.insecure_skip_verify_encoder)
     logging.basicConfig(level=logging.INFO)
 
     async def run():

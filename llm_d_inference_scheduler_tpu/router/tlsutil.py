@@ -1,0 +1,218 @@
+"""TLS serving: self-signed fallback, cert-dir loading, and live reload.
+
+Mirrors the reference's secure-serving stack
+(/root/reference/internal/tls/tls.go:33 CreateSelfSignedTLSCertificate,
+/root/reference/pkg/common/certs.go NewCertReloader,
+/root/reference/pkg/epp/server/runserver.go:136-171 SecureServing wiring):
+
+- With no cert path, a process-local self-signed certificate is minted at
+  startup (10-year validity, serverAuth EKU) so TLS is never a deployment
+  prerequisite.
+- With a cert path, ``<path>/tls.crt`` + ``<path>/tls.key`` are loaded —
+  the mount layout of a kubernetes.io/tls Secret.
+- With reload enabled, the pair is re-read when its mtime changes
+  (debounced), so cert-manager rotations take effect without a restart.
+  The reference watches with fsnotify; here a 1 s mtime poll drives
+  ``SSLContext.load_cert_chain`` on the live context — new handshakes pick
+  up the new pair, established connections are untouched (same semantics
+  as the reference's GetCertificate indirection).
+"""
+
+from __future__ import annotations
+
+import datetime
+import logging
+import os
+import ssl
+import tempfile
+import threading
+from typing import Any
+
+log = logging.getLogger("router.tls")
+
+CERT_FILE = "tls.crt"
+KEY_FILE = "tls.key"
+_RELOAD_POLL_S = 1.0
+
+
+def create_self_signed_cert(common_name: str = "llm-d-tpu",
+                            org: str = "Inference Ext",
+                            ) -> tuple[bytes, bytes]:
+    """Mint a self-signed server certificate (tls.go:33-86): 10-year
+    validity, digitalSignature+keyEncipherment, serverAuth EKU. SANs for
+    localhost loopback are added so in-cluster health probes can pin the
+    cert if they want to (clients normally skip verification for the
+    self-signed fallback, as the reference's do)."""
+    import ipaddress
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import ExtendedKeyUsageOID, NameOID
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name([
+        x509.NameAttribute(NameOID.ORGANIZATION_NAME, org),
+        x509.NameAttribute(NameOID.COMMON_NAME, common_name),
+    ])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now)
+        .not_valid_after(now + datetime.timedelta(days=3650))
+        .add_extension(x509.KeyUsage(
+            digital_signature=True, key_encipherment=True,
+            content_commitment=False, data_encipherment=False,
+            key_agreement=False, key_cert_sign=False, crl_sign=False,
+            encipher_only=False, decipher_only=False), critical=True)
+        .add_extension(x509.ExtendedKeyUsage(
+            [ExtendedKeyUsageOID.SERVER_AUTH]), critical=False)
+        .add_extension(x509.BasicConstraints(ca=False, path_length=None),
+                       critical=True)
+        .add_extension(x509.SubjectAlternativeName([
+            x509.DNSName("localhost"),
+            x509.DNSName(common_name),
+            x509.IPAddress(ipaddress.ip_address("127.0.0.1")),
+        ]), critical=False)
+        .sign(key, hashes.SHA256())
+    )
+    cert_pem = cert.public_bytes(serialization.Encoding.PEM)
+    key_pem = key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption())
+    return cert_pem, key_pem
+
+
+class TlsServing:
+    """One serving identity: cert-dir or self-signed, optional reload.
+
+    Exposes both transports used on the serving path: an ``ssl.SSLContext``
+    for aiohttp listeners (gateway HTTP, sidecar) and gRPC server
+    credentials (ext-proc), from the same certificate pair.
+    """
+
+    def __init__(self, cert_path: str | None = None,
+                 enable_reload: bool = False,
+                 common_name: str = "llm-d-tpu"):
+        self.cert_path = cert_path or None
+        # Reload needs real files to watch (runserver.go:159 gates reload on
+        # CertPath being set the same way).
+        self.enable_reload = bool(enable_reload and cert_path)
+        self._tmpdir: tempfile.TemporaryDirectory | None = None
+        if self.cert_path:
+            self._crt = os.path.join(self.cert_path, CERT_FILE)
+            self._key = os.path.join(self.cert_path, KEY_FILE)
+        else:
+            cert_pem, key_pem = create_self_signed_cert(common_name)
+            self._tmpdir = tempfile.TemporaryDirectory(prefix="llmd-tls-")
+            self._crt = os.path.join(self._tmpdir.name, CERT_FILE)
+            self._key = os.path.join(self._tmpdir.name, KEY_FILE)
+            with open(self._crt, "wb") as f:
+                f.write(cert_pem)
+            with open(self._key, "wb") as f:
+                f.write(key_pem)
+            log.info("TLS: using a self-signed certificate (no cert path)")
+        self._ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        self._ctx.minimum_version = ssl.TLSVersion.TLSv1_2
+        self._ctx.load_cert_chain(self._crt, self._key)
+        self._mtimes = self._stat()
+        self._stop = threading.Event()
+        self._watcher: threading.Thread | None = None
+        if self.enable_reload:
+            self._watcher = threading.Thread(
+                target=self._watch, name="cert-reload", daemon=True)
+            self._watcher.start()
+
+    # ---- server-side material -------------------------------------------
+
+    @property
+    def ssl_context(self) -> ssl.SSLContext:
+        return self._ctx
+
+    def cert_pem(self) -> bytes:
+        with open(self._crt, "rb") as f:
+            return f.read()
+
+    def key_pem(self) -> bytes:
+        with open(self._key, "rb") as f:
+            return f.read()
+
+    def grpc_server_credentials(self) -> Any:
+        """gRPC creds for add_secure_port. With reload, the certificate
+        configuration is re-fetched per handshake (the grpc-python analogue
+        of the reference's GetCertificate callback)."""
+        import grpc
+
+        if not self.enable_reload:
+            return grpc.ssl_server_credentials(
+                [(self.key_pem(), self.cert_pem())])
+
+        def fetch():
+            try:
+                return grpc.ssl_server_certificate_configuration(
+                    [(self.key_pem(), self.cert_pem())])
+            except Exception as e:  # keep serving the previous pair
+                log.warning("cert fetch failed: %s", e)
+                return None
+
+        return grpc.dynamic_ssl_server_credentials(
+            fetch(), lambda: fetch(), require_client_authentication=False)
+
+    # ---- reload ----------------------------------------------------------
+
+    def _stat(self):
+        try:
+            return (os.stat(self._crt).st_mtime_ns,
+                    os.stat(self._key).st_mtime_ns)
+        except OSError:
+            return None
+
+    def _watch(self):
+        # Debounce like certs.go:33 (250 ms): a rotation writes two files;
+        # reload once both settle.
+        pending_since = None
+        while not self._stop.wait(_RELOAD_POLL_S):
+            now = self._stat()
+            if now is None or now == self._mtimes:
+                if pending_since is not None:
+                    try:
+                        self._ctx.load_cert_chain(self._crt, self._key)
+                        self._mtimes = self._stat()
+                        pending_since = None
+                        log.info("TLS: reloaded certificate from %s",
+                                 self.cert_path)
+                    except Exception as e:
+                        # Mid-rotation partial write: retry next tick.
+                        log.warning("TLS reload failed (will retry): %s", e)
+                continue
+            self._mtimes = now
+            pending_since = True
+
+    def close(self):
+        self._stop.set()
+        if self._watcher is not None:
+            self._watcher.join(timeout=3)
+        if self._tmpdir is not None:
+            self._tmpdir.cleanup()
+            self._tmpdir = None
+
+
+def client_verify(insecure_skip_verify: bool = False,
+                  ca_cert_path: str | None = None) -> Any:
+    """The httpx ``verify`` argument for a TLS client leg
+    (proxy_helpers.go client transport): a CA bundle path, a permissive
+    context when verification is skipped, or stock verification."""
+    if insecure_skip_verify:
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+        return ctx
+    if ca_cert_path:
+        ctx = ssl.create_default_context(cafile=ca_cert_path)
+        return ctx
+    return True

@@ -77,6 +77,34 @@ def test_overrides_and_dp_ranks():
     assert not any("kube-lease-name" in a for a in args)
 
 
+def test_gateway_tls_rendering():
+    # Default: secure serving with self-signed fallback — no cert volume.
+    docs = _by_kind_name(_docs())
+    epp = docs[("Deployment", "tpu-pool-epp")]
+    spec = epp["spec"]["template"]["spec"]
+    args = spec["containers"][0]["args"]
+    assert "--secure-serving" in args
+    assert not any("cert-path" in a for a in args)
+    assert spec["containers"][0]["readinessProbe"]["httpGet"]["scheme"] == "HTTPS"
+    assert not any(v.get("secret") for v in spec.get("volumes", []))
+
+    # certSecret mounts the kubernetes.io/tls pair with reload.
+    docs = _by_kind_name(_docs({"gateway": {"certSecret": "epp-tls"}}))
+    spec = docs[("Deployment", "tpu-pool-epp")]["spec"]["template"]["spec"]
+    args = spec["containers"][0]["args"]
+    assert "--cert-path=/certs" in args and "--enable-cert-reload" in args
+    assert {"name": "epp-certs", "mountPath": "/certs", "readOnly": True} \
+        in spec["containers"][0]["volumeMounts"]
+    assert any(v.get("secret", {}).get("secretName") == "epp-tls"
+               for v in spec["volumes"])
+
+    # TLS off renders a plain listener.
+    docs = _by_kind_name(_docs({"gateway": {"secureServing": False}}))
+    spec = docs[("Deployment", "tpu-pool-epp")]["spec"]["template"]["spec"]
+    assert "--secure-serving" not in spec["containers"][0]["args"]
+    assert "scheme" not in spec["containers"][0]["readinessProbe"]["httpGet"]
+
+
 def test_cli_set_overrides(tmp_path, capsys):
     from render_chart import main
 
