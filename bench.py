@@ -62,9 +62,17 @@ def _engine_bytes_per_step(mcfg, batch: int, avg_ctx: float) -> float:
     # so the child does not have to fetch device buffers.
     d, L = mcfg.d_model, mcfg.n_layers
     kv_dim = mcfg.n_kv_heads * mcfg.head_dim
-    per_layer = d * d + 2 * d * kv_dim + d * d + 3 * d * mcfg.d_ff
+    # q/o projections are d × (n_heads*head_dim) — NOT d×d when head_dim is
+    # overridden (Qwen3-style configs decouple them; ADVICE r4).
+    q_dim = mcfg.n_heads * mcfg.head_dim
+    per_layer = 2 * d * q_dim + 2 * d * kv_dim + 3 * d * mcfg.d_ff
     if mcfg.n_experts:
-        per_layer = 2 * d * d + 2 * d * kv_dim + mcfg.n_experts * 3 * d * mcfg.d_ff
+        # Only the experts activated this step are read from HBM: k per
+        # token, deduped across the batch (upper-bounded by the expert
+        # count), plus the router matrix.
+        active = min(mcfg.n_experts, batch * mcfg.experts_per_token)
+        per_layer = (2 * d * q_dim + 2 * d * kv_dim
+                     + d * mcfg.n_experts + active * 3 * d * mcfg.d_ff)
     params = 2 * mcfg.vocab_size * d + L * per_layer
     kv_read = batch * avg_ctx * L * 2 * kv_dim
     return 2.0 * (params + kv_read)

@@ -318,6 +318,7 @@ class TpuEngine:
         # connector_nixlv2.go:109-253 control shape preserved).
         self._jit_stage = None
         self._embed_fns: dict[int, Any] = {}
+        self._embed_fns_lock = threading.Lock()
         self._release_reqs: list[tuple[str, str]] = []
         self._prefill_fns: dict[int, Any] = {}
         if self.pp_mesh is not None:
@@ -608,17 +609,22 @@ class TpuEngine:
                              "engines (pp/multi-host: route to a dense "
                              "replica)")
         bucket = self._bucket(max(len(ids), 1))
-        fn = self._embed_fns.get(bucket)
-        if fn is None:
-            def impl(params, tokens, seq_len):
-                hidden, _ = llama.forward(params, self.mcfg, tokens,
-                                          want_hidden=True)
-                mask = (jnp.arange(tokens.shape[1]) < seq_len[0])[None, :, None]
-                pooled = (hidden * mask).sum(axis=1) / seq_len[0]
-                return pooled[0]
+        # Lock the per-bucket fn creation: two concurrent first calls would
+        # otherwise each build+compile their own jit (benign race, duplicated
+        # compile work — ADVICE r4). Sharing one fn lets jax's own dispatch
+        # cache dedup the compilation.
+        with self._embed_fns_lock:
+            fn = self._embed_fns.get(bucket)
+            if fn is None:
+                def impl(params, tokens, seq_len):
+                    hidden, _ = llama.forward(params, self.mcfg, tokens,
+                                              want_hidden=True)
+                    mask = (jnp.arange(tokens.shape[1]) < seq_len[0])[None, :, None]
+                    pooled = (hidden * mask).sum(axis=1) / seq_len[0]
+                    return pooled[0]
 
-            fn = jax.jit(impl)
-            self._embed_fns[bucket] = fn
+                fn = jax.jit(impl)
+                self._embed_fns[bucket] = fn
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, : len(ids)] = ids
         vec = fn(self.params, self._put(tokens),

@@ -29,6 +29,16 @@ TPU meshes keep the device transfer path.
 Reference analogue: the NIXL side-channel handshake relays opaque transfer
 descriptors the engines resolve rank-by-rank (connector_nixlv2.go:191-253);
 here the descriptor is (address, uuid) per process.
+
+Trust model: the wire serves staged KV bytes to any peer presenting a valid
+63-bit uuid — the SAME model as the device transfer server and the engine's
+HTTP /kv route: all three assume a trusted mesh network (the NIXL side
+channel is equally unauthenticated). Mitigations built in: uuids are
+unguessable 63-bit randoms with one-shot registration windows (TTL-swept),
+the server binds to the engine's configured host (loopback in cpu-backend
+tests, the pod IP in a cluster — never a wildcard unless configured so),
+and concurrent transfer connections are capped (`MAX_CONNS`) so a
+misbehaving peer cannot spawn unbounded handler threads.
 """
 
 from __future__ import annotations
@@ -49,6 +59,12 @@ _U32 = struct.Struct(">I")
 _U64 = struct.Struct(">Q")
 _UNKNOWN = 0xFFFFFFFF
 
+# Concurrent-transfer cap: P/D fan-in is bounded by the decode group size
+# (each importer process opens one connection per pull), so a small cap
+# never throttles legitimate traffic but bounds the thread count under a
+# connection flood.
+MAX_CONNS = 32
+
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
     buf = b""
@@ -67,6 +83,7 @@ class ShardWireServer:
         self._host = host
         self._registry: dict[int, list[Any]] = {}
         self._lock = threading.Lock()
+        self._conn_sem = threading.Semaphore(MAX_CONNS)
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind((host, 0))
@@ -103,6 +120,13 @@ class ShardWireServer:
                 conn, _ = self._srv.accept()
             except OSError:
                 return  # closed
+            if not self._conn_sem.acquire(timeout=30.0):
+                # Flooded: shed instead of spawning unbounded threads; the
+                # puller retries on its own timeout.
+                log.warning("shard wire at connection cap (%d); shedding",
+                            MAX_CONNS)
+                conn.close()
+                continue
             threading.Thread(target=self._handle, args=(conn,),
                              name="shard-wire-conn", daemon=True).start()
 
@@ -130,6 +154,8 @@ class ShardWireServer:
         except Exception:
             if not self._closed:
                 log.debug("shard wire connection failed", exc_info=True)
+        finally:
+            self._conn_sem.release()
 
 
 def pull_shards(address: str, tuid: int,

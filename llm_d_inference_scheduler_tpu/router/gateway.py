@@ -442,6 +442,10 @@ class Gateway:
                     stream_state["started"] = True
                 await ws.prepare(request)
                 sse_carry = b""
+                sse_tail = b""
+                stream_hook = (self.director.handle_response_streaming
+                               if ireq is not None
+                               and self.cfg.response_streaming else None)
                 async for chunk in resp.aiter_bytes():
                     # TTFT counts the first *token-bearing* event: a role-only
                     # chat delta (no content) would otherwise flatter the
@@ -453,10 +457,16 @@ class Gateway:
                         if found:
                             first_byte_at = time.monotonic()
                             TTFT_SECONDS.labels(model_label).observe(first_byte_at - t_start)
-                    if ireq is not None:
-                        self.director.handle_response_streaming(None, ireq, endpoint, chunk)
-                    usage = _usage_from_sse(chunk) or usage
+                    if stream_hook is not None:
+                        stream_hook(None, ireq, endpoint, chunk)
+                    # Usage rides the FINAL SSE event: keep a bounded tail and
+                    # scan once at stream end. Per-chunk scanning both cost the
+                    # hot path and missed events split across transport chunks
+                    # (ADVICE r4).
+                    sse_tail = (sse_tail + chunk)[-_USAGE_TAIL:] \
+                        if sse_tail else chunk[-_USAGE_TAIL:]
                     await ws.write(chunk)
+                usage = _usage_from_sse(sse_tail) or {}
                 await ws.write_eof()
                 return ws
             else:
@@ -604,21 +614,31 @@ def _sse_scan_for_token(carry: bytes, chunk: bytes) -> tuple[bool, bytes]:
     return False, carry
 
 
-def _usage_from_sse(chunk: bytes) -> dict[str, int] | None:
-    if b'"usage"' not in chunk:
-        # Hot-path fast exit: only the final SSE chunk carries usage;
-        # json-parsing every token chunk is measurable at high fan-out.
+# Rolling-tail size for end-of-stream usage extraction: the terminal usage
+# event plus the [DONE] line are a few hundred bytes; 4 KiB leaves wide
+# margin without per-chunk memory growth.
+_USAGE_TAIL = 4096
+
+
+def _usage_from_sse(tail: bytes) -> dict[str, int] | None:
+    """Extract the usage record from the final bytes of an SSE stream. The
+    caller hands the end-of-stream tail, so events split across transport
+    chunks arrive reassembled here (a truncated leading line simply fails
+    the JSON parse and is skipped)."""
+    if b'"usage"' not in tail:
         return None
-    for line in chunk.split(b"\n"):
+    usage = None
+    for line in tail.split(b"\n"):
+        line = line.rstrip(b"\r")
         if line.startswith(b"data: ") and line != b"data: [DONE]":
             try:
                 doc = json.loads(line[6:])
                 u = doc.get("usage")
                 if isinstance(u, dict):
-                    return u
+                    usage = u  # last one wins (the terminal event's record)
             except Exception:
                 continue
-    return None
+    return usage
 
 
 def build_gateway(config_text: str | None, *, host: str = "127.0.0.1",
