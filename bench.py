@@ -239,6 +239,13 @@ pool:
             return {"error": "gateway never became ready"}
         results: list[dict] = []
 
+        # aiohttp measurement client: the through-router phase pays for the
+        # client, engine server, AND proxy on one GIL (direct-phase tokens
+        # never touch HTTP), so client parser cost suppresses the router
+        # number. httpx/h11 costs ~260 µs/token of CPU here; aiohttp's C
+        # parser ~60 µs (scripts/profile_router_sse.py).
+        import aiohttp
+
         async def one(client):
             # unique head so prefills don't collapse onto one cached prefix
             head = f"r{rng.randint(0, 1 << 30):010d} "
@@ -246,20 +253,22 @@ pool:
             t0 = time.monotonic()
             ttft = None
             tokens = 0
-            async with client.stream(
-                    "POST", f"http://127.0.0.1:{gport}/v1/completions",
+            async with client.post(
+                    f"http://127.0.0.1:{gport}/v1/completions",
                     json={"model": engine_cfg.model, "prompt": prompt,
                           "stream": True, "max_tokens": gen_tokens,
                           "ignore_eos": True}) as r:
-                async for line in r.aiter_lines():
-                    if line.startswith("data: ") and line != "data: [DONE]":
+                async for line in r.content:
+                    if line.startswith(b"data: ") and not line.startswith(
+                            b"data: [DONE]"):
                         if ttft is None:
                             ttft = time.monotonic() - t0
                         tokens += 1
             results.append({"ttft": ttft, "tokens": tokens,
                             "latency": time.monotonic() - t0})
 
-        async with httpx.AsyncClient(timeout=300) as client:
+        async with aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=300)) as client:
             await one(client)  # warm the HTTP path + compile
             results.clear()
             t0 = time.monotonic()
