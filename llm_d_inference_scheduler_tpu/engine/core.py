@@ -228,19 +228,35 @@ class TpuEngine:
         self.mesh = None
         self.pp_mesh = None
         if cfg.pp_size > 1:
-            if cfg.ep_size > 1 or self._dist:
+            if cfg.ep_size > 1:
                 # pp serves MoE models with REPLICATED experts today
                 # (tested: pp×tiny-moe token-parity); sharding the experts
-                # axis (ep>1) or spanning hosts under pp is future work.
-                raise ValueError("pp_size composes with ep>1/multi-host in "
-                                 "a later version; use pp (optionally ×tp; "
-                                 "MoE runs with replicated experts)")
+                # axis (ep>1) under pp is future work.
+                raise ValueError("pp_size composes with ep>1 in a later "
+                                 "version; pp serves MoE with replicated "
+                                 "experts")
             from ..parallel.pp_serve import make_pp_mesh, validate_pp
 
             validate_pp(self.mcfg, cfg.pp_size, cfg.tp_size)
             n_model = cfg.pp_size * cfg.tp_size
-            self.pp_mesh = make_pp_mesh(jax.devices()[:n_model],
-                                        cfg.pp_size, tp=cfg.tp_size)
+            if self._dist:
+                # Stage ring spanning hosts (BASELINE config-4 shape: a 70B
+                # pipeline across a multi-host slice). The global device
+                # list orders process-major, so the (pp, tp) reshape puts
+                # consecutive stages on consecutive hosts: tp collectives
+                # ride intra-host ICI, the ppermute stage hop crosses hosts
+                # once per turn. Every process's devices must be in the
+                # mesh — an SPMD process with no addressable device in the
+                # computation cannot participate.
+                if n_model != len(jax.devices()):
+                    raise ValueError(
+                        f"multi-host pp needs pp*tp == global devices "
+                        f"({n_model} != {len(jax.devices())})")
+                self.pp_mesh = make_pp_mesh(jax.devices(), cfg.pp_size,
+                                            tp=cfg.tp_size)
+            else:
+                self.pp_mesh = make_pp_mesh(jax.devices()[:n_model],
+                                            cfg.pp_size, tp=cfg.tp_size)
         elif cfg.tp_size > 1 or cfg.ep_size > 1 or self._dist:
             from ..parallel.serve import make_serve_mesh, validate_tp
 
@@ -1417,7 +1433,8 @@ class TpuEngine:
             from jax.sharding import NamedSharding, PartitionSpec
 
             return jax.make_array_from_process_local_data(
-                NamedSharding(self.mesh, PartitionSpec()), np.asarray(x))
+                NamedSharding(self.mesh or self.pp_mesh, PartitionSpec()),
+                np.asarray(x))
         return jnp.asarray(x)
 
     def _put_key(self, key):

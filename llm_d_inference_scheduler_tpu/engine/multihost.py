@@ -286,8 +286,9 @@ def run_follower(engine) -> None:
     restarts the whole SPMD group (in-place rejoin is impossible: the
     group's collectives require every member)."""
     chan = engine._instr_channel
+    mesh = engine.mesh or engine.pp_mesh
     log.info("follower %d ready (mesh %s)", engine.cfg.dist_process_id,
-             engine.mesh.shape if engine.mesh else None)
+             mesh.shape if mesh is not None else None)
     while True:
         try:
             op, args = chan.recv()

@@ -591,7 +591,14 @@ def pp_param_shardings(cfg: ModelConfig, mesh: Mesh):
 def shard_params_pp(params, cfg: ModelConfig, mesh: Mesh):
     """Lay unsharded params onto the (pp, tp) serving mesh."""
     validate_pp(cfg, mesh.shape["pp"], mesh.shape.get("tp", 1))
-    return jax.device_put(params, pp_param_shardings(cfg, mesh))
+    shardings = pp_param_shardings(cfg, mesh)
+    if any(d.process_index != jax.process_index() for d in mesh.devices.flat):
+        # Multi-host mesh: device_put cannot target non-addressable devices;
+        # route through a jitted identity (host inputs are treated as
+        # replicated — every process feeds identical bytes — and
+        # out_shardings lay down the per-process shards).
+        return jax.jit(lambda p: p, out_shardings=shardings)(params)
+    return jax.device_put(params, shardings)
 
 
 def init_pp_params(cfg: ModelConfig, mesh: Mesh, key, dtype=None):

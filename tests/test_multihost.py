@@ -111,6 +111,86 @@ def test_multihost_serving_matches_single_process():
     assert results["leader"] == expected
 
 
+# ---- pipeline parallelism spanning hosts (VERDICT r4 next #4) ------------
+
+COORD_PP = "127.0.0.1:19815"
+INSTR_PP = 19816
+
+
+def _dist_pp_worker(pid: int, q) -> None:
+    """2 processes × 2 devices → a global (pp=2, tp=2) mesh: each host owns
+    one full pipeline stage (tp inside the host), the stage-hop ppermute
+    crosses processes — the BASELINE config-4 shape (70B pipeline over a
+    multi-host slice) at test scale."""
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    try:
+        from llm_d_inference_scheduler_tpu.engine.core import TpuEngine
+        from llm_d_inference_scheduler_tpu.engine.multihost import (
+            maybe_init_distributed,
+            run_follower,
+        )
+
+        cfg = _engine_cfg(pp_size=2, tp_size=2,
+                          dist_coordinator=COORD_PP, dist_num_processes=2,
+                          dist_process_id=pid, dist_instr_port=INSTR_PP,
+                          dist_recv_timeout_s=600.0)
+        maybe_init_distributed(cfg)
+        assert len(jax.devices()) == 4
+        eng = TpuEngine(cfg)
+        assert eng.pp_mesh is not None and eng.mesh is None
+        # Stage placement: the pp axis must split across processes (the
+        # ring hop is the cross-host edge).
+        stage_procs = [sorted({d.process_index for d in row})
+                       for row in eng.pp_mesh.devices]
+        assert stage_procs == [[0], [1]]
+        if pid == 0:
+            tokens = asyncio.run(_serve_one(eng))
+            q.put(("leader", tokens))
+        else:
+            run_follower(eng)
+            q.put(("follower", "released"))
+    except Exception as e:
+        import traceback
+
+        q.put(("error", f"pid{pid}: {e}\n{traceback.format_exc()[-2000:]}"))
+
+
+def test_multihost_pp_matches_single_process():
+    """Greedy tokens through a host-spanning stage ring must equal the
+    single-process pp=2×tp=2 engine's (same SPMD program, stages split
+    across controllers)."""
+    from llm_d_inference_scheduler_tpu.engine.core import TpuEngine
+
+    expected = asyncio.run(_serve_one(TpuEngine(
+        _engine_cfg(pp_size=2, tp_size=2))))
+    assert len(expected) == N_GEN
+
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_dist_pp_worker, args=(pid, q), daemon=True)
+             for pid in range(2)]
+    for p in procs:
+        p.start()
+    results = {}
+    try:
+        for _ in range(2):
+            kind, payload = q.get(timeout=600)
+            assert kind != "error", payload
+            results[kind] = payload
+    finally:
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.terminate()
+
+    assert results["follower"] == "released"
+    assert results["leader"] == expected
+
+
 # ---- failure semantics (NEXT: multi-host hardening) ----------------------
 
 
