@@ -228,17 +228,10 @@ class TpuEngine:
         self.mesh = None
         self.pp_mesh = None
         if cfg.pp_size > 1:
-            if cfg.ep_size > 1:
-                # pp serves MoE models with REPLICATED experts today
-                # (tested: pp×tiny-moe token-parity); sharding the experts
-                # axis (ep>1) under pp is future work.
-                raise ValueError("pp_size composes with ep>1 in a later "
-                                 "version; pp serves MoE with replicated "
-                                 "experts")
             from ..parallel.pp_serve import make_pp_mesh, validate_pp
 
-            validate_pp(self.mcfg, cfg.pp_size, cfg.tp_size)
-            n_model = cfg.pp_size * cfg.tp_size
+            validate_pp(self.mcfg, cfg.pp_size, cfg.tp_size, cfg.ep_size)
+            n_model = cfg.pp_size * cfg.tp_size * cfg.ep_size
             if self._dist:
                 # Stage ring spanning hosts (BASELINE config-4 shape: a 70B
                 # pipeline across a multi-host slice). The global device
@@ -250,13 +243,14 @@ class TpuEngine:
                 # computation cannot participate.
                 if n_model != len(jax.devices()):
                     raise ValueError(
-                        f"multi-host pp needs pp*tp == global devices "
+                        f"multi-host pp needs pp*tp*ep == global devices "
                         f"({n_model} != {len(jax.devices())})")
                 self.pp_mesh = make_pp_mesh(jax.devices(), cfg.pp_size,
-                                            tp=cfg.tp_size)
+                                            tp=cfg.tp_size, ep=cfg.ep_size)
             else:
                 self.pp_mesh = make_pp_mesh(jax.devices()[:n_model],
-                                            cfg.pp_size, tp=cfg.tp_size)
+                                            cfg.pp_size, tp=cfg.tp_size,
+                                            ep=cfg.ep_size)
         elif cfg.tp_size > 1 or cfg.ep_size > 1 or self._dist:
             from ..parallel.serve import make_serve_mesh, validate_tp
 

@@ -19,7 +19,15 @@ training pipeline in parallel/pipeline.py):
   throughput comes from the decode batch riding each turn. Prefill uses the
   same ring at [1, S] shapes with per-slab KV scatters.
 
-**TP composition** (``tp > 1``): the mesh is 2-D ``(pp, tp)``. Within each
+**EP composition** (``ep > 1``): the mesh carries a third ``ep`` axis and
+MoE expert weights shard over it (w1/w3/w2's experts dim). Each stage slab
+ranks ALL experts with the replicated router, computes its local E/ep
+experts' outputs, and the gated combine psums over ``("tp", "ep")`` —
+the deep-MoE deployment shape (layers over pp, experts over ep, FFN hidden
+over tp). With ``ep == 1`` the experts are whole on every device and the
+same code path degenerates to dense-over-experts.
+
+**TP composition** (``tp > 1``): the mesh is ``(pp, tp, ep)``. Within each
 stage's slab the layer math is Megatron-TP — column-parallel wq/wk/wv/w1/w3,
 row-parallel wo/w2 (shardings.param_pspecs), one ``psum`` over ``tp`` after
 the attention output projection and one after the FFN, riding ICI inside the
@@ -54,17 +62,22 @@ __all__ = ["make_pp_mesh", "shard_params_pp", "pp_page_sharding",
            "make_pp_decode_chunk", "make_pp_prefill",
            "make_pp_prefill_with_prefix"]
 
-PP_SERVE_AXES = ("pp", "tp")
+PP_SERVE_AXES = ("pp", "tp", "ep")
 
 
-def make_pp_mesh(devices=None, pp: int | None = None, tp: int = 1) -> Mesh:
-    """(pp, tp) serving mesh. tp=1 keeps the pure stage ring (the tp axis is
-    size 1 and every tp collective is an XLA-elided identity)."""
+def make_pp_mesh(devices=None, pp: int | None = None, tp: int = 1,
+                 ep: int = 1) -> Mesh:
+    """(pp, tp, ep) serving mesh. tp=1/ep=1 keep the pure stage ring (the
+    extra axes are size 1 and their collectives are XLA-elided identities).
+    ``ep > 1`` shards MoE experts within each stage's slab — the deep-MoE
+    deployment shape (stage ring over pp, experts split over ep, FFN hidden
+    over tp)."""
     devices = list(devices if devices is not None else jax.devices())
-    pp = pp or (len(devices) // tp)
-    if pp * tp > len(devices):
-        raise ValueError(f"pp*tp={pp}*{tp} exceeds {len(devices)} devices")
-    arr = np.array(devices[: pp * tp]).reshape(pp, tp)
+    pp = pp or (len(devices) // (tp * ep))
+    if pp * tp * ep > len(devices):
+        raise ValueError(f"pp*tp*ep={pp}*{tp}*{ep} exceeds "
+                         f"{len(devices)} devices")
+    arr = np.array(devices[: pp * tp * ep]).reshape(pp, tp, ep)
     return Mesh(arr, PP_SERVE_AXES)
 
 
@@ -80,20 +93,50 @@ def pp_page_sharding(mesh: Mesh) -> NamedSharding:
 def _param_specs(cfg: ModelConfig):
     """Stage split on the stacked-L axis composed with Megatron TP specs.
 
-    The per-layer TP dims come from shardings.param_pspecs with the leading
-    (unsharded) L entry replaced by "pp"; the ep axis (absent from this mesh)
-    maps to None — experts replicate, their FFN hidden dim still shards on tp.
-    Embedding shards the model dim, lm_head the vocab dim (re-assembled with
-    _tp_full in the bodies).
+    The per-layer TP/EP dims come from shardings.param_pspecs with the
+    leading (unsharded) L entry replaced by "pp": MoE expert axes keep
+    their ``ep`` placement (each stage slab computes its local experts and
+    the combine psums over ``("tp", "ep")``), the FFN hidden dim shards on
+    tp. Embedding shards the model dim, lm_head the vocab dim (re-assembled
+    with _tp_full in the bodies).
     """
     tp_layers = param_pspecs(cfg)["layers"]
 
     def stage(spec: P) -> P:
-        return P("pp", *[a if a != "ep" else None for a in spec[1:]])
+        return P("pp", *spec[1:])
 
     return {"embed": P(None, "tp"),
             "layers": {k: stage(v) for k, v in tp_layers.items()},
             "final_norm": P(), "lm_head": P(None, "tp")}
+
+
+def _ffn_psum(cfg: ModelConfig, lp, h):
+    """FFN partial + its reduction, shard_map-local. Dense: llama._ffn then
+    psum over tp. MoE: the expert axes live on ``ep`` (possibly size 1 —
+    the specs place them there unconditionally, so the params are typed
+    ep-varying and the reduction MUST cover ep to keep the carry invariant).
+    The router is replicated so every device ranks ALL experts; the expert
+    einsums see only the local E/ep slice — slice the matching gate block
+    by ep rank, combine locally, and psum over ("tp", "ep")."""
+    if "router" not in lp:
+        return jax.lax.psum(llama._ffn(cfg, lp, h), "tp")
+    squeeze = h.ndim == 2  # decode step: [B, D]
+    if squeeze:
+        h = h[:, None]
+    logits = (h @ lp["router"]).astype(jnp.float32)          # [B, S, E] full
+    top_vals, top_idx = jax.lax.top_k(logits, cfg.experts_per_token)
+    gates = jax.nn.softmax(top_vals, axis=-1)
+    onehot = jax.nn.one_hot(top_idx, cfg.n_experts, dtype=h.dtype)
+    weights = jnp.einsum("bske,bsk->bse", onehot, gates.astype(h.dtype))
+    e_loc = lp["w1"].shape[0]                                # E/ep (static)
+    lo = jax.lax.axis_index("ep") * e_loc
+    w_loc = jax.lax.dynamic_slice_in_dim(weights, lo, e_loc, axis=2)
+    up = jnp.einsum("bsd,edf->bsef", h, lp["w1"])
+    gate = jnp.einsum("bsd,edf->bsef", h, lp["w3"])
+    out = jnp.einsum("bsef,efd->bsed", jax.nn.silu(up) * gate, lp["w2"])
+    y = jnp.einsum("bsed,bse->bsd", out, w_loc)
+    y = jax.lax.psum(y, ("tp", "ep"))
+    return y[:, 0] if squeeze else y
 
 
 def _tp_full(x, n_tp: int, axis: int):
@@ -119,10 +162,11 @@ def _tp_full(x, n_tp: int, axis: int):
 def _decode_slab(cfg: ModelConfig, params, x, k_pages, v_pages, tables,
                  positions, eff_blk):
     """One stage's layer slab for one decode token (shard_map-local view:
-    L/P layers, Hkv/tp kv-heads) with Megatron-TP collectives: psum over tp
-    after the attention output projection and after the FFN. KV for the new
-    token scatters into ``eff_blk`` (the caller trash-redirects off-turn
-    writes). Shared by the broadcast ring and the lane-group interleave."""
+    L/P layers, Hkv/tp kv-heads, E/ep experts) with Megatron-TP collectives:
+    psum over tp after the attention output projection, over (tp, ep) after
+    the FFN. KV for the new token scatters into ``eff_blk`` (the caller
+    trash-redirects off-turn writes). Shared by the broadcast ring and the
+    lane-group interleave."""
     B = x.shape[0]
     Dh = cfg.head_dim
     cos, sin = rope_table(positions, Dh, cfg.rope_theta)
@@ -142,7 +186,7 @@ def _decode_slab(cfg: ModelConfig, params, x, k_pages, v_pages, tables,
                                       cur_k=k, cur_v=v)
         x = x + jax.lax.psum(attn.reshape(B, -1) @ lp["wo"], "tp")
         h = rms_norm(x, lp["ln_mlp"], cfg.norm_eps)
-        x = x + jax.lax.psum(llama._ffn(cfg, lp, h), "tp")
+        x = x + _ffn_psum(cfg, lp, h)
         return x, (k, v)
 
     x, (k_cur, v_cur) = jax.lax.scan(body, x,
@@ -152,8 +196,8 @@ def _decode_slab(cfg: ModelConfig, params, x, k_pages, v_pages, tables,
     return x, k_pages, v_pages
 
 
-def _ring_decode_step(cfg: ModelConfig, n_stages: int, n_tp: int, perm, stage,
-                      params, tokens, positions, k_pages, v_pages,
+def _ring_decode_step(cfg: ModelConfig, n_stages: int, n_tp: int, perm,
+                      stage, params, tokens, positions, k_pages, v_pages,
                       block_tables):
     """One token for all lanes through the stage ring. Local (per-shard)
     views: params.layers / pages carry L/P layers and Hkv/tp kv-heads.
@@ -341,9 +385,9 @@ def _interleaved_chunk_body(cfg, n_stages, n_tp, perm, decode_chunk,
 
 def _tp_block(cfg: ModelConfig, lp, x, cos, sin, positions):
     """llama._layer with the TP collectives explicit (shard_map body form):
-    local head slices, psum over tp after wo and after the FFN. Returns
-    (x, k, v) with k/v carrying the LOCAL kv-head slice (pages are tp-sharded
-    on that axis)."""
+    local head slices, psum over tp after wo and over (tp, ep) after the
+    FFN. Returns (x, k, v) with k/v carrying the LOCAL kv-head slice (pages
+    are tp-sharded on that axis)."""
     B, S, _ = x.shape
     Dh = cfg.head_dim
     h = rms_norm(x, lp["ln_attn"], cfg.norm_eps)
@@ -357,7 +401,7 @@ def _tp_block(cfg: ModelConfig, lp, x, cos, sin, positions):
                                   kv_positions=positions)
     x = x + jax.lax.psum(attn.reshape(B, S, -1) @ lp["wo"], "tp")
     h = rms_norm(x, lp["ln_mlp"], cfg.norm_eps)
-    x = x + jax.lax.psum(llama._ffn(cfg, lp, h), "tp")
+    x = x + _ffn_psum(cfg, lp, h)
     return x, k, v
 
 
@@ -521,7 +565,7 @@ def make_pp_prefill_with_prefix(cfg: ModelConfig, mesh: Mesh,
                     kv_valid=kv_valid)
                 x = x + jax.lax.psum(attn.reshape(1, S, -1) @ lp["wo"], "tp")
                 h = rms_norm(x, lp["ln_mlp"], cfg.norm_eps)
-                x = x + jax.lax.psum(llama._ffn(cfg, lp, h), "tp")
+                x = x + _ffn_psum(cfg, lp, h)
                 return x, (k, v)
 
             x, (k_new, v_new) = jax.lax.scan(
@@ -573,7 +617,7 @@ def alloc_pp_pages(cfg: ModelConfig, mesh: Mesh, n_blocks: int):
     return zeros(), zeros()
 
 
-def validate_pp(cfg: ModelConfig, pp: int, tp: int = 1) -> None:
+def validate_pp(cfg: ModelConfig, pp: int, tp: int = 1, ep: int = 1) -> None:
     if cfg.n_layers % pp:
         raise ValueError(f"pp_size={pp} does not divide "
                          f"n_layers={cfg.n_layers}")
@@ -581,6 +625,8 @@ def validate_pp(cfg: ModelConfig, pp: int, tp: int = 1) -> None:
         validate_tp(cfg, tp)
         if cfg.d_model % tp:  # embed shards the model dim under pp×tp
             raise ValueError(f"tp={tp} does not divide d_model={cfg.d_model}")
+    if ep > 1:
+        validate_tp(cfg, 1, ep)  # ep divisibility checks
 
 
 def pp_param_shardings(cfg: ModelConfig, mesh: Mesh):
@@ -589,8 +635,9 @@ def pp_param_shardings(cfg: ModelConfig, mesh: Mesh):
 
 
 def shard_params_pp(params, cfg: ModelConfig, mesh: Mesh):
-    """Lay unsharded params onto the (pp, tp) serving mesh."""
-    validate_pp(cfg, mesh.shape["pp"], mesh.shape.get("tp", 1))
+    """Lay unsharded params onto the (pp, tp, ep) serving mesh."""
+    validate_pp(cfg, mesh.shape["pp"], mesh.shape.get("tp", 1),
+                mesh.shape.get("ep", 1))
     shardings = pp_param_shardings(cfg, mesh)
     if any(d.process_index != jax.process_index() for d in mesh.devices.flat):
         # Multi-host mesh: device_put cannot target non-addressable devices;
@@ -602,6 +649,7 @@ def shard_params_pp(params, cfg: ModelConfig, mesh: Mesh):
 
 
 def init_pp_params(cfg: ModelConfig, mesh: Mesh, key, dtype=None):
-    validate_pp(cfg, mesh.shape["pp"], mesh.shape.get("tp", 1))
+    validate_pp(cfg, mesh.shape["pp"], mesh.shape.get("tp", 1),
+                mesh.shape.get("ep", 1))
     return jax.jit(lambda k: llama.init_params(cfg, k, dtype=dtype),
                    out_shardings=pp_param_shardings(cfg, mesh))(key)

@@ -144,7 +144,7 @@ def _dist_pp_worker(pid: int, q) -> None:
         assert eng.pp_mesh is not None and eng.mesh is None
         # Stage placement: the pp axis must split across processes (the
         # ring hop is the cross-host edge).
-        stage_procs = [sorted({d.process_index for d in row})
+        stage_procs = [sorted({d.process_index for d in row.flat})
                        for row in eng.pp_mesh.devices]
         assert stage_procs == [[0], [1]]
         if pid == 0:
