@@ -329,6 +329,9 @@ class TpuEngine:
         self._jit_stage = None
         self._embed_fns: dict[int, Any] = {}
         self._embed_fns_lock = threading.Lock()
+        # Multi-host embeddings: queued by embed() (HTTP executor thread),
+        # drained by the engine thread so the op broadcast stays in order.
+        self._embed_reqs: list[tuple] = []
         self._release_reqs: list[tuple[str, str]] = []
         self._prefill_fns: dict[int, Any] = {}
         if self.pp_mesh is not None:
@@ -608,17 +611,37 @@ class TpuEngine:
         /v1/embeddings surface (the reference routes OpenAI embeddings
         bodies to vLLM embedding pods; this is the engine-half equivalent).
 
-        Stateless w.r.t. the batching loop (no KV pages/slots touched), so
-        it dispatches directly from the caller's thread; the device
-        serializes it against in-flight decode work. Pow2 prompt buckets
-        bound the compile cache. Padding tokens sit AFTER the valid prompt,
-        so causal attention never lets a valid query attend them; the mask
-        excludes them from the mean."""
-        if self.pp_mesh is not None or self._dist:
-            raise ValueError("embeddings are served by tp/single-device "
-                             "engines (pp/multi-host: route to a dense "
-                             "replica)")
+        Stateless w.r.t. the batching loop (no KV pages/slots touched).
+        Pow2 prompt buckets bound the compile cache. Padding tokens sit
+        AFTER the valid prompt, so causal attention never lets a valid
+        query attend them; the mask excludes them from the mean.
+        Single-process engines (plain / tp / pp rings) dispatch directly
+        from the caller's thread; multi-host engines must issue every
+        device op in broadcast order, so the request queues to the engine
+        thread and replays on the followers like any other op."""
         bucket = self._bucket(max(len(ids), 1))
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, : len(ids)] = ids
+        seq_len = np.asarray([max(len(ids), 1)], np.int32)
+        if self._dist:
+            import concurrent.futures
+
+            fut: concurrent.futures.Future = concurrent.futures.Future()
+            with self._cond:
+                if self.dist_degraded or self._stop:
+                    raise ValueError("engine unavailable for embeddings "
+                                     "(degraded or stopping)")
+                self._embed_reqs.append((bucket, tokens, seq_len, fut))
+                self._cond.notify()
+            return fut.result(timeout=600.0)
+        return self._op_embed(bucket, tokens=tokens, seq_len=seq_len)
+
+    def _op_embed(self, bucket: int, *, tokens, seq_len) -> np.ndarray:
+        fn = self._embed_fn_for(bucket)
+        vec = fn(self.params, self._put(tokens), self._put(seq_len))
+        return np.asarray(vec)
+
+    def _embed_fn_for(self, bucket: int):
         # Lock the per-bucket fn creation: two concurrent first calls would
         # otherwise each build+compile their own jit (benign race, duplicated
         # compile work — ADVICE r4). Sharing one fn lets jax's own dispatch
@@ -626,20 +649,30 @@ class TpuEngine:
         with self._embed_fns_lock:
             fn = self._embed_fns.get(bucket)
             if fn is None:
-                def impl(params, tokens, seq_len):
-                    hidden, _ = llama.forward(params, self.mcfg, tokens,
-                                              want_hidden=True)
-                    mask = (jnp.arange(tokens.shape[1]) < seq_len[0])[None, :, None]
-                    pooled = (hidden * mask).sum(axis=1) / seq_len[0]
-                    return pooled[0]
+                if self.pp_mesh is not None:
+                    from ..parallel.pp_serve import make_pp_embed
 
-                fn = jax.jit(impl)
+                    fn = make_pp_embed(self.mcfg, self.pp_mesh, bucket)
+                else:
+                    def impl(params, tokens, seq_len):
+                        hidden, _ = llama.forward(params, self.mcfg, tokens,
+                                                  want_hidden=True)
+                        mask = (jnp.arange(tokens.shape[1])
+                                < seq_len[0])[None, :, None]
+                        pooled = (hidden * mask).sum(axis=1) / seq_len[0]
+                        return pooled[0]
+
+                    if self._dist:
+                        from jax.sharding import NamedSharding, PartitionSpec
+
+                        # Replicated output: every process must hold an
+                        # addressable copy of the vector.
+                        fn = jax.jit(impl, out_shardings=NamedSharding(
+                            self.mesh, PartitionSpec()))
+                    else:
+                        fn = jax.jit(impl)
                 self._embed_fns[bucket] = fn
-        tokens = np.zeros((1, bucket), np.int32)
-        tokens[0, : len(ids)] = ids
-        vec = fn(self.params, self._put(tokens),
-                 self._put(np.asarray([max(len(ids), 1)], np.int32)))
-        return np.asarray(vec)
+        return fn
 
     def _warmup(self):
         """Compile the hot jits before serving (smallest prefill bucket,
@@ -699,13 +732,17 @@ class TpuEngine:
         while True:
             with self._cond:
                 while (not self._stop and not self._waiting and not self._import_ready
-                       and not self._abort_ids and not any(self.slots)):
+                       and not self._abort_ids and not self._embed_reqs
+                       and not any(self.slots)):
                     self._cond.wait(timeout=0.1)
                     # Keep the 1s KV snapshot cadence alive while idle: a
                     # subscriber joining an idle-but-warm engine must still
                     # learn its cache contents (PUB/SSE have no replay).
                     self._publish_kv_snapshot()
                 if self._stop:
+                    for *_, fut in self._embed_reqs:
+                        fut.set_exception(ValueError("engine stopping"))
+                    self._embed_reqs = []
                     return
             if self.dist_degraded:
                 # Drain everything (queued work included) without touching
@@ -723,6 +760,7 @@ class TpuEngine:
 
     def _step(self):
         self._drain_release_reqs()
+        self._drain_embed_reqs()
         self._sweep_exports()
         self._publish_kv_snapshot()
         self._process_aborts()
@@ -759,6 +797,10 @@ class TpuEngine:
             drained, self._waiting = self._waiting, []
             self.telemetry.waiting.set(0)
             imports, self._import_ready = self._import_ready, []
+            embeds, self._embed_reqs = self._embed_reqs, []
+        for *_, fut in embeds:
+            if not fut.done():
+                fut.set_exception(ValueError(f"engine aborted: {reason}"))
         for req, out, loop in drained:
             self._emit_to(out, loop, TokenEvent(
                 request_id=req.request_id, token_id=None,
@@ -804,6 +846,23 @@ class TpuEngine:
         for rid, consumed in reqs:
             self._device_call(("release_kv_export",),
                               dict(request_id=rid, consumed=consumed))
+
+    def _drain_embed_reqs(self):
+        """Multi-host embeddings: run queued embed ops on the engine thread
+        (broadcast order is the lockstep contract — a second thread issuing
+        device ops would interleave with decode ops on the followers)."""
+        with self._cond:
+            reqs, self._embed_reqs = self._embed_reqs, []
+        for bucket, tokens, seq_len, fut in reqs:
+            try:
+                fut.set_result(self._device_call(
+                    ("embed", bucket), dict(tokens=tokens, seq_len=seq_len)))
+            except ChannelBroken:
+                fut.set_exception(
+                    ValueError("engine degraded (multi-host peer lost)"))
+                raise
+            except Exception as e:
+                fut.set_exception(e)
 
     def _sweep_exports(self):
         now = time.monotonic()
@@ -1467,6 +1526,8 @@ class TpuEngine:
             return self._op_release_export(**args)
         if kind == "pull_kv_import":
             return self._op_pull_kv_import(**args)
+        if kind == "embed":
+            return self._op_embed(op[1], **args)
         raise ValueError(f"unknown device op {op!r}")
 
     def _shard_addresses(self) -> list[str]:

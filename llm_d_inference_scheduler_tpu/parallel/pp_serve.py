@@ -608,6 +608,55 @@ def make_pp_prefill_with_prefix(cfg: ModelConfig, mesh: Mesh,
     return jax.jit(sharded, donate_argnums=(4, 5))
 
 
+def make_pp_embed(cfg: ModelConfig, mesh: Mesh, bucket: int):
+    """Mean-pooled final-hidden embedding through the stage ring — the
+    /v1/embeddings surface for pp(×tp×ep) engines (engine/core.py embed()).
+    Same ring as prefill but no KV pages: each stage applies its slab,
+    stage 0's wrap-around holds the final hidden, the pooled vector psums
+    out replicated so every process can read it."""
+    n_stages = mesh.shape["pp"]
+    n_tp = mesh.shape.get("tp", 1)
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def embed(params, tokens, seq_len):
+        stage = jax.lax.axis_index("pp")
+        S = tokens.shape[1]
+        assert S == bucket
+        Dh = cfg.head_dim
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :],
+                                     (1, S))
+        cos, sin = rope_table(positions, Dh, cfg.rope_theta)
+        x0 = _tp_full(params["embed"][tokens], n_tp, axis=2)  # [1, S, D]
+
+        def slab(x):
+            def body(x, lp):
+                x, _, _ = _tp_block(cfg, lp, x, cos, sin, positions)
+                return x, None
+
+            x, _ = jax.lax.scan(body, x, params["layers"])
+            return x
+
+        def turn(tn, x):
+            x = jnp.where(stage == 0, jnp.where(tn == 0, x0, x), x)
+            x = slab(x)
+            return jax.lax.ppermute(x, "pp", perm)
+
+        x = jax.lax.pcast(jnp.zeros_like(x0), 'pp', to='varying')
+        x = jax.lax.fori_loop(0, n_stages, turn, x)
+        x = jax.lax.psum(jnp.where(stage == 0, x, jnp.zeros_like(x)), "pp")
+        hidden = rms_norm(x, params["final_norm"],
+                          cfg.norm_eps).astype(jnp.float32)
+        mask = (jnp.arange(S) < seq_len[0])[None, :, None]
+        pooled = (hidden * mask).sum(axis=1) / seq_len[0]
+        return pooled[0]
+
+    sharded = shard_map(
+        embed, mesh=mesh,
+        in_specs=(_param_specs(cfg), P(), P()),
+        out_specs=P())
+    return jax.jit(sharded)
+
+
 def alloc_pp_pages(cfg: ModelConfig, mesh: Mesh, n_blocks: int):
     shape = (cfg.n_layers, n_blocks, cfg.kv_block_size, cfg.n_kv_heads,
              cfg.head_dim)
