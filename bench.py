@@ -109,7 +109,11 @@ def child(model: str, batch: int) -> None:
                                          else pallas_env == "1"),
                        decode_ctx_buckets=os.environ.get(
                            "BENCH_CTX_BUCKETS", "0") == "1",
-                       warmup=True)
+                       # BENCH_WARMUP=0: lazy compiles only (the buckets the
+                       # run actually touches) — the qwen3-4b discipline:
+                       # full warmup blew the 25-min compile budget twice on
+                       # the remote-compile service (NEXT r4 item 4).
+                       warmup=os.environ.get("BENCH_WARMUP", "1") == "1")
 
     async def run():
         eng = TpuEngine(cfg)
@@ -334,6 +338,23 @@ def main() -> None:
                           "error": f"TPU unreachable: {e}"}))
         return
 
+    def probe_tunnel(tag: str) -> bool:
+        """Post-kill hygiene (VERDICT r4 next #1/#7): killing an in-flight
+        remote compile is THE known tunnel-wedge trigger, so any child
+        timeout is followed by a probe — the result goes to stderr so a
+        wedged end-state is visible in the driver log, not silent."""
+        try:
+            p = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax, jax.numpy as jnp; print(jnp.ones(2).sum())"],
+                capture_output=True, text=True, timeout=90)
+            ok = p.returncode == 0
+        except subprocess.TimeoutExpired:
+            ok = False
+        print(f"tunnel probe after {tag}: {'ALIVE' if ok else 'WEDGED'}",
+              file=sys.stderr)
+        return ok
+
     def run_child(model: str, batch: int, timeout_s: float,
                   router: bool = False) -> dict | None:
         env = dict(os.environ)
@@ -347,6 +368,7 @@ def main() -> None:
         except subprocess.TimeoutExpired:
             print(f"bench child {model}:{batch} exceeded {timeout_s:.0f}s",
                   file=sys.stderr)
+            probe_tunnel(f"killed child {model}:{batch}")
             return None
         if proc.returncode == 0 and proc.stdout.strip():
             try:

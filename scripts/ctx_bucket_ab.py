@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""A/B decode_ctx_buckets on chip (VERDICT r4 next #2).
+
+llama3-1b (head_dim 64 → no lane-aligned Pallas kernel) REGRESSES with
+batch on the XLA gather path: bs=32 < bs=16 in BENCH_full r4 (886 < 1005
+tok/s) because the gather reads O(max-table-width) HBM per lane per step.
+`decode_ctx_buckets` retraces the decode chunk per pow2 context bucket so
+short-context lanes read short tables. This script runs the SAME bench
+child twice (BENCH_CTX_BUCKETS 0/1) and records both to
+benchmarks/CTX_BUCKET_AB.json.
+
+If ON wins at 1b:32, flip the default for head_dim-64 models in
+DEFAULT_SWEEP (see bench.py) — done manually so the change is reviewed
+against real numbers.
+
+Usage:  python scripts/ctx_bucket_ab.py [--model llama3-1b] [--batch 32]
+        [--timeout 900]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def run_one(model: str, batch: int, ctx_buckets: bool, timeout: float):
+    env = dict(os.environ)
+    env["BENCH_CTX_BUCKETS"] = "1" if ctx_buckets else "0"
+    try:
+        p = subprocess.run(
+            [sys.executable, str(REPO / "bench.py"), "--child", model,
+             str(batch)],
+            capture_output=True, text=True, timeout=timeout, env=env)
+    except subprocess.TimeoutExpired:
+        return {"error": f"timeout after {timeout}s"}
+    if p.returncode != 0 or not p.stdout.strip():
+        return {"error": f"rc={p.returncode}: {p.stderr[-1500:]}"}
+    return json.loads(p.stdout.strip().splitlines()[-1])
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="llama3-1b")
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--timeout", type=float, default=900)
+    args = ap.parse_args()
+
+    off = run_one(args.model, args.batch, False, args.timeout)
+    print(f"ctx_buckets OFF: {off}", file=sys.stderr)
+    on = run_one(args.model, args.batch, True, args.timeout)
+    print(f"ctx_buckets ON : {on}", file=sys.stderr)
+
+    out = {"model": args.model, "batch": args.batch, "off": off, "on": on}
+    if "tokens_per_sec" in off and "tokens_per_sec" in on:
+        out["speedup"] = round(on["tokens_per_sec"] / off["tokens_per_sec"], 3)
+        out["winner"] = "on" if on["tokens_per_sec"] > off["tokens_per_sec"] \
+            else "off"
+    (REPO / "benchmarks").mkdir(exist_ok=True)
+    path = REPO / "benchmarks" / "CTX_BUCKET_AB.json"
+    path.write_text(json.dumps(out, indent=1) + "\n")
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
