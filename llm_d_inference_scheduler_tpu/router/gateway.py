@@ -151,7 +151,8 @@ class Gateway:
             from .health_grpc import HealthServer
 
             self.grpc_health = HealthServer(
-                ready_fn=self._ready, host=host, port=grpc_health_port)
+                ready_fn=self._ready, host=host, port=grpc_health_port,
+                tls=self.tls)
         # HA leader election + config reconciliation (controlplane.py —
         # reference runner.go:306-316 lease election with readiness coupling,
         # pkg/epp/controller reconcilers).

@@ -81,9 +81,14 @@ def serialize_response(status: int) -> bytes:
 class HealthServer:
     """Serves Check/Watch; status derives from a readiness callback."""
 
-    def __init__(self, ready_fn, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, ready_fn, host: str = "127.0.0.1", port: int = 0,
+                 tls=None):
         self.ready_fn = ready_fn
         self.host, self.port = host, port
+        # With secure serving, health shares the gateway's TLS identity —
+        # the reference registers health on the same TLS gRPC server as
+        # ext-proc (runserver.go HealthChecking branch).
+        self.tls = tls
         self._server: grpc.aio.Server | None = None
 
     def _status_for(self, service: str) -> int:
@@ -119,9 +124,15 @@ class HealthServer:
                 response_serializer=serialize_response),
         })
         self._server.add_generic_rpc_handlers((handlers,))
-        self.port = self._server.add_insecure_port(f"{self.host}:{self.port}")
+        addr = f"{self.host}:{self.port}"
+        if self.tls is not None:
+            self.port = self._server.add_secure_port(
+                addr, self.tls.grpc_server_credentials())
+        else:
+            self.port = self._server.add_insecure_port(addr)
         await self._server.start()
-        log.info("gRPC health on %s:%d", self.host, self.port)
+        log.info("gRPC health on %s:%d%s", self.host, self.port,
+                 " (TLS)" if self.tls else "")
         return self.port
 
     async def stop(self):

@@ -21,7 +21,7 @@ from llm_d_inference_scheduler_tpu.router.tlsutil import (
     create_self_signed_cert,
 )
 
-ENG, GW, EXTPROC = 18681, 18680, 18682
+ENG, GW, EXTPROC, HEALTH = 18681, 18680, 18682, 18687
 SC, PRE, DEC = 18691, 18693, 18695
 
 CFG = """
@@ -76,7 +76,8 @@ def test_gateway_https_and_extproc_tls_e2e():
                                         sim_decode_ms_per_token=1.0))
         await eng.start()
         gw = build_gateway(CFG, port=GW, poll_interval=0.02,
-                           grpc_ext_proc_port=EXTPROC, secure_serving=True)
+                           grpc_ext_proc_port=EXTPROC,
+                           grpc_health_port=HEALTH, secure_serving=True)
         await gw.start()
         try:
             # Plain HTTP must NOT work on a TLS listener.
@@ -120,6 +121,21 @@ def test_gateway_https_and_extproc_tls_e2e():
             dest = [r["set_headers"].get("x-gateway-destination-endpoint")
                     for r in responses if r["set_headers"]]
             assert f"127.0.0.1:{ENG}" in dest
+
+            # grpc.health.v1 shares the identity too (the reference
+            # registers health on the same TLS server as ext-proc).
+            from llm_d_inference_scheduler_tpu.router.health_grpc import (
+                SERVING,
+                serialize_response,
+            )
+
+            async with grpc.aio.secure_channel(f"127.0.0.1:{HEALTH}",
+                                               creds) as ch:
+                check = ch.unary_unary(
+                    "/grpc.health.v1.Health/Check",
+                    request_serializer=lambda s: b"",
+                    response_deserializer=lambda b: b)
+                assert await check("") == serialize_response(SERVING)
         finally:
             await gw.stop()
             await eng.stop()
