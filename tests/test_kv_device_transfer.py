@@ -142,6 +142,40 @@ def test_sharded_pull_tp_pair_matches_monolithic():
     asyncio.run(body())
 
 
+def test_sharded_pull_pp_pair_matches_monolithic():
+    """pp-sharded P/D pair: pages shard the LAYER axis over pp stages
+    (pp_serve.PAGE_SPEC); the prefiller stages one descriptor per unique
+    page shard and the pp decode engine pulls + scatters under its own
+    stage layout — device path, token parity with a monolithic pp engine.
+    (Round-5 follow-on to the tp pair: proves the kv_shards staging is
+    mesh-shape-agnostic, the precondition for disagg under the host-
+    spanning pp ring.)"""
+    async def body():
+        mono = EngineServer(_cfg(18761, pp_size=2))
+        await mono.start()
+        try:
+            async with httpx.AsyncClient(timeout=60) as c:
+                r = await c.post("http://127.0.0.1:18761/v1/completions",
+                                 json={"prompt": PROMPT, "max_tokens": 6,
+                                       "temperature": 0, "ignore_eos": True})
+                mono_text = r.json()["choices"][0]["text"]
+        finally:
+            await mono.stop()
+
+        pre, dec = await _pd_pair(18762, 18763, pp_size=2)
+        try:
+            ktp, doc = await _run_pd(18762, 18763)
+            assert "transfer_shards" in ktp and "kv_mesh" in ktp
+            assert dec.engine.kv_import_device_count == 1
+            assert dec.engine.kv_import_host_count == 0
+            assert doc["choices"][0]["text"] == mono_text
+        finally:
+            await pre.stop()
+            await dec.stop()
+
+    asyncio.run(body())
+
+
 def test_sharded_geometry_mismatch_falls_back_to_host():
     """tp=2 exporter, unsharded importer: geometry mismatch must degrade to
     the host-staged path (numpy resharding), not fail the request."""
