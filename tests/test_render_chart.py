@@ -104,6 +104,25 @@ def test_gateway_tls_rendering():
     assert "--secure-serving" not in spec["containers"][0]["args"]
     assert "scheme" not in spec["containers"][0]["readinessProbe"]["httpGet"]
 
+    # Sidecar TLS knobs render on the decode pod.
+    docs = _by_kind_name(_docs({"decode": {"sidecarTLS": {
+        "secureServing": True, "certSecret": "pd-tls",
+        "prefillerTLS": True}}}))
+    spec = docs[("Deployment", "tpu-pool-decode")]["spec"]["template"]["spec"]
+    sidecar = spec["containers"][0]
+    assert sidecar["name"] == "routing-sidecar"
+    for flag in ("--secure-serving", "--cert-path=/certs",
+                 "--use-tls-for-prefiller",
+                 "--insecure-skip-verify-prefiller"):
+        assert flag in sidecar["args"], flag
+    assert any(v.get("secret", {}).get("secretName") == "pd-tls"
+               for v in spec["volumes"])
+    # Default: no TLS args on the sidecar.
+    docs = _by_kind_name(_docs())
+    sidecar = docs[("Deployment", "tpu-pool-decode")]["spec"]["template"][
+        "spec"]["containers"][0]
+    assert not any("tls" in a or "secure" in a for a in sidecar["args"])
+
 
 def test_cli_set_overrides(tmp_path, capsys):
     from render_chart import main
