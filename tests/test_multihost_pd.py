@@ -57,7 +57,7 @@ def _child_env():
     jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
 
-def _prefill_worker(pid, ktp_q, done_q, err_q):
+def _prefill_worker(pid, ktp_q, done_q, err_q, overrides=None):
     _child_env()
     try:
         from llm_d_inference_scheduler_tpu.engine import EngineRequest
@@ -74,8 +74,11 @@ def _prefill_worker(pid, ktp_q, done_q, err_q):
 
         core.KV_EXPORT_TTL_S = 1200.0
 
-        cfg = _cfg(dist_coordinator=COORD_PRE, dist_num_processes=2,
-                   dist_process_id=pid, dist_instr_port=INSTR_PRE)
+        ov = dict(overrides or {})
+        coord = ov.pop("coord", COORD_PRE)
+        instr = ov.pop("instr", INSTR_PRE)
+        cfg = _cfg(dist_coordinator=coord, dist_num_processes=2,
+                   dist_process_id=pid, dist_instr_port=instr, **ov)
         maybe_init_distributed(cfg)
         eng = TpuEngine(cfg)
 
@@ -113,7 +116,7 @@ def _prefill_worker(pid, ktp_q, done_q, err_q):
         err_q.put(f"prefill pid{pid}: {e}\n{traceback.format_exc()[-2000:]}")
 
 
-def _decode_worker(pid, ktp_q, tok_q, err_q):
+def _decode_worker(pid, ktp_q, tok_q, err_q, overrides=None):
     _child_env()
     try:
         from llm_d_inference_scheduler_tpu.engine import EngineRequest
@@ -123,8 +126,11 @@ def _decode_worker(pid, ktp_q, tok_q, err_q):
             run_follower,
         )
 
-        cfg = _cfg(dist_coordinator=COORD_DEC, dist_num_processes=2,
-                   dist_process_id=pid, dist_instr_port=INSTR_DEC)
+        ov = dict(overrides or {})
+        coord = ov.pop("coord", COORD_DEC)
+        instr = ov.pop("instr", INSTR_DEC)
+        cfg = _cfg(dist_coordinator=coord, dist_num_processes=2,
+                   dist_process_id=pid, dist_instr_port=instr, **ov)
         maybe_init_distributed(cfg)
         eng = TpuEngine(cfg)
 
@@ -154,11 +160,29 @@ def _decode_worker(pid, ktp_q, tok_q, err_q):
 
 def test_dist_pd_sharded_handoff_matches_monolithic():
     # Reference tokens: single-process tp=2 monolithic engine.
+    _sharded_handoff_roundtrip({})
+
+
+def test_dist_pd_pp_sharded_handoff_matches_monolithic():
+    """Disaggregation across HOST-SPANNING pp groups: a 2-process pp2×tp2
+    prefill group stages layer-axis page shards, the pp decode group runs
+    the coordinated pull — tokens match a single-process pp2×tp2 engine.
+    (The BASELINE config-4 deployment: deep pipeline spanning hosts, P/D
+    split on top.)"""
+    _sharded_handoff_roundtrip(
+        {"pp_size": 2, "tp_size": 2},
+        coord_pre="127.0.0.1:19931", instr_pre=19932,
+        coord_dec="127.0.0.1:19933", instr_dec=19934)
+
+
+def _sharded_handoff_roundtrip(shape_kw, coord_pre=COORD_PRE,
+                               instr_pre=INSTR_PRE, coord_dec=COORD_DEC,
+                               instr_dec=INSTR_DEC):
     from llm_d_inference_scheduler_tpu.engine import EngineRequest
     from llm_d_inference_scheduler_tpu.engine.core import TpuEngine
 
     async def mono():
-        eng = TpuEngine(_cfg())
+        eng = TpuEngine(_cfg(**shape_kw))
         await eng.start()
         try:
             toks, _ = await _collect(eng, EngineRequest(
@@ -171,15 +195,19 @@ def test_dist_pd_sharded_handoff_matches_monolithic():
     expected = asyncio.run(mono())
     assert len(expected) == N_GEN
 
+    pre_ov = {"coord": coord_pre, "instr": instr_pre, **shape_kw}
+    dec_ov = {"coord": coord_dec, "instr": instr_dec, **shape_kw}
     ctx = mp.get_context("spawn")
     ktp_q, tok_q, err_q = ctx.Queue(), ctx.Queue(), ctx.Queue()
     done_q = ctx.Queue()
     ktp_relay = ctx.Queue()
     pre_procs = [
-        ctx.Process(target=_prefill_worker, args=(pid, ktp_q, done_q, err_q),
+        ctx.Process(target=_prefill_worker,
+                    args=(pid, ktp_q, done_q, err_q, pre_ov),
                     daemon=True) for pid in range(2)]
     dec_procs = [
-        ctx.Process(target=_decode_worker, args=(pid, ktp_relay, tok_q, err_q),
+        ctx.Process(target=_decode_worker,
+                    args=(pid, ktp_relay, tok_q, err_q, dec_ov),
                     daemon=True) for pid in range(2)]
     procs = pre_procs + dec_procs
 
