@@ -344,17 +344,34 @@ class EngineServer:
         emitted = 0      # prefix of `total` already written to the stream
         while True:
             ev: TokenEvent = await out.get()
-            if ev.token_id is not None:
-                total += ev.text
+            # Coalesce the awaited event with any queued burst: the engine
+            # emits decode_chunk tokens per fused dispatch, so under load
+            # the queue holds a run of them — one SSE delta (and one write)
+            # per drain instead of per token keeps the serving loop off the
+            # proxy/client hot path.
+            fin: TokenEvent | None = None
+            last_tok: TokenEvent | None = None
+            while True:
+                if ev.token_id is not None:
+                    total += ev.text
+                    last_tok = ev
+                if ev.finish_reason is not None:
+                    fin = ev
+                    break
+                try:
+                    ev = out.get_nowait()
+                except asyncio.QueueEmpty:
+                    break
+            if last_tok is not None:
                 hit = _first_stop_hit(total, stop_strings)
                 if hit is not None:
                     await write_piece(total[emitted:hit])
                     emitted = hit
                     self.engine.abort(req.request_id)
-                    ev = TokenEvent(request_id=req.request_id, token_id=None,
-                                    finish_reason=FinishReason.STOP,
-                                    prompt_tokens=n_prompt,
-                                    completion_tokens=ev.completion_tokens)
+                    fin = TokenEvent(request_id=req.request_id, token_id=None,
+                                     finish_reason=FinishReason.STOP,
+                                     prompt_tokens=n_prompt,
+                                     completion_tokens=last_tok.completion_tokens)
                 else:
                     # Hold back any suffix that could be the start of a stop
                     # string spanning token boundaries.
@@ -362,6 +379,7 @@ class EngineServer:
                     if safe > emitted:
                         await write_piece(total[emitted:safe])
                         emitted = safe
+            ev = fin if fin is not None else ev
             if ev.finish_reason is not None:
                 if ev.finish_reason != FinishReason.STOP and emitted < len(total):
                     await write_piece(total[emitted:])  # flush holdback
