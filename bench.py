@@ -256,7 +256,8 @@ pool:
             prompt = head + "x" * max(prompt_len - len(head), 1)
             t0 = time.monotonic()
             ttft = None
-            tokens = 0
+            events = 0
+            usage_tokens = 0
             async with client.post(
                     f"http://127.0.0.1:{gport}/v1/completions",
                     json={"model": engine_cfg.model, "prompt": prompt,
@@ -267,8 +268,19 @@ pool:
                             b"data: [DONE]"):
                         if ttft is None:
                             ttft = time.monotonic() - t0
-                        tokens += 1
-            results.append({"ttft": ttft, "tokens": tokens,
+                        events += 1
+                        if b'"usage"' in line:
+                            # Authoritative count: the engine coalesces
+                            # token bursts into one SSE delta under load,
+                            # so events != tokens.
+                            try:
+                                u = json.loads(line[6:]).get("usage") or {}
+                                usage_tokens = int(
+                                    u.get("completion_tokens") or 0)
+                            except Exception:
+                                pass
+            results.append({"ttft": ttft,
+                            "tokens": usage_tokens or events,
                             "latency": time.monotonic() - t0})
 
         async with aiohttp.ClientSession(

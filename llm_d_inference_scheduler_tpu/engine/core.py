@@ -853,13 +853,18 @@ class TpuEngine:
         device ops would interleave with decode ops on the followers)."""
         with self._cond:
             reqs, self._embed_reqs = self._embed_reqs, []
-        for bucket, tokens, seq_len, fut in reqs:
+        for i, (bucket, tokens, seq_len, fut) in enumerate(reqs):
             try:
                 fut.set_result(self._device_call(
                     ("embed", bucket), dict(tokens=tokens, seq_len=seq_len)))
             except ChannelBroken:
-                fut.set_exception(
-                    ValueError("engine degraded (multi-host peer lost)"))
+                # Lockstep is over: fail EVERY popped request (they are no
+                # longer on the queue, so the degrade drain can't reach
+                # them), then let the loop degrade.
+                for _, _, _, f in reqs[i:]:
+                    if not f.done():
+                        f.set_exception(ValueError(
+                            "engine degraded (multi-host peer lost)"))
                 raise
             except Exception as e:
                 fut.set_exception(e)

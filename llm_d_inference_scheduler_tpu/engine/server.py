@@ -351,10 +351,18 @@ class EngineServer:
             # proxy/client hot path.
             fin: TokenEvent | None = None
             last_tok: TokenEvent | None = None
+            hit: int | None = None
             while True:
                 if ev.token_id is not None:
                     total += ev.text
                     last_tok = ev
+                    if stop_strings:
+                        # Scan per folded token so the STOP usage record
+                        # counts exactly the tokens up to the hit, not the
+                        # whole drained burst.
+                        hit = _first_stop_hit(total, stop_strings)
+                        if hit is not None:
+                            break
                 if ev.finish_reason is not None:
                     fin = ev
                     break
@@ -363,7 +371,6 @@ class EngineServer:
                 except asyncio.QueueEmpty:
                     break
             if last_tok is not None:
-                hit = _first_stop_hit(total, stop_strings)
                 if hit is not None:
                     await write_piece(total[emitted:hit])
                     emitted = hit

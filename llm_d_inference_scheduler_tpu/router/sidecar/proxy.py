@@ -142,19 +142,21 @@ class Sidecar:
 
     def _rank_url(self) -> str:
         """decoder URL shifted by this listener's DP rank (data_parallel.go:39-88);
-        use_tls_for_decoder upgrades the scheme (proxy.go:155)."""
+        use_tls_for_decoder upgrades the scheme (proxy.go:155). Any path
+        prefix on the decoder URL is preserved."""
         from urllib.parse import urlsplit
 
         parts = urlsplit(self.cfg.decoder_url)
         scheme = "https" if self.cfg.use_tls_for_decoder else parts.scheme
+        path = parts.path.rstrip("/")
         if self.dp_rank == 0:
-            netloc = parts.netloc
-            return f"{scheme}://{netloc}"
+            return f"{scheme}://{parts.netloc}{path}"
         if parts.port is None:
             raise ValueError(
                 f"decoder URL {self.cfg.decoder_url!r} needs an explicit port "
                 f"for data-parallel rank dispatch")
-        return f"{scheme}://{parts.hostname}:{parts.port + self.dp_rank}"
+        return (f"{scheme}://{parts.hostname}:{parts.port + self.dp_rank}"
+                f"{path}")
 
     async def start(self):
         from ..tlsutil import client_verify

@@ -154,6 +154,13 @@ class TlsServing:
 
         def fetch():
             try:
+                # Validate the pair first: mid-rotation one file may be new
+                # while the other is still old (the poll path debounces for
+                # the same reason) — a mismatched pair would fail every
+                # handshake until both land. load_cert_chain raises on
+                # mismatch, and grpc then keeps serving the previous config.
+                probe = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+                probe.load_cert_chain(self._crt, self._key)
                 return grpc.ssl_server_certificate_configuration(
                     [(self.key_pem(), self.cert_pem())])
             except Exception as e:  # keep serving the previous pair

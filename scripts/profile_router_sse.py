@@ -38,11 +38,14 @@ async def drive(port: int, n_streams: int, gen_tokens: int, prompt_len: int,
     results: list[dict] = []
 
     async def one(client):
+        import json as _json
+
         head = f"r{rng.randint(0, 1 << 30):010d} "
         prompt = head + "x" * max(prompt_len - len(head), 1)
         t0 = time.monotonic()
         ttft = None
-        tokens = 0
+        events = 0
+        usage_tokens = 0
         async with client.post(
                 f"http://127.0.0.1:{port}/v1/completions",
                 json={"model": model, "prompt": prompt, "stream": True,
@@ -52,8 +55,18 @@ async def drive(port: int, n_streams: int, gen_tokens: int, prompt_len: int,
                         b"data: [DONE]"):
                     if ttft is None:
                         ttft = time.monotonic() - t0
-                    tokens += 1
-        results.append({"ttft": ttft, "tokens": tokens})
+                    events += 1
+                    if b'"usage"' in line:
+                        # The engine coalesces token bursts into one SSE
+                        # delta under load: events != tokens. The terminal
+                        # usage record is the authoritative count.
+                        try:
+                            u = _json.loads(line[6:]).get("usage") or {}
+                            usage_tokens = int(u.get("completion_tokens")
+                                               or 0)
+                        except Exception:
+                            pass
+        results.append({"ttft": ttft, "tokens": usage_tokens or events})
 
     async with aiohttp.ClientSession(
             timeout=aiohttp.ClientTimeout(total=300)) as client:
