@@ -226,6 +226,62 @@ async def run_topology_churn(n: int = 5000, concurrency: int = 100) -> dict:
     }
 
 
+async def run_priority_isolation(n: int = 4000, limit: int = 8,
+                                 service_s: float = 0.001) -> dict:
+    """BASELINE config 4's target: **priority isolation under saturation**.
+
+    A saturated egress (limit concurrent dispatches, each `service_s`) with
+    a 50/50 mix of premium (priority 10) and normal (priority 0) arrivals;
+    global-strict fairness must keep premium queue-wait flat while normal
+    absorbs the overload. Records per-tier wait percentiles + dispatch
+    counts — the isolation ratio is the artifact."""
+    inflight = 0
+
+    def saturation() -> float:
+        return 1.0 if inflight >= limit else inflight / limit
+
+    fc = FlowController(FlowControlConfig(default_ttl_s=120.0),
+                        saturation_fn=saturation)
+    await fc.start()
+    waits: dict[int, list[float]] = {0: [], 10: []}
+    dispatched = {0: 0, 10: 0}
+    sem = asyncio.Semaphore(limit * 16)  # heavy standing queue
+
+    async def one(i: int):
+        nonlocal inflight
+        prio = 10 if i % 2 else 0
+        async with sem:
+            item = FlowControlRequest(
+                request_id=f"p{i}",
+                flow_key=FlowKey(flow_id=f"tier{prio}-flow-{i % 8}",
+                                 priority=prio),
+                size_bytes=1024)
+            t = time.perf_counter()
+            out = await fc.enqueue_and_wait(item)
+            waits[prio].append(time.perf_counter() - t)
+            if out is QueueOutcome.DISPATCHED:
+                dispatched[prio] += 1
+                inflight += 1
+                await asyncio.sleep(service_s)
+                inflight -= 1
+                fc.notify_capacity()
+
+    await asyncio.gather(*[one(i) for i in range(n)])
+    await fc.stop()
+    out = {"n_requests": n, "egress_limit": limit,
+           "service_ms": service_s * 1e3, "tiers": {}}
+    for prio, w in waits.items():
+        w.sort()
+        out["tiers"][f"priority_{prio}"] = {
+            "dispatched": dispatched[prio],
+            "queue_wait_ms": {"p50": round(_pct(w, 0.50), 3),
+                              "p99": round(_pct(w, 0.99), 3)}}
+    hi = out["tiers"]["priority_10"]["queue_wait_ms"]["p50"]
+    lo = out["tiers"]["priority_0"]["queue_wait_ms"]["p50"]
+    out["isolation_p50_ratio"] = round(lo / hi, 1) if hi > 0 else None
+    return out
+
+
 async def main(quick: bool) -> dict:
     n_req = 2000 if quick else 20000
     points = []
@@ -242,8 +298,9 @@ async def main(quick: bool) -> dict:
                         concurrency=concurrency, n_requests=n_req))
     mass = await run_mass_cancellation(1000 if quick else 5000)
     churn = await run_topology_churn(1000 if quick else 5000)
+    prio = await run_priority_isolation(800 if quick else 4000)
     return {"performance_matrix": points, "mass_cancellation": mass,
-            "topology_churn": churn}
+            "topology_churn": churn, "priority_isolation": prio}
 
 
 if __name__ == "__main__":
