@@ -109,6 +109,10 @@ def child(model: str, batch: int) -> None:
                                          else pallas_env == "1"),
                        decode_ctx_buckets=os.environ.get(
                            "BENCH_CTX_BUCKETS", "0") == "1",
+                       # Amortize prefill weight passes across prompts
+                       # (prefill is HBM-bound at bench prompt lengths).
+                       prefill_batch=int(os.environ.get("BENCH_PREFILL_BATCH",
+                                                        "4")),
                        # BENCH_WARMUP=0: lazy compiles only (the buckets the
                        # run actually touches) — the qwen3-4b discipline:
                        # full warmup blew the 25-min compile budget twice on
@@ -150,7 +154,11 @@ def child(model: str, batch: int) -> None:
                 if record is not None:
                     record.append((first, completion))
 
-            await one(0, 2, None)  # compile the measured prefill bucket
+            # Compile the measured prefill bucket — a simultaneous burst so
+            # the batched [prefill_batch, S] shape compiles now, not inside
+            # the measured window.
+            await asyncio.gather(*[one(i - 100, 2, None) for i in range(
+                max(cfg.prefill_batch, 1))])
 
             # -- engine-direct load phase -------------------------------
             record: list[tuple[float, int]] = []

@@ -37,6 +37,15 @@ class EngineConfig:
     # batch×width matrix to keep its no-lazy-compile guarantee, which can
     # take minutes on a cold cache).
     decode_ctx_buckets: bool = False
+    # Batched prefill: admit up to N same-bucket plain prompts per fused
+    # prefill dispatch ([N, S] forward instead of N × [1, S]) — prefill is
+    # HBM-bound at serving prompt lengths, so one weights pass covers N
+    # prompts. Partial groups pad up to N (padding rows write the trash
+    # block), so exactly ONE extra traced shape per bucket. Prompts with a
+    # prefix-cache hit, multimodal embeds, or a cache probe keep the
+    # single-dispatch paths; pp engines always dispatch singly (the stage
+    # ring prefill is traced at [1, S]). 1 = classic per-prompt prefill.
+    prefill_batch: int = 1
     # Decode steps fused into one device dispatch (lax.scan over the decode
     # step + sampler on device). Amortizes per-dispatch latency — decisive
     # when the chip sits behind a network tunnel — at the cost of bursty

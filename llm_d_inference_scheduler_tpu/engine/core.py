@@ -96,6 +96,9 @@ class _Slot:
     # device→host sync off the dispatch critical path (the decode chunk for
     # the other lanes is already queued behind the prefill on device).
     pending_tok: Any = None
+    # Row of this slot's first token inside pending_tok (batched prefill
+    # shares one [N] device array across the group; singles use row 0).
+    pending_idx: int = 0
     prompt_len: int = 0
 
 
@@ -685,6 +688,15 @@ class TpuEngine:
             seq_len=np.asarray([1], np.int32),
             row=np.zeros((1, self.max_blocks_per_seq), np.int32),
             warm=True, **self._sample_np([_DUMMY_REQ])))
+        if self.cfg.prefill_batch > 1 and self.pp_mesh is None:
+            # Batched prefill pads every group to exactly prefill_batch rows,
+            # so ONE extra traced shape per bucket covers it.
+            K = self.cfg.prefill_batch
+            self._device_call(("prefill", bucket), dict(
+                tokens=np.zeros((K, bucket), np.int32),
+                seq_len=np.ones((K,), np.int32),
+                row=np.zeros((K, self.max_blocks_per_seq), np.int32),
+                warm=True, **self._sample_np([_DUMMY_REQ] * K)))
         # Compile EVERY decode bucket _batch_bucket can produce (1, 2, 4, …,
         # max_batch): a gate-able warm-up must leave no lazy compile to stall
         # the engine thread mid-serving.
@@ -922,6 +934,7 @@ class TpuEngine:
         return need
 
     def _admit(self):
+        group: list[tuple[int, EngineRequest, Any, Any, int]] = []
         for i, slot in enumerate(self.slots):
             if slot is not None:
                 continue
@@ -947,15 +960,211 @@ class TpuEngine:
                     continue
                 available = getattr(self.allocator, "reusable_blocks",
                                     self.allocator.free_blocks)
-                if need > available:
+                # Blocks the collected-but-not-yet-allocated group will claim
+                # count against capacity (allocation is deferred to the
+                # flush; only this thread allocates between here and there).
+                if need + sum(g[4] for g in group) > available:
                     break  # head-of-line waits for capacity
                 self._waiting.pop(0)
                 self.telemetry.waiting.set(len(self._waiting))
-            self._prefill_into_slot(i, req, out, loop, need)
+            group.append((i, req, out, loop, need))
+        self._flush_admissions(group)
+
+    def _flush_admissions(self, group):
+        """Dispatch collected admissions: same-bucket plain prompts batch
+        into one [N, S] prefill (cfg.prefill_batch rows, padded); everything
+        else — multimodal, cache probes, prefix-cache hits, in-group
+        duplicate prompts, solo entries, pp engines — takes the classic
+        single-dispatch paths. Batches go first so reroutes (duplicates /
+        hits) see the hashes the batch just committed. Any dispatch failure
+        cleans up EVERY not-yet-dispatched entry (they are already off
+        _waiting, so nothing else can reach them)."""
+        K = max(self.cfg.prefill_batch, 1)
+        # singles: (i, req, out, loop, need, precomputed|None)
+        singles: list[tuple] = []
+        by_bucket: dict[int, list] = {}
+        for i, req, out, loop, need in group:
+            if (K <= 1 or self.pp_mesh is not None
+                    or req.mm_embeds is not None
+                    or req.cache_hit_threshold is not None
+                    or (req.kv_transfer_params or {}).get("do_remote_decode")):
+                singles.append((i, req, out, loop, need, None))
+                continue
+            pre = self._prompt_and_hashes(req)
+            by_bucket.setdefault(self._bucket(len(pre[0])), []).append(
+                (i, req, out, loop, need, pre))
+        # batches: (bucket, [(i, req, out, loop, prompt, hashes, blocks)])
+        batches: list[tuple[int, list]] = []
+        seen_chains: set[tuple] = set()
+        for bucket, entries in by_bucket.items():
+            while entries:
+                chunk, entries = entries[:K], entries[K:]
+                if len(chunk) == 1:
+                    # Solo prompt: the already-traced [1, S] path is cheaper
+                    # than a padded [K, S] dispatch (nothing allocated yet).
+                    singles.append(chunk[0])
+                    continue
+                prepared = []
+                for i, req, out, loop, need, pre in chunk:
+                    prompt, hashes, caching = pre
+                    if hashes and tuple(hashes) in seen_chains:
+                        blocks = None  # duplicate: prefix-hit off the batch
+                    else:
+                        blocks = self._try_prepare_batch_entry(
+                            req, need, prompt, hashes, caching)
+                    if blocks is None:
+                        singles.append((i, req, out, loop, need, pre))
+                        continue
+                    if hashes:
+                        seen_chains.add(tuple(hashes))
+                    prepared.append((i, req, out, loop, need, pre, blocks))
+                if len(prepared) == 1:
+                    # Reroutes shrank the chunk to one survivor: demote it to
+                    # the [1, S] single path too (give back its blocks — the
+                    # single path allocates its own, possibly fewer after a
+                    # prefix match).
+                    i, req, out, loop, need, pre, blocks = prepared[0]
+                    with self._cond:
+                        self.allocator.free(blocks)
+                        self.telemetry.kv_usage.set(
+                            self.allocator.used_fraction)
+                    singles.append((i, req, out, loop, need, pre))
+                elif prepared:
+                    batches.append((bucket, prepared))
+        n_done = 0
+        try:
+            for bucket, prepared in batches:
+                self._run_batched_prefill(bucket, prepared)
+                n_done += 1
+            while singles:
+                i, req, out, loop, need, pre = singles.pop(0)
+                self._prefill_into_slot(i, req, out, loop, need,
+                                        precomputed=pre)
+        except Exception:
+            # The failing dispatch cleaned up its own entries; the rest
+            # would orphan without this (clients awaiting forever, blocks
+            # leaked).
+            leftover = batches[n_done + 1:] if n_done < len(batches) \
+                else []
+            with self._cond:
+                for _, prepared in leftover:
+                    for *_x, blocks in prepared:
+                        self.allocator.free(blocks)
+                self.telemetry.kv_usage.set(self.allocator.used_fraction)
+            for _, prepared in leftover:
+                for i, req, out, loop, need, pre, blocks in prepared:
+                    self._emit_to(out, loop, TokenEvent(
+                        request_id=req.request_id, token_id=None,
+                        finish_reason=FinishReason.ABORT,
+                        prompt_tokens=len(pre[0])))
+            for i, req, out, loop, need, pre in singles:
+                self._emit_to(out, loop, TokenEvent(
+                    request_id=req.request_id, token_id=None,
+                    finish_reason=FinishReason.ABORT,
+                    prompt_tokens=len(req.prompt_token_ids)))
+            raise
+
+    def _prompt_and_hashes(self, req):
+        """Truncated prompt + content-hash chain + caching gate — shared by
+        the single and batched prefill paths so they cannot drift."""
+        prompt = req.prompt_token_ids[: self.cfg.max_model_len - 1]
+        if len(prompt) < len(req.prompt_token_ids):
+            # Last-resort guard for direct submit() callers; the HTTP surface
+            # rejects over-context prompts with 400 before reaching here.
+            log.warning("request %s: prompt truncated %d -> %d tokens "
+                        "(max_model_len %d)", req.request_id,
+                        len(req.prompt_token_ids), len(prompt),
+                        self.cfg.max_model_len)
+        caching = isinstance(self.allocator, PrefixCachingAllocator)
+        if req.mm_embeds is not None:
+            # Multimodal prompts are NOT content-addressable by token ids:
+            # identical placeholder tokens can carry different images, so
+            # prefix caching and KV-event publication are disabled for them.
+            caching = False
+        hashes = (chain_block_hashes(self.model_name, prompt, "",
+                                     self.mcfg.kv_block_size)
+                  if caching or
+                  (self.kv_events is not None and req.mm_embeds is None)
+                  else [])
+        return prompt, hashes, caching
+
+    def _try_prepare_batch_entry(self, req, need: int, prompt, hashes,
+                                 caching: bool):
+        """Allocation for a batchable plain prefill. Returns the block list,
+        or None when a prefix-cache hit makes the O(prefix) single-dispatch
+        path the better deal."""
+        block = self.mcfg.kv_block_size
+        with self._cond:
+            if caching and hashes:
+                max_match = (len(prompt) - 1) // block
+                if self.allocator.match_prefix(hashes)[:max_match]:
+                    return None
+            blocks = self.allocator.alloc(need)
+            evicted = list(getattr(self.allocator, "last_evicted_hashes", []))
+            self.telemetry.kv_usage.set(self.allocator.used_fraction)
+        if evicted and self.kv_events is not None:
+            self.kv_events.removed(evicted)
+        return blocks
+
+    def _run_batched_prefill(self, bucket: int, entries: list[tuple]):
+        """One fused [K, bucket] prefill dispatch for up to K plain prompts.
+        Rows pad to cfg.prefill_batch (seq_len 1 + all-zero table → the one
+        garbage token writes the trash block), so the jit traces exactly one
+        batched shape per bucket. Slot bookkeeping mirrors the single path;
+        each slot lands PENDING with its row index into the shared token
+        array."""
+        K = self.cfg.prefill_batch
+        block = self.mcfg.kv_block_size
+        try:
+            # Staging is inside the try: a bad sampling knob on ONE request
+            # (e.g. non-numeric temperature from a direct submit() caller)
+            # must clean up the whole group like the single path would.
+            tokens = np.zeros((K, bucket), np.int32)
+            seq_len = np.ones((K,), np.int32)
+            rows = np.zeros((K, self.max_blocks_per_seq), np.int32)
+            for k, (_, req, _, _, need, pre, blocks) in enumerate(entries):
+                prompt = pre[0]
+                tokens[k, : len(prompt)] = prompt
+                seq_len[k] = len(prompt)
+                rows[k, : len(blocks)] = blocks
+            reqs = [e[1] for e in entries]
+            samp = self._sample_np(reqs + [_DUMMY_REQ] * (K - len(reqs)))
+            tok_dev = self._device_call(("prefill", bucket), dict(
+                tokens=tokens, seq_len=seq_len, row=rows, **samp))
+        except Exception:
+            with self._cond:
+                for *_, blocks in entries:
+                    self.allocator.free(blocks)
+                self.telemetry.kv_usage.set(self.allocator.used_fraction)
+            for _, req, out, loop, need, pre, _ in entries:
+                self._emit_to(out, loop, TokenEvent(
+                    request_id=req.request_id, token_id=None,
+                    finish_reason=FinishReason.ABORT,
+                    prompt_tokens=len(pre[0])))
+            raise
+        caching = isinstance(self.allocator, PrefixCachingAllocator)
+        for k, (i, req, out, loop, need, pre, blocks) in enumerate(entries):
+            prompt, hashes, _ = pre
+            self.telemetry.prompt_tokens.inc(len(prompt))
+            slot = _Slot(req=req, out=out, loop=loop, blocks=blocks,
+                         position=len(prompt), generated=[], last_token=-1,
+                         cached_tokens=0, pending_tok=tok_dev, pending_idx=k,
+                         prompt_len=len(prompt))
+            n_complete = len(prompt) // block
+            if caching:
+                with self._cond:
+                    self.allocator.commit_hashes(blocks[:n_complete],
+                                                 hashes[:n_complete])
+            slot.block_hashes = hashes[:n_complete]
+            if self.kv_events is not None and slot.block_hashes:
+                self.kv_events.stored(slot.block_hashes)
+            self.slots[i] = slot
+        self.telemetry.running.set(sum(s is not None for s in self.slots))
 
     # ---- prefill -------------------------------------------------------
 
-    def _prefill_into_slot(self, idx, req, out, loop, need: int):
+    def _prefill_into_slot(self, idx, req, out, loop, need: int,
+                           precomputed=None):
         if (self._dist and self.kv_transfer_server is None
                 and (req.kv_transfer_params or {}).get("do_remote_decode")):
             # Multi-host staging is shard-registered on every process's
@@ -970,25 +1179,10 @@ class TpuEngine:
                 finish_reason=FinishReason.ABORT,
                 prompt_tokens=len(req.prompt_token_ids)))
             return
-        prompt = req.prompt_token_ids[: self.cfg.max_model_len - 1]
-        if len(prompt) < len(req.prompt_token_ids):
-            # Last-resort guard for direct submit() callers; the HTTP surface
-            # rejects over-context prompts with 400 before reaching here.
-            log.warning("request %s: prompt truncated %d -> %d tokens "
-                        "(max_model_len %d)", req.request_id,
-                        len(req.prompt_token_ids), len(prompt),
-                        self.cfg.max_model_len)
         block = self.mcfg.kv_block_size
-        caching_enabled = isinstance(self.allocator, PrefixCachingAllocator)
-        if req.mm_embeds is not None:
-            # Multimodal prompts are NOT content-addressable by token ids:
-            # identical placeholder tokens can carry different images, so
-            # prefix caching and KV-event publication are disabled for them.
-            caching_enabled = False
-        hashes = (chain_block_hashes(self.model_name, prompt, "", block)
-                  if caching_enabled or
-                  (self.kv_events is not None and req.mm_embeds is None)
-                  else [])
+        prompt, hashes, caching_enabled = (
+            precomputed if precomputed is not None
+            else self._prompt_and_hashes(req))
 
         # Automatic prefix caching: reuse the longest cached run of complete
         # prompt blocks (keeping ≥1 suffix token so logits can be computed).
@@ -1074,7 +1268,7 @@ class TpuEngine:
         for idx, slot in enumerate(self.slots):
             if slot is None or slot.pending_tok is None:
                 continue
-            tok = int(np.asarray(slot.pending_tok)[0])
+            tok = int(np.asarray(slot.pending_tok)[slot.pending_idx])
             slot.pending_tok = None
             slot.generated = [tok]
             slot.last_token = tok
