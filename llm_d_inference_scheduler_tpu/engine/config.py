@@ -46,6 +46,17 @@ class EngineConfig:
     # single-dispatch paths; pp engines always dispatch singly (the stage
     # ring prefill is traced at [1, S]). 1 = classic per-prompt prefill.
     prefill_batch: int = 1
+    # Incremental prefill for LONG prompts: when > 0, a prompt whose
+    # un-cached suffix exceeds this many tokens prefills in windows of this
+    # size (rounded up to a KV-block multiple), one window per engine step,
+    # interleaved with the decode chunks of established lanes — bounding
+    # the decode stall a long-context prefill can cause to ~one window
+    # instead of the full prompt. Windows after the first ride the
+    # prefix-continuation jits (the same O(prefix) path prefix-cache hits
+    # use). 0 = classic whole-prompt prefill. Multimodal prompts always
+    # prefill whole (the embed splice targets absolute positions in the
+    # first forward).
+    prefill_chunk: int = 0
     # Decode steps fused into one device dispatch (lax.scan over the decode
     # step + sampler on device). Amortizes per-dispatch latency — decisive
     # when the chip sits behind a network tunnel — at the cost of bursty

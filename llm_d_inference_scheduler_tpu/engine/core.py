@@ -100,6 +100,15 @@ class _Slot:
     # shares one [N] device array across the group; singles use row 0).
     pending_idx: int = 0
     prompt_len: int = 0
+    # Incremental (chunked) prefill: while True the slot is excluded from
+    # decode batches; _advance_prefills writes one window per engine step
+    # so long prompts never stall the decode lanes for their full length.
+    prefilling: bool = False
+    prefill_rest: list[int] = dataclasses.field(default_factory=list)
+    prefill_written: int = 0
+    # (hashes, caching) — prefix-cache commit + KV-event publication are
+    # deferred until the last window lands.
+    chunk_meta: Any = None
 
 
 @dataclasses.dataclass
@@ -697,6 +706,31 @@ class TpuEngine:
                 seq_len=np.ones((K,), np.int32),
                 row=np.zeros((K, self.max_blocks_per_seq), np.int32),
                 warm=True, **self._sample_np([_DUMMY_REQ] * K)))
+        if self._prefill_window():
+            # Incremental prefill's mid-stream shapes: every intermediate
+            # window is FULL-width, so precompiling (win_bucket × pb ladder)
+            # removes the per-shape compile stall the feature exists to
+            # avoid. Only the final ragged window of a novel length may
+            # still lazy-compile once.
+            win = self._prefill_window()
+            wb = self._bucket(win)
+            self._device_call(("prefill", wb), dict(
+                tokens=np.zeros((1, wb), np.int32),
+                seq_len=np.asarray([1], np.int32),
+                row=np.zeros((1, self.max_blocks_per_seq), np.int32),
+                warm=True, **self._sample_np([_DUMMY_REQ])))
+            pb = 1
+            while True:
+                self._device_call(("prefix_prefill", wb, pb), dict(
+                    tokens=np.zeros((1, wb), np.int32),
+                    suffix_len=np.asarray([1], np.int32),
+                    prefix_len=np.asarray([0], np.int32),
+                    row=np.zeros((1, self.max_blocks_per_seq), np.int32),
+                    prior=np.zeros((1, pb), np.int32),
+                    warm=True, **self._sample_np([_DUMMY_REQ])))
+                if pb >= self.max_blocks_per_seq:
+                    break
+                pb = min(pb * 2, self.max_blocks_per_seq)
         # Compile EVERY decode bucket _batch_bucket can produce (1, 2, 4, …,
         # max_batch): a gate-able warm-up must leave no lazy compile to stall
         # the engine thread mid-serving.
@@ -778,7 +812,9 @@ class TpuEngine:
         self._process_aborts()
         self._process_imports()
         self._admit()
-        if any(s is not None and s.pending_tok is None for s in self.slots):
+        self._advance_prefills()
+        if any(s is not None and s.pending_tok is None and not s.prefilling
+               for s in self.slots):
             # Decode the established lanes (the chunk dispatch queues behind
             # any just-dispatched prefills on device), THEN land pending
             # first tokens — their host transfer overlapped the chunk.
@@ -991,6 +1027,11 @@ class TpuEngine:
                 singles.append((i, req, out, loop, need, None))
                 continue
             pre = self._prompt_and_hashes(req)
+            win = self._prefill_window()
+            if win and len(pre[0]) > win:
+                # Long prompt: the single path chunks it incrementally.
+                singles.append((i, req, out, loop, need, pre))
+                continue
             by_bucket.setdefault(self._bucket(len(pre[0])), []).append(
                 (i, req, out, loop, need, pre))
         # batches: (bucket, [(i, req, out, loop, prompt, hashes, blocks)])
@@ -1223,9 +1264,26 @@ class TpuEngine:
 
         cached_tokens = len(matched_bids) * block
         suffix = prompt[cached_tokens:]
+
+        win = self._prefill_window()
+        if win and len(suffix) > win and req.mm_embeds is None:
+            # Long prompt: park the slot PREFILLING; _advance_prefills
+            # writes one window per engine step (interleaved with decode).
+            if matched_bids:
+                self.telemetry.prefix_cached_tokens.inc(cached_tokens)
+            slot = _Slot(req=req, out=out, loop=loop, blocks=blocks,
+                         position=len(prompt), generated=[], last_token=-1,
+                         cached_tokens=cached_tokens, prompt_len=len(prompt),
+                         prefilling=True)
+            slot.prefill_rest = list(suffix)
+            slot.prefill_written = cached_tokens
+            slot.chunk_meta = (hashes, caching)
+            self.slots[idx] = slot
+            self.telemetry.running.set(sum(s is not None for s in self.slots))
+            return
+
         row = np.zeros((1, self.max_blocks_per_seq), np.int32)
         row[0, : len(blocks)] = blocks
-
         try:
             tok_dev = self._run_prefill_compute(req, prompt, suffix,
                                                 cached_tokens, matched_bids, row)
@@ -1266,7 +1324,7 @@ class TpuEngine:
         """Land pending first tokens (device transfer has had the decode
         chunk's execution time to complete) and emit/finish accordingly."""
         for idx, slot in enumerate(self.slots):
-            if slot is None or slot.pending_tok is None:
+            if slot is None or slot.pending_tok is None or slot.prefilling:
                 continue
             tok = int(np.asarray(slot.pending_tok)[slot.pending_idx])
             slot.pending_tok = None
@@ -1289,6 +1347,93 @@ class TpuEngine:
                 cached_tokens=slot.cached_tokens))
             slot.first_emitted = True
             self._maybe_finish_after_token(idx, tok)
+
+    def _prefill_window(self) -> int:
+        """Incremental-prefill window in tokens (a KV-block multiple so
+        every intermediate boundary is block-aligned); 0 = disabled."""
+        w = self.cfg.prefill_chunk
+        if w <= 0:
+            return 0
+        block = self.mcfg.kv_block_size
+        return max(block, (w + block - 1) // block * block)
+
+    def _advance_prefills(self):
+        """Write ONE window for the first PREFILLING slot (round-robin is
+        unnecessary: windows are small, and one per step keeps the decode
+        cadence). The final window's fused sample becomes the pending first
+        token; prefix-cache commit + KV events are deferred to that point."""
+        for idx, s in enumerate(self.slots):
+            if s is None or not s.prefilling:
+                continue
+            win = self._prefill_window()
+            window = s.prefill_rest[:win]
+            last = len(window) == len(s.prefill_rest)
+            written = s.prefill_written
+            block = self.mcfg.kv_block_size
+            req = s.req
+            row = np.zeros((1, self.max_blocks_per_seq), np.int32)
+            row[0, : len(s.blocks)] = s.blocks
+            try:
+                if written == 0:
+                    bucket = self._bucket(len(window))
+                    tokens = np.zeros((1, bucket), np.int32)
+                    tokens[0, : len(window)] = window
+                    tok_dev = self._device_call(("prefill", bucket), dict(
+                        tokens=tokens,
+                        seq_len=np.asarray([len(window)], np.int32),
+                        row=row, **self._sample_np([req])))
+                else:
+                    # Continuation window: gather the already-written prefix
+                    # from its (block-aligned) pages, scatter this window at
+                    # offset `written` — the prefix-cache-hit jit, reused.
+                    sb = self._bucket(len(window))
+                    prior_n = written // block
+                    pb = 1
+                    while pb < prior_n:
+                        pb *= 2
+                    pb = min(pb, self.max_blocks_per_seq)
+                    prior = np.zeros((1, pb), np.int32)
+                    prior[0, :prior_n] = s.blocks[:prior_n]
+                    tokens = np.zeros((1, sb), np.int32)
+                    tokens[0, : len(window)] = window
+                    tok_dev = self._device_call(
+                        ("prefix_prefill", sb, pb), dict(
+                            tokens=tokens,
+                            suffix_len=np.asarray([len(window)], np.int32),
+                            prefix_len=np.asarray([written], np.int32),
+                            row=row, prior=prior,
+                            **self._sample_np([req])))
+            except Exception:
+                self.slots[idx] = None
+                with self._cond:
+                    self.allocator.free(s.blocks)
+                    self.telemetry.kv_usage.set(self.allocator.used_fraction)
+                self._emit_to(s.out, s.loop, TokenEvent(
+                    request_id=req.request_id, token_id=None,
+                    finish_reason=FinishReason.ABORT,
+                    prompt_tokens=s.prompt_len))
+                self.telemetry.running.set(
+                    sum(x is not None for x in self.slots))
+                raise
+            self.telemetry.prompt_tokens.inc(len(window))
+            s.prefill_written = written + len(window)
+            s.prefill_rest = s.prefill_rest[len(window):]
+            if last:
+                hashes, caching = s.chunk_meta
+                s.chunk_meta = None
+                s.prefilling = False
+                s.pending_tok = tok_dev  # intermediate samples were discarded
+                n_complete = s.prompt_len // block
+                matched_n = s.cached_tokens // block
+                if caching:
+                    with self._cond:
+                        self.allocator.commit_hashes(
+                            s.blocks[matched_n:n_complete],
+                            hashes[matched_n:n_complete])
+                s.block_hashes = hashes[:n_complete]
+                if self.kv_events is not None and s.block_hashes:
+                    self.kv_events.stored(s.block_hashes)
+            return  # one window per step
 
     def _run_prefill_compute(self, req, prompt, suffix, cached_tokens,
                              matched_bids, row):
@@ -1930,12 +2075,12 @@ class TpuEngine:
 
     def _op_prefix_prefill(self, suffix_bucket, prefix_bucket, tokens,
                            suffix_len, prefix_len, row, prior, temps, top_k,
-                           top_p):
+                           top_p, warm=False):
         fn = self._prefix_prefill_fn(suffix_bucket, prefix_bucket)
         tok, self.k_pages, self.v_pages = fn(
             self.params, self._put(tokens), self._put(suffix_len),
             self._put(prefix_len), self.k_pages, self.v_pages,
-            self._put(row), self._put(prior), self._next_key(False),
+            self._put(row), self._put(prior), self._next_key(warm),
             self._put(temps), self._put(top_k), self._put(top_p))
         tok.copy_to_host_async()
         return tok
@@ -1995,7 +2140,8 @@ class TpuEngine:
 
     def _decode_once(self):
         active = [i for i, s in enumerate(self.slots)
-                  if s is not None and s.pending_tok is None]
+                  if s is not None and s.pending_tok is None
+                  and not s.prefilling]
         B = self._batch_bucket(len(active))
         W = self._ctx_bucket(max((len(self.slots[i].blocks) for i in active),
                                  default=1))
