@@ -82,9 +82,10 @@ class EngineConfig:
     # devices (composes with tp_size; total devices = tp_size * ep_size).
     ep_size: int = 1
     # Pipeline parallelism for serving (parallel/pp_serve.py): shard the
-    # layer stack + KV pages over pp_size stages; decode/prefill run the
-    # stage ring. Mutually exclusive with tp/ep in this version; forces
-    # prefix caching off (prefix-prefill rings: future work).
+    # layer stack + KV pages over pp_size stages on a (pp, tp, ep) mesh;
+    # decode/prefill/prefix-prefill/embed all run the stage ring. Composes
+    # with tp_size and ep_size, with prefix caching, and with multi-host
+    # (stages span hosts on the global mesh).
     pp_size: int = 1
     # Multi-host serving (engine/multihost.py): when dist_coordinator is set
     # ("host:port" of the jax.distributed coordinator), all dist_num_processes

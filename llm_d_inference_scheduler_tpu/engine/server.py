@@ -816,6 +816,16 @@ def main(argv: list[str] | None = None):
     p.add_argument("--tp-size", type=int, default=1,
                    help="tensor-parallel degree: shard params + KV pages over "
                         "this many devices (BASELINE config 4 path)")
+    p.add_argument("--pp-size", type=int, default=1,
+                   help="pipeline-parallel stages (stage-ring serving; "
+                        "composes with --tp-size/--ep-size)")
+    p.add_argument("--decode-chunk", type=int, default=8,
+                   help="decode steps fused per device dispatch")
+    p.add_argument("--prefill-batch", type=int, default=1,
+                   help="same-bucket prompts fused per prefill dispatch")
+    p.add_argument("--prefill-chunk", type=int, default=0,
+                   help="incremental prefill window in tokens for long "
+                        "prompts (0 = whole-prompt prefill)")
     p.add_argument("--ep-size", type=int, default=1,
                    help="expert-parallel degree for MoE models (composes "
                         "with --tp-size)")
@@ -840,6 +850,9 @@ def main(argv: list[str] | None = None):
                        served_model_name=args.served_model_name,
                        checkpoint_path=args.checkpoint, warmup=args.warmup,
                        tp_size=args.tp_size, ep_size=args.ep_size,
+                       pp_size=args.pp_size, decode_chunk=args.decode_chunk,
+                       prefill_batch=args.prefill_batch,
+                       prefill_chunk=args.prefill_chunk,
                        dist_coordinator=args.dist_coordinator,
                        dist_num_processes=args.dist_num_processes,
                        dist_process_id=args.dist_process_id,
