@@ -113,6 +113,10 @@ def child(model: str, batch: int) -> None:
                        # (prefill is HBM-bound at bench prompt lengths).
                        prefill_batch=int(os.environ.get("BENCH_PREFILL_BATCH",
                                                         "4")),
+                       # Long-context scenarios (BENCH_PROMPT >> default):
+                       # window the prefill so decode lanes keep moving.
+                       prefill_chunk=int(os.environ.get("BENCH_PREFILL_CHUNK",
+                                                        "0")),
                        # BENCH_WARMUP=0: lazy compiles only (the buckets the
                        # run actually touches) — the qwen3-4b discipline:
                        # full warmup blew the 25-min compile budget twice on
