@@ -219,3 +219,61 @@ def test_over_context_prompt_rejected_400():
             await srv.stop()
 
     run(body())
+
+
+def test_mixed_admission_fuzz_batched_and_chunked():
+    """Randomized mix of short/long prompts, mid-flight aborts, and varied
+    max_tokens against an engine running BOTH batched prefill (groups of 4)
+    and incremental prefill (32-token windows) with prefix caching on:
+    every request must terminate, and every block must come back."""
+    import random
+
+    from llm_d_inference_scheduler_tpu.engine.core import TpuEngine
+
+    rng = random.Random(11)
+
+    async def body():
+        eng = TpuEngine(_cfg("tpu", 0, max_batch=6, max_model_len=256,
+                             decode_chunk=4, kv_events_port=0, seed=11,
+                             prefill_batch=4, prefill_chunk=32))
+        await eng.start()
+        outcomes = {"finished": 0, "aborted": 0}
+        try:
+            async def one(i):
+                n_prompt = rng.choice([8, 30, 30, 90, 150])
+                base = rng.randrange(3)  # some identical prompts → dedupe
+                prompt = [1] + [(base * 131 + j * 7) % 400 + 3
+                                for j in range(n_prompt)]
+                req = EngineRequest(
+                    request_id=f"fz{i}", prompt_token_ids=prompt,
+                    max_tokens=rng.choice([1, 4, 9]), temperature=0.0,
+                    ignore_eos=True)
+                out = eng.submit(req)
+                kill_after = rng.random() < 0.2
+                got = 0
+                while True:
+                    ev = await asyncio.wait_for(out.get(), timeout=180)
+                    if ev.token_id is not None:
+                        got += 1
+                        if kill_after and got == 1:
+                            eng.abort(req.request_id)
+                    if ev.finish_reason is not None:
+                        key = ("aborted"
+                               if ev.finish_reason == FinishReason.ABORT
+                               else "finished")
+                        outcomes[key] += 1
+                        return
+
+            # Three overlapping waves so admission sees bursts AND trickles.
+            for wave in range(3):
+                await asyncio.gather(*[one(wave * 20 + i) for i in range(20)])
+            assert sum(outcomes.values()) == 60
+            # Allocator fully drained (trash block excluded).
+            free = getattr(eng.allocator, "reusable_blocks",
+                           eng.allocator.free_blocks)
+            assert free == eng.n_blocks - 1, (free, eng.n_blocks)
+        finally:
+            await eng.stop()
+        assert outcomes["finished"] > 0
+
+    run(body())
