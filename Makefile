@@ -1,0 +1,49 @@
+# Developer entry points mirroring the reference's Makefile targets
+# (SURVEY §4: make test-unit / test-integration-hermetic / bench-*).
+# No linter is baked into this image; py_compile stands in for `make format`.
+
+PY ?= python
+
+.PHONY: test test-fast test-unit test-dist bench bench-flowcontrol \
+	bench-router-sse dryrun render-chart compile-check
+
+# Full hermetic suite (virtual 8-device CPU mesh; no TPU or cluster needed —
+# the reference needs envtest + kind for the equivalent coverage).
+test:
+	$(PY) -m pytest tests/ -q
+
+# Everything except the spawned-process distributed tests (the slow tail).
+test-fast:
+	$(PY) -m pytest tests/ -q --deselect tests/test_multihost.py \
+		--deselect tests/test_multihost_pd.py
+
+test-unit: test-fast
+
+# The multi-process jax.distributed suites only.
+test-dist:
+	$(PY) -m pytest tests/test_multihost.py tests/test_multihost_pd.py -q
+
+# Serving benchmark on the real chip (one JSON line; the driver's entry).
+bench:
+	$(PY) bench.py
+
+bench-flowcontrol:
+	$(PY) scripts/flowcontrol_bench.py
+
+bench-router-sse:
+	$(PY) scripts/profile_router_sse.py
+
+# Driver-contract checks without hardware.
+dryrun:
+	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('ok')"
+
+compile-check:
+	$(PY) -c "import jax, __graft_entry__ as g; fn, a = g.entry(); \
+		jax.jit(fn)(*a); print('ok')"
+
+render-chart:
+	$(PY) scripts/render_chart.py deploy/charts/tpu-stack
+
+# Syntax sweep (no linter in this image).
+format:
+	$(PY) -m compileall -q llm_d_inference_scheduler_tpu scripts tests
