@@ -344,6 +344,10 @@ class TpuEngine:
         # Multi-host embeddings: queued by embed() (HTTP executor thread),
         # drained by the engine thread so the op broadcast stays in order.
         self._embed_reqs: list[tuple] = []
+        # P/D imports currently in their off-thread fetch window (popped
+        # from _waiting, not yet on _import_ready) — counted so idle()
+        # never declares the engine drained mid-transfer.
+        self._kv_fetching = 0
         self._release_reqs: list[tuple[str, str]] = []
         self._prefill_fns: dict[int, Any] = {}
         if self.pp_mesh is not None:
@@ -499,6 +503,16 @@ class TpuEngine:
             self.kv_events.close()
         if self.kv_shard_wire is not None:
             self.kv_shard_wire.close()
+
+    def idle(self) -> bool:
+        """True when nothing is admitted, queued, importing, fetching, or
+        waiting on an embed — the SIGTERM drain gate (server.run_server).
+        Kept here beside the state it reads so it cannot drift from the
+        engine loop's own wake predicate."""
+        with self._cond:
+            return (not any(s is not None for s in self.slots)
+                    and not self._waiting and not self._import_ready
+                    and not self._embed_reqs and self._kv_fetching == 0)
 
     def submit(self, req: EngineRequest) -> asyncio.Queue:
         """Thread-safe enqueue; returns the per-request event queue."""
@@ -1496,83 +1510,96 @@ class TpuEngine:
         sides have one; fall back to the host-staged HTTP path."""
         pi = _PendingImport(req=req, out=out, loop=loop)
         ktp = req.kv_transfer_params or {}
+        with self._cond:
+            self._kv_fetching += 1
 
         def fetch():
-            if (ktp.get("transfer_shards") and ktp.get("kv_mesh")
-                    and (self.kv_transfer_server is not None
-                         or self.kv_shard_wire is not None)):
-                # Sharded exporter. Multi-host importer: only preflight here
-                # (the pull is a coordinated engine-thread op); single-proc
-                # importer pulls every shard from the one exporter address.
-                try:
-                    self._check_shard_geometry(ktp)
-                    if self._dist:
-                        wire_addrs = (ktp.get("shard_wire_addrs")
-                                      if self._kv_wire == "host"
-                                      else ktp["transfer_shards"])
-                        if not wire_addrs or not all(wire_addrs):
-                            raise ValueError(
-                                f"no usable {self._kv_wire} wire addresses")
-                        for addr in wire_addrs:
-                            _tcp_preflight(addr)
-                        pi.dist_pull = True
-                        with self._cond:
-                            self._import_ready.append(pi)
-                            self._cond.notify()
-                        return
-                    self._pull_device_kv_sharded(pi, ktp)
-                    self.kv_import_device_count += 1
+            try:
+                self._fetch_inner(pi, ktp)
+            finally:
+                with self._cond:
+                    self._kv_fetching -= 1
+                    self._cond.notify()
+
+        threading.Thread(target=fetch, name="kv-fetch", daemon=True).start()
+
+    def _fetch_inner(self, pi, ktp):
+        """The fetch-thread body: resolve a transfer route, move the bytes
+        (or record the error), and hand the pending import to the engine
+        thread via _import_ready."""
+        if (ktp.get("transfer_shards") and ktp.get("kv_mesh")
+                and (self.kv_transfer_server is not None
+                     or self.kv_shard_wire is not None)):
+            # Sharded exporter. Multi-host importer: only preflight here
+            # (the pull is a coordinated engine-thread op); single-proc
+            # importer pulls every shard from the one exporter address.
+            try:
+                self._check_shard_geometry(ktp)
+                if self._dist:
+                    wire_addrs = (ktp.get("shard_wire_addrs")
+                                  if self._kv_wire == "host"
+                                  else ktp["transfer_shards"])
+                    if not wire_addrs or not all(wire_addrs):
+                        raise ValueError(
+                            f"no usable {self._kv_wire} wire addresses")
+                    for addr in wire_addrs:
+                        _tcp_preflight(addr)
+                    pi.dist_pull = True
                     with self._cond:
                         self._import_ready.append(pi)
                         self._cond.notify()
                     return
-                except Exception as e:
-                    log.warning("sharded kv pull (%s) failed (%s); "
-                                "host-path fallback",
-                                ktp.get("transfer_shards"), e)
-            if (ktp.get("transfer_address") and ktp.get("kv_shape")
-                    and not self._dist
-                    and self.kv_transfer_server is not None):
-                try:
-                    self._pull_device_kv(pi, ktp)
-                    self.kv_import_device_count += 1
-                    with self._cond:
-                        self._import_ready.append(pi)
-                        self._cond.notify()
-                    return
-                except Exception as e:
-                    log.warning("device kv pull from %s failed (%s); "
-                                "falling back to host path",
-                                ktp["transfer_address"], e)
-            if self._dist:
-                # No host path on a multi-host mesh (pages are not fully
-                # addressable): degrade to local prefill directly.
-                pi.error = "no usable sharded transfer route"
+                self._pull_device_kv_sharded(pi, ktp)
+                self.kv_import_device_count += 1
                 with self._cond:
                     self._import_ready.append(pi)
                     self._cond.notify()
                 return
-            import httpx
-
-            url = (f"http://{ktp['remote_host']}:{ktp['remote_port']}"
-                   f"/kv/{ktp['remote_request_id']}")
-            try:
-                r = httpx.get(url, timeout=30.0)
-                r.raise_for_status()
-                pi.payload = r.content
-                pi.headers = dict(r.headers)
-                self.kv_import_host_count += 1
-                try:
-                    httpx.delete(url, timeout=5.0)
-                except Exception:
-                    pass  # exporter TTL sweep reclaims
             except Exception as e:
-                pi.error = str(e)
+                log.warning("sharded kv pull (%s) failed (%s); "
+                            "host-path fallback",
+                            ktp.get("transfer_shards"), e)
+        if (ktp.get("transfer_address") and ktp.get("kv_shape")
+                and not self._dist
+                and self.kv_transfer_server is not None):
+            try:
+                self._pull_device_kv(pi, ktp)
+                self.kv_import_device_count += 1
+                with self._cond:
+                    self._import_ready.append(pi)
+                    self._cond.notify()
+                return
+            except Exception as e:
+                log.warning("device kv pull from %s failed (%s); "
+                            "falling back to host path",
+                            ktp["transfer_address"], e)
+        if self._dist:
+            # No host path on a multi-host mesh (pages are not fully
+            # addressable): degrade to local prefill directly.
+            pi.error = "no usable sharded transfer route"
             with self._cond:
                 self._import_ready.append(pi)
                 self._cond.notify()
+            return
+        import httpx
 
-        threading.Thread(target=fetch, name="kv-fetch", daemon=True).start()
+        url = (f"http://{ktp['remote_host']}:{ktp['remote_port']}"
+               f"/kv/{ktp['remote_request_id']}")
+        try:
+            r = httpx.get(url, timeout=30.0)
+            r.raise_for_status()
+            pi.payload = r.content
+            pi.headers = dict(r.headers)
+            self.kv_import_host_count += 1
+            try:
+                httpx.delete(url, timeout=5.0)
+            except Exception:
+                pass  # exporter TTL sweep reclaims
+        except Exception as e:
+            pi.error = str(e)
+        with self._cond:
+            self._import_ready.append(pi)
+            self._cond.notify()
 
     def _check_shard_geometry(self, ktp: dict[str, Any]) -> None:
         """A sharded pull needs identical page-sharding geometry on both

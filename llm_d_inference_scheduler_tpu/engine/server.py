@@ -117,6 +117,7 @@ class EngineServer:
     def __init__(self, cfg: EngineConfig, engine=None):
         self.cfg = cfg
         self.engine = engine or make_engine(cfg)
+        self.draining = False  # SIGTERM drain: health 503s, work finishes
         self.app = web.Application()
         self.app.add_routes([
             web.post("/v1/completions", self.completions),
@@ -156,7 +157,10 @@ class EngineServer:
 
             pub.hub = EventHub(asyncio.get_running_loop())
         await self.engine.start()
-        self._runner = web.AppRunner(self.app)
+        # Bounded handler shutdown: stop() must not sit out aiohttp's 60 s
+        # default waiting on streaming handlers — the drain path has already
+        # aborted their requests by the time cleanup runs.
+        self._runner = web.AppRunner(self.app, shutdown_timeout=5.0)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.cfg.host, self.cfg.port)
         await site.start()
@@ -615,12 +619,40 @@ class EngineServer:
         warming = bool(getattr(self.engine, "warming", False))
         degraded = bool(getattr(self.engine, "dist_degraded", False))
         status = ("degraded" if degraded
+                  else "draining" if self.draining
                   else "warming" if warming else "ok")
         return web.json_response({
             "status": status,
             "engine_id": self.engine.engine_id,
             "model": self.engine.model_name, "role": self.cfg.role,
         }, status=200 if status == "ok" else 503)
+
+    def engine_idle(self) -> bool:
+        """SIGTERM drain gate (k8s terminationGracePeriod flow: readiness
+        flips 503 via ``draining``, the LB stops routing, in-flight work
+        finishes, then the process exits). The predicate is engine-owned
+        (TpuEngine.idle / SimEngine.idle) so it cannot drift from the
+        engine loop's own state."""
+        idle = getattr(self.engine, "idle", None)
+        return idle() if idle is not None else True
+
+    def abort_inflight(self) -> None:
+        """Drain-timeout teardown: abort every live request via the
+        thread-safe per-request abort so blocked handlers unblock with an
+        ABORT event instead of hanging into the SIGKILL window."""
+        eng = self.engine
+        ids: set[str] = set(getattr(eng, "_tasks", {}) or {})
+        if hasattr(eng, "_cond"):
+            with eng._cond:
+                ids.update(s.req.request_id
+                           for s in getattr(eng, "slots", []) if s is not None)
+                ids.update(r.request_id
+                           for r, _, _ in getattr(eng, "_waiting", []))
+        for rid in ids:
+            try:
+                eng.abort(rid)
+            except Exception:
+                log.exception("drain abort failed for %s", rid)
 
     # ---- KV handoff data path (P/D disaggregation) ---------------------
 
@@ -785,14 +817,38 @@ class EngineServer:
         })
 
 
-async def run_server(cfg: EngineConfig):
+async def run_server(cfg: EngineConfig, drain_timeout_s: float = 30.0):
+    """Serve until SIGTERM/SIGINT, then drain gracefully: readiness flips
+    503 (the LB stops routing), in-flight requests finish (bounded by
+    ``drain_timeout_s``), then the engine stops — the k8s
+    terminationGracePeriod contract."""
+    import signal
+
     server = EngineServer(cfg)
     await server.start()
+    stop_ev = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            loop.add_signal_handler(sig, stop_ev.set)
+        except (NotImplementedError, RuntimeError):
+            pass  # non-main thread / platform without signal support
     try:
-        while True:
-            await asyncio.sleep(3600)
+        await stop_ev.wait()
+        server.draining = True
+        log.info("SIGTERM: draining (timeout %.0fs)", drain_timeout_s)
+        deadline = loop.time() + drain_timeout_s
+        while loop.time() < deadline and not server.engine_idle():
+            await asyncio.sleep(0.25)
+        if not server.engine_idle():
+            log.warning("drain timeout: aborting remaining in-flight work")
+            server.abort_inflight()
+            grace = loop.time() + 5.0
+            while loop.time() < grace and not server.engine_idle():
+                await asyncio.sleep(0.1)
     except asyncio.CancelledError:
-        await server.stop()
+        pass
+    await server.stop()
 
 
 def main(argv: list[str] | None = None):
@@ -826,6 +882,10 @@ def main(argv: list[str] | None = None):
     p.add_argument("--prefill-chunk", type=int, default=0,
                    help="incremental prefill window in tokens for long "
                         "prompts (0 = whole-prompt prefill)")
+    p.add_argument("--drain-timeout", type=float, default=30.0,
+                   help="seconds to let in-flight requests finish after "
+                        "SIGTERM before stopping (readiness 503s "
+                        "immediately)")
     p.add_argument("--ep-size", type=int, default=1,
                    help="expert-parallel degree for MoE models (composes "
                         "with --tp-size)")
@@ -869,7 +929,7 @@ def main(argv: list[str] | None = None):
 
         run_follower(TpuEngine(cfg))
         return
-    asyncio.run(run_server(cfg))
+    asyncio.run(run_server(cfg, drain_timeout_s=args.drain_timeout))
 
 
 if __name__ == "__main__":

@@ -81,6 +81,10 @@ class SimEngine:
         task.add_done_callback(lambda _: self._tasks.pop(req.request_id, None))
         return out
 
+    def idle(self) -> bool:
+        """Drain gate: no live per-request task."""
+        return not self._tasks
+
     def abort(self, request_id: str) -> None:
         task = self._tasks.get(request_id)
         if task is not None:

@@ -277,3 +277,89 @@ def test_mixed_admission_fuzz_batched_and_chunked():
         assert outcomes["finished"] > 0
 
     run(body())
+
+
+def test_sigterm_graceful_drain():
+    """run_server's SIGTERM flow: readiness flips 503 immediately, the
+    in-flight request still completes, then the server exits cleanly."""
+    import os
+    import signal
+
+    from llm_d_inference_scheduler_tpu.engine.server import run_server
+
+    async def body():
+        cfg = _cfg("sim", 18341, sim_decode_ms_per_token=30.0)
+        srv_task = asyncio.create_task(run_server(cfg, drain_timeout_s=20.0))
+        async with httpx.AsyncClient(timeout=60) as c:
+            for _ in range(100):  # wait for the listener
+                if srv_task.done():
+                    srv_task.result()  # surface the server's own exception
+                    raise AssertionError("server exited before serving")
+                try:
+                    r = await c.get("http://127.0.0.1:18341/health")
+                    if r.status_code == 200:
+                        break
+                except Exception:
+                    pass  # httpx/httpcore connect errors while binding
+                await asyncio.sleep(0.05)
+            else:
+                raise AssertionError("server never became healthy")
+
+            # Long-ish request in flight, then SIGTERM mid-generation.
+            gen = asyncio.create_task(c.post(
+                "http://127.0.0.1:18341/v1/completions",
+                json={"prompt": "hello", "max_tokens": 30}))
+            await asyncio.sleep(0.2)
+            os.kill(os.getpid(), signal.SIGTERM)
+            await asyncio.sleep(0.3)
+            r = await c.get("http://127.0.0.1:18341/health")
+            assert r.status_code == 503
+            assert r.json()["status"] == "draining"
+
+            resp = await gen
+            assert resp.status_code == 200
+            assert resp.json()["usage"]["completion_tokens"] == 30
+        await asyncio.wait_for(srv_task, timeout=30)
+
+    run(body())
+
+
+def test_drain_timeout_aborts_stragglers():
+    """A request that cannot finish inside the drain window is actively
+    aborted (ABORT event, not a hang into the SIGKILL window), and the
+    server exits promptly."""
+    import os
+    import signal
+    import time as _time
+
+    from llm_d_inference_scheduler_tpu.engine.server import run_server
+
+    async def body():
+        # 200ms/token x 200 tokens >> the 1s drain window.
+        cfg = _cfg("sim", 18342, sim_decode_ms_per_token=200.0)
+        srv_task = asyncio.create_task(run_server(cfg, drain_timeout_s=1.0))
+        async with httpx.AsyncClient(timeout=60) as c:
+            for _ in range(100):
+                if srv_task.done():
+                    srv_task.result()
+                    raise AssertionError("server exited before serving")
+                try:
+                    if (await c.get("http://127.0.0.1:18342/health")
+                            ).status_code == 200:
+                        break
+                except Exception:
+                    pass
+                await asyncio.sleep(0.05)
+            gen = asyncio.create_task(c.post(
+                "http://127.0.0.1:18342/v1/completions",
+                json={"prompt": "hello", "max_tokens": 200}))
+            await asyncio.sleep(0.3)
+            t0 = _time.monotonic()
+            os.kill(os.getpid(), signal.SIGTERM)
+            resp = await gen  # aborted partial completion, not a hang
+            assert resp.status_code == 200
+            assert resp.json()["usage"]["completion_tokens"] < 200
+            await asyncio.wait_for(srv_task, timeout=15)
+            assert _time.monotonic() - t0 < 12  # 1s drain + bounded teardown
+
+    run(body())
