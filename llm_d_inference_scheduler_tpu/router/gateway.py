@@ -143,6 +143,8 @@ class Gateway:
         ])
         self._runner: web.AppRunner | None = None
         self._client: httpx.AsyncClient | None = None
+        self.draining = False   # SIGTERM drain: readiness flips not-ready
+        self._inflight = 0      # live proxied requests (drain gate)
         self._models_fallback_cache: tuple[float, list] = (0.0, [])
         self._flusher: asyncio.Task | None = None
         self._profile_lock = asyncio.Lock()
@@ -204,7 +206,9 @@ class Gateway:
 
         self._upstream = _aiohttp.ClientSession(
             timeout=_aiohttp.ClientTimeout(total=300.0, sock_connect=5.0))
-        self._runner = web.AppRunner(self.app)
+        # Bounded handler shutdown: stop() must not sit out aiohttp's 60 s
+        # default waiting on SSE proxy handlers after a drain timeout.
+        self._runner = web.AppRunner(self.app, shutdown_timeout=5.0)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port,
                            ssl_context=self.tls.ssl_context
@@ -306,10 +310,14 @@ class Gateway:
     async def handle_inference(self, request: web.Request) -> web.StreamResponse:
         from .tracing import tracer
 
-        with tracer.span("gateway.request", path=request.path) as span:
-            resp = await self._handle_inference(request, span)
-            span.set_attribute("status", resp.status)
-            return resp
+        self._inflight += 1
+        try:
+            with tracer.span("gateway.request", path=request.path) as span:
+                resp = await self._handle_inference(request, span)
+                span.set_attribute("status", resp.status)
+                return resp
+        finally:
+            self._inflight -= 1
 
     async def _handle_inference(self, request: web.Request,
                                 span=None) -> web.StreamResponse:
@@ -510,7 +518,11 @@ class Gateway:
 
     def _ready(self) -> bool:
         """Readiness couples to leadership (reference health.go:52-104): a
-        follower replica reports not-ready so the LB routes to the leader."""
+        follower replica reports not-ready so the LB routes to the leader;
+        a draining replica reports not-ready so traffic moves off before
+        SIGTERM teardown."""
+        if self.draining:
+            return False
         if self.elector is not None and not self.elector.is_leader:
             return False
         return self.datastore.pool_ready and bool(self.datastore.endpoint_list())
@@ -720,6 +732,39 @@ def build_gateway(config_text: str | None, *, host: str = "127.0.0.1",
                    enable_cert_reload=enable_cert_reload)
 
 
+async def run_gateway(gw: Gateway, drain_timeout_s: float = 30.0):
+    """Serve until SIGTERM/SIGINT, then drain: readiness flips not-ready
+    (LB + ext-proc health pull this replica; stopping the elector releases
+    leadership so a standby takes over fast), in-flight proxied requests
+    finish bounded by ``drain_timeout_s``, then the gateway stops."""
+    import signal
+
+    await gw.start()
+    stop_ev = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            loop.add_signal_handler(sig, stop_ev.set)
+        except (NotImplementedError, RuntimeError):
+            pass
+    try:
+        await stop_ev.wait()
+        gw.draining = True
+        if gw.elector is not None:
+            await gw.elector.stop()
+            gw.elector = None
+        log.info("SIGTERM: draining %d in-flight requests", gw._inflight)
+        deadline = loop.time() + drain_timeout_s
+        while loop.time() < deadline and gw._inflight > 0:
+            await asyncio.sleep(0.25)
+        if gw._inflight:
+            log.warning("drain timeout with %d requests still in flight; "
+                        "closing", gw._inflight)
+    except asyncio.CancelledError:
+        pass
+    await gw.stop()
+
+
 def main(argv: list[str] | None = None):
     import argparse
 
@@ -768,6 +813,10 @@ def main(argv: list[str] | None = None):
     p.add_argument("--enable-cert-reload", action="store_true",
                    help="re-read --cert-path on change so cert-manager "
                         "rotations apply without a restart (certs.go)")
+    p.add_argument("--drain-timeout", type=float, default=30.0,
+                   help="seconds to let in-flight proxied requests finish "
+                        "after SIGTERM (readiness flips not-ready and the "
+                        "lease is released immediately)")
     args = p.parse_args(argv)
 
     text = args.config_text
@@ -811,15 +860,7 @@ def main(argv: list[str] | None = None):
 
     logging.basicConfig(level=logging.INFO)
 
-    async def run():
-        await gw.start()
-        try:
-            while True:
-                await asyncio.sleep(3600)
-        except asyncio.CancelledError:
-            await gw.stop()
-
-    asyncio.run(run())
+    asyncio.run(run_gateway(gw, drain_timeout_s=args.drain_timeout))
 
 
 if __name__ == "__main__":

@@ -169,3 +169,68 @@ def test_gateway_error_paths():
             await gw.stop()
 
     run(body())
+
+
+def test_gateway_sigterm_drain():
+    """run_gateway's SIGTERM flow: readiness flips not-ready immediately,
+    an in-flight proxied stream still completes, then the gateway exits."""
+    import os
+    import signal
+
+    from llm_d_inference_scheduler_tpu.engine import EngineConfig
+    from llm_d_inference_scheduler_tpu.engine.server import EngineServer
+    from llm_d_inference_scheduler_tpu.router.gateway import (
+        build_gateway,
+        run_gateway,
+    )
+
+    EPORT, GPORT = 18621, 18620
+
+    async def body():
+        eng = EngineServer(EngineConfig(backend="sim", model="tiny",
+                                        port=EPORT,
+                                        sim_decode_ms_per_token=40.0))
+        await eng.start()
+        gw = build_gateway(f"""
+pool:
+  endpoints:
+    - {{address: 127.0.0.1, port: {EPORT}}}
+""", port=GPORT, poll_interval=0.02)
+        gw_task = asyncio.create_task(run_gateway(gw, drain_timeout_s=20.0))
+        try:
+            async with httpx.AsyncClient(timeout=60) as c:
+                for _ in range(100):
+                    if gw_task.done():
+                        gw_task.result()
+                        raise AssertionError("gateway exited early")
+                    try:
+                        if (await c.get(
+                                f"http://127.0.0.1:{GPORT}/health")
+                                ).status_code == 200:
+                            break
+                    except Exception:
+                        pass
+                    await asyncio.sleep(0.05)
+                else:
+                    raise AssertionError("gateway never became ready")
+
+                gen = asyncio.create_task(c.post(
+                    f"http://127.0.0.1:{GPORT}/v1/completions",
+                    json={"model": "tiny", "prompt": "hi",
+                          "max_tokens": 25}))
+                await asyncio.sleep(0.2)
+                os.kill(os.getpid(), signal.SIGTERM)
+                await asyncio.sleep(0.3)
+                r = await c.get(f"http://127.0.0.1:{GPORT}/health")
+                assert r.status_code == 503  # draining: not-ready
+
+                resp = await gen
+                assert resp.status_code == 200
+                assert resp.json()["usage"]["completion_tokens"] == 25
+            await asyncio.wait_for(gw_task, timeout=30)
+        finally:
+            if not gw_task.done():
+                gw_task.cancel()
+            await eng.stop()
+
+    asyncio.run(body())
