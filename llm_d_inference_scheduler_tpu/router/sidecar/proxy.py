@@ -107,6 +107,7 @@ class Sidecar:
         self._encode_client: httpx.AsyncClient | None = None
         self._tls = None          # TlsServing; rank 0 owns, children borrow
         self._tls_owned = False
+        self._inflight = 0        # live generate requests (SIGTERM drain)
         self._dp_children: list["Sidecar"] = []
         self._bg_tasks: set = set()  # strong refs for fire-and-forget legs
 
@@ -208,6 +209,13 @@ class Sidecar:
     # ---- request handling ------------------------------------------------
 
     async def handle_generate(self, request: web.Request) -> web.StreamResponse:
+        self._inflight += 1
+        try:
+            return await self._handle_generate(request)
+        finally:
+            self._inflight -= 1
+
+    async def _handle_generate(self, request: web.Request) -> web.StreamResponse:
         raw = await request.read()
         try:
             body = json.loads(raw)
@@ -639,13 +647,30 @@ def main(argv: list[str] | None = None):
     logging.basicConfig(level=logging.INFO)
 
     async def run():
+        import signal
+
         sc = Sidecar(cfg)
         await sc.start()
+        stop_ev = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                loop.add_signal_handler(sig, stop_ev.set)
+            except (NotImplementedError, RuntimeError):
+                pass
         try:
-            while True:
-                await asyncio.sleep(3600)
+            await stop_ev.wait()
+            # Drain: in-flight P/D protocols finish (each leg has its own
+            # timeout), bounded; new requests race the listener teardown.
+            deadline = loop.time() + 30.0
+            inflight = lambda: sc._inflight + sum(  # noqa: E731
+                ch._inflight for ch in sc._dp_children)
+            log.info("SIGTERM: draining %d in-flight requests", inflight())
+            while loop.time() < deadline and inflight() > 0:
+                await asyncio.sleep(0.25)
         except asyncio.CancelledError:
-            await sc.stop()
+            pass
+        await sc.stop()
 
     asyncio.run(run())
 
