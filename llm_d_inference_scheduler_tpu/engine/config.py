@@ -57,6 +57,16 @@ class EngineConfig:
     # prefill whole (the embed splice targets absolute positions in the
     # first forward).
     prefill_chunk: int = 0
+    # Secure serving for the engine's HTTP surface (the in-cluster legs the
+    # sidecar's use-tls-for-prefiller/decoder knobs target): cert dir with
+    # tls.crt/tls.key, or a self-signed certificate when secure_serving is
+    # on without a path (router/tlsutil.py). Note: the host-staged /kv
+    # fallback's importer dials plain http (trusted-mesh side channel, like
+    # the reference's NIXL handshake) — TLS engines doing P/D rely on the
+    # device/shard transfer wires, which are not HTTP.
+    secure_serving: bool = False
+    cert_path: str = ""
+    enable_cert_reload: bool = False
     # Decode steps fused into one device dispatch (lax.scan over the decode
     # step + sampler on device). Amortizes per-dispatch latency — decisive
     # when the chip sits behind a network tunnel — at the cost of bursty

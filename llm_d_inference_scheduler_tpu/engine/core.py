@@ -1583,16 +1583,17 @@ class TpuEngine:
             return
         import httpx
 
-        url = (f"http://{ktp['remote_host']}:{ktp['remote_port']}"
+        scheme = ktp.get("remote_scheme") or "http"
+        url = (f"{scheme}://{ktp['remote_host']}:{ktp['remote_port']}"
                f"/kv/{ktp['remote_request_id']}")
         try:
-            r = httpx.get(url, timeout=30.0)
+            r = httpx.get(url, timeout=30.0, verify=False)
             r.raise_for_status()
             pi.payload = r.content
             pi.headers = dict(r.headers)
             self.kv_import_host_count += 1
             try:
-                httpx.delete(url, timeout=5.0)
+                httpx.delete(url, timeout=5.0, verify=False)
             except Exception:
                 pass  # exporter TTL sweep reclaims
         except Exception as e:
@@ -1636,9 +1637,11 @@ class TpuEngine:
         try:
             import httpx
 
-            httpx.delete(f"http://{ktp['remote_host']}:{ktp['remote_port']}"
+            scheme = ktp.get("remote_scheme") or "http"
+            httpx.delete(f"{scheme}://{ktp['remote_host']}:"
+                         f"{ktp['remote_port']}"
                          f"/kv/{ktp['remote_request_id']}?consumed=device",
-                         timeout=5.0)
+                         timeout=5.0, verify=False)
         except Exception:
             pass  # exporter TTL sweep reclaims
 
@@ -2264,6 +2267,11 @@ class TpuEngine:
                 "remote_first_token": first_token,
                 "remote_host": self.cfg.host,
                 "remote_port": self.cfg.port,
+                # TLS exporters: the host-staged /kv fallback must dial the
+                # right scheme (importers skip verification — pod-local
+                # certs, same trust model as the transfer wires).
+                "remote_scheme": ("https" if self.cfg.secure_serving
+                                  else "http"),
             }
             with self._exports_lock:
                 rec.update({

@@ -118,6 +118,7 @@ class EngineServer:
         self.cfg = cfg
         self.engine = engine or make_engine(cfg)
         self.draining = False  # SIGTERM drain: health 503s, work finishes
+        self._tls = None       # TlsServing when secure_serving is on
         self.app = web.Application()
         self.app.add_routes([
             web.post("/v1/completions", self.completions),
@@ -156,16 +157,26 @@ class EngineServer:
             from .kv_events import EventHub
 
             pub.hub = EventHub(asyncio.get_running_loop())
+        if self.cfg.secure_serving and self._tls is None:
+            # Before the (expensive) engine start: a bad cert path must
+            # fail in milliseconds, not after weights load + compile.
+            from ..router.tlsutil import TlsServing
+
+            self._tls = TlsServing(self.cfg.cert_path or None,
+                                   self.cfg.enable_cert_reload)
         await self.engine.start()
         # Bounded handler shutdown: stop() must not sit out aiohttp's 60 s
         # default waiting on streaming handlers — the drain path has already
         # aborted their requests by the time cleanup runs.
         self._runner = web.AppRunner(self.app, shutdown_timeout=5.0)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, self.cfg.host, self.cfg.port)
+        site = web.TCPSite(self._runner, self.cfg.host, self.cfg.port,
+                           ssl_context=self._tls.ssl_context
+                           if self._tls else None)
         await site.start()
-        log.info("engine %s listening on %s:%s", self.engine.engine_id,
-                 self.cfg.host, self.cfg.port)
+        log.info("engine %s listening on %s:%s%s", self.engine.engine_id,
+                 self.cfg.host, self.cfg.port,
+                 " (TLS)" if self._tls else "")
 
     async def stop(self):
         if self._runner:
@@ -173,6 +184,9 @@ class EngineServer:
         if self._ec_client is not None:
             await self._ec_client.aclose()
         await self.engine.stop()
+        if self._tls is not None:
+            self._tls.close()
+            self._tls = None
 
     # ---- request plumbing ---------------------------------------------
 
@@ -195,11 +209,16 @@ class EngineServer:
         import httpx
 
         if self._ec_client is None:
-            self._ec_client = httpx.AsyncClient(timeout=10)
+            # verify=False: ec_sources may be https (TLS encode workers with
+            # pod-local certs — the sidecar's use-tls-for-encoder leg).
+            self._ec_client = httpx.AsyncClient(timeout=10, verify=False)
 
         async def fetch(host):
+            # The sidecar scheme-qualifies sources when the encoder leg is
+            # TLS; bare host:port stays plain http.
+            base = host if "://" in host else f"http://{host}"
             try:
-                r = await self._ec_client.get(f"http://{host}/ec/{rid}")
+                r = await self._ec_client.get(f"{base}/ec/{rid}")
                 r.raise_for_status()
                 return r.json()
             except Exception as e:
@@ -886,6 +905,14 @@ def main(argv: list[str] | None = None):
                    help="seconds to let in-flight requests finish after "
                         "SIGTERM before stopping (readiness 503s "
                         "immediately)")
+    p.add_argument("--secure-serving", action="store_true",
+                   help="serve the OpenAI surface over TLS (self-signed "
+                        "unless --cert-path mounts tls.crt/tls.key)")
+    p.add_argument("--cert-path", default="",
+                   help="directory holding tls.crt + tls.key")
+    p.add_argument("--enable-cert-reload", action="store_true",
+                   help="re-read --cert-path when it changes (cert-manager "
+                        "rotation)")
     p.add_argument("--ep-size", type=int, default=1,
                    help="expert-parallel degree for MoE models (composes "
                         "with --tp-size)")
@@ -913,6 +940,9 @@ def main(argv: list[str] | None = None):
                        pp_size=args.pp_size, decode_chunk=args.decode_chunk,
                        prefill_batch=args.prefill_batch,
                        prefill_chunk=args.prefill_chunk,
+                       secure_serving=args.secure_serving,
+                       cert_path=args.cert_path,
+                       enable_cert_reload=args.enable_cert_reload,
                        dist_coordinator=args.dist_coordinator,
                        dist_num_processes=args.dist_num_processes,
                        dist_process_id=args.dist_process_id,

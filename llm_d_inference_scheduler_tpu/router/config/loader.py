@@ -99,6 +99,7 @@ def _endpoint_meta(e: dict[str, Any]) -> EndpointMetadata:
         port=int(e["port"]),
         metrics_port=int(e["metricsPort"]) if e.get("metricsPort") else None,
         labels=e.get("labels") or {},
+        scheme=str(e.get("scheme", "http")),
     )
 
 

@@ -37,7 +37,10 @@ class MetricsDataSource(PluginBase):
 
     async def collect(self, endpoint: Endpoint) -> str | None:
         if self._client is None:
-            self._client = httpx.AsyncClient(timeout=self._timeout)
+            # verify=False: https endpoints present pod-local certs (the
+            # reference scrape client's insecureSkipVerify default).
+            self._client = httpx.AsyncClient(timeout=self._timeout,
+                                             verify=False)
         try:
             r = await self._client.get(endpoint.metadata.metrics_url)
             r.raise_for_status()

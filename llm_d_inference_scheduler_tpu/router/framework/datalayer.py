@@ -26,14 +26,19 @@ class EndpointMetadata:
     namespace: str = "default"
     metrics_port: int | None = None
     labels: dict[str, str] = dataclasses.field(default_factory=dict)
+    # "https" for TLS model servers (engines started with --secure-serving);
+    # router clients skip verification against in-cluster pod-local certs —
+    # the reference scrape client's insecureSkipVerify default.
+    scheme: str = "http"
 
     @property
     def url(self) -> str:
-        return f"http://{self.address}:{self.port}"
+        return f"{self.scheme}://{self.address}:{self.port}"
 
     @property
     def metrics_url(self) -> str:
-        return f"http://{self.address}:{self.metrics_port or self.port}/metrics"
+        return (f"{self.scheme}://{self.address}:"
+                f"{self.metrics_port or self.port}/metrics")
 
     @property
     def address_port(self) -> str:

@@ -196,7 +196,8 @@ class Gateway:
         await self.dl_runtime.start()
         if self.flow_controller is not None:
             await self.flow_controller.start()
-        self._client = httpx.AsyncClient(timeout=httpx.Timeout(300.0, connect=5.0))
+        self._client = httpx.AsyncClient(timeout=httpx.Timeout(300.0, connect=5.0),
+                                         verify=False)  # pod-local certs
         # The proxy hop uses aiohttp's client: its C http parser costs a
         # fraction of httpx/h11 per chunk, and iter_any() coalesces SSE
         # events under load — together worth >30% through-router throughput
@@ -432,7 +433,11 @@ class Gateway:
         model_label = (ireq.target_model if ireq else "") or "unknown"
 
         try:
-            resp = await self._upstream.post(url, data=body, headers=fwd)
+            # ssl=False skips verification on https endpoints (pod-local
+            # certs — TLS engines started with --secure-serving).
+            resp = await self._upstream.post(
+                url, data=body, headers=fwd,
+                ssl=False if url.startswith("https") else None)
         except Exception as e:
             if ireq is not None:
                 self.director.handle_response_complete(None, ireq, endpoint, {})

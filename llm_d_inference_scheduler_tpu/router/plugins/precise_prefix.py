@@ -207,7 +207,8 @@ class PrecisePrefixCacheScorer(PluginBase):
         log.info("kv-event SSE subscriber for %s at %s", pod, url)
         while not stop.is_set():
             try:
-                with httpx.Client(timeout=httpx.Timeout(5.0, read=5.0)) as client:
+                with httpx.Client(timeout=httpx.Timeout(5.0, read=5.0),
+                                  verify=False) as client:  # pod-local certs
                     with client.stream("GET", url) as r:
                         if r.status_code != 200:
                             raise ConnectionError(f"status {r.status_code}")

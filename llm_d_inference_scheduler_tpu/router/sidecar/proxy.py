@@ -401,8 +401,12 @@ class Sidecar:
             return str(e)
         # Tell the downstream engines where to pull the staged embeddings
         # (the EC-connector config of reference engines, here per-request).
+        # Scheme-qualified when the encoder leg is TLS so the decode
+        # engine's /ec pull dials the right protocol.
         body["request_id"] = rid
-        body["ec_sources"] = [h for h, _, _ in primed]
+        body["ec_sources"] = [self._encode_base(h)
+                              if self.cfg.use_tls_for_encoder else h
+                              for h, _, _ in primed]
         return None
 
     async def _run_pd_protocol(self, request: web.Request, body: dict[str, Any],

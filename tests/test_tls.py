@@ -194,6 +194,115 @@ def test_cert_reload(tmp_path):
         ts.close()
 
 
+def test_full_tls_pd_stack_token_parity():
+    """Composed TLS P/D: client → sidecar (HTTPS) → decode engine (TLS)
+    with the 2-phase protocol's prefill leg to a TLS prefill engine —
+    every HTTP leg encrypted, the KV pull riding the (non-HTTP) device
+    transfer wire, tokens equal to a monolithic engine."""
+    from llm_d_inference_scheduler_tpu.router.sidecar.proxy import (
+        Sidecar,
+        SidecarConfig,
+    )
+
+    M, P2, D2, S2 = 18696, 18697, 18698, 18699
+    PROMPT = [1] + [(i * 11) % 400 + 3 for i in range(40)]
+
+    async def body():
+        mono = EngineServer(EngineConfig(backend="tpu", model="tiny",
+                                         port=M, max_batch=4,
+                                         max_model_len=256,
+                                         kv_events_port=0))
+        await mono.start()
+        try:
+            async with httpx.AsyncClient(timeout=60) as c:
+                r = await c.post(f"http://127.0.0.1:{M}/v1/completions",
+                                 json={"prompt": PROMPT, "max_tokens": 6,
+                                       "temperature": 0, "ignore_eos": True})
+                mono_text = r.json()["choices"][0]["text"]
+        finally:
+            await mono.stop()
+
+        pre = EngineServer(EngineConfig(backend="tpu", model="tiny",
+                                        port=P2, role="prefill", max_batch=4,
+                                        max_model_len=256, kv_events_port=0,
+                                        secure_serving=True))
+        dec = EngineServer(EngineConfig(backend="tpu", model="tiny",
+                                        port=D2, role="decode", max_batch=4,
+                                        max_model_len=256, kv_events_port=0,
+                                        secure_serving=True))
+        await pre.start()
+        await dec.start()
+        sc = Sidecar(SidecarConfig(
+            port=S2, decoder_url=f"https://127.0.0.1:{D2}",
+            secure_serving=True,
+            use_tls_for_prefiller=True, insecure_skip_verify_prefiller=True,
+            use_tls_for_decoder=True, insecure_skip_verify_decoder=True))
+        await sc.start()
+        try:
+            async with httpx.AsyncClient(timeout=60, verify=False) as c:
+                r = await c.post(
+                    f"https://127.0.0.1:{S2}/v1/completions",
+                    json={"prompt": PROMPT, "max_tokens": 6,
+                          "temperature": 0, "ignore_eos": True},
+                    headers={"x-prefiller-host-port": f"127.0.0.1:{P2}"})
+                assert r.status_code == 200, r.text
+                assert r.json()["choices"][0]["text"] == mono_text
+            # The KV moved over the device transfer wire, not plaintext HTTP.
+            assert dec.engine.kv_import_device_count == 1
+            assert dec.engine.kv_import_host_count == 0
+        finally:
+            await sc.stop()
+            await dec.stop()
+            await pre.stop()
+
+    asyncio.run(body())
+
+
+def test_gateway_routes_to_tls_engine():
+    """Router side of engine TLS: a pool endpoint declared `scheme: https`
+    is scraped (metrics) and proxied (completions) over TLS with
+    skip-verify — the reference scrape client's insecureSkipVerify
+    default against pod-local certs."""
+    E2, G2 = 18694, 18692
+
+    async def body():
+        eng = EngineServer(EngineConfig(backend="sim", model="tiny", port=E2,
+                                        sim_decode_ms_per_token=1.0,
+                                        secure_serving=True))
+        await eng.start()
+        gw = build_gateway(f"""
+pool:
+  endpoints:
+    - {{address: 127.0.0.1, port: {E2}, scheme: https}}
+plugins:
+  - {{type: queue-scorer}}
+schedulingProfiles:
+  - name: default
+    plugins:
+      - {{pluginRef: queue-scorer}}
+""", port=G2, poll_interval=0.02)
+        await gw.start()
+        try:
+            async with httpx.AsyncClient(timeout=30) as c:
+                r = await c.post(f"http://127.0.0.1:{G2}/v1/completions",
+                                 json={"model": "tiny", "prompt": "hello",
+                                       "max_tokens": 4})
+                assert r.status_code == 200, r.text
+                assert r.json()["choices"][0]["text"]
+            # The metrics collector scraped the https endpoint.
+            ep = gw.datastore.endpoint_list()[0]
+            for _ in range(100):
+                if ep.metrics.fresh:
+                    break
+                await asyncio.sleep(0.05)
+            assert ep.metrics.fresh
+        finally:
+            await gw.stop()
+            await eng.stop()
+
+    asyncio.run(body())
+
+
 def test_sidecar_secure_serving_and_tls_prefill_leg():
     """proxy.go:153-166: the sidecar serves HTTPS and drives the prefill
     leg over TLS (with per-leg skip-verify against the pod-local cert)."""
