@@ -137,7 +137,9 @@ class Sidecar:
             return None
         if (host == parts.hostname and parts.port is not None
                 and parts.port <= port < parts.port + max(self.cfg.data_parallel_size, 1)):
-            return f"{parts.scheme}://{host}:{port}"
+            scheme = ("https" if self.cfg.use_tls_for_decoder
+                      else parts.scheme)
+            return f"{scheme}://{host}:{port}{parts.path.rstrip('/')}"
         log.warning("ignoring out-of-range %s: %s", H_DATA_PARALLEL, hp)
         return None
 
