@@ -5,17 +5,23 @@
 PY ?= python
 
 .PHONY: test test-fast test-unit test-dist bench bench-flowcontrol \
-	bench-router-sse dryrun render-chart compile-check
+	bench-router-sse dryrun render-chart compile-check verify-metrics
 
 # Full hermetic suite (virtual 8-device CPU mesh; no TPU or cluster needed —
 # the reference needs envtest + kind for the equivalent coverage).
-test:
+test: verify-metrics
 	$(PY) -m pytest tests/ -q
 
 # Everything except the spawned-process distributed tests (the slow tail).
-test-fast:
+test-fast: verify-metrics
 	$(PY) -m pytest tests/ -q --deselect tests/test_multihost.py \
 		--deselect tests/test_multihost_pd.py
+
+# Static registry lint: duplicate family names / high-cardinality labels
+# across the router, engine, and sidecar metrics registries
+# (also hooked into pytest via tests/test_observability.py).
+verify-metrics:
+	$(PY) scripts/verify_metrics.py
 
 test-unit: test-fast
 

@@ -226,7 +226,16 @@ async def router_phase(server, engine_cfg, prompt_len: int, gen_tokens: int,
 
     import httpx
 
+    from llm_d_inference_scheduler_tpu.router import tracing
     from llm_d_inference_scheduler_tpu.router.gateway import build_gateway
+
+    # Full-sample tracing for the measured window: the span ring buffer
+    # yields the per-phase breakdown (gateway / orchestration / engine
+    # prefill+decode) so router-vs-engine latency attribution is a captured
+    # number, not an inference. Restored afterwards.
+    trace_prev = (tracing.tracer.enabled, tracing.tracer.sample_ratio)
+    tracing.tracer.enabled, tracing.tracer.sample_ratio = True, 1.0
+    tracing.tracer.finished.clear()
 
     eport, gport = 18461, 18460
     gw = build_gateway(
@@ -319,6 +328,19 @@ pool:
                     "inference_extension_scheduler_e2e_duration_seconds_count"):
                 sched_count = float(line.split()[-1])
 
+        # Per-phase latency attribution from the span ring buffer: mean/p50
+        # duration per span name across the measured window (gateway.request
+        # = full router pass, engine.prefill/engine.decode = engine phases —
+        # all components share the in-process tracer here).
+        by_name: dict[str, list[float]] = {}
+        for s in tracing.tracer.snapshot():
+            by_name.setdefault(s["name"], []).append(float(s["duration_ms"]))
+        span_breakdown = {
+            name: {"n": len(v),
+                   "mean_ms": round(sum(v) / len(v), 2),
+                   "p50_ms": round(statistics.median(v), 2)}
+            for name, v in sorted(by_name.items())}
+
         ok = [r for r in results if r["ttft"] is not None]
         ttfts = sorted(r["ttft"] for r in ok)
         if not ttfts:
@@ -331,10 +353,12 @@ pool:
                 ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.99))] * 1e3, 1),
             "sched_e2e_mean_ms": round(
                 sched_sum / sched_count * 1e3, 3) if sched_count else None,
+            "span_breakdown_ms": span_breakdown,
             "n_requests": n_requests,
             "request_errors": len(errs) + (len(results) - len(ok)),
         }
     finally:
+        tracing.tracer.enabled, tracing.tracer.sample_ratio = trace_prev
         await gw.stop()
 
 

@@ -339,6 +339,10 @@ class TpuEngine:
         # stays as fallback for single-process engines (reference
         # connector_nixlv2.go:109-253 control shape preserved).
         self._jit_stage = None
+        # (op, shape-bucket) keys already dispatched once: the first call of
+        # a novel key is a jit trace+compile — counted as a compile event;
+        # later calls feed the step-duration histograms.
+        self._seen_op_shapes: set[tuple[str, str]] = set()
         self._embed_fns: dict[int, Any] = {}
         self._embed_fns_lock = threading.Lock()
         # Multi-host embeddings: queued by embed() (HTTP executor thread),
@@ -1081,8 +1085,7 @@ class TpuEngine:
                     i, req, out, loop, need, pre, blocks = prepared[0]
                     with self._cond:
                         self.allocator.free(blocks)
-                        self.telemetry.kv_usage.set(
-                            self.allocator.used_fraction)
+                        self.telemetry.observe_allocator(self.allocator)
                     singles.append((i, req, out, loop, need, pre))
                 elif prepared:
                     batches.append((bucket, prepared))
@@ -1105,7 +1108,7 @@ class TpuEngine:
                 for _, prepared in leftover:
                     for *_x, blocks in prepared:
                         self.allocator.free(blocks)
-                self.telemetry.kv_usage.set(self.allocator.used_fraction)
+                self.telemetry.observe_allocator(self.allocator)
             for _, prepared in leftover:
                 for i, req, out, loop, need, pre, blocks in prepared:
                     self._emit_to(out, loop, TokenEvent(
@@ -1156,7 +1159,7 @@ class TpuEngine:
                     return None
             blocks = self.allocator.alloc(need)
             evicted = list(getattr(self.allocator, "last_evicted_hashes", []))
-            self.telemetry.kv_usage.set(self.allocator.used_fraction)
+            self.telemetry.observe_allocator(self.allocator)
         if evicted and self.kv_events is not None:
             self.kv_events.removed(evicted)
         return blocks
@@ -1190,7 +1193,7 @@ class TpuEngine:
             with self._cond:
                 for *_, blocks in entries:
                     self.allocator.free(blocks)
-                self.telemetry.kv_usage.set(self.allocator.used_fraction)
+                self.telemetry.observe_allocator(self.allocator)
             for _, req, out, loop, need, pre, _ in entries:
                 self._emit_to(out, loop, TokenEvent(
                     request_id=req.request_id, token_id=None,
@@ -1198,22 +1201,41 @@ class TpuEngine:
                     prompt_tokens=len(pre[0])))
             raise
         caching = isinstance(self.allocator, PrefixCachingAllocator)
-        for k, (i, req, out, loop, need, pre, blocks) in enumerate(entries):
-            prompt, hashes, _ = pre
-            self.telemetry.prompt_tokens.inc(len(prompt))
-            slot = _Slot(req=req, out=out, loop=loop, blocks=blocks,
-                         position=len(prompt), generated=[], last_token=-1,
-                         cached_tokens=0, pending_tok=tok_dev, pending_idx=k,
-                         prompt_len=len(prompt))
-            n_complete = len(prompt) // block
-            if caching:
+        try:
+            for k, (i, req, out, loop, need, pre, blocks) in enumerate(entries):
+                prompt, hashes, _ = pre
+                self.telemetry.prompt_tokens.inc(len(prompt))
+                slot = _Slot(req=req, out=out, loop=loop, blocks=blocks,
+                             position=len(prompt), generated=[], last_token=-1,
+                             cached_tokens=0, pending_tok=tok_dev, pending_idx=k,
+                             prompt_len=len(prompt))
+                n_complete = len(prompt) // block
+                if caching:
+                    with self._cond:
+                        self.allocator.commit_hashes(blocks[:n_complete],
+                                                     hashes[:n_complete])
+                slot.block_hashes = hashes[:n_complete]
+                if self.kv_events is not None and slot.block_hashes:
+                    self.kv_events.stored(slot.block_hashes)
+                self.slots[i] = slot
+        except BaseException:
+            # Post-dispatch bookkeeping failed (hash commit / event publish):
+            # the dispatch itself landed, but entries not yet slotted would
+            # leak their blocks and strand their clients (ADVICE r5). Clean
+            # up every entry whose slot assignment did not happen.
+            for i, req, out, loop, need, pre, blocks in entries:
+                s = self.slots[i]
+                if s is not None and s.req is req:
+                    continue  # fully slotted before the failure
                 with self._cond:
-                    self.allocator.commit_hashes(blocks[:n_complete],
-                                                 hashes[:n_complete])
-            slot.block_hashes = hashes[:n_complete]
-            if self.kv_events is not None and slot.block_hashes:
-                self.kv_events.stored(slot.block_hashes)
-            self.slots[i] = slot
+                    self.allocator.free(blocks)
+                    self.telemetry.observe_allocator(self.allocator)
+                self._emit_to(out, loop, TokenEvent(
+                    request_id=req.request_id, token_id=None,
+                    finish_reason=FinishReason.ABORT,
+                    prompt_tokens=len(pre[0])))
+            self.telemetry.running.set(sum(s is not None for s in self.slots))
+            raise
         self.telemetry.running.set(sum(s is not None for s in self.slots))
 
     # ---- prefill -------------------------------------------------------
@@ -1272,7 +1294,7 @@ class TpuEngine:
             new_bids = self.allocator.alloc(need - len(matched_bids))
             evicted = list(getattr(self.allocator, "last_evicted_hashes", []))
             blocks = matched_bids + new_bids
-            self.telemetry.kv_usage.set(self.allocator.used_fraction)
+            self.telemetry.observe_allocator(self.allocator)
         if evicted and self.kv_events is not None:
             self.kv_events.removed(evicted)
 
@@ -1304,7 +1326,7 @@ class TpuEngine:
         except Exception:
             with self._cond:
                 self.allocator.free(blocks)
-                self.telemetry.kv_usage.set(self.allocator.used_fraction)
+                self.telemetry.observe_allocator(self.allocator)
             self._emit_to(out, loop, TokenEvent(
                 request_id=req.request_id, token_id=None,
                 finish_reason=FinishReason.ABORT,
@@ -1421,7 +1443,7 @@ class TpuEngine:
                 self.slots[idx] = None
                 with self._cond:
                     self.allocator.free(s.blocks)
-                    self.telemetry.kv_usage.set(self.allocator.used_fraction)
+                    self.telemetry.observe_allocator(self.allocator)
                 self._emit_to(s.out, s.loop, TokenEvent(
                     request_id=req.request_id, token_id=None,
                     finish_reason=FinishReason.ABORT,
@@ -1684,7 +1706,7 @@ class TpuEngine:
                     blocks = self.allocator.alloc(need)
                     evicted = list(getattr(self.allocator,
                                            "last_evicted_hashes", []))
-                    self.telemetry.kv_usage.set(self.allocator.used_fraction)
+                    self.telemetry.observe_allocator(self.allocator)
                 self._import_ready.pop(0)
             if evicted and self.kv_events is not None:
                 self.kv_events.removed(evicted)
@@ -1697,7 +1719,7 @@ class TpuEngine:
                     # the allocation and degrade to local prefill.
                     with self._cond:
                         self.allocator.free(blocks)
-                        self.telemetry.kv_usage.set(self.allocator.used_fraction)
+                        self.telemetry.observe_allocator(self.allocator)
                     pi.error = f"import rejected: {e}"
             # Reference semantics: fall back to local prefill on transfer
             # failure (connector_nixlv2.go:160-177).
@@ -1877,10 +1899,42 @@ class TpuEngine:
     # follower processes (engine/multihost.py) can replay the identical jit
     # sequence. Op args are plain numpy/int — never device arrays.
 
+    @staticmethod
+    def _op_shape_key(op: tuple, args: dict) -> tuple[str, str] | None:
+        """Stable (op, shape-bucket) identity of a dispatch — the same key
+        space the jit caches trace on, so 'first time seen' == 'compiles'.
+        Ops with no per-shape jit variant (release/stage plumbing) are None."""
+        kind = op[0]
+        if kind == "decode":
+            return ("decode", f"{len(args['tokens'])}x{args['tables'].shape[1]}")
+        if kind == "prefill":
+            return ("prefill", f"{args['tokens'].shape[0]}x{op[1]}")
+        if kind == "prefix_prefill":
+            return ("prefix_prefill", f"{op[1]}x{op[2]}")
+        if kind == "mm_prefill":
+            return ("mm_prefill", f"{op[1]}x{op[2]}")
+        if kind == "embed":
+            return ("embed", str(op[1]))
+        return None
+
     def _device_call(self, op: tuple, args: dict):
         if self._instr_channel is not None and self._instr_channel.leader:
             self._instr_channel.broadcast(op, args)
-        return self._exec_op(op, args)
+        key = self._op_shape_key(op, args)
+        if key is None:
+            return self._exec_op(op, args)
+        t0 = time.monotonic()
+        result = self._exec_op(op, args)
+        dt = time.monotonic() - t0
+        if key not in self._seen_op_shapes:
+            self._seen_op_shapes.add(key)
+            self.telemetry.compile_events.labels(op=key[0], bucket=key[1]).inc()
+            self.telemetry.compile_duration.observe(dt)
+        elif key[0] in ("prefill", "prefix_prefill", "mm_prefill"):
+            # Dispatch wall time (the decode chunk's full dispatch→readback
+            # window is measured in _decode_once instead, where the sync is).
+            self.telemetry.prefill_step.observe(dt)
+        return result
 
     def _exec_op(self, op: tuple, args: dict):
         kind = op[0]
@@ -2188,10 +2242,17 @@ class TpuEngine:
 
         reqs = [self.slots[i].req for i in active]
         reqs += [_DUMMY_REQ] * (B - len(reqs))
+        self.telemetry.batch_fill.set(len(active) / max(self.cfg.max_batch, 1))
+        was_compiled = (("decode", f"{B}x{W}") in self._seen_op_shapes)
+        t0 = time.monotonic()
         toks = self._device_call(("decode",), dict(
             tokens=tokens, positions=positions, tables=tables,
             **self._sample_np(reqs)))
         sampled = np.asarray(toks)  # [K, B] — ONE readback per chunk
+        if was_compiled:
+            # Full chunk wall time (dispatch through readback); the first
+            # call per shape goes to the compile histogram instead.
+            self.telemetry.decode_step.observe(time.monotonic() - t0)
 
         for lane, i in enumerate(active):
             for step in range(sampled.shape[0]):
@@ -2300,7 +2361,7 @@ class TpuEngine:
                             self._shard_wire_addresses())
         with self._cond:
             self.allocator.free(s.blocks)
-            self.telemetry.kv_usage.set(self.allocator.used_fraction)
+            self.telemetry.observe_allocator(self.allocator)
             self._cond.notify()  # capacity freed: wake admission
         if (self.kv_events is not None and s.block_hashes
                 and not isinstance(self.allocator, PrefixCachingAllocator)):

@@ -135,6 +135,7 @@ class EngineServer:
             web.post("/v1/encode", self.encode),
             web.get("/ec/{request_id}", self.ec_fetch),
             web.get("/kv_events", self.kv_events_stream),
+            web.get("/debug/traces", self.traces),
         ])
         # E/PD encode store: request_id -> staged encoder output
         # {"embeds": float32 [rows, D], "indices": global item indices}
@@ -213,12 +214,18 @@ class EngineServer:
             # pod-local certs — the sidecar's use-tls-for-encoder leg).
             self._ec_client = httpx.AsyncClient(timeout=10, verify=False)
 
+        from ..router.tracing import tracer
+
+        trace_headers: dict[str, str] = {}
+        tracer.inject_headers(trace_headers)
+
         async def fetch(host):
             # The sidecar scheme-qualifies sources when the encoder leg is
             # TLS; bare host:port stays plain http.
             base = host if "://" in host else f"http://{host}"
             try:
-                r = await self._ec_client.get(f"{base}/ec/{rid}")
+                r = await self._ec_client.get(f"{base}/ec/{rid}",
+                                              headers=trace_headers)
                 r.raise_for_status()
                 return r.json()
             except Exception as e:
@@ -297,14 +304,23 @@ class EngineServer:
             return []
         return [stop] if isinstance(stop, str) else [s for s in stop if isinstance(s, str)]
 
+    @staticmethod
+    def _mark_first_token(timing: dict[str, float] | None, ev) -> None:
+        """Stamp the first token-bearing event's arrival for phase spans."""
+        if timing is not None and ev.token_id is not None \
+                and "first_token_at" not in timing:
+            timing["first_token_at"] = time.monotonic()
+
     async def _collect(self, req: EngineRequest, out: asyncio.Queue,
-                       stop_strings: list[str] | None = None) -> dict[str, Any]:
+                       stop_strings: list[str] | None = None,
+                       timing: dict[str, float] | None = None) -> dict[str, Any]:
         acc = ""
         n_completion, n_prompt = 0, len(req.prompt_token_ids)
         finish = FinishReason.LENGTH
         kv_params = None
         while True:
             ev: TokenEvent = await out.get()
+            self._mark_first_token(timing, ev)
             if ev.token_id is not None:
                 acc += ev.text
                 hit = _first_stop_hit(acc, stop_strings)
@@ -342,7 +358,8 @@ class EngineServer:
 
     async def _stream(self, request: web.Request, req: EngineRequest,
                       out: asyncio.Queue, chat: bool,
-                      stop_strings: list[str] | None = None) -> web.StreamResponse:
+                      stop_strings: list[str] | None = None,
+                      timing: dict[str, float] | None = None) -> web.StreamResponse:
         resp = web.StreamResponse(headers={
             "Content-Type": "text/event-stream",
             "Cache-Control": "no-cache",
@@ -367,6 +384,7 @@ class EngineServer:
         emitted = 0      # prefix of `total` already written to the stream
         while True:
             ev: TokenEvent = await out.get()
+            self._mark_first_token(timing, ev)
             # Coalesce the awaited event with any queued burst: the engine
             # emits decode_chunk tokens per fused dispatch, so under load
             # the queue holds a run of them — one SSE delta (and one write)
@@ -431,39 +449,81 @@ class EngineServer:
 
     # ---- handlers ------------------------------------------------------
 
+    def _request_span(self, request: web.Request):
+        """Engine-side server span, joined to the caller's W3C trace context
+        when the sidecar/gateway propagated one — the engine leg of the
+        gateway→sidecar→engine trace (docs/observability.md)."""
+        from ..router.tracing import tracer
+
+        return tracer.span_from_headers("engine.request", request.headers,
+                                        path=request.path,
+                                        engine_id=self.engine.engine_id)
+
+    @staticmethod
+    def _record_phase_spans(t_submit: float, timing: dict[str, float]) -> None:
+        """Post-hoc prefill/decode phase spans under the live engine.request
+        span: submit→first-token (queue + prefill) and first-token→finish."""
+        from ..router.tracing import tracer
+
+        first = timing.get("first_token_at")
+        if first is None:
+            return
+        done = time.monotonic()
+        tracer.record("engine.prefill", t_submit, first)
+        if done > first:
+            tracer.record("engine.decode", first, done)
+
     async def completions(self, request: web.Request) -> web.StreamResponse:
         body = await _json_body(request)
-        prompt_ids = self._tokenize_prompt(body.get("prompt", ""))
-        prompt_ids, mm, mm_pos = await self._resolve_multimodal(body, prompt_ids)
-        req = self._build_request(body, prompt_ids, mm_embeds=mm,
-                                  mm_positions=mm_pos)
-        stops = self._stop_strings(body)
-        out = self.engine.submit(req)
-        try:
-            if req.stream:
-                return await self._stream(request, req, out, chat=False, stop_strings=stops)
-            return web.json_response(await self._collect(req, out, stops))
-        except (asyncio.CancelledError, ConnectionResetError):
-            self.engine.abort(req.request_id)  # client went away: stop decoding
-            raise
+        with self._request_span(request) as span:
+            prompt_ids = self._tokenize_prompt(body.get("prompt", ""))
+            prompt_ids, mm, mm_pos = await self._resolve_multimodal(body, prompt_ids)
+            req = self._build_request(body, prompt_ids, mm_embeds=mm,
+                                      mm_positions=mm_pos)
+            span.set_attribute("request_id", req.request_id)
+            stops = self._stop_strings(body)
+            timing: dict[str, float] = {}
+            t0 = time.monotonic()
+            out = self.engine.submit(req)
+            try:
+                if req.stream:
+                    resp: web.StreamResponse = await self._stream(
+                        request, req, out, chat=False, stop_strings=stops,
+                        timing=timing)
+                else:
+                    resp = web.json_response(
+                        await self._collect(req, out, stops, timing))
+            except (asyncio.CancelledError, ConnectionResetError):
+                self.engine.abort(req.request_id)  # client went away: stop decoding
+                raise
+            self._record_phase_spans(t0, timing)
+            return resp
 
     async def chat_completions(self, request: web.Request) -> web.StreamResponse:
         body = await _json_body(request)
-        messages = body.get("messages", [])
-        prompt_ids = self.engine.tokenizer.encode(_chat_to_prompt(
-            messages, continue_final_message=bool(body.get("continue_final_message"))))
-        prompt_ids, mm, mm_pos = await self._resolve_multimodal(body, prompt_ids)
-        req = self._build_request(body, prompt_ids, mm_embeds=mm,
-                                  mm_positions=mm_pos)
-        stops = self._stop_strings(body)
-        out = self.engine.submit(req)
-        try:
-            if req.stream:
-                return await self._stream(request, req, out, chat=True, stop_strings=stops)
-            resp = await self._collect(req, out, stops)
-        except (asyncio.CancelledError, ConnectionResetError):
-            self.engine.abort(req.request_id)
-            raise
+        with self._request_span(request) as span:
+            messages = body.get("messages", [])
+            prompt_ids = self.engine.tokenizer.encode(_chat_to_prompt(
+                messages, continue_final_message=bool(body.get("continue_final_message"))))
+            prompt_ids, mm, mm_pos = await self._resolve_multimodal(body, prompt_ids)
+            req = self._build_request(body, prompt_ids, mm_embeds=mm,
+                                      mm_positions=mm_pos)
+            span.set_attribute("request_id", req.request_id)
+            stops = self._stop_strings(body)
+            timing: dict[str, float] = {}
+            t0 = time.monotonic()
+            out = self.engine.submit(req)
+            try:
+                if req.stream:
+                    ws = await self._stream(request, req, out, chat=True,
+                                            stop_strings=stops, timing=timing)
+                    self._record_phase_spans(t0, timing)
+                    return ws
+                resp = await self._collect(req, out, stops, timing)
+            except (asyncio.CancelledError, ConnectionResetError):
+                self.engine.abort(req.request_id)
+                raise
+            self._record_phase_spans(t0, timing)
         resp["object"] = "chat.completion"
         text = resp["choices"][0].pop("text")
         resp["choices"][0]["message"] = {"role": "assistant", "content": text}
@@ -532,20 +592,28 @@ class EngineServer:
         P/D ``kv_transfer_params`` relay, and a Responses-shaped reply with
         input/output token usage."""
         body = await _json_body(request)
-        messages = _responses_input_to_messages(body)
-        prompt_ids = self.engine.tokenizer.encode(_chat_to_prompt(messages))
-        gen_body = dict(body)
-        if body.get("max_output_tokens") is not None:
-            gen_body["max_tokens"] = body["max_output_tokens"]
-        req = self._build_request(gen_body, prompt_ids)
-        out = self.engine.submit(req)
-        try:
-            if req.stream:
-                return await self._stream_responses_api(request, req, out)
-            resp = await self._collect(req, out, [])
-        except (asyncio.CancelledError, ConnectionResetError):
-            self.engine.abort(req.request_id)
-            raise
+        with self._request_span(request) as span:
+            messages = _responses_input_to_messages(body)
+            prompt_ids = self.engine.tokenizer.encode(_chat_to_prompt(messages))
+            gen_body = dict(body)
+            if body.get("max_output_tokens") is not None:
+                gen_body["max_tokens"] = body["max_output_tokens"]
+            req = self._build_request(gen_body, prompt_ids)
+            span.set_attribute("request_id", req.request_id)
+            timing: dict[str, float] = {}
+            t0 = time.monotonic()
+            out = self.engine.submit(req)
+            try:
+                if req.stream:
+                    ws = await self._stream_responses_api(request, req, out,
+                                                          timing=timing)
+                    self._record_phase_spans(t0, timing)
+                    return ws
+                resp = await self._collect(req, out, [], timing)
+            except (asyncio.CancelledError, ConnectionResetError):
+                self.engine.abort(req.request_id)
+                raise
+            self._record_phase_spans(t0, timing)
         usage = resp["usage"]
         finish = resp["choices"][0]["finish_reason"]
         wrapped: dict[str, Any] = {
@@ -577,7 +645,9 @@ class EngineServer:
 
     async def _stream_responses_api(self, request: web.Request,
                                     req: EngineRequest,
-                                    out: asyncio.Queue) -> web.StreamResponse:
+                                    out: asyncio.Queue,
+                                    timing: dict[str, float] | None = None
+                                    ) -> web.StreamResponse:
         """Responses API streaming: semantic SSE events
         (response.output_text.delta … response.completed)."""
         resp = web.StreamResponse(headers={
@@ -588,6 +658,7 @@ class EngineServer:
         n_prompt = len(req.prompt_token_ids)
         while True:
             ev: TokenEvent = await out.get()
+            self._mark_first_token(timing, ev)
             if ev.token_id is not None and ev.text:
                 frame = {"type": "response.output_text.delta",
                          "delta": ev.text}
@@ -633,6 +704,16 @@ class EngineServer:
     async def metrics(self, request: web.Request) -> web.Response:
         return web.Response(body=self.engine.telemetry.render(),
                             content_type="text/plain", charset="utf-8")
+
+    async def traces(self, request: web.Request) -> web.Response:
+        """Engine-local span ring buffer (same Tracer/sink stack as the
+        router); the gateway's /debug/traces?merge=1 pulls and merges these
+        for cross-process trace assembly."""
+        from ..router.tracing import tracer
+
+        return web.json_response({"service": "engine",
+                                  "engine_id": self.engine.engine_id,
+                                  "spans": tracer.snapshot()})
 
     async def health(self, request: web.Request) -> web.Response:
         warming = bool(getattr(self.engine, "warming", False))

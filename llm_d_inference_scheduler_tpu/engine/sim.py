@@ -64,6 +64,9 @@ class SimEngine:
         self.telemetry.running.set(self._running)
         usable = max(self.n_blocks - 1, 1)
         self.telemetry.kv_usage.set(min(self._blocks_used / usable, 1.0))
+        self.telemetry.free_blocks.set(max(usable - self._blocks_used, 0))
+        self.telemetry.batch_fill.set(
+            min(self._running / max(self.cfg.max_batch, 1), 1.0))
 
     def _sweep_exports(self):
         # Decoders can never pull real KV from a sim (kv_fetch is 501), so
@@ -119,6 +122,8 @@ class SimEngine:
             self._update_gauges()
             try:
                 await asyncio.sleep(self.cfg.sim_prefill_ms_per_token * prompt_len / 1000)
+                self.telemetry.prefill_step.observe(
+                    self.cfg.sim_prefill_ms_per_token * prompt_len / 1000)
                 self.telemetry.prompt_tokens.inc(prompt_len)
                 self.telemetry.ttft.observe(time.monotonic() - req.arrival_time)
 
@@ -152,6 +157,8 @@ class SimEngine:
                 for i in range(n):
                     await asyncio.sleep(self.cfg.sim_decode_ms_per_token / 1000)
                     tok = self._gen_tokens[i % len(self._gen_tokens)]
+                    self.telemetry.decode_step.observe(
+                        self.cfg.sim_decode_ms_per_token / 1000)
                     self.telemetry.generation_tokens.inc()
                     out.put_nowait(TokenEvent(
                         request_id=req.request_id, token_id=tok,

@@ -34,6 +34,36 @@ class EngineTelemetry:
         self.cache_config.labels(block_size=str(block_size), num_gpu_blocks=str(num_blocks)).set(1)
         self.lora_info.labels(running_lora_adapters="", waiting_lora_adapters="", max_lora="0").set(1)
 
+        # Step-level instrumentation beyond the five-signal contract: block
+        # occupancy, batch fill, per-dispatch step timing, and compile events
+        # — the engine half of the cross-component latency attribution story
+        # (router scrapes these via the jetstream mapping; docs/observability.md).
+        self.free_blocks = g("jetstream:num_free_kv_blocks",
+                             "KV blocks immediately allocatable (free list)")
+        self.cached_blocks = g("jetstream:num_cached_kv_blocks",
+                               "Parked reusable prefix-cache KV blocks")
+        self.batch_fill = g("jetstream:batch_fill_ratio",
+                            "Active decode lanes / max_batch last step")
+        self.prefill_step = Histogram(
+            "jetstream:prefill_step_duration_seconds",
+            "Wall time of one prefill dispatch (post-compile)",
+            registry=self.registry,
+            buckets=(.001, .0025, .005, .01, .025, .05, .1, .25, .5, 1, 2.5))
+        self.decode_step = Histogram(
+            "jetstream:decode_step_duration_seconds",
+            "Wall time of one fused decode chunk (dispatch through readback)",
+            registry=self.registry,
+            buckets=(.002, .005, .01, .025, .05, .1, .25, .5, 1, 2.5))
+        self.compile_events = Counter(
+            "jetstream:compile_events_total",
+            "First dispatch of a novel (op, shape-bucket) — a jit compile",
+            ("op", "bucket"), registry=self.registry)
+        self.compile_duration = Histogram(
+            "jetstream:compile_duration_seconds",
+            "Wall time of first-dispatch (trace + compile + run) per bucket",
+            registry=self.registry,
+            buckets=(.05, .1, .25, .5, 1, 2.5, 5, 10, 30, 60, 120))
+
         self.prompt_tokens = Counter("jetstream:prompt_tokens_total", "Prefilled tokens",
                                      registry=self.registry)
         self.prefix_cached_tokens = Counter(
@@ -46,6 +76,14 @@ class EngineTelemetry:
                               buckets=(.005, .01, .025, .05, .1, .25, .5, 1, 2.5, 5, 10))
         self.request_success = Counter("jetstream:request_success_total", "Finished requests",
                                        ("finished_reason",), registry=self.registry)
+
+    def observe_allocator(self, allocator) -> None:
+        """One-call snapshot of the allocator's occupancy gauges — used at
+        every alloc/free site so usage, free-list depth, and parked cache
+        size can never drift apart."""
+        self.kv_usage.set(allocator.used_fraction)
+        self.free_blocks.set(allocator.free_blocks)
+        self.cached_blocks.set(getattr(allocator, "cached_block_count", 0))
 
     def render(self) -> bytes:
         return generate_latest(self.registry)

@@ -36,6 +36,9 @@ class MetricMapping:
     kv_usage: MetricSpec
     lora_info: MetricSpec | None = None
     cache_config: MetricSpec | None = None
+    # Free-block depth (engine telemetry beyond the five-signal contract);
+    # engines without the family simply leave Metrics.free_kv_blocks at -1.
+    free_blocks: MetricSpec | None = None
 
 
 JETSTREAM_MAPPING = MetricMapping(
@@ -44,6 +47,7 @@ JETSTREAM_MAPPING = MetricMapping(
     kv_usage=MetricSpec("jetstream:kv_cache_usage_perc"),
     lora_info=MetricSpec("jetstream:lora_requests_info"),
     cache_config=MetricSpec("jetstream:cache_config_info"),
+    free_blocks=MetricSpec("jetstream:num_free_kv_blocks"),
 )
 
 VLLM_MAPPING = MetricMapping(
@@ -135,6 +139,10 @@ class CoreMetricsExtractor(PluginBase):
                     m.max_active_models = int(labels.get("max_lora", "0"))
                 except ValueError:
                     pass
+        if mapping.free_blocks:
+            v, _ = _sample_value(families, mapping.free_blocks)
+            if v is not None:
+                m.free_kv_blocks = int(v)
         if mapping.cache_config:
             v, labels = _sample_value(families, mapping.cache_config)
             if v is not None and labels:

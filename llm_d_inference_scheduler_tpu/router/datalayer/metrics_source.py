@@ -7,12 +7,14 @@ core-metrics-extractor — SURVEY §2.5.
 from __future__ import annotations
 
 import logging
+import time
 from typing import Any
 
 import httpx
 
 from ..framework.datalayer import Endpoint
 from ..framework.plugin import PluginBase
+from ..metrics import SCRAPE_DURATION_SECONDS, SCRAPE_ERRORS_TOTAL
 
 log = logging.getLogger("router.datalayer.metrics")
 
@@ -41,11 +43,14 @@ class MetricsDataSource(PluginBase):
             # reference scrape client's insecureSkipVerify default).
             self._client = httpx.AsyncClient(timeout=self._timeout,
                                              verify=False)
+        t0 = time.monotonic()
         try:
             r = await self._client.get(endpoint.metadata.metrics_url)
             r.raise_for_status()
+            SCRAPE_DURATION_SECONDS.observe(time.monotonic() - t0)
             return r.text
         except Exception as e:
+            SCRAPE_ERRORS_TOTAL.labels(endpoint.metadata.address_port).inc()
             log.debug("scrape failed for %s: %s", endpoint.metadata.address_port, e)
             return None
 

@@ -63,6 +63,9 @@ class Metrics:
     kv_cache_max_token_capacity: int = 0
     cache_block_size: int = 0
     cache_num_blocks: int = 0
+    # Engine free-list depth (jetstream:num_free_kv_blocks); -1 = unknown
+    # (engine doesn't publish the family / not yet scraped).
+    free_kv_blocks: int = -1
     update_time: float = 0.0
 
     def clone(self) -> "Metrics":

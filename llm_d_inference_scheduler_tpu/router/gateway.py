@@ -272,9 +272,31 @@ class Gateway:
     # ---- handlers ---------------------------------------------------------
 
     async def traces(self, request: web.Request) -> web.Response:
+        """Finished-span ring buffer. With ?merge=1, fan out to every pool
+        endpoint's /debug/traces and merge (dedup by span_id), so one call
+        assembles cross-process gateway→sidecar→engine trace trees — the
+        parent links survive because every hop propagates traceparent."""
         from .tracing import tracer
 
-        return web.json_response({"spans": tracer.snapshot()})
+        spans = list(tracer.snapshot())
+        if request.query.get("merge") not in (None, "", "0"):
+            seen = {s["span_id"] for s in spans}
+
+            async def fetch(ep):
+                try:
+                    r = await self._client.get(
+                        ep.metadata.url + "/debug/traces", timeout=2.0)
+                    return (r.json().get("spans") or []) if r.status_code == 200 else []
+                except Exception:
+                    return []
+
+            for remote in await asyncio.gather(
+                    *[fetch(ep) for ep in self.datastore.endpoint_list()]):
+                for s in remote:
+                    if isinstance(s, dict) and s.get("span_id") not in seen:
+                        seen.add(s.get("span_id"))
+                        spans.append(s)
+        return web.json_response({"spans": spans})
 
     async def profile(self, request: web.Request) -> web.Response:
         """CPU profile of the router process for ?seconds=N (pprof analogue;
@@ -313,7 +335,10 @@ class Gateway:
 
         self._inflight += 1
         try:
-            with tracer.span("gateway.request", path=request.path) as span:
+            # Joins the client's W3C trace context when a traceparent header
+            # arrives; otherwise roots a fresh trace (sampling applies).
+            with tracer.span_from_headers("gateway.request", request.headers,
+                                          path=request.path) as span:
                 resp = await self._handle_inference(request, span)
                 span.set_attribute("status", resp.status)
                 return resp
@@ -430,6 +455,12 @@ class Gateway:
         url = (url_override or endpoint.metadata.url) + request.path
         fwd = {k: v for k, v in headers.items() if k in FORWARD_HEADERS}
         fwd["content-type"] = "application/json"
+        # Propagate the trace context downstream (sidecar/engine join it):
+        # the gateway.request span is current here, so it becomes the parent
+        # of the next hop's server span.
+        from .tracing import tracer
+
+        tracer.inject_headers(fwd)
         model_label = (ireq.target_model if ireq else "") or "unknown"
 
         try:
@@ -483,12 +514,12 @@ class Gateway:
                             TTFT_SECONDS.labels(model_label).observe(first_byte_at - t_start)
                     if stream_hook is not None:
                         stream_hook(None, ireq, endpoint, chunk)
-                    # Usage rides the FINAL SSE event: keep a bounded tail and
-                    # scan once at stream end. Per-chunk scanning both cost the
-                    # hot path and missed events split across transport chunks
-                    # (ADVICE r4).
-                    sse_tail = (sse_tail + chunk)[-_USAGE_TAIL:] \
-                        if sse_tail else chunk[-_USAGE_TAIL:]
+                    # Usage rides the FINAL SSE event: keep a bounded tail of
+                    # COMPLETE events and scan once at stream end. Trimming on
+                    # event boundaries (not a fixed byte window) means a large
+                    # terminal usage-bearing event survives intact instead of
+                    # being silently truncated to {}.
+                    sse_tail = _sse_tail_append(sse_tail, chunk)
                     await ws.write(chunk)
                 usage = _usage_from_sse(sse_tail) or {}
                 await ws.write_eof()
@@ -644,10 +675,37 @@ def _sse_scan_for_token(carry: bytes, chunk: bytes) -> tuple[bool, bytes]:
     return False, carry
 
 
-# Rolling-tail size for end-of-stream usage extraction: the terminal usage
+# Rolling-tail target for end-of-stream usage extraction: the terminal usage
 # event plus the [DONE] line are a few hundred bytes; 4 KiB leaves wide
-# margin without per-chunk memory growth.
+# margin without per-chunk memory growth. Trimming respects event boundaries,
+# so one oversized trailing event may exceed the target (bounded by the hard
+# cap — a tail that big with no event boundary is not a sane SSE stream).
 _USAGE_TAIL = 4096
+_USAGE_TAIL_HARD = 1 << 20
+
+
+def _sse_tail_append(tail: bytes, chunk: bytes) -> bytes:
+    """Append a transport chunk to the rolling SSE tail, trimming whole
+    events from the front. The tail always starts at an event boundary (or
+    the stream start), so the final usage-bearing event is never cut mid-
+    event no matter how large it is, up to the 1 MiB fail-safe."""
+    tail += chunk
+    if len(tail) <= _USAGE_TAIL:
+        return tail
+    # Resume at the start of the event CONTAINING the window edge: whole
+    # events ahead of it drop, but an event straddling (or overflowing) the
+    # window is kept from its own start — never cut mid-event. SSE permits
+    # LF or CRLF event terminators; honor both.
+    edge = len(tail) - _USAGE_TAIL
+    lf = tail.rfind(b"\n\n", 0, edge)
+    crlf = tail.rfind(b"\r\n\r\n", 0, edge)
+    start = max(lf + 2 if lf != -1 else 0,
+                crlf + 4 if crlf != -1 else 0)
+    if start:
+        tail = tail[start:]
+    if len(tail) > _USAGE_TAIL_HARD:
+        tail = tail[-_USAGE_TAIL_HARD:]
+    return tail
 
 
 def _usage_from_sse(tail: bytes) -> dict[str, int] | None:

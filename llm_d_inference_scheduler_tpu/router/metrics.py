@@ -78,3 +78,12 @@ SLO_VIOLATION_TOTAL = Counter(
     "inference_extension_slo_violation_total",
     "Completed requests whose observed latency violated the request SLO",
     ("kind",), registry=REGISTRY)
+# Metrics-data-source scrape health: per-endpoint failure counts and the
+# scrape latency distribution (label cardinality bounded by pool size).
+SCRAPE_ERRORS_TOTAL = Counter(
+    "inference_extension_metrics_scrape_errors_total",
+    "Failed engine /metrics scrapes", ("target",), registry=REGISTRY)
+SCRAPE_DURATION_SECONDS = Histogram(
+    "inference_extension_metrics_scrape_duration_seconds",
+    "Engine /metrics scrape latency", registry=REGISTRY,
+    buckets=(.001, .005, .01, .025, .05, .1, .25, .5, 1, 2))

@@ -159,9 +159,93 @@ class OtlpHttpExporter:
         self._thread.join(timeout=5)
 
 
+def span_to_otlp_json(span: dict[str, Any], service_name: str) -> dict[str, Any]:
+    """One finished span dict → the OTLP/JSON ExportTraceServiceRequest
+    mapping (camelCase keys, hex ids, stringified u64 nanos — the encoding
+    OTel collectors' file receivers and `otlp/json` ingest accept). Shared
+    by every component's file sink so router and engine spans land in one
+    uniform, collector-loadable stream."""
+    start_ns = int(span.get("start_unix_ns") or time.time_ns())
+    end_ns = start_ns + int(span.get("duration_ms", 0.0) * 1e6)
+
+    def attr_value(v: Any) -> dict[str, Any]:
+        if isinstance(v, bool):
+            return {"boolValue": v}
+        if isinstance(v, int):
+            return {"intValue": str(v)}
+        if isinstance(v, float):
+            return {"doubleValue": v}
+        return {"stringValue": str(v)}
+
+    status = span.get("status", "ok")
+    doc: dict[str, Any] = {
+        "traceId": span["trace_id"][:32].rjust(32, "0"),
+        "spanId": span["span_id"][:16].rjust(16, "0"),
+        "name": span["name"],
+        "kind": 2,  # SPAN_KIND_SERVER
+        "startTimeUnixNano": str(start_ns),
+        "endTimeUnixNano": str(end_ns),
+        "attributes": [{"key": k, "value": attr_value(v)}
+                       for k, v in (span.get("attributes") or {}).items()],
+        "status": ({"code": 1} if status == "ok"
+                   else {"code": 2, "message": status}),
+    }
+    if span.get("parent_id"):
+        doc["parentSpanId"] = span["parent_id"][:16].rjust(16, "0")
+    return {"resourceSpans": [{
+        "resource": {"attributes": [{"key": "service.name",
+                                     "value": {"stringValue": service_name}}]},
+        "scopeSpans": [{"spans": [doc]}],
+    }]}
+
+
+class OtlpFileExporter:
+    """JSONL file sink: one OTLP/JSON ExportTraceServiceRequest per finished
+    span — genuine OTLP-shaped export in a zero-egress environment (any log
+    shipper or `otelcol` file receiver can replay it). One append handle is
+    held open for the exporter's lifetime: exporters run synchronously at
+    span finish (often on the event loop), so per-span open/close churn is
+    the part of the I/O cost worth avoiding."""
+
+    def __init__(self, path: str, service_name: str = "llm-d-router-tpu"):
+        self.path = path
+        self.service_name = service_name
+        self._f = open(path, "a")
+
+    def export(self, span: dict[str, Any]) -> None:
+        import json
+
+        self._f.write(json.dumps(span_to_otlp_json(span, self.service_name))
+                      + "\n")
+        self._f.flush()
+
+    def shutdown(self) -> None:
+        self._f.close()
+
+
 def maybe_start_otlp_exporter() -> OtlpHttpExporter | None:
     endpoint = os.environ.get("OTEL_EXPORTER_OTLP_ENDPOINT", "")
     if not endpoint:
         return None
     name = os.environ.get("OTEL_SERVICE_NAME", "llm-d-router-tpu")
     return OtlpHttpExporter(endpoint, name)
+
+
+def env_exporters() -> list[Any]:
+    """All env-gated OTLP-shaped sinks, for the Tracer to register at
+    construction. Zero-egress default: with neither env var set the ring
+    buffer stays the only sink.
+
+    - OTEL_EXPORTER_OTLP_ENDPOINT → batching OTLP/HTTP POST (protobuf)
+    - OTEL_EXPORTER_OTLP_TRACES_FILE → OTLP/JSON JSONL file
+    Both honor OTEL_SERVICE_NAME, so router and engine processes tag their
+    spans distinctly while sharing one encoder and (optionally) one file."""
+    out: list[Any] = []
+    name = os.environ.get("OTEL_SERVICE_NAME", "llm-d-router-tpu")
+    path = os.environ.get("OTEL_EXPORTER_OTLP_TRACES_FILE", "")
+    if path:
+        out.append(OtlpFileExporter(path, name))
+    http = maybe_start_otlp_exporter()
+    if http is not None:
+        out.append(http)
+    return out

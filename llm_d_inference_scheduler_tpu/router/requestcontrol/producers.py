@@ -187,8 +187,13 @@ class TokenProducer(PluginBase):
                 else endpoints[0].metadata.url)
         payload = (request.body.chat_completions if chat
                    else request.body.completions) or {}
+        from ..tracing import tracer
+
+        trace_headers: dict[str, str] = {}
+        tracer.inject_headers(trace_headers)
         try:
-            r = await self._client.post(base + path, json=payload)
+            r = await self._client.post(base + path, json=payload,
+                                        headers=trace_headers)
             r.raise_for_status()
             ids = r.json().get("token_ids")
         except Exception:

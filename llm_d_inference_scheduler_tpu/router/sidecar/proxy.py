@@ -83,6 +83,8 @@ class Sidecar:
     def __init__(self, cfg: SidecarConfig, *, dp_rank: int = 0):
         import random
 
+        from prometheus_client import CollectorRegistry, Gauge
+
         self.cfg = cfg
         self.dp_rank = dp_rank
         # Injectable for tests (reference prefillSamplerFn).
@@ -94,8 +96,9 @@ class Sidecar:
             # passthrough to the local engine (the reference proxies
             # non-generate OpenAI surfaces the same way).
             web.post("/v1/embeddings", self._proxy_post),
-            web.get("/metrics", self._proxy_get),
-            web.get("/health", self._proxy_get),
+            web.get("/metrics", self._metrics),
+            web.get("/health", self._health),
+            web.get("/debug/traces", self._traces),
             web.get("/v1/models", self._proxy_get),
             # Streaming: the precise-prefix scorer's SSE subscriber must work
             # against sidecar-fronted decode endpoints too (ADVICE r1).
@@ -108,8 +111,20 @@ class Sidecar:
         self._tls = None          # TlsServing; rank 0 owns, children borrow
         self._tls_owned = False
         self._inflight = 0        # live generate requests (SIGTERM drain)
+        self.draining = False     # SIGTERM: health 503s, new work refused
         self._dp_children: list["Sidecar"] = []
         self._bg_tasks: set = set()  # strong refs for fire-and-forget legs
+        # Sidecar-local metric families, appended to the proxied engine
+        # scrape so the drain (and relay load) is observable per pod.
+        self.metrics_registry = CollectorRegistry()
+        self._g_draining = Gauge(
+            "sidecar_draining",
+            "1 while this sidecar is draining after SIGTERM",
+            registry=self.metrics_registry)
+        self._g_inflight = Gauge(
+            "sidecar_inflight_requests",
+            "Generate requests currently relayed by this sidecar",
+            registry=self.metrics_registry)
 
     # ---- per-leg TLS (reference proxy.go:153-166) -----------------------
 
@@ -181,7 +196,8 @@ class Sidecar:
             self._tls_owned = True
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, self.cfg.host, self.cfg.port + self.dp_rank,
+        site = web.TCPSite(self._runner, self.cfg.host,
+                           self.cfg.port + self.dp_rank,
                            ssl_context=self._tls.ssl_context
                            if self._tls else None)
         await site.start()
@@ -196,6 +212,19 @@ class Sidecar:
                 await child.start()
                 self._dp_children.append(child)
 
+    async def begin_drain(self):
+        """SIGTERM step 1: stop ACCEPTING WORK before waiting out in-flight
+        requests — readiness flips 503 (the LB/router pulls this replica)
+        and new generate arrivals get an immediate retryable 503 instead of
+        being reset at the end of the grace window. The listener itself
+        stays up through the window so /health and /metrics (including the
+        sidecar_draining gauge) stay observable from fresh connections;
+        stop() closes it after the drain."""
+        self.draining = True
+        self._g_draining.set(1)
+        for child in self._dp_children:
+            await child.begin_drain()
+
     async def stop(self):
         for child in self._dp_children:
             await child.stop()
@@ -208,14 +237,43 @@ class Sidecar:
         if self._tls is not None and self._tls_owned:
             self._tls.close()
 
+    @staticmethod
+    def _trace_headers(extra: dict[str, str] | None = None) -> dict[str, str]:
+        """Outbound headers carrying the current span's W3C trace context
+        (empty when no span is live — tracing off or sampled out)."""
+        from ..tracing import tracer
+
+        h = dict(extra or {})
+        tracer.inject_headers(h)
+        return h
+
     # ---- request handling ------------------------------------------------
 
     async def handle_generate(self, request: web.Request) -> web.StreamResponse:
+        from ..tracing import tracer
+
+        if self.draining:
+            # Clean retryable rejection: the router resubmits elsewhere; a
+            # request accepted now could be cut off mid-stream at teardown.
+            return web.json_response(
+                {"error": "sidecar draining"}, status=503,
+                headers={"x-removal-reason": "sidecar-draining"})
         self._inflight += 1
+        self._g_inflight.set(self._inflight)
         try:
-            return await self._handle_generate(request)
+            # Joins the gateway's trace via the propagated traceparent; the
+            # connector-protocol spans nest under this server span, and the
+            # decode/prefill legs re-propagate the context to the engines.
+            with tracer.span_from_headers("sidecar.request", request.headers,
+                                          path=request.path,
+                                          connector=self.cfg.connector,
+                                          dp_rank=self.dp_rank) as span:
+                resp = await self._handle_generate(request)
+                span.set_attribute("status", resp.status)
+                return resp
         finally:
             self._inflight -= 1
+            self._g_inflight.set(self._inflight)
 
     async def _handle_generate(self, request: web.Request) -> web.StreamResponse:
         raw = await request.read()
@@ -289,6 +347,9 @@ class Sidecar:
 
         with tracer.span("sidecar.sglang_protocol", prefiller=prefiller,
                          room=boot["bootstrap_room"]) as span:
+            # Snapshot the trace context NOW: the leg may outlive this span.
+            leg_headers = self._trace_headers()
+
             async def prefill_leg():
                 # Fire-and-forget with its own lifetime: the decode response
                 # finishing first must not cancel the prefill leg
@@ -296,7 +357,8 @@ class Sidecar:
                 try:
                     r = await self._prefill_client.post(
                         self._prefill_base(prefiller) + request.path,
-                        json=boot, timeout=self.cfg.prefill_timeout_s)
+                        json=boot, headers=leg_headers,
+                        timeout=self.cfg.prefill_timeout_s)
                     if r.status_code >= 300:
                         log.warning("sglang prefill at %s returned %d",
                                     prefiller, r.status_code)
@@ -335,7 +397,8 @@ class Sidecar:
             warm = False
             try:
                 r = await self._client.post(self._rank_url() + request.path,
-                                            json=probe_body)
+                                            json=probe_body,
+                                            headers=self._trace_headers())
                 if r.status_code == 200:
                     doc = r.json()
                     if doc.get("object") == "response":
@@ -390,11 +453,13 @@ class Sidecar:
 
             primed = [(h, share, idxs) for h, share, idxs
                       in zip(hosts, shares, share_indices) if share]
+            trace_headers = self._trace_headers()
             results = await _aio.gather(*[
                 self._encode_client.post(self._encode_base(h) + "/v1/encode",
                                          json={"request_id": rid,
                                                "items": share,
-                                               "item_indices": idxs})
+                                               "item_indices": idxs},
+                                         headers=trace_headers)
                 for h, share, idxs in primed])
             for r in results:
                 if r.status_code != 200:
@@ -442,7 +507,8 @@ class Sidecar:
         try:
             r = await self._prefill_client.post(
                 self._prefill_base(prefiller) + request.path,
-                json=prefill_body, timeout=self.cfg.prefill_timeout_s)
+                json=prefill_body, headers=self._trace_headers(),
+                timeout=self.cfg.prefill_timeout_s)
             if r.status_code == 200:
                 ktp = r.json().get("kv_transfer_params")
             else:
@@ -476,7 +542,8 @@ class Sidecar:
         url = base_url + request.path
         try:
             upstream = self._client.build_request(
-                "POST", url, json=body, headers={"content-type": "application/json"})
+                "POST", url, json=body, headers=self._trace_headers(
+                    {"content-type": "application/json"}))
             resp = await self._client.send(upstream, stream=True)
         except Exception as e:
             return web.json_response({"error": f"decode dispatch failed: {e}"},
@@ -523,7 +590,8 @@ class Sidecar:
             else:
                 step_body["prompt"] = body["prompt"] + acc_text
             r = await self._client.post(
-                (base_url or self._rank_url()) + request.path, json=step_body)
+                (base_url or self._rank_url()) + request.path, json=step_body,
+                headers=self._trace_headers())
             if r.status_code != 200:
                 return web.Response(body=r.content, status=r.status_code,
                                     content_type="application/json")
@@ -569,6 +637,58 @@ class Sidecar:
                                                            "text/plain").split(";")[0])
         except Exception as e:
             return web.json_response({"error": str(e)}, status=502)
+
+    async def _health(self, request: web.Request) -> web.Response:
+        """Readiness couples to the drain state: a draining sidecar reports
+        503 immediately (the LB/router stops routing here) instead of
+        relaying the engine's still-green health."""
+        if self.draining:
+            return web.json_response({"status": "draining"}, status=503)
+        return await self._proxy_get(request)
+
+    async def _traces(self, request: web.Request) -> web.Response:
+        """Sidecar span ring buffer + the decode engine's, merged (dedup by
+        span_id). The gateway's /debug/traces?merge=1 only sees POOL
+        endpoints — in a P/D topology that's this sidecar, so it must relay
+        the engine's spans or the engine leg of every trace is invisible."""
+        from ..tracing import tracer
+
+        spans = list(tracer.snapshot())
+        seen = {s["span_id"] for s in spans}
+        try:
+            r = await self._client.get(self._rank_url() + "/debug/traces",
+                                       timeout=2.0)
+            remote = (r.json().get("spans") or []) if r.status_code == 200 else []
+        except Exception:
+            remote = []
+        for s in remote:
+            if isinstance(s, dict) and s.get("span_id") not in seen:
+                seen.add(s.get("span_id"))
+                spans.append(s)
+        return web.json_response({"service": "sidecar", "spans": spans})
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        """Engine scrape relay + sidecar-local families (sidecar_draining,
+        sidecar_inflight_requests) appended, so one scrape covers both. An
+        unreachable engine still yields the sidecar families — the drain
+        gauge must stay observable through teardown."""
+        from prometheus_client import generate_latest
+
+        own = generate_latest(self.metrics_registry)
+        try:
+            r = await self._client.get(self._rank_url() + "/metrics")
+            if r.status_code == 200:
+                body = r.content + own
+            else:
+                # A non-2xx relay would make Prometheus discard the whole
+                # body, losing the sidecar families too — degrade to a
+                # comment + own families instead.
+                body = (f"# engine /metrics returned {r.status_code}\n"
+                        .encode()) + own
+        except Exception as e:
+            body = (f"# engine scrape failed: {e}\n".encode()) + own
+        return web.Response(body=body, status=200,
+                            content_type="text/plain", charset="utf-8")
 
     async def _proxy_get_stream(self, request: web.Request) -> web.StreamResponse:
         """Long-lived streaming GET proxy (SSE /kv_events): bytes are relayed
@@ -666,8 +786,12 @@ def main(argv: list[str] | None = None):
                 pass
         try:
             await stop_ev.wait()
-            # Drain: in-flight P/D protocols finish (each leg has its own
-            # timeout), bounded; new requests race the listener teardown.
+            # Drain: flip readiness + reject new generate work FIRST (clean
+            # retryable 503s instead of resets at teardown), then let
+            # in-flight P/D protocols finish (each leg has its own timeout),
+            # bounded. The sidecar_draining gauge marks the window; /health
+            # and /metrics stay reachable until stop().
+            await sc.begin_drain()
             deadline = loop.time() + 30.0
             inflight = lambda: sc._inflight + sum(  # noqa: E731
                 ch._inflight for ch in sc._dp_children)

@@ -30,10 +30,51 @@ log = logging.getLogger("router.tracing")
 _current_span: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
     "current_span", default=None)
 
+# W3C Trace Context wire headers (https://www.w3.org/TR/trace-context/):
+# traceparent = version "-" trace-id "-" parent-id "-" trace-flags.
+TRACEPARENT = "traceparent"
+TRACESTATE = "tracestate"
+
+
+def format_traceparent(span: "Span") -> str:
+    """W3C traceparent for ``span`` as the parent of the next hop."""
+    return (f"00-{span.trace_id[:32].rjust(32, '0')}"
+            f"-{span.span_id[:16].rjust(16, '0')}-01")
+
+
+_HEX = set("0123456789abcdef")
+
+
+def _is_hex(s: str) -> bool:
+    # Strict per-char check: int(x, 16) also accepts '+', '-', and '_'
+    # separators, which are invalid on the wire.
+    return bool(s) and all(c in _HEX for c in s)
+
+
+def parse_traceparent(value: str) -> tuple[str, str, bool] | None:
+    """Validate a traceparent header → (trace_id, parent_span_id, sampled),
+    or None for anything malformed (bad field widths, non-hex, all-zero ids,
+    extra fields under version 00, the forbidden version ff)."""
+    parts = value.strip().lower().split("-")
+    if len(parts) < 4:
+        return None
+    version, trace_id, span_id, flags = parts[0], parts[1], parts[2], parts[3]
+    if (len(version) != 2 or version == "ff"
+            or len(trace_id) != 32 or len(span_id) != 16 or len(flags) < 2):
+        return None
+    if not (_is_hex(version) and _is_hex(trace_id) and _is_hex(span_id)
+            and _is_hex(flags[:2])):
+        return None
+    if version == "00" and len(parts) != 4:
+        return None  # version 00 defines exactly four fields
+    if trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    return trace_id, span_id, bool(int(flags[:2], 16) & 0x01)
+
 
 class Span:
     __slots__ = ("name", "trace_id", "span_id", "parent_id", "start", "end",
-                 "start_unix_ns", "attributes", "status")
+                 "start_unix_ns", "attributes", "status", "tracestate")
 
     def __init__(self, name: str, trace_id: str, parent_id: str | None):
         self.name = name
@@ -45,6 +86,7 @@ class Span:
         self.end: float | None = None
         self.attributes: dict[str, Any] = {}
         self.status = "ok"
+        self.tracestate: str | None = None   # W3C tracestate, passed through
 
     def set_attribute(self, key: str, value: Any) -> None:
         self.attributes[key] = value
@@ -72,32 +114,45 @@ class Tracer:
         self.finished: deque[dict[str, Any]] = deque(maxlen=capacity)
         self._rng = random.Random()
         # Exporter slot (the OTLP analogue): callbacks receive each finished
-        # span dict. TRACING_EXPORT_PATH wires the built-in JSONL file
-        # exporter (OTLP-shaped records, collectable by any log shipper —
-        # genuine export in a zero-egress environment).
+        # span dict. TRACING_EXPORT_PATH wires the built-in raw-JSONL file
+        # exporter; the OTLP-shaped sinks (OTEL_EXPORTER_OTLP_ENDPOINT →
+        # HTTP, OTEL_EXPORTER_OTLP_TRACES_FILE → OTLP/JSON file) come from
+        # otlp.env_exporters() so router and engine share one encoder
+        # (reference: telemetry/tracing.go:52-129 env-configured exporter).
         self._exporters: list[Any] = []
         export_path = os.environ.get("TRACING_EXPORT_PATH", "")
         if export_path:
             self.add_exporter(FileSpanExporter(export_path))
-        # OTLP/HTTP export via OTEL_EXPORTER_OTLP_ENDPOINT (reference:
-        # telemetry/tracing.go:52-129 env-configured OTLP exporter).
-        from .otlp import maybe_start_otlp_exporter
+        from .otlp import env_exporters
 
-        otlp = maybe_start_otlp_exporter()
-        if otlp is not None:
-            self.add_exporter(otlp)
+        for exp in env_exporters():
+            self.add_exporter(exp)
 
     def add_exporter(self, exporter: Any) -> None:
         """exporter(span_dict) or an object with .export(span_dict)."""
         self._exporters.append(getattr(exporter, "export", exporter))
 
     @contextlib.contextmanager
-    def span(self, name: str, **attributes):
+    def span(self, name: str, *, remote_parent: tuple[str, str, bool] | None = None,
+             tracestate: str | None = None, **attributes):
+        """Open a span. ``remote_parent`` is an upstream W3C context
+        ``(trace_id, parent_span_id, sampled)`` extracted from headers: the
+        caller's sampling decision is honored (sampled=False drops the whole
+        local subtree; sampled=True records without re-rolling the dice)."""
         parent = _current_span.get()
         if not self.enabled or parent is _DROPPED:
             yield _NoopSpan()
             return
-        if parent is None and self._rng.random() > self.sample_ratio:
+        if parent is None and remote_parent is not None and not remote_parent[2]:
+            # Upstream sampled this trace out: propagate the drop.
+            token = _current_span.set(_DROPPED)
+            try:
+                yield _NoopSpan()
+            finally:
+                _current_span.reset(token)
+            return
+        if (parent is None and remote_parent is None
+                and self._rng.random() > self.sample_ratio):
             # Propagate the drop decision so children don't re-roll into
             # orphan spans with no assemblable root.
             token = _current_span.set(_DROPPED)
@@ -106,8 +161,14 @@ class Tracer:
             finally:
                 _current_span.reset(token)
             return
-        trace_id = parent.trace_id if parent else uuid.uuid4().hex
-        s = Span(name, trace_id, parent.span_id if parent else None)
+        if parent is not None:
+            trace_id, parent_id = parent.trace_id, parent.span_id
+        elif remote_parent is not None:
+            trace_id, parent_id = remote_parent[0], remote_parent[1]
+        else:
+            trace_id, parent_id = uuid.uuid4().hex, None
+        s = Span(name, trace_id, parent_id)
+        s.tracestate = (parent.tracestate if parent is not None else tracestate)
         s.attributes.update(attributes)
         token = _current_span.set(s)
         try:
@@ -127,6 +188,67 @@ class Tracer:
                     log.exception("span exporter failure")
             log.debug("span %s %.2fms %s", s.name,
                       (s.end - s.start) * 1e3, s.attributes)
+
+    def span_from_headers(self, name: str, headers: Any, **attributes):
+        """Open a span whose parent context comes from inbound W3C
+        ``traceparent``/``tracestate`` headers (any Mapping with .get).
+        Malformed or absent headers start a fresh root (local sampling
+        applies); a valid header joins the caller's trace with its sampling
+        decision intact — the cross-process half of span() nesting."""
+        remote = None
+        state = None
+        raw = headers.get(TRACEPARENT) if headers is not None else None
+        if raw:
+            remote = parse_traceparent(raw)
+            if remote is not None:
+                state = headers.get(TRACESTATE) or None
+        return self.span(name, remote_parent=remote, tracestate=state,
+                         **attributes)
+
+    def inject_headers(self, headers: dict[str, str]) -> None:
+        """Stamp the current span's W3C context onto an outbound header
+        mapping. A sampled-out trace propagates as flags 00 (fresh ids —
+        the receiver only reads the drop bit), so downstream components
+        don't re-roll their own sample and emit rootless partial traces.
+        No-op when tracing is off or no span context exists at all."""
+        s = _current_span.get()
+        if isinstance(s, Span):
+            headers[TRACEPARENT] = format_traceparent(s)
+            if s.tracestate:
+                headers[TRACESTATE] = s.tracestate
+        elif s is _DROPPED:
+            headers[TRACEPARENT] = (f"00-{uuid.uuid4().hex}"
+                                    f"-{uuid.uuid4().hex[:16]}-00")
+
+    def current_span(self) -> "Span | None":
+        s = _current_span.get()
+        return s if isinstance(s, Span) else None
+
+    def record(self, name: str, start_monotonic: float, end_monotonic: float,
+               *, parent: "Span | None" = None, **attributes) -> None:
+        """Emit an already-timed phase span (post-hoc instrumentation for
+        windows only known after the fact, e.g. engine prefill vs decode).
+        Parents under ``parent`` or the current context span; silently
+        drops when neither exists or tracing is off."""
+        if not self.enabled:
+            return
+        p = parent if parent is not None else self.current_span()
+        if not isinstance(p, Span):
+            return
+        s = Span(name, p.trace_id, p.span_id)
+        s.start = start_monotonic
+        s.end = end_monotonic
+        # Re-anchor wall clock: now minus how long ago the phase started.
+        s.start_unix_ns = time.time_ns() - int(
+            (time.monotonic() - start_monotonic) * 1e9)
+        s.attributes.update(attributes)
+        doc = s.to_dict()
+        self.finished.append(doc)
+        for export in self._exporters:
+            try:
+                export(doc)
+            except Exception:
+                log.exception("span exporter failure")
 
     def snapshot(self) -> list[dict[str, Any]]:
         return list(self.finished)

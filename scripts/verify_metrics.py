@@ -1,0 +1,107 @@
+"""Static metrics-registry lint: catch drift before it ships.
+
+Imports every metrics/telemetry registry in the tree (router, engine,
+sidecar) and fails on:
+
+- duplicate family names WITHIN or ACROSS registries — a cross-component
+  collision makes merged scrapes (e.g. the sidecar's engine-relay + own
+  families) ambiguous;
+- high-cardinality label names — labels whose values grow with traffic
+  (request ids, trace/span ids, URLs, rooms) blow up Prometheus series
+  counts; they belong on spans, never on metric labels.
+
+Run via `make verify-metrics`; tests/test_observability.py hooks it into
+the pytest run so CI catches registry drift statically.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Label names whose value sets are unbounded (per-request identity). Bounded
+# operational labels (model, finished_reason, target=pool endpoint, op,
+# bucket) are fine.
+FORBIDDEN_LABELS = {
+    "request_id", "trace_id", "span_id", "session_id", "uuid", "room",
+    "url", "query", "prompt",
+}
+
+
+def _families(registry, source: str):
+    # Prefer the DECLARED label names (a labeled family with no children yet
+    # exposes no samples, which would hide its labels from the lint); fall
+    # back to sample labels for custom collectors.
+    collectors = getattr(registry, "_collector_to_names", None)
+    if collectors:
+        for collector in list(collectors):
+            name = getattr(collector, "_name", None)
+            if name is None:
+                for metric in collector.collect():
+                    yield metric.name, {
+                        k for s in metric.samples for k in s.labels}, source
+                continue
+            yield name, set(getattr(collector, "_labelnames", ()) or ()), source
+        return
+    for metric in registry.collect():
+        label_names: set[str] = set()
+        for sample in metric.samples:
+            label_names.update(sample.labels)
+        yield metric.name, label_names, source
+
+
+def collect_registries():
+    """(name, registry) for every component registry in the tree."""
+    from llm_d_inference_scheduler_tpu.engine.telemetry import EngineTelemetry
+    from llm_d_inference_scheduler_tpu.router.metrics import REGISTRY
+    from llm_d_inference_scheduler_tpu.router.sidecar.proxy import (
+        Sidecar,
+        SidecarConfig,
+    )
+
+    engine = EngineTelemetry(block_size=16, num_blocks=64)
+    sidecar = Sidecar(SidecarConfig())
+    return [
+        ("router", REGISTRY),
+        ("engine", engine.registry),
+        ("sidecar", sidecar.metrics_registry),
+    ]
+
+
+def check() -> list[str]:
+    errors: list[str] = []
+    seen: dict[str, str] = {}
+    for source, registry in collect_registries():
+        for name, labels, src in _families(registry, source):
+            prev = seen.get(name)
+            if prev is not None and prev != src:
+                errors.append(
+                    f"duplicate family {name!r} in both {prev} and {src}")
+            elif prev == src:
+                errors.append(f"duplicate family {name!r} within {src}")
+            else:
+                seen[name] = src
+            bad = labels & FORBIDDEN_LABELS
+            if bad:
+                errors.append(
+                    f"{src} family {name!r} uses high-cardinality label(s) "
+                    f"{sorted(bad)}")
+    return errors
+
+
+def main() -> int:
+    errors = check()
+    for e in errors:
+        print(f"verify-metrics: {e}", file=sys.stderr)
+    if errors:
+        return 1
+    n = sum(len(list(reg.collect())) for _, reg in collect_registries())
+    print(f"verify-metrics: {n} families across router/engine/sidecar "
+          "registries — no duplicates, no high-cardinality labels")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

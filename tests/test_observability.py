@@ -1,0 +1,334 @@
+"""Observability: W3C trace propagation, engine step telemetry, SSE usage
+tail, sidecar drain, and the cross-component gateway→sidecar→engine trace."""
+
+import asyncio
+import json
+
+import httpx
+
+from llm_d_inference_scheduler_tpu.engine import EngineConfig
+from llm_d_inference_scheduler_tpu.engine.server import EngineServer
+from llm_d_inference_scheduler_tpu.router import tracing
+from llm_d_inference_scheduler_tpu.router.gateway import (
+    _sse_tail_append,
+    _usage_from_sse,
+    build_gateway,
+)
+from llm_d_inference_scheduler_tpu.router.sidecar.proxy import (
+    Sidecar,
+    SidecarConfig,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ---------- traceparent inject/extract ----------
+
+def test_traceparent_roundtrip():
+    t = tracing.Tracer(enabled=True, sample_ratio=1.0)
+    with t.span("root") as root:
+        headers: dict = {}
+        t.inject_headers(headers)
+    tp = headers["traceparent"]
+    parsed = tracing.parse_traceparent(tp)
+    assert parsed is not None
+    trace_id, span_id, sampled = parsed
+    assert trace_id == root.trace_id.rjust(32, "0")
+    assert span_id == root.span_id
+    assert sampled is True
+    assert "tracestate" not in headers  # none set → not emitted
+
+
+def test_traceparent_malformed_and_flags():
+    bad = [
+        "",                                               # empty
+        "00-abc-def-01",                                  # wrong widths
+        "00-" + "0" * 32 + "-" + "1" * 16 + "-01",        # all-zero trace id
+        "00-" + "1" * 32 + "-" + "0" * 16 + "-01",        # all-zero span id
+        "ff-" + "1" * 32 + "-" + "2" * 16 + "-01",        # forbidden version
+        "00-" + "g" * 32 + "-" + "2" * 16 + "-01",        # non-hex
+        "garbage",
+    ]
+    for v in bad:
+        assert tracing.parse_traceparent(v) is None, v
+    # sampled flag honored both ways
+    tid, sid = "a" * 32, "b" * 16
+    assert tracing.parse_traceparent(f"00-{tid}-{sid}-01") == (tid, sid, True)
+    assert tracing.parse_traceparent(f"00-{tid}-{sid}-00") == (tid, sid, False)
+
+
+def test_span_from_headers_joins_and_drops():
+    t = tracing.Tracer(enabled=True, sample_ratio=0.0)  # locally sample NOTHING
+    tid, sid = "c" * 32, "d" * 16
+    # sampled=1 from upstream overrides the local ratio
+    with t.span_from_headers("srv", {"traceparent": f"00-{tid}-{sid}-01",
+                                     "tracestate": "vendor=x"}):
+        inner: dict = {}
+        t.inject_headers(inner)
+    spans = t.snapshot()
+    assert [s["name"] for s in spans] == ["srv"]
+    assert spans[0]["trace_id"] == tid
+    assert spans[0]["parent_id"] == sid
+    # tracestate passes through to the next hop
+    assert inner["tracestate"] == "vendor=x"
+    assert tracing.parse_traceparent(inner["traceparent"])[0] == tid
+
+    # sampled=0 from upstream drops the local subtree even at ratio 1.0
+    t2 = tracing.Tracer(enabled=True, sample_ratio=1.0)
+    with t2.span_from_headers("srv", {"traceparent": f"00-{tid}-{sid}-00"}):
+        with t2.span("child"):
+            pass
+    assert t2.snapshot() == []
+
+    # malformed header → fresh root, local sampling applies
+    t3 = tracing.Tracer(enabled=True, sample_ratio=1.0)
+    with t3.span_from_headers("srv", {"traceparent": "not-a-context"}):
+        pass
+    (s,) = t3.snapshot()
+    assert s["parent_id"] is None and s["trace_id"] != tid
+
+    # a locally sampled-out trace still propagates its DROP decision
+    # downstream (flags 00), so the next hop doesn't re-roll into an
+    # orphan partial trace
+    t4 = tracing.Tracer(enabled=True, sample_ratio=0.0)
+    with t4.span("root"):
+        dropped: dict = {}
+        t4.inject_headers(dropped)
+    parsed = tracing.parse_traceparent(dropped["traceparent"])
+    assert parsed is not None and parsed[2] is False
+    # strict hex validation: int()-tolerated junk is rejected
+    assert tracing.parse_traceparent(
+        "00-+" + "a" * 31 + "-" + "b" * 16 + "-01") is None
+    assert tracing.parse_traceparent(
+        "00-" + "a" * 32 + "-" + "b" * 16 + "-01-extra") is None
+
+
+# ---------- SSE usage tail ----------
+
+def test_sse_tail_keeps_large_terminal_usage_event():
+    usage = {"prompt_tokens": 7, "completion_tokens": 3,
+             "total_tokens": 10}
+    big = {"choices": [{"text": "x" * 9000}], "usage": usage}
+    stream = b"".join(
+        b'data: {"choices": [{"text": "tok%d"}]}\n\n' % i for i in range(50)
+    ) + b"data: " + json.dumps(big).encode() + b"\n\ndata: [DONE]\n\n"
+    tail = b""
+    for i in range(0, len(stream), 1000):  # transport-chunked
+        tail = _sse_tail_append(tail, stream[i:i + 1000])
+    # the >4KiB terminal usage event survives trimming intact
+    assert _usage_from_sse(tail) == usage
+
+
+def test_sse_tail_trims_on_event_boundaries():
+    tail = b""
+    for i in range(100):
+        tail = _sse_tail_append(tail, b'data: {"choices": [{"text": "t%03d"}]}\n\n' % i)
+    assert len(tail) <= 4096 + 64
+    assert tail.startswith(b"data: ")  # always at an event boundary
+
+    # CRLF event terminators (valid SSE) trim just the same
+    usage = {"completion_tokens": 5}
+    tail = b""
+    for i in range(200):
+        tail = _sse_tail_append(
+            tail, b'data: {"choices": [{"text": "t%03d"}]}\r\n\r\n' % i)
+    tail = _sse_tail_append(
+        tail, b"data: " + json.dumps({"usage": usage}).encode()
+        + b"\r\n\r\ndata: [DONE]\r\n\r\n")
+    assert len(tail) <= 4096 + 64
+    assert tail.startswith(b"data: ")
+    assert _usage_from_sse(tail) == usage
+
+
+# ---------- metrics registries ----------
+
+def test_verify_metrics_registries_clean():
+    import importlib.util
+    import pathlib
+
+    spec = importlib.util.spec_from_file_location(
+        "verify_metrics",
+        pathlib.Path(__file__).resolve().parents[1] / "scripts"
+        / "verify_metrics.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.check() == []
+
+
+def test_engine_metrics_families_on_sim():
+    async def body():
+        eng = EngineServer(EngineConfig(backend="sim", model="tiny",
+                                        port=18655))
+        await eng.start()
+        try:
+            async with httpx.AsyncClient(timeout=10) as c:
+                r = await c.post("http://127.0.0.1:18655/v1/completions",
+                                 json={"prompt": "hello", "max_tokens": 3})
+                assert r.status_code == 200
+                text = (await c.get("http://127.0.0.1:18655/metrics")).text
+            for family in ("jetstream:num_free_kv_blocks",
+                           "jetstream:batch_fill_ratio",
+                           "jetstream:num_cached_kv_blocks",
+                           "jetstream:prefill_step_duration_seconds",
+                           "jetstream:decode_step_duration_seconds",
+                           "jetstream:compile_events_total",
+                           "jetstream:kv_cache_usage_perc"):
+                assert family in text, family
+            # the sim observed real steps
+            assert "jetstream:decode_step_duration_seconds_count 3.0" in text
+        finally:
+            await eng.stop()
+
+    run(body())
+
+
+def test_tpu_engine_step_and_compile_metrics():
+    async def body():
+        from llm_d_inference_scheduler_tpu.engine.core import TpuEngine
+        from llm_d_inference_scheduler_tpu.engine import EngineRequest
+
+        eng = TpuEngine(EngineConfig(backend="tpu", model="tiny",
+                                     max_batch=2, max_model_len=128))
+        await eng.start()
+        try:
+            for i in range(2):
+                out = eng.submit(EngineRequest(
+                    request_id=f"m{i}", prompt_token_ids=[1] + [9] * 5,
+                    max_tokens=4))
+                while True:
+                    ev = await asyncio.wait_for(out.get(), timeout=60)
+                    if ev.finish_reason is not None:
+                        break
+        finally:
+            await eng.stop()
+        text = eng.telemetry.render().decode()
+        # first prefill/decode dispatches were counted as compile events …
+        assert 'jetstream:compile_events_total{bucket="1x16",op="prefill"}' in text
+        assert 'op="decode"' in text
+        # … and the repeat decode dispatches landed in the step histogram
+        decode_count = next(
+            float(line.rsplit(" ", 1)[1])
+            for line in text.splitlines()
+            if line.startswith("jetstream:decode_step_duration_seconds_count"))
+        assert decode_count >= 1
+        # occupancy gauges settle back to all-free
+        assert f"jetstream:num_free_kv_blocks {float(eng.n_blocks - 1)}" in text
+
+    run(body())
+
+
+# ---------- e2e: one trace across gateway → sidecar → engine ----------
+
+def test_e2e_single_trace_across_components():
+    EPORT, SPORT, GPORT = 18656, 18657, 18658
+
+    async def body():
+        old = (tracing.tracer.enabled, tracing.tracer.sample_ratio)
+        tracing.tracer.enabled, tracing.tracer.sample_ratio = True, 1.0
+        tracing.tracer.finished.clear()
+        eng = EngineServer(EngineConfig(backend="sim", model="tiny",
+                                        port=EPORT))
+        await eng.start()
+        sc = Sidecar(SidecarConfig(port=SPORT,
+                                   decoder_url=f"http://127.0.0.1:{EPORT}"))
+        await sc.start()
+        gw = build_gateway(f"""
+pool:
+  endpoints:
+    - {{address: 127.0.0.1, port: {SPORT}}}
+""", port=GPORT, poll_interval=0.02)
+        await gw.start()
+        try:
+            client_tp = "00-" + "e" * 32 + "-" + "f" * 16 + "-01"
+            async with httpx.AsyncClient(timeout=30) as c:
+                r = await c.post(f"http://127.0.0.1:{GPORT}/v1/completions",
+                                 json={"model": "tiny", "prompt": "hi",
+                                       "max_tokens": 2},
+                                 headers={"traceparent": client_tp})
+                assert r.status_code == 200
+                spans = (await c.get(
+                    f"http://127.0.0.1:{GPORT}/debug/traces?merge=1")
+                         ).json()["spans"]
+            by_name = {}
+            for s in spans:
+                by_name.setdefault(s["name"], []).append(s)
+            for name in ("gateway.request", "gateway.request_orchestration",
+                         "sidecar.request", "engine.request",
+                         "engine.prefill", "engine.decode"):
+                assert name in by_name, (name, sorted(by_name))
+            gwr = by_name["gateway.request"][0]
+            # the gateway joined the CLIENT's trace
+            assert gwr["trace_id"] == "e" * 32
+            assert gwr["parent_id"] == "f" * 16
+            # every component's spans share that one trace id …
+            for name, group in by_name.items():
+                for s in group:
+                    assert s["trace_id"] == "e" * 32, (name, s)
+            # … with correct cross-component parent links
+            sidecar = by_name["sidecar.request"][0]
+            assert sidecar["parent_id"] == gwr["span_id"]
+            engine = by_name["engine.request"][0]
+            assert engine["parent_id"] == sidecar["span_id"]
+            assert by_name["engine.prefill"][0]["parent_id"] == engine["span_id"]
+            assert by_name["engine.decode"][0]["parent_id"] == engine["span_id"]
+        finally:
+            tracing.tracer.enabled, tracing.tracer.sample_ratio = old
+            await gw.stop()
+            await sc.stop()
+            await eng.stop()
+
+    run(body())
+
+
+# ---------- sidecar drain ----------
+
+def test_sidecar_drain_stops_listener_and_reports():
+    EPORT, SPORT = 18661, 18662
+
+    async def body():
+        eng = EngineServer(EngineConfig(backend="sim", model="tiny",
+                                        port=EPORT,
+                                        sim_decode_ms_per_token=30.0))
+        await eng.start()
+        sc = Sidecar(SidecarConfig(port=SPORT,
+                                   decoder_url=f"http://127.0.0.1:{EPORT}"))
+        await sc.start()
+        try:
+            async with httpx.AsyncClient(timeout=30) as c:
+                base = f"http://127.0.0.1:{SPORT}"
+                assert (await c.get(f"{base}/health")).status_code == 200
+                text = (await c.get(f"{base}/metrics")).text
+                assert "sidecar_draining 0.0" in text
+                # engine families relay through the same scrape
+                assert "jetstream:num_requests_running" in text
+
+                # in-flight request survives the drain
+                gen = asyncio.create_task(c.post(
+                    f"{base}/v1/completions",
+                    json={"prompt": "hi", "max_tokens": 10}))
+                await asyncio.sleep(0.1)
+                await sc.begin_drain()
+                resp = await gen
+                assert resp.status_code == 200
+                assert resp.json()["usage"]["completion_tokens"] == 10
+                # drain window, from a FRESH connection: readiness 503s, new
+                # generate work gets a clean retryable 503, and the drain
+                # gauge is scrapeable (the listener closes only at stop())
+                async with httpx.AsyncClient(timeout=5) as fresh:
+                    r = await fresh.get(f"{base}/health")
+                    assert r.status_code == 503
+                    assert r.json()["status"] == "draining"
+                    r = await fresh.post(f"{base}/v1/completions",
+                                         json={"prompt": "x", "max_tokens": 1})
+                    assert r.status_code == 503
+                    assert r.headers["x-removal-reason"] == "sidecar-draining"
+                    text = (await fresh.get(f"{base}/metrics")).text
+                    assert "sidecar_draining 1.0" in text
+            assert sc.draining
+        finally:
+            await sc.stop()
+            await eng.stop()
+
+    run(body())
