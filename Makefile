@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast test-unit test-dist bench bench-flowcontrol \
+.PHONY: test test-fast test-unit test-dist test-chaos bench bench-flowcontrol \
 	bench-router-sse dryrun render-chart compile-check verify-metrics
 
 # Full hermetic suite (virtual 8-device CPU mesh; no TPU or cluster needed —
@@ -28,6 +28,12 @@ test-unit: test-fast
 # The multi-process jax.distributed suites only.
 test-dist:
 	$(PY) -m pytest tests/test_multihost.py tests/test_multihost_pd.py -q
+
+# Fault-injection suite with a fixed seed: chaos decisions hash
+# (CHAOS_SEED, fault kind, request id), so reruns are bit-identical.
+test-chaos: verify-metrics
+	CHAOS_SEED=11 $(PY) -m pytest tests/test_resilience.py \
+		tests/test_engine_robustness.py -q -k chaos
 
 # Serving benchmark on the real chip (one JSON line; the driver's entry).
 bench:

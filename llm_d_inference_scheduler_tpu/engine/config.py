@@ -127,6 +127,17 @@ class EngineConfig:
     # remains as the cross-stack fallback.
     kv_transfer: str = "auto"
 
+    # Deterministic fault injection on the HTTP generate surface (chaos
+    # shim, router/resilience.py FaultInjector — applies to both the sim
+    # and the tpu backend since it sits at the server layer). Spec grammar:
+    # comma-separated "kind:pct[:arg]" with kind in reset|http503|delay|
+    # stall (arg = milliseconds for delay/stall); the fault decision is a
+    # stable hash of (chaos_seed, kind, request id), so a given request id
+    # always takes the same fault — hermetic, reproducible failover tests.
+    # Empty falls back to the ENGINE_CHAOS env var (same grammar).
+    chaos: str = ""
+    chaos_seed: int = 0
+
     def resolved_kv_events_port(self) -> int:
         return self.port + 1000 if self.kv_events_port == -1 else self.kv_events_port
 

@@ -115,11 +115,21 @@ def _responses_input_to_messages(body: dict[str, Any]) -> list[dict[str, Any]]:
 
 class EngineServer:
     def __init__(self, cfg: EngineConfig, engine=None):
+        import os
+
+        from ..router.resilience import FaultInjector
+
         self.cfg = cfg
         self.engine = engine or make_engine(cfg)
         self.draining = False  # SIGTERM drain: health 503s, work finishes
         self._tls = None       # TlsServing when secure_serving is on
-        self.app = web.Application()
+        # Chaos shim + end-to-end deadline enforcement ride one middleware
+        # on the generate surface (_resilience_mw). `chaos` stays a mutable
+        # attribute so hermetic tests can flip injector.enabled mid-run.
+        self.chaos = FaultInjector.from_spec(
+            cfg.chaos or os.environ.get("ENGINE_CHAOS", ""),
+            seed=cfg.chaos_seed)
+        self.app = web.Application(middlewares=[self._resilience_mw])
         self.app.add_routes([
             web.post("/v1/completions", self.completions),
             web.post("/v1/chat/completions", self.chat_completions),
@@ -148,6 +158,106 @@ class EngineServer:
         self._ec_capacity = 1024
         self._runner: web.AppRunner | None = None
         self._ec_client = None  # long-lived client for /ec pulls
+
+    # ---- resilience middleware ----------------------------------------
+
+    GEN_PATHS = ("/v1/completions", "/v1/chat/completions", "/v1/responses")
+
+    def _chaos_request_id(self, request: web.Request, raw: bytes) -> str:
+        """Stable identity for the fault decision: the router always
+        forwards x-request-id; direct callers can put request_id in the
+        body; otherwise fall back to the (random) engine-side id — still a
+        valid sample, just not replayable."""
+        rid = request.headers.get("x-request-id")
+        if rid:
+            return rid
+        try:
+            rid = json.loads(raw).get("request_id")
+        except Exception:
+            rid = None
+        return str(rid) if rid else uuid.uuid4().hex
+
+    @web.middleware
+    async def _resilience_mw(self, request: web.Request, handler):
+        """Fault injection + deadline enforcement on the generate surface.
+
+        Chaos (config/env-gated, deterministic by request-id hash):
+        ``reset`` closes the connection before any response bytes (the
+        hermetic stand-in for connect-refused — the caller sees a
+        pre-stream transport error, the retryable class); ``http503``
+        returns a retryable 503 with x-removal-reason; ``delay`` adds fixed
+        latency then serves normally; ``stall`` starts an SSE response,
+        writes one partial event, then resets mid-stream (exercises the
+        relay abort guards).
+
+        Deadlines: an ``x-request-timeout`` header (remaining seconds,
+        stamped by the gateway/sidecar) bounds the serve — non-streaming
+        requests are cancelled and answered 504 when it runs out;
+        streaming requests get a watchdog that drops the connection (the
+        status line is already on the wire, so a clean close is the only
+        honest signal)."""
+        if request.path not in self.GEN_PATHS:
+            return await handler(request)
+
+        if self.chaos is not None and self.chaos.rules:
+            raw = await request.read()  # cached; handlers re-read freely
+            rule = self.chaos.decide(self._chaos_request_id(request, raw))
+            if rule is not None:
+                log.info("chaos: injecting %s for %s", rule.kind, request.path)
+                if rule.kind == "delay":
+                    await asyncio.sleep(rule.arg / 1000.0)
+                elif rule.kind == "http503":
+                    return web.json_response(
+                        {"error": "chaos: injected 503"}, status=503,
+                        headers={"x-removal-reason": "chaos-injected"})
+                elif rule.kind == "reset":
+                    if request.transport is not None:
+                        request.transport.close()
+                    return web.Response()  # connection already reset under it
+                elif rule.kind == "stall":
+                    resp = web.StreamResponse(headers={
+                        "Content-Type": "text/event-stream"})
+                    await resp.prepare(request)
+                    await resp.write(
+                        b'data: {"choices":[{"index":0,"text":"chaos"}]}\n\n')
+                    await asyncio.sleep((rule.arg or 10.0) / 1000.0)
+                    if request.transport is not None:
+                        request.transport.close()
+                    return resp
+
+        raw_timeout = request.headers.get("x-request-timeout")
+        if raw_timeout is None:
+            return await handler(request)
+        try:
+            remaining = float(raw_timeout)
+        except ValueError:
+            return await handler(request)
+        if remaining <= 0:
+            return web.json_response(
+                {"error": "deadline exceeded"}, status=504,
+                headers={"x-removal-reason": "deadline-exceeded"})
+        is_stream = False
+        try:
+            is_stream = bool(json.loads(await request.read()).get("stream"))
+        except Exception:
+            pass
+        if is_stream:
+            transport = request.transport
+            watchdog = asyncio.get_running_loop().call_later(
+                remaining,
+                lambda: transport.close() if transport is not None else None)
+            try:
+                return await handler(request)
+            finally:
+                watchdog.cancel()
+        try:
+            return await asyncio.wait_for(handler(request), timeout=remaining)
+        except asyncio.TimeoutError:
+            # wait_for cancelled the handler; its CancelledError path
+            # already aborted the in-flight engine request.
+            return web.json_response(
+                {"error": "deadline exceeded"}, status=504,
+                headers={"x-removal-reason": "deadline-exceeded"})
 
     # ---- lifecycle ----------------------------------------------------
 
@@ -1008,6 +1118,14 @@ def main(argv: list[str] | None = None):
                    help="instruction-channel address: leader bind / follower "
                         "dial (the leader's reachable address on real "
                         "multi-host slices); defaults to --host")
+    p.add_argument("--chaos", default="",
+                   help="deterministic fault injection on the generate "
+                        "surface: comma-separated kind:pct[:arg] with kind "
+                        "in reset|http503|delay|stall (arg = ms); decided "
+                        "by request-id hash. Also via the ENGINE_CHAOS env "
+                        "var; empty disables")
+    p.add_argument("--chaos-seed", type=int, default=0,
+                   help="seed folded into the fault-decision hash")
     args = p.parse_args(argv)
     if args.platform:
         import jax
@@ -1028,7 +1146,8 @@ def main(argv: list[str] | None = None):
                        dist_num_processes=args.dist_num_processes,
                        dist_process_id=args.dist_process_id,
                        dist_instr_port=args.dist_instr_port,
-                       dist_instr_host=args.dist_instr_host)
+                       dist_instr_host=args.dist_instr_host,
+                       chaos=args.chaos, chaos_seed=args.chaos_seed)
     logging.basicConfig(level=logging.INFO)
     from .multihost import maybe_init_distributed, run_follower
 

@@ -42,6 +42,7 @@ class RawConfig:
     data_layer: dict[str, Any]
     flow_control: dict[str, Any]
     saturation_detector: dict[str, Any] | None
+    resilience: dict[str, Any]
     pool: dict[str, Any]
     objectives: list[dict[str, Any]]
     model_rewrites: list[dict[str, Any]]
@@ -61,6 +62,7 @@ class RouterConfig:
     parser_spec: dict[str, Any]
     flow_control: dict[str, Any]
     saturation_detector_spec: dict[str, Any] | None
+    resilience: dict[str, Any]
     static_endpoints: list[EndpointMetadata]
     pool: EndpointPool
     objectives: list[Any] = dataclasses.field(default_factory=list)
@@ -86,6 +88,7 @@ def load_raw_config(text: str | None) -> RawConfig:
         data_layer=doc.get("dataLayer") or {},
         flow_control=doc.get("flowControl") or {},
         saturation_detector=doc.get("saturationDetector"),
+        resilience=doc.get("resilience") or {},
         pool=doc.get("pool") or {},
         objectives=doc.get("objectives") or [],
         model_rewrites=doc.get("modelRewrites") or [],
@@ -245,6 +248,7 @@ def instantiate(raw: RawConfig, handle: Handle,
         parser_spec=parser_spec,
         flow_control=raw.flow_control,
         saturation_detector_spec=raw.saturation_detector,
+        resilience=raw.resilience,
         static_endpoints=static_endpoints,
         pool=pool,
         objectives=objectives,

@@ -13,6 +13,7 @@ import random
 from typing import Callable, Iterable
 
 from ..framework.datalayer import Endpoint, EndpointMetadata
+from ..resilience import BreakerRegistry
 
 
 @dataclasses.dataclass
@@ -63,6 +64,10 @@ class Datastore:
         self._objectives: dict[str, InferenceObjective] = {}
         self._rewrites: dict[str, InferenceModelRewrite] = {}
         self._listeners: list[Callable[[str, Endpoint], None]] = []
+        # Passive per-endpoint circuit breakers (router/resilience.py):
+        # shared by the gateway's retry path and the circuit-breaker-filter
+        # scheduling plugin so ejections apply fleet-wide.
+        self.breakers = BreakerRegistry()
 
     # ---- pool ----------------------------------------------------------
 
@@ -97,6 +102,7 @@ class Datastore:
     def endpoint_delete(self, address_port: str) -> None:
         ep = self._endpoints.pop(address_port, None)
         if ep is not None:
+            self.breakers.remove(address_port)
             for fn in self._listeners:
                 fn("removed", ep)
 

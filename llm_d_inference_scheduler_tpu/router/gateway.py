@@ -25,6 +25,7 @@ import time
 import uuid
 from typing import Any
 
+import aiohttp
 import httpx
 from aiohttp import web
 from prometheus_client import generate_latest
@@ -35,16 +36,29 @@ from .datalayer.runtime import DataLayerRuntime
 from .framework.scheduling import InferenceRequest
 from .handlers.parsers import make_parser
 from .metrics import (
+    DEADLINE_EXCEEDED_TOTAL,
     POOL_AVG_KV_CACHE,
     POOL_AVG_QUEUE,
     POOL_READY_ENDPOINTS,
     REGISTRY,
     REQUEST_DURATION,
+    RETRIES_TOTAL,
+    RETRY_BUDGET_EXHAUSTED_TOTAL,
     TTFT_SECONDS,
     INPUT_TOKENS,
     OUTPUT_TOKENS,
+    UPSTREAM_STREAM_ABORTED_TOTAL,
 )
 from .requestcontrol.admission import AdmissionError, X_REMOVAL_REASON
+from .resilience import (
+    DEADLINE_EXCEEDED_REASON,
+    Deadline,
+    H_REQUEST_TIMEOUT,
+    RETRY_BUDGET_REASON,
+    ResilienceConfig,
+    RetryBudget,
+    UpstreamFailure,
+)
 from .requestcontrol.director import (
     Director,
     H_DESTINATION,
@@ -87,6 +101,15 @@ class Gateway:
         self.dl_runtime = dl_runtime
         self.host, self.port = host, port
         self.parser = make_parser(cfg.parser_spec)
+
+        # Resilience: retry/failover policy, token-bucket retry budget, and
+        # the datastore-shared breaker registry (router/resilience.py).
+        self.resilience = ResilienceConfig.from_spec(cfg.resilience)
+        self.retry_budget = RetryBudget(
+            ratio=self.resilience.retry_budget_ratio,
+            min_per_sec=self.resilience.retry_budget_min_per_sec,
+            burst=self.resilience.retry_budget_burst)
+        datastore.breakers.configure(self.resilience)
 
         # saturation detector: explicit spec or default utilization-detector
         from .framework.plugin import global_registry
@@ -357,6 +380,17 @@ class Gateway:
             headers.pop(h, None)
         headers.setdefault(H_REQUEST_ID, f"req-{uuid.uuid4().hex[:12]}")
 
+        # End-to-end deadline: client x-request-timeout (float seconds) or
+        # the configured default; decremented across hops from here on.
+        deadline = Deadline.from_headers(
+            headers, default_s=self.resilience.default_timeout_s,
+            max_s=self.resilience.max_timeout_s)
+        if deadline is not None and deadline.expired:
+            DEADLINE_EXCEEDED_TOTAL.inc()
+            return web.json_response(
+                {"error": "deadline exceeded"}, status=504,
+                headers={X_REMOVAL_REASON: DEADLINE_EXCEEDED_REASON})
+
         parse = self.parser.parse(raw, headers, path=request.path)
         if parse.error:
             return web.json_response({"error": parse.error}, status=400)
@@ -365,8 +399,9 @@ class Gateway:
             ep = self.director.get_random_endpoint()
             if ep is None:
                 return web.json_response({"error": "no endpoints"}, status=503)
-            return await self._proxy(request, None, ep, raw, headers, t_start,
-                                     original_model="")
+            return await self._proxy_with_failover(
+                request, None, [ep], raw, headers, t_start,
+                original_model="", deadline=deadline)
 
         ireq = InferenceRequest(
             request_id=headers[H_REQUEST_ID],
@@ -383,7 +418,6 @@ class Gateway:
                 {"error": e.reason}, status=e.code,
                 headers={X_REMOVAL_REASON: e.reason})
 
-        target = result.primary().target_endpoints[0]
         # Repackage through the parser (director.go:289-306) only when the
         # bytes must change: model rewrite, or a translating (non-OpenAI)
         # parser; otherwise forward the raw body untouched (hot path).
@@ -401,38 +435,16 @@ class Gateway:
         # Register for mid-flight eviction: sheddable in-flight requests can be
         # cancelled to admit higher-priority work (reference eviction channel →
         # ImmediateResponse(429), handlers/server.go:266-284).
-        # DP rank routing: when a profile handler picked a rank, route to the
-        # pod's rank-specific listener (what Envoy does with the reference's
-        # x-data-parallel-host-port) after validating it belongs to the pod.
-        from .plugins.disagg import DataParallelProfileHandler
-        from .requestcontrol.director import H_DATA_PARALLEL
-
-        override = None
-        dp_target = ireq.headers.get(H_DATA_PARALLEL)
-        if dp_target:
-            try:
-                host, _, port = dp_target.rpartition(":")
-                port = int(port)
-                dp_size = int(target.metadata.labels.get(
-                    DataParallelProfileHandler.DP_SIZE_LABEL, "1"))
-            except ValueError:
-                host, port, dp_size = "", -1, 1
-            if (host == target.metadata.address
-                    and target.metadata.port <= port < target.metadata.port + dp_size):
-                override = f"http://{host}:{port}"
-                # Consumed for routing; the rank listener itself encodes the
-                # rank, so don't forward the header downstream.
-                ireq.headers.pop(H_DATA_PARALLEL, None)
-
         task = asyncio.current_task()
         evict_key = self.evictor.register(ireq.request_id,
                                           ireq.objectives.priority, task.cancel)
         stream_state = {"started": False}
         try:
-            return await self._proxy(request, ireq, target, body_out, ireq.headers,
-                                     t_start, original_model=original_model,
-                                     stream_state=stream_state,
-                                     url_override=override)
+            return await self._proxy_with_failover(
+                request, ireq, list(result.primary().target_endpoints),
+                body_out, ireq.headers, t_start,
+                original_model=original_model, stream_state=stream_state,
+                deadline=deadline)
         except asyncio.CancelledError:
             if self.evictor.was_evicted(evict_key) and not stream_state["started"]:
                 from .flowcontrol.eviction import EVICTED_REASON
@@ -447,11 +459,167 @@ class Gateway:
         finally:
             self.evictor.deregister(evict_key)
 
+    def _dp_override(self, ireq: InferenceRequest, target) -> str | None:
+        """DP rank routing: when a profile handler picked a rank, route to
+        the pod's rank-specific listener (what Envoy does with the
+        reference's x-data-parallel-host-port) after validating it belongs
+        to the target pod."""
+        from .plugins.disagg import DataParallelProfileHandler
+        from .requestcontrol.director import H_DATA_PARALLEL
+
+        dp_target = ireq.headers.get(H_DATA_PARALLEL)
+        if not dp_target:
+            return None
+        try:
+            host, _, port = dp_target.rpartition(":")
+            port = int(port)
+            dp_size = int(target.metadata.labels.get(
+                DataParallelProfileHandler.DP_SIZE_LABEL, "1"))
+        except ValueError:
+            host, port, dp_size = "", -1, 1
+        if (host == target.metadata.address
+                and target.metadata.port <= port < target.metadata.port + dp_size):
+            # Consumed for routing; the rank listener itself encodes the
+            # rank, so don't forward the header downstream.
+            ireq.headers.pop(H_DATA_PARALLEL, None)
+            return f"http://{host}:{port}"
+        return None
+
+    async def _proxy_with_failover(self, request: web.Request,
+                                   ireq: InferenceRequest | None,
+                                   candidates: list, body: bytes,
+                                   headers: dict[str, str], t_start: float,
+                                   *, original_model: str,
+                                   stream_state: dict | None = None,
+                                   deadline: Deadline | None = None
+                                   ) -> web.StreamResponse:
+        """Dispatch with retry + failover: walk the scheduling result's
+        ranked candidates on pre-stream failures (connect errors, retryable
+        502/503 such as ``x-removal-reason: sidecar-draining``), then
+        re-schedule ONCE with the failed endpoints excluded. Bounded by the
+        per-request attempt cap and the token-bucket retry budget so retries
+        cannot amplify an outage; a response whose stream has started is
+        never retried (the status line is on the wire). Endpoint outcomes
+        feed the passive circuit breakers."""
+        res = self.resilience
+        breakers = self.datastore.breakers
+        self.retry_budget.deposit()
+        attempted: set[str] = set()
+        rescheduled = ireq is None  # only scheduled requests can re-schedule
+        failure: UpstreamFailure | None = None
+        budget_exhausted = False
+        blocked: set[str] = set()  # breaker-denied this request
+        last_target = None
+        attempt = 0
+        while attempt < res.max_attempts:
+            if deadline is not None and deadline.expired:
+                failure = UpstreamFailure(
+                    "deadline", 504, DEADLINE_EXCEEDED_REASON)
+                break
+            target = None
+            for ep in candidates:
+                k = ep.metadata.address_port
+                if k in attempted or k in blocked:
+                    continue
+                if not breakers.allow(k):
+                    blocked.add(k)
+                    continue
+                target = ep
+                break
+            if target is None and not rescheduled:
+                rescheduled = True
+                # Breaker-denied endpoints join the exclusion set: without
+                # them the scheduler can re-pick the same open endpoint
+                # (it looks idle) and the request dies with healthy pods
+                # available.
+                result = self.director.reschedule(None, ireq,
+                                                  exclude=attempted | blocked)
+                if result is not None:
+                    candidates = list(result.primary().target_endpoints)
+                    continue
+            if target is None:
+                break
+            key = target.metadata.address_port
+            if attempt > 0:
+                if not self.retry_budget.try_spend():
+                    RETRY_BUDGET_EXHAUSTED_TOTAL.inc()
+                    budget_exhausted = True
+                    # allow() above may have claimed the half-open probe
+                    # slot; this attempt never dispatches, so free it.
+                    breakers.release_probe(key)
+                    break
+                RETRIES_TOTAL.labels(failure.kind if failure
+                                     else "other").inc()
+            attempt += 1
+            last_target = target
+            override = (self._dp_override(ireq, target)
+                        if ireq is not None else None)
+            try:
+                resp = await self._proxy(
+                    request, ireq, target, body, headers, t_start,
+                    original_model=original_model,
+                    stream_state=stream_state, url_override=override,
+                    deadline=deadline)
+            except UpstreamFailure as f:
+                failure = f
+                attempted.add(key)
+                breakers.record_failure(key)
+                log.warning("upstream %s failed pre-stream (%s: %s); %s",
+                            key, f.kind, f.detail or f.reason,
+                            "retrying" if attempt < res.max_attempts
+                            else "attempt cap reached")
+                continue
+            except asyncio.CancelledError:
+                # Eviction / client cancel mid-attempt: no outcome to
+                # record, but the probe slot must not leak.
+                breakers.release_probe(key)
+                raise
+            # Relayed responses feed the breaker: sub-500 is endpoint
+            # health; a relayed 500 is endpoint brokenness. Other relayed
+            # 5xx (an engine-side deadline 504, a 501 unimplemented
+            # surface) reflect the REQUEST, not the pod — recording them as
+            # failures would let short-deadline traffic eject healthy
+            # endpoints fleet-wide, so they only release the probe slot.
+            if resp.status < 500:
+                breakers.record_success(key)
+            elif resp.status == 500:
+                breakers.record_failure(key)
+            else:
+                breakers.release_probe(key)
+            return resp
+        # Out of options: close the request-control bracket exactly once
+        # (handle_request incremented the running counter) and surface the
+        # last failure with the canonical x-removal-reason contract.
+        if ireq is not None:
+            self.director.handle_response_complete(None, ireq, last_target, {})
+        if failure is not None and failure.kind == "deadline":
+            DEADLINE_EXCEEDED_TOTAL.inc()
+            return web.json_response(
+                {"error": "deadline exceeded"}, status=504,
+                headers={X_REMOVAL_REASON: DEADLINE_EXCEEDED_REASON})
+        # Budget-suppressed fast-fails are marked in the body so operators
+        # (and tests) can tell them from ordinary upstream errors; the
+        # x-removal-reason header keeps the upstream's own cause.
+        extra = {"retry": RETRY_BUDGET_REASON} if budget_exhausted else {}
+        if failure is not None and failure.kind in ("connect", "read"):
+            return web.json_response(
+                {"error": f"upstream {failure.kind} failed: {failure.detail}",
+                 **extra},
+                status=502, headers={X_REMOVAL_REASON: failure.reason})
+        if failure is not None:  # retryable status, relayed as-is
+            return web.json_response(
+                {"error": failure.reason, **extra}, status=failure.status,
+                headers={X_REMOVAL_REASON: failure.reason})
+        return web.json_response(
+            {"error": "no upstream endpoint available"}, status=503,
+            headers={X_REMOVAL_REASON: "no-upstream-available"})
+
     async def _proxy(self, request: web.Request, ireq: InferenceRequest | None,
                      endpoint, body: bytes, headers: dict[str, str],
                      t_start: float, original_model: str,
                      stream_state: dict | None = None,
-                     url_override: str | None = None) -> web.StreamResponse:
+                     url_override: str | None = None,
+                     deadline: Deadline | None = None) -> web.StreamResponse:
         url = (url_override or endpoint.metadata.url) + request.path
         fwd = {k: v for k, v in headers.items() if k in FORWARD_HEADERS}
         fwd["content-type"] = "application/json"
@@ -463,17 +631,47 @@ class Gateway:
         tracer.inject_headers(fwd)
         model_label = (ireq.target_model if ireq else "") or "unknown"
 
+        kwargs = {}
+        if deadline is not None:
+            # The downstream leg inherits the REMAINING budget: stamped on
+            # the wire for the next hop, and enforced locally as the
+            # attempt's total timeout (covers connect + full body relay).
+            remaining = max(deadline.remaining_s, 0.001)
+            fwd[H_REQUEST_TIMEOUT] = deadline.header_value()
+            kwargs["timeout"] = aiohttp.ClientTimeout(
+                total=remaining, sock_connect=min(5.0, remaining))
         try:
             # ssl=False skips verification on https endpoints (pod-local
             # certs — TLS engines started with --secure-serving).
             resp = await self._upstream.post(
                 url, data=body, headers=fwd,
-                ssl=False if url.startswith("https") else None)
+                ssl=False if url.startswith("https") else None, **kwargs)
         except Exception as e:
-            if ireq is not None:
-                self.director.handle_response_complete(None, ireq, endpoint, {})
-            return web.json_response({"error": f"upstream connect failed: {e}"},
-                                     status=502)
+            raise UpstreamFailure("connect", 0, "upstream-connect-error",
+                                  str(e)) from e
+
+        # Pre-stream retryable failures: nothing has been relayed to the
+        # client yet, so a 502/503 (e.g. x-removal-reason: sidecar-draining
+        # from PR 1's drain path) walks to the next candidate instead of
+        # becoming client-visible.
+        if resp.status in (502, 503):
+            reason = (resp.headers.get(X_REMOVAL_REASON)
+                      or f"upstream-{resp.status}")
+            resp.release()
+            raise UpstreamFailure("status", resp.status, reason)
+
+        streaming_body = "text/event-stream" in resp.headers.get("content-type", "")
+        data = None
+        if not streaming_body:
+            # The full body read is still pre-stream from the client's view
+            # (headers go out only with the assembled web.Response below), so
+            # an upstream dying mid-body stays retryable too.
+            try:
+                data = await resp.read()
+            except Exception as e:
+                resp.release()
+                raise UpstreamFailure("read", 0, "upstream-read-error",
+                                      str(e)) from e
 
         if ireq is not None:
             self.director.handle_response_received(None, ireq, endpoint, resp.status)
@@ -486,12 +684,11 @@ class Gateway:
             # Session stickiness: return the (scheduling-stamped) encoded
             # token to the client (reference session_affinity.go ResponseBody).
             out_headers["x-session-token"] = ireq.headers["x-session-token"]
-        streaming = "text/event-stream" in resp.headers.get("content-type", "")
         usage: dict[str, int] = {}
         first_byte_at: float | None = None
 
         try:
-            if streaming:
+            if streaming_body:
                 ws = web.StreamResponse(status=resp.status, headers=out_headers)
                 if stream_state is not None:
                     stream_state["started"] = True
@@ -501,12 +698,31 @@ class Gateway:
                 stream_hook = (self.director.handle_response_streaming
                                if ireq is not None
                                and self.cfg.response_streaming else None)
-                async for chunk in resp.content.iter_any():
-                    # TTFT counts the first *token-bearing* event: a role-only
-                    # chat delta (no content) would otherwise flatter the
-                    # metric. Events split across transport chunks are
-                    # reassembled via the carry; unparseable events count
-                    # (fail-open).
+                # Upstream reads and client writes fail differently: an
+                # upstream disconnect mid-stream is counted (and closed
+                # cleanly — the 200 status line is already on the wire, so
+                # no retry is possible and a traceback'd 500 would corrupt
+                # the stream), while a client hanging up is routine and
+                # must not pollute the upstream-abort metric or blame the
+                # (healthy) endpoint in logs.
+                upstream_iter = resp.content.iter_any()
+                while True:
+                    try:
+                        chunk = await upstream_iter.__anext__()
+                    except StopAsyncIteration:
+                        break
+                    except (aiohttp.ClientError, ConnectionResetError,
+                            asyncio.TimeoutError) as e:
+                        UPSTREAM_STREAM_ABORTED_TOTAL.inc()
+                        log.warning("upstream stream aborted mid-relay from "
+                                    "%s: %s",
+                                    endpoint.metadata.address_port, e)
+                        break
+                    # TTFT counts the first *token-bearing* event: a
+                    # role-only chat delta (no content) would otherwise
+                    # flatter the metric. Events split across transport
+                    # chunks are reassembled via the carry; unparseable
+                    # events count (fail-open).
                     if first_byte_at is None:
                         found, sse_carry = _sse_scan_for_token(sse_carry, chunk)
                         if found:
@@ -514,18 +730,25 @@ class Gateway:
                             TTFT_SECONDS.labels(model_label).observe(first_byte_at - t_start)
                     if stream_hook is not None:
                         stream_hook(None, ireq, endpoint, chunk)
-                    # Usage rides the FINAL SSE event: keep a bounded tail of
-                    # COMPLETE events and scan once at stream end. Trimming on
-                    # event boundaries (not a fixed byte window) means a large
-                    # terminal usage-bearing event survives intact instead of
-                    # being silently truncated to {}.
+                    # Usage rides the FINAL SSE event: keep a bounded tail
+                    # of COMPLETE events and scan once at stream end.
+                    # Trimming on event boundaries (not a fixed byte
+                    # window) means a large terminal usage-bearing event
+                    # survives intact instead of being silently truncated
+                    # to {}.
                     sse_tail = _sse_tail_append(sse_tail, chunk)
-                    await ws.write(chunk)
+                    try:
+                        await ws.write(chunk)
+                    except (ConnectionResetError, ConnectionError) as e:
+                        log.debug("client closed stream mid-relay: %s", e)
+                        break
                 usage = _usage_from_sse(sse_tail) or {}
-                await ws.write_eof()
+                try:
+                    await ws.write_eof()
+                except (ConnectionResetError, ConnectionError):
+                    pass  # client already gone
                 return ws
             else:
-                data = await resp.read()
                 first_byte_at = time.monotonic()
                 TTFT_SECONDS.labels(model_label).observe(first_byte_at - t_start)
                 data = _rewrite_model_name(data, ireq, original_model)

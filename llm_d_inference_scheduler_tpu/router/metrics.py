@@ -87,3 +87,29 @@ SCRAPE_DURATION_SECONDS = Histogram(
     "inference_extension_metrics_scrape_duration_seconds",
     "Engine /metrics scrape latency", registry=REGISTRY,
     buckets=(.001, .005, .01, .025, .05, .1, .25, .5, 1, 2))
+# Resilient data plane (router/resilience.py): retry/failover, passive
+# endpoint circuit breaking, end-to-end deadlines, stream-abort handling.
+RETRIES_TOTAL = Counter(
+    "router_retries_total",
+    "Gateway retry/failover attempts after a pre-stream upstream failure",
+    ("kind",), registry=REGISTRY)  # kind: connect | read | status
+RETRY_BUDGET_EXHAUSTED_TOTAL = Counter(
+    "router_retry_budget_exhausted_total",
+    "Retries suppressed because the token-bucket retry budget was empty",
+    registry=REGISTRY)
+BREAKER_STATE = Gauge(
+    "router_endpoint_circuit_breaker_state",
+    "Per-endpoint breaker state: 0 closed, 1 half-open, 2 open",
+    ("endpoint",), registry=REGISTRY)  # cardinality bounded by pool size
+BREAKER_TRANSITIONS_TOTAL = Counter(
+    "router_circuit_breaker_transitions_total",
+    "Breaker state transitions per endpoint",
+    ("endpoint", "to_state"), registry=REGISTRY)
+DEADLINE_EXCEEDED_TOTAL = Counter(
+    "router_request_deadline_exceeded_total",
+    "Requests rejected at the gateway with the end-to-end deadline exhausted",
+    registry=REGISTRY)
+UPSTREAM_STREAM_ABORTED_TOTAL = Counter(
+    "router_upstream_stream_aborted_total",
+    "Response streams cut mid-relay by an upstream disconnect (closed "
+    "cleanly toward the client instead of raising)", registry=REGISTRY)

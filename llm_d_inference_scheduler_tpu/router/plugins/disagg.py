@@ -150,8 +150,8 @@ class DisaggHeadersHandler(PluginBase):
         request.headers.pop(H_PREFILLER, None)
         prefill = result.profile_results.get(self.prefill_profile)
         if prefill and prefill.target_endpoints:
-            request.headers[H_PREFILLER] = (
-                prefill.target_endpoints[0].metadata.address_port)
+            request.headers[H_PREFILLER] = ",".join(
+                ep.metadata.address_port for ep in prefill.target_endpoints)
         request.headers.pop(H_ENCODERS, None)
         encode = result.profile_results.get(self.encode_profile)
         if encode and encode.target_endpoints:
@@ -230,10 +230,16 @@ class DisaggProfileHandler(PluginBase):
         # Delete-then-set (reference disagg_profile_handler.go PreRequest):
         # ingress already strips client-supplied routing headers, but an
         # earlier plugin in the PreRequest chain may have written them.
+        # The FULL ranked candidate list rides the header (comma-separated):
+        # the sidecar's P/D protocols fail over across candidates before
+        # falling back to local decode. Pickers default to one endpoint;
+        # set maxNumOfEndpoints > 1 on the prefill profile's picker to give
+        # the sidecar failover room.
         request.headers.pop(H_PREFILLER, None)
         prefill = result.profile_results.get(self.PREFILL)
         if prefill and prefill.target_endpoints:
-            request.headers[H_PREFILLER] = prefill.target_endpoints[0].metadata.address_port
+            request.headers[H_PREFILLER] = ",".join(
+                ep.metadata.address_port for ep in prefill.target_endpoints)
         request.headers.pop(H_ENCODERS, None)
         encode = result.profile_results.get(self.ENCODE)
         if encode and encode.target_endpoints:

@@ -169,6 +169,33 @@ class PrefixCacheAffinityFilter(PluginBase):
         return sticky
 
 
+@register_plugin("circuit-breaker-filter")
+class CircuitBreakerFilter(PluginBase):
+    """Exclude endpoints whose passive circuit breaker is hard-open — the
+    fleet-wide half of the resilience layer (router/resilience.py): the
+    gateway's retry path records failures into the datastore's breaker
+    registry, and this filter keeps every subsequent scheduling cycle off
+    the ejected pods until their half-open window. Half-open endpoints stay
+    schedulable (probes must flow), and the filter fails open when every
+    candidate is broken (scheduling must not brick on a fully-ejected
+    pool)."""
+
+    def __init__(self, name: str | None = None):
+        super().__init__(name)
+        self._datastore = None
+
+    def configure(self, params, handle) -> None:
+        self._datastore = getattr(handle, "datastore", None)
+
+    def filter(self, ctx, state, request, endpoints):
+        reg = getattr(self._datastore, "breakers", None)
+        if reg is None:
+            return endpoints
+        kept = [ep for ep in endpoints
+                if reg.would_allow(ep.metadata.address_port)]
+        return kept or endpoints
+
+
 @register_plugin("model-serving-filter")
 class ModelServingFilter(PluginBase):
     """Keep endpoints whose polled /v1/models list contains the requested

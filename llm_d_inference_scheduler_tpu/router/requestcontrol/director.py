@@ -166,6 +166,35 @@ class Director:
             log.warning("data producers exceeded %.0fms budget for %s",
                         PRODUCER_BUDGET_S * 1e3, request.request_id)
 
+    def reschedule(self, ctx: Any, request: InferenceRequest,
+                   exclude: set[str]) -> SchedulingResult | None:
+        """Failover re-schedule (gateway retry path): re-run the scheduler
+        over the surviving candidates with the ``exclude``d address_ports
+        removed. Admission and data producers are NOT re-run — the request
+        was already admitted and its producer attributes are still fresh —
+        and the request counters are not re-incremented (the original
+        handle_request/handle_response_complete pair still brackets the
+        request exactly once). Returns None when no viable result exists."""
+        candidates = [ep for ep in self._candidates(request)
+                      if ep.metadata.address_port not in exclude]
+        if not candidates:
+            return None
+        try:
+            result = self.scheduler.schedule(ctx, request, candidates)
+        except Exception as e:
+            log.warning("failover reschedule failed for %s: %s",
+                        request.request_id, e)
+            return None
+        request.scheduling_result = result
+        primary = result.primary().target_endpoints
+        request.headers[H_DESTINATION] = ",".join(
+            ep.metadata.address_port for ep in primary)
+        # Re-run PreRequest so the new target's routing headers (prefiller
+        # candidates, DP rank) match the re-scheduled result.
+        for p in self.pre_request_plugins:
+            p.pre_request(ctx, request, result)
+        return result
+
     # ---- fallback & response path ----------------------------------------
 
     def get_random_endpoint(self) -> Endpoint | None:

@@ -225,16 +225,31 @@ class InflightLoadProducer(PluginBase):
     def _estimate_tokens(self, request: InferenceRequest) -> int:
         return estimate_input_tokens(request)
 
-    def pre_request(self, ctx, request, result: SchedulingResult) -> None:
-        for ep in result.primary().target_endpoints[:1]:
-            load = self._loads.setdefault(ep.metadata.address_port, InFlightLoad())
-            load.requests += 1
-            load.tokens += self._estimate_tokens(request)
-
-    def response_complete(self, ctx, request, endpoint, usage) -> None:
-        if endpoint is None:
-            return
-        load = self._loads.get(endpoint.metadata.address_port)
+    def _release(self, key: str, request: InferenceRequest) -> None:
+        load = self._loads.get(key)
         if load:
             load.requests = max(load.requests - 1, 0)
             load.tokens = max(load.tokens - self._estimate_tokens(request), 0)
+
+    def pre_request(self, ctx, request, result: SchedulingResult) -> None:
+        # The incremented endpoint is remembered ON the request: failover
+        # can re-run pre_request (reschedule) or complete on a different
+        # endpoint than was scheduled, and decrementing by the completion
+        # endpoint would leak a permanent phantom +1 on the failed one.
+        prev = getattr(request, "_inflight_load_key", None)
+        if prev is not None:
+            self._release(prev, request)
+        for ep in result.primary().target_endpoints[:1]:
+            key = ep.metadata.address_port
+            load = self._loads.setdefault(key, InFlightLoad())
+            load.requests += 1
+            load.tokens += self._estimate_tokens(request)
+            setattr(request, "_inflight_load_key", key)
+
+    def response_complete(self, ctx, request, endpoint, usage) -> None:
+        key = getattr(request, "_inflight_load_key", None)
+        if key is None:
+            key = endpoint.metadata.address_port if endpoint is not None else None
+        if key is not None:
+            self._release(key, request)
+            setattr(request, "_inflight_load_key", None)

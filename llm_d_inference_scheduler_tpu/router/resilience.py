@@ -1,0 +1,411 @@
+"""Shared resilience layer: retry budgets, circuit breaking, deadlines, chaos.
+
+The router exists to keep traffic flowing through engine churn (P/D-Serve,
+arXiv:2408.08147: fast failover between disaggregated instances + fallback to
+aggregated serving is what keeps P99s flat; RTP-LLM makes the same case for
+deadline-bounded, retry-budgeted dispatch). This module holds the mechanisms
+both data planes share:
+
+- ``RetryBudget``: a token bucket that bounds how many *retries* the fleet
+  may issue relative to first-attempt traffic, so failover cannot amplify an
+  outage into a retry storm (Finagle/Envoy retry-budget semantics: a deposit
+  per admitted request plus a small time-based trickle, spent 1 token per
+  retry).
+- ``CircuitBreaker`` / ``BreakerRegistry``: passive consecutive-failure
+  ejection per endpoint with half-open probes. The registry lives on the
+  Datastore so the gateway's per-request checks and the
+  ``circuit-breaker-filter`` scheduling plugin share one view — a broken pod
+  is excluded fleet-wide, not just per request.
+- ``Deadline``: end-to-end request timeout carried in the
+  ``x-request-timeout`` header (float seconds), decremented across hops
+  (gateway → sidecar → engine) so every leg inherits the *remaining* budget.
+- ``FaultInjector``: deterministic, env/config-gated chaos rules (connection
+  reset, injected 503, fixed latency, mid-stream stall) decided by
+  request-id hash — every failover behavior above is testable hermetically
+  and reproducibly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+import zlib
+from typing import Any, Callable
+
+from .metrics import (
+    BREAKER_STATE,
+    BREAKER_TRANSITIONS_TOTAL,
+)
+
+# End-to-end deadline wire header: float seconds of REMAINING budget. Each
+# hop re-stamps it with its own remaining time before dialing downstream.
+H_REQUEST_TIMEOUT = "x-request-timeout"
+
+DEADLINE_EXCEEDED_REASON = "deadline-exceeded"
+RETRY_BUDGET_REASON = "retry-budget-exhausted"
+
+
+class UpstreamFailure(Exception):
+    """A pre-stream upstream failure the caller may retry or surface.
+
+    ``kind``: "connect" (dial/transport error before a response),
+    "read" (body read failed before anything was relayed to the client),
+    "status" (a retryable 502/503 response), or "deadline".
+    """
+
+    def __init__(self, kind: str, status: int, reason: str, detail: str = ""):
+        super().__init__(detail or reason)
+        self.kind = kind
+        self.status = status
+        self.reason = reason
+        self.detail = detail
+
+
+# ---- configuration ------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ResilienceConfig:
+    """The YAML ``resilience:`` section (camelCase keys, like the rest of
+    the EndpointPickerConfig surface)."""
+
+    # Per-request attempt cap (first attempt + retries/failovers).
+    max_attempts: int = 3
+    # Retry budget: tokens deposited per admitted request / per second /
+    # bucket cap. A retry spends 1 token; an empty bucket fails fast.
+    retry_budget_ratio: float = 0.1
+    retry_budget_min_per_sec: float = 1.0
+    retry_budget_burst: float = 10.0
+    # Passive endpoint circuit breaking.
+    breaker_failure_threshold: int = 5
+    breaker_open_s: float = 30.0
+    breaker_half_open_successes: int = 1
+    # End-to-end deadlines: default when the client sends no
+    # x-request-timeout (0 = no default), and a cap on what clients may ask.
+    default_timeout_s: float = 0.0
+    max_timeout_s: float = 600.0
+
+    @classmethod
+    def from_spec(cls, spec: dict[str, Any] | None) -> "ResilienceConfig":
+        spec = spec or {}
+        return cls(
+            max_attempts=max(1, int(spec.get("maxAttempts", 3))),
+            retry_budget_ratio=float(spec.get("retryBudgetRatio", 0.1)),
+            retry_budget_min_per_sec=float(spec.get("retryBudgetMinPerSec", 1.0)),
+            retry_budget_burst=float(spec.get("retryBudgetBurst", 10.0)),
+            breaker_failure_threshold=max(
+                1, int(spec.get("breakerFailureThreshold", 5))),
+            breaker_open_s=float(spec.get("breakerOpenS", 30.0)),
+            breaker_half_open_successes=max(
+                1, int(spec.get("breakerHalfOpenSuccesses", 1))),
+            default_timeout_s=float(spec.get("defaultTimeoutS", 0.0)),
+            max_timeout_s=float(spec.get("maxTimeoutS", 600.0)),
+        )
+
+
+# ---- retry budget -------------------------------------------------------
+
+
+class RetryBudget:
+    """Token bucket bounding fleet-wide retry amplification.
+
+    Deposits: ``ratio`` tokens per admitted request (call ``deposit()`` once
+    per request) plus a lazy ``min_per_sec`` time trickle so a quiet router
+    can still probe a recovering pool. Spends: 1 token per retry. The bucket
+    starts full (``burst``) so a cold router can absorb a small burst.
+    """
+
+    def __init__(self, ratio: float = 0.1, min_per_sec: float = 1.0,
+                 burst: float = 10.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.ratio = max(ratio, 0.0)
+        self.min_per_sec = max(min_per_sec, 0.0)
+        self.burst = max(burst, 0.0)
+        self._clock = clock
+        self._tokens = self.burst
+        self._last = clock()
+
+    def _refill(self) -> None:
+        now = self._clock()
+        self._tokens = min(self.burst,
+                           self._tokens + (now - self._last) * self.min_per_sec)
+        self._last = now
+
+    @property
+    def tokens(self) -> float:
+        self._refill()
+        return self._tokens
+
+    def deposit(self) -> None:
+        """One admitted request arrived: grow the budget by ``ratio``."""
+        self._refill()
+        self._tokens = min(self.burst, self._tokens + self.ratio)
+
+    def try_spend(self, n: float = 1.0) -> bool:
+        """Reserve budget for one retry; False = fail fast, don't retry."""
+        self._refill()
+        if self._tokens >= n:
+            self._tokens -= n
+            return True
+        return False
+
+
+# ---- circuit breaker ----------------------------------------------------
+
+CLOSED, HALF_OPEN, OPEN = "closed", "half-open", "open"
+_STATE_VALUE = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
+
+class CircuitBreaker:
+    """Passive per-endpoint breaker: consecutive failures open it; after
+    ``open_s`` it half-opens and admits ONE in-flight probe at a time;
+    ``half_open_successes`` successful probes close it, any probe failure
+    re-opens it."""
+
+    def __init__(self, failure_threshold: int = 5, open_s: float = 30.0,
+                 half_open_successes: int = 1,
+                 clock: Callable[[], float] = time.monotonic):
+        self.failure_threshold = max(1, failure_threshold)
+        self.open_s = open_s
+        self.half_open_successes = max(1, half_open_successes)
+        self._clock = clock
+        self.state = CLOSED
+        self._failures = 0
+        self._successes = 0
+        self._opened_at = 0.0
+        self._probe_inflight = False
+
+    def _maybe_half_open(self) -> None:
+        if self.state == OPEN and self._clock() - self._opened_at >= self.open_s:
+            self.state = HALF_OPEN
+            self._successes = 0
+            self._probe_inflight = False
+
+    def allow(self) -> bool:
+        """Consume an attempt slot. Half-open admits a single in-flight
+        probe; callers MUST follow up with record_success/record_failure."""
+        self._maybe_half_open()
+        if self.state == OPEN:
+            return False
+        if self.state == HALF_OPEN:
+            if self._probe_inflight:
+                return False
+            self._probe_inflight = True
+        return True
+
+    def would_allow(self) -> bool:
+        """Non-consuming view for scheduling filters: only hard-open
+        endpoints are excluded (half-open stays schedulable so probes
+        flow)."""
+        self._maybe_half_open()
+        return self.state != OPEN
+
+    def record_success(self) -> None:
+        if self.state == HALF_OPEN:
+            self._probe_inflight = False
+            self._successes += 1
+            if self._successes >= self.half_open_successes:
+                self.state = CLOSED
+                self._failures = 0
+        else:
+            self._failures = 0
+
+    def record_failure(self) -> None:
+        if self.state == HALF_OPEN:
+            self._probe_inflight = False
+            self._open()
+        elif self.state == CLOSED:
+            self._failures += 1
+            if self._failures >= self.failure_threshold:
+                self._open()
+
+    def release(self) -> None:
+        """An allow()ed attempt was abandoned before any outcome (budget
+        fast-fail, caller cancelled): free the half-open probe slot without
+        counting a success or failure, so the endpoint doesn't stay
+        unprobeable forever."""
+        if self.state == HALF_OPEN:
+            self._probe_inflight = False
+
+    def _open(self) -> None:
+        self.state = OPEN
+        self._opened_at = self._clock()
+        self._failures = 0
+
+
+class BreakerRegistry:
+    """Per-endpoint breakers keyed by address_port, with the state mirrored
+    to the ``router_endpoint_circuit_breaker_state`` gauge (0 closed,
+    1 half-open, 2 open — label cardinality bounded by pool size, same
+    contract as the scrape-error counter)."""
+
+    def __init__(self, failure_threshold: int = 5, open_s: float = 30.0,
+                 half_open_successes: int = 1,
+                 clock: Callable[[], float] = time.monotonic):
+        self._kw = dict(failure_threshold=failure_threshold, open_s=open_s,
+                        half_open_successes=half_open_successes)
+        self._clock = clock
+        self._breakers: dict[str, CircuitBreaker] = {}
+
+    def configure(self, cfg: ResilienceConfig) -> None:
+        """Apply the loaded resilience config (gateway startup — before any
+        traffic, so existing breakers needn't be rebuilt)."""
+        self._kw = dict(failure_threshold=cfg.breaker_failure_threshold,
+                        open_s=cfg.breaker_open_s,
+                        half_open_successes=cfg.breaker_half_open_successes)
+        self._breakers.clear()
+
+    def _get(self, key: str) -> CircuitBreaker:
+        b = self._breakers.get(key)
+        if b is None:
+            b = CircuitBreaker(clock=self._clock, **self._kw)
+            self._breakers[key] = b
+            BREAKER_STATE.labels(key).set(0)
+        return b
+
+    def _tracked(self, key: str, fn: Callable[[CircuitBreaker], Any]) -> Any:
+        b = self._get(key)
+        before = b.state
+        out = fn(b)
+        if b.state != before:
+            BREAKER_STATE.labels(key).set(_STATE_VALUE[b.state])
+            BREAKER_TRANSITIONS_TOTAL.labels(key, b.state).inc()
+        return out
+
+    def allow(self, key: str) -> bool:
+        return self._tracked(key, lambda b: b.allow())
+
+    def would_allow(self, key: str) -> bool:
+        return self._tracked(key, lambda b: b.would_allow())
+
+    def record_success(self, key: str) -> None:
+        self._tracked(key, lambda b: b.record_success())
+
+    def record_failure(self, key: str) -> None:
+        self._tracked(key, lambda b: b.record_failure())
+
+    def release_probe(self, key: str) -> None:
+        self._tracked(key, lambda b: b.release())
+
+    def state(self, key: str) -> str:
+        b = self._breakers.get(key)
+        if b is None:
+            return CLOSED
+        b._maybe_half_open()
+        return b.state
+
+    def remove(self, key: str) -> None:
+        """Endpoint left the pool: drop its breaker and gauge label."""
+        if self._breakers.pop(key, None) is not None:
+            try:
+                BREAKER_STATE.remove(key)
+            except KeyError:
+                pass
+
+    def states(self) -> dict[str, str]:
+        return {k: self.state(k) for k in list(self._breakers)}
+
+
+# ---- end-to-end deadlines -----------------------------------------------
+
+
+class Deadline:
+    """Remaining end-to-end budget for one request, decremented implicitly
+    as time passes; every hop re-stamps ``x-request-timeout`` with
+    ``header_value()`` so downstream legs inherit what's left."""
+
+    __slots__ = ("_deadline", "_clock")
+
+    def __init__(self, budget_s: float,
+                 clock: Callable[[], float] = time.monotonic):
+        self._clock = clock
+        self._deadline = clock() + max(budget_s, 0.0)
+
+    @property
+    def remaining_s(self) -> float:
+        return max(self._deadline - self._clock(), 0.0)
+
+    @property
+    def expired(self) -> bool:
+        return self._clock() >= self._deadline
+
+    def header_value(self) -> str:
+        return f"{self.remaining_s:.3f}"
+
+    @classmethod
+    def from_headers(cls, headers: Any, *, default_s: float = 0.0,
+                     max_s: float = 600.0,
+                     clock: Callable[[], float] = time.monotonic
+                     ) -> "Deadline | None":
+        """Parse ``x-request-timeout`` (float seconds). An explicit
+        non-positive value means "already expired" (a hop forwarded an
+        exhausted budget); an absent/invalid header falls back to
+        ``default_s`` (0 = no deadline)."""
+        raw = headers.get(H_REQUEST_TIMEOUT) if headers is not None else None
+        budget = None
+        if raw is not None:
+            try:
+                budget = float(raw)
+            except (TypeError, ValueError):
+                budget = None
+        if budget is None:
+            if default_s <= 0:
+                return None
+            budget = default_s
+        return cls(min(budget, max_s), clock)
+
+
+# ---- deterministic fault injection --------------------------------------
+
+
+@dataclasses.dataclass
+class FaultRule:
+    kind: str          # "reset" | "http503" | "delay" | "stall"
+    pct: float         # 0..100 of request-id hash space
+    arg: float = 0.0   # delay/stall: milliseconds
+
+
+class FaultInjector:
+    """Config/env-gated chaos shim. Rules are decided by a stable hash of
+    (seed, rule kind, request id): the same request id always takes the same
+    fault, so chaos tests are hermetic and re-runnable. Spec grammar:
+    comma-separated ``kind:pct[:arg]`` — e.g.
+    ``"reset:50,http503:25,delay:100:250,stall:25:10"``. First matching rule
+    wins. ``triggered`` counts firings per kind (test observability)."""
+
+    KINDS = ("reset", "http503", "delay", "stall")
+
+    def __init__(self, rules: list[FaultRule], seed: int = 0):
+        self.rules = rules
+        self.seed = seed
+        self.enabled = True
+        self.triggered: dict[str, int] = {k: 0 for k in self.KINDS}
+
+    @classmethod
+    def from_spec(cls, spec: str | None, seed: int = 0) -> "FaultInjector | None":
+        spec = (spec or "").strip()
+        if not spec:
+            return None
+        rules = []
+        for part in spec.split(","):
+            fields = [f.strip() for f in part.strip().split(":")]
+            if not fields or not fields[0]:
+                continue
+            kind = fields[0]
+            if kind not in cls.KINDS:
+                raise ValueError(f"unknown fault kind {kind!r}; "
+                                 f"known: {cls.KINDS}")
+            pct = float(fields[1]) if len(fields) > 1 else 100.0
+            arg = float(fields[2]) if len(fields) > 2 else 0.0
+            rules.append(FaultRule(kind, pct, arg))
+        return cls(rules, seed) if rules else None
+
+    def decide(self, request_id: str) -> FaultRule | None:
+        if not self.enabled:
+            return None
+        for rule in self.rules:
+            h = zlib.crc32(f"{self.seed}:{rule.kind}:{request_id}".encode()) % 10000
+            if h < rule.pct * 100:
+                self.triggered[rule.kind] += 1
+                return rule
+        return None

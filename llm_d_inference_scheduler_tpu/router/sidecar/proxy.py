@@ -32,6 +32,7 @@ import httpx
 from aiohttp import web
 
 from ..requestcontrol.director import H_DATA_PARALLEL, H_ENCODERS, H_PREFILLER
+from ..resilience import DEADLINE_EXCEEDED_REASON, Deadline, H_REQUEST_TIMEOUT
 
 log = logging.getLogger("router.sidecar")
 
@@ -83,7 +84,7 @@ class Sidecar:
     def __init__(self, cfg: SidecarConfig, *, dp_rank: int = 0):
         import random
 
-        from prometheus_client import CollectorRegistry, Gauge
+        from prometheus_client import CollectorRegistry, Counter, Gauge
 
         self.cfg = cfg
         self.dp_rank = dp_rank
@@ -124,6 +125,19 @@ class Sidecar:
         self._g_inflight = Gauge(
             "sidecar_inflight_requests",
             "Generate requests currently relayed by this sidecar",
+            registry=self.metrics_registry)
+        self._c_prefill_failover = Counter(
+            "sidecar_prefill_failovers_total",
+            "Prefill attempts that failed over to the next header candidate",
+            registry=self.metrics_registry)
+        self._c_stream_aborted = Counter(
+            "sidecar_upstream_stream_aborted_total",
+            "Decode streams cut mid-relay by an upstream disconnect "
+            "(closed cleanly toward the client)",
+            registry=self.metrics_registry)
+        self._c_deadline = Counter(
+            "sidecar_deadline_exceeded_total",
+            "Requests rejected because the end-to-end deadline was exhausted",
             registry=self.metrics_registry)
 
     # ---- per-leg TLS (reference proxy.go:153-166) -----------------------
@@ -282,9 +296,18 @@ class Sidecar:
         except Exception:
             return web.json_response({"error": "invalid JSON"}, status=400)
 
+        # End-to-end deadline: the gateway stamps the REMAINING budget on
+        # x-request-timeout; every leg below inherits what's left.
+        deadline = Deadline.from_headers(request.headers)
+        if deadline is not None and deadline.expired:
+            self._c_deadline.inc()
+            return web.json_response(
+                {"error": "deadline exceeded"}, status=504,
+                headers={"x-removal-reason": DEADLINE_EXCEEDED_REASON})
+
         # Disagg headers are consumed here and never forwarded downstream
         # (upstream dispatch builds its own header set).
-        prefiller = self._pick_prefiller(request)
+        prefillers = self._prefiller_candidates(request)
         encoders = request.headers.get(H_ENCODERS)
 
         if encoders and self.cfg.connector != "passthrough":
@@ -298,42 +321,72 @@ class Sidecar:
             if err is not None:
                 log.warning("encode primer failed (%s); continuing without", err)
 
-        if prefiller and self.cfg.connector != "passthrough":
-            if (self.cfg.ssrf_allowlist is not None
-                    and prefiller not in self.cfg.ssrf_allowlist):
-                return web.json_response(
-                    {"error": f"prefiller {prefiller} not in allowlist"}, status=403)
+        if prefillers and self.cfg.connector != "passthrough":
+            if self.cfg.ssrf_allowlist is not None:
+                allowed = [h for h in prefillers
+                           if h in self.cfg.ssrf_allowlist]
+                if not allowed:
+                    return web.json_response(
+                        {"error": f"prefillers {prefillers} not in allowlist"},
+                        status=403)
+                if len(allowed) < len(prefillers):
+                    log.warning("dropping non-allowlisted prefill candidates "
+                                "%s", [h for h in prefillers
+                                       if h not in allowed])
+                prefillers = allowed
             if self.cfg.connector == "shared-storage":
-                return await self._run_shared_storage_protocol(request, body,
-                                                               prefiller)
+                return await self._run_shared_storage_protocol(
+                    request, body, prefillers, deadline)
             if self.cfg.connector == "sglang":
-                return await self._run_sglang_protocol(request, body, prefiller)
-            return await self._run_pd_protocol(request, body, prefiller)
-        return await self._dispatch_decode(request, body)
+                return await self._run_sglang_protocol(request, body,
+                                                       prefillers, deadline)
+            return await self._run_pd_protocol(request, body, prefillers,
+                                               deadline)
+        return await self._dispatch_decode(request, body, deadline=deadline)
 
-    def _pick_prefiller(self, request: web.Request) -> str | None:
-        """Resolve the prefill target from the routing header
-        (chat_completions.go:79-95): the router may send repeated header
-        values or one comma-separated value; with sampling enabled pick
-        uniformly at random, else the first candidate."""
+    def _prefiller_candidates(self, request: web.Request) -> list[str]:
+        """Resolve the FULL ordered prefill candidate list from the routing
+        header (chat_completions.go:79-95): the router may send repeated
+        header values or one comma-separated value. The P/D and SGLang
+        protocols walk this list on prefiller failure before falling back to
+        local decode. With sampling enabled, the list is rotated to a
+        uniformly random starting candidate (the sampling knob became a
+        shuffle of the failover order, spreading prefill load while keeping
+        every candidate reachable)."""
         values = request.headers.getall(H_PREFILLER, [])
         if len(values) == 1:
             values = values[0].split(",")
         hosts = [v.strip() for v in values if v.strip()]
-        if not hosts:
-            return None
-        if self.cfg.enable_prefiller_sampling:
-            return hosts[self._prefill_sampler(len(hosts))]
-        return hosts[0]
+        if len(hosts) > 1 and self.cfg.enable_prefiller_sampling:
+            start = self._prefill_sampler(len(hosts))
+            hosts = hosts[start:] + hosts[:start]
+        return hosts
+
+    def _pick_prefiller(self, request: web.Request) -> str | None:
+        """First candidate of the ordered list (kept for callers that need
+        exactly one target)."""
+        hosts = self._prefiller_candidates(request)
+        return hosts[0] if hosts else None
 
     async def _run_sglang_protocol(self, request: web.Request,
                                    body: dict[str, Any],
-                                   prefiller: str) -> web.StreamResponse:
+                                   prefillers: list[str],
+                                   deadline: Deadline | None = None
+                                   ) -> web.StreamResponse:
         """SGLang-style connector (reference connector_sglang.go:43-231):
         inject bootstrap {host, port, room-id} into BOTH legs, fire the
         prefill request asynchronously, and dispatch decode CONCURRENTLY —
         the engines rendezvous on the bootstrap channel for the KV transfer
-        (no kv_transfer_params relay, no prefill-completion wait)."""
+        (no kv_transfer_params relay, no prefill-completion wait). The
+        async prefill leg walks the candidate list on failure; the decode
+        leg keeps the first candidate's bootstrap fields because the
+        rendezvous target is fixed the moment decode is dispatched. With
+        real sglang engines a failed-over prefill therefore warms the new
+        candidate's cache but cannot complete THIS request's KV transfer —
+        the decode engine times out its bootstrap wait and computes
+        locally, exactly as it would with no failover at all (no-worse);
+        deferring decode until a prefiller answers would forfeit the
+        connector's defining concurrency."""
         import asyncio
         import random
         import time as _time
@@ -341,11 +394,12 @@ class Sidecar:
         from ..tracing import tracer
 
         boot = dict(body)
-        boot["bootstrap_host"] = prefiller.rpartition(":")[0] or prefiller
+        boot["bootstrap_host"] = (prefillers[0].rpartition(":")[0]
+                                  or prefillers[0])
         boot["bootstrap_port"] = self.cfg.bootstrap_port
         boot["bootstrap_room"] = _time.time_ns() + random.randint(0, 999)
 
-        with tracer.span("sidecar.sglang_protocol", prefiller=prefiller,
+        with tracer.span("sidecar.sglang_protocol", prefiller=prefillers[0],
                          room=boot["bootstrap_room"]) as span:
             # Snapshot the trace context NOW: the leg may outlive this span.
             leg_headers = self._trace_headers()
@@ -354,30 +408,50 @@ class Sidecar:
                 # Fire-and-forget with its own lifetime: the decode response
                 # finishing first must not cancel the prefill leg
                 # (connector_sglang.go uses context.WithoutCancel).
-                try:
-                    r = await self._prefill_client.post(
-                        self._prefill_base(prefiller) + request.path,
-                        json=boot, headers=leg_headers,
-                        timeout=self.cfg.prefill_timeout_s)
-                    if r.status_code >= 300:
+                for i, prefiller in enumerate(prefillers):
+                    if deadline is not None and deadline.expired:
+                        return
+                    if i:
+                        self._c_prefill_failover.inc()
+                    leg_boot = dict(boot)
+                    leg_boot["bootstrap_host"] = (
+                        prefiller.rpartition(":")[0] or prefiller)
+                    hdrs = dict(leg_headers)
+                    timeout = self.cfg.prefill_timeout_s
+                    if deadline is not None:
+                        # Re-stamped per attempt: a later candidate must see
+                        # what is left NOW, not the walk-start snapshot.
+                        timeout = max(min(timeout, deadline.remaining_s), 0.001)
+                        hdrs[H_REQUEST_TIMEOUT] = deadline.header_value()
+                    try:
+                        r = await self._prefill_client.post(
+                            self._prefill_base(prefiller) + request.path,
+                            json=leg_boot, headers=hdrs,
+                            timeout=timeout)
+                        if r.status_code < 300:
+                            return
                         log.warning("sglang prefill at %s returned %d",
                                     prefiller, r.status_code)
-                except Exception as e:
-                    log.warning("sglang prefill at %s failed: %s", prefiller, e)
+                    except Exception as e:
+                        log.warning("sglang prefill at %s failed: %s",
+                                    prefiller, e)
 
             task = asyncio.get_running_loop().create_task(prefill_leg())
             self._bg_tasks.add(task)
             task.add_done_callback(self._bg_tasks.discard)
             t0 = time.monotonic()
             try:
-                return await self._dispatch_decode(request, boot)
+                return await self._dispatch_decode(request, boot,
+                                                   deadline=deadline)
             finally:
                 span.set_attribute("decode_duration_ms",
                                    round((time.monotonic() - t0) * 1e3, 1))
 
     async def _run_shared_storage_protocol(self, request: web.Request,
                                            body: dict[str, Any],
-                                           prefiller: str) -> web.StreamResponse:
+                                           prefillers: list[str],
+                                           deadline: Deadline | None = None
+                                           ) -> web.StreamResponse:
         """Shared-storage connector (reference connector_shared_storage.go:
         30-271): try decode FIRST with a cache_hit_threshold probe; only if the
         decode engine reports finish_reason=cache_threshold (cache too cold),
@@ -386,7 +460,7 @@ class Sidecar:
         from ..tracing import tracer
 
         with tracer.span("sidecar.shared_storage_protocol",
-                         prefiller=prefiller) as span:
+                         prefiller=prefillers[0]) as span:
             # Cheap probe: max_tokens=1 so a warm hit never generates the
             # completion twice; the real generation always goes through
             # _dispatch_decode (which also honors decode_chunk_size/stream).
@@ -414,8 +488,10 @@ class Sidecar:
                 log.warning("shared-storage probe failed (%s); running P/D", e)
             span.set_attribute("cache_hit", warm)
             if warm:
-                return await self._dispatch_decode(request, body)
-            return await self._run_pd_protocol(request, body, prefiller)
+                return await self._dispatch_decode(request, body,
+                                                   deadline=deadline)
+            return await self._run_pd_protocol(request, body, prefillers,
+                                               deadline)
 
     @staticmethod
     def _multimodal_items(body: dict[str, Any]) -> list[dict[str, Any]]:
@@ -477,14 +553,18 @@ class Sidecar:
         return None
 
     async def _run_pd_protocol(self, request: web.Request, body: dict[str, Any],
-                               prefiller: str) -> web.StreamResponse:
+                               prefillers: list[str],
+                               deadline: Deadline | None = None
+                               ) -> web.StreamResponse:
         """2-phase tpu-dcn protocol (NIXL-v2 analogue). Span attributes mirror
         the reference's sidecar spans (true_ttft_ms/prefill_duration_ms,
         connector_nixlv2.go:276-299)."""
         from ..tracing import tracer
 
-        with tracer.span("sidecar.pd_protocol", prefiller=prefiller) as span:
-            return await self._run_pd_protocol_inner(request, body, prefiller, span)
+        with tracer.span("sidecar.pd_protocol",
+                         prefiller=prefillers[0]) as span:
+            return await self._run_pd_protocol_inner(request, body, prefillers,
+                                                     span, deadline)
 
     @staticmethod
     def _max_tokens_field(path: str) -> str:
@@ -494,7 +574,8 @@ class Sidecar:
         return ("max_output_tokens" if path.endswith("/responses")
                 else "max_tokens")
 
-    async def _run_pd_protocol_inner(self, request, body, prefiller, span):
+    async def _run_pd_protocol_inner(self, request, body, prefillers, span,
+                                     deadline=None):
         t0 = time.monotonic()
         prefill_body = dict(body)
         prefill_body["kv_transfer_params"] = {"do_remote_decode": True}
@@ -503,34 +584,69 @@ class Sidecar:
         # the decode leg keeps the caller's original limit (or absence).
         prefill_body[self._max_tokens_field(request.path)] = 1
 
+        # Failover across the router's ranked candidates (P/D-Serve's fast
+        # inter-instance failover): each attempt inherits the REMAINING
+        # deadline budget; when every candidate fails (or the budget runs
+        # out) the request falls back to aggregated local decode.
         ktp = None
-        try:
-            r = await self._prefill_client.post(
-                self._prefill_base(prefiller) + request.path,
-                json=prefill_body, headers=self._trace_headers(),
-                timeout=self.cfg.prefill_timeout_s)
-            if r.status_code == 200:
-                ktp = r.json().get("kv_transfer_params")
-            else:
-                log.warning("prefill at %s returned %d; falling back to decode",
-                            prefiller, r.status_code)
-        except Exception as e:
-            log.warning("prefill at %s failed (%s); falling back to decode",
-                        prefiller, e)
+        attempts = 0
+        for i, prefiller in enumerate(prefillers):
+            if deadline is not None and deadline.expired:
+                log.warning("prefill deadline exhausted after %d attempt(s); "
+                            "falling back to decode", attempts)
+                break
+            if i:
+                self._c_prefill_failover.inc()
+            attempts += 1
+            timeout = self.cfg.prefill_timeout_s
+            headers = self._trace_headers()
+            if deadline is not None:
+                timeout = max(min(timeout, deadline.remaining_s), 0.001)
+                headers[H_REQUEST_TIMEOUT] = deadline.header_value()
+            try:
+                r = await self._prefill_client.post(
+                    self._prefill_base(prefiller) + request.path,
+                    json=prefill_body, headers=headers, timeout=timeout)
+                if r.status_code == 200:
+                    ktp = r.json().get("kv_transfer_params")
+                    span.set_attribute("prefill_endpoint", prefiller)
+                    break
+                log.warning("prefill at %s returned %d; %s", prefiller,
+                            r.status_code,
+                            "trying next candidate"
+                            if i + 1 < len(prefillers)
+                            else "falling back to decode")
+            except Exception as e:
+                log.warning("prefill at %s failed (%s); %s", prefiller, e,
+                            "trying next candidate"
+                            if i + 1 < len(prefillers)
+                            else "falling back to decode")
 
         decode_body = dict(body)
         if ktp is not None:
             decode_body["kv_transfer_params"] = ktp
         prefill_ms = (time.monotonic() - t0) * 1e3
         span.set_attribute("prefill_duration_ms", round(prefill_ms, 1))
+        span.set_attribute("prefill_attempts", attempts)
         span.set_attribute("fallback_to_decode", ktp is None)
         return await self._dispatch_decode(request, decode_body,
                                            extra_headers={
-                                               "x-prefill-duration-ms": f"{prefill_ms:.1f}"})
+                                               "x-prefill-duration-ms": f"{prefill_ms:.1f}"},
+                                           deadline=deadline)
 
     async def _dispatch_decode(self, request: web.Request, body: dict[str, Any],
-                               extra_headers: dict[str, str] | None = None
+                               extra_headers: dict[str, str] | None = None,
+                               deadline: Deadline | None = None
                                ) -> web.StreamResponse:
+        if deadline is not None and deadline.expired:
+            # The prefill walk (or queueing) consumed the whole budget:
+            # honor the deadline contract instead of dispatching a decode
+            # doomed to a 1 ms timeout and surfacing as a retryable 502.
+            self._c_deadline.inc()
+            return web.json_response(
+                {"error": "deadline exceeded"}, status=504,
+                headers={**(extra_headers or {}),
+                         "x-removal-reason": DEADLINE_EXCEEDED_REASON})
         chunkable = (self.cfg.decode_chunk_size > 0 and not body.get("stream")
                      and "kv_transfer_params" not in body
                      and int(body.get("max_tokens") or 16) > 0
@@ -538,16 +654,22 @@ class Sidecar:
         base_url = self._dp_header_url(request) or self._rank_url()
         if chunkable:
             return await self._chunked_decode(request, body, extra_headers,
-                                              base_url)
+                                              base_url, deadline)
         url = base_url + request.path
+        leg_headers = self._trace_headers({"content-type": "application/json"})
+        timeout = self.cfg.decode_timeout_s
+        if deadline is not None:
+            # The decode leg inherits the remaining end-to-end budget.
+            timeout = max(min(timeout, deadline.remaining_s), 0.001)
+            leg_headers[H_REQUEST_TIMEOUT] = deadline.header_value()
         try:
             upstream = self._client.build_request(
-                "POST", url, json=body, headers=self._trace_headers(
-                    {"content-type": "application/json"}))
+                "POST", url, json=body, headers=leg_headers, timeout=timeout)
             resp = await self._client.send(upstream, stream=True)
         except Exception as e:
             return web.json_response({"error": f"decode dispatch failed: {e}"},
-                                     status=502)
+                                     status=502,
+                                     headers=dict(extra_headers or {}))
         out_headers = {"content-type": resp.headers.get("content-type",
                                                         "application/json")}
         out_headers.update(extra_headers or {})
@@ -555,11 +677,43 @@ class Sidecar:
             if "text/event-stream" in out_headers["content-type"]:
                 ws = web.StreamResponse(status=resp.status_code, headers=out_headers)
                 await ws.prepare(request)
-                async for chunk in resp.aiter_bytes():
-                    await ws.write(chunk)
-                await ws.write_eof()
+                # Engine reads vs client writes fail differently: an engine
+                # disconnect mid-stream is counted and the relay closed
+                # cleanly (the status line is on the wire — the router's
+                # stream-abort guard mirrors this on its own hop); a client
+                # hangup is routine and must not count as an engine abort.
+                engine_iter = resp.aiter_bytes()
+                while True:
+                    try:
+                        chunk = await engine_iter.__anext__()
+                    except StopAsyncIteration:
+                        break
+                    except (httpx.HTTPError, ConnectionResetError,
+                            ConnectionError) as e:
+                        self._c_stream_aborted.inc()
+                        log.warning("decode stream aborted mid-relay: %s", e)
+                        break
+                    try:
+                        await ws.write(chunk)
+                    except (ConnectionResetError, ConnectionError) as e:
+                        log.debug("client closed stream mid-relay: %s", e)
+                        break
+                try:
+                    await ws.write_eof()
+                except (ConnectionResetError, ConnectionError):
+                    pass  # client already gone
                 return ws
-            data = await resp.aread()
+            try:
+                data = await resp.aread()
+            except (httpx.HTTPError, ConnectionResetError,
+                    ConnectionError) as e:
+                # Body read died before anything was relayed: still a clean
+                # 502 toward the client, with the prefill timing headers
+                # preserved for observability.
+                self._c_stream_aborted.inc()
+                return web.json_response(
+                    {"error": f"decode read failed: {e}"}, status=502,
+                    headers=dict(extra_headers or {}))
             return web.Response(body=data, status=resp.status_code,
                                 headers=out_headers)
         finally:
@@ -567,7 +721,8 @@ class Sidecar:
 
     async def _chunked_decode(self, request: web.Request, body: dict[str, Any],
                               extra_headers: dict[str, str] | None,
-                              base_url: str | None = None) -> web.StreamResponse:
+                              base_url: str | None = None,
+                              deadline: Deadline | None = None) -> web.StreamResponse:
         """Bounded decode slices (reference decode.go:62-444): issue decode in
         max_tokens=chunk steps, re-appending the generated text between steps
         (chat uses the continue-final-message pattern)."""
@@ -589,9 +744,19 @@ class Sidecar:
                 step_body["messages"] = msgs
             else:
                 step_body["prompt"] = body["prompt"] + acc_text
+            step_headers = self._trace_headers()
+            step_timeout = self.cfg.decode_timeout_s
+            if deadline is not None:
+                if deadline.expired:
+                    # Mid-sequence deadline: return what was decoded so far
+                    # rather than burning budget on further slices.
+                    break
+                step_timeout = max(min(step_timeout, deadline.remaining_s),
+                                   0.001)
+                step_headers[H_REQUEST_TIMEOUT] = deadline.header_value()
             r = await self._client.post(
                 (base_url or self._rank_url()) + request.path, json=step_body,
-                headers=self._trace_headers())
+                headers=step_headers, timeout=step_timeout)
             if r.status_code != 200:
                 return web.Response(body=r.content, status=r.status_code,
                                     content_type="application/json")
@@ -605,6 +770,13 @@ class Sidecar:
             if choice.get("finish_reason") != "length":
                 break
 
+        if not doc:
+            # Deadline expired before the first slice completed.
+            self._c_deadline.inc()
+            return web.json_response(
+                {"error": "deadline exceeded"}, status=504,
+                headers={**(extra_headers or {}),
+                         "x-removal-reason": DEADLINE_EXCEEDED_REASON})
         if chat:
             doc["choices"][0]["message"]["content"] = acc_text
         else:

@@ -324,6 +324,48 @@ def test_sigterm_graceful_drain():
     run(body())
 
 
+def test_chaos_shim_on_engine_surface():
+    """The env/config-gated fault-injection shim (router/resilience.py via
+    the EngineServer middleware): injected 503s carry the retryable
+    x-removal-reason contract, decisions are deterministic per request id,
+    and non-generate surfaces (health/metrics) are never chaos'd."""
+    async def body():
+        cfg = _cfg("sim", 18343, chaos="http503:50", chaos_seed=7)
+        srv = EngineServer(cfg)
+        await srv.start()
+        try:
+            async with httpx.AsyncClient(base_url="http://127.0.0.1:18343",
+                                         timeout=30) as c:
+                outcomes = {}
+                for i in range(32):
+                    r = await c.post("/v1/completions",
+                                     json={"prompt": "x", "max_tokens": 1},
+                                     headers={"x-request-id": f"det-{i}"})
+                    outcomes[f"det-{i}"] = r.status_code
+                assert set(outcomes.values()) == {200, 503}  # pct 50 splits
+                for rid, status in outcomes.items():
+                    r = await c.post("/v1/completions",
+                                     json={"prompt": "x", "max_tokens": 1},
+                                     headers={"x-request-id": rid})
+                    assert r.status_code == status  # same id, same fate
+                    if status == 503:
+                        assert r.headers["x-removal-reason"] == "chaos-injected"
+                # Control surfaces stay clean.
+                assert (await c.get("/health")).status_code == 200
+                assert (await c.get("/metrics")).status_code == 200
+                # Runtime gate: disabling the injector heals everything.
+                srv.chaos.enabled = False
+                for rid in list(outcomes)[:8]:
+                    r = await c.post("/v1/completions",
+                                     json={"prompt": "x", "max_tokens": 1},
+                                     headers={"x-request-id": rid})
+                    assert r.status_code == 200
+        finally:
+            await srv.stop()
+
+    run(body())
+
+
 def test_drain_timeout_aborts_stragglers():
     """A request that cannot finish inside the drain window is actively
     aborted (ABORT event, not a hang into the SIGKILL window), and the
