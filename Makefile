@@ -5,23 +5,35 @@
 PY ?= python
 
 .PHONY: test test-fast test-unit test-dist test-chaos bench bench-flowcontrol \
-	bench-router-sse dryrun render-chart compile-check verify-metrics
+	bench-router-sse bench-decisions dryrun render-chart compile-check \
+	verify-metrics verify-decisions
 
 # Full hermetic suite (virtual 8-device CPU mesh; no TPU or cluster needed —
 # the reference needs envtest + kind for the equivalent coverage).
-test: verify-metrics
+test: verify-metrics verify-decisions
 	$(PY) -m pytest tests/ -q
 
 # Everything except the spawned-process distributed tests (the slow tail).
-test-fast: verify-metrics
+test-fast: verify-metrics verify-decisions
 	$(PY) -m pytest tests/ -q --deselect tests/test_multihost.py \
 		--deselect tests/test_multihost_pd.py
 
-# Static registry lint: duplicate family names / high-cardinality labels
-# across the router, engine, and sidecar metrics registries
-# (also hooked into pytest via tests/test_observability.py).
+# Static registry lint: duplicate family names / high-cardinality labels /
+# missing pinned families across the router, engine, and sidecar metrics
+# registries (also hooked into pytest via tests/test_observability.py).
 verify-metrics:
 	$(PY) scripts/verify_metrics.py
+
+# Decision flight-recorder coverage lint: every registered
+# filter/scorer/picker type must appear in a recorded decision
+# (also hooked into pytest via tests/test_decisions.py).
+verify-decisions:
+	$(PY) scripts/verify_decisions.py
+
+# Recorder-overhead microbench on the flow-control dispatch path (CPU-only;
+# writes benchmarks/DECISIONS_MICRO.json — target <3%, kill-switch ~0%).
+bench-decisions:
+	$(PY) bench.py --sched-microbench
 
 test-unit: test-fast
 

@@ -362,9 +362,220 @@ pool:
         await gw.stop()
 
 
+def sched_microbench(quick: bool = False) -> dict:
+    """Decision-recorder overhead microbench (CPU-only, no chip needed).
+
+    Measures the two hot paths the flight recorder touches, recorder ON vs
+    the config kill-switch (`decisions: {enabled: false}`):
+
+    - **flow-control dispatch**: requests pumped through
+      FlowControlAdmissionController.admit -> enqueue_and_wait -> shard
+      dispatch (the <3% overhead target of the decision-recorder contract;
+      the kill-switch path is one `is None` check, i.e. ~0%);
+    - **scheduler**: Scheduler.schedule over a profile with one filter, two
+      scorers, and the max-score picker across 8 endpoints (per-filter drop
+      + per-scorer top-K + picker margin recording).
+
+    Methodology: the box this runs on is shared; wall-clock AND CPU-second
+    costs drift by tens of percent between back-to-back runs (frequency
+    scaling / steal time) - far above the ~2 us effect measured, so
+    differencing two noisy path timings cannot resolve it. Instead the
+    flow-control overhead is DECOMPOSED: the recorder's per-request hook
+    sequence on that path (recorder.start + record_admission + the queue
+    clock reads) is timed in a tight loop (min of reps - deterministic to
+    ~0.1 us), and divided by the dispatch path's per-request floor (min
+    over interleaved on/off chunks, GC parked). The scheduler phase keeps
+    the differential chunk measurement - its effect (per-candidate
+    score/filter/picker recording) is large enough to resolve directly.
+    Prints one JSON line; main() writes benchmarks/DECISIONS_MICRO.json."""
+    import asyncio
+    import gc
+
+    from llm_d_inference_scheduler_tpu.router.decisions import (
+        DecisionConfig,
+        DecisionRecorder,
+    )
+    from llm_d_inference_scheduler_tpu.router.flowcontrol import (
+        FlowControlAdmissionController,
+        FlowControlConfig,
+        FlowController,
+    )
+    from llm_d_inference_scheduler_tpu.router.framework.datalayer import (
+        Endpoint,
+        EndpointMetadata,
+    )
+    from llm_d_inference_scheduler_tpu.router.framework.scheduling import (
+        InferenceRequest,
+        InferenceRequestBody,
+    )
+    from llm_d_inference_scheduler_tpu.router.plugins.filters import DecodeFilter
+    from llm_d_inference_scheduler_tpu.router.plugins.pickers import MaxScorePicker
+    from llm_d_inference_scheduler_tpu.router.plugins.scorers import (
+        KvCacheUtilizationScorer,
+        QueueScorer,
+    )
+    from llm_d_inference_scheduler_tpu.router.scheduling.scheduler import (
+        Scheduler,
+        SchedulerProfile,
+        WeightedScorer,
+    )
+    from llm_d_inference_scheduler_tpu.router.plugins.profile_handlers import (
+        SingleProfileHandler,
+    )
+
+    chunk = 500
+    chunks_per_cfg = 8 if quick else 16
+    concurrency = 64
+    endpoints = [Endpoint(EndpointMetadata(name=f"ep{i}",
+                                           address="10.0.0.%d" % i,
+                                           port=8000))
+                 for i in range(8)]
+    recorders = {"on": DecisionRecorder(DecisionConfig(enabled=True)),
+                 "off": DecisionRecorder(DecisionConfig(enabled=False))}
+
+    def make_request(i: int, recorder: DecisionRecorder) -> InferenceRequest:
+        # Multi-flow, mixed-priority traffic: the fairness policy then does
+        # real per-dispatch work (the reference flowcontrol benchmark's
+        # shape), so the denominator is the production dispatch path, not a
+        # degenerate single-queue pop.
+        req = InferenceRequest(request_id=f"mb-{i}", target_model="tiny",
+                               body=InferenceRequestBody(
+                                   completions={"prompt": "x"}),
+                               headers={"x-gateway-inference-fairness-id":
+                                        f"flow-{i % 8}"},
+                               request_size_bytes=64)
+        req.objectives.priority = -1 if i % 4 == 0 else 0
+        req.decision = recorder.start(req.request_id, req.target_model)
+        return req
+
+    async def run_flowcontrol() -> list[tuple[float, float]]:
+        fc = FlowController(FlowControlConfig(shards=1),
+                            saturation_fn=lambda: 0.0)
+        admission = FlowControlAdmissionController(fc)
+        await fc.start()
+
+        async def one_chunk(label: str) -> float:
+            recorder = recorders[label]
+            done = 0
+            t0 = time.monotonic()
+            while done < chunk:
+                wave = min(concurrency, chunk - done)
+                await asyncio.gather(*[
+                    admission.admit(None, make_request(done + i, recorder),
+                                    endpoints)
+                    for i in range(wave)])
+                done += wave
+            return (time.monotonic() - t0) / chunk * 1e6  # us/request
+
+        try:
+            for label in ("on", "off"):  # warm dispatch loop + allocator
+                await one_chunk(label)
+            pairs = []
+            gc.collect()
+            gc.disable()
+            try:
+                for _ in range(chunks_per_cfg):
+                    pairs.append((await one_chunk("on"),
+                                  await one_chunk("off")))
+            finally:
+                gc.enable()
+            return pairs
+        finally:
+            await fc.stop()
+
+    def run_scheduler() -> list[tuple[float, float]]:
+        profile = SchedulerProfile(
+            "default", [DecodeFilter("decode-filter")],
+            [WeightedScorer(QueueScorer("queue-scorer"), 2.0),
+             WeightedScorer(KvCacheUtilizationScorer("kv-scorer"), 2.0)],
+            MaxScorePicker("max-score-picker"))
+        sched = Scheduler({"default": profile}, SingleProfileHandler())
+
+        def one_chunk(label: str) -> float:
+            recorder = recorders[label]
+            t0 = time.monotonic()
+            for i in range(chunk):
+                sched.schedule(None, make_request(i, recorder), endpoints)
+            return (time.monotonic() - t0) / chunk * 1e6
+
+        for label in ("on", "off"):  # warmup
+            one_chunk(label)
+        pairs = []
+        gc.collect()
+        gc.disable()
+        try:
+            for _ in range(chunks_per_cfg):
+                pairs.append((one_chunk("on"), one_chunk("off")))
+        finally:
+            gc.enable()
+        return pairs
+
+    def admission_hook_cost_us() -> float:
+        """Tight-loop (min-of-reps) cost of exactly what the recorder adds
+        per request on the flow-control dispatch path, net of the
+        kill-switch baseline (recorder.start returning None)."""
+        n = 20000 if quick else 50000
+        best = {}
+        for label in ("on", "off"):
+            recorder = DecisionRecorder(
+                DecisionConfig(enabled=label == "on"))
+            b = float("inf")
+            for _ in range(5):
+                t0 = time.perf_counter()
+                for i in range(n):
+                    rec = recorder.start("hook-probe", "tiny")
+                    if rec is not None:
+                        t = time.monotonic()
+                        rec.record_admission(
+                            "flow-control", "dispatched", flow_id="f",
+                            priority_band=0,
+                            queue_ms=(time.monotonic() - t) * 1e3)
+                b = min(b, (time.perf_counter() - t0) / n * 1e6)
+            best[label] = b
+        return best["on"] - best["off"]
+
+    out: dict = {"metric": "decision_recorder_overhead",
+                 "chunk": chunk, "pairs_per_run": chunks_per_cfg}
+    for phase, runner in (("flowcontrol_dispatch", run_flowcontrol),
+                          ("scheduler", run_scheduler)):
+        pairs = []
+        for _ in range(2 if quick else 4):  # independent interleaved runs
+            r = runner()
+            if asyncio.iscoroutine(r):
+                r = asyncio.run(r)
+            pairs.extend(r)
+        # timeit methodology: contention and allocator noise are strictly
+        # additive, so the MINIMUM over many interleaved chunks is the
+        # noise-floor estimate for each config.
+        on = min(p[0] for p in pairs)
+        off = min(p[1] for p in pairs)
+        out[phase] = {
+            "us_per_req_recorder_on": round(on, 2),
+            "us_per_req_kill_switch": round(off, 2),
+        }
+        if phase == "flowcontrol_dispatch":
+            hook = admission_hook_cost_us()
+            out[phase]["recorder_hook_us_per_req"] = round(hook, 3)
+            out[phase]["overhead_pct"] = round(hook / off * 100.0, 2)
+        else:
+            out[phase]["overhead_pct"] = round((on - off) / off * 100.0, 2)
+    out["target"] = "flowcontrol_dispatch overhead < 3%"
+    print(json.dumps(out))
+    return out
+
+
 def main() -> None:
     if len(sys.argv) > 3 and sys.argv[1] == "--child":
         child(sys.argv[2], int(sys.argv[3]))
+        return
+    if "--sched-microbench" in sys.argv:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")  # no chip needed
+        res = sched_microbench(quick="--quick" in sys.argv)
+        here = os.path.dirname(os.path.abspath(__file__))
+        os.makedirs(os.path.join(here, "benchmarks"), exist_ok=True)
+        with open(os.path.join(here, "benchmarks",
+                               "DECISIONS_MICRO.json"), "w") as f:
+            json.dump(res, f, indent=1)
         return
 
     deadline = time.monotonic() + float(os.environ.get("BENCH_DEADLINE", "2700"))

@@ -67,6 +67,13 @@ class EngineConfig:
     secure_serving: bool = False
     cert_path: str = ""
     enable_cert_reload: bool = False
+    # Outbound TLS verification for the engine's own client legs — encoder
+    # /ec pulls and the host-staged /kv pull + release DELETEs against TLS
+    # peers. Default skip-verify (in-cluster pod-local certs, mirroring the
+    # sidecar's per-leg insecure-skip-verify flags); a CA bundle path turns
+    # real verification on (router/tlsutil.py client_verify).
+    client_insecure_skip_verify: bool = True
+    client_ca_cert_path: str = ""
     # Decode steps fused into one device dispatch (lax.scan over the decode
     # step + sampler on device). Amortizes per-dispatch latency — decisive
     # when the chip sits behind a network tunnel — at the cost of bursty

@@ -514,9 +514,19 @@ class TpuEngine:
         Kept here beside the state it reads so it cannot drift from the
         engine loop's own wake predicate."""
         with self._cond:
-            return (not any(s is not None for s in self.slots)
-                    and not self._waiting and not self._import_ready
-                    and not self._embed_reqs and self._kv_fetching == 0)
+            busy = (any(s is not None for s in self.slots)
+                    or self._waiting or self._import_ready
+                    or self._embed_reqs or self._kv_fetching != 0
+                    or self._release_reqs)
+        if busy:
+            return False
+        # Staged P/D exports pin device KV a decode peer may still be
+        # mid-pull on (ADVICE r5): draining a prefill pod while kv_exports
+        # is non-empty (or releases are queued but not yet broadcast) would
+        # tear the pages out from under the peer. Checked outside _cond —
+        # no other path nests these locks in this order.
+        with self._exports_lock:
+            return not self.kv_exports
 
     def submit(self, req: EngineRequest) -> asyncio.Queue:
         """Thread-safe enqueue; returns the per-request event queue."""
@@ -1608,14 +1618,15 @@ class TpuEngine:
         scheme = ktp.get("remote_scheme") or "http"
         url = (f"{scheme}://{ktp['remote_host']}:{ktp['remote_port']}"
                f"/kv/{ktp['remote_request_id']}")
+        verify = self._client_tls_verify()
         try:
-            r = httpx.get(url, timeout=30.0, verify=False)
+            r = httpx.get(url, timeout=30.0, verify=verify)
             r.raise_for_status()
             pi.payload = r.content
             pi.headers = dict(r.headers)
             self.kv_import_host_count += 1
             try:
-                httpx.delete(url, timeout=5.0, verify=False)
+                httpx.delete(url, timeout=5.0, verify=verify)
             except Exception:
                 pass  # exporter TTL sweep reclaims
         except Exception as e:
@@ -1653,6 +1664,21 @@ class TpuEngine:
             jnp.dtype(ktp["kv_dtype"]))
         self._release_remote_export(ktp)
 
+    def _client_tls_verify(self):
+        """TLS verification policy for the engine's outbound HTTP legs
+        (host-staged /kv pulls + release DELETEs): default skip-verify for
+        pod-local certs, or the configured CA bundle (ADVICE r5). Memoized —
+        the config is immutable after startup and SSLContext construction is
+        not free on the latency-sensitive transfer path."""
+        verify = getattr(self, "_http_verify", None)
+        if verify is None:
+            from ..router.tlsutil import client_verify
+
+            verify = client_verify(self.cfg.client_insecure_skip_verify,
+                                   self.cfg.client_ca_cert_path or None)
+            self._http_verify = verify
+        return verify
+
     def _release_remote_export(self, ktp: dict[str, Any]) -> None:
         """Best-effort: tell the exporter its staged copy was consumed
         device-side so it drops the record without self-draining."""
@@ -1663,7 +1689,7 @@ class TpuEngine:
             httpx.delete(f"{scheme}://{ktp['remote_host']}:"
                          f"{ktp['remote_port']}"
                          f"/kv/{ktp['remote_request_id']}?consumed=device",
-                         timeout=5.0, verify=False)
+                         timeout=5.0, verify=self._client_tls_verify())
         except Exception:
             pass  # exporter TTL sweep reclaims
 

@@ -320,9 +320,15 @@ class EngineServer:
         import httpx
 
         if self._ec_client is None:
-            # verify=False: ec_sources may be https (TLS encode workers with
-            # pod-local certs — the sidecar's use-tls-for-encoder leg).
-            self._ec_client = httpx.AsyncClient(timeout=10, verify=False)
+            # ec_sources may be https (TLS encode workers — the sidecar's
+            # use-tls-for-encoder leg); verification follows the engine's
+            # client TLS policy (default skip-verify for pod-local certs).
+            from ..router.tlsutil import client_verify
+
+            self._ec_client = httpx.AsyncClient(
+                timeout=10, verify=client_verify(
+                    self.cfg.client_insecure_skip_verify,
+                    self.cfg.client_ca_cert_path or None))
 
         from ..router.tracing import tracer
 
@@ -1126,6 +1132,13 @@ def main(argv: list[str] | None = None):
                         "var; empty disables")
     p.add_argument("--chaos-seed", type=int, default=0,
                    help="seed folded into the fault-decision hash")
+    p.add_argument("--client-verify", action="store_true",
+                   help="verify TLS on the engine's outbound legs (ec/kv "
+                        "pulls) with the system trust store instead of the "
+                        "pod-local skip-verify default")
+    p.add_argument("--client-ca-cert", default="",
+                   help="CA bundle for the outbound legs (implies "
+                        "verification against this bundle)")
     args = p.parse_args(argv)
     if args.platform:
         import jax
@@ -1147,7 +1160,10 @@ def main(argv: list[str] | None = None):
                        dist_process_id=args.dist_process_id,
                        dist_instr_port=args.dist_instr_port,
                        dist_instr_host=args.dist_instr_host,
-                       chaos=args.chaos, chaos_seed=args.chaos_seed)
+                       chaos=args.chaos, chaos_seed=args.chaos_seed,
+                       client_insecure_skip_verify=not (
+                           args.client_verify or args.client_ca_cert),
+                       client_ca_cert_path=args.client_ca_cert)
     logging.basicConfig(level=logging.INFO)
     from .multihost import maybe_init_distributed, run_follower
 

@@ -43,6 +43,8 @@ class RawConfig:
     flow_control: dict[str, Any]
     saturation_detector: dict[str, Any] | None
     resilience: dict[str, Any]
+    decisions: dict[str, Any]
+    tls_client: dict[str, Any]
     pool: dict[str, Any]
     objectives: list[dict[str, Any]]
     model_rewrites: list[dict[str, Any]]
@@ -63,6 +65,16 @@ class RouterConfig:
     flow_control: dict[str, Any]
     saturation_detector_spec: dict[str, Any] | None
     resilience: dict[str, Any]
+    # decisions: the decision flight recorder knobs (enabled/capacity/topK —
+    # router/decisions.py DecisionConfig). tlsClient: verification policy for
+    # the GATEWAY's own client legs (upstream proxy, /debug/traces +
+    # /v1/models fan-out) — insecureSkipVerify (default true: pod-local
+    # certs) or caCertPath (router/tlsutil.py client_verify). The metrics
+    # scrape and kv-event SSE data sources take the same knobs as per-plugin
+    # parameters instead (they are plugins, configured where they are
+    # declared).
+    decisions: dict[str, Any]
+    tls_client: dict[str, Any]
     static_endpoints: list[EndpointMetadata]
     pool: EndpointPool
     objectives: list[Any] = dataclasses.field(default_factory=list)
@@ -89,6 +101,8 @@ def load_raw_config(text: str | None) -> RawConfig:
         flow_control=doc.get("flowControl") or {},
         saturation_detector=doc.get("saturationDetector"),
         resilience=doc.get("resilience") or {},
+        decisions=doc.get("decisions") or {},
+        tls_client=doc.get("tlsClient") or {},
         pool=doc.get("pool") or {},
         objectives=doc.get("objectives") or [],
         model_rewrites=doc.get("modelRewrites") or [],
@@ -249,6 +263,8 @@ def instantiate(raw: RawConfig, handle: Handle,
         flow_control=raw.flow_control,
         saturation_detector_spec=raw.saturation_detector,
         resilience=raw.resilience,
+        decisions=raw.decisions,
+        tls_client=raw.tls_client,
         static_endpoints=static_endpoints,
         pool=pool,
         objectives=objectives,

@@ -27,9 +27,17 @@ class MetricsDataSource(PluginBase):
         self._extractors: list[Any] = []
         self._timeout = timeout_s
         self._client: httpx.AsyncClient | None = None
+        # TLS verification for https scrape targets: default skip-verify
+        # (pod-local certs, the reference scrape client's default), or a CA
+        # bundle for real verification (tlsutil.client_verify; ADVICE r5).
+        self._insecure_skip_verify = True
+        self._ca_cert_path: str | None = None
 
     def configure(self, params: dict[str, Any], handle: Any) -> None:
         self._timeout = float(params.get("timeoutSeconds", self._timeout))
+        self._insecure_skip_verify = bool(
+            params.get("insecureSkipVerify", self._insecure_skip_verify))
+        self._ca_cert_path = params.get("caCertPath") or None
 
     def add_extractor(self, ex: Any) -> None:
         self._extractors.append(ex)
@@ -39,10 +47,12 @@ class MetricsDataSource(PluginBase):
 
     async def collect(self, endpoint: Endpoint) -> str | None:
         if self._client is None:
-            # verify=False: https endpoints present pod-local certs (the
-            # reference scrape client's insecureSkipVerify default).
-            self._client = httpx.AsyncClient(timeout=self._timeout,
-                                             verify=False)
+            from ..tlsutil import client_verify
+
+            self._client = httpx.AsyncClient(
+                timeout=self._timeout,
+                verify=client_verify(self._insecure_skip_verify,
+                                     self._ca_cert_path))
         t0 = time.monotonic()
         try:
             r = await self._client.get(endpoint.metadata.metrics_url)

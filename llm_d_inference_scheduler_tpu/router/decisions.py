@@ -1,0 +1,435 @@
+"""Scheduling decision flight recorder: per-request explainability.
+
+PR 1's traces show *where time went* and PR 2's counters show *aggregate
+outcomes*; this module answers "why did request X land on pod Y?" — the gap
+P/D-Serve (arXiv:2408.08147) blames for undebuggable fleet-scale P/D
+regressions, and NetKV (arXiv:2606.03910) closes by recording *per-candidate*
+scores, not just the winner.
+
+One ``DecisionRecord`` accumulates as the request crosses the layers:
+
+- admission: controller verdict, flow-control queue time, priority band,
+  flow id, shed/evict retries (requestcontrol/admission.py,
+  flowcontrol/admission.py);
+- model rewrite and producer budget spend (requestcontrol/director.py);
+- per profile, per scheduling round: candidate count in, per-filter drops
+  (filter name → endpoints removed), per-scorer per-endpoint raw and
+  weighted scores (top-K, configurable), the picker's choice and win margin
+  (scheduling/scheduler.py, carried through the cycle via CycleState);
+- post-schedule: the gateway's retry/failover attempt trail — which ranked
+  candidate each attempt used and why it moved on (gateway.py).
+
+Storage is a bounded ring (default ~1k records) with an id index, zero-egress
+like the trace buffer: inspect via ``GET /debug/decisions`` /
+``/debug/decisions/<request-id>``, opt into a compact per-request verdict
+with the ``x-debug-decision: summary`` request header, or read the phase
+summaries as span events on the orchestration span
+(``/debug/traces?merge=1``). A config kill-switch (``decisions.enabled:
+false``) reduces every hook to one ``is None`` check — the overhead contract
+``bench.py --sched-microbench`` measures.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+import time
+from collections import deque
+from typing import Any
+
+SCHEMA_VERSION = 1
+
+# CycleState key under which the scheduler publishes the active record so
+# plugins (and the profile loop itself) can annotate the cycle they run in.
+DECISION_STATE_KEY = "decision_record"
+
+
+class DecisionRecord:
+    """One request's decision trail. Mutated in place by the layer hooks;
+    ``to_dict()`` is the schema-versioned wire form."""
+
+    __slots__ = ("request_id", "model", "target_model", "priority",
+                 "_start", "_admission", "_producers",
+                 "_rounds", "_attempts", "_final", "top_k")
+
+    # Container fields are lazily created (None until first write): a record
+    # is opened on EVERY request, and five eager container allocations per
+    # request are measurable GC pressure on the flow-control dispatch path.
+    _EMPTY_DICT: dict[str, Any] = {}
+    _EMPTY_LIST: list[Any] = []
+
+    def __init__(self, request_id: str, model: str, *, top_k: int = 8):
+        self.top_k = top_k
+        self._reset(request_id, model)
+
+    def _reset(self, request_id: str, model: str) -> None:
+        """(Re)initialize for a fresh request — the recorder pools evicted
+        records to keep the per-request cost on the flow-control dispatch
+        path to a handful of attribute stores (no allocation)."""
+        self.request_id = request_id
+        self.model = model
+        self.target_model = model
+        self.priority = 0
+        self._start = time.monotonic()
+        self._admission = None
+        self._producers = None
+        self._rounds = None
+        self._attempts = None
+        self._final = None
+
+    @property
+    def start_unix(self) -> float:
+        """Wall-clock request start, derived from the monotonic anchor at
+        read time (one fewer clock read on the record-open hot path)."""
+        return time.time() - (time.monotonic() - self._start)
+
+    @property
+    def admission(self) -> dict[str, Any]:
+        return self._admission if self._admission is not None else self._EMPTY_DICT
+
+    @property
+    def producers(self) -> dict[str, Any]:
+        return self._producers if self._producers is not None else self._EMPTY_DICT
+
+    @property
+    def rounds(self) -> list[dict[str, Any]]:
+        return self._rounds if self._rounds is not None else self._EMPTY_LIST
+
+    @property
+    def attempts(self) -> list[dict[str, Any]]:
+        return self._attempts if self._attempts is not None else self._EMPTY_LIST
+
+    @property
+    def final(self) -> dict[str, Any]:
+        return self._final if self._final is not None else self._EMPTY_DICT
+
+    # ---- layer hooks ----------------------------------------------------
+
+    def record_rewrite(self, target_model: str) -> None:
+        self.target_model = target_model
+
+    def record_admission(self, mechanism: str, outcome: str, *,
+                         flow_id: str | None = None,
+                         priority_band: int | None = None,
+                         queue_ms: float | None = None,
+                         retried_after_shed: bool = False,
+                         reason: str | None = None) -> None:
+        # Hot path (flow-control dispatch): one dict literal on the common
+        # shape; rounding happens at render time (to_dict).
+        if (flow_id is not None and priority_band is not None
+                and queue_ms is not None and not retried_after_shed
+                and not reason):
+            self._admission = {"mechanism": mechanism, "outcome": outcome,
+                               "flow_id": flow_id,
+                               "priority_band": priority_band,
+                               "queue_ms": queue_ms}
+            return
+        a: dict[str, Any] = {"mechanism": mechanism, "outcome": outcome}
+        if flow_id is not None:
+            a["flow_id"] = flow_id
+        if priority_band is not None:
+            a["priority_band"] = priority_band
+        if queue_ms is not None:
+            a["queue_ms"] = queue_ms
+        if retried_after_shed:
+            a["retried_after_shed"] = True
+        if reason:
+            a["reason"] = reason
+        self._admission = a
+
+    def record_admit_plugin_reject(self, plugin: str, reason: str) -> None:
+        """AdmitRequest-plugin rejection: lands beside (not over) a
+        flow-control admission section when one exists."""
+        if self._admission is None:
+            self._admission = {}
+        self._admission.setdefault("admit_plugin", plugin)
+        self._admission["outcome"] = "rejected"
+        self._admission.setdefault("reason", reason)
+
+    def record_producers(self, spent_ms: float, budget_ms: float,
+                         names: list[str]) -> None:
+        self._producers = {"spent_ms": round(spent_ms, 3),
+                          "budget_ms": round(budget_ms, 3),
+                          "producers": names}
+
+    def begin_round(self, reason: str, candidates_in: int) -> dict[str, Any]:
+        rnd = {"reason": reason, "candidates_in": candidates_in,
+               "profiles": {}}
+        if self._rounds is None:
+            self._rounds = []
+        self._rounds.append(rnd)
+        return rnd
+
+    def begin_profile(self, profile: str, candidates_in: int) -> dict[str, Any]:
+        """Profile section within the CURRENT round (the scheduler opens the
+        round before running profiles)."""
+        if not self._rounds:
+            self.begin_round("schedule", candidates_in)
+        sec = {"candidates_in": candidates_in, "filters": [],
+               "scorers": {}, "picker": None, "outcome": "pending"}
+        self._rounds[-1]["profiles"][profile] = sec
+        return sec
+
+    @staticmethod
+    def profile_filter(sec: dict[str, Any], name: str,
+                       n_in: int, kept: list[str],
+                       dropped: list[str]) -> None:
+        sec["filters"].append({"plugin": name, "in": n_in, "out": len(kept),
+                               "dropped": dropped})
+
+    @staticmethod
+    def profile_scorer(sec: dict[str, Any], name: str, weight: float,
+                       raw: dict[str, float]) -> None:
+        """Per-endpoint raw scores. Zero-copy on the scheduling hot path:
+        the scorer's freshly-built result dict is referenced (never mutated
+        after score() returns); top-K trimming, weighting, and rounding all
+        happen at render time (to_dict)."""
+        sec["scorers"][name] = {"weight": weight, "_raw": raw}
+
+    @staticmethod
+    def profile_picker(sec: dict[str, Any], name: str, picked: list[str],
+                       totals: dict[str, float]) -> None:
+        ranked = sorted(totals.items(), key=lambda kv: kv[1], reverse=True)
+        winner_total = totals.get(picked[0], 0.0) if picked else None
+        runner_up = next(((ep, t) for ep, t in ranked
+                          if not picked or ep != picked[0]), None)
+        sec["picker"] = {
+            "plugin": name,
+            "picked": picked,
+            "winner_total": (round(winner_total, 6)
+                             if winner_total is not None else None),
+            "runner_up": runner_up[0] if runner_up else None,
+            "margin": (round(winner_total - runner_up[1], 6)
+                       if winner_total is not None and runner_up else None),
+        }
+        sec["outcome"] = "picked" if picked else "no_pick"
+
+    def record_attempt(self, endpoint: str, outcome: str, *,
+                       status: int | None = None,
+                       reason: str | None = None) -> None:
+        """One dispatch attempt in the gateway's retry/failover walk.
+        ``outcome``: "ok" or the UpstreamFailure kind
+        ("connect"/"read"/"status"/"deadline")."""
+        if self._attempts is None:
+            self._attempts = []
+        a: dict[str, Any] = {"rank": len(self._attempts),
+                             "endpoint": endpoint, "outcome": outcome}
+        if status is not None:
+            a["status"] = status
+        if reason:
+            a["reason"] = reason
+        self._attempts.append(a)
+
+    def record_event(self, kind: str, **detail: Any) -> None:
+        """Out-of-band failover events (breaker denial, reschedule, retry
+        budget exhaustion) interleaved into the attempt trail."""
+        if self._attempts is None:
+            self._attempts = []
+        self._attempts.append({"rank": len(self._attempts),
+                               "event": kind, **detail})
+
+    def finalize(self, status: int, *, destination: str | None = None,
+                 reason: str | None = None) -> None:
+        if self._final:
+            return  # first terminal outcome wins (error paths may overlap)
+        self._final = {"status": status,
+                       "duration_ms": round(
+                           (time.monotonic() - self._start) * 1e3, 3)}
+        if destination:
+            self._final["destination"] = destination
+        if reason:
+            self._final["reason"] = reason
+
+    # ---- render ---------------------------------------------------------
+
+    def to_dict(self, *, compact: bool = False) -> dict[str, Any]:
+        doc: dict[str, Any] = {
+            "schema_version": SCHEMA_VERSION,
+            "request_id": self.request_id,
+            "model": self.model,
+            "target_model": self.target_model,
+            "priority": self.priority,
+            "start_unix": self.start_unix,
+            "admission": self._render_admission(),
+            "final": self.final,
+        }
+        if compact:
+            doc["summary"] = self.summary_line()
+            return doc
+        doc["producers"] = self.producers
+        doc["rounds"] = [self._render_round(r) for r in self.rounds]
+        doc["attempts"] = self.attempts
+        return doc
+
+    def _render_admission(self) -> dict[str, Any]:
+        a = dict(self.admission)
+        if "queue_ms" in a:
+            a["queue_ms"] = round(a["queue_ms"], 3)
+        return a
+
+    def _render_round(self, rnd: dict[str, Any]) -> dict[str, Any]:
+        return {"reason": rnd["reason"],
+                "candidates_in": rnd["candidates_in"],
+                "profiles": {p: self._render_profile(sec)
+                             for p, sec in rnd["profiles"].items()}}
+
+    def _render_profile(self, sec: dict[str, Any]) -> dict[str, Any]:
+        scorers = {}
+        for name, s in sec["scorers"].items():
+            raw = s["_raw"]
+            w = s["weight"]
+            top = sorted(raw.items(), key=lambda kv: kv[1],
+                         reverse=True)[: self.top_k]
+            scorers[name] = {
+                "weight": w,
+                "scores": {ep: {"raw": round(v, 6),
+                                "weighted": round(
+                                    w * min(max(v, 0.0), 1.0), 6)}
+                           for ep, v in top},
+                "candidates": len(raw),
+            }
+        return {"candidates_in": sec["candidates_in"],
+                "filters": sec["filters"],
+                "scorers": scorers,
+                "picker": sec["picker"],
+                "outcome": sec["outcome"]}
+
+    def _primary_picker(self) -> dict[str, Any] | None:
+        """Picker section of the last round's first picked profile (the
+        primary is scheduled first by every profile handler here)."""
+        for rnd in reversed(self.rounds):
+            for sec in rnd["profiles"].values():
+                if sec.get("picker") and sec["picker"].get("picked"):
+                    return sec["picker"]
+        return None
+
+    def summary_line(self) -> str:
+        """Compact one-line verdict for the x-debug-decision response header:
+        winner, runner-up, margin, per-filter drop counts, attempt count."""
+        parts: list[str] = []
+        pk = self._primary_picker()
+        if pk:
+            parts.append(f"winner={pk['picked'][0]}")
+            if pk.get("runner_up"):
+                parts.append(f"runner_up={pk['runner_up']}")
+            if pk.get("margin") is not None:
+                parts.append(f"margin={pk['margin']:.4f}")
+        if self.admission:
+            parts.append(f"admission={self.admission.get('outcome')}")
+            if "queue_ms" in self.admission:
+                parts.append(f"queue_ms={self.admission['queue_ms']:.3f}")
+        drops = []
+        for rnd in self.rounds:
+            for pname, sec in rnd["profiles"].items():
+                for f in sec["filters"]:
+                    if f["dropped"]:
+                        drops.append(f"{pname}/{f['plugin']}:{len(f['dropped'])}")
+        if drops:
+            parts.append("drops=" + ",".join(drops))
+        if len(self.attempts) > 1:
+            parts.append(f"attempts={len(self.attempts)}")
+        return " ".join(parts) or "no-decision"
+
+    def span_events(self) -> list[tuple[str, dict[str, Any]]]:
+        """Phase summaries to attach to the orchestration span so
+        /debug/traces?merge=1 correlates decision and latency in one tree."""
+        events: list[tuple[str, dict[str, Any]]] = []
+        if self.admission:
+            events.append(("decision.admission", dict(self.admission)))
+        for i, rnd in enumerate(self.rounds):
+            for pname, sec in rnd["profiles"].items():
+                attrs: dict[str, Any] = {
+                    "round": i, "reason": rnd["reason"],
+                    "candidates_in": sec["candidates_in"],
+                    "outcome": sec["outcome"],
+                }
+                dropped = sum(len(f["dropped"]) for f in sec["filters"])
+                if dropped:
+                    attrs["filter_dropped"] = dropped
+                pk = sec.get("picker")
+                if pk and pk.get("picked"):
+                    attrs["picked"] = pk["picked"][0]
+                    if pk.get("margin") is not None:
+                        attrs["margin"] = pk["margin"]
+                events.append((f"decision.profile.{pname}", attrs))
+        if len(self.attempts) > 1:
+            events.append(("decision.failover", {
+                "attempts": [a.get("endpoint") or a.get("event")
+                             for a in self.attempts],
+            }))
+        return events
+
+
+@dataclasses.dataclass
+class DecisionConfig:
+    """The YAML ``decisions:`` section (camelCase keys like the rest of the
+    config surface). ``enabled: false`` is the kill-switch the overhead
+    contract requires."""
+
+    enabled: bool = True
+    capacity: int = 1024
+    top_k: int = 8
+
+    @classmethod
+    def from_spec(cls, spec: dict[str, Any] | None) -> "DecisionConfig":
+        spec = spec or {}
+        return cls(enabled=bool(spec.get("enabled", True)),
+                   capacity=max(1, int(spec.get("capacity", 1024))),
+                   top_k=max(1, int(spec.get("topK", 8))))
+
+
+class DecisionRecorder:
+    """Bounded, lock-free ring of DecisionRecords with an id index.
+
+    All writers run on the gateway's event loop (director, scheduler,
+    flow-control admission, proxy failover), so plain dict/deque mutation is
+    safe and cheap — no lock on the dispatch path. The ring bounds memory:
+    evicting the oldest record also drops its index entry (unless a newer
+    record reused the id)."""
+
+    def __init__(self, cfg: DecisionConfig | None = None):
+        self.cfg = cfg or DecisionConfig()
+        self._ring: deque[DecisionRecord] = deque()
+        self._by_id: dict[str, DecisionRecord] = {}
+
+    @property
+    def enabled(self) -> bool:
+        return self.cfg.enabled
+
+    def start(self, request_id: str, model: str) -> DecisionRecord | None:
+        """Open a record (None when the kill-switch is off — every layer
+        hook then degrades to a single ``is None`` check)."""
+        cfg = self.cfg
+        if not cfg.enabled:
+            return None
+        ring, by_id = self._ring, self._by_id
+        rec = None
+        if len(ring) >= cfg.capacity:
+            old = ring.popleft()
+            if by_id.get(old.request_id) is old:
+                del by_id[old.request_id]
+            # Pool the evicted record IF nothing else still references it
+            # (refcount = the local + getrefcount's argument): a record
+            # evicted out from under a still-in-flight request or a debug
+            # reader must not be recycled into another request's trail.
+            if sys.getrefcount(old) == 2:
+                old._reset(request_id, model)
+                old.top_k = cfg.top_k
+                rec = old
+        if rec is None:
+            rec = DecisionRecord(request_id, model, top_k=cfg.top_k)
+        ring.append(rec)
+        by_id[request_id] = rec
+        return rec
+
+    def get(self, request_id: str) -> DecisionRecord | None:
+        return self._by_id.get(request_id)
+
+    def snapshot(self, n: int | None = None) -> list[DecisionRecord]:
+        """Most-recent-first."""
+        out = list(self._ring)
+        out.reverse()
+        return out[:n] if n else out
+
+    def __len__(self) -> int:
+        return len(self._ring)

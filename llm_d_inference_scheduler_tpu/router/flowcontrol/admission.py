@@ -5,6 +5,7 @@ client-facing error codes with x-removal-reason semantics."""
 
 from __future__ import annotations
 
+import time
 from typing import Any
 
 from ..framework.datalayer import Endpoint
@@ -38,6 +39,9 @@ class FlowControlAdmissionController:
             flow_key=FlowKey(flow_id, request.objectives.priority),
             size_bytes=max(request.request_size_bytes, 1),
         )
+        rec = request.decision  # decision flight recorder (may be None)
+        t0 = time.monotonic() if rec is not None else 0.0
+        retried_after_shed = False
         outcome = await self.controller.enqueue_and_wait(item)
         if (outcome == QueueOutcome.REJECTED_CAPACITY
                 and request.objectives.priority >= 0):
@@ -48,11 +52,18 @@ class FlowControlAdmissionController:
             if self.evictor is not None:
                 self.evictor.evict_n(1)
             if freed_queue_slot:
+                retried_after_shed = True
                 retry = FlowControlRequest(
                     request_id=request.request_id,
                     flow_key=item.flow_key,
                     size_bytes=item.size_bytes)
                 outcome = await self.controller.enqueue_and_wait(retry)
+        if rec is not None:
+            rec.record_admission(
+                "flow-control", outcome.value, flow_id=flow_id,
+                priority_band=request.objectives.priority,
+                queue_ms=(time.monotonic() - t0) * 1e3,
+                retried_after_shed=retried_after_shed)
         if outcome != QueueOutcome.DISPATCHED:
             code, reason = _OUTCOME_ERRORS.get(outcome, (429, outcome.value))
             raise AdmissionError(code, reason)

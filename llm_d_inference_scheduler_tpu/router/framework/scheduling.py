@@ -105,6 +105,12 @@ class InferenceRequest:
     request_size_bytes: int = 0
     # filled by the director after scheduling:
     scheduling_result: "SchedulingResult | None" = None
+    # Decision flight-recorder record (router/decisions.py DecisionRecord),
+    # opened by the director when the recorder is enabled; every layer hook
+    # degrades to one `is None` check when it is off. The scheduler republishes
+    # it into CycleState (DECISION_STATE_KEY) so plugins can annotate the
+    # cycle they run in.
+    decision: Any = None
 
 
 class CycleState:

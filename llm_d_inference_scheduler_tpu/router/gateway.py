@@ -33,6 +33,7 @@ from prometheus_client import generate_latest
 from .config.loader import Handle, RouterConfig, load_config
 from .datalayer.datastore import Datastore
 from .datalayer.runtime import DataLayerRuntime
+from .decisions import SCHEMA_VERSION, DecisionConfig, DecisionRecorder
 from .framework.scheduling import InferenceRequest
 from .handlers.parsers import make_parser
 from .metrics import (
@@ -76,6 +77,13 @@ ROUTER_OWNED_HEADERS = ("x-prefiller-host-port", "x-encoder-hosts-ports",
                         "x-data-parallel-host-port",
                         "x-gateway-destination-endpoint")
 
+# Decision flight recorder opt-in: a request carrying
+# `x-debug-decision: summary` gets the compact one-line verdict echoed in
+# the response's x-decision-summary header (curl-level debugging; the full
+# record stays on /debug/decisions/<request-id>).
+H_DEBUG_DECISION = "x-debug-decision"
+H_DECISION_SUMMARY = "x-decision-summary"
+
 
 class Gateway:
     def __init__(self, cfg: RouterConfig, datastore: Datastore,
@@ -110,6 +118,27 @@ class Gateway:
             min_per_sec=self.resilience.retry_budget_min_per_sec,
             burst=self.resilience.retry_budget_burst)
         datastore.breakers.configure(self.resilience)
+
+        # Decision flight recorder (router/decisions.py): default-on bounded
+        # ring; `decisions: {enabled: false}` is the kill-switch that
+        # restores the zero-overhead baseline.
+        self.decision_recorder = DecisionRecorder(
+            DecisionConfig.from_spec(cfg.decisions))
+
+        # Outbound TLS verification policy for router-side client legs
+        # (upstream proxy, /debug/traces + /v1/models fan-out). Default:
+        # skip-verify (in-cluster pod-local certs); `tlsClient.caCertPath`
+        # turns real verification on (ADVICE r5).
+        from .tlsutil import client_verify
+
+        tc = cfg.tls_client or {}
+        self._client_tls_verify = client_verify(
+            insecure_skip_verify=bool(tc.get("insecureSkipVerify", True)),
+            ca_cert_path=tc.get("caCertPath") or None)
+        # aiohttp form of the same policy: None = stock verification,
+        # SSLContext = CA bundle or permissive skip-verify context.
+        self._upstream_ssl = (None if self._client_tls_verify is True
+                              else self._client_tls_verify)
 
         # saturation detector: explicit spec or default utilization-detector
         from .framework.plugin import global_registry
@@ -150,7 +179,8 @@ class Gateway:
             pre_request_plugins=cfg.pre_request_plugins,
             response_received=cfg.response_received,
             response_streaming=cfg.response_streaming,
-            response_complete=cfg.response_complete)
+            response_complete=cfg.response_complete,
+            recorder=self.decision_recorder)
 
         self.app = web.Application()
         self.app.add_routes([
@@ -163,6 +193,8 @@ class Gateway:
             web.get("/v1/models", self.models),
             web.get("/debug/traces", self.traces),
             web.get("/debug/profile", self.profile),
+            web.get("/debug/decisions", self.decisions),
+            web.get("/debug/decisions/{request_id}", self.decision_detail),
         ])
         self._runner: web.AppRunner | None = None
         self._client: httpx.AsyncClient | None = None
@@ -219,8 +251,10 @@ class Gateway:
         await self.dl_runtime.start()
         if self.flow_controller is not None:
             await self.flow_controller.start()
+        # Verification policy from tlsClient config (default skip-verify:
+        # pod-local certs — no longer hardcoded, ADVICE r5).
         self._client = httpx.AsyncClient(timeout=httpx.Timeout(300.0, connect=5.0),
-                                         verify=False)  # pod-local certs
+                                         verify=self._client_tls_verify)
         # The proxy hop uses aiohttp's client: its C http parser costs a
         # fraction of httpx/h11 per chunk, and iter_any() coalesces SSE
         # events under load — together worth >30% through-router throughput
@@ -321,6 +355,34 @@ class Gateway:
                         spans.append(s)
         return web.json_response({"spans": spans})
 
+    async def decisions(self, request: web.Request) -> web.Response:
+        """Recent decision records (compact). ?n=N bounds the page (default
+        50); each entry carries the one-line summary plus admission/final
+        sections — the full record lives at /debug/decisions/<request-id>."""
+        try:
+            n = int(request.query.get("n", "50"))
+        except ValueError:
+            n = 50
+        recs = self.decision_recorder.snapshot(max(1, n))
+        return web.json_response({
+            "schema_version": SCHEMA_VERSION,
+            "enabled": self.decision_recorder.enabled,
+            "count": len(self.decision_recorder),
+            "decisions": [r.to_dict(compact=True) for r in recs],
+        })
+
+    async def decision_detail(self, request: web.Request) -> web.Response:
+        """Full schema-versioned DecisionRecord for one request id:
+        admission → flow control → per-profile filter drops + scorer tables +
+        picker pick → retry/failover attempt trail."""
+        rid = request.match_info["request_id"]
+        rec = self.decision_recorder.get(rid)
+        if rec is None:
+            return web.json_response(
+                {"error": f"no decision record for request id {rid!r}",
+                 "enabled": self.decision_recorder.enabled}, status=404)
+        return web.json_response(rec.to_dict())
+
     async def profile(self, request: web.Request) -> web.Response:
         """CPU profile of the router process for ?seconds=N (pprof analogue;
         reference mounts pprof handlers behind --enable-pprof, SURVEY §5)."""
@@ -416,7 +478,8 @@ class Gateway:
         except RequestError as e:
             return web.json_response(
                 {"error": e.reason}, status=e.code,
-                headers={X_REMOVAL_REASON: e.reason})
+                headers={X_REMOVAL_REASON: e.reason,
+                         **self._decision_headers(ireq)})
 
         # Repackage through the parser (director.go:289-306) only when the
         # bytes must change: model rewrite, or a translating (non-OpenAI)
@@ -449,15 +512,28 @@ class Gateway:
             if self.evictor.was_evicted(evict_key) and not stream_state["started"]:
                 from .flowcontrol.eviction import EVICTED_REASON
 
+                if ireq.decision is not None:
+                    ireq.decision.record_event("evicted_inflight")
+                    ireq.decision.finalize(429, reason=EVICTED_REASON)
                 return web.json_response(
                     {"error": EVICTED_REASON}, status=429,
-                    headers={X_REMOVAL_REASON: EVICTED_REASON})
+                    headers={X_REMOVAL_REASON: EVICTED_REASON,
+                             **self._decision_headers(ireq)})
             # Mid-stream eviction (or external cancel): the 200 status line is
             # already on the wire — the only clean signal is the dropped
             # connection, so propagate.
             raise
         finally:
             self.evictor.deregister(evict_key)
+
+    @staticmethod
+    def _decision_headers(ireq: InferenceRequest | None) -> dict[str, str]:
+        """The x-decision-summary echo, present only when the client opted
+        in with `x-debug-decision: summary` and a record exists."""
+        if (ireq is not None and ireq.decision is not None
+                and ireq.headers.get(H_DEBUG_DECISION, "").lower() == "summary"):
+            return {H_DECISION_SUMMARY: ireq.decision.summary_line()}
+        return {}
 
     def _dp_override(self, ireq: InferenceRequest, target) -> str | None:
         """DP rank routing: when a profile handler picked a rank, route to
@@ -504,6 +580,7 @@ class Gateway:
         res = self.resilience
         breakers = self.datastore.breakers
         self.retry_budget.deposit()
+        rec = ireq.decision if ireq is not None else None
         attempted: set[str] = set()
         rescheduled = ireq is None  # only scheduled requests can re-schedule
         failure: UpstreamFailure | None = None
@@ -515,6 +592,8 @@ class Gateway:
             if deadline is not None and deadline.expired:
                 failure = UpstreamFailure(
                     "deadline", 504, DEADLINE_EXCEEDED_REASON)
+                if rec is not None:
+                    rec.record_event("deadline_exceeded")
                 break
             target = None
             for ep in candidates:
@@ -523,6 +602,8 @@ class Gateway:
                     continue
                 if not breakers.allow(k):
                     blocked.add(k)
+                    if rec is not None:
+                        rec.record_event("breaker_denied", endpoint=k)
                     continue
                 target = ep
                 break
@@ -547,6 +628,9 @@ class Gateway:
                     # allow() above may have claimed the half-open probe
                     # slot; this attempt never dispatches, so free it.
                     breakers.release_probe(key)
+                    if rec is not None:
+                        rec.record_event("retry_budget_exhausted",
+                                         endpoint=key)
                     break
                 RETRIES_TOTAL.labels(failure.kind if failure
                                      else "other").inc()
@@ -564,6 +648,10 @@ class Gateway:
                 failure = f
                 attempted.add(key)
                 breakers.record_failure(key)
+                if rec is not None:
+                    rec.record_attempt(key, f.kind,
+                                       status=f.status or None,
+                                       reason=f.reason)
                 log.warning("upstream %s failed pre-stream (%s: %s); %s",
                             key, f.kind, f.detail or f.reason,
                             "retrying" if attempt < res.max_attempts
@@ -592,27 +680,38 @@ class Gateway:
         # last failure with the canonical x-removal-reason contract.
         if ireq is not None:
             self.director.handle_response_complete(None, ireq, last_target, {})
+        dec_headers = self._decision_headers(ireq)
         if failure is not None and failure.kind == "deadline":
             DEADLINE_EXCEEDED_TOTAL.inc()
+            if rec is not None:
+                rec.finalize(504, reason=DEADLINE_EXCEEDED_REASON)
             return web.json_response(
                 {"error": "deadline exceeded"}, status=504,
-                headers={X_REMOVAL_REASON: DEADLINE_EXCEEDED_REASON})
+                headers={X_REMOVAL_REASON: DEADLINE_EXCEEDED_REASON,
+                         **dec_headers})
         # Budget-suppressed fast-fails are marked in the body so operators
         # (and tests) can tell them from ordinary upstream errors; the
         # x-removal-reason header keeps the upstream's own cause.
         extra = {"retry": RETRY_BUDGET_REASON} if budget_exhausted else {}
         if failure is not None and failure.kind in ("connect", "read"):
+            if rec is not None:
+                rec.finalize(502, reason=failure.reason)
             return web.json_response(
                 {"error": f"upstream {failure.kind} failed: {failure.detail}",
                  **extra},
-                status=502, headers={X_REMOVAL_REASON: failure.reason})
+                status=502, headers={X_REMOVAL_REASON: failure.reason,
+                                     **dec_headers})
         if failure is not None:  # retryable status, relayed as-is
+            if rec is not None:
+                rec.finalize(failure.status, reason=failure.reason)
             return web.json_response(
                 {"error": failure.reason, **extra}, status=failure.status,
-                headers={X_REMOVAL_REASON: failure.reason})
+                headers={X_REMOVAL_REASON: failure.reason, **dec_headers})
+        if rec is not None:
+            rec.finalize(503, reason="no-upstream-available")
         return web.json_response(
             {"error": "no upstream endpoint available"}, status=503,
-            headers={X_REMOVAL_REASON: "no-upstream-available"})
+            headers={X_REMOVAL_REASON: "no-upstream-available", **dec_headers})
 
     async def _proxy(self, request: web.Request, ireq: InferenceRequest | None,
                      endpoint, body: bytes, headers: dict[str, str],
@@ -641,11 +740,13 @@ class Gateway:
             kwargs["timeout"] = aiohttp.ClientTimeout(
                 total=remaining, sock_connect=min(5.0, remaining))
         try:
-            # ssl=False skips verification on https endpoints (pod-local
-            # certs — TLS engines started with --secure-serving).
+            # TLS legs follow the tlsClient verification policy (default: a
+            # skip-verify context for pod-local certs — engines started with
+            # --secure-serving; a configured CA bundle verifies for real).
             resp = await self._upstream.post(
                 url, data=body, headers=fwd,
-                ssl=False if url.startswith("https") else None, **kwargs)
+                ssl=self._upstream_ssl if url.startswith("https") else None,
+                **kwargs)
         except Exception as e:
             raise UpstreamFailure("connect", 0, "upstream-connect-error",
                                   str(e)) from e
@@ -675,11 +776,20 @@ class Gateway:
 
         if ireq is not None:
             self.director.handle_response_received(None, ireq, endpoint, resp.status)
+            if ireq.decision is not None:
+                # The relayed attempt is recorded BEFORE the response headers
+                # are built so the x-decision-summary echo below agrees with
+                # the /debug/decisions record (same attempt count/outcome).
+                ireq.decision.record_attempt(
+                    endpoint.metadata.address_port, "ok", status=resp.status)
+                ireq.decision.finalize(
+                    resp.status, destination=endpoint.metadata.address_port)
 
         out_headers = {
             H_DESTINATION_SERVED: endpoint.metadata.address_port,
             "content-type": resp.headers.get("content-type", "application/json"),
         }
+        out_headers.update(self._decision_headers(ireq))  # x-debug-decision echo
         if ireq is not None and "x-session-token" in ireq.headers:
             # Session stickiness: return the (scheduling-stamped) encoded
             # token to the client (reference session_affinity.go ResponseBody).

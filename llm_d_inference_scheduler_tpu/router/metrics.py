@@ -113,3 +113,22 @@ UPSTREAM_STREAM_ABORTED_TOTAL = Counter(
     "router_upstream_stream_aborted_total",
     "Response streams cut mid-relay by an upstream disconnect (closed "
     "cleanly toward the client instead of raising)", registry=REGISTRY)
+# Decision flight recorder aggregates (router/decisions.py): the histogram/
+# counter shadows of the per-request records, so score distributions, filter
+# pressure, and pick decisiveness are graphable without reading records.
+# Label cardinality is bounded by the configured plugin set.
+SCORER_SCORE = Histogram(
+    "router_scorer_score",
+    "Per-endpoint raw scorer outputs observed at scheduling time",
+    ("scorer",), registry=REGISTRY,
+    buckets=(0.0, .1, .2, .3, .4, .5, .6, .7, .8, .9, 1.0))
+FILTER_DROPPED_TOTAL = Counter(
+    "router_filter_dropped_endpoints_total",
+    "Candidate endpoints removed per scheduling filter",
+    ("filter",), registry=REGISTRY)
+PICKER_WIN_MARGIN = Histogram(
+    "router_picker_win_margin",
+    "Weighted-score margin between the picked endpoint and the runner-up "
+    "(0 = coin flip; large = decisive pick)",
+    ("picker",), registry=REGISTRY,
+    buckets=(0.0, .01, .025, .05, .1, .25, .5, 1.0, 2.0, 4.0))

@@ -77,7 +77,7 @@ def _keyvalue(key: str, v: Any) -> bytes:
 def encode_span(span: dict[str, Any], epoch_offset_ns: int) -> bytes:
     """opentelemetry.proto.trace.v1.Span: trace_id=1, span_id=2,
     parent_span_id=4, name=5, kind=6, start=7, end=8, attributes=9,
-    status=15. Real per-span wall-clock start (tracing.py stamps
+    events=11, status=15. Real per-span wall-clock start (tracing.py stamps
     start_unix_ns at span begin); epoch_offset_ns is only the fallback for
     records without one."""
     start_ns = int(span.get("start_unix_ns") or epoch_offset_ns)
@@ -93,6 +93,15 @@ def encode_span(span: dict[str, Any], epoch_offset_ns: int) -> bytes:
     out += _fixed64(8, end_ns)
     for k, v in (span.get("attributes") or {}).items():
         out += _ld(9, _keyvalue(k, v))
+    for ev in span.get("events") or ():
+        # Span.Event: time_unix_nano=1, name=2, attributes=3 (the decision
+        # flight recorder's phase summaries ride these).
+        ev_bytes = bytearray()
+        ev_bytes += _fixed64(1, int(ev.get("time_unix_ns") or start_ns))
+        ev_bytes += _str(2, str(ev.get("name", "")))
+        for k, v in (ev.get("attributes") or {}).items():
+            ev_bytes += _ld(3, _keyvalue(k, v))
+        out += _ld(11, bytes(ev_bytes))
     status = span.get("status", "ok")
     if status == "ok":
         out += _ld(15, _tag(3, 0) + _varint(1))   # code=STATUS_CODE_OK
@@ -192,6 +201,13 @@ def span_to_otlp_json(span: dict[str, Any], service_name: str) -> dict[str, Any]
     }
     if span.get("parent_id"):
         doc["parentSpanId"] = span["parent_id"][:16].rjust(16, "0")
+    if span.get("events"):
+        doc["events"] = [
+            {"timeUnixNano": str(int(ev.get("time_unix_ns") or start_ns)),
+             "name": str(ev.get("name", "")),
+             "attributes": [{"key": k, "value": attr_value(v)}
+                            for k, v in (ev.get("attributes") or {}).items()]}
+            for ev in span["events"]]
     return {"resourceSpans": [{
         "resource": {"attributes": [{"key": "service.name",
                                      "value": {"stringValue": service_name}}]},

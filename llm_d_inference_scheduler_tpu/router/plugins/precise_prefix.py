@@ -112,6 +112,10 @@ class PrecisePrefixCacheScorer(PluginBase):
         self.block_size_tokens = 16
         self.events_port_offset = 1000
         self.transport = "http"  # "http" (SSE, default) | "zmq"
+        # TLS verification for https kv-event streams: skip-verify default
+        # (pod-local certs), CA bundle opts into real verification.
+        self.insecure_skip_verify = True
+        self.ca_cert_path: str | None = None
         # One sync SUB per pod, each on its own thread. Deliberately NOT
         # zmq.asyncio: asyncio SUB sockets in this stack intermittently never
         # woke for delivered messages (the same wire traffic was visible to a
@@ -125,6 +129,9 @@ class PrecisePrefixCacheScorer(PluginBase):
         self.events_port_offset = int(params.get("eventsPortOffset",
                                                  self.events_port_offset))
         self.transport = params.get("transport", self.transport)
+        self.insecure_skip_verify = bool(
+            params.get("insecureSkipVerify", self.insecure_skip_verify))
+        self.ca_cert_path = params.get("caCertPath") or None
 
     # ---- scoring -------------------------------------------------------
 
@@ -205,10 +212,13 @@ class PrecisePrefixCacheScorer(PluginBase):
         import httpx
 
         log.info("kv-event SSE subscriber for %s at %s", pod, url)
+        from ..tlsutil import client_verify
+
+        verify = client_verify(self.insecure_skip_verify, self.ca_cert_path)
         while not stop.is_set():
             try:
                 with httpx.Client(timeout=httpx.Timeout(5.0, read=5.0),
-                                  verify=False) as client:  # pod-local certs
+                                  verify=verify) as client:
                     with client.stream("GET", url) as r:
                         if r.status_code != 200:
                             raise ConnectionError(f"status {r.status_code}")

@@ -58,10 +58,15 @@ class Director:
                  pre_request_plugins: list[Any] | None = None,
                  response_received: list[Any] | None = None,
                  response_streaming: list[Any] | None = None,
-                 response_complete: list[Any] | None = None):
+                 response_complete: list[Any] | None = None,
+                 recorder: Any = None):
         self.datastore = datastore
         self.scheduler = scheduler
         self.admission = admission
+        # Decision flight recorder (router/decisions.py DecisionRecorder);
+        # None or disabled → request.decision stays None and every layer
+        # hook costs one `is None` check.
+        self.recorder = recorder
         self.producers = producers or []
         self.admit_plugins = admit_plugins or []
         self.pre_request_plugins = pre_request_plugins or []
@@ -75,10 +80,24 @@ class Director:
     async def handle_request(self, ctx: Any, request: InferenceRequest) -> SchedulingResult:
         from ..tracing import tracer
 
+        if self.recorder is not None:
+            request.decision = self.recorder.start(request.request_id,
+                                                   request.target_model)
         with tracer.span("gateway.request_orchestration",
                          request_id=request.request_id,
                          model=request.target_model) as span:
-            result = await self._handle_request(ctx, request)
+            try:
+                result = await self._handle_request(ctx, request)
+            finally:
+                # Attach the decision phase summaries as span events so
+                # /debug/traces?merge=1 correlates decision and latency in
+                # one tree (rejections included). The events-attr probe
+                # skips the summary building entirely on no-op spans
+                # (tracing off / sampled out).
+                rec = request.decision
+                if rec is not None and hasattr(span, "events"):
+                    for name, attrs in rec.span_events():
+                        span.add_event(name, **attrs)
             span.set_attribute(
                 "target", request.headers.get(H_DESTINATION, ""))
             span.set_attribute("profiles", list(result.profile_results))
@@ -87,6 +106,7 @@ class Director:
     async def _handle_request(self, ctx: Any,
                               request: InferenceRequest) -> SchedulingResult:
         original_model = request.target_model
+        rec = request.decision
 
         # 1. weighted model rewrite (director.go:263-343)
         rewrite_hdr = request.headers.get(H_MODEL_REWRITE)
@@ -96,6 +116,8 @@ class Director:
             rw = self.datastore.rewrite_for(request.target_model)
             if rw is not None:
                 request.target_model = rw.pick_target(self._rng)
+        if rec is not None and request.target_model != original_model:
+            rec.record_rewrite(request.target_model)
 
         # 2. objective → priority (director.go:164-178)
         obj_name = request.headers.get(H_OBJECTIVE, "")
@@ -103,28 +125,51 @@ class Director:
             obj = self.datastore.objective_get(obj_name)
             if obj is not None:
                 request.objectives.priority = obj.priority
+        if rec is not None:
+            rec.priority = request.objectives.priority
 
         # 3. candidates (+ Envoy subset hint restriction, metadata.go:40-50)
         candidates = self._candidates(request)
         if not candidates:
             REQUEST_ERROR_TOTAL.labels(original_model, "no_endpoints").inc()
+            if rec is not None:
+                rec.finalize(503, reason="no ready endpoints in pool")
             raise RequestError(503, "no ready endpoints in pool")
 
-        # 4. admission (may block in flow control / shed sheddable load)
+        # 4. admission (may block in flow control / shed sheddable load).
+        # The flow-control controller writes the detailed section (queue
+        # time, band, flow id); this fallback covers the legacy/always paths.
         try:
             await self.admission.admit(ctx, request, candidates)
+            if rec is not None and not rec.admission:
+                rec.record_admission(type(self.admission).__name__, "admitted")
         except AdmissionError as e:
             REQUEST_ERROR_TOTAL.labels(original_model, "admission").inc()
+            if rec is not None:
+                if not rec.admission:
+                    rec.record_admission(type(self.admission).__name__,
+                                         "rejected", reason=e.reason)
+                rec.finalize(e.code, reason=e.reason)
             raise RequestError(e.code, e.reason) from None
 
         # 5. data producers under a global budget (director.go:232, 400ms)
+        t_prod = time.monotonic()
         await self._run_producers(ctx, request, candidates)
+        if rec is not None and self.producers:
+            rec.record_producers(
+                (time.monotonic() - t_prod) * 1e3, PRODUCER_BUDGET_S * 1e3,
+                [str(p.typed_name()) for p in self.producers])
 
         # 6. admit plugins (latency SLO admitters etc.)
         for p in self.admit_plugins:
             ok, reason = await p.admit(ctx, request, candidates)
             if not ok:
                 REQUEST_ERROR_TOTAL.labels(original_model, "admit_plugin").inc()
+                if rec is not None:
+                    # The flow-control section (if any) stays; the plugin
+                    # verdict lands beside it rather than clobbering it.
+                    rec.record_admit_plugin_reject(str(p.typed_name()), reason)
+                    rec.finalize(429, reason=reason)
                 raise RequestError(429, reason)
 
         # 7. schedule
@@ -132,6 +177,8 @@ class Director:
             result = self.scheduler.schedule(ctx, request, candidates)
         except Exception as e:
             REQUEST_ERROR_TOTAL.labels(original_model, "scheduling").inc()
+            if rec is not None:
+                rec.finalize(503, reason=f"scheduling failed: {e}")
             raise RequestError(503, f"scheduling failed: {e}") from None
         request.scheduling_result = result
 
@@ -177,13 +224,21 @@ class Director:
         request exactly once). Returns None when no viable result exists."""
         candidates = [ep for ep in self._candidates(request)
                       if ep.metadata.address_port not in exclude]
+        rec = request.decision
         if not candidates:
+            if rec is not None:
+                rec.record_event("reschedule_exhausted",
+                                 excluded=sorted(exclude))
             return None
+        if rec is not None:
+            rec.record_event("reschedule", excluded=sorted(exclude))
         try:
             result = self.scheduler.schedule(ctx, request, candidates)
         except Exception as e:
             log.warning("failover reschedule failed for %s: %s",
                         request.request_id, e)
+            if rec is not None:
+                rec.record_event("reschedule_failed", error=str(e))
             return None
         request.scheduling_result = result
         primary = result.primary().target_endpoints

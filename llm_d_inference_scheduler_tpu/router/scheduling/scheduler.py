@@ -6,6 +6,15 @@ until none remain, each profile runs its filter chain (short-circuit on
 empty), weighted-sums scorer outputs (clamped to [0,1]), and delegates the
 final choice to its picker; the handler then folds per-profile results into a
 SchedulingResult.
+
+Decision flight recorder (router/decisions.py): when the request carries a
+DecisionRecord, each profile run logs per-filter drops, per-scorer
+per-endpoint raw+weighted scores (top-K at render), and the picker's choice
+with its win margin; the aggregate metric shadows (router_scorer_score —
+sampled, router_filter_dropped_endpoints_total, router_picker_win_margin)
+ride the same gate so the decisions kill-switch restores the pre-recorder
+baseline. The record travels via CycleState (DECISION_STATE_KEY) so plugins
+can annotate the cycle too.
 """
 
 from __future__ import annotations
@@ -15,6 +24,7 @@ import logging
 import time
 from typing import Any
 
+from ..decisions import DECISION_STATE_KEY
 from ..framework.datalayer import Endpoint
 from ..framework.scheduling import (
     CycleState,
@@ -23,7 +33,13 @@ from ..framework.scheduling import (
     ScoredEndpoint,
     SchedulingResult,
 )
-from ..metrics import SCHEDULER_E2E_SECONDS, PLUGIN_DURATION_SECONDS
+from ..metrics import (
+    FILTER_DROPPED_TOTAL,
+    PICKER_WIN_MARGIN,
+    PLUGIN_DURATION_SECONDS,
+    SCHEDULER_E2E_SECONDS,
+    SCORER_SCORE,
+)
 
 log = logging.getLogger("router.scheduler")
 
@@ -41,6 +57,26 @@ class SchedulerProfile:
         self.filters = filters
         self.scorers = scorers
         self.picker = picker
+        # Metric label children resolved once (labels() hashes + locks per
+        # call — measurable when the recorder observes per endpoint).
+        self._filter_meta = [(f, str(f.typed_name()),
+                              FILTER_DROPPED_TOTAL.labels(str(f.typed_name())))
+                             for f in filters]
+        self._scorer_meta = [(ws, str(ws.scorer.typed_name()),
+                              SCORER_SCORE.labels(str(ws.scorer.typed_name())))
+                             for ws in scorers]
+        self._picker_name = str(picker.typed_name())
+        self._picker_margin = PICKER_WIN_MARGIN.labels(self._picker_name)
+        # Per-endpoint score observations are sampled 1-in-N: the decision
+        # record keeps every score (zero-copy), but feeding each of
+        # |scorers| × |candidates| values through a prometheus histogram
+        # every cycle is the recorder's single biggest CPU cost, and the
+        # distribution converges just as well sampled. Starts at N-1 so the
+        # very first recorded cycle observes (test determinism).
+        self._obs_tick = self.SCORE_OBS_SAMPLE - 1
+
+    # Sampling period for router_scorer_score observations (see __init__).
+    SCORE_OBS_SAMPLE = 8
 
     def run(self, ctx: Any, request: InferenceRequest, state: CycleState,
             endpoints: list[Endpoint]) -> ProfileRunResult | None:
@@ -48,35 +84,80 @@ class SchedulerProfile:
         # read which profile pass they are scoring (e.g. no-hit-lru records
         # its cold decision per profile).
         state.write("current_profile", self.name)
+        rec = state.read(DECISION_STATE_KEY)
+        rec_sec = (rec.begin_profile(self.name, len(endpoints))
+                   if rec is not None else None)
         candidates = endpoints
-        for f in self.filters:
+        for f, fname, drop_counter in self._filter_meta:
             t0 = time.monotonic()
+            before = candidates
             candidates = f.filter(ctx, state, request, candidates)
-            PLUGIN_DURATION_SECONDS.labels("filter", str(f.typed_name())).observe(
+            PLUGIN_DURATION_SECONDS.labels("filter", fname).observe(
                 time.monotonic() - t0)
+            # Drop bookkeeping + aggregate shadow metrics ride the recorder
+            # gate: the decisions kill-switch must restore the pre-recorder
+            # baseline, so nothing here runs when it is off.
+            if rec_sec is not None:
+                kept_list = [ep.metadata.address_port for ep in candidates]
+                kept = set(kept_list)
+                dropped = [ep.metadata.address_port for ep in before
+                           if ep.metadata.address_port not in kept]
+                if dropped:
+                    drop_counter.inc(len(dropped))
+                rec.profile_filter(rec_sec, fname, len(before),
+                                   kept_list, dropped)
             if not candidates:
                 log.debug("profile %s: filter %s emptied the candidate set",
                           self.name, f.typed_name())
+                if rec_sec is not None:
+                    rec_sec["outcome"] = "filtered_empty"
                 return None
 
+        observe_scores = False
+        if rec_sec is not None:
+            self._obs_tick = (self._obs_tick + 1) % self.SCORE_OBS_SAMPLE
+            observe_scores = self._obs_tick == 0
         totals: dict[str, float] = {ep.metadata.address_port: 0.0 for ep in candidates}
         raw_scores: dict[str, dict[str, float]] = {}
-        for ws in self.scorers:
+        for ws, sname, score_hist in self._scorer_meta:
             t0 = time.monotonic()
             scores = ws.scorer.score(ctx, state, request, candidates)
-            PLUGIN_DURATION_SECONDS.labels("scorer", str(ws.scorer.typed_name())).observe(
+            PLUGIN_DURATION_SECONDS.labels("scorer", sname).observe(
                 time.monotonic() - t0)
-            raw_scores[str(ws.scorer.typed_name())] = scores
-            for key in totals:
-                s = min(max(scores.get(key, 0.0), 0.0), 1.0)  # clamp to [0,1]
-                totals[key] += ws.weight * s
+            raw_scores[sname] = scores
+            if rec_sec is not None:
+                # The record keeps every score (zero-copy: the scorer result
+                # dict is referenced); the histogram shadow is sampled.
+                if observe_scores:
+                    for key in totals:
+                        s = min(max(scores.get(key, 0.0), 0.0), 1.0)
+                        totals[key] += ws.weight * s
+                        score_hist.observe(s)
+                else:
+                    for key in totals:
+                        s = min(max(scores.get(key, 0.0), 0.0), 1.0)
+                        totals[key] += ws.weight * s
+                rec.profile_scorer(rec_sec, sname, ws.weight, scores)
+            else:
+                for key in totals:
+                    s = min(max(scores.get(key, 0.0), 0.0), 1.0)  # clamp [0,1]
+                    totals[key] += ws.weight * s
 
         scored = [ScoredEndpoint(ep, totals[ep.metadata.address_port])
                   for ep in candidates]
+        pname = self._picker_name
         t0 = time.monotonic()
         picked = self.picker.pick(ctx, state, request, scored)
-        PLUGIN_DURATION_SECONDS.labels("picker", str(self.picker.typed_name())).observe(
+        PLUGIN_DURATION_SECONDS.labels("picker", pname).observe(
             time.monotonic() - t0)
+        if rec_sec is not None:
+            picked_keys = [ep.metadata.address_port for ep in picked]
+            if picked and len(totals) > 1:
+                winner = totals[picked_keys[0]]
+                runner_up = max(v for k, v in totals.items()
+                                if k != picked_keys[0])
+                self._picker_margin.observe(max(winner - runner_up, 0.0))
+            rec.profile_picker(rec_sec, pname, picked_keys, totals)
         if not picked:
             return None
         return ProfileRunResult(target_endpoints=picked, raw_scores=raw_scores)
@@ -91,6 +172,11 @@ class Scheduler:
                  candidates: list[Endpoint]) -> SchedulingResult:
         t_start = time.monotonic()
         state = CycleState()
+        rec = getattr(request, "decision", None)
+        if rec is not None:
+            state.write(DECISION_STATE_KEY, rec)
+            rec.begin_round("reschedule" if rec.rounds else "schedule",
+                            len(candidates))
         results: dict[str, ProfileRunResult] = {}
         while True:
             to_run = self.profile_handler.pick_profiles(

@@ -213,13 +213,18 @@ def client_verify(insecure_skip_verify: bool = False,
                   ca_cert_path: str | None = None) -> Any:
     """The httpx ``verify`` argument for a TLS client leg
     (proxy_helpers.go client transport): a CA bundle path, a permissive
-    context when verification is skipped, or stock verification."""
+    context when verification is skipped, or stock verification.
+
+    A CA bundle TAKES PRECEDENCE over the skip flag: the router-side config
+    surfaces default ``insecureSkipVerify`` to true (pod-local certs), so an
+    operator setting only ``caCertPath`` means "verify against this bundle"
+    — silently keeping CERT_NONE there would be a believed-but-absent
+    security property."""
+    if ca_cert_path:
+        return ssl.create_default_context(cafile=ca_cert_path)
     if insecure_skip_verify:
         ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
         ctx.check_hostname = False
         ctx.verify_mode = ssl.CERT_NONE
-        return ctx
-    if ca_cert_path:
-        ctx = ssl.create_default_context(cafile=ca_cert_path)
         return ctx
     return True

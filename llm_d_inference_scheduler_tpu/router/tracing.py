@@ -74,7 +74,12 @@ def parse_traceparent(value: str) -> tuple[str, str, bool] | None:
 
 class Span:
     __slots__ = ("name", "trace_id", "span_id", "parent_id", "start", "end",
-                 "start_unix_ns", "attributes", "status", "tracestate")
+                 "start_unix_ns", "attributes", "status", "tracestate",
+                 "events")
+
+    # Per-span event cap: events carry decision-record phase summaries and
+    # similar annotations, never unbounded streams.
+    MAX_EVENTS = 64
 
     def __init__(self, name: str, trace_id: str, parent_id: str | None):
         self.name = name
@@ -87,12 +92,21 @@ class Span:
         self.attributes: dict[str, Any] = {}
         self.status = "ok"
         self.tracestate: str | None = None   # W3C tracestate, passed through
+        self.events: list[dict[str, Any]] = []
 
     def set_attribute(self, key: str, value: Any) -> None:
         self.attributes[key] = value
 
+    def add_event(self, name: str, **attributes: Any) -> None:
+        """OTel-shaped span event: a named, timestamped annotation inside
+        the span (decision-record phase summaries ride these)."""
+        if len(self.events) >= self.MAX_EVENTS:
+            return
+        self.events.append({"name": name, "time_unix_ns": time.time_ns(),
+                            "attributes": attributes})
+
     def to_dict(self) -> dict[str, Any]:
-        return {
+        doc = {
             "name": self.name,
             "trace_id": self.trace_id,
             "span_id": self.span_id,
@@ -102,6 +116,9 @@ class Span:
             "attributes": self.attributes,
             "status": self.status,
         }
+        if self.events:
+            doc["events"] = self.events
+        return doc
 
 
 class Tracer:
@@ -269,6 +286,9 @@ class FileSpanExporter:
 
 class _NoopSpan:
     def set_attribute(self, key: str, value: Any) -> None:
+        pass
+
+    def add_event(self, name: str, **attributes: Any) -> None:
         pass
 
 

@@ -1,0 +1,156 @@
+"""Decision-recorder coverage lint: every registered scheduling plugin
+must show up in a recorded decision.
+
+The flight recorder's value is completeness — "why did request X land on
+pod Y" has to name EVERY filter that pruned, scorer that ranked, and picker
+that chose. A plugin that bypasses the recorder (e.g. a future scorer
+subclassing around the profile loop, or a picker registered under a type the
+scheduler never threads through) silently punches a hole in the trail. This
+check instantiates every registered plugin type, drives each
+filter/scorer/picker through a real ``Scheduler.schedule`` cycle with a
+recorder attached, and fails unless the plugin's type name appears in the
+resulting ``DecisionRecord``.
+
+Run via ``make verify-decisions``; tests/test_decisions.py hooks it into the
+pytest run so CI catches recorder-bypassing plugins statically.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _endpoints():
+    from llm_d_inference_scheduler_tpu.router.framework.datalayer import (
+        Endpoint,
+        EndpointMetadata,
+    )
+
+    eps = []
+    for i, role in enumerate(["decode", "prefill", "encode", "both", ""]):
+        labels = {"llm-d.ai/role": role} if role else {}
+        ep = Endpoint(EndpointMetadata(name=f"ep{i}", address=f"10.9.0.{i}",
+                                       port=9000, labels=labels))
+        ep.metrics.waiting_queue_size = i
+        ep.metrics.kv_cache_usage_percent = 0.1 * i
+        eps.append(ep)
+    return eps
+
+
+def _request(i: int, rec):
+    from llm_d_inference_scheduler_tpu.router.framework.scheduling import (
+        InferenceRequest,
+        InferenceRequestBody,
+    )
+
+    req = InferenceRequest(
+        request_id=f"verify-decisions-{i}", target_model="tiny",
+        body=InferenceRequestBody(completions={"prompt": "verify " * 8}))
+    req.decision = rec
+    return req
+
+
+def check() -> list[str]:
+    import llm_d_inference_scheduler_tpu.router.plugins  # noqa: F401
+    import llm_d_inference_scheduler_tpu.router.plugins.saturation  # noqa: F401
+    import llm_d_inference_scheduler_tpu.router.requestcontrol.producers  # noqa: F401
+    from llm_d_inference_scheduler_tpu.router.config.loader import Handle
+    from llm_d_inference_scheduler_tpu.router.datalayer.datastore import Datastore
+    from llm_d_inference_scheduler_tpu.router.decisions import (
+        DecisionConfig,
+        DecisionRecorder,
+    )
+    from llm_d_inference_scheduler_tpu.router.framework.plugin import (
+        global_registry,
+    )
+    from llm_d_inference_scheduler_tpu.router.plugins.profile_handlers import (
+        SchedulingError,
+        SingleProfileHandler,
+    )
+    from llm_d_inference_scheduler_tpu.router.scheduling.scheduler import (
+        Scheduler,
+        SchedulerProfile,
+        WeightedScorer,
+    )
+
+    handle = Handle(datastore=Datastore())
+    recorder = DecisionRecorder(DecisionConfig(enabled=True))
+    endpoints = _endpoints()
+    errors: list[str] = []
+
+    # Instantiate every registered type once (aliases collapse onto the same
+    # class; dedupe by canonical cls.TYPE so each plugin is checked once).
+    plugins: dict[str, object] = {}
+    for type_name in global_registry.known_types():
+        try:
+            obj = global_registry.instantiate(type_name, type_name, {}, handle)
+        except Exception as e:
+            errors.append(f"plugin type {type_name!r} failed to instantiate "
+                          f"with empty parameters: {e}")
+            continue
+        plugins.setdefault(type(obj).TYPE, obj)
+
+    def default_picker():
+        return global_registry.instantiate(
+            "max-score-picker", "max-score-picker", {}, handle)
+
+    checked = 0
+    for canonical, plugin in sorted(plugins.items()):
+        is_filter = hasattr(plugin, "filter")
+        is_scorer = hasattr(plugin, "score")
+        is_picker = hasattr(plugin, "pick")
+        if not (is_filter or is_scorer or is_picker):
+            continue  # not a scheduling-cycle plugin (producer, handler, …)
+        checked += 1
+        if is_picker:
+            profile = SchedulerProfile("p", [], [], plugin)
+        elif is_scorer:
+            profile = SchedulerProfile(
+                "p", [], [WeightedScorer(plugin, 1.0)], default_picker())
+        else:
+            profile = SchedulerProfile("p", [plugin], [], default_picker())
+        sched = Scheduler({"p": profile}, SingleProfileHandler())
+        rec = recorder.start(f"vd-{canonical}", "tiny")
+        try:
+            sched.schedule(None, _request(checked, rec), endpoints)
+        except SchedulingError:
+            pass  # a filter may legitimately empty the set; still recorded
+        except Exception as e:
+            errors.append(f"{canonical}: schedule cycle raised {e!r}")
+            continue
+        doc = rec.to_dict()
+        names: set[str] = set()
+        for rnd in doc["rounds"]:
+            for sec in rnd["profiles"].values():
+                names.update(f["plugin"].split("/")[0] for f in sec["filters"])
+                names.update(k.split("/")[0] for k in sec["scorers"])
+                if sec["picker"]:
+                    names.add(sec["picker"]["plugin"].split("/")[0])
+        if canonical not in names:
+            role = ("picker" if is_picker
+                    else "scorer" if is_scorer else "filter")
+            errors.append(
+                f"{role} {canonical!r} ran a scheduling cycle but never "
+                f"appeared in the DecisionRecord (recorder bypass)")
+    if checked == 0:
+        errors.append("no filter/scorer/picker plugin types registered — "
+                      "registry import broken?")
+    return errors
+
+
+def main() -> int:
+    errors = check()
+    for e in errors:
+        print(f"verify-decisions: {e}", file=sys.stderr)
+    if errors:
+        return 1
+    print("verify-decisions: every registered filter/scorer/picker type "
+          "appears in a recorded decision")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

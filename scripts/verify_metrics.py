@@ -29,6 +29,19 @@ FORBIDDEN_LABELS = {
     "url", "query", "prompt",
 }
 
+# Families other subsystems depend on by name (docs, dashboards, the
+# decision flight recorder's aggregate shadows): their silent removal or
+# rename is a break, so the lint pins them. (name, source-registry).
+REQUIRED_FAMILIES = {
+    # Counter family names appear here WITHOUT the _total suffix
+    # (prometheus_client strips it from the collector name).
+    ("router_scorer_score", "router"),
+    ("router_filter_dropped_endpoints", "router"),
+    ("router_picker_win_margin", "router"),
+    ("router_retries", "router"),
+    ("router_endpoint_circuit_breaker_state", "router"),
+}
+
 
 def _families(registry, source: str):
     # Prefer the DECLARED label names (a labeled family with no children yet
@@ -73,8 +86,10 @@ def collect_registries():
 def check() -> list[str]:
     errors: list[str] = []
     seen: dict[str, str] = {}
+    required = set(REQUIRED_FAMILIES)
     for source, registry in collect_registries():
         for name, labels, src in _families(registry, source):
+            required.discard((name, src))
             prev = seen.get(name)
             if prev is not None and prev != src:
                 errors.append(
@@ -88,6 +103,9 @@ def check() -> list[str]:
                 errors.append(
                     f"{src} family {name!r} uses high-cardinality label(s) "
                     f"{sorted(bad)}")
+    for name, src in sorted(required):
+        errors.append(f"required family {name!r} missing from the {src} "
+                      "registry (renamed or removed?)")
     return errors
 
 

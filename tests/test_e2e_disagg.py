@@ -375,3 +375,143 @@ def test_sidecar_proxies_kv_events_stream():
             await dec.stop()
 
     asyncio.run(body())
+
+
+def test_golden_decision_record_disagg_with_chaos_failover():
+    """Golden DecisionRecord through the disagg path: the full record for one
+    request must show admission (flow control: queue time + band), the
+    prefill profile's filter drops, the decode profile's per-endpoint scorer
+    table and picker pick, and a chaos-induced failover attempt trail —
+    first attempt against a chaos-reset decode endpoint, reschedule, then
+    success via the healthy sidecar-fronted decode pod."""
+    GW7, EA7, SC7, DEC7, PRE7 = 18960, 18961, 18962, 18963, 18964
+
+    cfg = f"""
+featureGates: {{flowControl: true}}
+decisions: {{topK: 4}}
+pool:
+  endpoints:
+    - {{address: 127.0.0.1, port: {EA7}, labels: {{llm-d.ai/role: decode}}}}
+    - {{address: 127.0.0.1, port: {SC7}, labels: {{llm-d.ai/role: decode}}}}
+    - {{address: 127.0.0.1, port: {PRE7}, labels: {{llm-d.ai/role: prefill}}}}
+plugins:
+  - {{type: header-based-testing-filter}}
+  - {{type: decode-filter}}
+  - {{type: prefill-filter}}
+  - {{type: queue-scorer}}
+  - type: disagg-profile-handler
+    parameters:
+      pdDecider:
+        type: prefix-based-pd-decider
+        parameters: {{thresholdTokens: 16}}
+schedulingProfiles:
+  - name: decode
+    plugins:
+      - {{pluginRef: decode-filter}}
+      - {{pluginRef: header-based-testing-filter}}
+      - {{pluginRef: queue-scorer, weight: 2}}
+  - name: prefill
+    plugins:
+      - {{pluginRef: prefill-filter}}
+      - {{pluginRef: queue-scorer}}
+"""
+
+    async def body():
+        # Chaos decode endpoint: resets every connection (deterministic shim).
+        ea = EngineServer(EngineConfig(backend="sim", model="tiny", port=EA7,
+                                       chaos="reset:100"))
+        dec = _engine(DEC7, "decode")
+        pre = _engine(PRE7, "prefill")
+        await ea.start()
+        await dec.start()
+        await pre.start()
+        sc = Sidecar(SidecarConfig(port=SC7,
+                                   decoder_url=f"http://127.0.0.1:{DEC7}",
+                                   ssrf_allowlist=[f"127.0.0.1:{PRE7}"]))
+        await sc.start()
+        gw = build_gateway(cfg, port=GW7, poll_interval=0.02)
+        await gw.start()
+        try:
+            async with httpx.AsyncClient(timeout=120) as c:
+                r = await c.post(
+                    f"http://127.0.0.1:{GW7}/v1/completions",
+                    json={"model": "tiny", "prompt": LONG_PROMPT,
+                          "max_tokens": 4, "temperature": 0},
+                    headers={"x-request-id": "golden-disagg-1",
+                             "x-debug-decision": "summary",
+                             "test-epp-endpoint-selection":
+                                 f"127.0.0.1:{EA7}"})
+                assert r.status_code == 200
+                # Failover landed on the healthy sidecar-fronted pod.
+                assert r.headers["x-gateway-destination-endpoint-served"] == \
+                    f"127.0.0.1:{SC7}"
+                assert ea.chaos.triggered["reset"] > 0
+                assert f"winner=127.0.0.1:" in r.headers["x-decision-summary"]
+
+                r = await c.get(f"http://127.0.0.1:{GW7}"
+                                "/debug/decisions/golden-disagg-1")
+                assert r.status_code == 200
+                rec = r.json()
+                assert rec["schema_version"] == 1
+
+                # Admission: flow-control verdict with queue time + band.
+                adm = rec["admission"]
+                assert adm["mechanism"] == "flow-control"
+                assert adm["outcome"] == "dispatched"
+                assert adm["priority_band"] == 0
+                assert adm["queue_ms"] >= 0
+
+                # Round 1 (schedule): decode profile — filter drops recorded
+                # per filter, per-endpoint weighted scorer table, picker pick
+                # of the (chaos) endpoint the test header forced.
+                assert [rd["reason"] for rd in rec["rounds"]] == \
+                    ["schedule", "reschedule"]
+                d1 = rec["rounds"][0]["profiles"]["decode"]
+                by_plugin = {f["plugin"].split("/")[0]: f
+                             for f in d1["filters"]}
+                assert f"127.0.0.1:{PRE7}" in \
+                    by_plugin["decode-filter"]["dropped"]
+                assert f"127.0.0.1:{SC7}" in \
+                    by_plugin["header-based-testing-filter"]["dropped"]
+                qs = d1["scorers"]["queue-scorer/queue-scorer"]
+                assert qs["weight"] == 2.0
+                assert f"127.0.0.1:{EA7}" in qs["scores"]
+                assert set(qs["scores"][f"127.0.0.1:{EA7}"]) == \
+                    {"raw", "weighted"}
+                assert d1["picker"]["picked"] == [f"127.0.0.1:{EA7}"]
+
+                # Round 1: prefill profile — role filter drops both decode
+                # endpoints, prefill pod picked.
+                p1 = rec["rounds"][0]["profiles"]["prefill"]
+                pf = next(f for f in p1["filters"]
+                          if f["plugin"].startswith("prefill-filter"))
+                assert set(pf["dropped"]) == {f"127.0.0.1:{EA7}",
+                                              f"127.0.0.1:{SC7}"}
+                assert p1["picker"]["picked"] == [f"127.0.0.1:{PRE7}"]
+
+                # Round 2 (failover reschedule): the healthy pod wins.
+                d2 = rec["rounds"][1]["profiles"]["decode"]
+                assert d2["picker"]["picked"] == [f"127.0.0.1:{SC7}"]
+
+                # Attempt trail: chaos connect failure → reschedule event
+                # (excluding the broken pod) → success on the sidecar.
+                attempts = rec["attempts"]
+                assert attempts[0]["endpoint"] == f"127.0.0.1:{EA7}"
+                assert attempts[0]["outcome"] == "connect"
+                resched = next(a for a in attempts if a.get("event") ==
+                               "reschedule")
+                assert f"127.0.0.1:{EA7}" in resched["excluded"]
+                ok = attempts[-1]
+                assert ok["endpoint"] == f"127.0.0.1:{SC7}"
+                assert ok["outcome"] == "ok" and ok["status"] == 200
+
+                assert rec["final"]["status"] == 200
+                assert rec["final"]["destination"] == f"127.0.0.1:{SC7}"
+        finally:
+            await gw.stop()
+            await sc.stop()
+            await pre.stop()
+            await dec.stop()
+            await ea.stop()
+
+    asyncio.run(body())

@@ -405,3 +405,40 @@ def test_drain_timeout_aborts_stragglers():
             assert _time.monotonic() - t0 < 12  # 1s drain + bounded teardown
 
     run(body())
+
+
+def test_drain_gate_waits_for_staged_kv_export():
+    """SIGTERM drain must not tear down a prefill pod while a staged KV
+    export is waiting for (or mid-way through) a decode peer's pull:
+    idle() counts kv_exports and queued release requests (ADVICE r5)."""
+    async def body():
+        from llm_d_inference_scheduler_tpu.engine.core import TpuEngine
+
+        eng = TpuEngine(_cfg("tpu", 0, role="prefill"))
+        await eng.start()
+        try:
+            assert eng.idle()
+            req = EngineRequest(request_id="drain-exp",
+                                prompt_token_ids=[1, 2, 3], max_tokens=1,
+                                kv_transfer_params={"do_remote_decode": True})
+            out = eng.submit(req)
+            while True:
+                ev = await asyncio.wait_for(out.get(), timeout=30)
+                if ev.finish_reason is not None:
+                    break
+            assert ev.kv_transfer_params is not None
+            assert "drain-exp" in eng.kv_exports
+            # The request finished, but the staged export pins the drain
+            # gate: a decode peer may still be mid-pull.
+            assert not eng.idle()
+            # Release (decode peer finished its pull) -> drain may proceed.
+            eng.release_kv_export("drain-exp")
+            for _ in range(100):
+                if eng.idle():
+                    break
+                await asyncio.sleep(0.05)
+            assert eng.idle()
+        finally:
+            await eng.stop()
+
+    run(body())

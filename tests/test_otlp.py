@@ -128,3 +128,28 @@ def test_otlp_env_activation(monkeypatch):
     with tr.span("s"):
         pass
     assert tr.snapshot()[0]["name"] == "s"
+
+
+def test_otlp_span_events_exported_both_encodings():
+    """Span events (the decision flight recorder's phase summaries) must
+    survive BOTH OTLP encodings — silently dropping them from the sinks
+    would make the recorder look like it never fired in a collector."""
+    from llm_d_inference_scheduler_tpu.router.otlp import (
+        encode_span,
+        span_to_otlp_json,
+    )
+
+    span = {"trace_id": "ab" * 16, "span_id": "cd" * 8, "name": "s",
+            "duration_ms": 1.0, "start_unix_ns": 1000,
+            "attributes": {"a": 1},
+            "events": [{"name": "decision.admission", "time_unix_ns": 1500,
+                        "attributes": {"outcome": "dispatched", "n": 2}}]}
+    wire = encode_span(span, 0)
+    assert b"decision.admission" in wire and b"dispatched" in wire
+
+    doc = span_to_otlp_json(span, "svc")
+    ev = doc["resourceSpans"][0]["scopeSpans"][0]["spans"][0]["events"][0]
+    assert ev["name"] == "decision.admission"
+    assert ev["timeUnixNano"] == "1500"
+    assert {"key": "outcome", "value": {"stringValue": "dispatched"}} in \
+        ev["attributes"]

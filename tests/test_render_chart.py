@@ -132,3 +132,38 @@ def test_cli_set_overrides(tmp_path, capsys):
           "--set", "poolName=x", "-o", str(out)])
     docs = _by_kind_name(list(yaml.safe_load_all(out.read_text())))
     assert docs[("Deployment", "x-decode")]["spec"]["replicas"] == 5
+
+
+def test_pd_pod_tls_rendering():
+    """Decode/prefill pod TLS knobs (NEXT.md gap): the sidecar's per-leg
+    flags (decoder/encoder join prefiller) and the engines' --secure-serving
+    render; defaults stay plain."""
+    docs = _by_kind_name(_docs({
+        "decode": {"engineTLS": True,
+                   "sidecarTLS": {"decoderTLS": True, "encoderTLS": True}},
+        "prefill": {"engineTLS": True},
+    }))
+    dec_spec = docs[("Deployment", "tpu-pool-decode")]["spec"]["template"]["spec"]
+    sidecar, engine = dec_spec["containers"][0], dec_spec["containers"][1]
+    for flag in ("--use-tls-for-decoder", "--insecure-skip-verify-decoder",
+                 "--use-tls-for-encoder", "--insecure-skip-verify-encoder"):
+        assert flag in sidecar["args"], flag
+    assert "--use-tls-for-prefiller" not in sidecar["args"]
+    assert "--secure-serving" in engine["args"]
+    assert engine["readinessProbe"]["httpGet"]["scheme"] == "HTTPS"
+    pre = docs[("Deployment", "tpu-pool-prefill")]["spec"]["template"][
+        "spec"]["containers"][0]
+    assert "--secure-serving" in pre["args"]
+    assert pre["readinessProbe"]["httpGet"]["scheme"] == "HTTPS"
+
+    # Defaults: no TLS args, plain probes (no scheme key).
+    docs = _by_kind_name(_docs())
+    dec_spec = docs[("Deployment", "tpu-pool-decode")]["spec"]["template"]["spec"]
+    assert not any("tls" in a or "secure" in a
+                   for a in dec_spec["containers"][0]["args"])
+    assert "--secure-serving" not in dec_spec["containers"][1]["args"]
+    assert "scheme" not in dec_spec["containers"][1]["readinessProbe"]["httpGet"]
+    pre = docs[("Deployment", "tpu-pool-prefill")]["spec"]["template"][
+        "spec"]["containers"][0]
+    assert "--secure-serving" not in pre["args"]
+    assert "scheme" not in pre["readinessProbe"]["httpGet"]
