@@ -5,16 +5,16 @@
 PY ?= python
 
 .PHONY: test test-fast test-unit test-dist test-chaos bench bench-flowcontrol \
-	bench-router-sse bench-decisions dryrun render-chart compile-check \
-	verify-metrics verify-decisions
+	bench-router-sse bench-decisions bench-sched dryrun render-chart \
+	compile-check verify-metrics verify-decisions verify-hotpath
 
 # Full hermetic suite (virtual 8-device CPU mesh; no TPU or cluster needed —
 # the reference needs envtest + kind for the equivalent coverage).
-test: verify-metrics verify-decisions
+test: verify-metrics verify-decisions verify-hotpath
 	$(PY) -m pytest tests/ -q
 
 # Everything except the spawned-process distributed tests (the slow tail).
-test-fast: verify-metrics verify-decisions
+test-fast: verify-metrics verify-decisions verify-hotpath
 	$(PY) -m pytest tests/ -q --deselect tests/test_multihost.py \
 		--deselect tests/test_multihost_pd.py
 
@@ -30,10 +30,22 @@ verify-metrics:
 verify-decisions:
 	$(PY) scripts/verify_decisions.py
 
+# Scheduling hot-path lint: no router module may call chain_block_hashes
+# directly — everything goes through the prefix-hash memo
+# (also hooked into pytest via tests/test_hashmemo.py).
+verify-hotpath:
+	$(PY) scripts/verify_hotpath.py
+
 # Recorder-overhead microbench on the flow-control dispatch path (CPU-only;
 # writes benchmarks/DECISIONS_MICRO.json — target <3%, kill-switch ~0%).
 bench-decisions:
-	$(PY) bench.py --sched-microbench
+	$(PY) bench.py --sched-microbench --micro-only
+
+# Pool-scale scheduling hot-path sweep (8/32/128 endpoints × 16/64/128
+# blocks, recorder on/off, memoized vs pre-memo legacy emulation); writes
+# benchmarks/SCHED_HOTPATH.json — target ≥30% lower cost at 128×64.
+bench-sched:
+	$(PY) bench.py --sched-microbench --sweep-only
 
 test-unit: test-fast
 

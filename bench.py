@@ -564,18 +564,285 @@ def sched_microbench(quick: bool = False) -> dict:
     return out
 
 
+def sched_pool_sweep(quick: bool = False) -> dict:
+    """Pool-scale scheduling hot-path sweep (CPU-only, no chip needed).
+
+    Measures per-request cost of one full scheduling cycle — approx-prefix
+    producer produce(), Scheduler.schedule() with the precise-prefix +
+    queue scorers, and both pre_request hooks (director step order) — over
+    8/32/128 endpoints × 16/64/128 prompt blocks, recorder on/off.
+
+    Each cell compares the shipped **memoized** path (per-request
+    PrefixHashMemo + global LRU + KvBlockIndex.match_prefix batch walk)
+    against a **legacy emulation** of the pre-memo hot path (per-endpoint
+    chain_block_hashes in produce/score/pre_request + per-hash index.holds
+    locking), reconstructed here in the bench so the before/after delta is
+    measured in one binary on one box. Traffic is 50% repeat ("warm")
+    prompts — the global-LRU case — and 50% distinct cold prompts, which
+    exercise only the per-request memo; a quarter of the pods hold the warm
+    prompts' blocks so prefix walks do real consecutive matching.
+
+    Methodology matches sched_microbench: interleaved legacy/memo chunks,
+    GC parked, MIN over chunks as the noise-floor estimate. Also reports
+    xxhash chain computations per cycle on the memo path via the
+    utils.hashing.CHAIN_COMPUTES counter (the O(endpoints)→O(1) claim).
+    Prints one JSON line; main() writes benchmarks/SCHED_HOTPATH.json."""
+    import asyncio
+    import gc
+
+    from llm_d_inference_scheduler_tpu.router import hashmemo
+    from llm_d_inference_scheduler_tpu.router.decisions import (
+        DecisionConfig,
+        DecisionRecorder,
+    )
+    from llm_d_inference_scheduler_tpu.router.framework.datalayer import (
+        Endpoint,
+        EndpointMetadata,
+    )
+    from llm_d_inference_scheduler_tpu.router.framework.scheduling import (
+        InferenceRequest,
+        InferenceRequestBody,
+    )
+    from llm_d_inference_scheduler_tpu.router.plugins.attributes import (
+        PREFIX_ATTRIBUTE_KEY,
+        PrefixCacheMatchInfo,
+    )
+    from llm_d_inference_scheduler_tpu.router.plugins.pickers import MaxScorePicker
+    from llm_d_inference_scheduler_tpu.router.plugins.precise_prefix import (
+        PrecisePrefixCacheScorer,
+    )
+    from llm_d_inference_scheduler_tpu.router.plugins.profile_handlers import (
+        SingleProfileHandler,
+    )
+    from llm_d_inference_scheduler_tpu.router.plugins.scorers import QueueScorer
+    from llm_d_inference_scheduler_tpu.router.requestcontrol.producers import (
+        ApproxPrefixCacheProducer,
+    )
+    from llm_d_inference_scheduler_tpu.router.scheduling.scheduler import (
+        Scheduler,
+        SchedulerProfile,
+        WeightedScorer,
+    )
+    from llm_d_inference_scheduler_tpu.utils import hashing
+
+    BS = 16  # engine cache block size (tokens)
+    recorders = {"on": DecisionRecorder(DecisionConfig(enabled=True)),
+                 "off": DecisionRecorder(DecisionConfig(enabled=False))}
+
+    def legacy_chain(request, bs):
+        # Pre-memo behavior: a full chain computation at every call site.
+        return hashing.chain_block_hashes(
+            request.target_model, request.body.tokenized_prompt,
+            request.body.prompt_text(), bs)
+
+    class LegacyPreciseScorer(PrecisePrefixCacheScorer):
+        """Pre-PR hot path: chain per endpoint + per-hash holds() locking."""
+
+        def score(self, ctx, state, request, endpoints):
+            out = {}
+            hashes_by_bs = {}
+            for ep in endpoints:
+                bs = ep.metrics.cache_block_size or self.block_size_tokens
+                if bs not in hashes_by_bs:
+                    hashes_by_bs[bs] = legacy_chain(request, bs)
+                hashes = hashes_by_bs[bs]
+                pod = ep.metadata.address_port
+                match = 0
+                for h in hashes:
+                    if self.index.holds(pod, h):
+                        match += 1
+                    else:
+                        break
+                out[pod] = match / len(hashes) if hashes else 0.0
+            return out
+
+        def pre_request(self, ctx, request, result):
+            for ep in result.primary().target_endpoints[:1]:
+                bs = ep.metrics.cache_block_size or self.block_size_tokens
+                self.index.add_speculative(ep.metadata.address_port,
+                                           legacy_chain(request, bs))
+
+    async def legacy_produce(prod, request, endpoints):
+        for ep in endpoints:
+            bs = prod._block_size_for(ep)
+            hashes = legacy_chain(request, bs)
+            lru = prod._lru_for(ep)
+            match = 0
+            for h in hashes:
+                if lru.contains(h):
+                    match += 1
+                else:
+                    break
+            ep.attributes.put(PREFIX_ATTRIBUTE_KEY,
+                              PrefixCacheMatchInfo(match, len(hashes), bs))
+
+    def legacy_pre_request(prod, request, result):
+        for ep in result.primary().target_endpoints[:1]:
+            bs = prod._block_size_for(ep)
+            lru = prod._lru_for(ep)
+            for h in legacy_chain(request, bs):
+                lru.add(h)
+
+    def build_pipeline(n_endpoints, legacy):
+        endpoints = []
+        for i in range(n_endpoints):
+            ep = Endpoint(EndpointMetadata(name=f"ep{i}",
+                                           address=f"10.0.{i // 256}.{i % 256}",
+                                           port=8000))
+            ep.metrics.cache_block_size = BS
+            ep.metrics.cache_num_blocks = 4096
+            ep.metrics.waiting_queue_size = i % 7
+            endpoints.append(ep)
+        producer = ApproxPrefixCacheProducer("approx")
+        scorer = (LegacyPreciseScorer if legacy
+                  else PrecisePrefixCacheScorer)("precise")
+        profile = SchedulerProfile(
+            "default", [],
+            [WeightedScorer(scorer, 3.0),
+             WeightedScorer(QueueScorer("queue-scorer"), 1.0)],
+            MaxScorePicker("max-score-picker"))
+        sched = Scheduler({"default": profile}, SingleProfileHandler())
+        return endpoints, producer, scorer, sched
+
+    def warm_tokens(w, n_blocks):
+        return [(w * 9973 + j) % 50000 for j in range(n_blocks * BS)]
+
+    def make_requests(n, n_blocks, recorder, salt):
+        reqs = []
+        for i in range(n):
+            if i % 2 == 0:  # warm: one of 8 repeat prompts (LRU/retry case)
+                toks = warm_tokens(i % 8, n_blocks)
+            else:  # cold: distinct prompt, per-request memo only
+                toks = [(salt + i * 7919 + j) % 50000
+                        for j in range(n_blocks * BS)]
+            req = InferenceRequest(
+                request_id=f"sw-{salt}-{i}", target_model="tiny",
+                body=InferenceRequestBody(completions={"prompt": "x"},
+                                          tokenized_prompt=toks))
+            req.decision = recorder.start(req.request_id, req.target_model)
+            reqs.append(req)
+        return reqs
+
+    async def run_chunk(reqs, endpoints, producer, scorer, sched, legacy):
+        t0 = time.monotonic()
+        if legacy:
+            for req in reqs:
+                await legacy_produce(producer, req, endpoints)
+                result = sched.schedule(None, req, endpoints)
+                legacy_pre_request(producer, req, result)
+                scorer.pre_request(None, req, result)
+        else:
+            for req in reqs:
+                await producer.produce(None, req, endpoints)
+                result = sched.schedule(None, req, endpoints)
+                producer.pre_request(None, req, result)
+                scorer.pre_request(None, req, result)
+        return (time.monotonic() - t0) / len(reqs) * 1e6  # us/request
+
+    def measure(n_endpoints, n_blocks, rec_label):
+        recorder = recorders[rec_label]
+        # Chunk sized to the config's cost so the sweep stays bounded.
+        chunk = max(16, min(300, 40000 // (n_endpoints * n_blocks)))
+        reps = 2 if quick else 3
+        pipelines = {leg: build_pipeline(n_endpoints, leg)
+                     for leg in (True, False)}
+        hashmemo.global_lru_clear()
+        # Warm pods: every 4th pod holds the 8 warm prompts' blocks in both
+        # the precise index and the approx LRU, so prefix walks match.
+        for leg, (endpoints, producer, scorer, _) in pipelines.items():
+            for w in range(8):
+                hashes = hashing.chain_block_hashes(
+                    "tiny", warm_tokens(w, n_blocks), "", BS)
+                for ep in endpoints[::4]:
+                    scorer.index.add(ep.metadata.address_port, hashes)
+                    lru = producer._lru_for(ep)
+                    for h in hashes:
+                        lru.add(h)
+
+        async def body():
+            salt = 0
+            for leg in (True, False):  # warm allocator + caches
+                salt += 1
+                await run_chunk(make_requests(chunk, n_blocks, recorder,
+                                              salt * 104729),
+                                *pipelines[leg], leg)
+            best = {True: float("inf"), False: float("inf")}
+            chains = None
+            gc.collect()
+            gc.disable()
+            try:
+                for _ in range(reps):
+                    for leg in (True, False):  # interleaved
+                        salt += 1
+                        reqs = make_requests(chunk, n_blocks, recorder,
+                                             salt * 104729)
+                        c0 = hashing.CHAIN_COMPUTES
+                        us = await run_chunk(reqs, *pipelines[leg], leg)
+                        best[leg] = min(best[leg], us)
+                        if not leg:
+                            chains = (hashing.CHAIN_COMPUTES - c0) / chunk
+            finally:
+                gc.enable()
+            return best, chains
+
+        best, chains = asyncio.run(body())
+        return {
+            "endpoints": n_endpoints, "blocks": n_blocks,
+            "recorder": rec_label, "chunk": chunk,
+            "us_per_req_before": round(best[True], 2),
+            "us_per_req_after": round(best[False], 2),
+            "improvement_pct": round(
+                (best[True] - best[False]) / best[True] * 100.0, 1),
+            "chain_computes_per_cycle_after": round(chains, 3),
+        }
+
+    rows = [measure(E, B, rec_label)
+            for E in (8, 32, 128)
+            for B in (16, 64, 128)
+            for rec_label in ("on", "off")]
+    gate = [r for r in rows if r["endpoints"] == 128 and r["blocks"] == 64]
+    out = {
+        "metric": "sched_hotpath_pool_sweep",
+        "before": "legacy emulation: per-endpoint chain_block_hashes in "
+                  "produce/score/pre_request + per-hash index.holds locking",
+        "after": "per-request PrefixHashMemo + global LRU + "
+                 "KvBlockIndex.match_prefix batch walk",
+        "sweep": rows,
+        "acceptance": {
+            "config": "128 endpoints x 64 blocks",
+            "required_improvement_pct": 30.0,
+            "measured_improvement_pct": {r["recorder"]: r["improvement_pct"]
+                                         for r in gate},
+            "passed": all(r["improvement_pct"] >= 30.0 for r in gate),
+        },
+    }
+    print(json.dumps(out))
+    return out
+
+
 def main() -> None:
     if len(sys.argv) > 3 and sys.argv[1] == "--child":
         child(sys.argv[2], int(sys.argv[3]))
         return
     if "--sched-microbench" in sys.argv:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")  # no chip needed
-        res = sched_microbench(quick="--quick" in sys.argv)
+        quick = "--quick" in sys.argv
+        # Default runs both phases; --micro-only (make bench-decisions) and
+        # --sweep-only (make bench-sched) pay for just their own artifact.
+        run_micro = "--sweep-only" not in sys.argv
+        run_sweep = "--micro-only" not in sys.argv
         here = os.path.dirname(os.path.abspath(__file__))
         os.makedirs(os.path.join(here, "benchmarks"), exist_ok=True)
-        with open(os.path.join(here, "benchmarks",
-                               "DECISIONS_MICRO.json"), "w") as f:
-            json.dump(res, f, indent=1)
+        if run_micro:
+            res = sched_microbench(quick=quick)
+            with open(os.path.join(here, "benchmarks",
+                                   "DECISIONS_MICRO.json"), "w") as f:
+                json.dump(res, f, indent=1)
+        if run_sweep:
+            sweep = sched_pool_sweep(quick=quick)
+            with open(os.path.join(here, "benchmarks",
+                                   "SCHED_HOTPATH.json"), "w") as f:
+                json.dump(sweep, f, indent=1)
         return
 
     deadline = time.monotonic() + float(os.environ.get("BENCH_DEADLINE", "2700"))

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import copy
 import dataclasses
+import functools
 import time
 from typing import Any, Iterable, Protocol, runtime_checkable
 
@@ -40,8 +41,11 @@ class EndpointMetadata:
         return (f"{self.scheme}://{self.address}:"
                 f"{self.metrics_port or self.port}/metrics")
 
-    @property
+    @functools.cached_property
     def address_port(self) -> str:
+        # Cached: address/port never change after construction (the datastore
+        # replaces the whole metadata object on endpoint churn), and this key
+        # is read dozens of times per scheduling cycle.
         return f"{self.address}:{self.port}"
 
     @property

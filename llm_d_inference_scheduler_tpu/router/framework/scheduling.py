@@ -111,6 +111,11 @@ class InferenceRequest:
     # it into CycleState (DECISION_STATE_KEY) so plugins can annotate the
     # cycle they run in.
     decision: Any = None
+    # Prefix-hash memo (router/hashmemo.py PrefixHashMemo), lazily attached
+    # by the first producer/scorer that needs a hash chain and reused by
+    # every later consumer of the cycle — including failover reschedules of
+    # the same request object.
+    prefix_hashes: Any = None
 
 
 class CycleState:
@@ -164,6 +169,12 @@ class SchedulingResult:
 
 @runtime_checkable
 class Filter(Protocol):
+    """Prunes the candidate set. The returned list MUST be a (possibly
+    reordered) subset of ``endpoints`` — a filter drops candidates, it never
+    substitutes or invents them. The scheduler relies on this: an unchanged
+    length means nothing was dropped (drop bookkeeping and the decision
+    record's filter trail are keyed on it)."""
+
     def typed_name(self): ...
     def filter(self, ctx: Any, state: CycleState, request: InferenceRequest,
                endpoints: list[Endpoint]) -> list[Endpoint]: ...

@@ -1,0 +1,107 @@
+"""Shared prefix-hash memo: the ONLY sanctioned path from router-side
+plugins to ``chain_block_hashes``.
+
+One scheduling cycle used to recompute the full xxhash chain once per
+endpoint per consumer — ``ApproxPrefixCacheProducer.produce`` inside its
+per-endpoint loop, again in its ``pre_request``, and
+``PrecisePrefixCacheScorer`` a third and fourth time — O(endpoints × blocks)
+xxh64 work for a value that depends only on (model, prompt, block size).
+Two layers collapse that to at most one computation per (mode, block size)
+per request:
+
+- **Per-request memo** (``PrefixHashMemo``, riding
+  ``InferenceRequest.prefix_hashes``): every producer/scorer/pre_request
+  hook of the cycle — and any failover *reschedule* of the same request —
+  reuses the first computation. Entries remember whether they were computed
+  from token ids or from text, so when ``TokenProducer`` upgrades the
+  request from char-based to token-based hashing mid-cycle the stale
+  char-based chain is recomputed, never served.
+- **Global LRU** keyed by ``(model, mode, prompt-fingerprint, block_size)``:
+  repeat prompts, retries, and reschedules that build a fresh request
+  object skip xxhash entirely. The fingerprint is one xxh64 pass over the
+  prompt (itself memoized per request), so the key never pins prompt text.
+
+Returned hash lists are shared between the LRU, the memo, and callers —
+treat them as immutable.
+
+``scripts/verify_hotpath.py`` (make verify-hotpath) lints that no other
+router module calls ``chain_block_hashes`` directly.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+from ..utils.hashing import (
+    chain_block_hashes,
+    text_fingerprint,
+    token_fingerprint,
+)
+
+GLOBAL_LRU_CAPACITY = 1024
+
+# Written from the event loop only, but guarded anyway: the lock is one
+# uncontended acquire per *request* per block size, noise next to the chain
+# computation it saves, and keeps the memo safe if a producer ever moves to
+# a worker thread.
+_global_lock = threading.Lock()
+_global_lru: OrderedDict[tuple, list[int]] = OrderedDict()
+
+
+def global_lru_clear() -> None:
+    """Test hook: reset the cross-request LRU."""
+    with _global_lock:
+        _global_lru.clear()
+
+
+class PrefixHashMemo:
+    """Memoized prefix-hash chains for one request's scheduling lifetime."""
+
+    __slots__ = ("_entries", "_fp")
+
+    def __init__(self):
+        # block_size -> (token_based, hashes); mode -> prompt fingerprint
+        self._entries: dict[int, tuple[bool, list[int]]] = {}
+        self._fp: dict[bool, int] = {}
+
+    def hashes(self, model: str, body, block_size: int) -> list[int]:
+        # Truthiness, not `is not None`: an engine render reply of [] must
+        # fall back to char-based hashing exactly like the direct
+        # chain_block_hashes call does (`if token_ids:`), not produce an
+        # empty chain that zeroes every prefix score.
+        token_based = bool(body.tokenized_prompt)
+        ent = self._entries.get(block_size)
+        if ent is not None and ent[0] == token_based:
+            return ent[1]
+        # A char-based entry after tokenization landed is stale (the chains
+        # live in different hash spaces); fall through and recompute.
+        fp = self._fp.get(token_based)
+        if fp is None:
+            fp = (token_fingerprint(body.tokenized_prompt) if token_based
+                  else text_fingerprint(body.prompt_text()))
+            self._fp[token_based] = fp
+        key = (model, token_based, fp, block_size)
+        with _global_lock:
+            hashes = _global_lru.get(key)
+            if hashes is not None:
+                _global_lru.move_to_end(key)
+        if hashes is None:
+            hashes = chain_block_hashes(
+                model, body.tokenized_prompt,
+                "" if token_based else body.prompt_text(), block_size)
+            with _global_lock:
+                _global_lru[key] = hashes
+                while len(_global_lru) > GLOBAL_LRU_CAPACITY:
+                    _global_lru.popitem(last=False)
+        self._entries[block_size] = (token_based, hashes)
+        return hashes
+
+
+def request_prefix_hashes(request, block_size: int) -> list[int]:
+    """Hash chain for ``request`` at ``block_size``, memoized on the request
+    (lazily attached to ``InferenceRequest.prefix_hashes``)."""
+    memo = request.prefix_hashes
+    if memo is None:
+        memo = request.prefix_hashes = PrefixHashMemo()
+    return memo.hashes(request.target_model, request.body, block_size)

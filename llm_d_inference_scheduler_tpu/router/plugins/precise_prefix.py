@@ -28,15 +28,32 @@ from typing import Any
 
 import zmq
 
-from ...utils.hashing import chain_block_hashes
 from ..framework.datalayer import Endpoint
 from ..framework.plugin import PluginBase, register_plugin
 from ..framework.scheduling import InferenceRequest, SchedulingResult
+from ..hashmemo import request_prefix_hashes
 
 log = logging.getLogger("router.precise_prefix")
 
 TOPIC = b"kv-events"
 SPECULATIVE_TTL_S = 10.0
+
+
+def drain_sse_frames(buf: str) -> tuple[list[str], str]:
+    """Split complete ``\\n\\n``-terminated SSE frames off ``buf``, returning
+    (frames, remainder). Find-offset parsing: one advancing scan position
+    instead of re-splitting (and so rescanning/copying) the whole buffer per
+    frame — the same fix the gateway SSE leg got (``buf += chunk`` + repeated
+    ``split`` is O(n²) across a long stream)."""
+    pos = 0
+    frames = []
+    while True:
+        end = buf.find("\n\n", pos)
+        if end < 0:
+            break
+        frames.append(buf[pos:end])
+        pos = end + 2
+    return frames, (buf[pos:] if pos else buf)
 
 
 class KvBlockIndex:
@@ -50,11 +67,14 @@ class KvBlockIndex:
     """
 
     CONFIRMED_TTL_S = 10.0  # several snapshot periods
+    SWEEP_INTERVAL_S = 1.0  # batched expiry cadence (replaces per-lookup TTL pops)
 
     def __init__(self):
         self._by_pod: dict[str, dict[int, float]] = {}  # hash -> expiry
         self._speculative: dict[tuple[str, int], float] = {}  # -> expiry
         self._lock = threading.Lock()
+        self._next_pod_sweep: dict[str, float] = {}  # per-pod cadence
+        self._next_spec_sweep = 0.0
 
     def add(self, pod: str, hashes: list[int]) -> None:
         expiry = time.monotonic() + self.CONFIRMED_TTL_S
@@ -63,6 +83,15 @@ class KvBlockIndex:
             for h in hashes:
                 entries[h] = expiry
                 self._speculative.pop((pod, h), None)  # confirmed
+            # The speculative sweep rides the subscriber threads' writes,
+            # never the scheduler's scoring path.
+            now = expiry - self.CONFIRMED_TTL_S
+            if now >= self._next_spec_sweep:
+                self._next_spec_sweep = now + self.SWEEP_INTERVAL_S
+                dead = [k for k, exp in self._speculative.items()
+                        if exp <= now]
+                for k in dead:
+                    del self._speculative[k]
 
     def remove(self, pod: str, hashes: list[int]) -> None:
         with self._lock:
@@ -76,24 +105,51 @@ class KvBlockIndex:
             for h in hashes:
                 self._speculative[(pod, h)] = expiry
 
-    def holds(self, pod: str, h: int) -> bool:
+    def _sweep_pod(self, pod: str, entries: dict[int, float],
+                   now: float) -> None:
+        """Batched per-pod expiry (caller holds the lock): drop the queried
+        pod's dead entries at most once per SWEEP_INTERVAL_S instead of
+        popping per lookup. Per-pod — never a full-index scan under the
+        lock — so the hold is O(one pod's cache), not O(pods × hashes);
+        reads between sweeps are plain dict gets guarded by `exp > now`."""
+        if now < self._next_pod_sweep.get(pod, 0.0):
+            return
+        self._next_pod_sweep[pod] = now + self.SWEEP_INTERVAL_S
+        dead = [h for h, exp in entries.items() if exp <= now]
+        for h in dead:
+            del entries[h]
+
+    def match_prefix(self, pod: str, hashes: list[int]) -> int:
+        """Length of the consecutive-from-start prefix of ``hashes`` held by
+        ``pod`` — ONE lock acquisition for the whole walk (the per-hash
+        ``holds`` loop used to take the lock once per block per endpoint)."""
         now = time.monotonic()
         with self._lock:
-            exp = self._by_pod.get(pod, {}).get(h)
-            if exp is not None:
-                if exp > now:
-                    return True
-                self._by_pod[pod].pop(h, None)
-            exp = self._speculative.get((pod, h))
-            if exp is not None:
-                if exp > now:
-                    return True
-                self._speculative.pop((pod, h), None)
-            return False
+            entries = self._by_pod.get(pod)
+            if entries is not None:
+                self._sweep_pod(pod, entries, now)
+            spec = self._speculative
+            match = 0
+            for h in hashes:
+                if entries is not None:
+                    exp = entries.get(h)
+                    if exp is not None and exp > now:
+                        match += 1
+                        continue
+                exp = spec.get((pod, h))
+                if exp is not None and exp > now:
+                    match += 1
+                    continue
+                break
+            return match
+
+    def holds(self, pod: str, h: int) -> bool:
+        return self.match_prefix(pod, [h]) == 1
 
     def drop_pod(self, pod: str) -> None:
         with self._lock:
             self._by_pod.pop(pod, None)
+            self._next_pod_sweep.pop(pod, None)
             self._speculative = {k: v for k, v in self._speculative.items()
                                  if k[0] != pod}
 
@@ -139,24 +195,19 @@ class PrecisePrefixCacheScorer(PluginBase):
         return ["request/tokenized"]
 
     def _hashes(self, request: InferenceRequest, block_size: int) -> list[int]:
-        return chain_block_hashes(request.target_model,
-                                  request.body.tokenized_prompt,
-                                  request.body.prompt_text(), block_size)
+        return request_prefix_hashes(request, block_size)
 
     def score(self, ctx, state, request, endpoints):
         out: dict[str, float] = {}
-        hashes_by_bs: dict[int, list[int]] = {}  # hashing once per block size
         for ep in endpoints:
             bs = ep.metrics.cache_block_size or self.block_size_tokens
-            hashes = hashes_by_bs.setdefault(bs, self._hashes(request, bs))
-            pod = ep.metadata.address_port
-            match = 0
-            for h in hashes:
-                if self.index.holds(pod, h):
-                    match += 1
-                else:
-                    break  # consecutive-prefix requirement
-            out[pod] = match / len(hashes) if hashes else 0.0
+            hashes = self._hashes(request, bs)  # memoized per (request, bs)
+            # One lock acquisition per endpoint for the whole
+            # consecutive-prefix walk (the per-hash holds() loop was one per
+            # block per endpoint).
+            match = self.index.match_prefix(ep.metadata.address_port, hashes)
+            out[ep.metadata.address_port] = (match / len(hashes)
+                                             if hashes else 0.0)
         return out
 
     def pre_request(self, ctx, request: InferenceRequest,
@@ -227,8 +278,8 @@ class PrecisePrefixCacheScorer(PluginBase):
                             if stop.is_set():
                                 return
                             buf += chunk
-                            while "\n\n" in buf:
-                                frame, buf = buf.split("\n\n", 1)
+                            frames, buf = drain_sse_frames(buf)
+                            for frame in frames:
                                 for line in frame.splitlines():
                                     if line.startswith("data: "):
                                         try:

@@ -15,8 +15,9 @@ from __future__ import annotations
 from collections import OrderedDict
 from typing import Any
 
-from ...utils.hashing import chain_block_hashes
+from ...utils.hashing import text_fingerprint
 from ..framework.datalayer import Endpoint
+from ..hashmemo import request_prefix_hashes
 from ..framework.plugin import PluginBase, register_plugin
 from ..framework.scheduling import InferenceRequest, SchedulingResult
 from ..metrics import PREFIX_HIT_RATIO
@@ -43,6 +44,11 @@ class _PodLru:
         self._od[h] = None
         self._od.move_to_end(h)
         while len(self._od) > self.capacity:
+            self._od.popitem(last=False)
+
+    def resize(self, capacity: int) -> None:
+        self.capacity = capacity
+        while len(self._od) > capacity:
             self._od.popitem(last=False)
 
     def contains(self, h: int) -> bool:
@@ -79,16 +85,22 @@ class ApproxPrefixCacheProducer(PluginBase):
 
     def _lru_for(self, ep: Endpoint) -> _PodLru:
         key = ep.metadata.address_port
+        # Capacity follows the scraped cache geometry: before the first
+        # scrape lands, cache_num_blocks is 0 and the default applies, but
+        # the LRU re-sizes as soon as (or whenever) real geometry appears —
+        # it is never pinned at first sight. A scrape flapping back to 0
+        # (family missing one poll) keeps the last known capacity rather
+        # than shrinking to the default and evicting warm entries.
+        scraped = ep.metrics.cache_num_blocks
         lru = self._indexes.get(key)
         if lru is None:
-            cap = ep.metrics.cache_num_blocks or self.lru_capacity
-            lru = self._indexes[key] = _PodLru(cap)
+            lru = self._indexes[key] = _PodLru(scraped or self.lru_capacity)
+        elif scraped and lru.capacity != scraped:
+            lru.resize(scraped)
         return lru
 
     def _hashes(self, request: InferenceRequest, block_size: int) -> list[int]:
-        return chain_block_hashes(
-            request.target_model, request.body.tokenized_prompt,
-            request.body.prompt_text(), block_size)
+        return request_prefix_hashes(request, block_size)
 
     async def produce(self, ctx: Any, request: InferenceRequest,
                       endpoints: list[Endpoint]) -> None:
@@ -130,8 +142,9 @@ class TokenProducer(PluginBase):
 
     Reference: dataproducer/tokenizer — calls vLLM's /v1/completions/render +
     /v1/chat/completions/render over HTTP (tokenizer/vllm_http.go); here the
-    TPU engines expose the same endpoints. An LRU keyed by (model, prompt)
-    keeps repeat tokenizations off the producer budget.
+    TPU engines expose the same endpoints. An LRU keyed by
+    (model, prompt-fingerprint) keeps repeat tokenizations off the producer
+    budget.
 
     With ``udsPath`` set, the render calls go to a node-local tokenizer
     service over a unix-domain socket instead of the scheduled endpoint —
@@ -146,7 +159,9 @@ class TokenProducer(PluginBase):
         self.timeout_s = 0.35  # must fit the director's 400ms producer budget
         self.cache_capacity = 2048
         self.uds_path: str | None = None
-        self._cache: OrderedDict[tuple[str, str], list[int]] = OrderedDict()
+        # Keyed by (model, xxh64(prompt-text)) — a fingerprint, not the
+        # prompt itself: 2048 long prompts held verbatim pin megabytes.
+        self._cache: OrderedDict[tuple[str, int], list[int]] = OrderedDict()
         self._client = None
 
     def configure(self, params: dict[str, Any], handle: Any) -> None:
@@ -165,7 +180,7 @@ class TokenProducer(PluginBase):
         if request.body.tokenized_prompt is not None or not endpoints:
             return
         chat = request.body.chat_completions is not None
-        key = (request.target_model, request.body.prompt_text())
+        key = (request.target_model, text_fingerprint(request.body.prompt_text()))
         cached = self._cache.get(key)
         if cached is not None:
             self._cache.move_to_end(key)

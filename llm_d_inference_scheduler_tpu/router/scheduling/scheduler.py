@@ -74,9 +74,17 @@ class SchedulerProfile:
         # distribution converges just as well sampled. Starts at N-1 so the
         # very first recorded cycle observes (test determinism).
         self._obs_tick = self.SCORE_OBS_SAMPLE - 1
+        # Per-plugin duration observations ride the same scheme: a cycle
+        # with 1 filter + 2 scorers + picker used to pay 18 monotonic reads
+        # and 9 histogram observes per request; sampled 1-in-N the latency
+        # distributions converge identically while the hot path keeps only
+        # the e2e pair. Starts at N-1 so the first cycle observes.
+        self._dur_tick = self.DURATION_OBS_SAMPLE - 1
 
     # Sampling period for router_scorer_score observations (see __init__).
     SCORE_OBS_SAMPLE = 8
+    # Sampling period for router_plugin_duration_seconds observations.
+    DURATION_OBS_SAMPLE = 8
 
     def run(self, ctx: Any, request: InferenceRequest, state: CycleState,
             endpoints: list[Endpoint]) -> ProfileRunResult | None:
@@ -87,25 +95,40 @@ class SchedulerProfile:
         rec = state.read(DECISION_STATE_KEY)
         rec_sec = (rec.begin_profile(self.name, len(endpoints))
                    if rec is not None else None)
+        # Per-plugin duration observes are sampled (see __init__); a skipped
+        # cycle does zero monotonic reads for them.
+        self._dur_tick = (self._dur_tick + 1) % self.DURATION_OBS_SAMPLE
+        observe_dur = self._dur_tick == 0
         candidates = endpoints
+        # address_port keys re-snapshotted after every filter (cheap now
+        # that the property is cached on the metadata): filters may drop,
+        # reorder, or mutate the list in place — only the length is trusted
+        # to detect drops (a filter returns a permutation of a subset of
+        # its input, so equal length ⇒ nothing dropped).
+        keys = [ep.metadata.address_port for ep in candidates]
         for f, fname, drop_counter in self._filter_meta:
-            t0 = time.monotonic()
-            before = candidates
+            prev_keys = keys
+            t0 = time.monotonic() if observe_dur else 0.0
             candidates = f.filter(ctx, state, request, candidates)
-            PLUGIN_DURATION_SECONDS.labels("filter", fname).observe(
-                time.monotonic() - t0)
+            if observe_dur:
+                PLUGIN_DURATION_SECONDS.labels("filter", fname).observe(
+                    time.monotonic() - t0)
+            keys = [ep.metadata.address_port for ep in candidates]
             # Drop bookkeeping + aggregate shadow metrics ride the recorder
             # gate: the decisions kill-switch must restore the pre-recorder
-            # baseline, so nothing here runs when it is off.
+            # baseline, so nothing here runs when it is off — and the
+            # kept/dropped set rebuild is skipped when nothing was dropped.
             if rec_sec is not None:
-                kept_list = [ep.metadata.address_port for ep in candidates]
-                kept = set(kept_list)
-                dropped = [ep.metadata.address_port for ep in before
-                           if ep.metadata.address_port not in kept]
-                if dropped:
-                    drop_counter.inc(len(dropped))
-                rec.profile_filter(rec_sec, fname, len(before),
-                                   kept_list, dropped)
+                if len(keys) == len(prev_keys):
+                    rec.profile_filter(rec_sec, fname, len(prev_keys),
+                                       keys, [])
+                else:
+                    kept = set(keys)
+                    dropped = [k for k in prev_keys if k not in kept]
+                    if dropped:
+                        drop_counter.inc(len(dropped))
+                    rec.profile_filter(rec_sec, fname, len(prev_keys),
+                                       keys, dropped)
             if not candidates:
                 log.debug("profile %s: filter %s emptied the candidate set",
                           self.name, f.typed_name())
@@ -117,13 +140,14 @@ class SchedulerProfile:
         if rec_sec is not None:
             self._obs_tick = (self._obs_tick + 1) % self.SCORE_OBS_SAMPLE
             observe_scores = self._obs_tick == 0
-        totals: dict[str, float] = {ep.metadata.address_port: 0.0 for ep in candidates}
+        totals: dict[str, float] = dict.fromkeys(keys, 0.0)
         raw_scores: dict[str, dict[str, float]] = {}
         for ws, sname, score_hist in self._scorer_meta:
-            t0 = time.monotonic()
+            t0 = time.monotonic() if observe_dur else 0.0
             scores = ws.scorer.score(ctx, state, request, candidates)
-            PLUGIN_DURATION_SECONDS.labels("scorer", sname).observe(
-                time.monotonic() - t0)
+            if observe_dur:
+                PLUGIN_DURATION_SECONDS.labels("scorer", sname).observe(
+                    time.monotonic() - t0)
             raw_scores[sname] = scores
             if rec_sec is not None:
                 # The record keeps every score (zero-copy: the scorer result
@@ -143,13 +167,14 @@ class SchedulerProfile:
                     s = min(max(scores.get(key, 0.0), 0.0), 1.0)  # clamp [0,1]
                     totals[key] += ws.weight * s
 
-        scored = [ScoredEndpoint(ep, totals[ep.metadata.address_port])
-                  for ep in candidates]
+        scored = [ScoredEndpoint(ep, totals[k])
+                  for ep, k in zip(candidates, keys)]
         pname = self._picker_name
-        t0 = time.monotonic()
+        t0 = time.monotonic() if observe_dur else 0.0
         picked = self.picker.pick(ctx, state, request, scored)
-        PLUGIN_DURATION_SECONDS.labels("picker", pname).observe(
-            time.monotonic() - t0)
+        if observe_dur:
+            PLUGIN_DURATION_SECONDS.labels("picker", pname).observe(
+                time.monotonic() - t0)
         if rec_sec is not None:
             picked_keys = [ep.metadata.address_port for ep in picked]
             if picked and len(totals) > 1:

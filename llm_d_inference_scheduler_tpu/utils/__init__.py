@@ -1,3 +1,3 @@
-from .hashing import chain_block_hashes
+from .hashing import chain_block_hashes, text_fingerprint, token_fingerprint
 
-__all__ = ["chain_block_hashes"]
+__all__ = ["chain_block_hashes", "text_fingerprint", "token_fingerprint"]

@@ -21,9 +21,31 @@ import xxhash
 AVG_CHARS_PER_TOKEN = 4
 MAX_PREFIX_BLOCKS = 128
 
+# Monotonic count of full chain computations. The router's scheduling hot
+# path must do at most a couple of these per cycle (everything else rides the
+# PrefixHashMemo, router/hashmemo.py); perf tests and the pool-scale
+# microbench assert on deltas of this counter.
+CHAIN_COMPUTES = 0
+
+
+def token_fingerprint(token_ids: list[int]) -> int:
+    """One-pass xxh64 over the packed token ids — a compact stand-in for the
+    prompt identity in cache keys (memo LRU, tokenizer cache) so long prompts
+    are never pinned verbatim."""
+    return xxhash.xxh64(
+        b"".join(t.to_bytes(4, "little", signed=False) for t in token_ids)
+    ).intdigest()
+
+
+def text_fingerprint(text: str) -> int:
+    """xxh64 of the raw prompt text (char-based fingerprint counterpart)."""
+    return xxhash.xxh64(text.encode()).intdigest()
+
 
 def chain_block_hashes(model: str, token_ids: list[int] | None, text: str,
                        block_size_tokens: int) -> list[int]:
+    global CHAIN_COMPUTES
+    CHAIN_COMPUTES += 1
     h = xxhash.xxh64(model.encode()).intdigest()
     out: list[int] = []
     if token_ids:

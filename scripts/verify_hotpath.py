@@ -1,0 +1,87 @@
+"""Scheduling hot-path lint: router-side code must reach the prefix-hash
+chain through the shared memo, never ``chain_block_hashes`` directly.
+
+One scheduling cycle scores every endpoint; a plugin that re-hashes the
+prompt inside its per-endpoint loop silently reintroduces the
+O(endpoints × blocks) xxhash work the memo (router/hashmemo.py) exists to
+collapse. This lint AST-walks every module under the router package and
+fails on any import or reference of ``chain_block_hashes`` outside the memo
+module itself — mirroring scripts/verify_decisions.py's recorder-bypass
+check. The engine is exempt: it hashes blocks it actually commits (one
+chain per request lifecycle), not per candidate endpoint.
+
+Run via ``make verify-hotpath``; tests/test_hashmemo.py hooks it into the
+pytest run so CI catches memo-bypassing plugins statically.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import sys
+
+FORBIDDEN = "chain_block_hashes"
+# The memo module is the single sanctioned caller on the router side.
+ALLOWED = {"hashmemo.py"}
+
+
+def _router_dir() -> pathlib.Path:
+    return (pathlib.Path(__file__).resolve().parents[1]
+            / "llm_d_inference_scheduler_tpu" / "router")
+
+
+def check() -> list[str]:
+    errors: list[str] = []
+    root = _router_dir()
+    if not root.is_dir():
+        return [f"router package not found at {root}"]
+    for path in sorted(root.rglob("*.py")):
+        rel = path.relative_to(root).as_posix()
+        if path.name in ALLOWED:
+            continue
+        try:
+            tree = ast.parse(path.read_text(), filename=str(path))
+        except SyntaxError as e:
+            errors.append(f"{rel}: unparseable ({e})")
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom):
+                for alias in node.names or []:
+                    if alias.name == FORBIDDEN:
+                        errors.append(
+                            f"router/{rel}:{node.lineno}: imports "
+                            f"{FORBIDDEN} — go through "
+                            f"hashmemo.request_prefix_hashes instead")
+            elif isinstance(node, ast.Attribute) and node.attr == FORBIDDEN:
+                errors.append(
+                    f"router/{rel}:{node.lineno}: references "
+                    f".{FORBIDDEN} — go through "
+                    f"hashmemo.request_prefix_hashes instead")
+            elif isinstance(node, ast.Name) and node.id == FORBIDDEN:
+                errors.append(
+                    f"router/{rel}:{node.lineno}: references "
+                    f"{FORBIDDEN} — go through "
+                    f"hashmemo.request_prefix_hashes instead")
+    # The sanctioned path itself must exist and still use the shared chain.
+    memo = root / "hashmemo.py"
+    if not memo.is_file():
+        errors.append("router/hashmemo.py missing — the sanctioned "
+                      "chain_block_hashes wrapper is gone")
+    elif FORBIDDEN not in memo.read_text():
+        errors.append("router/hashmemo.py no longer calls "
+                      f"{FORBIDDEN} — memo/chain drift?")
+    return errors
+
+
+def main() -> int:
+    errors = check()
+    for e in errors:
+        print(f"verify-hotpath: {e}", file=sys.stderr)
+    if errors:
+        return 1
+    print("verify-hotpath: no router module bypasses the prefix-hash memo")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

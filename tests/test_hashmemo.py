@@ -1,0 +1,298 @@
+"""Scheduling hot-path: prefix-hash memo, batched KV-index matching, and the
+verify-hotpath lint (ISSUE 4 — one cycle must cost O(blocks + endpoints),
+not O(endpoints × blocks))."""
+
+import asyncio
+import pathlib
+import sys
+
+import pytest
+
+from llm_d_inference_scheduler_tpu.router import hashmemo
+from llm_d_inference_scheduler_tpu.router.framework.datalayer import (
+    Endpoint,
+    EndpointMetadata,
+)
+from llm_d_inference_scheduler_tpu.router.framework.scheduling import (
+    InferenceRequest,
+    InferenceRequestBody,
+    ProfileRunResult,
+    SchedulingResult,
+)
+from llm_d_inference_scheduler_tpu.router.hashmemo import request_prefix_hashes
+from llm_d_inference_scheduler_tpu.router.plugins.precise_prefix import (
+    KvBlockIndex,
+    PrecisePrefixCacheScorer,
+    drain_sse_frames,
+)
+from llm_d_inference_scheduler_tpu.router.requestcontrol.producers import (
+    ApproxPrefixCacheProducer,
+    TokenProducer,
+)
+from llm_d_inference_scheduler_tpu.utils import hashing
+from llm_d_inference_scheduler_tpu.utils.hashing import chain_block_hashes
+
+
+@pytest.fixture(autouse=True)
+def _fresh_global_lru():
+    hashmemo.global_lru_clear()
+    yield
+    hashmemo.global_lru_clear()
+
+
+def _request(rid="r1", prompt="hello world " * 40, tokens=None):
+    return InferenceRequest(
+        request_id=rid, target_model="tiny",
+        body=InferenceRequestBody(completions={"prompt": prompt},
+                                  tokenized_prompt=tokens))
+
+
+def _endpoints(n, block_size=16, num_blocks=4096):
+    eps = []
+    for i in range(n):
+        ep = Endpoint(EndpointMetadata(name=f"ep{i}", address=f"10.8.0.{i}",
+                                       port=9000))
+        ep.metrics.cache_block_size = block_size
+        ep.metrics.cache_num_blocks = num_blocks
+        eps.append(ep)
+    return eps
+
+
+def _result_for(ep):
+    return SchedulingResult(
+        profile_results={"default": ProfileRunResult(target_endpoints=[ep])},
+        primary_profile_name="default")
+
+
+# ---- memo semantics -------------------------------------------------------
+
+
+def test_memo_parity_with_direct_chain():
+    # Char-based (no tokenized prompt) and token-based, several block sizes:
+    # the memo is a pure cache — values must be bit-identical to the direct
+    # computation.
+    for tokens in (None, list(range(100, 180))):
+        for bs in (4, 16, 64):
+            req = _request(tokens=tokens)
+            direct = chain_block_hashes("tiny", tokens,
+                                        req.body.prompt_text(), bs)
+            assert request_prefix_hashes(req, bs) == direct
+            # Second read: same values, served from the memo.
+            assert request_prefix_hashes(req, bs) == direct
+
+
+def test_memo_empty_token_list_falls_back_to_char_hashing():
+    # An engine render reply of [] must behave like the direct call sites
+    # did (`if token_ids:` truthiness): char-based chains, never an empty
+    # token chain that zeroes every prefix score.
+    req = _request(tokens=[])
+    assert request_prefix_hashes(req, 16) == chain_block_hashes(
+        "tiny", None, req.body.prompt_text(), 16)
+
+
+def test_memo_invalidated_by_tokenization_upgrade():
+    # TokenProducer sets tokenized_prompt mid-cycle: a char-based chain
+    # memoized before the upgrade must never be served afterwards.
+    req = _request()
+    char_chain = request_prefix_hashes(req, 16)
+    assert char_chain == chain_block_hashes("tiny", None,
+                                            req.body.prompt_text(), 16)
+    req.body.tokenized_prompt = list(range(200, 264))
+    tok_chain = request_prefix_hashes(req, 16)
+    assert tok_chain == chain_block_hashes("tiny", req.body.tokenized_prompt,
+                                           "", 16)
+    assert tok_chain != char_chain
+
+
+def test_memo_reuse_on_reschedule_no_recompute():
+    # The retry/failover path re-runs producers' pre_request and the scorer
+    # against the SAME request object: zero new chain computations.
+    req = _request(tokens=list(range(0, 96)))
+    eps = _endpoints(128)
+    prod = ApproxPrefixCacheProducer("approx")
+    scorer = PrecisePrefixCacheScorer("precise")
+
+    before = hashing.CHAIN_COMPUTES
+    asyncio.run(prod.produce(None, req, eps))
+    scorer.score(None, None, req, eps)
+    prod.pre_request(None, req, _result_for(eps[0]))
+    scorer.pre_request(None, req, _result_for(eps[0]))
+    first_cycle = hashing.CHAIN_COMPUTES - before
+    # The O-claim: one full 128-endpoint cycle (produce + score + both
+    # pre_request hooks) computes the chain at most twice — not O(endpoints).
+    assert first_cycle <= 2
+
+    before = hashing.CHAIN_COMPUTES
+    scorer.score(None, None, req, [ep for ep in eps if ep is not eps[0]])
+    prod.pre_request(None, req, _result_for(eps[1]))
+    scorer.pre_request(None, req, _result_for(eps[1]))
+    assert hashing.CHAIN_COMPUTES - before == 0  # reschedule: pure reuse
+
+
+def test_global_lru_serves_repeat_prompts_across_requests():
+    tokens = list(range(500, 564))
+    r1 = _request(rid="a", tokens=list(tokens))
+    r2 = _request(rid="b", tokens=list(tokens))  # fresh request object
+    h1 = request_prefix_hashes(r1, 16)
+    before = hashing.CHAIN_COMPUTES
+    assert request_prefix_hashes(r2, 16) == h1
+    assert hashing.CHAIN_COMPUTES - before == 0  # LRU hit, no xxhash at all
+
+
+def test_global_lru_distinguishes_model_mode_and_block_size():
+    tokens = list(range(64))
+    req = _request(tokens=tokens)
+    assert request_prefix_hashes(req, 16) != request_prefix_hashes(req, 32)
+    other = InferenceRequest(
+        request_id="m2", target_model="other-model",
+        body=InferenceRequestBody(completions={"prompt": "x"},
+                                  tokenized_prompt=list(tokens)))
+    assert request_prefix_hashes(other, 16) != request_prefix_hashes(req, 16)
+
+
+# ---- batched KV-index matching -------------------------------------------
+
+
+def test_match_prefix_consecutive_walk():
+    idx = KvBlockIndex()
+    idx.add("pod", [1, 2, 3])
+    idx.add_speculative("pod", [4])
+    assert idx.match_prefix("pod", [1, 2, 3, 4, 99]) == 4
+    assert idx.match_prefix("pod", [2, 3]) == 2
+    assert idx.match_prefix("pod", [99, 1]) == 0  # must match from the start
+    assert idx.match_prefix("other", [1]) == 0
+    assert idx.match_prefix("pod", []) == 0
+
+
+def test_match_prefix_batched_expiry_sweep():
+    idx = KvBlockIndex()
+    idx.add("pod", [1, 2])
+    idx.add_speculative("pod", [3])
+    # Force-expire entry 2 and the speculative 3; the next lookup must not
+    # count either, and the due per-pod sweep must physically drop the
+    # confirmed one (per-pod — a match never scans the whole index).
+    idx._by_pod["pod"][2] = 0.0
+    idx._speculative[("pod", 3)] = 0.0
+    idx._next_pod_sweep["pod"] = 0.0
+    assert idx.match_prefix("pod", [1, 2, 3]) == 1
+    assert 2 not in idx._by_pod["pod"]
+    # Speculative garbage is collected on the subscriber write path (add),
+    # never on the scoring path.
+    idx._next_spec_sweep = 0.0
+    idx.add("other", [9])
+    assert ("pod", 3) not in idx._speculative
+
+
+def test_holds_still_honors_expiry():
+    idx = KvBlockIndex()
+    idx.add("pod", [7])
+    assert idx.holds("pod", 7)
+    idx._by_pod["pod"][7] = 0.0
+    assert not idx.holds("pod", 7)
+
+
+# ---- producer satellites --------------------------------------------------
+
+
+def test_pod_lru_resizes_when_cache_geometry_appears():
+    prod = ApproxPrefixCacheProducer("approx")
+    ep = _endpoints(1, num_blocks=0)[0]  # first scrape not landed yet
+    lru = prod._lru_for(ep)
+    assert lru.capacity == prod.lru_capacity  # default fallback, not pinned
+    for h in range(16):
+        lru.add(h)
+    ep.metrics.cache_num_blocks = 8  # real geometry lands
+    lru2 = prod._lru_for(ep)
+    assert lru2 is lru and lru2.capacity == 8
+    assert len(lru2) == 8  # trimmed to the real capacity, LRU end dropped
+    assert lru2.contains(15) and not lru2.contains(0)
+    ep.metrics.cache_num_blocks = 32  # geometry can also grow
+    assert prod._lru_for(ep).capacity == 32
+    # A scrape flapping back to 0 (family missing one poll) keeps the last
+    # known capacity instead of shrinking to the default and evicting.
+    ep.metrics.cache_num_blocks = 0
+    assert prod._lru_for(ep).capacity == 32
+
+
+def test_scheduler_keys_track_reordering_filter():
+    # The Filter protocol doesn't forbid same-length reordering: scores must
+    # still land on the right endpoints.
+    from llm_d_inference_scheduler_tpu.router.plugins.pickers import (
+        MaxScorePicker,
+    )
+    from llm_d_inference_scheduler_tpu.router.plugins.profile_handlers import (
+        SingleProfileHandler,
+    )
+    from llm_d_inference_scheduler_tpu.router.scheduling.scheduler import (
+        Scheduler,
+        SchedulerProfile,
+        WeightedScorer,
+    )
+
+    class ReverseFilter:
+        def typed_name(self):
+            return "reverse-filter"
+
+        def filter(self, ctx, state, request, endpoints):
+            return list(reversed(endpoints))
+
+    class LastWinsScorer:
+        def typed_name(self):
+            return "last-wins-scorer"
+
+        def score(self, ctx, state, request, endpoints):
+            return {eps[-1].metadata.address_port: 1.0
+                    for eps in [endpoints]}
+
+    eps = _endpoints(4)
+    profile = SchedulerProfile(
+        "default", [ReverseFilter()],
+        [WeightedScorer(LastWinsScorer(), 1.0)],
+        MaxScorePicker("max-score-picker"))
+    sched = Scheduler({"default": profile}, SingleProfileHandler())
+    result = sched.schedule(None, _request(), eps)
+    # After reversal the last candidate is eps[0]; a stale key snapshot
+    # would pair its 1.0 score with a different endpoint.
+    picked = result.primary().target_endpoints[0]
+    assert picked.metadata.address_port == eps[0].metadata.address_port
+
+
+def test_token_producer_cache_keys_are_fingerprints():
+    prod = TokenProducer("tok")
+    prompt = "a very long prompt " * 200
+    ids = [1, 2, 3]
+    prod._cache[("tiny", hashing.text_fingerprint(prompt))] = ids
+    req = _request(prompt=prompt)
+    asyncio.run(prod.produce(None, req, _endpoints(1)))
+    assert req.body.tokenized_prompt == ids  # hit without any HTTP call
+    # No key may pin prompt text verbatim.
+    assert all(isinstance(m, str) and isinstance(fp, int)
+               for m, fp in prod._cache)
+
+
+# ---- SSE find-offset parsing ---------------------------------------------
+
+
+def test_drain_sse_frames_across_chunk_boundaries():
+    buf = ""
+    frames = []
+    for chunk in ["data: {\"a\"", ": 1}\n\ndata: {\"b\": 2}\n", "\n",
+                  "data: partial"]:
+        buf += chunk
+        got, buf = drain_sse_frames(buf)
+        frames.extend(got)
+    assert frames == ['data: {"a": 1}', 'data: {"b": 2}']
+    assert buf == "data: partial"  # incomplete frame stays buffered
+    got, buf = drain_sse_frames(buf + "\n\n")
+    assert got == ["data: partial"] and buf == ""
+
+
+# ---- hot-path lint hook ---------------------------------------------------
+
+
+def test_verify_hotpath_lint_clean():
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]
+                           / "scripts"))
+    import verify_hotpath
+
+    assert verify_hotpath.check() == []

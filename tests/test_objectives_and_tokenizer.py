@@ -124,9 +124,14 @@ pool:
                                            "max_tokens": 1})
                     served.append(r.headers["x-gateway-destination-endpoint-served"])
             assert len(set(served)) == 1  # exact-token prefix affinity sticks
-            # the producer actually tokenized: its cache holds the prompt
+            # the producer actually tokenized: its cache holds the prompt's
+            # fingerprint (keys never pin prompt text verbatim)
+            from llm_d_inference_scheduler_tpu.utils.hashing import (
+                text_fingerprint,
+            )
+
             producer = gw.cfg.plugins_by_name["token-producer"]
-            assert any(k[1].startswith("shared prefix") for k in producer._cache)
+            assert ("tiny", text_fingerprint(prompt)) in producer._cache
         finally:
             await gw.stop()
             for e in engines:
