@@ -5,16 +5,17 @@
 PY ?= python
 
 .PHONY: test test-fast test-unit test-dist test-chaos bench bench-flowcontrol \
-	bench-router-sse bench-decisions bench-sched dryrun render-chart \
-	compile-check verify-metrics verify-decisions verify-hotpath
+	bench-router-sse bench-decisions bench-sched bench-sched-offload dryrun \
+	render-chart compile-check verify-metrics verify-decisions \
+	verify-hotpath verify-threadsafe
 
 # Full hermetic suite (virtual 8-device CPU mesh; no TPU or cluster needed —
 # the reference needs envtest + kind for the equivalent coverage).
-test: verify-metrics verify-decisions verify-hotpath
+test: verify-metrics verify-decisions verify-hotpath verify-threadsafe
 	$(PY) -m pytest tests/ -q
 
 # Everything except the spawned-process distributed tests (the slow tail).
-test-fast: verify-metrics verify-decisions verify-hotpath
+test-fast: verify-metrics verify-decisions verify-hotpath verify-threadsafe
 	$(PY) -m pytest tests/ -q --deselect tests/test_multihost.py \
 		--deselect tests/test_multihost_pd.py
 
@@ -36,6 +37,13 @@ verify-decisions:
 verify-hotpath:
 	$(PY) scripts/verify_hotpath.py
 
+# Thread-safety declaration lint: every registered filter/scorer/picker
+# must declare its THREAD_SAFE audit result — undeclared plugins would be
+# silently trampolined onto the event loop, defeating the scheduler-pool
+# offload (also hooked into pytest via tests/test_schedpool.py).
+verify-threadsafe:
+	$(PY) scripts/verify_threadsafe.py
+
 # Recorder-overhead microbench on the flow-control dispatch path (CPU-only;
 # writes benchmarks/DECISIONS_MICRO.json — target <3%, kill-switch ~0%).
 bench-decisions:
@@ -46,6 +54,14 @@ bench-decisions:
 # benchmarks/SCHED_HOTPATH.json — target ≥30% lower cost at 128×64.
 bench-sched:
 	$(PY) bench.py --sched-microbench --sweep-only
+
+# Concurrent-scheduling offload bench (CPU-only): event-loop stall p50/p99
+# + streamed-token inter-arrival gap while 32 concurrent 128-endpoint
+# scheduling cycles churn, offload on vs off; plus offloaded per-cycle cost
+# and inline-vs-offload pick parity. Writes benchmarks/SCHED_OFFLOAD.json —
+# target ≥5x lower p99 loop stall with offload on.
+bench-sched-offload:
+	$(PY) bench.py --sched-offload
 
 test-unit: test-fast
 

@@ -41,6 +41,7 @@ class RawConfig:
     parser: dict[str, Any] | None
     data_layer: dict[str, Any]
     flow_control: dict[str, Any]
+    scheduling: dict[str, Any]
     saturation_detector: dict[str, Any] | None
     resilience: dict[str, Any]
     decisions: dict[str, Any]
@@ -63,6 +64,10 @@ class RouterConfig:
     feature_gates: dict[str, bool]
     parser_spec: dict[str, Any]
     flow_control: dict[str, Any]
+    # scheduling: the concurrent scheduling engine knobs
+    # (router/schedpool.py SchedulingConfig — {workers, maxBatch};
+    # workers: 0 is the inline kill-switch).
+    scheduling: dict[str, Any]
     saturation_detector_spec: dict[str, Any] | None
     resilience: dict[str, Any]
     # decisions: the decision flight recorder knobs (enabled/capacity/topK —
@@ -99,6 +104,7 @@ def load_raw_config(text: str | None) -> RawConfig:
         parser=doc.get("parser"),
         data_layer=doc.get("dataLayer") or {},
         flow_control=doc.get("flowControl") or {},
+        scheduling=doc.get("scheduling") or {},
         saturation_detector=doc.get("saturationDetector"),
         resilience=doc.get("resilience") or {},
         decisions=doc.get("decisions") or {},
@@ -261,6 +267,7 @@ def instantiate(raw: RawConfig, handle: Handle,
         feature_gates=raw.feature_gates,
         parser_spec=parser_spec,
         flow_control=raw.flow_control,
+        scheduling=raw.scheduling,
         saturation_detector_spec=raw.saturation_detector,
         resilience=raw.resilience,
         decisions=raw.decisions,

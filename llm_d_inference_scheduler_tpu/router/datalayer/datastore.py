@@ -10,10 +10,12 @@ from __future__ import annotations
 
 import dataclasses
 import random
+import time
 from typing import Callable, Iterable
 
 from ..framework.datalayer import Endpoint, EndpointMetadata
 from ..resilience import BreakerRegistry
+from ..snapshot import PoolSnapshot
 
 
 @dataclasses.dataclass
@@ -68,6 +70,45 @@ class Datastore:
         # shared by the gateway's retry path and the circuit-breaker-filter
         # scheduling plugin so ejections apply fleet-wide.
         self.breakers = BreakerRegistry()
+        # Copy-on-write scheduling snapshot (router/snapshot.py). Two dirty
+        # levels: membership changes (add/delete/resync) force a rebuild on
+        # the next snapshot() call — a deleted endpoint must leave the
+        # scheduling view promptly; scrape landings mark the snapshot STALE,
+        # rebuilt only once the current epoch is older than
+        # SNAPSHOT_MIN_REFRESH_S. Under steady scraping (128 collectors ×
+        # 50 ms poll ≈ 2.5k landings/s) an unconditional rebuild would copy
+        # the whole pool on the event loop for nearly every request — and
+        # co-dispatched batch members could each see a different epoch if a
+        # scrape landed between their director steps. The refresh floor
+        # bounds rebuild CPU and keeps one epoch per dispatch batch; scraped
+        # metrics are inherently ≥ one poll interval stale anyway.
+        self._snapshot: PoolSnapshot | None = None
+        self._snapshot_dirty = True   # hard: membership changed
+        self._snapshot_stale = False  # soft: scrape data landed
+        self._snapshot_epoch = 0
+
+    # ---- scheduling snapshot ------------------------------------------
+
+    SNAPSHOT_MIN_REFRESH_S = 0.01
+
+    def mark_snapshot_dirty(self) -> None:
+        """A scrape landed: refresh the snapshot once the rate-limit floor
+        passes (soft staleness — pool membership is unchanged)."""
+        self._snapshot_stale = True
+
+    def snapshot(self) -> PoolSnapshot:
+        """Current copy-on-write pool snapshot (rebuilt lazily when dirty)."""
+        snap = self._snapshot
+        rebuild = snap is None or self._snapshot_dirty or (
+            self._snapshot_stale
+            and time.monotonic() - snap.built_at >= self.SNAPSHOT_MIN_REFRESH_S)
+        if rebuild:
+            self._snapshot_epoch += 1
+            self._snapshot = PoolSnapshot(self._snapshot_epoch,
+                                          self._endpoints.values())
+            self._snapshot_dirty = False
+            self._snapshot_stale = False
+        return self._snapshot
 
     # ---- pool ----------------------------------------------------------
 
@@ -89,6 +130,7 @@ class Datastore:
 
     def endpoint_add_or_update(self, meta: EndpointMetadata) -> Endpoint:
         key = meta.address_port
+        self._snapshot_dirty = True
         ep = self._endpoints.get(key)
         if ep is None:
             ep = Endpoint(meta)
@@ -102,6 +144,7 @@ class Datastore:
     def endpoint_delete(self, address_port: str) -> None:
         ep = self._endpoints.pop(address_port, None)
         if ep is not None:
+            self._snapshot_dirty = True
             self.breakers.remove(address_port)
             for fn in self._listeners:
                 fn("removed", ep)

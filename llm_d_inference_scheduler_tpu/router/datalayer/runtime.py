@@ -6,13 +6,29 @@ gets a Collector task that, every tick (default 50ms like the reference),
 runs source.collect() and feeds the raw result through the source's
 extractors, updating the endpoint's Metrics/Attributes in place. Endpoint
 lifecycle events fan out to registered EndpointLifecycle plugins.
+
+Two scale behaviors (ISSUE 5):
+
+- **Extractor offload**: the Prometheus text parse inside each extractor is
+  pure-Python CPU (at 128 pods × 1 s it rides the event loop between every
+  SSE token write). With an ``offload`` executor attached (the scheduler
+  pool's workers, router/schedpool.py), extraction runs off-loop; the
+  collector awaits completion, so per-endpoint write ordering is unchanged.
+- **Start-time jitter**: collectors used to start in phase, so every
+  interval tick scraped the whole fleet in one burst. The first collect
+  stays immediate (readiness), then each collector sleeps a random fraction
+  of one interval once, de-phasing the fleet permanently.
+
+Each completed scrape marks the datastore's scheduling snapshot dirty —
+the copy-on-write publication point of router/snapshot.py.
 """
 
 from __future__ import annotations
 
 import asyncio
 import logging
-from typing import Any
+import random
+from typing import Any, Callable
 
 from ..framework.datalayer import Endpoint
 from .datastore import Datastore
@@ -23,10 +39,15 @@ DEFAULT_POLL_INTERVAL_S = 0.05  # reference: datalayer/collector.go:52
 
 
 class _Collector:
-    def __init__(self, endpoint: Endpoint, sources: list[Any], interval: float):
+    def __init__(self, endpoint: Endpoint, sources: list[Any], interval: float,
+                 *, offload: Any = None, jitter_s: float = 0.0,
+                 on_scrape: Callable[[], None] | None = None):
         self.endpoint = endpoint
         self.sources = sources
         self.interval = interval
+        self.offload = offload            # executor for off-loop extraction
+        self.jitter_s = jitter_s          # one-shot phase offset (anti-herd)
+        self.on_scrape = on_scrape        # snapshot dirty notification
         self._task: asyncio.Task | None = None
 
     def start(self):
@@ -36,17 +57,41 @@ class _Collector:
         if self._task:
             self._task.cancel()
 
+    def _extract(self, src: Any, raw: Any) -> None:
+        """One source's extractor chain (runs on a worker when offloaded:
+        the Prometheus text parse is the CPU; extractors write scalar
+        metric fields + whole attribute values, both GIL-atomic, and the
+        collector awaits completion so ordering per endpoint holds)."""
+        for ex in src.extractors():
+            ex.extract(raw, self.endpoint)
+
     async def _run(self):
         try:
+            first = True
             while True:
+                landed = False
                 for src in self.sources:
                     try:
                         raw = await src.collect(self.endpoint)
-                        for ex in src.extractors():
-                            ex.extract(raw, self.endpoint)
+                        if self.offload is not None:
+                            await asyncio.get_running_loop().run_in_executor(
+                                self.offload, self._extract, src, raw)
+                        else:
+                            self._extract(src, raw)
+                        landed = True
                     except Exception:
                         log.exception("collector error for %s",
                                       self.endpoint.metadata.address_port)
+                if landed and self.on_scrape is not None:
+                    self.on_scrape()
+                if first:
+                    # De-phase after the immediate first collect: without
+                    # this every collector started by start() ticks in
+                    # lockstep and each interval scrapes the fleet in one
+                    # thundering-herd burst.
+                    first = False
+                    if self.jitter_s > 0:
+                        await asyncio.sleep(self.jitter_s)
                 await asyncio.sleep(self.interval)
         except asyncio.CancelledError:
             pass
@@ -58,8 +103,12 @@ class DataLayerRuntime:
         self.poll_interval = poll_interval
         self.sources: list[Any] = []
         self.lifecycle_plugins: list[Any] = []
+        # CPU-offload executor for extractor parsing (the gateway attaches
+        # the scheduler pool's workers when `scheduling.workers > 0`).
+        self.offload: Any = None
         self._collectors: dict[str, _Collector] = {}
         self._started = False
+        self._jitter_rng = random.Random()
         datastore.on_endpoint_event(self._on_endpoint_event)
 
     def register_source(self, source: Any) -> None:
@@ -106,6 +155,9 @@ class DataLayerRuntime:
         key = ep.metadata.address_port
         if key in self._collectors:
             return
-        c = _Collector(ep, self.sources, self.poll_interval)
+        c = _Collector(ep, self.sources, self.poll_interval,
+                       offload=self.offload,
+                       jitter_s=self._jitter_rng.uniform(0, self.poll_interval),
+                       on_scrape=self.datastore.mark_snapshot_dirty)
         self._collectors[key] = c
         c.start()

@@ -58,6 +58,20 @@ class DecisionRecord:
     _EMPTY_DICT: dict[str, Any] = {}
     _EMPTY_LIST: list[Any] = []
 
+    @staticmethod
+    def _live_items(d: dict[str, Any]) -> list[tuple[str, Any]]:
+        """Snapshot a dict's items for render-side iteration. Scheduling
+        cycles may run on scheduler-pool worker threads
+        (router/schedpool.py), so a record rendered by GET /debug/decisions
+        on the event loop can be mid-mutation: a key insert during a plain
+        ``.items()`` walk raises RuntimeError (and a bounded retry does NOT
+        converge against a busy writer — the walk loses the race every
+        time). ``dict(d)`` of a plain dict is a single C-level copy under
+        the GIL, atomic w.r.t. concurrent inserts; iterating the private
+        copy can never see a resize. A half-written round then renders as
+        a point-in-time view — fine for a debug surface."""
+        return list(dict(d).items())
+
     def __init__(self, request_id: str, model: str, *, top_k: int = 8):
         self.top_k = top_k
         self._reset(request_id, model)
@@ -257,8 +271,8 @@ class DecisionRecord:
             doc["summary"] = self.summary_line()
             return doc
         doc["producers"] = self.producers
-        doc["rounds"] = [self._render_round(r) for r in self.rounds]
-        doc["attempts"] = self.attempts
+        doc["rounds"] = [self._render_round(r) for r in list(self.rounds)]
+        doc["attempts"] = list(self.attempts)
         return doc
 
     def _render_admission(self) -> dict[str, Any]:
@@ -271,11 +285,11 @@ class DecisionRecord:
         return {"reason": rnd["reason"],
                 "candidates_in": rnd["candidates_in"],
                 "profiles": {p: self._render_profile(sec)
-                             for p, sec in rnd["profiles"].items()}}
+                             for p, sec in self._live_items(rnd["profiles"])}}
 
     def _render_profile(self, sec: dict[str, Any]) -> dict[str, Any]:
         scorers = {}
-        for name, s in sec["scorers"].items():
+        for name, s in self._live_items(sec["scorers"]):
             raw = s["_raw"]
             w = s["weight"]
             top = sorted(raw.items(), key=lambda kv: kv[1],
@@ -297,8 +311,8 @@ class DecisionRecord:
     def _primary_picker(self) -> dict[str, Any] | None:
         """Picker section of the last round's first picked profile (the
         primary is scheduled first by every profile handler here)."""
-        for rnd in reversed(self.rounds):
-            for sec in rnd["profiles"].values():
+        for rnd in reversed(list(self.rounds)):
+            for _, sec in self._live_items(rnd["profiles"]):
                 if sec.get("picker") and sec["picker"].get("picked"):
                     return sec["picker"]
         return None
@@ -319,9 +333,9 @@ class DecisionRecord:
             if "queue_ms" in self.admission:
                 parts.append(f"queue_ms={self.admission['queue_ms']:.3f}")
         drops = []
-        for rnd in self.rounds:
-            for pname, sec in rnd["profiles"].items():
-                for f in sec["filters"]:
+        for rnd in list(self.rounds):
+            for pname, sec in self._live_items(rnd["profiles"]):
+                for f in list(sec["filters"]):
                     if f["dropped"]:
                         drops.append(f"{pname}/{f['plugin']}:{len(f['dropped'])}")
         if drops:
@@ -381,11 +395,18 @@ class DecisionConfig:
 class DecisionRecorder:
     """Bounded, lock-free ring of DecisionRecords with an id index.
 
-    All writers run on the gateway's event loop (director, scheduler,
-    flow-control admission, proxy failover), so plain dict/deque mutation is
-    safe and cheap — no lock on the dispatch path. The ring bounds memory:
-    evicting the oldest record also drops its index entry (unless a newer
-    record reused the id)."""
+    Ring and index mutation (start/evict/lookup) stays on the gateway's
+    event loop. Record CONTENT writers are loop-bound too (director,
+    flow-control admission, proxy failover) with one exception: the
+    scheduler's round/profile hooks run on scheduler-pool worker threads
+    when `scheduling.workers > 0` (router/schedpool.py). Every such write
+    is an individually GIL-atomic list append or dict insert, so the path
+    stays lock-free; the render side (GET /debug/decisions, header
+    summaries) snapshots live dicts via ``DecisionRecord._live_items``
+    instead of iterating them raw — an in-flight record renders as a
+    point-in-time view rather than raising mid-mutation. The ring bounds
+    memory: evicting the oldest record also drops its index entry (unless
+    a newer record reused the id)."""
 
     def __init__(self, cfg: DecisionConfig | None = None):
         self.cfg = cfg or DecisionConfig()

@@ -33,7 +33,11 @@ import logging
 import time
 from typing import Any, Callable
 
-from ..metrics import FLOW_CONTROL_QUEUE_SECONDS, FLOW_CONTROL_QUEUE_SIZE
+from ..metrics import (
+    FLOW_CONTROL_QUEUE_SECONDS,
+    FLOW_CONTROL_QUEUE_SIZE,
+    SCHED_BATCH_SIZE,
+)
 from .policies import (
     FAIRNESS_POLICIES,
     ORDERING_POLICIES,
@@ -66,6 +70,11 @@ class FlowControlConfig:
     per_flow_max_bytes: int | None = None
     default_ttl_s: float = DEFAULT_TTL_S
     flow_gc_s: float = DEFAULT_FLOW_GC_S
+    # Batched dispatch (ISSUE 5): items popped per shard wake, fairness
+    # order preserved. 1 = the historical one-pop-one-yield cycle; the
+    # gateway raises it to scheduling.maxBatch when the scheduler pool is
+    # offloaded so co-dispatched requests share one snapshot epoch.
+    dispatch_batch: int = 1
 
     @classmethod
     def from_spec(cls, spec: dict[str, Any]) -> "FlowControlConfig":
@@ -81,6 +90,7 @@ class FlowControlConfig:
             per_flow_max_bytes=spec.get("perFlowMaxBytes"),
             default_ttl_s=float(spec.get("defaultTTLSeconds", DEFAULT_TTL_S)),
             flow_gc_s=float(spec.get("flowGCSeconds", DEFAULT_FLOW_GC_S)),
+            dispatch_batch=max(1, int(spec.get("dispatchBatch", 1))),
         )
 
 
@@ -201,17 +211,32 @@ class _Shard:
                     backoff = min(backoff * 2, SATURATION_BACKOFF_MAX_S)
                     continue
                 backoff = DISPATCH_POLL_S
-                key = self.fairness.pick_flow(self.queues)
-                if key is None:
-                    continue
-                item = self.queues[key].pop()
-                if item is None:
-                    continue
-                self.last_active[key] = time.monotonic()
-                self.total_requests -= 1
-                self.total_bytes -= item.size_bytes
-                FLOW_CONTROL_QUEUE_SECONDS.observe(time.monotonic() - item.enqueue_time)
-                item.resolve(QueueOutcome.DISPATCHED)
+                # Batched dispatch: pop up to dispatch_batch items across
+                # flows per wake, fairness-order preserved (pick_flow is
+                # consulted per item, so strict-priority / round-robin
+                # semantics are identical to the one-pop cycle), then yield
+                # ONCE. The saturation gate above was checked for the whole
+                # batch, so co-dispatched requests proceed under one
+                # scrape-state view — and, downstream, one pool-snapshot
+                # epoch (the director's snapshot rebuilds at most once per
+                # dirty event, not per request).
+                dispatched = 0
+                while dispatched < self.cfg.dispatch_batch:
+                    key = self.fairness.pick_flow(self.queues)
+                    if key is None:
+                        break
+                    item = self.queues[key].pop()
+                    if item is None:
+                        break
+                    self.last_active[key] = time.monotonic()
+                    self.total_requests -= 1
+                    self.total_bytes -= item.size_bytes
+                    FLOW_CONTROL_QUEUE_SECONDS.observe(
+                        time.monotonic() - item.enqueue_time)
+                    item.resolve(QueueOutcome.DISPATCHED)
+                    dispatched += 1
+                if dispatched:
+                    SCHED_BATCH_SIZE.observe(dispatched)
                 await asyncio.sleep(0)  # yield so dispatched work can start
         except asyncio.CancelledError:
             for q in self.queues.values():

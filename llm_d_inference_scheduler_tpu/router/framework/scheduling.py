@@ -165,6 +165,16 @@ class SchedulingResult:
 
 
 # ---- extension points --------------------------------------------------
+#
+# Thread-safety contract (scheduler-pool offload, router/schedpool.py):
+# every registered Filter/Scorer/Picker class must declare a ``THREAD_SAFE``
+# class attribute — ``True`` after auditing that concurrent off-loop
+# ``filter``/``score``/``pick`` calls cannot corrupt its state (pure reads,
+# lock-protected shared structures, or individually GIL-atomic mutations),
+# ``False`` otherwise. Plugins that declare ``False`` (or nothing — but
+# ``scripts/verify_threadsafe.py`` lints that in-tree plugins always
+# declare) are transparently trampolined back onto the event loop when the
+# pool is offloaded: correct, just not concurrent.
 
 
 @runtime_checkable

@@ -67,6 +67,7 @@ from .requestcontrol.director import (
     H_REQUEST_ID,
     RequestError,
 )
+from .schedpool import LoopLagMonitor, SchedulerPool, SchedulingConfig
 from .datalayer.data_graph import validate_and_order_producers
 
 log = logging.getLogger("router.gateway")
@@ -83,6 +84,13 @@ ROUTER_OWNED_HEADERS = ("x-prefiller-host-port", "x-encoder-hosts-ports",
 # record stays on /debug/decisions/<request-id>).
 H_DEBUG_DECISION = "x-debug-decision"
 H_DECISION_SUMMARY = "x-decision-summary"
+
+# Request bodies at or above this size have their JSON parse routed through
+# the scheduler pool's workers instead of the event loop (json.loads of a
+# multi-megabyte long-context body is a multi-millisecond loop stall —
+# larger than the scheduling cycle the pool exists to offload). Small
+# bodies parse inline: the executor hop costs more than the parse.
+LARGE_BODY_PARSE_BYTES = 16 << 10
 
 
 class Gateway:
@@ -171,6 +179,23 @@ class Gateway:
 
             admission = LegacyAdmissionController(self.detector)
 
+        # Concurrent scheduling engine (router/schedpool.py): worker threads
+        # run scheduling cycles over copy-on-write pool snapshots when
+        # `scheduling: {workers: N>0}`; workers: 0 (default) = inline path.
+        # The pool's executor doubles as the CPU-offload pool for scrape
+        # parsing (data layer) and large-body request parsing (below).
+        self.sched_pool = SchedulerPool(
+            cfg.scheduler, SchedulingConfig.from_spec(cfg.scheduling))
+        dl_runtime.offload = self.sched_pool.executor
+        if self.flow_controller is not None and self.sched_pool.offloaded:
+            # Batched flow-control dispatch: one shard wake hands up to
+            # maxBatch co-dispatched requests to the pool; they share one
+            # snapshot epoch and one scrape-state view.
+            self.flow_controller.cfg.dispatch_batch = max(
+                self.flow_controller.cfg.dispatch_batch,
+                self.sched_pool.cfg.max_batch)
+        self.loop_lag = LoopLagMonitor()
+
         producers = validate_and_order_producers(cfg.producers)
         self.director = Director(
             datastore, cfg.scheduler, admission=admission,
@@ -180,7 +205,8 @@ class Gateway:
             response_received=cfg.response_received,
             response_streaming=cfg.response_streaming,
             response_complete=cfg.response_complete,
-            recorder=self.decision_recorder)
+            recorder=self.decision_recorder,
+            sched_pool=self.sched_pool)
 
         self.app = web.Application()
         self.app.add_routes([
@@ -273,6 +299,10 @@ class Gateway:
                            if self.tls else None)
         await site.start()
         self._flusher = asyncio.get_running_loop().create_task(self._flush_pool_gauges())
+        # Loop-lag heartbeat: the stall token relays experience, live on
+        # /metrics (router_loop_lag_seconds) — the number the scheduler
+        # offload exists to shrink.
+        self.loop_lag.start()
         if self.grpc_health is not None:
             await self.grpc_health.start()
         if self.grpc_ext_proc is not None:
@@ -287,6 +317,7 @@ class Gateway:
                  self.host, self.port, len(self.datastore.endpoint_list()))
 
     async def stop(self):
+        self.loop_lag.stop()
         if self._flusher:
             self._flusher.cancel()
         if self.grpc_health is not None:
@@ -308,6 +339,7 @@ class Gateway:
         if getattr(self, "_upstream", None) is not None:
             await self._upstream.close()
         await self.dl_runtime.stop()
+        self.sched_pool.shutdown()
         if self.tls is not None:
             self.tls.close()
 
@@ -453,7 +485,19 @@ class Gateway:
                 {"error": "deadline exceeded"}, status=504,
                 headers={X_REMOVAL_REASON: DEADLINE_EXCEEDED_REASON})
 
-        parse = self.parser.parse(raw, headers, path=request.path)
+        # Large bodies parse off-loop (the parsers are stateless): a
+        # multi-megabyte long-context JSON body is pure CPU that would
+        # otherwise stall every live SSE relay for milliseconds.
+        if (len(raw) >= LARGE_BODY_PARSE_BYTES
+                and self.sched_pool.executor is not None):
+            import functools
+
+            parse = await asyncio.get_running_loop().run_in_executor(
+                self.sched_pool.executor,
+                functools.partial(self.parser.parse, raw, headers,
+                                  path=request.path))
+        else:
+            parse = self.parser.parse(raw, headers, path=request.path)
         if parse.error:
             return web.json_response({"error": parse.error}, status=400)
 

@@ -132,3 +132,23 @@ PICKER_WIN_MARGIN = Histogram(
     "(0 = coin flip; large = decisive pick)",
     ("picker",), registry=REGISTRY,
     buckets=(0.0, .01, .025, .05, .1, .25, .5, 1.0, 2.0, 4.0))
+# Concurrent scheduling engine (router/schedpool.py + router/snapshot.py):
+# off-loop scheduler workers over copy-on-write pool snapshots, batched
+# flow-control dispatch.
+SCHED_OFFLOAD_QUEUE_SECONDS = Histogram(
+    "router_sched_offload_queue_seconds",
+    "Time a scheduling cycle waited between submission to the worker pool "
+    "and a worker picking it up",
+    registry=REGISTRY,
+    buckets=(.00001, .0001, .00025, .0005, .001, .0025, .005, .01, .05, .1))
+SCHED_BATCH_SIZE = Histogram(
+    "router_sched_batch_size",
+    "Flow-control items dispatched per shard wake (co-dispatched batches "
+    "share one pool-snapshot epoch)",
+    registry=REGISTRY, buckets=(1, 2, 4, 8, 16, 32, 64))
+LOOP_LAG_SECONDS = Histogram(
+    "router_loop_lag_seconds",
+    "Event-loop scheduling stall sampled by the gateway's heartbeat "
+    "(sleep-overshoot of a 100ms timer; the stall token relays experience)",
+    registry=REGISTRY,
+    buckets=(.0001, .0005, .001, .0025, .005, .01, .025, .05, .1, .5))

@@ -38,6 +38,11 @@ class PrefixBasedPdDecider(PluginBase):
     """Disaggregate iff non-cached input tokens ≥ threshold
     (prefix_based_pd_decider.go:99-149)."""
 
+    # Audited: disaggregate (called off-loop from the disagg handler's
+    # pick_profiles) only reads the request and endpoint attributes;
+    # threshold_tokens is configure-time constant.
+    THREAD_SAFE = True
+
     def __init__(self, name: str | None = None):
         super().__init__(name)
         self.threshold_tokens = 256
@@ -58,6 +63,8 @@ class PrefixBasedPdDecider(PluginBase):
 class AlwaysDisaggPdDecider(PluginBase):
     """Always split (benchmarking — always_disagg_pd_decider.go)."""
 
+    THREAD_SAFE = True  # audited: stateless
+
     def disaggregate(self, ctx, request, decode_endpoint) -> bool:
         return True
 
@@ -68,6 +75,8 @@ class AlwaysDisaggMultimodalDecider(PluginBase):
     (always_disagg_mm_decider.go)."""
 
     MM_TYPES = ("image_url", "video_url", "input_audio")
+
+    THREAD_SAFE = True  # audited: pure read of the request body
 
     def disaggregate(self, ctx, request, decode_endpoint) -> bool:
         chat = request.body.chat_completions
@@ -92,6 +101,11 @@ class DataParallelProfileHandler(PluginBase):
     engine. Rank count comes from the pod label llm-d.ai/dp-size."""
 
     DP_SIZE_LABEL = "llm-d.ai/dp-size"
+
+    # Audited: pick_profiles/process_results (the off-loop methods) are
+    # stateless; the _rr rotation is only mutated in pre_request, which the
+    # director runs on the event loop.
+    THREAD_SAFE = True
 
     def __init__(self, name: str | None = None):
         super().__init__(name)
@@ -164,6 +178,13 @@ class DisaggProfileHandler(PluginBase):
     """Unified D / P-D (E-stages reserved) profile orchestration."""
 
     DECODE, PREFILL, ENCODE = "decode", "prefill", "encode"
+
+    # Audited: pick_profiles/process_results read configure-time decider
+    # refs and per-cycle arguments only; the deciders they delegate to
+    # declare their own THREAD_SAFE audits. A decider declaring False makes
+    # this handler unsafe too — the scheduler pool enforces that at bind
+    # time (schedpool._handler_threadsafe trampolines the whole handler).
+    THREAD_SAFE = True
 
     def __init__(self, name: str | None = None):
         super().__init__(name)

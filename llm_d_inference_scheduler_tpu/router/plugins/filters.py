@@ -15,6 +15,9 @@ class _RoleFilter(PluginBase):
 
     ROLES: tuple[str, ...] = ()
     MATCH_UNLABELED = False
+    # Thread-safety audit (scheduler-pool offload, router/schedpool.py):
+    # pure read of immutable metadata labels.
+    THREAD_SAFE = True
 
     def filter(self, ctx: Any, state: CycleState, request: InferenceRequest,
                endpoints: list[Endpoint]) -> list[Endpoint]:
@@ -46,6 +49,9 @@ class EncodeFilter(_RoleFilter):
 class LabelSelectorFilter(PluginBase):
     """Generic label matcher: matchLabels equality + matchExpressions
     (In/NotIn/Exists/DoesNotExist)."""
+
+    # Audit: match rules are written once at configure(); reads only.
+    THREAD_SAFE = True
 
     def __init__(self, name: str | None = None):
         super().__init__(name)
@@ -83,6 +89,9 @@ class FreshMetricsFilter(PluginBase):
     (fail-open, like the reference's PodsWithFreshMetrics + utilization
     detector fallback)."""
 
+    # Audit: reads the (snapshot-copied) metrics view only.
+    THREAD_SAFE = True
+
     def filter(self, ctx, state, request, endpoints):
         fresh = [ep for ep in endpoints if ep.metrics.fresh]
         return fresh or endpoints
@@ -100,6 +109,10 @@ class PrefixCacheAffinityFilter(PluginBase):
       the best non-sticky one's by more than maxTTFTPenaltyMs, stickiness is
       broken (an overloaded cache holder shouldn't trap traffic).
     """
+
+    # Audit: attribute reads (clone-on-read) + a shared random.Random whose
+    # C-level draws are GIL-atomic.
+    THREAD_SAFE = True
 
     def __init__(self, name=None):
         super().__init__(name)
@@ -180,6 +193,11 @@ class CircuitBreakerFilter(PluginBase):
     candidate is broken (scheduling must not brick on a fully-ejected
     pool)."""
 
+    # Audit: BreakerRegistry.would_allow mutates only single scalar state
+    # fields (GIL-atomic); a racing open→half-open flip at worst double
+    # counts one transition metric.
+    THREAD_SAFE = True
+
     def __init__(self, name: str | None = None):
         super().__init__(name)
         self._datastore = None
@@ -205,6 +223,9 @@ class ModelServingFilter(PluginBase):
     pools). Fail-open per endpoint until its first poll lands, and for the
     whole set when no endpoint matches (scheduling must not brick on stale
     model lists)."""
+
+    # Audit: clone-on-read attribute lookups only.
+    THREAD_SAFE = True
 
     def filter(self, ctx, state, request, endpoints):
         from ..datalayer.models_source import endpoint_models

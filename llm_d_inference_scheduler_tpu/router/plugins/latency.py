@@ -45,6 +45,10 @@ class LatencyScorer(PluginBase):
       depth, and prefix-cache score.
     """
 
+    # Thread-safety audit (scheduler-pool offload): attribute/metrics reads
+    # only; weights written once at configure().
+    THREAD_SAFE = True
+
     def __init__(self, name: str | None = None):
         super().__init__(name)
         self.ttft_weight = 0.5
@@ -158,6 +162,9 @@ class SloHeadroomTierFilter(PluginBase):
     with probability epsilonExploreNeg (default 1%) so recovering endpoints
     still see traffic; no predictions at all → pass-through.
     """
+
+    # Audit: attribute reads + GIL-atomic rng draw.
+    THREAD_SAFE = True
 
     def __init__(self, name: str | None = None):
         super().__init__(name)

@@ -11,6 +11,12 @@ from ..framework.scheduling import ScoredEndpoint
 
 
 class _PickerBase(PluginBase):
+    # Thread-safety audit (scheduler-pool offload, router/schedpool.py):
+    # config fields written once at configure(); the shared random.Random's
+    # C-level draws are GIL-atomic (interleaved draws change tie-break
+    # outcomes, never corrupt state).
+    THREAD_SAFE = True
+
     def __init__(self, name: str | None = None):
         super().__init__(name)
         self.max_endpoints = 1

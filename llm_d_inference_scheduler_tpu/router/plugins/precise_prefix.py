@@ -162,6 +162,12 @@ class KvBlockIndex:
 
 @register_plugin("precise-prefix-cache-scorer")
 class PrecisePrefixCacheScorer(PluginBase):
+    # Thread-safety audit (scheduler-pool offload): the KvBlockIndex is
+    # already lock-protected (written by subscriber threads, read by
+    # scheduling wherever it runs); the prefix-hash memo rides the request
+    # (one cycle = one thread) with its global LRU behind its own lock.
+    THREAD_SAFE = True
+
     def __init__(self, name: str | None = None):
         super().__init__(name)
         self.index = KvBlockIndex()

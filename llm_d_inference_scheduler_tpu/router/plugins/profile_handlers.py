@@ -16,6 +16,10 @@ class SchedulingError(Exception):
 class SingleProfileHandler(PluginBase):
     """One profile, one pass (reference profilehandler/single)."""
 
+    # Audited: pick_profiles/process_results (the methods that run inside
+    # Scheduler.schedule, off-loop under the scheduler pool) are stateless.
+    THREAD_SAFE = True
+
     def pick_profiles(self, ctx, request: InferenceRequest, profiles: dict[str, Any],
                       results: dict[str, ProfileRunResult]) -> dict[str, Any]:
         if results:

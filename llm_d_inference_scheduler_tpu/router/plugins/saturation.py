@@ -18,6 +18,10 @@ from ..plugins.attributes import INFLIGHT_ATTRIBUTE_KEY, InFlightLoad
 class UtilizationDetector(PluginBase):
     """EndpointScore = max(queue/queueThresh, kv/kvThresh); pool = mean."""
 
+    # Thread-safety audit (scheduler-pool offload, doubles as a filter):
+    # metrics reads; thresholds written once at configure().
+    THREAD_SAFE = True
+
     def __init__(self, name: str | None = None):
         super().__init__(name)
         self.queue_threshold = 5
@@ -45,6 +49,9 @@ class UtilizationDetector(PluginBase):
 @register_plugin("concurrency-detector")
 class ConcurrencyDetector(PluginBase):
     """In-flight load against capacity×(1+headroom), requests or tokens mode."""
+
+    # Audit: clone-on-read attribute lookups only.
+    THREAD_SAFE = True
 
     def __init__(self, name: str | None = None):
         super().__init__(name)

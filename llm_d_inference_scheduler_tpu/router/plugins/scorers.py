@@ -31,6 +31,10 @@ def _normalized_inverse(values: dict[str, float]) -> dict[str, float]:
 class QueueScorer(PluginBase):
     """Inverse waiting-queue depth (reference scorer/queuedepth)."""
 
+    # Thread-safety audit (scheduler-pool offload, router/schedpool.py):
+    # metrics/attribute reads only — declared on each stateless scorer.
+    THREAD_SAFE = True
+
     def score(self, ctx, state, request, endpoints):
         return _normalized_inverse(
             {ep.metadata.address_port: float(ep.metrics.waiting_queue_size)
@@ -41,6 +45,8 @@ class QueueScorer(PluginBase):
 class KvCacheUtilizationScorer(PluginBase):
     """1 − KV cache usage (reference scorer/kvcacheutilization)."""
 
+    THREAD_SAFE = True
+
     def score(self, ctx, state, request, endpoints):
         return {ep.metadata.address_port:
                 min(max(1.0 - ep.metrics.kv_cache_usage_percent, 0.0), 1.0)
@@ -49,6 +55,8 @@ class KvCacheUtilizationScorer(PluginBase):
 
 @register_plugin("running-requests-size-scorer")
 class RunningRequestsScorer(PluginBase):
+    THREAD_SAFE = True
+
     def score(self, ctx, state, request, endpoints):
         return _normalized_inverse(
             {ep.metadata.address_port: float(ep.metrics.running_requests_size)
@@ -59,6 +67,8 @@ class RunningRequestsScorer(PluginBase):
 class LoadAwareScorer(PluginBase):
     """Queue depth against a saturation threshold (reference scorer/loadaware):
     score = max(0, 1 - queue/threshold)."""
+
+    THREAD_SAFE = True
 
     def __init__(self, name: str | None = None):
         super().__init__(name)
@@ -79,6 +89,8 @@ class PrefixCacheScorer(PluginBase):
     """Approximate prefix-match ratio from the approx-prefix-cache-producer's
     PrefixCacheMatchInfo attribute (reference scorer/prefix)."""
 
+    THREAD_SAFE = True
+
     def consumes(self) -> list[str]:
         return [PREFIX_ATTRIBUTE_KEY]
 
@@ -95,6 +107,8 @@ class ActiveRequestScorer(PluginBase):
     """EPP-side in-flight request count from inflight-load-producer
     (reference scorer/activerequest)."""
 
+    THREAD_SAFE = True
+
     def consumes(self) -> list[str]:
         return [INFLIGHT_ATTRIBUTE_KEY]
 
@@ -109,6 +123,8 @@ class ActiveRequestScorer(PluginBase):
 @register_plugin("token-load-scorer")
 class TokenLoadScorer(PluginBase):
     """Token-weighted in-flight load (reference scorer/tokenload)."""
+
+    THREAD_SAFE = True
 
     def consumes(self) -> list[str]:
         return [INFLIGHT_ATTRIBUTE_KEY]
@@ -125,6 +141,8 @@ class TokenLoadScorer(PluginBase):
 class LoraAffinityScorer(PluginBase):
     """Prefer pods with the requested LoRA active (1.0) or waiting (0.75),
     else pods with a free adapter slot (0.5) (reference scorer/loraaffinity)."""
+
+    THREAD_SAFE = True
 
     def score(self, ctx, state, request, endpoints):
         model = request.target_model
@@ -154,6 +172,8 @@ class SessionAffinityScorer(PluginBase):
     a live endpoint simply score nothing (fresh placement)."""
 
     SESSION_HEADER = "x-session-token"
+    # Audit: stateless (header decode + metadata compare).
+    THREAD_SAFE = True
 
     @staticmethod
     def _encode(address_port: str) -> str:
@@ -199,6 +219,13 @@ class NoHitLruScorer(PluginBase):
       profile's pick to the LRU front (both grow cache on a P/D split).
     """
 
+    # Audit: the LRU/cold-tracking dicts are mutated with individually
+    # GIL-atomic operations (get / setdefault / pop-with-default /
+    # move-semantics via pop+store); concurrent cycles at worst reorder LRU
+    # positions, never corrupt state. Eviction pops pass a default so two
+    # threads draining the same oldest key cannot raise.
+    THREAD_SAFE = True
+
     def __init__(self, name: str | None = None, lru_size: int = 1024):
         super().__init__(name)
         self._lru: dict[str, None] = {}   # insertion-ordered; front = oldest
@@ -231,7 +258,12 @@ class NoHitLruScorer(PluginBase):
             # Cold requests that never reached pre_request (rejected
             # post-schedule) would otherwise accumulate; evict the OLDEST
             # entries (insertion order) so in-flight requests keep theirs.
-            self._cold.pop(next(iter(self._cold)))
+            # Default-None pop: two off-loop cycles may race to drain the
+            # same oldest key.
+            try:
+                self._cold.pop(next(iter(self._cold)), None)
+            except (StopIteration, RuntimeError):
+                break
         self._cold.setdefault(request.request_id, set()).add(profile)
         n = len(endpoints)
         if n == 1:
@@ -257,7 +289,10 @@ class NoHitLruScorer(PluginBase):
         self._lru.pop(addr, None)
         self._lru[addr] = None           # most-recent at the back
         while len(self._lru) > self._lru_size:
-            self._lru.pop(next(iter(self._lru)))
+            try:
+                self._lru.pop(next(iter(self._lru)), None)
+            except (StopIteration, RuntimeError):
+                break
 
     def pre_request(self, ctx, request, result) -> None:
         profiles_cold = self._cold.pop(request.request_id, None)
@@ -284,6 +319,8 @@ class ContextLengthAwareScorer(PluginBase):
     """Route long-context requests to endpoints with token budget for them
     (reference scorer/contextlengthaware): estimated tokens vs remaining KV
     token capacity; falls back to chars/4 when no tokenization is present."""
+
+    THREAD_SAFE = True
 
     def score(self, ctx, state, request, endpoints):
         need = estimate_input_tokens(request)

@@ -23,6 +23,9 @@ TEST_HEADER = "test-epp-endpoint-selection"
 class HeaderBasedTestingFilter(PluginBase):
     """Keep only the endpoint named by the test header (conformance steering)."""
 
+    # Audit: stateless header/metadata comparison.
+    THREAD_SAFE = True
+
     def filter(self, ctx, state, request, endpoints):
         want = request.headers.get(TEST_HEADER)
         if not want:

@@ -59,10 +59,15 @@ class Director:
                  response_received: list[Any] | None = None,
                  response_streaming: list[Any] | None = None,
                  response_complete: list[Any] | None = None,
-                 recorder: Any = None):
+                 recorder: Any = None,
+                 sched_pool: Any = None):
         self.datastore = datastore
         self.scheduler = scheduler
         self.admission = admission
+        # Scheduler pool (router/schedpool.py): when offloaded
+        # (scheduling.workers > 0), cycles run on worker threads over
+        # copy-on-write pool snapshots; None or workers: 0 = inline.
+        self.sched_pool = sched_pool
         # Decision flight recorder (router/decisions.py DecisionRecorder);
         # None or disabled → request.decision stays None and every layer
         # hook costs one `is None` check.
@@ -152,6 +157,25 @@ class Director:
                 rec.finalize(e.code, reason=e.reason)
             raise RequestError(e.code, e.reason) from None
 
+        # 4b. scheduling candidates: with the scheduler pool offloaded,
+        # re-resolve against the epoch-versioned pool snapshot AFTER the
+        # (possibly long) admission wait — producer attribute writes then
+        # land on this request's private overlay views and the off-loop
+        # cycle never races a scrape landing. Co-dispatched flow-control
+        # batch members resolve the same epoch (the snapshot rebuilds at
+        # most once per dirty event). An emptied pool keeps the
+        # pre-admission candidates: scheduling proceeds against the old
+        # epoch (endpoint deletion mid-flight is a proxy-time failure, not
+        # a scheduling KeyError).
+        if self.sched_pool is not None and self.sched_pool.offloaded:
+            snap_candidates = self._candidates(request, snapshot=True)
+            if snap_candidates:
+                candidates = snap_candidates
+            # Remembered for failover reschedules: the producer attribute
+            # overlays live on these per-request views, not on the shared
+            # endpoints, so a reschedule must score the same views.
+            request._sched_candidates = candidates
+
         # 5. data producers under a global budget (director.go:232, 400ms)
         t_prod = time.monotonic()
         await self._run_producers(ctx, request, candidates)
@@ -172,9 +196,9 @@ class Director:
                     rec.finalize(429, reason=reason)
                 raise RequestError(429, reason)
 
-        # 7. schedule
+        # 7. schedule (off-loop via the scheduler pool when configured)
         try:
-            result = self.scheduler.schedule(ctx, request, candidates)
+            result = await self._schedule(ctx, request, candidates)
         except Exception as e:
             REQUEST_ERROR_TOTAL.labels(original_model, "scheduling").inc()
             if rec is not None:
@@ -193,8 +217,20 @@ class Director:
         RUNNING_REQUESTS.labels(request.target_model).inc()
         return result
 
-    def _candidates(self, request: InferenceRequest) -> list[Endpoint]:
-        eps = self.datastore.endpoint_list()
+    async def _schedule(self, ctx: Any, request: InferenceRequest,
+                        candidates: list[Endpoint]):
+        if self.sched_pool is not None:
+            return await self.sched_pool.schedule(ctx, request, candidates)
+        return self.scheduler.schedule(ctx, request, candidates)
+
+    def _candidates(self, request: InferenceRequest,
+                    *, snapshot: bool = False) -> list[Endpoint]:
+        if snapshot:
+            # Per-request overlay views over the current snapshot epoch
+            # (router/snapshot.py) — safe to score off-loop.
+            eps: list = self.datastore.snapshot().view()
+        else:
+            eps = self.datastore.endpoint_list()
         subset = request.headers.get(H_SUBSET_HINT)
         if subset:
             allowed = {s.strip() for s in subset.split(",") if s.strip()}
@@ -221,8 +257,19 @@ class Director:
         was already admitted and its producer attributes are still fresh —
         and the request counters are not re-incremented (the original
         handle_request/handle_response_complete pair still brackets the
-        request exactly once). Returns None when no viable result exists."""
-        candidates = [ep for ep in self._candidates(request)
+        request exactly once). Runs INLINE even when the scheduler pool is
+        offloaded: failovers are rare, the caller is synchronous, and the
+        surviving candidates carry the original cycle's producer overlays.
+        Returns None when no viable result exists."""
+        base = None
+        if self.sched_pool is not None and self.sched_pool.offloaded:
+            # Offloaded cycles scored per-request snapshot views; the
+            # producer overlays (prefix match info, in-flight load) exist
+            # only there, so the reschedule reuses them.
+            base = getattr(request, "_sched_candidates", None)
+        if base is None:
+            base = self._candidates(request)
+        candidates = [ep for ep in base
                       if ep.metadata.address_port not in exclude]
         rec = request.decision
         if not candidates:

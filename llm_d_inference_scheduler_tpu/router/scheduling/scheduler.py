@@ -20,6 +20,7 @@ can annotate the cycle too.
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import logging
 import time
 from typing import Any
@@ -71,15 +72,20 @@ class SchedulerProfile:
         # record keeps every score (zero-copy), but feeding each of
         # |scorers| × |candidates| values through a prometheus histogram
         # every cycle is the recorder's single biggest CPU cost, and the
-        # distribution converges just as well sampled. Starts at N-1 so the
-        # very first recorded cycle observes (test determinism).
-        self._obs_tick = self.SCORE_OBS_SAMPLE - 1
+        # distribution converges just as well sampled. itertools.count:
+        # its __next__ is C-level GIL-atomic, so concurrent cycles on
+        # scheduler-pool workers (router/schedpool.py) never lose ticks the
+        # way a Python read-modify-write would — the profile itself must
+        # honor the THREAD_SAFE contract it imposes on its plugins. Counts
+        # from 0 so the very first recorded cycle observes (test
+        # determinism).
+        self._obs_counter = itertools.count()
         # Per-plugin duration observations ride the same scheme: a cycle
         # with 1 filter + 2 scorers + picker used to pay 18 monotonic reads
         # and 9 histogram observes per request; sampled 1-in-N the latency
         # distributions converge identically while the hot path keeps only
-        # the e2e pair. Starts at N-1 so the first cycle observes.
-        self._dur_tick = self.DURATION_OBS_SAMPLE - 1
+        # the e2e pair.
+        self._dur_counter = itertools.count()
 
     # Sampling period for router_scorer_score observations (see __init__).
     SCORE_OBS_SAMPLE = 8
@@ -97,8 +103,7 @@ class SchedulerProfile:
                    if rec is not None else None)
         # Per-plugin duration observes are sampled (see __init__); a skipped
         # cycle does zero monotonic reads for them.
-        self._dur_tick = (self._dur_tick + 1) % self.DURATION_OBS_SAMPLE
-        observe_dur = self._dur_tick == 0
+        observe_dur = next(self._dur_counter) % self.DURATION_OBS_SAMPLE == 0
         candidates = endpoints
         # address_port keys re-snapshotted after every filter (cheap now
         # that the property is cached on the metadata): filters may drop,
@@ -138,8 +143,8 @@ class SchedulerProfile:
 
         observe_scores = False
         if rec_sec is not None:
-            self._obs_tick = (self._obs_tick + 1) % self.SCORE_OBS_SAMPLE
-            observe_scores = self._obs_tick == 0
+            observe_scores = (
+                next(self._obs_counter) % self.SCORE_OBS_SAMPLE == 0)
         totals: dict[str, float] = dict.fromkeys(keys, 0.0)
         raw_scores: dict[str, dict[str, float]] = {}
         for ws, sname, score_hist in self._scorer_meta:

@@ -1,0 +1,140 @@
+"""Copy-on-write pool snapshots: the scheduling-time view of the endpoint
+pool.
+
+Scheduling used to read the LIVE ``Endpoint`` objects the data-layer
+collectors mutate in place — safe only because every reader and writer
+shared the gateway's event loop. Moving scheduling cycles off-loop
+(router/schedpool.py) breaks that invariant two ways:
+
+- a scrape landing mid-cycle could hand one scorer pre-scrape queue depth
+  and the next scorer post-scrape KV usage (torn pool view);
+- data producers write per-request attributes (prefix match info, in-flight
+  load) onto the SHARED endpoint attribute map, so two concurrently
+  scheduled requests would clobber each other's producer outputs.
+
+``PoolSnapshot`` fixes both: an immutable, epoch-versioned copy of
+(metadata, metrics, attributes) per endpoint, published copy-on-write by
+the Datastore — endpoint add/delete/resync and scrape landings mark it
+dirty; the next ``Datastore.snapshot()`` call rebuilds it once and every
+caller until the next dirty event shares the same epoch (so a co-dispatched
+flow-control batch schedules against ONE scrape-state view). ``view()``
+hands each request its own ``SnapshotEndpoint`` list: shared immutable
+metadata, the snapshot's point-in-time metrics, and a per-request overlay
+attribute map (producer writes land in the overlay; reads fall through to
+the snapshot base with the same clone-on-read contract as ``AttributeMap``).
+
+P/D-Serve (arXiv:2408.08147) and RTP-LLM (arXiv:2605.29639) isolate
+routing-decision state from the streaming data plane the same way; see
+docs/performance.md §Concurrency model.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Iterable
+
+from .framework.datalayer import Endpoint, EndpointMetadata, Metrics
+
+
+def _copy_dict(d: dict) -> dict:
+    """Copy a dict that a worker thread may be mutating concurrently (the
+    offloaded scrape extractors write endpoint attributes off-loop).
+    ``dict(d)`` of a plain dict is a single C-level copy under the GIL —
+    atomic w.r.t. concurrent inserts, never a torn read."""
+    return dict(d)
+
+
+def _copy_metrics(m: Metrics) -> Metrics:
+    """Point-in-time metrics copy. Field reads are GIL-atomic; the two
+    model dicts are copied with the concurrent-mutation retry. Much cheaper
+    than ``Metrics.clone()`` (deepcopy) — the snapshot rebuilds on every
+    scrape landing under load."""
+    return dataclasses.replace(
+        m,
+        active_models=_copy_dict(m.active_models),
+        waiting_models=_copy_dict(m.waiting_models))
+
+
+class OverlayAttributes:
+    """Per-request attribute view over a shared snapshot base: writes go to
+    the request-private overlay, reads check the overlay then fall through
+    to the base. Clone-on-read matches ``AttributeMap`` (values exposing
+    ``.clone()`` are cloned; plain values are treated as immutable)."""
+
+    __slots__ = ("_base", "_data")
+
+    _MISS = object()
+
+    def __init__(self, base: dict[str, Any]):
+        self._base = base
+        self._data: dict[str, Any] = {}
+
+    def put(self, key: str, value: Any) -> None:
+        self._data[key] = value
+
+    def get(self, key: str, default: Any = None) -> Any:
+        # Two-step with a sentinel: scorers read attributes per endpoint
+        # per cycle, and after producers run the overlay hit is the common
+        # case — don't pay the base lookup for it.
+        v = self._data.get(key, self._MISS)
+        if v is self._MISS:
+            v = self._base.get(key, self._MISS)
+            if v is self._MISS:
+                return default
+        if hasattr(v, "clone"):
+            return v.clone()
+        return v
+
+    def keys(self) -> Iterable[str]:
+        return {**self._base, **self._data}.keys()
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._data or key in self._base
+
+
+class SnapshotEndpoint:
+    """Scorer-visible endpoint view carved from a PoolSnapshot: shared
+    immutable metadata, the snapshot's metrics copy, a per-request overlay
+    attribute map. Duck-compatible with ``framework.datalayer.Endpoint`` —
+    filters/scorers/pickers, the director's prepare step, and the gateway's
+    proxy leg all read only ``metadata`` / ``metrics`` / ``attributes``."""
+
+    __slots__ = ("metadata", "metrics", "attributes", "snapshot_epoch")
+
+    def __init__(self, metadata: EndpointMetadata, metrics: Metrics,
+                 attrs_base: dict[str, Any], epoch: int):
+        self.metadata = metadata
+        self.metrics = metrics
+        self.attributes = OverlayAttributes(attrs_base)
+        self.snapshot_epoch = epoch
+
+    def __repr__(self) -> str:
+        return (f"SnapshotEndpoint({self.metadata.address_port}, "
+                f"epoch={self.snapshot_epoch})")
+
+
+class PoolSnapshot:
+    """One epoch of the pool: immutable after construction. ``view()``
+    builds fresh per-request SnapshotEndpoints (cheap: three slot stores
+    per endpoint) so concurrent cycles never share a mutable object."""
+
+    __slots__ = ("epoch", "built_at", "_entries")
+
+    def __init__(self, epoch: int, endpoints: Iterable[Endpoint]):
+        self.epoch = epoch
+        self.built_at = time.monotonic()
+        # (metadata ref, metrics copy, attributes base copy) per endpoint.
+        self._entries: list[tuple[EndpointMetadata, Metrics, dict]] = [
+            (ep.metadata, _copy_metrics(ep.metrics),
+             _copy_dict(ep.attributes._data))
+            for ep in endpoints]
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def view(self) -> list[SnapshotEndpoint]:
+        """A fresh scheduling view: one overlay endpoint per pool member."""
+        epoch = self.epoch
+        return [SnapshotEndpoint(meta, metrics, attrs, epoch)
+                for meta, metrics, attrs in self._entries]

@@ -40,6 +40,11 @@ REQUIRED_FAMILIES = {
     ("router_picker_win_margin", "router"),
     ("router_retries", "router"),
     ("router_endpoint_circuit_breaker_state", "router"),
+    # Concurrent scheduling engine (ISSUE 5): offload queueing, batched
+    # dispatch, and the loop-lag heartbeat the offload exists to shrink.
+    ("router_sched_offload_queue_seconds", "router"),
+    ("router_sched_batch_size", "router"),
+    ("router_loop_lag_seconds", "router"),
 }
 
 

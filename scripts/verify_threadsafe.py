@@ -1,0 +1,93 @@
+"""Thread-safety declaration lint for scheduling plugins.
+
+The scheduler pool (router/schedpool.py) runs whole ``Scheduler.schedule``
+cycles on worker threads when ``scheduling.workers > 0`` — that is the
+filter/scorer/picker chains PLUS the profile handler's
+pick_profiles/process_results and any PD/encode decider they consult.
+Safety there is enforced, not assumed: a plugin must DECLARE
+``THREAD_SAFE`` (``True`` after audit, ``False`` to be trampolined back
+onto the event loop). A plugin that declares nothing is trampolined too —
+correct but silently serialized onto the loop, which defeats the offload —
+so this lint fails when any registered in-tree off-loop-capable type lacks
+the declaration, exactly like scripts/verify_decisions.py fails on
+recorder bypasses.
+
+Run via ``make verify-threadsafe``; tests/test_schedpool.py hooks it into
+the pytest run so CI catches undeclared plugins statically.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def check() -> list[str]:
+    import llm_d_inference_scheduler_tpu.router.plugins  # noqa: F401
+    import llm_d_inference_scheduler_tpu.router.plugins.saturation  # noqa: F401
+    import llm_d_inference_scheduler_tpu.router.requestcontrol.producers  # noqa: F401
+    from llm_d_inference_scheduler_tpu.router.config.loader import Handle
+    from llm_d_inference_scheduler_tpu.router.datalayer.datastore import Datastore
+    from llm_d_inference_scheduler_tpu.router.framework.plugin import (
+        global_registry,
+    )
+
+    handle = Handle(datastore=Datastore())
+    errors: list[str] = []
+    checked = 0
+    seen_classes: set[type] = set()
+    for type_name in global_registry.known_types():
+        try:
+            obj = global_registry.instantiate(type_name, type_name, {}, handle)
+        except Exception as e:
+            errors.append(f"plugin type {type_name!r} failed to instantiate "
+                          f"with empty parameters: {e}")
+            continue
+        cls = type(obj)
+        if cls in seen_classes:  # aliases collapse onto one class
+            continue
+        seen_classes.add(cls)
+        # Profile handlers (pick_profiles/process_results) and PD/encode
+        # deciders (disaggregate) run INSIDE Scheduler.schedule, so they go
+        # off-loop exactly like filter/scorer/picker chains and need the
+        # same audit. Producers / parsers / pre-request-only plugins never
+        # run off-loop.
+        role = ("filter" if hasattr(obj, "filter") else
+                "scorer" if hasattr(obj, "score") else
+                "picker" if hasattr(obj, "pick") else
+                "profile-handler" if hasattr(obj, "pick_profiles") else
+                "decider" if hasattr(obj, "disaggregate") else None)
+        if role is None:
+            continue  # producer / parser / pre-request-only — stays on-loop
+        checked += 1
+        declared = getattr(cls, "THREAD_SAFE", None)
+        if declared is None:
+            errors.append(
+                f"{role} {cls.TYPE!r} ({cls.__name__}) declares no "
+                f"THREAD_SAFE attribute — audit it and declare True, or "
+                f"declare False to be trampolined onto the event loop")
+        elif not isinstance(declared, bool):
+            errors.append(
+                f"{role} {cls.TYPE!r} declares THREAD_SAFE={declared!r} — "
+                f"must be the literal True or False")
+    if checked == 0:
+        errors.append("no off-loop-capable plugin types registered — "
+                      "registry import broken?")
+    return errors
+
+
+def main() -> int:
+    errors = check()
+    for e in errors:
+        print(f"verify-threadsafe: {e}", file=sys.stderr)
+    if errors:
+        return 1
+    print("verify-threadsafe: every registered filter/scorer/picker/"
+          "profile-handler/decider declares its THREAD_SAFE audit result")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -115,6 +115,43 @@ def test_record_render_and_summary():
     assert list(scores["scores"]) == ["b:1"] and scores["candidates"] == 2
 
 
+def test_render_snapshots_live_dicts():
+    """Off-loop scheduling (scheduler-pool workers) can mutate a record
+    while GET /debug/decisions renders it on the event loop — the render
+    side must snapshot live dicts via an atomic ``dict()`` copy before
+    iterating (a retry loop would livelock against a busy writer; see
+    ``DecisionRecord._live_items``), never iterate them raw."""
+
+    assert DecisionRecord._live_items({"k": 1}) == [("k", 1)]
+
+    # End-to-end: a worker thread hammers round/profile/scorer inserts
+    # while the loop side renders — no RuntimeError, every render a
+    # consistent point-in-time document.
+    import threading
+
+    rec = DecisionRecord("req-race", "tiny")
+    rec.begin_round("schedule", 2)
+
+    def writer():
+        # Bounded: renders walk every round, so an unbounded writer makes
+        # each render slower than the last and the test quadratic.
+        for i in range(2000):
+            sec = rec.begin_profile(f"p{i}", 2)
+            rec.profile_scorer(sec, f"s{i}", 1.0, {"a:1": 0.5})
+            rec.profile_picker(sec, "picker", ["a:1"], {"a:1": 0.5})
+
+    t = threading.Thread(target=writer)
+    t.start()
+    try:
+        while t.is_alive():
+            doc = rec.to_dict()
+            assert doc["request_id"] == "req-race"
+            rec.summary_line()
+    finally:
+        t.join()
+    assert len(rec.to_dict()["rounds"][0]["profiles"]) == 2000
+
+
 def test_scheduler_records_rounds_and_kill_switch_skips():
     from llm_d_inference_scheduler_tpu.router.plugins.filters import DecodeFilter
     from llm_d_inference_scheduler_tpu.router.plugins.pickers import MaxScorePicker

@@ -1,0 +1,489 @@
+"""Concurrent scheduling engine (ISSUE 5): copy-on-write pool snapshots,
+off-loop scheduler workers, loop trampolines for undeclared plugins,
+batched flow-control dispatch, scrape-parse offload, and the
+verify-threadsafe lint hook."""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import pathlib
+import sys
+import threading
+import time
+
+import pytest
+
+from llm_d_inference_scheduler_tpu.router.datalayer.datastore import Datastore
+from llm_d_inference_scheduler_tpu.router.datalayer.runtime import (
+    DataLayerRuntime,
+    _Collector,
+)
+from llm_d_inference_scheduler_tpu.router.flowcontrol import (
+    FlowControlConfig,
+    FlowController,
+)
+from llm_d_inference_scheduler_tpu.router.flowcontrol.types import (
+    FlowControlRequest,
+    FlowKey,
+    QueueOutcome,
+)
+from llm_d_inference_scheduler_tpu.router.framework.datalayer import (
+    Endpoint,
+    EndpointMetadata,
+)
+from llm_d_inference_scheduler_tpu.router.framework.plugin import TypedName
+from llm_d_inference_scheduler_tpu.router.framework.scheduling import (
+    InferenceRequest,
+    InferenceRequestBody,
+)
+from llm_d_inference_scheduler_tpu.router.plugins.pickers import MaxScorePicker
+from llm_d_inference_scheduler_tpu.router.plugins.profile_handlers import (
+    SingleProfileHandler,
+)
+from llm_d_inference_scheduler_tpu.router.plugins.scorers import (
+    KvCacheUtilizationScorer,
+    QueueScorer,
+)
+from llm_d_inference_scheduler_tpu.router.schedpool import (
+    SchedulerPool,
+    SchedulingConfig,
+    trampoline_scheduler,
+)
+from llm_d_inference_scheduler_tpu.router.scheduling.scheduler import (
+    Scheduler,
+    SchedulerProfile,
+    WeightedScorer,
+)
+
+
+def _datastore(n: int = 8) -> Datastore:
+    ds = Datastore()
+    for i in range(n):
+        ep = ds.endpoint_add_or_update(EndpointMetadata(
+            name=f"ep{i}", address=f"10.0.0.{i}", port=8000))
+        # Distinct queue depths -> distinct scores -> deterministic picks.
+        ep.metrics.waiting_queue_size = i
+        ep.metrics.kv_cache_usage_percent = 0.05 * i
+        ep.metrics.update_time = time.monotonic()
+    return ds
+
+
+def _scheduler() -> Scheduler:
+    profile = SchedulerProfile(
+        "default", [],
+        [WeightedScorer(QueueScorer("queue-scorer"), 2.0),
+         WeightedScorer(KvCacheUtilizationScorer("kv-scorer"), 2.0)],
+        MaxScorePicker("max-score-picker"))
+    return Scheduler({"default": profile}, SingleProfileHandler())
+
+
+def _request(i: int) -> InferenceRequest:
+    return InferenceRequest(
+        request_id=f"sp-{i}", target_model="tiny",
+        body=InferenceRequestBody(completions={"prompt": f"p{i}"}))
+
+
+# ---- snapshot semantics --------------------------------------------------
+
+
+def test_snapshot_is_cached_until_dirty():
+    ds = _datastore()
+    s1 = ds.snapshot()
+    assert ds.snapshot() is s1  # copy-on-write: same epoch until dirty
+    # Scrape landings are SOFT dirty: within the refresh floor the epoch is
+    # intentionally reused (bounds rebuild CPU under steady scraping and
+    # keeps one epoch per co-dispatched batch).
+    ds.mark_snapshot_dirty()
+    assert ds.snapshot() is s1
+    # Once the floor passes, the next snapshot() publishes a fresh epoch.
+    ds.SNAPSHOT_MIN_REFRESH_S = 0.0
+    s2 = ds.snapshot()
+    assert s2 is not s1 and s2.epoch == s1.epoch + 1
+
+
+def test_snapshot_isolates_metrics_and_attributes():
+    ds = _datastore()
+    ds.SNAPSHOT_MIN_REFRESH_S = 0.0  # scrape-dirty rebuilds immediately
+    snap = ds.snapshot()
+    views = snap.view()
+    # Live scrape write after the snapshot: the view keeps the old value.
+    ds.endpoint_get("10.0.0.3:8000").metrics.waiting_queue_size = 999
+    assert views[3].metrics.waiting_queue_size == 3
+    # Per-request attribute overlays are private to each view() call.
+    views[0].attributes.put("attr", {"x": 1})
+    assert snap.view()[0].attributes.get("attr") is None
+    # Base attributes captured at build fall through to overlay readers.
+    ds.mark_snapshot_dirty()
+    ds.endpoint_get("10.0.0.0:8000").attributes.put("base-key", "v")
+    assert ds.snapshot().view()[0].attributes.get("base-key") == "v"
+
+
+def test_endpoint_churn_bumps_epoch():
+    ds = _datastore(3)
+    e1 = ds.snapshot().epoch
+    ds.endpoint_delete("10.0.0.2:8000")
+    assert ds.snapshot().epoch == e1 + 1
+    assert len(ds.snapshot()) == 2
+    ds.resync([EndpointMetadata(name="n", address="10.1.0.1", port=9000)])
+    snap = ds.snapshot()
+    assert snap.epoch > e1 + 1
+    assert [v.metadata.address_port for v in snap.view()] == ["10.1.0.1:9000"]
+
+
+def test_delete_mid_cycle_schedules_old_epoch_next_batch_sees_new():
+    """An endpoint deleted while an off-loop cycle is in flight: the cycle
+    finishes against its (old-epoch) views without KeyError; the next
+    dispatch batch observes the new epoch without the endpoint."""
+    ds = _datastore(4)
+
+    class SlowScorer:
+        THREAD_SAFE = True
+
+        def typed_name(self):
+            return TypedName("slow-scorer", "slow")
+
+        def score(self, ctx, state, request, endpoints):
+            time.sleep(0.05)  # hold the cycle open across the deletion
+            return {ep.metadata.address_port: 0.0 for ep in endpoints}
+
+    profile = SchedulerProfile(
+        "default", [],
+        [WeightedScorer(SlowScorer(), 1.0),
+         WeightedScorer(QueueScorer("queue-scorer"), 2.0)],
+        MaxScorePicker("max-score-picker"))
+    sched = Scheduler({"default": profile}, SingleProfileHandler())
+    pool = SchedulerPool(sched, SchedulingConfig(workers=2))
+
+    async def run():
+        old_epoch = ds.snapshot().epoch
+        views = ds.snapshot().view()
+        task = asyncio.ensure_future(pool.schedule(None, _request(0), views))
+        await asyncio.sleep(0.01)      # cycle is now inside the slow scorer
+        ds.endpoint_delete("10.0.0.0:8000")  # the would-be winner
+        result = await task            # finishes against the old epoch
+        picked = result.primary().target_endpoints[0]
+        assert picked.metadata.address_port == "10.0.0.0:8000"
+        assert picked.snapshot_epoch == old_epoch
+        # The next batch resolves a fresh epoch without the dead endpoint.
+        fresh = ds.snapshot()
+        assert fresh.epoch > old_epoch
+        assert "10.0.0.0:8000" not in [
+            v.metadata.address_port for v in fresh.view()]
+        return True
+
+    try:
+        assert asyncio.run(run())
+    finally:
+        pool.shutdown()
+
+
+# ---- kill-switch parity and trampolines ---------------------------------
+
+
+def test_workers0_and_workersN_produce_identical_picks():
+    """`scheduling: {workers: 0}` (inline kill-switch) and workers: N must
+    pick identically for a fixed scrape state."""
+    ds = _datastore(8)
+
+    def picks(workers: int) -> list[str]:
+        pool = SchedulerPool(_scheduler(), SchedulingConfig(workers=workers))
+
+        async def run():
+            out = []
+            for i in range(16):
+                cands = (ds.snapshot().view() if pool.offloaded
+                         else ds.endpoint_list())
+                res = await pool.schedule(None, _request(i), cands)
+                out.append(res.primary().target_endpoints[0]
+                           .metadata.address_port)
+            return out
+
+        try:
+            return asyncio.run(run())
+        finally:
+            pool.shutdown()
+
+    inline, offloaded = picks(0), picks(4)
+    assert inline == offloaded
+    assert inline[0] == "10.0.0.0:8000"  # lowest queue + kv wins
+
+
+def test_threadsafe_plugin_runs_on_worker_undeclared_on_loop():
+    threads: dict[str, int] = {}
+
+    class SafeScorer:
+        THREAD_SAFE = True
+
+        def typed_name(self):
+            return TypedName("safe-scorer", "safe")
+
+        def score(self, ctx, state, request, endpoints):
+            threads["safe"] = threading.get_ident()
+            return {ep.metadata.address_port: 0.1 for ep in endpoints}
+
+    class UndeclaredScorer:
+        def typed_name(self):
+            return TypedName("undeclared-scorer", "undeclared")
+
+        def score(self, ctx, state, request, endpoints):
+            threads["undeclared"] = threading.get_ident()
+            return {ep.metadata.address_port: 0.2 for ep in endpoints}
+
+    ds = _datastore(3)
+    profile = SchedulerProfile(
+        "default", [],
+        [WeightedScorer(SafeScorer(), 1.0),
+         WeightedScorer(UndeclaredScorer(), 1.0)],
+        MaxScorePicker("max-score-picker"))
+    sched = Scheduler({"default": profile}, SingleProfileHandler())
+    pool = SchedulerPool(sched, SchedulingConfig(workers=1))
+
+    async def run():
+        await pool.schedule(None, _request(0), ds.snapshot().view())
+        return threading.get_ident()
+
+    try:
+        loop_thread = asyncio.run(run())
+    finally:
+        pool.shutdown()
+    # The undeclared scorer was trampolined back onto the loop thread; the
+    # audited one ran off-loop on a worker.
+    assert threads["undeclared"] == loop_thread
+    assert threads["safe"] != loop_thread
+
+
+def test_trampoline_scheduler_noop_when_all_safe():
+    sched = _scheduler()
+    loop = asyncio.new_event_loop()
+    try:
+        assert trampoline_scheduler(sched, loop) is sched
+    finally:
+        loop.close()
+
+
+def test_unsafe_decider_trampolines_whole_handler():
+    """Deciders run INSIDE the handler's pick_profiles, so a decider that
+    declares THREAD_SAFE = False must drag the whole handler back onto the
+    loop — the handler's own True declaration is not enough."""
+    from llm_d_inference_scheduler_tpu.router.plugins.disagg import (
+        DisaggProfileHandler,
+    )
+
+    class UnsafeDecider:
+        THREAD_SAFE = False
+
+        def typed_name(self):
+            return TypedName("unsafe-decider", "unsafe")
+
+        def disaggregate(self, ctx, request, decode_endpoint):
+            return True
+
+    handler = DisaggProfileHandler()
+    handler.pd_decider = UnsafeDecider()
+    profile = SchedulerProfile(
+        "decode", [],
+        [WeightedScorer(QueueScorer("queue-scorer"), 1.0)],
+        MaxScorePicker("max-score-picker"))
+    sched = Scheduler({"decode": profile}, handler)
+    loop = asyncio.new_event_loop()
+    try:
+        wrapped = trampoline_scheduler(sched, loop)
+        assert wrapped is not sched
+        assert wrapped.profile_handler.wrapped is handler
+
+        # Swap in a safe decider: nothing to wrap, scheduler passes through.
+        handler.pd_decider.THREAD_SAFE = True
+        assert trampoline_scheduler(sched, loop) is sched
+    finally:
+        loop.close()
+
+
+def test_switch_interval_refcounted_across_pools():
+    """The GIL switch interval is process-global: the first offloaded pool
+    to shut down must not revert it while a second pool still runs."""
+    prev = sys.getswitchinterval()
+    assert prev > 0.001  # interpreter default (5 ms) — nothing else holds it
+    a = SchedulerPool(_scheduler(), SchedulingConfig(workers=1))
+    b = SchedulerPool(_scheduler(), SchedulingConfig(workers=1))
+    try:
+        assert sys.getswitchinterval() == pytest.approx(0.001)
+        a.shutdown()
+        assert sys.getswitchinterval() == pytest.approx(0.001)
+    finally:
+        a.shutdown()
+        b.shutdown()
+    assert sys.getswitchinterval() == pytest.approx(prev)
+
+
+# ---- batched flow-control dispatch --------------------------------------
+
+
+def test_batched_dispatch_preserves_fairness_and_batches():
+    """dispatch_batch=4: one wake drains multiple flows in fairness order,
+    and everything queued is dispatched."""
+    cfg = FlowControlConfig(shards=1, dispatch_batch=4)
+    order: list[str] = []
+
+    async def run():
+        fc = FlowController(cfg, saturation_fn=lambda: 0.0)
+        await fc.start()
+        try:
+            async def submit(i, flow):
+                item = FlowControlRequest(
+                    request_id=f"b{i}", flow_key=FlowKey(flow, 0),
+                    size_bytes=1)
+                out = await fc.enqueue_and_wait(item)
+                order.append(item.request_id)
+                return out
+            outs = await asyncio.gather(*[
+                submit(i, f"flow-{i % 2}") for i in range(8)])
+            assert all(o == QueueOutcome.DISPATCHED for o in outs)
+            assert len(order) == 8
+        finally:
+            await fc.stop()
+
+    asyncio.run(run())
+
+
+def test_dispatch_batch_default_is_one():
+    assert FlowControlConfig.from_spec({}).dispatch_batch == 1
+    assert FlowControlConfig.from_spec({"dispatchBatch": 6}).dispatch_batch == 6
+
+
+# ---- scrape-parse offload + collector jitter -----------------------------
+
+
+class _FakeSource:
+    def __init__(self):
+        self.extracted_on: list[int] = []
+        outer = self
+
+        class _Ex:
+            def typed_name(self):
+                return TypedName("fake-extractor", "fake")
+
+            def extract(self, raw, endpoint):
+                outer.extracted_on.append(threading.get_ident())
+                endpoint.metrics.waiting_queue_size = int(raw)
+
+        self._ex = _Ex()
+
+    def typed_name(self):
+        return TypedName("fake-source", "fake")
+
+    async def collect(self, endpoint):
+        return "7"
+
+    def extractors(self):
+        return [self._ex]
+
+    def add_extractor(self, ex):
+        pass
+
+
+def test_collector_extracts_on_offload_executor_and_marks_snapshot():
+    ds = Datastore()
+    ds.SNAPSHOT_MIN_REFRESH_S = 0.0  # scrape-dirty rebuilds immediately
+    rt = DataLayerRuntime(ds, poll_interval=0.01)
+    src = _FakeSource()
+    rt.register_source(src)
+    pool = concurrent.futures.ThreadPoolExecutor(1)
+    rt.offload = pool
+
+    async def run():
+        ep = ds.endpoint_add_or_update(EndpointMetadata(
+            name="e", address="10.2.0.1", port=8000))
+        before = ds.snapshot().epoch
+        await rt.start()
+        for _ in range(100):
+            if src.extracted_on:
+                break
+            await asyncio.sleep(0.01)
+        await rt.stop()
+        assert src.extracted_on, "extractor never ran"
+        # Parse CPU left the loop...
+        assert src.extracted_on[0] != threading.get_ident()
+        # ...the metrics landed...
+        assert ep.metrics.waiting_queue_size == 7
+        # ...and the scrape published a fresh snapshot epoch.
+        assert ds.snapshot().epoch > before
+        assert ds.snapshot().view()[0].metrics.waiting_queue_size == 7
+
+    try:
+        asyncio.run(run())
+    finally:
+        pool.shutdown(wait=False)
+
+
+def test_collector_first_collect_is_immediate_despite_jitter():
+    """Anti-thundering-herd jitter must not delay the FIRST scrape (pool
+    readiness rides on it) — the phase offset applies after it."""
+    ds = Datastore()
+    ep = Endpoint(EndpointMetadata(name="e", address="10.3.0.1", port=8000))
+    src = _FakeSource()
+
+    async def run():
+        c = _Collector(ep, [src], interval=5.0, jitter_s=4.0,
+                       on_scrape=ds.mark_snapshot_dirty)
+        c.start()
+        t0 = time.monotonic()
+        while not src.extracted_on and time.monotonic() - t0 < 1.0:
+            await asyncio.sleep(0.005)
+        c.stop()
+        assert src.extracted_on, "first collect delayed by jitter"
+        assert time.monotonic() - t0 < 1.0
+
+    asyncio.run(run())
+
+
+# ---- gateway / config wiring --------------------------------------------
+
+
+def test_gateway_wires_scheduling_config():
+    from llm_d_inference_scheduler_tpu.router.gateway import build_gateway
+
+    gw = build_gateway("""
+featureGates: {flowControl: true}
+scheduling: {workers: 2, maxBatch: 5}
+pool:
+  endpoints:
+    - {address: 127.0.0.1, port: 19999}
+""")
+    try:
+        assert gw.sched_pool.offloaded
+        assert gw.sched_pool.cfg.workers == 2
+        assert gw.sched_pool.cfg.max_batch == 5
+        assert gw.director.sched_pool is gw.sched_pool
+        # Batched dispatch follows scheduling.maxBatch when offloaded.
+        assert gw.flow_controller.cfg.dispatch_batch == 5
+        # The scrape-parse offload shares the pool's workers.
+        assert gw.dl_runtime.offload is gw.sched_pool.executor
+    finally:
+        gw.sched_pool.shutdown()
+
+
+def test_gateway_default_is_inline_killswitch():
+    from llm_d_inference_scheduler_tpu.router.gateway import build_gateway
+
+    gw = build_gateway("""
+featureGates: {flowControl: true}
+pool:
+  endpoints:
+    - {address: 127.0.0.1, port: 19998}
+""")
+    assert not gw.sched_pool.offloaded
+    assert gw.sched_pool.executor is None
+    assert gw.flow_controller.cfg.dispatch_batch == 1  # one-pop-one-yield
+    assert gw.dl_runtime.offload is None
+
+
+# ---- lint hook -----------------------------------------------------------
+
+
+def test_verify_threadsafe_lint_clean():
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]
+                           / "scripts"))
+    import verify_threadsafe
+
+    assert verify_threadsafe.check() == []
