@@ -5,13 +5,13 @@
 PY ?= python
 
 .PHONY: test test-fast test-unit test-dist test-chaos bench bench-flowcontrol \
-	bench-router-sse bench-decisions bench-sched bench-sched-offload dryrun \
-	render-chart compile-check verify-metrics verify-decisions \
-	verify-hotpath verify-threadsafe
+	bench-router-sse bench-decisions bench-sched bench-sched-offload \
+	bench-slo dryrun render-chart compile-check verify-metrics \
+	verify-decisions verify-hotpath verify-threadsafe verify-slo
 
 # Full hermetic suite (virtual 8-device CPU mesh; no TPU or cluster needed —
 # the reference needs envtest + kind for the equivalent coverage).
-test: verify-metrics verify-decisions verify-hotpath verify-threadsafe
+test: verify-metrics verify-decisions verify-hotpath verify-threadsafe verify-slo
 	$(PY) -m pytest tests/ -q
 
 # Everything except the spawned-process distributed tests (the slow tail).
@@ -44,6 +44,13 @@ verify-hotpath:
 verify-threadsafe:
 	$(PY) scripts/verify_threadsafe.py
 
+# SLO-ledger terminal-path check: success, shed, retry-exhausted, deadline,
+# and mid-stream abort must ALL stamp an slo_met outcome on the decision
+# record — absent rows overcount attainment (also hooked into pytest via
+# tests/test_slo.py).
+verify-slo:
+	$(PY) scripts/verify_slo.py
+
 # Recorder-overhead microbench on the flow-control dispatch path (CPU-only;
 # writes benchmarks/DECISIONS_MICRO.json — target <3%, kill-switch ~0%).
 bench-decisions:
@@ -62,6 +69,14 @@ bench-sched:
 # target ≥5x lower p99 loop stall with offload on.
 bench-sched-offload:
 	$(PY) bench.py --sched-offload
+
+# SLO observability bench (CPU-only): per-chunk ledger-hook cost vs the 5ms
+# token cadence (kill-switch ~0%) plus a rate ramp past saturation showing
+# goodput vs raw throughput divergence and predictor MAE by load band.
+# Writes benchmarks/SLO_OBS.json — the baseline ROADMAP item 5 (goodput-max
+# admission) will be judged against.
+bench-slo:
+	$(PY) bench.py --slo-ramp
 
 test-unit: test-fast
 

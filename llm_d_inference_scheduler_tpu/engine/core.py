@@ -21,6 +21,7 @@ Architecture (TPU-first, JetStream-style):
 from __future__ import annotations
 
 import asyncio
+import collections
 import dataclasses
 import json
 import logging
@@ -47,6 +48,23 @@ from .tokenizer import get_tokenizer
 log = logging.getLogger("engine.core")
 
 KV_EXPORT_TTL_S = 60.0
+
+# Device-pull byte accounting: kv_shape is the staged K array's shape, K and
+# V move together, and kv_dtype names the element type.
+_KV_DTYPE_BYTES = {"float32": 4, "float16": 2, "bfloat16": 2, "int8": 1,
+                   "float8_e4m3fn": 1, "float8_e5m2": 1}
+
+
+def _kv_param_bytes(ktp: dict[str, Any]) -> int | None:
+    """Bytes a device-wire pull moves, derived from the exporter's staged
+    geometry (the host path counts the payload directly)."""
+    shape = ktp.get("kv_shape")
+    if not shape:
+        return None
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return 2 * n * _KV_DTYPE_BYTES.get(str(ktp.get("kv_dtype", "")), 2)
 
 
 def _tcp_preflight(address: str, timeout: float = 2.0) -> None:
@@ -191,6 +209,13 @@ class TpuEngine:
         self._transfer_lock = threading.Lock()
         self.kv_import_device_count = 0  # diagnostics: pulls over ICI/DCN
         self.kv_import_host_count = 0    # diagnostics: host-staged HTTP fetches
+        # Per-request KV pull stats (request_id -> {ms, bytes, route}):
+        # written by the fetch thread, read (popped) by the server when it
+        # stamps x-kv-pull-ms/-bytes on the decode response — the measured
+        # per-pair transfer cost the router's /debug/transfers table
+        # aggregates. Bounded ring; individually GIL-atomic dict/deque ops.
+        self.kv_import_stats: dict[str, dict[str, Any]] = {}
+        self._kv_import_order: collections.deque[str] = collections.deque()
         if cfg.kv_transfer in ("auto", "device"):
             try:
                 self.kv_transfer_server = _get_transfer_server()
@@ -1555,10 +1580,31 @@ class TpuEngine:
 
         threading.Thread(target=fetch, name="kv-fetch", daemon=True).start()
 
+    KV_IMPORT_STATS_CAP = 512
+
+    def _note_kv_import(self, request_id: str, t0: float,
+                        nbytes: int | None, route: str) -> None:
+        """Record one completed pull's duration/bytes for the server to
+        stamp on the decode response (x-kv-pull-ms/-bytes → the router's
+        per-pair /debug/transfers table)."""
+        # A re-dispatched request id overwrites its dict entry; appending a
+        # duplicate ring slot too would make a later eviction pop the LIVE
+        # entry when the stale first occurrence reaches the front.
+        if request_id not in self.kv_import_stats:
+            self._kv_import_order.append(request_id)
+        self.kv_import_stats[request_id] = {
+            "ms": (time.monotonic() - t0) * 1e3,
+            "bytes": int(nbytes or 0),
+            "route": route,
+        }
+        while len(self._kv_import_order) > self.KV_IMPORT_STATS_CAP:
+            self.kv_import_stats.pop(self._kv_import_order.popleft(), None)
+
     def _fetch_inner(self, pi, ktp):
         """The fetch-thread body: resolve a transfer route, move the bytes
         (or record the error), and hand the pending import to the engine
         thread via _import_ready."""
+        t0 = time.monotonic()
         if (ktp.get("transfer_shards") and ktp.get("kv_mesh")
                 and (self.kv_transfer_server is not None
                      or self.kv_shard_wire is not None)):
@@ -1583,6 +1629,8 @@ class TpuEngine:
                     return
                 self._pull_device_kv_sharded(pi, ktp)
                 self.kv_import_device_count += 1
+                self._note_kv_import(pi.req.request_id, t0,
+                                     _kv_param_bytes(ktp), "device")
                 with self._cond:
                     self._import_ready.append(pi)
                     self._cond.notify()
@@ -1597,6 +1645,8 @@ class TpuEngine:
             try:
                 self._pull_device_kv(pi, ktp)
                 self.kv_import_device_count += 1
+                self._note_kv_import(pi.req.request_id, t0,
+                                     _kv_param_bytes(ktp), "device")
                 with self._cond:
                     self._import_ready.append(pi)
                     self._cond.notify()
@@ -1625,6 +1675,8 @@ class TpuEngine:
             pi.payload = r.content
             pi.headers = dict(r.headers)
             self.kv_import_host_count += 1
+            self._note_kv_import(pi.req.request_id, t0,
+                                 len(r.content), "host")
             try:
                 httpx.delete(url, timeout=5.0, verify=verify)
             except Exception:

@@ -589,6 +589,24 @@ class EngineServer:
         if done > first:
             tracer.record("engine.decode", first, done)
 
+    def _kv_pull_headers(self, req: EngineRequest) -> dict[str, str]:
+        """Measured KV pull cost for P/D decode requests, stamped on the
+        non-streaming response (the engine's fetch thread recorded it —
+        engine/core.py ``_note_kv_import``). The sidecar relays these as
+        ``x-kv-transfer-*`` so the router's per-(prefill, decode)-pair
+        /debug/transfers table sees real wire measurements, not proxies.
+        Streaming responses send headers before the pull resolves, so they
+        carry nothing."""
+        if (req.kv_transfer_params or {}).get("remote_host") is None:
+            return {}
+        stats = getattr(self.engine, "kv_import_stats", {}).pop(
+            req.request_id, None)
+        if not stats:
+            return {}
+        return {"x-kv-pull-ms": f"{stats['ms']:.2f}",
+                "x-kv-pull-bytes": str(stats["bytes"]),
+                "x-kv-pull-route": stats["route"]}
+
     async def completions(self, request: web.Request) -> web.StreamResponse:
         body = await _json_body(request)
         with self._request_span(request) as span:
@@ -608,7 +626,8 @@ class EngineServer:
                         timing=timing)
                 else:
                     resp = web.json_response(
-                        await self._collect(req, out, stops, timing))
+                        await self._collect(req, out, stops, timing),
+                        headers=self._kv_pull_headers(req))
             except (asyncio.CancelledError, ConnectionResetError):
                 self.engine.abort(req.request_id)  # client went away: stop decoding
                 raise
@@ -643,7 +662,7 @@ class EngineServer:
         resp["object"] = "chat.completion"
         text = resp["choices"][0].pop("text")
         resp["choices"][0]["message"] = {"role": "assistant", "content": text}
-        return web.json_response(resp)
+        return web.json_response(resp, headers=self._kv_pull_headers(req))
 
     async def embeddings(self, request: web.Request) -> web.Response:
         """OpenAI /v1/embeddings: mean-pooled final-hidden-state vectors

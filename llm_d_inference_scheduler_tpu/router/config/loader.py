@@ -45,6 +45,7 @@ class RawConfig:
     saturation_detector: dict[str, Any] | None
     resilience: dict[str, Any]
     decisions: dict[str, Any]
+    slo: dict[str, Any]
     tls_client: dict[str, Any]
     pool: dict[str, Any]
     objectives: list[dict[str, Any]]
@@ -79,6 +80,10 @@ class RouterConfig:
     # parameters instead (they are plugins, configured where they are
     # declared).
     decisions: dict[str, Any]
+    # slo: the SLO & goodput ledger knobs (router/slo.py SloConfig —
+    # {enabled, defaultTtftMs, defaultTpotMs, perModel}; enabled: false is
+    # the kill-switch that removes the per-chunk ledger hook entirely).
+    slo: dict[str, Any]
     tls_client: dict[str, Any]
     static_endpoints: list[EndpointMetadata]
     pool: EndpointPool
@@ -108,6 +113,7 @@ def load_raw_config(text: str | None) -> RawConfig:
         saturation_detector=doc.get("saturationDetector"),
         resilience=doc.get("resilience") or {},
         decisions=doc.get("decisions") or {},
+        slo=doc.get("slo") or {},
         tls_client=doc.get("tlsClient") or {},
         pool=doc.get("pool") or {},
         objectives=doc.get("objectives") or [],
@@ -271,6 +277,7 @@ def instantiate(raw: RawConfig, handle: Handle,
         saturation_detector_spec=raw.saturation_detector,
         resilience=raw.resilience,
         decisions=raw.decisions,
+        slo=raw.slo,
         tls_client=raw.tls_client,
         static_endpoints=static_endpoints,
         pool=pool,

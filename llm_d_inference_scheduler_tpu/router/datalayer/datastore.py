@@ -16,6 +16,7 @@ from typing import Callable, Iterable
 from ..framework.datalayer import Endpoint, EndpointMetadata
 from ..resilience import BreakerRegistry
 from ..snapshot import PoolSnapshot
+from .transfers import TransferTable
 
 
 @dataclasses.dataclass
@@ -70,6 +71,11 @@ class Datastore:
         # shared by the gateway's retry path and the circuit-breaker-filter
         # scheduling plugin so ejections apply fleet-wide.
         self.breakers = BreakerRegistry()
+        # Per-(prefill, decode)-pair KV-transfer EWMA table
+        # (datalayer/transfers.py): fed by the gateway from sidecar-relayed
+        # pull stats, served at /debug/transfers, readable by future
+        # transfer-cost scorers (ROADMAP item 3).
+        self.transfers = TransferTable()
         # Copy-on-write scheduling snapshot (router/snapshot.py). Two dirty
         # levels: membership changes (add/delete/resync) force a rebuild on
         # the next snapshot() call — a deleted endpoint must leave the

@@ -1,0 +1,106 @@
+"""Per-(prefill, decode)-pair KV-transfer telemetry: the measured-cost table
+transfer-aware P/D pairing (NetKV, arXiv:2606.03910 — ROADMAP item 3) will
+score against.
+
+The decode engine times its own KV pull (engine/core.py ``_fetch_inner``:
+device wire vs host-staged HTTP, exact bytes moved) and stamps
+``x-kv-pull-ms`` / ``x-kv-pull-bytes`` on its non-streaming response; the
+sidecar relays them — beside its existing ``x-prefill-duration-ms`` — as
+``x-kv-transfer-ms`` / ``x-kv-transfer-bytes`` plus ``x-kv-prefiller`` (the
+prefill candidate that actually served, post-failover). The gateway lands
+each observation here, keyed by the (prefill, decode) endpoint pair, as
+exponentially-weighted moving averages; ``GET /debug/transfers`` serves the
+table. Writers run on the gateway event loop — no locking needed.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from typing import Any
+
+
+class PairTransferStats:
+    """EWMA transfer cost for one (prefill → decode) pair."""
+
+    __slots__ = ("pulls", "ewma_pull_ms", "ewma_bytes", "ewma_prefill_ms",
+                 "bytes_total", "last_unix")
+
+    def __init__(self):
+        self.pulls = 0
+        self.ewma_pull_ms: float | None = None
+        self.ewma_bytes: float | None = None
+        self.ewma_prefill_ms: float | None = None
+        self.bytes_total = 0
+        self.last_unix = 0.0
+
+    def render(self) -> dict[str, Any]:
+        doc: dict[str, Any] = {"pulls": self.pulls,
+                               "bytes_total": self.bytes_total,
+                               "last_unix": self.last_unix}
+        if self.ewma_pull_ms is not None:
+            doc["ewma_pull_ms"] = round(self.ewma_pull_ms, 3)
+        if self.ewma_bytes is not None:
+            doc["ewma_bytes"] = round(self.ewma_bytes, 1)
+            if self.ewma_pull_ms:
+                # MB/s = bytes/ms / 1e3 — the wire-speed signal that
+                # separates same-host from cross-host pairs.
+                doc["ewma_mb_per_s"] = round(
+                    self.ewma_bytes / self.ewma_pull_ms / 1e3, 3)
+        if self.ewma_prefill_ms is not None:
+            doc["ewma_prefill_ms"] = round(self.ewma_prefill_ms, 3)
+        return doc
+
+
+class TransferTable:
+    """Bounded LRU of per-pair EWMA transfer stats (lives on the Datastore,
+    like the breaker registry, so future scheduling plugins can read it)."""
+
+    ALPHA = 0.2        # EWMA weight of the newest observation
+    MAX_PAIRS = 512    # pool_size² bound for pathological pools
+
+    def __init__(self):
+        self._pairs: OrderedDict[tuple[str, str], PairTransferStats] = \
+            OrderedDict()
+
+    def record(self, prefill: str, decode: str, *,
+               pull_ms: float | None = None, nbytes: int | None = None,
+               prefill_ms: float | None = None) -> None:
+        key = (prefill, decode)
+        stats = self._pairs.get(key)
+        if stats is None:
+            if len(self._pairs) >= self.MAX_PAIRS:
+                self._pairs.popitem(last=False)
+            stats = self._pairs[key] = PairTransferStats()
+        else:
+            self._pairs.move_to_end(key)
+        stats.last_unix = time.time()
+        a = self.ALPHA
+        if pull_ms is not None:
+            # `pulls` counts MEASURED pulls only: prefill-only rows (streamed
+            # responses carry no engine pull stats) must not inflate the
+            # sample count a transfer-cost scorer will weigh evidence by.
+            stats.pulls += 1
+            stats.ewma_pull_ms = (pull_ms if stats.ewma_pull_ms is None
+                                  else (1 - a) * stats.ewma_pull_ms
+                                  + a * pull_ms)
+        if nbytes is not None:
+            stats.bytes_total += nbytes
+            stats.ewma_bytes = (float(nbytes) if stats.ewma_bytes is None
+                                else (1 - a) * stats.ewma_bytes + a * nbytes)
+        if prefill_ms is not None:
+            stats.ewma_prefill_ms = (
+                prefill_ms if stats.ewma_prefill_ms is None
+                else (1 - a) * stats.ewma_prefill_ms + a * prefill_ms)
+
+    def pair(self, prefill: str, decode: str) -> PairTransferStats | None:
+        """Lookup for future transfer-cost scorers (no LRU touch: reading a
+        pair's cost must not pin it against eviction)."""
+        return self._pairs.get((prefill, decode))
+
+    def snapshot(self) -> dict[str, Any]:
+        return {"pairs": [{"prefill": p, "decode": d, **stats.render()}
+                          for (p, d), stats in self._pairs.items()]}
+
+    def __len__(self) -> int:
+        return len(self._pairs)

@@ -17,7 +17,10 @@ One ``DecisionRecord`` accumulates as the request crosses the layers:
   weighted scores (top-K, configurable), the picker's choice and win margin
   (scheduling/scheduler.py, carried through the cycle via CycleState);
 - post-schedule: the gateway's retry/failover attempt trail — which ranked
-  candidate each attempt used and why it moved on (gateway.py).
+  candidate each attempt used and why it moved on (gateway.py);
+- post-serve: the SLO ledger's outcome block (router/slo.py) — predicted vs
+  actual TTFT/TPOT vs the request SLO, the slo_met verdict with its miss
+  reason, and the per-pair KV-transfer row on the disagg path.
 
 Storage is a bounded ring (default ~1k records) with an id index, zero-egress
 like the trace buffer: inspect via ``GET /debug/decisions`` /
@@ -50,7 +53,7 @@ class DecisionRecord:
 
     __slots__ = ("request_id", "model", "target_model", "priority",
                  "_start", "_admission", "_producers",
-                 "_rounds", "_attempts", "_final", "top_k")
+                 "_rounds", "_attempts", "_final", "_outcome", "top_k")
 
     # Container fields are lazily created (None until first write): a record
     # is opened on EVERY request, and five eager container allocations per
@@ -90,6 +93,7 @@ class DecisionRecord:
         self._rounds = None
         self._attempts = None
         self._final = None
+        self._outcome = None
 
     @property
     def start_unix(self) -> float:
@@ -116,6 +120,10 @@ class DecisionRecord:
     @property
     def final(self) -> dict[str, Any]:
         return self._final if self._final is not None else self._EMPTY_DICT
+
+    @property
+    def outcome(self) -> dict[str, Any]:
+        return self._outcome if self._outcome is not None else self._EMPTY_DICT
 
     # ---- layer hooks ----------------------------------------------------
 
@@ -242,6 +250,15 @@ class DecisionRecord:
         self._attempts.append({"rank": len(self._attempts),
                                "event": kind, **detail})
 
+    def record_outcome(self, outcome: dict[str, Any]) -> None:
+        """SLO-ledger serving outcome (router/slo.py): predicted vs actual
+        TTFT/TPOT vs SLO targets, slo_met verdict, miss reason, and (on the
+        disagg path) the per-pair KV-transfer row. Stamped exactly once on
+        every terminal path — success, shed, retry-exhausted, deadline,
+        abort — so /debug/decisions/<id> closes the predict→observe loop."""
+        if self._outcome is None:
+            self._outcome = outcome
+
     def finalize(self, status: int, *, destination: str | None = None,
                  reason: str | None = None) -> None:
         if self._final:
@@ -266,6 +283,7 @@ class DecisionRecord:
             "start_unix": self.start_unix,
             "admission": self._render_admission(),
             "final": self.final,
+            "outcome": self.outcome,
         }
         if compact:
             doc["summary"] = self.summary_line()

@@ -40,7 +40,8 @@ class FlowControlAdmissionController:
             size_bytes=max(request.request_size_bytes, 1),
         )
         rec = request.decision  # decision flight recorder (may be None)
-        t0 = time.monotonic() if rec is not None else 0.0
+        obs = getattr(request, "outcome", None)  # SLO ledger (may be None)
+        t0 = time.monotonic() if rec is not None or obs is not None else 0.0
         retried_after_shed = False
         outcome = await self.controller.enqueue_and_wait(item)
         if (outcome == QueueOutcome.REJECTED_CAPACITY
@@ -58,12 +59,18 @@ class FlowControlAdmissionController:
                     flow_key=item.flow_key,
                     size_bytes=item.size_bytes)
                 outcome = await self.controller.enqueue_and_wait(retry)
-        if rec is not None:
-            rec.record_admission(
-                "flow-control", outcome.value, flow_id=flow_id,
-                priority_band=request.objectives.priority,
-                queue_ms=(time.monotonic() - t0) * 1e3,
-                retried_after_shed=retried_after_shed)
+        if rec is not None or obs is not None:
+            queue_ms = (time.monotonic() - t0) * 1e3
+            if rec is not None:
+                rec.record_admission(
+                    "flow-control", outcome.value, flow_id=flow_id,
+                    priority_band=request.objectives.priority,
+                    queue_ms=queue_ms,
+                    retried_after_shed=retried_after_shed)
+            if obs is not None:
+                # The SLO ledger's queue-time component: admission wait is
+                # part of the client-observed TTFT budget.
+                obs.queue_ms = queue_ms
         if outcome != QueueOutcome.DISPATCHED:
             code, reason = _OUTCOME_ERRORS.get(outcome, (429, outcome.value))
             raise AdmissionError(code, reason)

@@ -111,6 +111,12 @@ class InferenceRequest:
     # it into CycleState (DECISION_STATE_KEY) so plugins can annotate the
     # cycle they run in.
     decision: Any = None
+    # SLO-ledger observation (router/slo.py RequestObservation), opened by
+    # the gateway before orchestration when the ledger is enabled; the
+    # flow-control admission and predicted-latency PreRequest hooks write
+    # queue time and per-request predictions into it, and the gateway closes
+    # it exactly once on every terminal path. None = ledger kill-switch.
+    outcome: Any = None
     # Prefix-hash memo (router/hashmemo.py PrefixHashMemo), lazily attached
     # by the first producer/scorer that needs a hash chain and reused by
     # every later consumer of the cycle — including failover reschedules of

@@ -21,6 +21,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import sys
 import time
 import uuid
 from typing import Any
@@ -38,6 +39,7 @@ from .framework.scheduling import InferenceRequest
 from .handlers.parsers import make_parser
 from .metrics import (
     DEADLINE_EXCEEDED_TOTAL,
+    KV_TRANSFER_MS,
     POOL_AVG_KV_CACHE,
     POOL_AVG_QUEUE,
     POOL_READY_ENDPOINTS,
@@ -68,6 +70,7 @@ from .requestcontrol.director import (
     RequestError,
 )
 from .schedpool import LoopLagMonitor, SchedulerPool, SchedulingConfig
+from .slo import SloConfig, SloLedger, finite_float_or_none
 from .datalayer.data_graph import validate_and_order_producers
 
 log = logging.getLogger("router.gateway")
@@ -132,6 +135,11 @@ class Gateway:
         # restores the zero-overhead baseline.
         self.decision_recorder = DecisionRecorder(
             DecisionConfig.from_spec(cfg.decisions))
+
+        # SLO & goodput ledger (router/slo.py): per-request serving outcomes
+        # closing the predict→observe loop. `slo: {enabled: false}` removes
+        # the per-chunk hook from the streaming path entirely.
+        self.slo_ledger = SloLedger(SloConfig.from_spec(cfg.slo))
 
         # Outbound TLS verification policy for router-side client legs
         # (upstream proxy, /debug/traces + /v1/models fan-out). Default:
@@ -221,6 +229,8 @@ class Gateway:
             web.get("/debug/profile", self.profile),
             web.get("/debug/decisions", self.decisions),
             web.get("/debug/decisions/{request_id}", self.decision_detail),
+            web.get("/debug/slo", self.slo),
+            web.get("/debug/transfers", self.transfers),
         ])
         self._runner: web.AppRunner | None = None
         self._client: httpx.AsyncClient | None = None
@@ -403,6 +413,18 @@ class Gateway:
             "decisions": [r.to_dict(compact=True) for r in recs],
         })
 
+    async def slo(self, request: web.Request) -> web.Response:
+        """Fleet SLO/goodput rollup (router/slo.py): per-endpoint and
+        per-band attainment, predictor signed error + MAE, goodput vs raw
+        token counts, bounded miss-reason tallies."""
+        return web.json_response(self.slo_ledger.snapshot())
+
+    async def transfers(self, request: web.Request) -> web.Response:
+        """Per-(prefill, decode)-pair KV-transfer EWMA table
+        (datalayer/transfers.py): pull duration, bytes, derived wire speed,
+        and prefill-leg duration per pair."""
+        return web.json_response(self.datastore.transfers.snapshot())
+
     async def decision_detail(self, request: web.Request) -> web.Response:
         """Full schema-versioned DecisionRecord for one request id:
         admission → flow control → per-profile filter drops + scorer tables +
@@ -516,10 +538,19 @@ class Gateway:
             headers=headers,
             request_size_bytes=len(raw))
         original_model = parse.model
+        # SLO ledger: opened BEFORE orchestration so the flow-control
+        # admission hook can stamp queue time and the predicted-latency
+        # PreRequest hook can stamp this request's prediction.
+        self.slo_ledger.start(ireq, t_start)
 
         try:
             result = await self.director.handle_request(None, ireq)
         except RequestError as e:
+            # Director error finalization (no endpoints, admission shed,
+            # admit-plugin reject, scheduling failure): the ledger records
+            # slo_met=false with the reason — an absent field would
+            # overcount attainment.
+            self.slo_ledger.complete(ireq, status=e.code, reason=e.reason)
             return web.json_response(
                 {"error": e.reason}, status=e.code,
                 headers={X_REMOVAL_REASON: e.reason,
@@ -559,13 +590,18 @@ class Gateway:
                 if ireq.decision is not None:
                     ireq.decision.record_event("evicted_inflight")
                     ireq.decision.finalize(429, reason=EVICTED_REASON)
+                self.slo_ledger.complete(ireq, status=429,
+                                         reason=EVICTED_REASON)
                 return web.json_response(
                     {"error": EVICTED_REASON}, status=429,
                     headers={X_REMOVAL_REASON: EVICTED_REASON,
                              **self._decision_headers(ireq)})
             # Mid-stream eviction (or external cancel): the 200 status line is
             # already on the wire — the only clean signal is the dropped
-            # connection, so propagate.
+            # connection, so propagate (the ledger still closes: an aborted
+            # stream is slo_met=false, not an absent row).
+            self.slo_ledger.complete(ireq, status=499,
+                                     reason="cancelled-mid-stream")
             raise
         finally:
             self.evictor.deregister(evict_key)
@@ -729,6 +765,9 @@ class Gateway:
             DEADLINE_EXCEEDED_TOTAL.inc()
             if rec is not None:
                 rec.finalize(504, reason=DEADLINE_EXCEEDED_REASON)
+            if ireq is not None:
+                self.slo_ledger.complete(ireq, status=504,
+                                         reason=DEADLINE_EXCEEDED_REASON)
             return web.json_response(
                 {"error": "deadline exceeded"}, status=504,
                 headers={X_REMOVAL_REASON: DEADLINE_EXCEEDED_REASON,
@@ -740,6 +779,9 @@ class Gateway:
         if failure is not None and failure.kind in ("connect", "read"):
             if rec is not None:
                 rec.finalize(502, reason=failure.reason)
+            if ireq is not None:  # retry-exhausted terminal
+                self.slo_ledger.complete(ireq, status=502,
+                                         reason=failure.reason)
             return web.json_response(
                 {"error": f"upstream {failure.kind} failed: {failure.detail}",
                  **extra},
@@ -748,11 +790,17 @@ class Gateway:
         if failure is not None:  # retryable status, relayed as-is
             if rec is not None:
                 rec.finalize(failure.status, reason=failure.reason)
+            if ireq is not None:
+                self.slo_ledger.complete(ireq, status=failure.status,
+                                         reason=failure.reason)
             return web.json_response(
                 {"error": failure.reason, **extra}, status=failure.status,
                 headers={X_REMOVAL_REASON: failure.reason, **dec_headers})
         if rec is not None:
             rec.finalize(503, reason="no-upstream-available")
+        if ireq is not None:
+            self.slo_ledger.complete(ireq, status=503,
+                                     reason="no-upstream-available")
         return web.json_response(
             {"error": "no upstream endpoint available"}, status=503,
             headers={X_REMOVAL_REASON: "no-upstream-available", **dec_headers})
@@ -840,6 +888,9 @@ class Gateway:
             out_headers["x-session-token"] = ireq.headers["x-session-token"]
         usage: dict[str, int] = {}
         first_byte_at: float | None = None
+        # SLO-ledger observation: None when the kill-switch is off, so the
+        # per-chunk hook below costs exactly one `is None` check.
+        obs = ireq.outcome if ireq is not None else None
 
         try:
             if streaming_body:
@@ -868,6 +919,8 @@ class Gateway:
                     except (aiohttp.ClientError, ConnectionResetError,
                             asyncio.TimeoutError) as e:
                         UPSTREAM_STREAM_ABORTED_TOTAL.inc()
+                        if obs is not None:
+                            obs.abort_reason = "upstream-stream-aborted"
                         log.warning("upstream stream aborted mid-relay from "
                                     "%s: %s",
                                     endpoint.metadata.address_port, e)
@@ -882,6 +935,18 @@ class Gateway:
                         if found:
                             first_byte_at = time.monotonic()
                             TTFT_SECONDS.labels(model_label).observe(first_byte_at - t_start)
+                            if obs is not None:
+                                # Reuses the monotonic read TTFT just paid.
+                                obs.first_token(first_byte_at)
+                    elif obs is not None and _token_bearing(chunk):
+                        # Per-token inter-arrival capture: one clock read +
+                        # a few adds per transport chunk (<1% of the 5ms
+                        # token cadence; benchmarks/SLO_OBS.json). Framing
+                        # chunks are not token arrivals — counting them
+                        # would stretch last_token_at past the real last
+                        # token and inflate actual TPOT into a false SLO
+                        # miss.
+                        obs.on_chunk()
                     if stream_hook is not None:
                         stream_hook(None, ireq, endpoint, chunk)
                     # Usage rides the FINAL SSE event: keep a bounded tail
@@ -894,6 +959,8 @@ class Gateway:
                     try:
                         await ws.write(chunk)
                     except (ConnectionResetError, ConnectionError) as e:
+                        if obs is not None:
+                            obs.abort_reason = "client-disconnect"
                         log.debug("client closed stream mid-relay: %s", e)
                         break
                 usage = _usage_from_sse(sse_tail) or {}
@@ -919,11 +986,64 @@ class Gateway:
                     # Backend capacity freed: wake saturated dispatch shards
                     # immediately instead of waiting out their backoff poll.
                     self.flow_controller.notify_capacity()
+                # An exception unwinding through this finally (eviction /
+                # client-disconnect CancelledError from the relay loop —
+                # not in any caught tuple above) is an aborted stream: the
+                # ledger must not stamp it as a met 200. The outer 499
+                # complete() can't fix it later — complete is first-wins.
+                if (obs is not None and obs.abort_reason is None
+                        and sys.exc_info()[0] is not None):
+                    obs.abort_reason = "cancelled-mid-stream"
+                # Terminal ledger accounting: per-pair KV-transfer stats off
+                # the sidecar's response headers, then the SLO verdict
+                # (met/missed, or error for relayed 4xx/5xx and aborts).
+                transfer = self._record_transfer(ireq, endpoint, resp.headers)
+                self.slo_ledger.complete(ireq, status=resp.status,
+                                         endpoint=endpoint, usage=usage,
+                                         transfer=transfer)
                 REQUEST_DURATION.labels(model_label).observe(time.monotonic() - t_start)
                 if usage.get("prompt_tokens"):
                     INPUT_TOKENS.labels(model_label).observe(usage["prompt_tokens"])
                 if usage.get("completion_tokens"):
                     OUTPUT_TOKENS.labels(model_label).observe(usage["completion_tokens"])
+
+    def _record_transfer(self, ireq: InferenceRequest, endpoint,
+                         resp_headers) -> dict[str, Any] | None:
+        """Land the sidecar-relayed per-pair KV-transfer stats
+        (``x-kv-transfer-ms``/``-bytes`` from the decode engine's measured
+        pull, ``x-kv-prefiller`` for the pair identity, and the existing
+        ``x-prefill-duration-ms``) into the datastore's EWMA table. Returns
+        the row for the DecisionRecord outcome block, or None when the
+        response carries no disagg telemetry."""
+        pull = resp_headers.get("x-kv-transfer-ms")
+        prefill = resp_headers.get("x-prefill-duration-ms")
+        if not pull and not prefill:
+            return None
+        # Pair identity comes ONLY from the sidecar's served-prefiller stamp:
+        # on fallback-to-decode the sidecar sends x-prefill-duration-ms (the
+        # wasted walk time) with no x-kv-prefiller, and attributing that to
+        # a routing-header candidate that never served would poison the
+        # per-pair EWMAs the transfer-cost scorer will read.
+        prefiller = resp_headers.get("x-kv-prefiller")
+        if not prefiller:
+            return None
+        pull_ms = finite_float_or_none(pull)
+        prefill_ms = finite_float_or_none(prefill)
+        nbytes = finite_float_or_none(resp_headers.get("x-kv-transfer-bytes"))
+        nbytes = int(nbytes) if nbytes is not None else None
+        decode = endpoint.metadata.address_port
+        self.datastore.transfers.record(prefiller, decode, pull_ms=pull_ms,
+                                        nbytes=nbytes, prefill_ms=prefill_ms)
+        if pull_ms is not None:
+            KV_TRANSFER_MS.observe(pull_ms)
+        row: dict[str, Any] = {"prefill": prefiller, "decode": decode}
+        if pull_ms is not None:
+            row["pull_ms"] = pull_ms
+        if nbytes is not None:
+            row["bytes"] = nbytes
+        if prefill_ms is not None:
+            row["prefill_ms"] = prefill_ms
+        return row
 
     async def metrics(self, request: web.Request) -> web.Response:
         return web.Response(body=generate_latest(REGISTRY),
@@ -1008,6 +1128,21 @@ def _rewrite_model_name(data: bytes, ireq: InferenceRequest | None,
     except Exception:
         pass
     return data
+
+
+def _token_bearing(chunk: bytes) -> bool:
+    """Cheap streaming-relay classification: count the transport chunk as a
+    token arrival unless it is pure framing — keep-alive comment, blank
+    heartbeat, or the [DONE] sentinel. iter_any() chunks can split an SSE
+    event mid-separator, so leading CR/LF is stripped before classifying:
+    a token event arriving as '\\ndata: …' must still advance the TPOT
+    clock. (A usage-only terminal event still counts: telling it apart
+    needs a JSON parse the per-chunk budget can't afford, and engines emit
+    it back-to-back with the final token.)"""
+    if chunk[:1] in (b"\n", b"\r"):
+        chunk = chunk.lstrip(b"\r\n")
+    b0 = chunk[:1]
+    return bool(b0) and b0 != b":" and not chunk.startswith(b"data: [DONE]")
 
 
 def _usage_from_json(data: bytes) -> dict[str, int] | None:

@@ -152,3 +152,38 @@ LOOP_LAG_SECONDS = Histogram(
     "(sleep-overshoot of a 100ms timer; the stall token relays experience)",
     registry=REGISTRY,
     buckets=(.0001, .0005, .001, .0025, .005, .01, .025, .05, .1, .5))
+# SLO & goodput ledger (router/slo.py): per-request serving outcomes,
+# predictor calibration, goodput vs raw token rate. The per-request detail
+# (predicted vs actual vs SLO, miss reason, transfer row) lives in the
+# DecisionRecord outcome block; these are the graphable aggregates.
+SLO_ATTAINMENT = Gauge(
+    "router_slo_attainment",
+    "Running SLO attainment ratio (slo_met terminal requests / all terminal "
+    "requests) per endpoint", ("endpoint",),
+    registry=REGISTRY)  # children evicted with SloLedger.MAX_ENDPOINTS LRU
+SLO_REQUESTS_TOTAL = Counter(
+    "router_slo_requests_total",
+    "Terminal serving outcomes by verdict (met / missed / error)",
+    ("verdict",), registry=REGISTRY)
+GOODPUT_TOKENS_TOTAL = Counter(
+    "router_goodput_tokens_total",
+    "Completion tokens delivered inside the request SLO (goodput; "
+    "P/D-Serve's fleet objective)", ("model",), registry=REGISTRY)
+OUTPUT_TOKENS_TOTAL = Counter(
+    "router_output_tokens_total",
+    "All completion tokens delivered (raw token rate — divergence from "
+    "router_goodput_tokens_total is wasted work)",
+    ("model",), registry=REGISTRY)
+PREDICTOR_ERROR_MS = Histogram(
+    "router_predictor_error_ms",
+    "Absolute error of the predicted-latency ridge vs the observed value "
+    "(kind: ttft | tpot; role: served endpoint's pool role). Signed "
+    "error/bias is in the /debug/slo rollup.",
+    ("kind", "role"), registry=REGISTRY,
+    buckets=(1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000, 2500))
+KV_TRANSFER_MS = Histogram(
+    "router_kv_transfer_ms",
+    "Per-request KV pull duration measured by the decode engine and relayed "
+    "through the sidecar (per-pair EWMA table at /debug/transfers)",
+    registry=REGISTRY,
+    buckets=(1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000, 2500))

@@ -14,7 +14,7 @@ from ..framework.datalayer import Endpoint
 from ..framework.plugin import PluginBase, register_plugin
 from ..framework.scheduling import InferenceRequest
 from ..plugins.attributes import LATENCY_ATTRIBUTE_KEY
-from .predicted_latency import H_SLO_TPOT, H_SLO_TTFT
+from ..slo import H_SLO_TPOT, H_SLO_TTFT, parse_slo_header_ms
 
 
 @register_plugin("latency-slo-admitter")
@@ -36,11 +36,8 @@ class LatencySloAdmitter(PluginBase):
                     endpoints: list[Endpoint]) -> tuple[bool, str]:
         if request.objectives.priority >= 0:
             return True, ""
-        try:
-            has_slo = (float(request.headers.get(H_SLO_TTFT, "") or 0) > 0
-                       or float(request.headers.get(H_SLO_TPOT, "") or 0) > 0)
-        except ValueError:
-            has_slo = False
+        has_slo = (parse_slo_header_ms(request.headers, H_SLO_TTFT) > 0
+                   or parse_slo_header_ms(request.headers, H_SLO_TPOT) > 0)
         if not has_slo:
             return True, ""
 

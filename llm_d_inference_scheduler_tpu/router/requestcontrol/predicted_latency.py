@@ -42,10 +42,16 @@ from ..plugins.attributes import (
     estimate_input_tokens,
 )
 
-log = logging.getLogger("router.predicted_latency")
+# SLO header contract shared with the outcome side (router/slo.py is the
+# single source; the ledger judges the same targets this producer predicts
+# against).
+from ..slo import (  # noqa: F401 (re-export)
+    H_SLO_TPOT,
+    H_SLO_TTFT,
+    parse_slo_header_ms,
+)
 
-H_SLO_TTFT = "x-slo-ttft-ms"
-H_SLO_TPOT = "x-slo-tpot-ms"
+log = logging.getLogger("router.predicted_latency")
 
 
 class OnlineRidge:
@@ -160,10 +166,7 @@ class PredictedLatencyProducer(PluginBase):
 
     @staticmethod
     def _slo(request: InferenceRequest, header: str) -> float:
-        try:
-            return float(request.headers.get(header, "") or 0.0)
-        except ValueError:
-            return 0.0
+        return parse_slo_header_ms(request.headers, header)
 
     # ---- Produce: bulk predictions --------------------------------------
 
@@ -212,6 +215,19 @@ class PredictedLatencyProducer(PluginBase):
         if info is not None:
             PREDICTED_TTFT_MS.observe(info.ttft_ms)
             PREDICTED_TPOT_MS.observe(info.tpot_ms)
+        # SLO-ledger outcome hook: stamp THIS request's prediction (for the
+        # endpoint actually picked) so the ledger can compute calibration
+        # error at completion. Re-runs on failover reschedules, so the
+        # prediction always targets the endpoint that serves.
+        obs = getattr(request, "outcome", None)
+        if obs is not None:
+            obs.endpoint = key
+            role = ep.metadata.labels.get(self.role_label)
+            if role:
+                obs.role = role
+            if info is not None:
+                obs.predicted_ttft_ms = info.ttft_ms
+                obs.predicted_tpot_ms = info.tpot_ms if info.tpot_ms else None
         setattr(request, _CTX_ATTR, _RequestContext(
             endpoint=key, start=time.monotonic(),
             ttft_features=self._ttft_features(request, ep),

@@ -33,6 +33,7 @@ from aiohttp import web
 
 from ..requestcontrol.director import H_DATA_PARALLEL, H_ENCODERS, H_PREFILLER
 from ..resilience import DEADLINE_EXCEEDED_REASON, Deadline, H_REQUEST_TIMEOUT
+from ..slo import finite_float_or_none
 
 log = logging.getLogger("router.sidecar")
 
@@ -84,7 +85,12 @@ class Sidecar:
     def __init__(self, cfg: SidecarConfig, *, dp_rank: int = 0):
         import random
 
-        from prometheus_client import CollectorRegistry, Counter, Gauge
+        from prometheus_client import (
+            CollectorRegistry,
+            Counter,
+            Gauge,
+            Histogram,
+        )
 
         self.cfg = cfg
         self.dp_rank = dp_rank
@@ -139,6 +145,12 @@ class Sidecar:
             "sidecar_deadline_exceeded_total",
             "Requests rejected because the end-to-end deadline was exhausted",
             registry=self.metrics_registry)
+        self._h_kv_transfer = Histogram(
+            "sidecar_kv_transfer_ms",
+            "KV pull duration measured by the decode engine and relayed "
+            "through this sidecar (x-kv-pull-ms -> x-kv-transfer-ms)",
+            registry=self.metrics_registry,
+            buckets=(1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000, 2500))
 
     # ---- per-leg TLS (reference proxy.go:153-166) -----------------------
 
@@ -589,6 +601,7 @@ class Sidecar:
         # deadline budget; when every candidate fails (or the budget runs
         # out) the request falls back to aggregated local decode.
         ktp = None
+        served_prefiller = None
         attempts = 0
         for i, prefiller in enumerate(prefillers):
             if deadline is not None and deadline.expired:
@@ -609,6 +622,7 @@ class Sidecar:
                     json=prefill_body, headers=headers, timeout=timeout)
                 if r.status_code == 200:
                     ktp = r.json().get("kv_transfer_params")
+                    served_prefiller = prefiller
                     span.set_attribute("prefill_endpoint", prefiller)
                     break
                 log.warning("prefill at %s returned %d; %s", prefiller,
@@ -629,9 +643,14 @@ class Sidecar:
         span.set_attribute("prefill_duration_ms", round(prefill_ms, 1))
         span.set_attribute("prefill_attempts", attempts)
         span.set_attribute("fallback_to_decode", ktp is None)
+        extra = {"x-prefill-duration-ms": f"{prefill_ms:.1f}"}
+        if served_prefiller is not None:
+            # Pair identity for the router's /debug/transfers table: the
+            # prefill candidate that actually served (post-failover), not
+            # whatever the routing header listed first.
+            extra["x-kv-prefiller"] = served_prefiller
         return await self._dispatch_decode(request, decode_body,
-                                           extra_headers={
-                                               "x-prefill-duration-ms": f"{prefill_ms:.1f}"},
+                                           extra_headers=extra,
                                            deadline=deadline)
 
     async def _dispatch_decode(self, request: web.Request, body: dict[str, Any],
@@ -673,6 +692,18 @@ class Sidecar:
         out_headers = {"content-type": resp.headers.get("content-type",
                                                         "application/json")}
         out_headers.update(extra_headers or {})
+        # Relay the decode engine's measured KV pull cost (non-streaming
+        # responses only — streamed headers leave before the pull resolves)
+        # so the router can land the (prefill, decode) pair observation.
+        pull_ms = resp.headers.get("x-kv-pull-ms")
+        if pull_ms:
+            out_headers["x-kv-transfer-ms"] = pull_ms
+            pull_bytes = resp.headers.get("x-kv-pull-bytes")
+            if pull_bytes:
+                out_headers["x-kv-transfer-bytes"] = pull_bytes
+            v = finite_float_or_none(pull_ms)
+            if v is not None:
+                self._h_kv_transfer.observe(v)
         try:
             if "text/event-stream" in out_headers["content-type"]:
                 ws = web.StreamResponse(status=resp.status_code, headers=out_headers)

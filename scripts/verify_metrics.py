@@ -8,7 +8,10 @@ sidecar) and fails on:
   families) ambiguous;
 - high-cardinality label names — labels whose values grow with traffic
   (request ids, trace/span ids, URLs, rooms) blow up Prometheus series
-  counts; they belong on spans, never on metric labels.
+  counts; they belong on spans, never on metric labels;
+- router/sidecar families missing a docs/metrics.md row — a family an
+  operator can scrape but cannot look up is drift (the engine's bulk
+  jetstream:* step families are documented in observability.md instead).
 
 Run via `make verify-metrics`; tests/test_observability.py hooks it into
 the pytest run so CI catches registry drift statically.
@@ -45,7 +48,22 @@ REQUIRED_FAMILIES = {
     ("router_sched_offload_queue_seconds", "router"),
     ("router_sched_batch_size", "router"),
     ("router_loop_lag_seconds", "router"),
+    # SLO & goodput ledger (ISSUE 6): attainment, goodput vs raw tokens,
+    # predictor calibration, per-pair KV-transfer cost.
+    ("router_slo_attainment", "router"),
+    ("router_slo_requests", "router"),
+    ("router_goodput_tokens", "router"),
+    ("router_output_tokens", "router"),
+    ("router_predictor_error_ms", "router"),
+    ("router_kv_transfer_ms", "router"),
+    ("sidecar_kv_transfer_ms", "sidecar"),
 }
+
+# Registries whose every family must have a docs/metrics.md row (the
+# registry↔docs sync lint below). The engine's jetstream:* step families are
+# documented in bulk in observability.md, so only the router and sidecar
+# surfaces are pinned row-by-row.
+DOC_SYNCED_SOURCES = {"router", "sidecar"}
 
 
 def _families(registry, source: str):
@@ -88,13 +106,32 @@ def collect_registries():
     ]
 
 
+def _docs_text() -> str:
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "metrics.md")
+    try:
+        with open(path) as f:
+            return f.read()
+    except OSError:
+        return ""
+
+
 def check() -> list[str]:
     errors: list[str] = []
     seen: dict[str, str] = {}
     required = set(REQUIRED_FAMILIES)
+    docs = _docs_text()
     for source, registry in collect_registries():
         for name, labels, src in _families(registry, source):
             required.discard((name, src))
+            # Registry↔docs sync: every router/sidecar family needs a
+            # docs/metrics.md row (counters may be documented with their
+            # _total suffix — prometheus_client strips it here).
+            if (src in DOC_SYNCED_SOURCES and name not in docs
+                    and f"{name}_total" not in docs):
+                errors.append(
+                    f"{src} family {name!r} has no docs/metrics.md row "
+                    "(add one, or document the rename)")
             prev = seen.get(name)
             if prev is not None and prev != src:
                 errors.append(

@@ -75,10 +75,13 @@ def test_disagg_path_end_to_end():
                 pre_prompt_tokens_before = _counter_value(
                     pre, "jetstream:prompt_tokens_total")
 
-                # Through the router: long prompt → P/D split.
+                # Through the router: long prompt → P/D split. SLO headers
+                # opt the request into a defined-SLO ledger verdict.
                 r = await c.post(f"http://127.0.0.1:{GW}/v1/completions",
                                  json={"model": "tiny", "prompt": LONG_PROMPT,
-                                       "max_tokens": 6, "temperature": 0})
+                                       "max_tokens": 6, "temperature": 0},
+                                 headers={"x-request-id": "disagg-slo-1",
+                                          "x-slo-ttft-ms": "60000"})
                 assert r.status_code == 200
                 assert r.headers["x-gateway-destination-endpoint-served"] == \
                     f"127.0.0.1:{SC}"
@@ -100,6 +103,45 @@ def test_disagg_path_end_to_end():
                 m = await c.get(f"http://127.0.0.1:{GW}/metrics")
                 assert 'disagg_decision_total{decision_type="prefill-decode"}' in m.text
                 assert 'disagg_decision_total{decision_type="decode"}' in m.text
+
+                # SLO-ledger outcome block on the decision record: predicted
+                # vs actual vs SLO plus the per-pair transfer row (the P/D
+                # request's KV pull was measured by the decode engine and
+                # relayed sidecar → gateway).
+                r = await c.get(
+                    f"http://127.0.0.1:{GW}/debug/decisions/disagg-slo-1")
+                out = r.json()["outcome"]
+                assert out["slo_met"] is True
+                assert out["slo"] == {"ttft_ms": 60000.0, "tpot_ms": 0.0,
+                                      "defined": True}
+                assert out["actual"]["ttft_ms"] > 0
+                assert out["actual"]["tokens"] == 6
+                tr = out["transfer"]
+                assert tr["prefill"] == f"127.0.0.1:{PRE}"
+                assert tr["decode"] == f"127.0.0.1:{SC}"
+                assert tr["pull_ms"] > 0 and tr["bytes"] > 0
+                assert tr["prefill_ms"] > 0
+
+                # Fleet rollups are non-empty: /debug/slo attainment + the
+                # /debug/transfers per-pair EWMA row.
+                slo = (await c.get(f"http://127.0.0.1:{GW}/debug/slo")).json()
+                assert slo["totals"]["requests"] >= 2
+                assert slo["totals"]["slo_met"] >= 2
+                assert f"127.0.0.1:{SC}" in slo["endpoints"]
+                transfers = (await c.get(
+                    f"http://127.0.0.1:{GW}/debug/transfers")).json()
+                pair = next(p for p in transfers["pairs"]
+                            if p["prefill"] == f"127.0.0.1:{PRE}"
+                            and p["decode"] == f"127.0.0.1:{SC}")
+                assert pair["pulls"] >= 1
+                assert pair["ewma_pull_ms"] > 0
+                assert pair["bytes_total"] > 0
+                assert pair["ewma_prefill_ms"] > 0
+
+                # And the router metric families observed the same pull.
+                m = await c.get(f"http://127.0.0.1:{GW}/metrics")
+                assert "router_kv_transfer_ms_count" in m.text
+                assert 'router_goodput_tokens_total{model="tiny"}' in m.text
         finally:
             await gw.stop()
             await sc.stop()
@@ -507,6 +549,13 @@ schedulingProfiles:
 
                 assert rec["final"]["status"] == 200
                 assert rec["final"]["destination"] == f"127.0.0.1:{SC7}"
+
+                # Outcome block closes the loop even on the failover path:
+                # no SLO headers → vacuously met, e2e/TTFT still measured.
+                out = rec["outcome"]
+                assert out["slo_met"] is True
+                assert out["slo"]["defined"] is False
+                assert out["actual"]["e2e_ms"] > 0
         finally:
             await gw.stop()
             await sc.stop()

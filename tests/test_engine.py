@@ -442,3 +442,25 @@ def test_incremental_prefill_token_parity_and_no_stall():
     assert a == b == lt_w
     assert cached >= 112  # 7 complete blocks committed by the chunked path
 
+
+
+def test_note_kv_import_dedupes_eviction_ring():
+    """A re-dispatched request id overwrites its kv_import_stats entry; the
+    eviction ring must not gain a duplicate slot, or a later cap eviction
+    pops the LIVE entry when the stale first occurrence reaches the front
+    (the decode response then silently loses its x-kv-pull-ms stamp)."""
+    import collections
+    import time as _time
+
+    from llm_d_inference_scheduler_tpu.engine.core import TpuEngine
+
+    class Stub:
+        KV_IMPORT_STATS_CAP = TpuEngine.KV_IMPORT_STATS_CAP
+
+    s = Stub()
+    s.kv_import_stats = {}
+    s._kv_import_order = collections.deque()
+    for _ in range(3):
+        TpuEngine._note_kv_import(s, "r1", _time.monotonic(), 10, "host")
+    assert len(s._kv_import_order) == 1
+    assert s.kv_import_stats["r1"]["bytes"] == 10

@@ -274,3 +274,79 @@ def test_scorer_all_negative_prefers_least_violating():
     near = _ep(2, info=_info(-10, -5, dispatched=2))
     scores = LatencyScorer().score(None, None, _req(), [deep, near])
     assert scores["127.0.0.1:2"] > scores["127.0.0.1:1"]
+
+
+# ---- predictor calibration through the SLO ledger -----------------------
+
+
+def _predictor_error_count() -> float:
+    from llm_d_inference_scheduler_tpu.router.metrics import REGISTRY
+
+    total = 0.0
+    for m in REGISTRY.collect():
+        if m.name == "router_predictor_error_ms":
+            total += sum(s.value for s in m.samples
+                         if s.name.endswith("_count"))
+    return total
+
+
+def test_trained_predictor_produces_bounded_error_observations():
+    """Trained-then-served requests must close the predict→observe loop:
+    each served request lands a ``router_predictor_error_ms`` observation,
+    and the ledger's TTFT calibration (MAE) is bounded — the ridge trained
+    on the very latencies the sim scripts, so triple-digit-second error
+    would mean the ledger compares mismatched quantities."""
+    CAL_FAST, CAL_GW = 18625, 18626
+
+    cfg = f"""
+pool:
+  endpoints:
+    - {{address: 127.0.0.1, port: {CAL_FAST}}}
+plugins:
+  - {{type: predicted-latency-producer}}
+  - {{type: latency-scorer}}
+schedulingProfiles:
+  - name: default
+    plugins:
+      - {{pluginRef: latency-scorer}}
+"""
+
+    async def body():
+        eng = EngineServer(EngineConfig(backend="sim", model="tiny",
+                                        port=CAL_FAST,
+                                        sim_decode_ms_per_token=1.0))
+        await eng.start()
+        gw = build_gateway(cfg, port=CAL_GW, poll_interval=0.02)
+        await gw.start()
+        try:
+            async with httpx.AsyncClient(timeout=60) as c:
+                # Train past MIN_SAMPLES, then serve with predictions live.
+                for _ in range(6):
+                    r = await c.post(
+                        f"http://127.0.0.1:{CAL_GW}/v1/completions",
+                        json={"model": "tiny", "prompt": "warm",
+                              "max_tokens": 8})
+                    assert r.status_code == 200
+                before = _predictor_error_count()
+                for _ in range(8):
+                    r = await c.post(
+                        f"http://127.0.0.1:{CAL_GW}/v1/completions",
+                        json={"model": "tiny", "prompt": "serve",
+                              "max_tokens": 8},
+                        headers={"x-slo-ttft-ms": "60000"})
+                    assert r.status_code == 200
+                # Every trained-then-served request observed an error.
+                assert _predictor_error_count() - before >= 8
+
+                slo = (await c.get(
+                    f"http://127.0.0.1:{CAL_GW}/debug/slo")).json()
+                ttft = slo["totals"]["predictor"]["ttft"]
+                assert ttft["n"] >= 8
+                # Bounded: sim e2e is ~10ms; allow generous shared-box slack.
+                assert 0 <= ttft["mae_ms"] < 1000
+                assert abs(ttft["mean_signed_ms"]) <= ttft["mae_ms"] + 1e-9
+        finally:
+            await gw.stop()
+            await eng.stop()
+
+    asyncio.run(body())

@@ -1,0 +1,393 @@
+"""SLO & goodput ledger: target resolution, verdicts on every terminal
+shape, predictor calibration rollup, the per-pair KV-transfer EWMA table,
+and the verify-slo terminal-path check."""
+
+import time
+
+from llm_d_inference_scheduler_tpu.router.datalayer.transfers import (
+    TransferTable,
+)
+from llm_d_inference_scheduler_tpu.router.decisions import DecisionRecord
+from llm_d_inference_scheduler_tpu.router.framework.datalayer import (
+    Endpoint,
+    EndpointMetadata,
+)
+from llm_d_inference_scheduler_tpu.router.framework.scheduling import (
+    InferenceRequest,
+    InferenceRequestBody,
+    Objectives,
+)
+from llm_d_inference_scheduler_tpu.router.slo import (
+    SloConfig,
+    SloLedger,
+    H_SLO_TPOT,
+    H_SLO_TTFT,
+)
+
+
+def _req(rid="r1", model="m", priority=0, headers=None) -> InferenceRequest:
+    return InferenceRequest(
+        request_id=rid, target_model=model,
+        body=InferenceRequestBody(completions={"prompt": "x"}),
+        headers=headers or {}, objectives=Objectives(priority=priority))
+
+
+def _ep(port=9001, role=None) -> Endpoint:
+    labels = {"llm-d.ai/role": role} if role else {}
+    return Endpoint(EndpointMetadata(name=f"e{port}", address="127.0.0.1",
+                                     port=port, labels=labels))
+
+
+def _ledger(**spec) -> SloLedger:
+    return SloLedger(SloConfig.from_spec(spec))
+
+
+# ---- config / targets ---------------------------------------------------
+
+
+def test_targets_headers_beat_model_defaults_beat_global():
+    led = _ledger(defaultTtftMs=500, defaultTpotMs=20,
+                  perModel={"m": {"ttftMs": 300, "tpotMs": 10}})
+    # Headers win.
+    assert led.resolve_targets("m", {H_SLO_TTFT: "100", H_SLO_TPOT: "5"}) \
+        == (100, 5)
+    # Per-model defaults fill absent headers.
+    assert led.resolve_targets("m", {}) == (300, 10)
+    # Global defaults for unknown models.
+    assert led.resolve_targets("other", {}) == (500, 20)
+    # Garbage header falls through to config.
+    assert led.resolve_targets("m", {H_SLO_TTFT: "nan-ish?"}) == (300, 10)
+
+
+def test_killswitch_returns_none_observation():
+    led = _ledger(enabled=False)
+    req = _req()
+    assert led.start(req, time.monotonic()) is None
+    assert req.outcome is None
+    led.complete(req, status=200)  # must be a no-op, not a crash
+    assert led.snapshot()["totals"]["requests"] == 0
+
+
+# ---- verdicts -----------------------------------------------------------
+
+
+def test_streamed_request_meets_slo():
+    led = _ledger()
+    req = _req(headers={H_SLO_TTFT: "1000", H_SLO_TPOT: "1000"})
+    t0 = time.monotonic()
+    obs = led.start(req, t0)
+    obs.first_token(t0 + 0.010)
+    obs.last_token_at = t0 + 0.020
+    led.complete(req, status=200, endpoint=_ep(),
+                 usage={"completion_tokens": 6})
+    snap = led.snapshot()
+    assert snap["totals"] == {**snap["totals"], "requests": 1, "slo_met": 1,
+                              "goodput_tokens": 6, "output_tokens": 6}
+    assert snap["totals"]["attainment"] == 1.0
+
+
+def test_ttft_miss_records_reason_and_drops_goodput():
+    led = _ledger()
+    req = _req(headers={H_SLO_TTFT: "5"})
+    t0 = time.monotonic() - 1.0  # opened 1s ago
+    obs = led.start(req, t0)
+    obs.t_start = t0
+    obs.first_token(t0 + 0.5)  # 500ms TTFT >> 5ms SLO
+    led.complete(req, status=200, endpoint=_ep(),
+                 usage={"completion_tokens": 8})
+    snap = led.snapshot()
+    assert snap["totals"]["slo_met"] == 0
+    assert snap["totals"]["output_tokens"] == 8
+    assert snap["totals"]["goodput_tokens"] == 0
+    assert any(k.startswith("ttft") for k in snap["miss_reasons"])
+
+
+def test_non_streaming_uses_e2e_as_ttft_and_whole_response_tpot():
+    led = _ledger()
+    req = _req(headers={H_SLO_TTFT: "60000", H_SLO_TPOT: "60000"})
+    rec = DecisionRecord(req.request_id, "m")
+    req.decision = rec
+    led.start(req, time.monotonic() - 0.2)  # 200ms e2e, no stream events
+    led.complete(req, status=200, endpoint=_ep(),
+                 usage={"completion_tokens": 10})
+    out = rec.outcome
+    assert out["slo_met"] is True and out["streamed"] is False
+    # e2e-as-TTFT ≈ 200ms; whole-response TPOT = e2e / tokens.
+    assert 150 < out["actual"]["ttft_ms"] < 2000
+    assert abs(out["actual"]["tpot_ms"] - out["actual"]["ttft_ms"] / 10) < 0.01
+
+
+def test_error_and_abort_are_slo_met_false_with_reason():
+    led = _ledger()
+    # Explicit error reason (shed / retry-exhausted / deadline shapes).
+    req = _req(rid="err")
+    led.start(req, time.monotonic())
+    led.complete(req, status=429, reason="shed under saturation")
+    # Mid-stream abort.
+    req2 = _req(rid="abort")
+    rec = DecisionRecord("abort", "m")
+    req2.decision = rec
+    obs = led.start(req2, time.monotonic())
+    obs.first_token(time.monotonic())
+    obs.abort_reason = "client-disconnect"
+    led.complete(req2, status=200, endpoint=_ep())
+    snap = led.snapshot()
+    assert snap["totals"]["requests"] == 2 and snap["totals"]["slo_met"] == 0
+    assert snap["miss_reasons"].get("shed") == 1
+    assert snap["miss_reasons"].get("client-disconnect") == 1
+    assert rec.outcome["slo_met"] is False
+    assert rec.outcome["reason"] == "client-disconnect"
+
+
+def test_complete_is_idempotent_first_wins():
+    led = _ledger()
+    req = _req()
+    led.start(req, time.monotonic())
+    led.complete(req, status=200, endpoint=_ep(),
+                 usage={"completion_tokens": 3})
+    led.complete(req, status=502, reason="late duplicate")
+    snap = led.snapshot()
+    assert snap["totals"]["requests"] == 1
+    assert snap["totals"]["slo_met"] == 1
+
+
+# ---- predictor calibration ---------------------------------------------
+
+
+def test_predictor_error_rollup_signed_and_mae():
+    led = _ledger()
+    for rid, predicted, actual_s in (("a", 100.0, 0.150), ("b", 100.0, 0.050)):
+        req = _req(rid=rid)
+        t0 = time.monotonic() - actual_s
+        obs = led.start(req, t0)
+        obs.t_start = t0
+        obs.predicted_ttft_ms = predicted
+        obs.first_token(t0 + actual_s)
+        led.complete(req, status=200, endpoint=_ep(role="decode"),
+                     usage={"completion_tokens": 1})
+    ttft = led.snapshot()["totals"]["predictor"]["ttft"]
+    assert ttft["n"] == 2
+    # errors: +50ms and -50ms → MAE ≈ 50, signed mean ≈ 0.
+    assert 45 < ttft["mae_ms"] < 55
+    assert abs(ttft["mean_signed_ms"]) < 10
+
+
+def test_predictor_ttft_calibration_subtracts_queue_time():
+    # The TTFT ridge is dispatch-relative; the client-observed TTFT also
+    # contains the flow-control queue wait. Calibration must compare like
+    # with like or under load the MAE reports queue time, not model error.
+    led = _ledger()
+    req = _req()
+    t0 = time.monotonic() - 0.150
+    obs = led.start(req, t0)
+    obs.t_start = t0
+    obs.predicted_ttft_ms = 100.0
+    obs.queue_ms = 50.0
+    obs.first_token(t0 + 0.150)  # client-observed TTFT ≈ 150ms
+    led.complete(req, status=200, endpoint=_ep(),
+                 usage={"completion_tokens": 1})
+    ttft = led.snapshot()["totals"]["predictor"]["ttft"]
+    # dispatch-relative actual ≈ 100ms → error ≈ 0, not 50.
+    assert ttft["mae_ms"] < 10
+
+
+def test_non_streamed_tpot_judges_slo_but_skips_calibration():
+    # The TPOT ridge trains only on streamed inter-token cadence; the
+    # non-streamed whole-response average (queue+prefill folded in) still
+    # drives the SLO verdict but must not feed kind=tpot calibration.
+    led = _ledger()
+    req = _req(headers={H_SLO_TPOT: "0.001"})
+    t0 = time.monotonic() - 0.100
+    obs = led.start(req, t0)
+    obs.t_start = t0
+    obs.predicted_tpot_ms = 4.0
+    led.complete(req, status=200, endpoint=_ep(),
+                 usage={"completion_tokens": 10})
+    snap = led.snapshot()
+    assert snap["totals"]["slo_met"] == 0          # verdict still judged
+    assert snap["totals"]["predictor"]["tpot"]["n"] == 0  # no calibration
+
+
+def test_transfer_header_guard_rejects_nonfinite():
+    # A malformed x-kv-transfer-ms must not seed NaN into the per-pair
+    # EWMAs (0.8*NaN + 0.2*x stays NaN forever) or the histogram sums —
+    # shared guard for the gateway landing and the sidecar relay.
+    from llm_d_inference_scheduler_tpu.router.slo import finite_float_or_none
+    assert finite_float_or_none("nan") is None
+    assert finite_float_or_none("inf") is None
+    assert finite_float_or_none("3.5") == 3.5
+    assert finite_float_or_none("") is None
+    assert finite_float_or_none(None) is None
+
+
+def test_token_bearing_chunk_classification():
+    # Framing chunks (keep-alives, blank heartbeats, [DONE]) must not
+    # advance the TPOT clock, but a token event split across reads —
+    # arriving with the previous event's trailing separator — must.
+    from llm_d_inference_scheduler_tpu.router.gateway import _token_bearing
+    assert _token_bearing(b'data: {"choices": []}\n\n')
+    assert _token_bearing(b'\ndata: {"choices": []}\n\n')   # split separator
+    assert _token_bearing(b'\r\n\r\ndata: {"x": 1}\n\n')
+    assert not _token_bearing(b": keep-alive\n\n")
+    assert not _token_bearing(b"\n\n")
+    assert not _token_bearing(b"\r\n")
+    assert not _token_bearing(b"data: [DONE]\n\n")
+    assert not _token_bearing(b"\n\ndata: [DONE]\n\n")
+
+
+def test_nonfinite_slo_headers_fall_back_to_defaults():
+    led = _ledger(defaultTtftMs=500)
+    for bad in ("nan", "inf", "-inf"):
+        req = _req(rid=f"r-{bad}", headers={H_SLO_TTFT: bad})
+        obs = led.start(req, time.monotonic())
+        assert obs.slo_ttft_ms == 500.0
+
+
+def test_model_rewrite_relabels_tokens_and_redoes_per_model_defaults():
+    # The director's weighted rewrite lands AFTER the ledger opens; token
+    # counters and perModel defaults must follow the serving name.
+    led = _ledger(perModel={"served-v2": {"tpotMs": 7}})
+    req = _req(model="client-name")
+    t0 = time.monotonic() - 0.010
+    obs = led.start(req, t0)
+    obs.t_start = t0
+    req.target_model = "served-v2"  # director rewrite mid-flight
+    led.complete(req, status=200, endpoint=_ep(),
+                 usage={"completion_tokens": 3})
+    assert obs.model == "served-v2"
+    assert obs.slo_tpot_ms == 7.0
+
+
+def test_band_reread_at_completion_after_director_classifies():
+    # The director resolves the x-objective header AFTER the ledger opens;
+    # the band must reflect the classified priority, not the open-time 0.
+    led = _ledger()
+    req = _req()
+    led.start(req, time.monotonic())
+    req.objectives.priority = -1  # director classifies mid-flight
+    led.complete(req, status=200, endpoint=_ep(),
+                 usage={"completion_tokens": 1})
+    assert set(led.snapshot()["bands"]) == {"-1"}
+
+
+def test_candidate_walk_failover_drops_stale_prediction():
+    # Pre-stream failover walks ranked candidates without re-running
+    # PreRequest: rank-1's prediction must not calibrate against rank-2's
+    # serving latency.
+    led = _ledger()
+    req = _req()
+    t0 = time.monotonic() - 0.100
+    obs = led.start(req, t0)
+    obs.t_start = t0
+    obs.endpoint = "127.0.0.1:9001"      # PreRequest stamped rank-1
+    obs.role = "decode"
+    obs.predicted_ttft_ms = 5.0
+    obs.first_token(t0 + 0.100)
+    led.complete(req, status=200, endpoint=_ep(9002),  # rank-2 served
+                 usage={"completion_tokens": 1})
+    snap = led.snapshot()
+    assert snap["totals"]["predictor"]["ttft"]["n"] == 0
+    assert "127.0.0.1:9002" in snap["endpoints"]
+
+
+def test_band_and_endpoint_rollup():
+    led = _ledger()
+    for rid, prio, port in (("a", 0, 9001), ("b", -1, 9002)):
+        req = _req(rid=rid, priority=prio)
+        led.start(req, time.monotonic())
+        led.complete(req, status=200, endpoint=_ep(port),
+                     usage={"completion_tokens": 2})
+    snap = led.snapshot()
+    assert set(snap["bands"]) == {"0", "-1"}
+    assert set(snap["endpoints"]) == {"127.0.0.1:9001", "127.0.0.1:9002"}
+    assert snap["endpoints"]["127.0.0.1:9001"]["attainment"] == 1.0
+
+
+def test_endpoint_rollup_lru_bound_under_pod_churn():
+    # Rescheduled pods arrive under fresh ip:ports forever; the per-endpoint
+    # table (and its attainment gauge children) must stay bounded.
+    led = _ledger()
+    for i in range(SloLedger.MAX_ENDPOINTS + 10):
+        req = _req(rid=f"r{i}")
+        led.start(req, time.monotonic())
+        led.complete(req, status=200, endpoint=_ep(10000 + i),
+                     usage={"completion_tokens": 1})
+    eps = led.snapshot()["endpoints"]
+    assert len(eps) == SloLedger.MAX_ENDPOINTS
+    assert "127.0.0.1:10000" not in eps          # oldest evicted
+    assert f"127.0.0.1:{10000 + SloLedger.MAX_ENDPOINTS + 9}" in eps
+    # Totals keep the full history even though the per-endpoint rows rotate.
+    assert led.snapshot()["totals"]["requests"] == SloLedger.MAX_ENDPOINTS + 10
+
+
+# ---- inter-arrival capture ---------------------------------------------
+
+
+def test_on_chunk_gap_buckets_and_max():
+    led = _ledger()
+    req = _req()
+    obs = led.start(req, time.monotonic())
+    obs.first_token(time.monotonic())
+    obs.last_token_at = time.monotonic() - 0.020  # 20ms gap → third bucket
+    obs.on_chunk()
+    obs.last_token_at = time.monotonic() - 0.300  # 300ms gap → overflow
+    obs.on_chunk()
+    assert obs.gap_buckets[2] == 1
+    assert obs.gap_buckets[4] == 1
+    assert obs.gap_max_ms >= 300
+    assert obs.token_events == 3
+    # The outcome block renders the mean inter-arrival gap beside max.
+    req.decision = rec = DecisionRecord(req.request_id, "m")
+    led.complete(req, status=200, endpoint=_ep(),
+                 usage={"completion_tokens": 3})
+    mean = rec.outcome["actual"]["gap_mean_ms"]
+    assert 150 <= mean <= obs.gap_max_ms
+
+
+# ---- transfer table -----------------------------------------------------
+
+
+def test_transfer_table_ewma_and_snapshot():
+    t = TransferTable()
+    t.record("p:1", "d:1", pull_ms=10.0, nbytes=1000, prefill_ms=30.0)
+    t.record("p:1", "d:1", pull_ms=20.0, nbytes=2000, prefill_ms=50.0)
+    s = t.pair("p:1", "d:1")
+    assert s.pulls == 2 and s.bytes_total == 3000
+    # EWMA(0.2): 10 → 0.8*10 + 0.2*20 = 12.
+    assert abs(s.ewma_pull_ms - 12.0) < 1e-9
+    snap = t.snapshot()["pairs"]
+    assert snap[0]["prefill"] == "p:1" and snap[0]["decode"] == "d:1"
+    assert "ewma_mb_per_s" in snap[0]
+
+
+def test_transfer_table_lru_bound():
+    t = TransferTable()
+    t.MAX_PAIRS = 4
+    for i in range(8):
+        t.record(f"p:{i}", "d:1", pull_ms=1.0)
+    assert len(t) == 4
+    assert t.pair("p:0", "d:1") is None
+    assert t.pair("p:7", "d:1") is not None
+
+
+def test_partial_rows_prefill_only():
+    # Streamed disagg responses carry no engine pull stats — the pair row
+    # still lands with the prefill-leg duration.
+    t = TransferTable()
+    t.record("p:1", "d:1", prefill_ms=42.0)
+    s = t.pair("p:1", "d:1")
+    assert s.ewma_pull_ms is None and s.ewma_prefill_ms == 42.0
+    assert "ewma_pull_ms" not in s.render()
+
+
+# ---- terminal-path verification hook ------------------------------------
+
+
+def test_verify_slo_terminal_paths_clean():
+    import pathlib
+    import sys
+
+    sys.path.insert(0, str(pathlib.Path(__file__).parent.parent / "scripts"))
+    import verify_slo
+
+    assert verify_slo.check() == []
