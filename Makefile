@@ -6,8 +6,9 @@ PY ?= python
 
 .PHONY: test test-fast test-unit test-dist test-chaos bench bench-flowcontrol \
 	bench-router-sse bench-decisions bench-sched bench-sched-offload \
-	bench-slo dryrun render-chart compile-check verify-metrics \
-	verify-decisions verify-hotpath verify-threadsafe verify-slo
+	bench-slo bench-overload dryrun render-chart compile-check \
+	verify-metrics verify-decisions verify-hotpath verify-threadsafe \
+	verify-slo
 
 # Full hermetic suite (virtual 8-device CPU mesh; no TPU or cluster needed —
 # the reference needs envtest + kind for the equivalent coverage).
@@ -77,6 +78,15 @@ bench-sched-offload:
 # admission) will be judged against.
 bench-slo:
 	$(PY) bench.py --slo-ramp
+
+# Overload-control bench (CPU-only): the --slo-ramp machinery driven at
+# 1x/2x/4x measured capacity with the goodput-max overload controller ON
+# (predictive admission + degrade ladder + Retry-After shedding) and again
+# with the kill-switch OFF (the PR 6 goodput collapse shape). Writes
+# benchmarks/OVERLOAD.json — target: goodput at 2x/4x within 30% of 1x and
+# overload wasted-token fraction < 0.15, with every shed explained.
+bench-overload:
+	$(PY) bench.py --overload-ramp
 
 test-unit: test-fast
 

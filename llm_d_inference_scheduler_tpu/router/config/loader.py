@@ -46,6 +46,7 @@ class RawConfig:
     resilience: dict[str, Any]
     decisions: dict[str, Any]
     slo: dict[str, Any]
+    overload: dict[str, Any]
     tls_client: dict[str, Any]
     pool: dict[str, Any]
     objectives: list[dict[str, Any]]
@@ -84,6 +85,12 @@ class RouterConfig:
     # {enabled, defaultTtftMs, defaultTpotMs, perModel}; enabled: false is
     # the kill-switch that removes the per-chunk ledger hook entirely).
     slo: dict[str, Any]
+    # overload: the goodput-max overload controller knobs
+    # (router/overload.py OverloadConfig — predictive SLO admission,
+    # degrade ladder, Retry-After shedding, unmeetable queue eviction;
+    # enabled: false (the default) is the kill-switch that keeps behavior
+    # bit-identical to the pre-overload router).
+    overload: dict[str, Any]
     tls_client: dict[str, Any]
     static_endpoints: list[EndpointMetadata]
     pool: EndpointPool
@@ -114,6 +121,7 @@ def load_raw_config(text: str | None) -> RawConfig:
         resilience=doc.get("resilience") or {},
         decisions=doc.get("decisions") or {},
         slo=doc.get("slo") or {},
+        overload=doc.get("overload") or {},
         tls_client=doc.get("tlsClient") or {},
         pool=doc.get("pool") or {},
         objectives=doc.get("objectives") or [],
@@ -278,6 +286,7 @@ def instantiate(raw: RawConfig, handle: Handle,
         resilience=raw.resilience,
         decisions=raw.decisions,
         slo=raw.slo,
+        overload=raw.overload,
         tls_client=raw.tls_client,
         static_endpoints=static_endpoints,
         pool=pool,

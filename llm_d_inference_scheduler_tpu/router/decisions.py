@@ -53,7 +53,8 @@ class DecisionRecord:
 
     __slots__ = ("request_id", "model", "target_model", "priority",
                  "_start", "_admission", "_producers",
-                 "_rounds", "_attempts", "_final", "_outcome", "top_k")
+                 "_rounds", "_attempts", "_final", "_outcome", "_shed",
+                 "top_k")
 
     # Container fields are lazily created (None until first write): a record
     # is opened on EVERY request, and five eager container allocations per
@@ -94,6 +95,7 @@ class DecisionRecord:
         self._attempts = None
         self._final = None
         self._outcome = None
+        self._shed = None
 
     @property
     def start_unix(self) -> float:
@@ -125,6 +127,10 @@ class DecisionRecord:
     def outcome(self) -> dict[str, Any]:
         return self._outcome if self._outcome is not None else self._EMPTY_DICT
 
+    @property
+    def shed(self) -> dict[str, Any]:
+        return self._shed if self._shed is not None else self._EMPTY_DICT
+
     # ---- layer hooks ----------------------------------------------------
 
     def record_rewrite(self, target_model: str) -> None:
@@ -135,12 +141,13 @@ class DecisionRecord:
                          priority_band: int | None = None,
                          queue_ms: float | None = None,
                          retried_after_shed: bool = False,
-                         reason: str | None = None) -> None:
+                         reason: str | None = None,
+                         shed_victims: list[str] | None = None) -> None:
         # Hot path (flow-control dispatch): one dict literal on the common
         # shape; rounding happens at render time (to_dict).
         if (flow_id is not None and priority_band is not None
                 and queue_ms is not None and not retried_after_shed
-                and not reason):
+                and not reason and not shed_victims):
             self._admission = {"mechanism": mechanism, "outcome": outcome,
                                "flow_id": flow_id,
                                "priority_band": priority_band,
@@ -155,6 +162,11 @@ class DecisionRecord:
             a["queue_ms"] = queue_ms
         if retried_after_shed:
             a["retried_after_shed"] = True
+        if shed_victims:
+            # The queued/in-flight requests sacrificed so THIS request's
+            # capacity-shed retry could be admitted (flowcontrol/
+            # admission.py) — /debug/decisions explains who was evicted.
+            a["shed_victims"] = list(shed_victims)
         if reason:
             a["reason"] = reason
         self._admission = a
@@ -250,6 +262,21 @@ class DecisionRecord:
         self._attempts.append({"rank": len(self._attempts),
                                "event": kind, **detail})
 
+    def record_shed(self, block: dict[str, Any], *,
+                    escalate: bool = False) -> None:
+        """Overload-control verdict (router/overload.py): predicted TTFT vs
+        SLO vs the queue-drain estimate, the ladder rung taken (degrade
+        actions or shed + Retry-After) — every shed/degrade decision is
+        explainable at /debug/decisions/<id>. ``escalate`` replaces an
+        earlier block (a degraded-then-admitted request later evicted from
+        the queue as unmeetable must explain the eviction, not the rung it
+        was admitted on), keeping the superseded block under ``prior``."""
+        if self._shed is None:
+            self._shed = block
+        elif escalate:
+            block["prior"] = self._shed
+            self._shed = block
+
     def record_outcome(self, outcome: dict[str, Any]) -> None:
         """SLO-ledger serving outcome (router/slo.py): predicted vs actual
         TTFT/TPOT vs SLO targets, slo_met verdict, miss reason, and (on the
@@ -285,6 +312,8 @@ class DecisionRecord:
             "final": self.final,
             "outcome": self.outcome,
         }
+        if self._shed is not None:
+            doc["shed"] = self._shed
         if compact:
             doc["summary"] = self.summary_line()
             return doc
@@ -350,6 +379,8 @@ class DecisionRecord:
             parts.append(f"admission={self.admission.get('outcome')}")
             if "queue_ms" in self.admission:
                 parts.append(f"queue_ms={self.admission['queue_ms']:.3f}")
+        if self._shed is not None:
+            parts.append(f"overload={self._shed.get('action')}")
         drops = []
         for rnd in list(self.rounds):
             for pname, sec in self._live_items(rnd["profiles"]):

@@ -10,6 +10,7 @@ from typing import Any
 
 from ..framework.datalayer import Endpoint
 from ..framework.scheduling import InferenceRequest
+from ..overload import HINT_ATTR
 from ..requestcontrol.admission import AdmissionError
 from .controller import FlowController
 from .types import FlowControlRequest, FlowKey, QueueOutcome
@@ -23,42 +24,64 @@ _OUTCOME_ERRORS = {
     QueueOutcome.EVICTED_TTL: (429, "queue wait exceeded TTL"),
     QueueOutcome.EVICTED_CONTEXT_CANCELLED: (499, "client cancelled while queued"),
     QueueOutcome.EVICTED_SHED: (429, "shed under saturation"),
+    QueueOutcome.EVICTED_UNMEETABLE: (
+        429, "shed in queue: remaining SLO budget below predicted service time"),
 }
 
 
 class FlowControlAdmissionController:
-    def __init__(self, controller: FlowController, evictor: Any = None):
+    def __init__(self, controller: FlowController, evictor: Any = None,
+                 overload: Any = None):
         self.controller = controller
         self.evictor = evictor
+        # OverloadController (router/overload.py) — None or disabled keeps
+        # every path here bit-identical to the pre-overload behavior.
+        self.overload = overload
+
+    def _make_item(self, request: InferenceRequest,
+                   flow_key: FlowKey) -> FlowControlRequest:
+        item = FlowControlRequest(
+            request_id=request.request_id,
+            flow_key=flow_key,
+            size_bytes=max(request.request_size_bytes, 1))
+        hint = getattr(request, HINT_ATTR, None)
+        if hint is not None:
+            # Overload stamp: marks the queued item eligible for
+            # predicted-unmeetable eviction (controller.py sweep).
+            item.slo_ttft_ms = hint.slo_ttft_ms
+            item.predicted_service_ms = hint.service_ttft_ms
+        return item
 
     async def admit(self, ctx: Any, request: InferenceRequest,
                     endpoints: list[Endpoint]) -> None:
         flow_id = request.headers.get(FAIRNESS_HEADER, DEFAULT_FLOW)
-        item = FlowControlRequest(
-            request_id=request.request_id,
-            flow_key=FlowKey(flow_id, request.objectives.priority),
-            size_bytes=max(request.request_size_bytes, 1),
-        )
+        flow_key = FlowKey(flow_id, request.objectives.priority)
+        item = self._make_item(request, flow_key)
         rec = request.decision  # decision flight recorder (may be None)
         obs = getattr(request, "outcome", None)  # SLO ledger (may be None)
         t0 = time.monotonic() if rec is not None or obs is not None else 0.0
         retried_after_shed = False
+        shed_victims: list[str] = []
         outcome = await self.controller.enqueue_and_wait(item)
         if (outcome == QueueOutcome.REJECTED_CAPACITY
                 and request.objectives.priority >= 0):
             # Make room: shed queued sheddable items (frees queue capacity for
             # the retry) and evict an in-flight sheddable request (frees
-            # backend capacity so the queue drains).
-            freed_queue_slot = self.controller.shed_queued(1) > 0
+            # backend capacity so the queue drains). The victims' request ids
+            # land in THIS request's admission record so /debug/decisions
+            # explains who was sacrificed and why.
+            queue_victims = self.controller.shed_queued(1)
             if self.evictor is not None:
-                self.evictor.evict_n(1)
-            if freed_queue_slot:
+                shed_victims = queue_victims + self.evictor.evict_n(1)
+            else:
+                shed_victims = queue_victims
+            if queue_victims:
+                # Retry only when a QUEUE slot was actually freed (an
+                # in-flight eviction frees backend capacity, not the queue
+                # capacity this rejection was about).
                 retried_after_shed = True
-                retry = FlowControlRequest(
-                    request_id=request.request_id,
-                    flow_key=item.flow_key,
-                    size_bytes=item.size_bytes)
-                outcome = await self.controller.enqueue_and_wait(retry)
+                item = self._make_item(request, flow_key)
+                outcome = await self.controller.enqueue_and_wait(item)
         if rec is not None or obs is not None:
             queue_ms = (time.monotonic() - t0) * 1e3
             if rec is not None:
@@ -66,11 +89,50 @@ class FlowControlAdmissionController:
                     "flow-control", outcome.value, flow_id=flow_id,
                     priority_band=request.objectives.priority,
                     queue_ms=queue_ms,
-                    retried_after_shed=retried_after_shed)
+                    retried_after_shed=retried_after_shed,
+                    shed_victims=shed_victims or None)
             if obs is not None:
                 # The SLO ledger's queue-time component: admission wait is
                 # part of the client-observed TTFT budget.
                 obs.queue_ms = queue_ms
         if outcome != QueueOutcome.DISPATCHED:
             code, reason = _OUTCOME_ERRORS.get(outcome, (429, outcome.value))
+            if (outcome == QueueOutcome.EVICTED_UNMEETABLE
+                    and self.overload is not None):
+                # In-queue shed: explain it like an admission-time shed —
+                # a shed block on the record (predicted vs SLO vs drain)
+                # plus a finite Retry-After, and the distinct ledger
+                # verdict.
+                overshoot = (item.predicted_service_ms
+                             + (time.monotonic() - item.enqueue_time) * 1e3
+                             - item.slo_ttft_ms)
+                retry_after = self.overload.retry_after_s(overshoot)
+                if rec is not None and hasattr(rec, "record_shed"):
+                    # escalate: a degraded-then-admitted request may already
+                    # carry its degrade block — the eviction supersedes it.
+                    rec.record_shed({
+                        "action": "evict_unmeetable",
+                        "predicted_ttft_ms": round(
+                            item.predicted_service_ms, 3),
+                        "slo_ttft_ms": item.slo_ttft_ms,
+                        "queue_wait_ms": round(
+                            (time.monotonic() - item.enqueue_time) * 1e3, 3),
+                        "drain_rate_rps": round(
+                            self.overload.drain.rate(), 3),
+                        "reason": "queue_unmeetable",
+                        "retry_after_s": retry_after,
+                    }, escalate=True)
+                raise AdmissionError(code, reason,
+                                     retry_after_s=retry_after, shed=True)
+            if (outcome == QueueOutcome.EVICTED_SHED
+                    and self.overload is not None):
+                # A capacity-shed victim is equally a deliberate control
+                # action that consumed no serving capacity: under overload
+                # control it gets the same distinct ledger verdict and a
+                # finite Retry-After as the other shed paths (with the
+                # kill-switch off, self.overload is None and the pre-PR
+                # "error" verdict is bit-identical).
+                raise AdmissionError(code, reason,
+                                     retry_after_s=self.overload.retry_after_s(),
+                                     shed=True)
             raise AdmissionError(code, reason)

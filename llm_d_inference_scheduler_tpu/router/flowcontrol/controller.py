@@ -38,11 +38,13 @@ from ..metrics import (
     FLOW_CONTROL_QUEUE_SIZE,
     SCHED_BATCH_SIZE,
 )
+from ..overload import DISABLED_QUEUE_POLICY
 from .policies import (
     FAIRNESS_POLICIES,
     ORDERING_POLICIES,
     FcfsOrdering,
     GlobalStrictFairness,
+    decayed_priority,
 )
 from .types import FlowControlRequest, FlowKey, QueueOutcome
 
@@ -99,10 +101,14 @@ class _Shard:
     loop context (+ synchronous enqueue on the same event loop)."""
 
     def __init__(self, idx: int, cfg: FlowControlConfig,
-                 saturation_fn: Callable[[], float]):
+                 saturation_fn: Callable[[], float], owner: Any = None):
         self.idx = idx
         self.cfg = cfg
         self.saturation_fn = saturation_fn
+        # The FlowController: read live for the overload coupling
+        # (queue_policy + dispatch_observer land after construction via
+        # OverloadController.attach_flow).
+        self.owner = owner
         self.fairness = FAIRNESS_POLICIES[cfg.fairness]()
         self._ordering = ORDERING_POLICIES[cfg.ordering]()
         self.queues: dict[FlowKey, Any] = {}
@@ -153,24 +159,57 @@ class _Shard:
             self.total_bytes -= item.size_bytes
         item.resolve(outcome)
 
-    def shed_queued(self, n: int) -> int:
-        """Evict up to n queued sheddable items (priority < 0), lowest priority
-        first — frees queue capacity for higher-priority arrivals."""
-        shed = 0
+    def shed_queued(self, n: int) -> list[str]:
+        """Evict up to n queued sheddable items (priority < 0), lowest
+        priority first — frees queue capacity for higher-priority arrivals.
+        Returns the victims' request ids so the beneficiary's
+        DecisionRecord can explain who was sacrificed.
+
+        With overload control active (queue_policy.decay_per_s > 0) victim
+        selection uses the AGE-DECAYED effective priority: a long-waiting
+        sheddable item loses its slot to fresh feasible work even from a
+        nominally lower band."""
+        pol = (self.owner.queue_policy if self.owner is not None
+               else DISABLED_QUEUE_POLICY)
+        victims: list[str] = []
+        if pol.decay_per_s > 0:
+            now = time.monotonic()
+            while len(victims) < n:
+                best_key = best_score = None
+                for key, q in self.queues.items():
+                    if key.priority >= 0:
+                        continue
+                    head = q.peek()
+                    if head is None:
+                        continue
+                    score = decayed_priority(key.priority, head.enqueue_time,
+                                             now, pol.decay_per_s)
+                    if best_score is None or score < best_score:
+                        best_key, best_score = key, score
+                if best_key is None:
+                    break
+                item = self.queues[best_key].pop()
+                if item is None:
+                    continue
+                self.total_requests -= 1
+                self.total_bytes -= item.size_bytes
+                item.resolve(QueueOutcome.EVICTED_SHED)
+                victims.append(item.request_id)
+            return victims
         for key in sorted((k for k in self.queues if k.priority < 0),
                           key=lambda k: k.priority):
             q = self.queues[key]
-            while shed < n:
+            while len(victims) < n:
                 item = q.pop()
                 if item is None:
                     break
                 self.total_requests -= 1
                 self.total_bytes -= item.size_bytes
                 item.resolve(QueueOutcome.EVICTED_SHED)
-                shed += 1
-            if shed >= n:
+                victims.append(item.request_id)
+            if len(victims) >= n:
                 break
-        return shed
+        return victims
 
     # ---- dispatch loop ----
 
@@ -237,6 +276,12 @@ class _Shard:
                     dispatched += 1
                 if dispatched:
                     SCHED_BATCH_SIZE.observe(dispatched)
+                    obs = (self.owner.dispatch_observer
+                           if self.owner is not None else None)
+                    if obs is not None:
+                        # Overload drain-rate estimator (router/overload.py):
+                        # one call per wake, not per item.
+                        obs(dispatched)
                 await asyncio.sleep(0)  # yield so dispatched work can start
         except asyncio.CancelledError:
             for q in self.queues.values():
@@ -271,15 +316,29 @@ class _Shard:
         if now - self._last_sweep < SWEEP_INTERVAL_S:
             return
         self._last_sweep = now
+        pol = (self.owner.queue_policy if self.owner is not None
+               else DISABLED_QUEUE_POLICY)
         for key in list(self.queues):
             q = self.queues[key]
-            expired = [it for it in q.items()
-                       if it.deadline is not None and it.deadline < now]
-            for item in expired:
+            expired: list[tuple[FlowControlRequest, QueueOutcome]] = []
+            for it in q.items():
+                if it.deadline is not None and it.deadline < now:
+                    expired.append((it, QueueOutcome.EVICTED_TTL))
+                elif (pol.eviction_enabled and it.slo_ttft_ms > 0
+                      and (now - it.enqueue_time) * 1e3
+                      + it.predicted_service_ms > it.slo_ttft_ms):
+                    # Predicted-unmeetable (router/overload.py): the
+                    # remaining SLO budget is smaller than the predicted
+                    # service time — evict BEFORE the TTL fires, freeing
+                    # the slot for meetable work.
+                    expired.append((it, QueueOutcome.EVICTED_UNMEETABLE))
+            for item, outcome in expired:
                 if q.remove(item):
                     self.total_requests -= 1
                     self.total_bytes -= item.size_bytes
-                    item.resolve(QueueOutcome.EVICTED_TTL)
+                    item.resolve(outcome)
+                    if outcome is QueueOutcome.EVICTED_UNMEETABLE:
+                        pol.note_unmeetable()
         self._gc_idle_flows()
 
     def _gc_idle_flows(self):
@@ -297,7 +356,14 @@ class FlowController:
     def __init__(self, cfg: FlowControlConfig,
                  saturation_fn: Callable[[], float]):
         self.cfg = cfg
-        self.shards = [_Shard(i, cfg, saturation_fn) for i in range(cfg.shards)]
+        # Overload coupling (router/overload.py OverloadController
+        # .attach_flow): drain-rate observer + queue policy (unmeetable
+        # eviction, priority decay). The disabled defaults keep the shard
+        # hot path at one attribute check and pre-overload semantics.
+        self.dispatch_observer: Callable[[int], None] | None = None
+        self.queue_policy = DISABLED_QUEUE_POLICY
+        self.shards = [_Shard(i, cfg, saturation_fn, owner=self)
+                       for i in range(cfg.shards)]
         self._started = False
 
     async def start(self):
@@ -318,14 +384,15 @@ class FlowController:
     def queued_requests(self) -> int:
         return sum(s.total_requests for s in self.shards)
 
-    def shed_queued(self, n: int) -> int:
-        """Shed up to n queued sheddable items across shards."""
-        shed = 0
+    def shed_queued(self, n: int) -> list[str]:
+        """Shed up to n queued sheddable items across shards; returns the
+        victims' request ids."""
+        victims: list[str] = []
         for s in self.shards:
-            if shed >= n:
+            if len(victims) >= n:
                 break
-            shed += s.shed_queued(n - shed)
-        return shed
+            victims.extend(s.shed_queued(n - len(victims)))
+        return victims
 
     def notify_capacity(self) -> None:
         """Wake saturated shards: backend capacity has (likely) freed."""

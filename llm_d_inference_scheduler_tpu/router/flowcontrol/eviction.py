@@ -68,15 +68,17 @@ class RequestEvictor:
     def inflight_count(self) -> int:
         return len(self._inflight)
 
-    def evict_n(self, n: int) -> int:
+    def evict_n(self, n: int) -> list[str]:
         """Cancel up to n sheddable in-flight requests (lowest priority first,
         oldest first within a priority — the reference's
         priority-then-time-eviction-order-policy + sheddable-eviction-filter).
+        Returns the evicted request ids so the beneficiary's DecisionRecord
+        can name its victims.
         """
         sheddable = sorted(
             ((k, r) for k, r in self._inflight.items() if r.priority < 0),
             key=lambda kv: (kv[1].priority, kv[1].start_time))
-        evicted = 0
+        evicted: list[str] = []
         for key, rec in sheddable[:n]:
             self._evicted.add(key)
             self._inflight.pop(key, None)
@@ -86,7 +88,7 @@ class RequestEvictor:
                 log.exception("evict cancel failed for %s", rec.request_id)
                 continue
             EVICTIONS_TOTAL.inc()
-            evicted += 1
+            evicted.append(rec.request_id)
             log.info("evicted in-flight request %s (priority %d)",
                      rec.request_id, rec.priority)
         return evicted

@@ -93,3 +93,12 @@ class RoundRobinFairness:
 
 
 FAIRNESS_POLICIES = {p.NAME: p for p in (GlobalStrictFairness, RoundRobinFairness)}
+
+
+def decayed_priority(priority: int, enqueue_time: float, now: float,
+                     decay_per_s: float) -> float:
+    """Age-decayed effective priority for overload victim selection
+    (router/overload.py): a queued sheddable item loses ``decay_per_s``
+    bands per second of queue age, so long-waiting work ranks below fresh
+    feasible work when the shed path picks a victim. Lower = shed first."""
+    return priority - decay_per_s * max(now - enqueue_time, 0.0)

@@ -27,6 +27,10 @@ class QueueOutcome(str, enum.Enum):
     EVICTED_TTL = "evicted_ttl"
     EVICTED_CONTEXT_CANCELLED = "evicted_context_cancelled"
     EVICTED_SHED = "evicted_shed"
+    # Overload control (router/overload.py): the item's remaining SLO
+    # budget fell below its predicted service time while queued — evicted
+    # before the TTL fires so its slot goes to meetable work.
+    EVICTED_UNMEETABLE = "evicted_unmeetable"
 
 
 @dataclasses.dataclass
@@ -40,6 +44,13 @@ class FlowControlRequest:
     enqueue_time: float = dataclasses.field(default_factory=time.monotonic)
     future: asyncio.Future | None = None
     context: Any = None  # carries cancellation (e.g. client connection)
+    # Overload-control stamp (flowcontrol/admission.py, from the director's
+    # OverloadAssessment): slo_ttft_ms > 0 marks the item eligible for
+    # predicted-unmeetable eviction — evict once
+    # waited + predicted_service_ms > slo_ttft_ms. 0 = exempt (the
+    # pre-overload default, and every item while the kill-switch is off).
+    slo_ttft_ms: float = 0.0
+    predicted_service_ms: float = 0.0
 
     def resolve(self, outcome: QueueOutcome) -> None:
         if self.future is not None and not self.future.done():

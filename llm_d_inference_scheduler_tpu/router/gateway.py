@@ -69,6 +69,7 @@ from .requestcontrol.director import (
     H_REQUEST_ID,
     RequestError,
 )
+from .overload import OverloadConfig, OverloadController
 from .schedpool import LoopLagMonitor, SchedulerPool, SchedulingConfig
 from .slo import SloConfig, SloLedger, finite_float_or_none
 from .datalayer.data_graph import validate_and_order_producers
@@ -141,6 +142,22 @@ class Gateway:
         # the per-chunk hook from the streaming path entirely.
         self.slo_ledger = SloLedger(SloConfig.from_spec(cfg.slo))
 
+        # Goodput-max overload controller (router/overload.py): predictive
+        # SLO admission, degrade ladder, Retry-After shedding. Disabled by
+        # default (`overload: {enabled: true}` opts in); the predictor is
+        # the predicted-latency producer when one is configured.
+        producers = validate_and_order_producers(cfg.producers)
+        self.overload = OverloadController(
+            OverloadConfig.from_spec(cfg.overload),
+            ledger=self.slo_ledger,
+            predictor=next((p for p in producers
+                            if hasattr(p, "admission_estimate")), None))
+        if self.overload.enabled:
+            # Little's-law backlog: the in-flight counter sees the queue a
+            # new arrival actually stands behind (flow queue + scheduled +
+            # streaming), before engine scrapes or saturation ever move.
+            self.overload.inflight_fn = lambda: self._inflight
+
         # Outbound TLS verification policy for router-side client legs
         # (upstream proxy, /debug/traces + /v1/models fan-out). Default:
         # skip-verify (in-cluster pod-local certs); `tlsClient.caCertPath`
@@ -180,8 +197,14 @@ class Gateway:
                 fc_cfg,
                 saturation_fn=lambda: self.detector.saturation(
                     self.datastore.endpoint_list()))
-            admission = FlowControlAdmissionController(self.flow_controller,
-                                                       evictor=self.evictor)
+            admission = FlowControlAdmissionController(
+                self.flow_controller, evictor=self.evictor,
+                overload=self.overload if self.overload.enabled else None)
+            if self.overload.enabled:
+                # Queue depth + measured drain rate feed the feasibility
+                # estimate; the queues gain unmeetable eviction + priority
+                # decay (all gated on the same kill-switch).
+                self.overload.attach_flow(self.flow_controller)
         else:
             from .requestcontrol.admission import LegacyAdmissionController
 
@@ -204,7 +227,6 @@ class Gateway:
                 self.sched_pool.cfg.max_batch)
         self.loop_lag = LoopLagMonitor()
 
-        producers = validate_and_order_producers(cfg.producers)
         self.director = Director(
             datastore, cfg.scheduler, admission=admission,
             producers=producers,
@@ -214,7 +236,8 @@ class Gateway:
             response_streaming=cfg.response_streaming,
             response_complete=cfg.response_complete,
             recorder=self.decision_recorder,
-            sched_pool=self.sched_pool)
+            sched_pool=self.sched_pool,
+            overload=self.overload if self.overload.enabled else None)
 
         self.app = web.Application()
         self.app.add_routes([
@@ -549,12 +572,20 @@ class Gateway:
             # Director error finalization (no endpoints, admission shed,
             # admit-plugin reject, scheduling failure): the ledger records
             # slo_met=false with the reason — an absent field would
-            # overcount attainment.
-            self.slo_ledger.complete(ireq, status=e.code, reason=e.reason)
-            return web.json_response(
-                {"error": e.reason}, status=e.code,
-                headers={X_REMOVAL_REASON: e.reason,
-                         **self._decision_headers(ireq)})
+            # overcount attainment. Overload sheds are the distinct ledger
+            # verdict and carry a finite computed Retry-After header.
+            shed = getattr(e, "shed", False)
+            retry_after = getattr(e, "retry_after_s", None)
+            self.slo_ledger.complete(ireq, status=e.code, reason=e.reason,
+                                     shed=shed)
+            body: dict[str, Any] = {"error": e.reason}
+            headers = {X_REMOVAL_REASON: e.reason,
+                       **self._decision_headers(ireq)}
+            if retry_after is not None:
+                # HTTP delta-seconds is an integer; never hand out 0.
+                headers["Retry-After"] = str(max(int(round(retry_after)), 1))
+                body["retry_after_s"] = retry_after
+            return web.json_response(body, status=e.code, headers=headers)
 
         # Repackage through the parser (director.go:289-306) only when the
         # bytes must change: model rewrite, or a translating (non-OpenAI)
@@ -563,6 +594,11 @@ class Gateway:
         payload = ireq.body.payload
         needs_repackage = (payload is not None
                            and (ireq.target_model != original_model
+                                # Degrade ladder (router/overload.py): the
+                                # controller mutated the payload (e.g.
+                                # max_tokens clamp) — the raw client bytes
+                                # no longer match what must be served.
+                                or getattr(ireq, "degraded", False)
                                 or self.parser.typed_name().type
                                 not in ("openai-parser", "passthrough-parser")))
         if needs_repackage:
@@ -1001,6 +1037,17 @@ class Gateway:
                 self.slo_ledger.complete(ireq, status=resp.status,
                                          endpoint=endpoint, usage=usage,
                                          transfer=transfer)
+                if (self.overload.enabled and resp.status < 400
+                        and (obs is None or obs.abort_reason is None)):
+                    # Served-outcome feedback for the overload controller:
+                    # the healthy-e2e Little's-law anchor plus the
+                    # observed-vs-predicted TTFT bias corrector. Aborted /
+                    # evicted streams are excluded — their truncated e2e
+                    # would drag the healthy anchor down and make the
+                    # controller shed MORE exactly when eviction pressure
+                    # is highest (a self-reinforcing loop).
+                    self.overload.note_served(
+                        ireq, (time.monotonic() - t_start) * 1e3)
                 REQUEST_DURATION.labels(model_label).observe(time.monotonic() - t_start)
                 if usage.get("prompt_tokens"):
                     INPUT_TOKENS.labels(model_label).observe(usage["prompt_tokens"])

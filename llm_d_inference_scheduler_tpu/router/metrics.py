@@ -187,3 +187,26 @@ KV_TRANSFER_MS = Histogram(
     "through the sidecar (per-pair EWMA table at /debug/transfers)",
     registry=REGISTRY,
     buckets=(1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000, 2500))
+# Goodput-max overload control (router/overload.py): predictive SLO
+# admission, degrade ladder, Retry-After shedding, and predicted-unmeetable
+# queue eviction. Reason/action label sets are fixed small enums.
+ADMISSION_SHED_TOTAL = Counter(
+    "router_admission_shed_total",
+    "Requests shed by the overload controller before capacity was spent "
+    "(reason: predicted_ttft_miss | predicted_tpot_miss | queue_unmeetable)",
+    ("reason",), registry=REGISTRY)
+DEGRADED_REQUESTS_TOTAL = Counter(
+    "router_degraded_requests_total",
+    "Requests admitted via the degrade ladder instead of being shed "
+    "(action: clamp_max_tokens | model_rewrite)",
+    ("action",), registry=REGISTRY)
+RETRY_AFTER_SECONDS = Histogram(
+    "router_retry_after_seconds",
+    "Computed Retry-After handed to shed requests (derived from the queue "
+    "drain rate; always finite)",
+    registry=REGISTRY, buckets=(1, 2, 5, 10, 15, 30, 60))
+QUEUE_DRAIN_RATE = Gauge(
+    "router_queue_drain_rate",
+    "Measured flow-control dispatch rate (requests/second, EWMA) feeding "
+    "the overload controller's queue-wait and Retry-After estimates",
+    registry=REGISTRY)

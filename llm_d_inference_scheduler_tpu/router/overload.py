@@ -1,0 +1,582 @@
+"""Goodput-max overload control: predictive SLO admission, a degrade
+ladder, and Retry-After shedding.
+
+PR 6's ledger measured the failure this module fixes: under a 2x/4x
+overload ramp raw throughput holds while goodput collapses toward zero and
+the wasted-token fraction hits 1.0 (benchmarks/SLO_OBS.json) — the router
+admits work that will blow its deadline, burns tokens on it, then kills it
+mid-stream. P/D-Serve's gateway (arXiv:2408.08147) closes this loop at
+*admission*: every token generated should be a token delivered inside SLO.
+
+``OverloadController.assess`` runs in the director BEFORE the flow-control
+enqueue and estimates time-to-first-token *if admitted now*:
+
+    predicted TTFT = queue wait (queued / measured drain rate)
+                   + best per-endpoint ridge prediction
+                     (requestcontrol/predicted_latency.py, calibrated by
+                      the PR 6 ledger)
+
+On a predicted SLO miss it walks a configurable degrade ladder:
+
+1. **degrade** — clamp ``max_tokens`` and/or rewrite to a configured
+   cheaper model variant (the director's rewrite hook), then admit;
+2. **shed** — fast-fail with 429 and a computed ``Retry-After`` derived
+   from the queue drain rate, before any capacity is spent.
+
+The flow-control queues get two overload-aware behaviors (gated on the
+same kill-switch): **predicted-unmeetable eviction** (a queued item whose
+remaining SLO budget is smaller than its predicted service time is evicted
+before its TTL fires, freeing capacity for meetable work) and
+**priority decay** (a long-waiting sheddable item's effective priority
+decays with queue age, so it loses its victim-selection slot to fresh
+feasible work).
+
+Every shed/degrade decision is explainable: the DecisionRecord gains a
+``shed`` block (predicted TTFT vs SLO vs drain estimate —
+``/debug/decisions/<id>``), the SLO ledger stamps the distinct ``shed``
+verdict (router/slo.py — a shed is not an SLO miss), and the new metric
+families (``router_admission_shed_total{reason}``,
+``router_degraded_requests_total{action}``, ``router_retry_after_seconds``,
+``router_queue_drain_rate``) make the control loop graphable.
+
+``overload: {enabled: false}`` (the default) is the kill-switch: every
+hook degrades to one attribute check and behavior is bit-identical to the
+pre-overload router.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import Any, Callable
+
+from .metrics import (
+    ADMISSION_SHED_TOTAL,
+    DEGRADED_REQUESTS_TOTAL,
+    QUEUE_DRAIN_RATE,
+    RETRY_AFTER_SECONDS,
+)
+
+# Machine-readable shed reasons (the {reason} label on
+# router_admission_shed_total — bounded cardinality).
+REASON_TTFT = "predicted_ttft_miss"
+REASON_TPOT = "predicted_tpot_miss"
+REASON_QUEUE = "queue_unmeetable"
+
+SHED_REASON = "overload-shed"  # x-removal-reason for admission-time sheds
+
+
+@dataclasses.dataclass
+class OverloadConfig:
+    """The YAML ``overload:`` section. ``enabled: false`` (default) is the
+    kill-switch: assess() returns None, the flow-control queues keep their
+    pre-overload semantics, and the ledger never sees a shed verdict."""
+
+    enabled: bool = False
+    # Priority bands STRICTLY ABOVE this are exempt from overload control
+    # (premium tiers are never predictively shed; the existing sheddable
+    # semantics — priority < 0 — stay untouched below it).
+    max_priority: int = 0
+    # Feasibility slack: predicted <= SLO * headroom_factor admits. < 1
+    # sheds early (reserve headroom), > 1 tolerates predicted overshoot.
+    headroom_factor: float = 1.0
+    # Degrade ladder step 1: 0 / "" disables each action.
+    degrade_max_tokens: int = 0
+    degrade_model: str = ""
+    # Degrade-and-admit while predicted TTFT <= SLO * degrade_admit_ratio;
+    # beyond that the request sheds even when degrade actions exist (a
+    # degraded request that still misses its SLO is pure wasted work).
+    degrade_admit_ratio: float = 1.5
+    # Retry-After bounds (seconds; the header must be finite).
+    retry_after_min_s: float = 1.0
+    retry_after_max_s: float = 30.0
+    # Flow-control queue behaviors.
+    queue_eviction: bool = True
+    # Effective-priority decay for shed victim selection, in priority bands
+    # per second of queue age.
+    priority_decay_per_s: float = 0.1
+
+    @classmethod
+    def from_spec(cls, spec: dict[str, Any] | None) -> "OverloadConfig":
+        spec = spec or {}
+        degrade = spec.get("degrade") or {}
+        cfg = cls(
+            enabled=bool(spec.get("enabled", False)),
+            max_priority=int(spec.get("maxPriority", 0)),
+            headroom_factor=float(spec.get("headroomFactor", 1.0)),
+            degrade_max_tokens=int(degrade.get("maxTokensClamp", 0)),
+            degrade_model=str(degrade.get("modelRewrite", "") or ""),
+            degrade_admit_ratio=float(degrade.get("admitRatio", 1.5)),
+            retry_after_min_s=float(spec.get("retryAfterMinS", 1.0)),
+            retry_after_max_s=float(spec.get("retryAfterMaxS", 30.0)),
+            queue_eviction=bool(spec.get("queueEviction", True)),
+            priority_decay_per_s=float(spec.get("priorityDecayPerS", 0.1)),
+        )
+        if cfg.headroom_factor <= 0:
+            raise ValueError("overload.headroomFactor must be > 0")
+        if cfg.degrade_admit_ratio < 1.0:
+            raise ValueError("overload.degrade.admitRatio must be >= 1")
+        if not (0 < cfg.retry_after_min_s <= cfg.retry_after_max_s):
+            raise ValueError("overload: retryAfterMinS/MaxS must satisfy "
+                             "0 < min <= max")
+        return cfg
+
+
+class DrainRateEstimator:
+    """Measured queue drain rate (dispatches/second), EWMA over 1 s windows.
+
+    ``note(n)`` is called from the flow-control dispatch loop (one call per
+    shard wake, not per item); ``rate()`` folds in the decay of elapsed
+    empty windows, so a stalled queue's estimate falls toward zero instead
+    of reporting the last busy second forever."""
+
+    WINDOW_S = 1.0
+
+    def __init__(self, halflife_s: float = 5.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self._clock = clock
+        # Per-window EWMA coefficient from the half-life.
+        self._alpha = 1.0 - 0.5 ** (self.WINDOW_S / max(halflife_s, 1e-3))
+        self._window_start = clock()
+        self._window_count = 0
+        self._rate = 0.0
+        self.total = 0  # lifetime dispatches (cold-start detection)
+
+    def _roll(self, now: float) -> None:
+        elapsed = now - self._window_start
+        if elapsed < self.WINDOW_S:
+            return
+        windows = int(elapsed / self.WINDOW_S)
+        # First elapsed window carries the accumulated count …
+        self._rate += self._alpha * (self._window_count / self.WINDOW_S
+                                     - self._rate)
+        self._window_count = 0
+        # … the rest were empty. Cap the loop: past ~20 half-lives the
+        # EWMA is zero to double precision anyway.
+        for _ in range(min(windows - 1, 128)):
+            self._rate -= self._alpha * self._rate
+        self._window_start += windows * self.WINDOW_S
+
+    def note(self, n: int = 1) -> None:
+        self._roll(self._clock())
+        self._window_count += n
+        self.total += n
+
+    def rate(self) -> float:
+        """Dispatches/second; blends the EWMA with the live window so a
+        fresh burst registers before its window closes."""
+        now = self._clock()
+        self._roll(now)
+        if not self._window_count:
+            return self._rate
+        open_s = max(now - self._window_start, 1e-6)
+        live = self._window_count / max(open_s, 0.25)
+        return max(self._rate, live)
+
+
+class QueueOverloadPolicy:
+    """The slice of overload state the flow-control shards read: whether
+    predicted-unmeetable eviction runs in the TTL sweep, and the
+    priority-decay rate for shed victim selection. A disabled singleton is
+    the default so the shard hot path stays one attribute check."""
+
+    __slots__ = ("eviction_enabled", "decay_per_s")
+
+    def __init__(self, eviction_enabled: bool = False,
+                 decay_per_s: float = 0.0):
+        self.eviction_enabled = eviction_enabled
+        self.decay_per_s = decay_per_s
+
+    def note_unmeetable(self, n: int = 1) -> None:
+        ADMISSION_SHED_TOTAL.labels(REASON_QUEUE).inc(n)
+
+
+DISABLED_QUEUE_POLICY = QueueOverloadPolicy()
+
+
+@dataclasses.dataclass
+class OverloadAssessment:
+    """One admission-time feasibility verdict. ``action`` is the rung of
+    the degrade ladder taken: "admit" (feasible), "degrade" (ladder step
+    1, then admit), or "shed" (ladder step 2: 429 + Retry-After)."""
+
+    action: str
+    reason: str = ""                 # machine reason (metric label)
+    detail: str = ""                 # human reason (error body / record)
+    predicted_ttft_ms: float = 0.0   # queue wait + service estimate + bias
+    service_ttft_ms: float = 0.0     # best per-endpoint ridge prediction
+    queue_wait_ms: float = 0.0
+    bias_ms: float = 0.0             # observed-vs-predicted corrector
+    drain_rate_rps: float = 0.0
+    slo_ttft_ms: float = 0.0
+    predicted_tpot_ms: float | None = None
+    slo_tpot_ms: float = 0.0
+    retry_after_s: float | None = None
+    degrade_actions: tuple[str, ...] = ()
+
+    def block(self) -> dict[str, Any]:
+        """The DecisionRecord ``shed`` block: predicted TTFT vs SLO vs the
+        drain estimate — every shed/degrade explainable at
+        /debug/decisions."""
+        b: dict[str, Any] = {
+            "action": self.action,
+            "predicted_ttft_ms": round(self.predicted_ttft_ms, 3),
+            "service_ttft_ms": round(self.service_ttft_ms, 3),
+            "queue_wait_ms": round(self.queue_wait_ms, 3),
+            "drain_rate_rps": round(self.drain_rate_rps, 3),
+            "slo_ttft_ms": self.slo_ttft_ms,
+        }
+        if self.bias_ms:
+            b["bias_ms"] = round(self.bias_ms, 3)
+        if self.slo_tpot_ms > 0:
+            b["slo_tpot_ms"] = self.slo_tpot_ms
+        if self.predicted_tpot_ms is not None:
+            b["predicted_tpot_ms"] = round(self.predicted_tpot_ms, 3)
+        if self.reason:
+            b["reason"] = self.reason
+        if self.retry_after_s is not None:
+            b["retry_after_s"] = self.retry_after_s
+        if self.degrade_actions:
+            b["degrade_actions"] = list(self.degrade_actions)
+        return b
+
+
+# Stamped onto the InferenceRequest so the flow-control admission can carry
+# the feasibility estimate into the queued item (unmeetable eviction needs
+# predicted service time + SLO budget per item).
+HINT_ATTR = "_overload_hint"
+
+
+@dataclasses.dataclass
+class OverloadHint:
+    service_ttft_ms: float
+    slo_ttft_ms: float
+    # Total admission-time prediction (queue wait + service + bias): the
+    # served outcome is compared against THIS to train the bias corrector.
+    predicted_ttft_ms: float = 0.0
+
+
+class OverloadController:
+    """Admission-time feasibility check + degrade ladder + Retry-After.
+
+    Lives on the gateway; the director calls ``assess`` before the
+    flow-control enqueue, the flow controller feeds ``note_dispatch`` and
+    reads ``queue_policy``, and the flow-control admission asks
+    ``retry_after_s`` when a queued item is evicted as unmeetable."""
+
+    # Healthy-e2e EWMA coefficient (note_completion).
+    E2E_ALPHA = 0.1
+    # Observed-vs-predicted TTFT bias EWMA coefficients (note_served).
+    # Asymmetric by design: under-prediction (the overload tax) folds in
+    # fast — every completion that ran slower than predicted means the
+    # admissions made in the pipeline's blind window are already too
+    # optimistic — while relief decays slowly, so one lucky completion
+    # can't reopen the gate mid-overload. Shedding a feasible request
+    # costs one 429 + Retry-After; admitting an infeasible one costs its
+    # whole token budget.
+    BIAS_ALPHA_UP = 0.4
+    BIAS_ALPHA_DOWN = 0.05
+    # Wall-clock bias half-life: completion-driven decay alone can latch
+    # the controller shut — shed everything and no completion ever arrives
+    # to relax the bias that is causing the shedding. Time decay is the
+    # probe valve: after a few seconds of silence admissions trickle again
+    # and re-measure reality.
+    BIAS_HALFLIFE_S = 3.0
+
+    def __init__(self, cfg: OverloadConfig, *, ledger: Any = None,
+                 predictor: Any = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.cfg = cfg
+        self.ledger = ledger          # SloLedger (resolve_targets)
+        self.predictor = predictor    # PredictedLatencyProducer (or None)
+        self.drain = DrainRateEstimator(clock=clock)
+        self.flow = None              # FlowController (queue depth), optional
+        # Gateway in-flight counter (requests between arrival and terminal
+        # response — queued, scheduled, and streaming alike). With it the
+        # wait estimate sees the backlog that lives INSIDE the gateway and
+        # engines before flow-control saturation ever gates: Little's law
+        # says a healthy pipeline holds ~drain_rate x healthy_e2e requests,
+        # and everything beyond that is queueing ahead of a new arrival.
+        self.inflight_fn: Callable[[], int] | None = None
+        self._e2e_ewma_ms: float | None = None
+        # Signed EWMA of (actual - predicted) TTFT over served requests:
+        # the overload tax the ridge never saw (loop contention, connection
+        # handling under flood) shows up here and folds back into the next
+        # admission decision — the same predict→observe loop the PR 6
+        # ledger closed for calibration, closed for CONTROL.
+        self._bias_ms: float | None = None
+        self._bias_at: float = 0.0  # last update (wall-clock decay anchor)
+        self._clock = clock
+        self.queue_policy = (QueueOverloadPolicy(
+            eviction_enabled=cfg.queue_eviction,
+            decay_per_s=max(cfg.priority_decay_per_s, 0.0))
+            if cfg.enabled else DISABLED_QUEUE_POLICY)
+
+    @property
+    def enabled(self) -> bool:
+        return self.cfg.enabled
+
+    # ---- flow-control coupling -----------------------------------------
+
+    def attach_flow(self, flow: Any) -> None:
+        """Wire the flow controller: its queue depth feeds the wait
+        estimate, its dispatch loop feeds the drain estimator, and its
+        shards read the queue policy (unmeetable eviction, priority
+        decay)."""
+        self.flow = flow
+        flow.dispatch_observer = self.note_dispatch
+        flow.queue_policy = self.queue_policy
+
+    def note_dispatch(self, n: int = 1) -> None:
+        self.drain.note(n)
+
+    def note_completion(self, e2e_ms: float) -> None:
+        """Healthy-pipeline e2e EWMA, fed by the gateway on every served
+        (sub-400) response — the Little's-law anchor for how many in-flight
+        requests the stack holds when it is meeting its latency."""
+        prev = self._e2e_ewma_ms
+        self._e2e_ewma_ms = (e2e_ms if prev is None
+                             else prev + self.E2E_ALPHA * (e2e_ms - prev))
+
+    def note_served(self, request: Any, e2e_ms: float) -> None:
+        """Terminal feedback for a served response: feeds the healthy-e2e
+        anchor always, and — when the request carried an admission-time
+        assessment — the observed-vs-predicted TTFT bias corrector."""
+        self.note_completion(e2e_ms)
+        if request is None:
+            return
+        hint = getattr(request, HINT_ATTR, None)
+        if hint is None or hint.predicted_ttft_ms <= 0:
+            return
+        obs = getattr(request, "outcome", None)
+        if obs is not None and obs.first_token_at is not None:
+            actual = (obs.first_token_at - obs.t_start) * 1e3
+        else:
+            # Non-streamed (or ledger off): e2e is the first byte.
+            actual = e2e_ms
+        err = actual - hint.predicted_ttft_ms
+        prev = self._decayed_bias()
+        if prev is None:
+            self._bias_ms = err
+        else:
+            alpha = (self.BIAS_ALPHA_UP if err > prev
+                     else self.BIAS_ALPHA_DOWN)
+            self._bias_ms = prev + alpha * (err - prev)
+        self._bias_at = self._clock()
+
+    def _decayed_bias(self) -> float | None:
+        """The bias corrector with its wall-clock half-life applied."""
+        if self._bias_ms is None:
+            return None
+        dt = self._clock() - self._bias_at
+        if dt <= 0:
+            return self._bias_ms
+        return self._bias_ms * 0.5 ** (dt / self.BIAS_HALFLIFE_S)
+
+    # ---- feasibility ----------------------------------------------------
+
+    # Below this drain rate (req/s) the estimator carries no usable signal
+    # — dividing a backlog by a decayed-to-nothing EWMA would report hours
+    # of wait on an idle router.
+    DRAIN_RATE_FLOOR = 0.05
+
+    def _queue_wait_ms(self, slo_ttft_ms: float) -> tuple[float, float]:
+        """(estimated wait for a new arrival, drain rate).
+
+        Backlog = the gateway's in-flight count when wired (it includes the
+        flow-control queue, scheduled work, and live streams — the queue a
+        new arrival actually stands behind), else the flow queue alone.
+        The request being assessed is itself already counted in-flight, so
+        one is subtracted. The healthy pipeline population
+        drain_rate x e2e_ewma rides for free (Little's law); only the
+        EXCESS above it is queueing delay. The e2e anchor is clamped to 2x
+        the SLO so a degraded pipeline (long e2e BECAUSE it is overloaded)
+        can't talk the estimate into admitting more.
+
+        Fail-open: before the estimator has ever seen a dispatch, with no
+        backlog, or once the drain EWMA has decayed below the signal floor
+        (an idle router), the wait is 0 — unless explicit flow-queue items
+        are waiting with no drain at all, which is a stalled pipeline and
+        reports one full Retry-After window."""
+        rate = self.drain.rate()
+        QUEUE_DRAIN_RATE.set(rate)
+        queued = self.flow.queued_requests if self.flow is not None else 0
+        if self.inflight_fn is not None:
+            # Queued requests are in-flight too; -1 excludes the request
+            # being assessed (the gateway counted it on arrival).
+            backlog = max(self.inflight_fn() - 1, 0)
+        else:
+            backlog = queued
+        if backlog <= 0 or self.drain.total == 0:
+            return 0.0, rate
+        if rate <= self.DRAIN_RATE_FLOOR:
+            # No usable drain signal. Explicitly queued work with no drain
+            # is a stalled pipeline — effectively unbounded wait; a backlog
+            # of live streams on an idle-decayed estimator is not evidence
+            # of queueing, so fail open (the ridge + bias still protect).
+            return (self.cfg.retry_after_max_s * 1e3 if queued > 0 else 0.0,
+                    rate)
+        e2e = self._e2e_ewma_ms
+        if e2e is None:
+            # No completion observed yet: assume the in-flight population
+            # is the healthy one (fail open), count only the explicit queue.
+            excess = float(queued)
+        else:
+            cap = 2.0 * slo_ttft_ms if slo_ttft_ms > 0 else e2e
+            steady = rate * min(e2e, cap) / 1e3
+            excess = max(backlog - steady, 0.0)
+        return excess / rate * 1e3, rate
+
+    def retry_after_s(self, overshoot_ms: float = 0.0) -> float:
+        """Finite Retry-After from the drain estimate: how long until the
+        backlog has drained enough that the same request would fit its SLO
+        (the predicted overshoot), bounded to [min, max]. Every computed
+        value feeds router_retry_after_seconds — admission-time sheds and
+        in-queue unmeetable evictions alike."""
+        cfg = self.cfg
+        v = max(overshoot_ms / 1e3, cfg.retry_after_min_s)
+        if not math.isfinite(v):
+            v = cfg.retry_after_max_s
+        v = round(min(v, cfg.retry_after_max_s), 3)
+        RETRY_AFTER_SECONDS.observe(v)
+        return v
+
+    def assess(self, request: Any, endpoints: list[Any]) -> OverloadAssessment | None:
+        """Feasibility verdict for one request, or None when overload
+        control does not apply (kill-switch, exempt band, no SLO). The
+        caller (director) applies the verdict: raises 429 on "shed",
+        applies the degrade actions on "degrade", and stamps the hint for
+        the flow-control queue either way."""
+        cfg = self.cfg
+        if not cfg.enabled:
+            return None
+        if request.objectives.priority > cfg.max_priority:
+            return None
+        if self.ledger is not None:
+            slo_ttft, slo_tpot = self.ledger.resolve_targets(
+                request.target_model, request.headers)
+        else:
+            from .slo import H_SLO_TPOT, H_SLO_TTFT, parse_slo_header_ms
+
+            slo_ttft = parse_slo_header_ms(request.headers, H_SLO_TTFT)
+            slo_tpot = parse_slo_header_ms(request.headers, H_SLO_TPOT)
+        if slo_ttft <= 0 and slo_tpot <= 0:
+            return None  # no SLO → nothing to protect
+
+        est = (self.predictor.admission_estimate(request, endpoints)
+               if self.predictor is not None else None)
+        service_ttft = est[0] if est is not None else 0.0
+        tpot = est[1] if est is not None else None
+        queue_wait, rate = self._queue_wait_ms(slo_ttft)
+        # Only a pessimistic bias folds in: an optimistic one (actual ran
+        # FASTER than predicted) admitting extra load is how collapse
+        # restarts. And it folds in only while there IS excess backlog —
+        # the bias measures the overload tax, and a pipeline at or below
+        # its steady population is the calibrated regime the ridge alone
+        # predicts. Without this, a bias spike latches the gate shut while
+        # the pipeline drains idle (bang-bang oscillation burning exactly
+        # the capacity the controller is protecting).
+        bias = (max(self._decayed_bias() or 0.0, 0.0)
+                if queue_wait > 0 else 0.0)
+        predicted_ttft = queue_wait + service_ttft + bias
+
+        h = cfg.headroom_factor
+        ttft_ok = slo_ttft <= 0 or predicted_ttft <= slo_ttft * h
+        tpot_ok = slo_tpot <= 0 or tpot is None or tpot <= slo_tpot * h
+
+        a = OverloadAssessment(
+            action="admit",
+            predicted_ttft_ms=predicted_ttft, service_ttft_ms=service_ttft,
+            queue_wait_ms=queue_wait, bias_ms=bias, drain_rate_rps=rate,
+            slo_ttft_ms=slo_ttft, predicted_tpot_ms=tpot,
+            slo_tpot_ms=slo_tpot)
+        if ttft_ok and tpot_ok:
+            return a
+
+        a.reason = REASON_TTFT if not ttft_ok else REASON_TPOT
+        has_degrade = bool(cfg.degrade_max_tokens or cfg.degrade_model)
+        marginal = (slo_ttft <= 0
+                    or predicted_ttft <= slo_ttft * h * cfg.degrade_admit_ratio)
+        # A TPOT-only miss is a per-token service property — clamping
+        # max_tokens doesn't change it; only a model rewrite can.
+        tpot_fixable = tpot_ok or bool(cfg.degrade_model)
+        if has_degrade and marginal and tpot_fixable:
+            a.action = "degrade"
+            actions = []
+            if cfg.degrade_max_tokens:
+                actions.append("clamp_max_tokens")
+            if cfg.degrade_model:
+                actions.append("model_rewrite")
+            a.degrade_actions = tuple(actions)
+            return a
+
+        a.action = "shed"
+        if not ttft_ok:
+            overshoot = predicted_ttft - slo_ttft * h
+            a.detail = (f"overload shed: predicted TTFT "
+                        f"{predicted_ttft:.0f}ms > SLO {slo_ttft:.0f}ms "
+                        f"(queue wait {queue_wait:.0f}ms at "
+                        f"{rate:.2f} req/s drain)")
+        else:
+            overshoot = 0.0
+            a.detail = (f"overload shed: predicted TPOT {tpot:.2f}ms > "
+                        f"SLO {slo_tpot:.0f}ms on every endpoint")
+        a.retry_after_s = self.retry_after_s(overshoot)
+        ADMISSION_SHED_TOTAL.labels(a.reason).inc()
+        return a
+
+    # ---- degrade ladder step 1 ------------------------------------------
+
+    def apply_degrade(self, request: Any,
+                      assessment: OverloadAssessment) -> list[str]:
+        """Apply the configured degrade actions to the request in place.
+        Returns the actions actually applied (a request already below the
+        clamp / already on the cheap model degrades to a no-op)."""
+        cfg = self.cfg
+        applied: list[str] = []
+        payload = request.body.payload if request.body is not None else None
+        if (cfg.degrade_max_tokens > 0 and payload is not None
+                and "embeddings" != _payload_kind(request.body)):
+            cur = payload.get("max_tokens")
+            if not isinstance(cur, (int, float)) or cur > cfg.degrade_max_tokens:
+                payload["max_tokens"] = cfg.degrade_max_tokens
+                applied.append("clamp_max_tokens")
+        if cfg.degrade_model and request.target_model != cfg.degrade_model:
+            request.target_model = cfg.degrade_model
+            applied.append("model_rewrite")
+        for action in applied:
+            DEGRADED_REQUESTS_TOTAL.labels(action).inc()
+        if applied:
+            # The gateway must re-serialize the mutated payload instead of
+            # forwarding the raw client bytes.
+            request.degraded = True
+        return applied
+
+    # ---- hint stamping ---------------------------------------------------
+
+    def stamp_hint(self, request: Any,
+                   assessment: OverloadAssessment) -> None:
+        """Carry the feasibility estimate onto the request so the
+        flow-control admission can stamp the queued item (predicted
+        service time + budget drive unmeetable eviction). The in-queue
+        renege bar tracks the ADMISSION bar, never dropping below the raw
+        SLO: a request admitted under headroomFactor > 1 (or via the
+        degrade band, which knowingly tolerates predicted > SLO) must not
+        be evicted by the very next sweep for exceeding a budget tighter
+        than the one it was admitted at."""
+        budget = assessment.slo_ttft_ms
+        if budget > 0:
+            bar = self.cfg.headroom_factor
+            if assessment.action == "degrade":
+                bar *= self.cfg.degrade_admit_ratio
+            budget *= max(1.0, bar)
+        setattr(request, HINT_ATTR, OverloadHint(
+            service_ttft_ms=assessment.service_ttft_ms,
+            slo_ttft_ms=budget,
+            predicted_ttft_ms=assessment.predicted_ttft_ms))
+
+
+def _payload_kind(body: Any) -> str:
+    return "embeddings" if getattr(body, "embeddings", None) is not None \
+        else "generate"

@@ -17,10 +17,16 @@ X_REMOVAL_REASON = "x-removal-reason"
 
 
 class AdmissionError(Exception):
-    def __init__(self, code: int, reason: str):
+    def __init__(self, code: int, reason: str, *,
+                 retry_after_s: float | None = None, shed: bool = False):
         super().__init__(reason)
         self.code = code
         self.reason = reason
+        # Overload-control extras (router/overload.py): a finite computed
+        # Retry-After for 429s, and the shed marker that makes the SLO
+        # ledger stamp the distinct "shed" verdict instead of "error".
+        self.retry_after_s = retry_after_s
+        self.shed = shed
 
 
 class LegacyAdmissionController:

@@ -7,6 +7,7 @@ probabilisticadmitter}/plugin.go. Both act only on sheddable requests
 
 from __future__ import annotations
 
+import os
 import random
 from typing import Any
 
@@ -75,7 +76,14 @@ class ProbabilisticAdmitter(PluginBase):
         self.kv_cache_util_threshold = 0.8
         self.power = 5.0
         self.k = 300.0
-        self._rng = random.Random()
+        # Deterministic shed decisions under the chaos harness and in unit
+        # tests: an explicit `seed` param wins, else CHAOS_SEED (the same
+        # env `make test-chaos` pins), else an unseeded RNG as before.
+        try:
+            chaos_seed = int(os.environ.get("CHAOS_SEED", ""))
+        except ValueError:
+            chaos_seed = None  # absent or non-numeric: unseeded as before
+        self._rng = random.Random(chaos_seed)
 
     def configure(self, params: dict[str, Any], handle: Any) -> None:
         self.queue_depth_threshold = float(
@@ -84,6 +92,8 @@ class ProbabilisticAdmitter(PluginBase):
             params.get("kvCacheUtilThreshold", self.kv_cache_util_threshold))
         self.power = float(params.get("power", self.power))
         self.k = float(params.get("k", self.k))
+        if "seed" in params:
+            self._rng = random.Random(int(params["seed"]))
         for field, v in (("queueDepthThreshold", self.queue_depth_threshold),
                          ("kvCacheUtilThreshold", self.kv_cache_util_threshold),
                          ("power", self.power), ("k", self.k)):

@@ -44,10 +44,16 @@ H_DATA_PARALLEL = "x-data-parallel-host-port"
 
 
 class RequestError(Exception):
-    def __init__(self, code: int, reason: str):
+    def __init__(self, code: int, reason: str, *,
+                 retry_after_s: float | None = None, shed: bool = False):
         super().__init__(reason)
         self.code = code
         self.reason = reason
+        # Overload-control extras (router/overload.py): the gateway turns
+        # retry_after_s into a Retry-After header and `shed` into the SLO
+        # ledger's distinct shed verdict.
+        self.retry_after_s = retry_after_s
+        self.shed = shed
 
 
 class Director:
@@ -60,10 +66,15 @@ class Director:
                  response_streaming: list[Any] | None = None,
                  response_complete: list[Any] | None = None,
                  recorder: Any = None,
-                 sched_pool: Any = None):
+                 sched_pool: Any = None,
+                 overload: Any = None):
         self.datastore = datastore
         self.scheduler = scheduler
         self.admission = admission
+        # Goodput-max overload controller (router/overload.py): predictive
+        # SLO admission + degrade ladder, run BEFORE the flow-control
+        # enqueue. None (or disabled) = pre-overload behavior bit-identical.
+        self.overload = overload
         # Scheduler pool (router/schedpool.py): when offloaded
         # (scheduling.workers > 0), cycles run on worker threads over
         # copy-on-write pool snapshots; None or workers: 0 = inline.
@@ -141,6 +152,37 @@ class Director:
                 rec.finalize(503, reason="no ready endpoints in pool")
             raise RequestError(503, "no ready endpoints in pool")
 
+        # 3b. overload control (router/overload.py): BEFORE enqueueing,
+        # estimate time-to-first-token if admitted now (queue wait from the
+        # measured drain rate + the best per-endpoint ridge prediction) and
+        # on a predicted SLO miss walk the degrade ladder — degrade-and-
+        # admit, or fast-fail 429 with a computed Retry-After before any
+        # capacity is spent. assess() is None when the kill-switch is off,
+        # the band is exempt, or the request carries no SLO.
+        if self.overload is not None:
+            verdict = self.overload.assess(request, candidates)
+            if verdict is not None:
+                if verdict.action == "shed":
+                    REQUEST_ERROR_TOTAL.labels(original_model,
+                                               "overload_shed").inc()
+                    if rec is not None:
+                        rec.record_shed(verdict.block())
+                        rec.record_admission("overload-controller", "shed",
+                                             reason=verdict.detail)
+                        rec.finalize(429, reason=verdict.detail)
+                    raise RequestError(429, verdict.detail,
+                                       retry_after_s=verdict.retry_after_s,
+                                       shed=True)
+                if verdict.action == "degrade":
+                    applied = self.overload.apply_degrade(request, verdict)
+                    if rec is not None:
+                        rec.record_shed(verdict.block())
+                        if "model_rewrite" in applied:
+                            rec.record_rewrite(request.target_model)
+                # Feasibility stamp for the flow-control queue: predicted
+                # service time + SLO budget drive unmeetable eviction.
+                self.overload.stamp_hint(request, verdict)
+
         # 4. admission (may block in flow control / shed sheddable load).
         # The flow-control controller writes the detailed section (queue
         # time, band, flow id); this fallback covers the legacy/always paths.
@@ -155,7 +197,9 @@ class Director:
                     rec.record_admission(type(self.admission).__name__,
                                          "rejected", reason=e.reason)
                 rec.finalize(e.code, reason=e.reason)
-            raise RequestError(e.code, e.reason) from None
+            raise RequestError(e.code, e.reason,
+                               retry_after_s=getattr(e, "retry_after_s", None),
+                               shed=getattr(e, "shed", False)) from None
 
         # 4b. scheduling candidates: with the scheduler pool offloaded,
         # re-resolve against the epoch-versioned pool snapshot AFTER the

@@ -168,6 +168,45 @@ class PredictedLatencyProducer(PluginBase):
     def _slo(request: InferenceRequest, header: str) -> float:
         return parse_slo_header_ms(request.headers, header)
 
+    # ---- admission-time feasibility probe (router/overload.py) ----------
+
+    def admission_estimate(self, request: InferenceRequest,
+                           endpoints: list[Endpoint]
+                           ) -> tuple[float, float | None] | None:
+        """Best-endpoint service estimate for the overload controller,
+        BEFORE this request's Produce/admission ran: (min predicted TTFT
+        ms over endpoints, min predicted TPOT ms over endpoints or None).
+        The two minima are taken INDEPENDENTLY — feasibility asks whether
+        any endpoint can meet each axis, and coupling TPOT to the
+        TTFT-winning endpoint would shed requests another endpoint could
+        serve inside both SLOs. An endpoint without a trained TPOT model
+        (or with the prefill role) is neutral on that axis, same rule as
+        produce(). Returns None when no endpoint has a trained TTFT model
+        (fail open — a cold router must not shed)."""
+        best_ttft: float | None = None
+        best_tpot: float | None = None
+        tpot_neutral = False  # any endpoint with no TPOT constraint at all
+        for ep in endpoints:
+            ap = ep.metadata.address_port
+            model = self._ttft_models.get(ap)
+            if model is None or model.n_samples < self.MIN_SAMPLES:
+                continue
+            ttft = max(model.predict(self._ttft_features(request, ep)), 0.0)
+            if best_ttft is None or ttft < best_ttft:
+                best_ttft = ttft
+            tpot_model = self._tpot_models.get(ap)
+            if (tpot_model is not None
+                    and tpot_model.n_samples >= self.MIN_SAMPLES
+                    and ep.metadata.labels.get(self.role_label) != "prefill"):
+                tpot = max(tpot_model.predict(self._tpot_features(ep)), 0.0)
+                if best_tpot is None or tpot < best_tpot:
+                    best_tpot = tpot
+            else:
+                tpot_neutral = True
+        if best_ttft is None:
+            return None
+        return best_ttft, None if tpot_neutral else best_tpot
+
     # ---- Produce: bulk predictions --------------------------------------
 
     async def produce(self, ctx: Any, request: InferenceRequest,

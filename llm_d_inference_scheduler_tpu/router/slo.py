@@ -187,23 +187,30 @@ class _ErrAgg:
 class _Agg:
     """Attainment + goodput accumulator (one per endpoint / band / total)."""
 
-    __slots__ = ("requests", "slo_met", "output_tokens", "goodput_tokens",
-                 "ttft_err", "tpot_err")
+    __slots__ = ("requests", "slo_met", "shed", "output_tokens",
+                 "goodput_tokens", "ttft_err", "tpot_err")
 
     def __init__(self):
         self.requests = 0
         self.slo_met = 0
+        self.shed = 0
         self.output_tokens = 0
         self.goodput_tokens = 0
         self.ttft_err = _ErrAgg()
         self.tpot_err = _ErrAgg()
 
     def render(self, *, predictor: bool = True) -> dict[str, Any]:
+        # Shed-at-admission is a DISTINCT verdict, not an SLO miss: a shed
+        # request consumed no serving capacity and generated no tokens, so
+        # attainment is judged over the requests the router actually tried
+        # to serve. The shed count stays visible beside it.
+        served = self.requests - self.shed
         doc: dict[str, Any] = {
             "requests": self.requests,
             "slo_met": self.slo_met,
-            "attainment": (round(self.slo_met / self.requests, 4)
-                           if self.requests else None),
+            "shed": self.shed,
+            "attainment": (round(self.slo_met / served, 4)
+                           if served > 0 else None),
             "output_tokens": self.output_tokens,
             "goodput_tokens": self.goodput_tokens,
         }
@@ -233,6 +240,7 @@ class SloLedger:
         self._by_endpoint: OrderedDict[str, _Agg] = OrderedDict()
         self._by_band: dict[int, _Agg] = {}
         self._miss_reasons: dict[str, int] = {}
+        self._shed_reasons: dict[str, int] = {}
         self._start_unix = time.time()
 
     @property
@@ -272,7 +280,8 @@ class SloLedger:
     def complete(self, request: Any, *, status: int,
                  endpoint: Any = None, usage: dict[str, int] | None = None,
                  reason: str | None = None,
-                 transfer: dict[str, Any] | None = None) -> None:
+                 transfer: dict[str, Any] | None = None,
+                 shed: bool = False) -> None:
         """Terminal accounting: exactly once per request (first call wins —
         error paths may overlap with the proxy's finally)."""
         obs: RequestObservation | None = getattr(request, "outcome", None)
@@ -342,7 +351,14 @@ class SloLedger:
             reason = obs.abort_reason
         if reason is None and status >= 400:
             reason = f"http-{status}"
-        if reason is not None:
+        if shed:
+            # Overload shed (router/overload.py): the request was refused
+            # BEFORE capacity was spent — a deliberate control action, not
+            # an SLO miss and not a serving error. Distinct verdict so
+            # attainment/goodput stay honest under admission control.
+            met, verdict = False, "shed"
+            reason = reason or "shed-at-admission"
+        elif reason is not None:
             met, verdict = False, "error"
         else:
             met = True
@@ -394,6 +410,8 @@ class SloLedger:
                     self._endpoint_agg(obs.endpoint or "(unrouted)"),
                     self._agg(self._by_band, obs.band)):
             agg.requests += 1
+            if shed:
+                agg.shed += 1
             if met:
                 agg.slo_met += 1
             agg.output_tokens += tokens
@@ -403,13 +421,18 @@ class SloLedger:
                 agg.ttft_err.add(ttft_signed)
             if tpot_signed is not None:
                 agg.tpot_err.add(tpot_signed)
-        if not met and reason:
+        if shed and reason:
+            key = reason.split(" ")[0]  # bounded cardinality: drop numbers
+            self._shed_reasons[key] = self._shed_reasons.get(key, 0) + 1
+        elif not met and reason:
             key = reason.split(" ")[0]  # bounded cardinality: drop numbers
             self._miss_reasons[key] = self._miss_reasons.get(key, 0) + 1
         if obs.endpoint:
             ep_agg = self._by_endpoint[obs.endpoint]
-            SLO_ATTAINMENT.labels(obs.endpoint).set(
-                ep_agg.slo_met / ep_agg.requests)
+            served = ep_agg.requests - ep_agg.shed
+            if served > 0:
+                SLO_ATTAINMENT.labels(obs.endpoint).set(
+                    ep_agg.slo_met / served)
 
         # Stamp the outcome block into the decision record so
         # /debug/decisions/<id> shows predicted vs actual vs SLO.
@@ -446,6 +469,8 @@ class SloLedger:
                 "slo_met": met,
                 "streamed": obs.streamed,
             }
+            if shed:
+                block["shed"] = True
             if reason:
                 block["reason"] = reason
             if transfer:
@@ -490,6 +515,7 @@ class SloLedger:
             "bands": {str(b): a.render(predictor=False)
                       for b, a in sorted(self._by_band.items())},
             "miss_reasons": dict(sorted(self._miss_reasons.items())),
+            "shed_reasons": dict(sorted(self._shed_reasons.items())),
         }
         if t.output_tokens:
             doc["totals"]["goodput_ratio"] = round(

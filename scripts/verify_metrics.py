@@ -57,6 +57,12 @@ REQUIRED_FAMILIES = {
     ("router_predictor_error_ms", "router"),
     ("router_kv_transfer_ms", "router"),
     ("sidecar_kv_transfer_ms", "sidecar"),
+    # Goodput-max overload control (ISSUE 8): admission-time sheds, degrade
+    # ladder actions, computed Retry-After, measured queue drain rate.
+    ("router_admission_shed", "router"),
+    ("router_degraded_requests", "router"),
+    ("router_retry_after_seconds", "router"),
+    ("router_queue_drain_rate", "router"),
 }
 
 # Registries whose every family must have a docs/metrics.md row (the

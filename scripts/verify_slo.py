@@ -12,7 +12,11 @@ outcome block with a verdict:
 - **shed** — flow-control capacity 0 → 429 at admission;
 - **retry-exhausted** — every candidate connect-fails → 502;
 - **deadline** — budget expires mid-walk after a slow upstream attempt → 504;
-- **abort** — client disconnects mid-stream → the record still closes.
+- **abort** — client disconnects mid-stream → the record still closes;
+- **overload shed** — the overload controller (router/overload.py) refuses
+  a predictively-hopeless request: the ledger must stamp the distinct
+  ``shed`` verdict EXACTLY once and the 429 must carry a finite
+  ``Retry-After``.
 
 Run via ``make verify-slo``; tests/test_slo.py hooks it into the pytest run.
 """
@@ -24,7 +28,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-GW, ENG, DEAD, GW_SHED = 18710, 18711, 18712, 18713
+GW, ENG, DEAD, GW_SHED, GW_OVL = 18710, 18711, 18712, 18713, 18714
 
 CFG = f"""
 featureGates: {{flowControl: true}}
@@ -41,6 +45,21 @@ flowControl: {{maxGlobalRequests: 0}}
 pool:
   endpoints:
     - {{address: 127.0.0.1, port: {ENG}}}
+"""
+
+OVL_CFG = f"""
+featureGates: {{flowControl: true}}
+overload: {{enabled: true}}
+pool:
+  endpoints:
+    - {{address: 127.0.0.1, port: {ENG}}}
+plugins:
+  - {{type: predicted-latency-producer}}
+  - {{type: queue-scorer}}
+schedulingProfiles:
+  - name: default
+    plugins:
+      - {{pluginRef: queue-scorer}}
 """
 
 
@@ -68,6 +87,8 @@ async def _drive() -> list[str]:
     await gw.start()
     gw_shed = build_gateway(SHED_CFG, port=GW_SHED, poll_interval=0.02)
     await gw_shed.start()
+    gw_ovl = build_gateway(OVL_CFG, port=GW_OVL, poll_interval=0.02)
+    await gw_ovl.start()
 
     def expect(name: str, outcome: dict | None, *, met: bool) -> None:
         if outcome is None:
@@ -156,7 +177,51 @@ async def _drive() -> list[str]:
                 if outcome is not None:
                     break
             expect("abort", outcome, met=False)
+
+            # 6. overload shed-at-admission — train the predictor past its
+            # sample floor, then a 0.01ms TTFT SLO is predictively
+            # hopeless: the 429 must carry a finite Retry-After and the
+            # ledger must stamp the distinct shed verdict EXACTLY once.
+            for i in range(7):
+                r = await c.post(
+                    f"http://127.0.0.1:{GW_OVL}/v1/completions",
+                    json={"model": "tiny", "prompt": f"t{i}",
+                          "max_tokens": 2})
+                if r.status_code != 200:
+                    errors.append(f"overload-shed: training request {i} "
+                                  f"got {r.status_code}")
+            rid = "verify-slo-overload-shed"
+            r = await c.post(
+                f"http://127.0.0.1:{GW_OVL}/v1/completions",
+                json={"model": "tiny", "prompt": "ok", "max_tokens": 2},
+                headers={"x-request-id": rid, "x-slo-ttft-ms": "0.01"})
+            if r.status_code != 429:
+                errors.append(f"overload-shed: expected 429, "
+                              f"got {r.status_code}")
+            ra = r.headers.get("retry-after")
+            try:
+                if ra is None or not (1 <= int(ra) <= 86400):
+                    errors.append(f"overload-shed: 429 without a finite "
+                                  f"Retry-After (got {ra!r})")
+            except ValueError:
+                errors.append(f"overload-shed: non-integer Retry-After "
+                              f"{ra!r}")
+            outcome = await _outcome(c, GW_OVL, rid)
+            expect("overload-shed", outcome, met=False)
+            if outcome is not None and not outcome.get("shed"):
+                errors.append("overload-shed: outcome block missing the "
+                              "shed verdict marker")
+            totals = (await c.get(
+                f"http://127.0.0.1:{GW_OVL}/debug/slo")).json()["totals"]
+            if totals.get("shed") != 1:
+                errors.append(f"overload-shed: ledger shed count "
+                              f"{totals.get('shed')} != 1 (stamp must land "
+                              "exactly once)")
+            if totals.get("requests") != 8:
+                errors.append(f"overload-shed: ledger requests "
+                              f"{totals.get('requests')} != 8")
     finally:
+        await gw_ovl.stop()
         await gw_shed.stop()
         await gw.stop()
         await eng.stop()
@@ -175,8 +240,8 @@ def main() -> int:
         print(f"verify-slo: {e}", file=sys.stderr)
     if errors:
         return 1
-    print("verify-slo: all 5 terminal paths (success, shed, retry-exhausted, "
-          "deadline, abort) stamp an SLO outcome")
+    print("verify-slo: all 6 terminal paths (success, shed, retry-exhausted, "
+          "deadline, abort, overload-shed+Retry-After) stamp an SLO outcome")
     return 0
 
 

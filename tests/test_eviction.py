@@ -19,9 +19,9 @@ def test_evictor_priority_then_time_order():
     mid_key = ev.register("mid", -1, lambda: cancelled.append("mid"))
     ev.register("normal", 0, lambda: cancelled.append("normal"))
 
-    assert ev.evict_n(2) == 2
+    assert ev.evict_n(2) == ["old-low", "new-low"]
     assert cancelled == ["old-low", "new-low"]  # lowest priority, oldest first
-    assert ev.evict_n(5) == 1  # only "mid" remains sheddable
+    assert ev.evict_n(5) == ["mid"]  # only "mid" remains sheddable
     assert cancelled == ["old-low", "new-low", "mid"]
     assert "normal" not in cancelled  # non-sheddable never evicted
     assert ev.was_evicted(mid_key)
@@ -36,7 +36,7 @@ def test_evictor_duplicate_request_ids_tracked_independently():
     assert k1 != k2
     ev.deregister(k1)  # first finishes; second must remain tracked
     assert ev.inflight_count == 1
-    assert ev.evict_n(1) == 1
+    assert ev.evict_n(1) == ["dup"]
     assert cancelled == ["second"]
     assert ev.was_evicted(k2) and not ev.was_evicted(k1)
 
@@ -68,7 +68,7 @@ pool:
                         break
                 assert gw.evictor.inflight_count == 1
 
-                assert gw.evictor.evict_n(1) == 1
+                assert len(gw.evictor.evict_n(1)) == 1
                 r = await sheddable
                 assert r.status_code == 429
                 assert "evicted" in r.headers.get("x-removal-reason", "")

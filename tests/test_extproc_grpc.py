@@ -398,7 +398,7 @@ pool:
                 assert r2["oneof"] == "request_body"
                 # The scheduled sheddable request is now registered; evict it.
                 assert gw.evictor.inflight_count == 1
-                assert gw.evictor.evict_n(1) == 1
+                assert len(gw.evictor.evict_n(1)) == 1
                 r3 = decode_response(await stream.read())
                 assert r3["oneof"] == "immediate"
                 assert r3["status"] == 429
