@@ -6,7 +6,7 @@ PY ?= python
 
 .PHONY: test test-fast test-unit test-dist test-chaos bench bench-flowcontrol \
 	bench-router-sse bench-decisions bench-sched bench-sched-offload \
-	bench-slo bench-overload dryrun render-chart compile-check \
+	bench-scaleout bench-slo bench-overload dryrun render-chart compile-check \
 	verify-metrics verify-decisions verify-hotpath verify-threadsafe \
 	verify-slo
 
@@ -70,6 +70,15 @@ bench-sched:
 # target ≥5x lower p99 loop stall with offload on.
 bench-sched-offload:
 	$(PY) bench.py --sched-offload
+
+# Multi-process scale-out bench (CPU-only): aggregate scheduling throughput
+# under saturation churn in 1/2/4 worker processes over disjoint flow
+# shards (the fleet's own flow_shard partitioner), plus cross-shard pick
+# parity vs a single-process run (scheduling.pickSeed). Writes
+# benchmarks/SCHED_SCALEOUT.json — target ≥2.5x aggregate cycles/sec at 4
+# workers with bit-identical picks.
+bench-scaleout:
+	$(PY) bench.py --sched-scaleout
 
 # SLO observability bench (CPU-only): per-chunk ledger-hook cost vs the 5ms
 # token cadence (kill-switch ~0%) plus a rate ramp past saturation showing

@@ -42,6 +42,7 @@ class RawConfig:
     data_layer: dict[str, Any]
     flow_control: dict[str, Any]
     scheduling: dict[str, Any]
+    fleet: dict[str, Any]
     saturation_detector: dict[str, Any] | None
     resilience: dict[str, Any]
     decisions: dict[str, Any]
@@ -68,8 +69,14 @@ class RouterConfig:
     flow_control: dict[str, Any]
     # scheduling: the concurrent scheduling engine knobs
     # (router/schedpool.py SchedulingConfig — {workers, maxBatch};
-    # workers: 0 is the inline kill-switch).
+    # workers: 0 is the inline kill-switch; pickSeed seeds every picker's
+    # tie-break RNG per request so picks are reproducible across worker
+    # counts — applied to the pickers at instantiate time below).
     scheduling: dict[str, Any]
+    # fleet: the multi-process sharded gateway knobs (router/fleet.py
+    # FleetConfig — {workers, balancer, snapshotIpc, adminPort}; workers: 1
+    # (the default) is the single-process router, bit-identical).
+    fleet: dict[str, Any]
     saturation_detector_spec: dict[str, Any] | None
     resilience: dict[str, Any]
     # decisions: the decision flight recorder knobs (enabled/capacity/topK —
@@ -117,6 +124,7 @@ def load_raw_config(text: str | None) -> RawConfig:
         data_layer=doc.get("dataLayer") or {},
         flow_control=doc.get("flowControl") or {},
         scheduling=doc.get("scheduling") or {},
+        fleet=doc.get("fleet") or {},
         saturation_detector=doc.get("saturationDetector"),
         resilience=doc.get("resilience") or {},
         decisions=doc.get("decisions") or {},
@@ -195,6 +203,19 @@ def instantiate(raw: RawConfig, handle: Handle,
         if picker is None:
             picker = _ensure("max-score-picker")  # defaults.go: picker injection
         profiles[pname] = SchedulerProfile(pname, filters, scorers, picker)
+
+    # scheduling.pickSeed: seed every picker's tie-break RNG with a
+    # per-request derivation (plugins/pickers.py _rng_for) so picks are a
+    # pure function of (seed, request) — reproducible across runs, worker
+    # threads, AND fleet worker counts (the shard-parity contract of
+    # benchmarks/SCHED_SCALEOUT.json). A per-picker `pickSeed` parameter
+    # set where the plugin is declared wins over this profile-wide default.
+    pick_seed = raw.scheduling.get("pickSeed") if raw.scheduling else None
+    if pick_seed is not None:
+        for prof in profiles.values():
+            if (hasattr(prof.picker, "_rng_for")
+                    and prof.picker.pick_seed is None):
+                prof.picker.pick_seed = int(pick_seed)
 
     # Profile handler: explicit plugin wins; else single-profile-handler.
     for plugin in plugins_by_name.values():
@@ -282,6 +303,7 @@ def instantiate(raw: RawConfig, handle: Handle,
         parser_spec=parser_spec,
         flow_control=raw.flow_control,
         scheduling=raw.scheduling,
+        fleet=raw.fleet,
         saturation_detector_spec=raw.saturation_detector,
         resilience=raw.resilience,
         decisions=raw.decisions,

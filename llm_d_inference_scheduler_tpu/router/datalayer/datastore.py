@@ -14,6 +14,7 @@ import time
 from typing import Callable, Iterable
 
 from ..framework.datalayer import Endpoint, EndpointMetadata
+from ..metrics import SNAPSHOT_EPOCH
 from ..resilience import BreakerRegistry
 from ..snapshot import PoolSnapshot
 from .transfers import TransferTable
@@ -92,6 +93,11 @@ class Datastore:
         self._snapshot_dirty = True   # hard: membership changed
         self._snapshot_stale = False  # soft: scrape data landed
         self._snapshot_epoch = 0
+        # Fleet follower mode (router/fleet.py): once a leader-published
+        # snapshot has been applied, this datastore stops building its own
+        # epochs — membership and scrape state both arrive via IPC frames,
+        # and a locally-built epoch would race the leader's numbering.
+        self._remote_snapshots = False
 
     # ---- scheduling snapshot ------------------------------------------
 
@@ -105,6 +111,8 @@ class Datastore:
     def snapshot(self) -> PoolSnapshot:
         """Current copy-on-write pool snapshot (rebuilt lazily when dirty)."""
         snap = self._snapshot
+        if self._remote_snapshots and snap is not None:
+            return snap
         rebuild = snap is None or self._snapshot_dirty or (
             self._snapshot_stale
             and time.monotonic() - snap.built_at >= self.SNAPSHOT_MIN_REFRESH_S)
@@ -114,7 +122,30 @@ class Datastore:
                                           self._endpoints.values())
             self._snapshot_dirty = False
             self._snapshot_stale = False
+            SNAPSHOT_EPOCH.set(self._snapshot_epoch)
         return self._snapshot
+
+    def apply_remote_snapshot(self, epoch: int, entries: list) -> None:
+        """Install a leader-published PoolSnapshot epoch (fleet snapshot
+        IPC, router/fleet.py). The frame is authoritative for BOTH pool
+        membership and per-endpoint scrape state: the live Endpoint objects
+        are resynced and updated in place (the saturation detector, pool
+        gauges, and proxy legs read those), then the frame is installed as
+        THE scheduling snapshot under the leader's epoch number — a batch
+        dispatched in this worker schedules against exactly the epoch a
+        single-process router would have seen."""
+        self.resync([meta for meta, _metrics, _attrs in entries])
+        for meta, metrics, attrs in entries:
+            ep = self._endpoints.get(meta.address_port)
+            if ep is not None:
+                ep.metrics = metrics
+                ep.attributes._data = dict(attrs)
+        self._snapshot = PoolSnapshot.from_entries(epoch, entries)
+        self._snapshot_epoch = epoch
+        self._snapshot_dirty = False
+        self._snapshot_stale = False
+        self._remote_snapshots = True
+        SNAPSHOT_EPOCH.set(epoch)
 
     # ---- pool ----------------------------------------------------------
 

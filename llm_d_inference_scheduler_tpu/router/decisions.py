@@ -142,7 +142,8 @@ class DecisionRecord:
                          queue_ms: float | None = None,
                          retried_after_shed: bool = False,
                          reason: str | None = None,
-                         shed_victims: list[str] | None = None) -> None:
+                         shed_victims: list[str] | None = None,
+                         shard: int | None = None) -> None:
         # Hot path (flow-control dispatch): one dict literal on the common
         # shape; rounding happens at render time (to_dict).
         if (flow_id is not None and priority_band is not None
@@ -152,6 +153,10 @@ class DecisionRecord:
                                "flow_id": flow_id,
                                "priority_band": priority_band,
                                "queue_ms": queue_ms}
+            if shard is not None:
+                # Fleet worker identity (router/fleet.py): which shard's
+                # flow-control queues admitted this request.
+                self._admission["shard"] = shard
             return
         a: dict[str, Any] = {"mechanism": mechanism, "outcome": outcome}
         if flow_id is not None:
@@ -160,6 +165,8 @@ class DecisionRecord:
             a["priority_band"] = priority_band
         if queue_ms is not None:
             a["queue_ms"] = queue_ms
+        if shard is not None:
+            a["shard"] = shard
         if retried_after_shed:
             a["retried_after_shed"] = True
         if shed_victims:

@@ -1,0 +1,1129 @@
+"""Multi-process sharded gateway fleet: scheduling throughput past the GIL.
+
+PR 5 moved scheduling cycles off the event loop, but its own benchmark
+documents the ceiling: every worker thread shares one GIL, so *aggregate*
+scheduling throughput under saturation churn cannot exceed one core
+(docs/performance.md §Concurrency model, SCHED_OFFLOAD.json
+``cycles_per_sec``). This module breaks that ceiling the way P/D-Serve
+(arXiv:2408.08147) does at tens of thousands of devices — a fleet of
+gateway processes in front of the shared pool:
+
+- **N full gateway workers**, each its own process with its own event loop,
+  scheduler pool, and flow-control shards, owning a disjoint shard of
+  flows. They share the public listen port via ``SO_REUSEPORT`` (kernel
+  connection balancing), or sit behind a thin hash-by-flow-id front
+  balancer (``fleet.balancer: hash`` — the portable fallback, and the mode
+  that gives *strict* flow→shard ownership).
+- **Pool state replicates instead of multiplying**: worker 0 is the
+  datalayer leader — the only process running the scrape + kv-event SSE
+  pipeline — and publishes ``PoolSnapshot`` epochs over a unix-socket IPC
+  stream (the copy-on-write snapshot from router/snapshot.py is already
+  the serialization unit). Followers apply each frame as membership +
+  scrape state + THE scheduling snapshot, so N workers impose 1× scrape
+  load on every engine and a batch dispatched in any worker schedules
+  against the same epoch it would have seen single-process. The staleness
+  bound is the publish poll (= ``Datastore.SNAPSHOT_MIN_REFRESH_S``) on
+  top of the soft-dirty window the single-process router already has.
+- **Observability fans back in**: the supervisor serves one merged
+  ``/metrics`` (counters/histograms summed across workers, replicated pool
+  gauges deduplicated, ``router_shard_*`` families labeled per shard) and
+  one ``/debug/decisions`` / ``/debug/slo`` / ``/debug/transfers`` view
+  that routes record lookups to the owning shard.
+
+``fleet: {workers: 1}`` (the default) never enters this module — the
+single-process router is bit-identical to the pre-fleet gateway.
+
+Scaling is measured by ``make bench-scaleout`` → benchmarks/
+SCHED_SCALEOUT.json: a 1/2/4-worker saturation-churn sweep with per-shard
+picks bit-identical to a single-process run (``scheduling.pickSeed``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import dataclasses
+import logging
+import multiprocessing
+import os
+import pickle
+import shutil
+import signal
+import socket
+import struct
+import tempfile
+import time
+from typing import Any, Callable, Iterable
+
+import xxhash
+from aiohttp import web
+from prometheus_client import generate_latest
+from prometheus_client.parser import text_string_to_metric_families
+
+from .metrics import (
+    FLEET_BALANCER_CONNECTIONS,
+    FLEET_REGISTRY,
+    FLEET_WORKERS,
+    SHARD_REQUESTS,
+    SHARD_SNAPSHOT_EPOCH,
+    SHARD_UP,
+)
+
+log = logging.getLogger("router.fleet")
+
+# Offset of the supervisor's admin port from the public data port when
+# fleet.adminPort is not configured.
+DEFAULT_ADMIN_OFFSET = 1000
+
+# How long the supervisor waits for every worker's admin plane to answer
+# before declaring the fleet up.
+WORKER_READY_TIMEOUT_S = 30.0
+
+# Crash-restart budget per worker: a worker that keeps dying stops being
+# restarted (the shard shows as down in router_shard_up instead of
+# flapping forever).
+MAX_WORKER_RESTARTS = 5
+
+
+def flow_shard(flow_id: str, workers: int) -> int:
+    """Stable flow→shard assignment shared by the front balancer and the
+    bench's stream partitioner. xxh64, not ``hash()``: Python's string hash
+    is salted per interpreter, and shard ownership must agree across
+    processes and runs."""
+    if workers <= 1:
+        return 0
+    return xxhash.xxh64_intdigest(flow_id.encode()) % workers
+
+
+@dataclasses.dataclass
+class FleetConfig:
+    """The YAML ``fleet:`` section. ``workers: 1`` (default) is the
+    single-process router, bit-identical to the pre-fleet gateway."""
+
+    workers: int = 1
+    balancer: str = "reuseport"   # reuseport | hash
+    snapshot_ipc: bool = True     # leader publishes PoolSnapshot epochs
+    admin_port: int | None = None  # default: data port + 1000
+
+    @classmethod
+    def from_spec(cls, spec: dict[str, Any] | None) -> "FleetConfig":
+        spec = spec or {}
+        balancer = str(spec.get("balancer", "reuseport"))
+        if balancer not in ("reuseport", "hash"):
+            raise ValueError(f"fleet.balancer must be 'reuseport' or 'hash', "
+                             f"got {balancer!r}")
+        return cls(
+            workers=max(1, int(spec.get("workers", 1))),
+            balancer=balancer,
+            snapshot_ipc=bool(spec.get("snapshotIpc", True)),
+            admin_port=(int(spec["adminPort"])
+                        if spec.get("adminPort") is not None else None))
+
+
+@dataclasses.dataclass
+class FleetWorkerSpec:
+    """Per-worker identity handed to ``build_gateway`` (picklable: it rides
+    the multiprocessing spawn)."""
+
+    index: int
+    workers: int
+    role: str = "leader"           # leader | follower
+    ipc_path: str | None = None    # None = every worker runs its own datalayer
+    admin_host: str = "127.0.0.1"
+    admin_port: int | None = None  # private per-worker admin listener
+    reuse_port: bool = False
+
+    @property
+    def runs_datalayer(self) -> bool:
+        """Followers with snapshot IPC replicate pool state instead of
+        scraping; everyone else (leader, or IPC disabled) runs the full
+        scrape + SSE pipeline."""
+        return self.role != "follower" or self.ipc_path is None
+
+
+# ---------------------------------------------------------------------------
+# Snapshot IPC: leader publishes PoolSnapshot epochs, followers apply them.
+# ---------------------------------------------------------------------------
+
+_FRAME_LEN = struct.Struct("!I")
+_FRAME_MAX = 256 << 20  # sanity bound on one pickled pool frame
+
+
+def _encode_frame(epoch: int, entries: list, bad_keys: set[str]) -> bytes:
+    """Length-prefixed pickle of one snapshot epoch. Endpoint attributes
+    can hold arbitrary producer outputs; anything unpicklable is dropped
+    from the frame (with its key cached so the common case stays one
+    whole-frame pickle)."""
+    try:
+        payload = pickle.dumps((epoch, entries),
+                               protocol=pickle.HIGHEST_PROTOCOL)
+    except Exception:
+        sanitized = []
+        for meta, metrics, attrs in entries:
+            keep = {}
+            for k, v in attrs.items():
+                if k in bad_keys:
+                    continue
+                try:
+                    pickle.dumps(v, protocol=pickle.HIGHEST_PROTOCOL)
+                    keep[k] = v
+                except Exception:
+                    bad_keys.add(k)
+                    log.warning("snapshot IPC: dropping unpicklable "
+                                "endpoint attribute %r from published "
+                                "frames", k)
+            sanitized.append((meta, metrics, keep))
+        payload = pickle.dumps((epoch, sanitized),
+                               protocol=pickle.HIGHEST_PROTOCOL)
+    return _FRAME_LEN.pack(len(payload)) + payload
+
+
+class SnapshotPublisher:
+    """Datalayer-leader side: poll the datastore's COW snapshot at the
+    soft-dirty cadence and broadcast each NEW epoch to every connected
+    follower over a unix socket. A follower that connects mid-stream gets
+    the current epoch immediately (no warm-up gap)."""
+
+    def __init__(self, datastore: Any, path: str,
+                 interval_s: float | None = None):
+        self.datastore = datastore
+        self.path = path
+        self.interval_s = (interval_s if interval_s is not None
+                           else type(datastore).SNAPSHOT_MIN_REFRESH_S)
+        self._server: asyncio.AbstractServer | None = None
+        self._task: asyncio.Task | None = None
+        self._writers: list[asyncio.StreamWriter] = []
+        self._frame: bytes | None = None
+        self._epoch = -1
+        self._bad_keys: set[str] = set()
+
+    async def start(self) -> None:
+        with contextlib.suppress(OSError):
+            os.unlink(self.path)
+        self._server = await asyncio.start_unix_server(self._on_client,
+                                                       path=self.path)
+        self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        for w in self._writers:
+            with contextlib.suppress(Exception):
+                w.close()
+        self._writers.clear()
+        with contextlib.suppress(OSError):
+            os.unlink(self.path)
+
+    async def _on_client(self, reader: asyncio.StreamReader,
+                         writer: asyncio.StreamWriter) -> None:
+        if self._frame is not None:
+            try:
+                writer.write(self._frame)
+                await writer.drain()
+            except Exception:
+                writer.close()
+                return
+        self._writers.append(writer)
+
+    async def _run(self) -> None:
+        try:
+            while True:
+                snap = self.datastore.snapshot()
+                if snap.epoch != self._epoch:
+                    # Mark the epoch consumed BEFORE encoding: a failed
+                    # epoch is skipped (the next scrape mints a fresh one
+                    # within ~one poll), not retried in a 10 ms log storm.
+                    self._epoch = snap.epoch
+                    try:
+                        frame = _encode_frame(snap.epoch, snap.entries(),
+                                              self._bad_keys)
+                        self._frame = frame
+                        await self._broadcast(frame)
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception:
+                        # The publish loop must outlive one bad epoch
+                        # (e.g. an unpicklable value inside a Metrics
+                        # field, beyond the attribute sanitization): a
+                        # silently-dead publisher would pin every follower
+                        # to its last applied epoch — scheduling on
+                        # ever-staler data with no error anywhere.
+                        log.exception("snapshot publish failed for epoch "
+                                      "%s; skipping it", snap.epoch)
+                await asyncio.sleep(self.interval_s)
+        except asyncio.CancelledError:
+            pass
+
+    # A follower that stops draining (paused process, swap storm) must not
+    # stall publication to the REST of the fleet: its drain is bounded, and
+    # on timeout the writer is dropped — the follower reconnects and gets
+    # the current frame fresh.
+    DRAIN_TIMEOUT_S = 1.0
+
+    async def _broadcast(self, frame: bytes) -> None:
+        # Remove ONLY failed writers, never reassign the list wholesale:
+        # each drain() is a yield point where _on_client may append a
+        # newly-connected follower, and a snapshot-then-replace would drop
+        # it — an open connection that never receives another epoch.
+        for w in list(self._writers):
+            try:
+                w.write(frame)
+                await asyncio.wait_for(w.drain(), timeout=self.DRAIN_TIMEOUT_S)
+            except Exception:
+                with contextlib.suppress(Exception):
+                    w.close()
+                with contextlib.suppress(ValueError):
+                    self._writers.remove(w)
+
+
+class SnapshotSubscriber:
+    """Follower side: connect to the leader's snapshot socket (retrying —
+    the leader may still be booting, or restarting) and apply each frame
+    via ``Datastore.apply_remote_snapshot``."""
+
+    RETRY_MAX_S = 5.0  # backoff ceiling for consecutive apply failures
+
+    def __init__(self, datastore: Any, path: str, retry_s: float = 0.25):
+        self.datastore = datastore
+        self.path = path
+        self.retry_s = retry_s
+        self._task: asyncio.Task | None = None
+        self.applied_epoch = 0
+        self._consecutive_failures = 0
+
+    def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._task
+            self._task = None
+
+    async def _run(self) -> None:
+        try:
+            while True:
+                try:
+                    reader, writer = await asyncio.open_unix_connection(
+                        path=self.path)
+                except (OSError, ConnectionError):
+                    await asyncio.sleep(self.retry_s)
+                    continue
+                try:
+                    await self._consume(reader)
+                except (asyncio.IncompleteReadError, ConnectionError,
+                        OSError):
+                    pass  # leader restart / stream cut: reconnect quietly
+                except Exception:
+                    # A bad frame (unpicklable-by-reference value, shape
+                    # drift across versions) must not kill the subscriber
+                    # silently — that would pin this follower to its last
+                    # applied epoch forever. Log and reconnect. The
+                    # publisher re-sends the CURRENT frame on reconnect,
+                    # so a SYSTEMATIC failure (e.g. mixed builds in a
+                    # rolling upgrade) would tight-loop full-pool
+                    # transfers + tracebacks — back off exponentially on
+                    # consecutive apply failures instead.
+                    self._consecutive_failures += 1
+                    log.exception("snapshot frame failed to apply "
+                                  "(%d consecutive); reconnecting",
+                                  self._consecutive_failures)
+                finally:
+                    with contextlib.suppress(Exception):
+                        writer.close()
+                await asyncio.sleep(min(
+                    self.retry_s * (2 ** self._consecutive_failures),
+                    self.RETRY_MAX_S))
+        except asyncio.CancelledError:
+            pass
+
+    async def _consume(self, reader: asyncio.StreamReader) -> None:
+        while True:
+            header = await reader.readexactly(_FRAME_LEN.size)
+            (length,) = _FRAME_LEN.unpack(header)
+            if not 0 < length <= _FRAME_MAX:
+                raise ConnectionError(f"bad snapshot frame length {length}")
+            payload = await reader.readexactly(length)
+            epoch, entries = pickle.loads(payload)
+            self.datastore.apply_remote_snapshot(epoch, entries)
+            self.applied_epoch = epoch
+            self._consecutive_failures = 0
+
+
+# ---------------------------------------------------------------------------
+# Merged observability: one /metrics, /debug/decisions, /debug/slo,
+# /debug/transfers across shards.
+# ---------------------------------------------------------------------------
+
+# Gauge families the merge must NOT sum — two classes, same max rule:
+# - replicated pool state (snapshot IPC / same engines): every worker
+#   reports the same value, so summing multiplies it by the worker count
+#   (max == the shared value; under IPC lag, the freshest worker's view);
+# - bounded per-worker gauges — ratios and enums: summing two workers'
+#   0.9 SLO attainment to 1.8, or two open breakers (state 2) to 4,
+#   produces values outside the family's domain. Max is the conservative
+#   worst/best-state view; the REQUEST-WEIGHTED attainment merge (the
+#   accurate one) is what the supervisor's /debug/slo serves.
+MAX_MERGED_GAUGES = {
+    "inference_pool_ready_pods",
+    "inference_pool_average_kv_cache_utilization",
+    "inference_pool_average_queue_size",
+    "router_snapshot_epoch",
+    "router_slo_attainment",
+    "router_endpoint_circuit_breaker_state",
+}
+
+
+def _escape_label(v: str) -> str:
+    return v.replace("\\", r"\\").replace("\n", r"\n").replace('"', r'\"')
+
+
+def _escape_help(v: str) -> str:
+    return v.replace("\\", r"\\").replace("\n", r"\n")
+
+
+def merge_parsed(families_per_worker: list[list[Any]]) -> str:
+    """Merge parsed Prometheus metric families from N workers into one
+    exposition: counters/histograms/summaries sum sample-wise, replicated
+    pool gauges take max, ``_created`` timestamps take min (earliest
+    birth), everything keyed by (sample name, labels) so per-model /
+    per-endpoint children merge correctly. One HELP/TYPE block per family —
+    the duplicate-family lint in scripts/verify_metrics.py holds on the
+    output."""
+    order: list[str] = []
+    fams: dict[str, Any] = {}
+    values: dict[str, dict[tuple, float]] = {}
+    for families in families_per_worker:
+        for fam in families:
+            if fam.name not in fams:
+                fams[fam.name] = fam
+                values[fam.name] = {}
+                order.append(fam.name)
+            acc = values[fam.name]
+            replicated = (fam.type == "gauge"
+                          and fam.name in MAX_MERGED_GAUGES)
+            for s in fam.samples:
+                key = (s.name, tuple(sorted(s.labels.items())))
+                prev = acc.get(key)
+                if prev is None:
+                    acc[key] = s.value
+                elif s.name.endswith("_created"):
+                    acc[key] = min(prev, s.value)
+                elif replicated:
+                    acc[key] = max(prev, s.value)
+                else:
+                    acc[key] = prev + s.value
+    out: list[str] = []
+    for name in order:
+        fam = fams[name]
+        ftype = "untyped" if fam.type == "unknown" else fam.type
+        # Classic text format spells counter families WITH the _total
+        # suffix on the HELP/TYPE lines (the parser strips it from
+        # fam.name); re-append it so the merged exposition round-trips.
+        decl = name + "_total" if fam.type == "counter" else name
+        out.append(f"# HELP {decl} {_escape_help(fam.documentation)}")
+        out.append(f"# TYPE {decl} {ftype}")
+        for (sname, labels), value in values[name].items():
+            if labels:
+                lbl = ",".join(f'{k}="{_escape_label(str(v))}"'
+                               for k, v in labels)
+                out.append(f"{sname}{{{lbl}}} {value}")
+            else:
+                out.append(f"{sname} {value}")
+    return "\n".join(out) + "\n"
+
+
+def merge_expositions(texts: Iterable[str]) -> str:
+    """Text-level convenience wrapper over ``merge_parsed``."""
+    return merge_parsed([list(text_string_to_metric_families(t))
+                         for t in texts])
+
+
+def _merge_err(target: dict[str, Any], err: dict[str, Any]) -> None:
+    """Merge one predictor-error rollup ({n, mae_ms, mean_signed_ms}) into
+    target, n-weighted."""
+    n0, n1 = target.get("n", 0), err.get("n", 0)
+    if not n1:
+        return
+    if not n0:
+        target.update(err)
+        return
+    n = n0 + n1
+    target["mae_ms"] = round((target["mae_ms"] * n0 + err["mae_ms"] * n1) / n, 3)
+    target["mean_signed_ms"] = round(
+        (target["mean_signed_ms"] * n0 + err["mean_signed_ms"] * n1) / n, 3)
+    target["n"] = n
+
+
+def _merge_agg(target: dict[str, Any], agg: dict[str, Any]) -> None:
+    """Merge one SLO attainment/goodput accumulator render (slo.py _Agg)
+    into target: counts sum, attainment recomputed from the summed counts,
+    predictor errors n-weighted."""
+    for k in ("requests", "slo_met", "shed", "output_tokens",
+              "goodput_tokens"):
+        target[k] = target.get(k, 0) + agg.get(k, 0)
+    served = target.get("requests", 0) - target.get("shed", 0)
+    target["attainment"] = (round(target.get("slo_met", 0) / served, 4)
+                            if served > 0 else None)
+    if "predictor" in agg:
+        tp = target.setdefault("predictor", {"ttft": {"n": 0},
+                                             "tpot": {"n": 0}})
+        for kind in ("ttft", "tpot"):
+            _merge_err(tp.setdefault(kind, {"n": 0}),
+                       agg["predictor"].get(kind, {"n": 0}))
+
+
+def merge_slo(docs: list[dict[str, Any]]) -> dict[str, Any]:
+    """Fleet /debug/slo: the sum of the per-worker ledgers — totals,
+    per-endpoint and per-band rollups, miss/shed reason tallies — with
+    ratios recomputed from the summed counts (never averaged)."""
+    out: dict[str, Any] = {
+        "enabled": any(d.get("enabled") for d in docs),
+        "workers": len(docs),
+        "totals": {},
+        "endpoints": {},
+        "bands": {},
+        "miss_reasons": {},
+        "shed_reasons": {},
+    }
+    since = [d["since_unix"] for d in docs if d.get("since_unix")]
+    if since:
+        out["since_unix"] = min(since)
+        out["window_s"] = round(time.time() - out["since_unix"], 1)
+    for doc in docs:
+        _merge_agg(out["totals"], doc.get("totals") or {})
+        for ep, agg in (doc.get("endpoints") or {}).items():
+            _merge_agg(out["endpoints"].setdefault(ep, {}), agg)
+        for band, agg in (doc.get("bands") or {}).items():
+            _merge_agg(out["bands"].setdefault(band, {}), agg)
+        for key in ("miss_reasons", "shed_reasons"):
+            for reason, n in (doc.get(key) or {}).items():
+                out[key][reason] = out[key].get(reason, 0) + n
+    if out["totals"].get("output_tokens"):
+        out["totals"]["goodput_ratio"] = round(
+            out["totals"].get("goodput_tokens", 0)
+            / out["totals"]["output_tokens"], 4)
+    return out
+
+
+class FleetAdmin:
+    """The supervisor's fan-in admin plane, separable from process
+    management (tests drive it against stub workers): merged /metrics and
+    the /debug record lookups routed to the owning shard."""
+
+    def __init__(self, worker_admin: list[tuple[str, int]], *,
+                 host: str = "127.0.0.1", port: int = 9081,
+                 worker_alive: Callable[[int], bool] | None = None):
+        self.worker_admin = worker_admin
+        self.host, self.port = host, port
+        self.worker_alive = worker_alive or (lambda i: True)
+        self.app = web.Application()
+        self.app.add_routes([
+            web.get("/metrics", self.metrics),
+            web.get("/health", self.health),
+            web.get("/debug/fleet", self.fleet_view),
+            web.get("/debug/decisions", self.decisions),
+            web.get("/debug/decisions/{request_id}", self.decision_detail),
+            web.get("/debug/slo", self.slo),
+            web.get("/debug/transfers", self.transfers),
+        ])
+        self._runner: web.AppRunner | None = None
+        self._session = None
+        # Per-shard request totals already credited to SHARD_REQUESTS (the
+        # counter advances by scrape deltas; a worker restart resets its
+        # own totals, so negative deltas clamp to 0).
+        self._credited: dict[int, float] = {}
+        # Last successfully parsed exposition per shard: an unreachable
+        # worker (restart, slow scrape) must not make the merged *_total
+        # counters DIP and recover — Prometheus reads that as a counter
+        # reset and rate()/increase() spike on every fleet series. Serving
+        # the stale families keeps the merge monotonic; router_shard_up
+        # says which shard the staleness belongs to.
+        self._last_families: dict[int, list] = {}
+
+    async def start(self) -> None:
+        import aiohttp
+
+        self._session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=5.0))
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+    async def _fetch(self, shard: int, path: str) -> tuple[int, Any]:
+        """(status, json-or-text) from one worker's admin plane; (0, None)
+        when the worker is unreachable."""
+        host, port = self.worker_admin[shard]
+        try:
+            async with self._session.get(
+                    f"http://{host}:{port}{path}") as resp:
+                if "json" in (resp.headers.get("content-type") or ""):
+                    return resp.status, await resp.json()
+                return resp.status, await resp.text()
+        except Exception:
+            return 0, None
+
+    async def _fan_out(self, path: str) -> list[tuple[int, Any]]:
+        return await asyncio.gather(
+            *[self._fetch(i, path) for i in range(len(self.worker_admin))])
+
+    async def metrics(self, request: web.Request) -> web.Response:
+        results = await self._fan_out("/metrics")
+        parsed: list[list[Any]] = []
+        for shard, (status, text) in enumerate(results):
+            up = status == 200 and isinstance(text, str)
+            SHARD_UP.labels(str(shard)).set(1.0 if up else 0.0)
+            if up:
+                families = list(text_string_to_metric_families(text))
+                self._last_families[shard] = families
+                self._note_shard_stats(shard, families)
+            else:
+                # Monotonicity over freshness for a missing shard: merge
+                # its last-seen families so fleet counters don't dip and
+                # "reset" (see _last_families).
+                families = self._last_families.get(shard)
+            if families:
+                parsed.append(families)
+        body = merge_parsed(parsed) + generate_latest(FLEET_REGISTRY).decode()
+        return web.Response(text=body, content_type="text/plain",
+                            charset="utf-8")
+
+    def _note_shard_stats(self, shard: int, families: list[Any]) -> None:
+        """Derive the per-shard families from one worker's scrape: its
+        snapshot epoch, and the delta of its request total since the last
+        merge (credited to the shard-labeled counter)."""
+        total = 0.0
+        for fam in families:
+            if fam.name == "router_snapshot_epoch":
+                for s in fam.samples:
+                    SHARD_SNAPSHOT_EPOCH.labels(str(shard)).set(s.value)
+            elif fam.name == "inference_extension_request":
+                total += sum(s.value for s in fam.samples
+                             if s.name == "inference_extension_request_total")
+        prev = self._credited.get(shard, 0.0)
+        if total > prev:
+            SHARD_REQUESTS.labels(str(shard)).inc(total - prev)
+        self._credited[shard] = total
+
+    async def health(self, request: web.Request) -> web.Response:
+        results = await self._fan_out("/health")
+        workers = []
+        ready = 0
+        all_alive = True
+        for shard, (status, doc) in enumerate(results):
+            alive = status != 0 and self.worker_alive(shard)
+            all_alive = all_alive and alive
+            if status == 200:
+                ready += 1
+            workers.append({"shard": shard, "alive": alive,
+                            "status": (doc if isinstance(doc, dict)
+                                       else None)})
+        # A permanently-down shard must surface here, not hide behind the
+        # healthy ones: in hash-balancer mode it blackholes its flows, and
+        # a dead shard-0 leader freezes every follower's pool view. One
+        # transiently-restarting worker flips readiness for a beat — the
+        # probe-tolerant kind of honest.
+        ok = ready > 0 and all_alive
+        return web.json_response(
+            {"status": "ok" if ok else "not-ready",
+             "workers_ready": ready, "workers": workers},
+            status=200 if ok else 503)
+
+    async def fleet_view(self, request: web.Request) -> web.Response:
+        return web.json_response({
+            "workers": len(self.worker_admin),
+            "admin": [{"shard": i, "host": h, "port": p,
+                       "alive": self.worker_alive(i)}
+                      for i, (h, p) in enumerate(self.worker_admin)],
+        })
+
+    async def decisions(self, request: web.Request) -> web.Response:
+        """One list across shards: each worker's recent records, annotated
+        with the owning shard, newest first — trimmed to the page size the
+        caller asked for (same contract as the single-process endpoint)."""
+        try:
+            n = max(1, int(request.query.get("n", "50")))
+        except ValueError:
+            n = 50
+        results = await self._fan_out(f"/debug/decisions?n={n}")
+        merged: list[dict] = []
+        enabled = False
+        count = 0
+        schema = None
+        for shard, (status, doc) in enumerate(results):
+            if status != 200 or not isinstance(doc, dict):
+                continue
+            enabled = enabled or bool(doc.get("enabled"))
+            count += doc.get("count", 0)
+            schema = schema or doc.get("schema_version")
+            for rec in doc.get("decisions") or []:
+                rec["shard"] = shard
+                merged.append(rec)
+        merged.sort(key=lambda r: r.get("start_unix") or 0, reverse=True)
+        return web.json_response({"schema_version": schema,
+                                  "enabled": enabled, "count": count,
+                                  "decisions": merged[:n]})
+
+    async def decision_detail(self, request: web.Request) -> web.Response:
+        """Route the lookup to the owning shard: the record lives in
+        exactly one worker's ring (the one that served the request)."""
+        rid = request.match_info["request_id"]
+        results = await self._fan_out(f"/debug/decisions/{rid}")
+        for shard, (status, doc) in enumerate(results):
+            if status == 200 and isinstance(doc, dict):
+                doc["shard"] = shard
+                return web.json_response(doc)
+        return web.json_response(
+            {"error": f"no decision record for request id {rid!r} "
+                      "in any shard"}, status=404)
+
+    async def slo(self, request: web.Request) -> web.Response:
+        results = await self._fan_out("/debug/slo")
+        return web.json_response(merge_slo(
+            [doc for status, doc in results
+             if status == 200 and isinstance(doc, dict)]))
+
+    async def transfers(self, request: web.Request) -> web.Response:
+        results = await self._fan_out("/debug/transfers")
+        pairs: list[dict] = []
+        for shard, (status, doc) in enumerate(results):
+            if status != 200 or not isinstance(doc, dict):
+                continue
+            for row in doc.get("pairs") or []:
+                row["shard"] = shard
+                pairs.append(row)
+        return web.json_response({"pairs": pairs})
+
+
+# ---------------------------------------------------------------------------
+# Thin hash-by-flow-id front balancer (portable fallback to SO_REUSEPORT).
+# ---------------------------------------------------------------------------
+
+class HashBalancer:
+    """Accepts on the public port and splices each connection to the worker
+    owning its flow: the flow id is read from the FIRST request head on the
+    connection (the flow-control fairness header, then the session token,
+    then the request id, then the client address), hashed with
+    ``flow_shard``. Keep-alive requests ride the same splice, so a client
+    connection is sticky to its shard.
+
+    The routing unit is the CONNECTION, deliberately — re-inspecting every
+    request would make this a full HTTP proxy, not a thin splice. Flow →
+    shard ownership therefore holds when a connection carries one flow
+    (direct clients; proxies with per-flow/per-client upstream pools). A
+    fronting proxy that multiplexes MANY flows over one pooled keep-alive
+    connection gets connection-affinity only — the later flows land on the
+    first flow's shard (correct service, diluted ownership; see
+    docs/performance.md §Scale-out).
+
+    The fallback order is a deliberate throughput/ownership dial: strict
+    ownership applies to traffic that DECLARES a flow identity (the
+    fairness header the flow-control plane keys on, or a session token).
+    Anonymous traffic — no flow headers — deliberately SPREADS: a
+    client-sent request id varies per request and the final fallback is
+    the peer ADDRESS (no ephemeral port, so one client keeps shard
+    affinity across reconnects). Pinning all headerless traffic to the
+    gateway's single default flow would serialize the whole anonymous
+    workload onto one worker and undo the scale-out for exactly the
+    commonest client."""
+
+    FLOW_HEADERS = ("x-gateway-inference-fairness-id", "x-session-token",
+                    "x-request-id")
+    HEAD_MAX = 64 << 10
+
+    def __init__(self, host: str, port: int,
+                 targets: list[tuple[str, int]]):
+        self.host, self.port = host, port
+        self.targets = targets
+        self._server: asyncio.AbstractServer | None = None
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port, limit=self.HEAD_MAX)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    def _flow_id(self, head: bytes, peer: Any) -> str:
+        headers: dict[str, str] = {}
+        for line in head.split(b"\r\n")[1:]:
+            # RFC 7230: field-name ":" OWS field-value — the space after
+            # the colon is optional, so split on the bare colon.
+            name, sep, value = line.partition(b":")
+            if sep:
+                headers[name.decode("latin1").lower().strip()] = (
+                    value.decode("latin1").strip())
+        for h in self.FLOW_HEADERS:
+            if headers.get(h):
+                return headers[h]
+        # Address only, NOT the (host, port) tuple: the ephemeral port
+        # changes per connection, which would randomize instead of giving
+        # the client stable shard affinity across reconnects.
+        if isinstance(peer, (tuple, list)) and peer:
+            return str(peer[0])
+        return str(peer)
+
+    async def _handle(self, cr: asyncio.StreamReader,
+                      cw: asyncio.StreamWriter) -> None:
+        try:
+            try:
+                head = await asyncio.wait_for(cr.readuntil(b"\r\n\r\n"),
+                                              timeout=10.0)
+            except (asyncio.TimeoutError, asyncio.IncompleteReadError,
+                    asyncio.LimitOverrunError):
+                return
+            shard = flow_shard(
+                self._flow_id(head, cw.get_extra_info("peername")),
+                len(self.targets))
+            FLEET_BALANCER_CONNECTIONS.labels(str(shard)).inc()
+            try:
+                ur, uw = await asyncio.open_connection(*self.targets[shard])
+            except OSError:
+                cw.write(b"HTTP/1.1 503 Service Unavailable\r\n"
+                         b"content-length: 0\r\nconnection: close\r\n\r\n")
+                with contextlib.suppress(Exception):
+                    await cw.drain()
+                return
+            uw.write(head)
+            try:
+                await uw.drain()
+                await asyncio.gather(self._pipe(cr, uw),
+                                     self._pipe(ur, cw))
+            finally:
+                with contextlib.suppress(Exception):
+                    uw.close()
+        finally:
+            with contextlib.suppress(Exception):
+                cw.close()
+
+    @staticmethod
+    async def _pipe(reader: asyncio.StreamReader,
+                    writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                chunk = await reader.read(1 << 16)
+                if not chunk:
+                    break
+                writer.write(chunk)
+                await writer.drain()
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            with contextlib.suppress(Exception):
+                writer.write_eof()
+
+
+# ---------------------------------------------------------------------------
+# Supervisor: spawn + monitor the worker processes.
+# ---------------------------------------------------------------------------
+
+def _worker_main(spec: dict[str, Any]) -> None:
+    """Worker-process entry (multiprocessing spawn target): one full
+    gateway — own event loop, scheduler pool, flow-control shards — with
+    the fleet identity steering listen-socket sharing and the datalayer
+    leader/follower split."""
+    logging.basicConfig(
+        level=logging.INFO,
+        format=f"%(asctime)s shard{spec['worker']['index']} "
+               "%(name)s %(levelname)s %(message)s")
+    from .gateway import build_gateway, run_gateway
+
+    gw = build_gateway(spec["config_text"], host=spec["host"],
+                       port=spec["port"],
+                       poll_interval=spec["poll_interval"],
+                       fleet=FleetWorkerSpec(**spec["worker"]))
+    asyncio.run(run_gateway(gw, drain_timeout_s=spec["drain_timeout_s"]))
+
+
+class FleetSupervisor:
+    """Spawns N gateway workers, keeps them alive, and serves the fan-in
+    admin plane. Worker 0 is the datalayer leader (scrape + SSE + snapshot
+    publication); the rest are followers over the snapshot IPC stream."""
+
+    def __init__(self, config_text: str | None, *, host: str = "127.0.0.1",
+                 port: int = 8081, fleet: FleetConfig | None = None,
+                 poll_interval: float = 0.05,
+                 drain_timeout_s: float = 30.0):
+        self.config_text = config_text
+        self.host, self.port = host, port
+        self.fleet = fleet or FleetConfig()
+        self.poll_interval = poll_interval
+        self.drain_timeout_s = drain_timeout_s
+        if (self.fleet.balancer == "reuseport"
+                and not hasattr(socket, "SO_REUSEPORT")):
+            # The portable fallback the config names: platforms without
+            # SO_REUSEPORT get the front balancer instead of a bind error.
+            log.warning("SO_REUSEPORT unavailable on this platform; "
+                        "falling back to fleet.balancer: hash")
+            self.fleet = dataclasses.replace(self.fleet, balancer="hash")
+        self.admin_port = self.fleet.admin_port or port + DEFAULT_ADMIN_OFFSET
+        self.worker_admin = [("127.0.0.1", self.admin_port + 1 + i)
+                             for i in range(self.fleet.workers)]
+        # hash balancer: workers listen on private loopback ports behind
+        # the public port; reuseport: all workers bind the public port.
+        self._worker_ports = (
+            [port] * self.fleet.workers if self.fleet.balancer == "reuseport"
+            else [self.admin_port + 1 + self.fleet.workers + i
+                  for i in range(self.fleet.workers)])
+        self._ctx = multiprocessing.get_context("spawn")
+        self._procs: list[Any] = [None] * self.fleet.workers
+        self._restarts = [0] * self.fleet.workers
+        self._ipc_dir: str | None = None
+        self.ipc_path: str | None = None
+        self.admin: FleetAdmin | None = None
+        self.balancer: HashBalancer | None = None
+        self._monitor: asyncio.Task | None = None
+        self._stopping = False
+
+    def _worker_spec(self, i: int) -> dict[str, Any]:
+        return {
+            "config_text": self.config_text,
+            "host": self.host if self.fleet.balancer == "reuseport"
+            else "127.0.0.1",
+            "port": self._worker_ports[i],
+            "poll_interval": self.poll_interval,
+            "drain_timeout_s": self.drain_timeout_s,
+            "worker": {
+                "index": i,
+                "workers": self.fleet.workers,
+                "role": "leader" if i == 0 else "follower",
+                "ipc_path": self.ipc_path,
+                "admin_host": self.worker_admin[i][0],
+                "admin_port": self.worker_admin[i][1],
+                "reuse_port": self.fleet.balancer == "reuseport",
+            },
+        }
+
+    def _spawn(self, i: int) -> None:
+        proc = self._ctx.Process(target=_worker_main,
+                                 args=(self._worker_spec(i),),
+                                 name=f"router-shard-{i}", daemon=True)
+        proc.start()
+        self._procs[i] = proc
+        log.info("spawned gateway shard %d/%d (pid %s, port %s, admin %s)",
+                 i, self.fleet.workers, proc.pid, self._worker_ports[i],
+                 self.worker_admin[i][1])
+
+    def worker_alive(self, i: int) -> bool:
+        p = self._procs[i]
+        return p is not None and p.is_alive()
+
+    async def start(self) -> None:
+        FLEET_WORKERS.set(self.fleet.workers)
+        if self.fleet.snapshot_ipc and self.fleet.workers > 1:
+            self._ipc_dir = tempfile.mkdtemp(prefix="router-fleet-")
+            self.ipc_path = os.path.join(self._ipc_dir, "snapshot.sock")
+        try:
+            for i in range(self.fleet.workers):
+                self._spawn(i)
+            await self._wait_ready()
+            self.admin = FleetAdmin(self.worker_admin, host="127.0.0.1",
+                                    port=self.admin_port,
+                                    worker_alive=self.worker_alive)
+            await self.admin.start()
+            if self.fleet.balancer == "hash":
+                self.balancer = HashBalancer(
+                    self.host, self.port,
+                    [("127.0.0.1", p) for p in self._worker_ports])
+                await self.balancer.start()
+        except BaseException:
+            # A failed startup must not strand worker processes (or the
+            # IPC tempdir) behind the raised error.
+            await self.stop()
+            raise
+        self._monitor = asyncio.get_running_loop().create_task(
+            self._monitor_loop())
+        log.info("fleet up: %d workers, balancer=%s, admin :%d%s",
+                 self.fleet.workers, self.fleet.balancer, self.admin_port,
+                 f", snapshot IPC {self.ipc_path}" if self.ipc_path else "")
+
+    async def _wait_ready(self) -> None:
+        """Block until every worker's admin listener answers (any status —
+        a 503 not-ready still proves the process booted)."""
+        import aiohttp
+
+        deadline = time.monotonic() + WORKER_READY_TIMEOUT_S
+        pending = set(range(self.fleet.workers))
+        async with aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=1.0)) as session:
+            while pending and time.monotonic() < deadline:
+                for i in list(pending):
+                    host, port = self.worker_admin[i]
+                    try:
+                        async with session.get(
+                                f"http://{host}:{port}/health"):
+                            pass
+                        pending.discard(i)
+                    except Exception:
+                        if not self.worker_alive(i):
+                            raise RuntimeError(
+                                f"fleet worker {i} died during startup "
+                                f"(exitcode {self._procs[i].exitcode})")
+                if pending:
+                    await asyncio.sleep(0.1)
+        if pending:
+            raise RuntimeError(
+                f"fleet workers {sorted(pending)} not ready after "
+                f"{WORKER_READY_TIMEOUT_S:.0f}s")
+
+    async def _monitor_loop(self) -> None:
+        try:
+            while True:
+                await asyncio.sleep(1.0)
+                for i in range(self.fleet.workers):
+                    # router_shard_up has ONE writer — the admin /metrics
+                    # fan-in (scrape success implies process alive AND
+                    # admin answering); this loop only restarts the dead.
+                    alive = self.worker_alive(i)
+                    if alive or self._stopping:
+                        continue
+                    # The restart budget bounds follower crash loops; the
+                    # DATALAYER LEADER (shard 0) is exempt — a permanently
+                    # dead leader freezes every follower's pool view, so it
+                    # always respawns (the 1 s monitor tick is the backoff).
+                    if (i != 0 and self._restarts[i] >= MAX_WORKER_RESTARTS):
+                        continue
+                    self._restarts[i] += 1
+                    log.warning(
+                        "gateway shard %d died (exitcode %s); restart %d%s",
+                        i, self._procs[i].exitcode, self._restarts[i],
+                        "" if i == 0 else f"/{MAX_WORKER_RESTARTS}")
+                    self._spawn(i)
+        except asyncio.CancelledError:
+            pass
+
+    async def stop(self) -> None:
+        self._stopping = True
+        if self._monitor is not None:
+            self._monitor.cancel()
+            self._monitor = None
+        if self.balancer is not None:
+            await self.balancer.stop()
+            self.balancer = None
+        if self.admin is not None:
+            await self.admin.stop()
+            self.admin = None
+        for p in self._procs:
+            if p is not None and p.is_alive():
+                p.terminate()
+        deadline = time.monotonic() + self.drain_timeout_s + 5.0
+        for p in self._procs:
+            if p is None:
+                continue
+            p.join(timeout=max(deadline - time.monotonic(), 0.1))
+            if p.is_alive():
+                p.kill()
+                p.join(timeout=5.0)
+        if self._ipc_dir is not None:
+            shutil.rmtree(self._ipc_dir, ignore_errors=True)
+            self._ipc_dir = None
+
+
+async def _run_supervisor(sup: FleetSupervisor) -> None:
+    await sup.start()
+    stop_ev = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        with contextlib.suppress(NotImplementedError, RuntimeError):
+            loop.add_signal_handler(sig, stop_ev.set)
+    try:
+        await stop_ev.wait()
+    except asyncio.CancelledError:
+        pass
+    await sup.stop()
+
+
+def run_fleet(config_text: str | None, *, host: str = "127.0.0.1",
+              port: int = 8081, fleet: FleetConfig | None = None,
+              poll_interval: float = 0.05,
+              drain_timeout_s: float = 30.0) -> None:
+    """Run a sharded gateway fleet until SIGTERM/SIGINT (the multi-process
+    counterpart of gateway.run_gateway)."""
+    sup = FleetSupervisor(config_text, host=host, port=port, fleet=fleet,
+                          poll_interval=poll_interval,
+                          drain_timeout_s=drain_timeout_s)
+    asyncio.run(_run_supervisor(sup))
+
+
+def main(argv: list[str] | None = None) -> None:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="TPU inference router gateway fleet (multi-process "
+                    "sharded scale-out)")
+    p.add_argument("--config-file", default=None)
+    p.add_argument("--config-text", default=None)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8081)
+    p.add_argument("--workers", type=int, default=None,
+                   help="override fleet.workers from the config")
+    p.add_argument("--balancer", choices=("reuseport", "hash"), default=None,
+                   help="override fleet.balancer")
+    p.add_argument("--admin-port", type=int, default=None,
+                   help="supervisor fan-in admin port (default: port+1000)")
+    p.add_argument("--no-snapshot-ipc", action="store_true",
+                   help="every worker runs its own scrape pipeline instead "
+                        "of replicating the leader's snapshots (N x scrape "
+                        "load on every engine)")
+    p.add_argument("--poll-interval", type=float, default=0.05)
+    p.add_argument("--drain-timeout", type=float, default=30.0)
+    args = p.parse_args(argv)
+
+    text = args.config_text
+    if args.config_file:
+        with open(args.config_file) as f:
+            text = f.read()
+
+    from .config.loader import load_raw_config
+
+    spec = dict(load_raw_config(text).fleet)
+    if args.workers is not None:
+        spec["workers"] = args.workers
+    if args.balancer is not None:
+        spec["balancer"] = args.balancer
+    if args.admin_port is not None:
+        spec["adminPort"] = args.admin_port
+    if args.no_snapshot_ipc:
+        spec["snapshotIpc"] = False
+    fleet = FleetConfig.from_spec(spec)
+
+    logging.basicConfig(level=logging.INFO)
+    if fleet.workers <= 1:
+        # workers: 1 IS the single-process router — no supervisor, no IPC,
+        # bit-identical to the pre-fleet gateway. Build it directly (the
+        # same build_gateway + run_gateway path gateway.main takes) rather
+        # than delegating through gateway.main's argv: that both pins the
+        # explicit `--workers 1` override against a config declaring
+        # workers > 1, and honors --poll-interval, which gateway.main's
+        # CLI does not expose.
+        from .gateway import build_gateway, run_gateway
+
+        gw = build_gateway(text, host=args.host, port=args.port,
+                           poll_interval=args.poll_interval)
+        asyncio.run(run_gateway(gw, drain_timeout_s=args.drain_timeout))
+        return
+    run_fleet(text, host=args.host, port=args.port, fleet=fleet,
+              poll_interval=args.poll_interval,
+              drain_timeout_s=args.drain_timeout)
+
+
+if __name__ == "__main__":
+    main()

@@ -31,12 +31,17 @@ _OUTCOME_ERRORS = {
 
 class FlowControlAdmissionController:
     def __init__(self, controller: FlowController, evictor: Any = None,
-                 overload: Any = None):
+                 overload: Any = None, shard: int | None = None):
         self.controller = controller
         self.evictor = evictor
         # OverloadController (router/overload.py) — None or disabled keeps
         # every path here bit-identical to the pre-overload behavior.
         self.overload = overload
+        # Fleet shard ownership (router/fleet.py): this worker's shard
+        # index, stamped into every admission record so /debug/decisions
+        # shows which worker's flow-control queues owned the flow. None in
+        # the single-process router (no extra field on the record).
+        self.shard = shard
 
     def _make_item(self, request: InferenceRequest,
                    flow_key: FlowKey) -> FlowControlRequest:
@@ -90,7 +95,8 @@ class FlowControlAdmissionController:
                     priority_band=request.objectives.priority,
                     queue_ms=queue_ms,
                     retried_after_shed=retried_after_shed,
-                    shed_victims=shed_victims or None)
+                    shed_victims=shed_victims or None,
+                    shard=self.shard)
             if obs is not None:
                 # The SLO ledger's queue-time component: admission wait is
                 # part of the client-observed TTFT budget.

@@ -89,6 +89,11 @@ ROUTER_OWNED_HEADERS = ("x-prefiller-host-port", "x-encoder-hosts-ports",
 H_DEBUG_DECISION = "x-debug-decision"
 H_DECISION_SUMMARY = "x-decision-summary"
 
+# Fleet shard identity echoed on proxied responses (router/fleet.py): which
+# worker process served this request — the per-request twin of the
+# supervisor's router_shard_* families.
+H_ROUTER_SHARD = "x-router-shard"
+
 # Request bodies at or above this size have their JSON parse routed through
 # the scheduler pool's workers instead of the event loop (json.loads of a
 # multi-megabyte long-context body is a multi-millisecond loop stall —
@@ -107,8 +112,17 @@ class Gateway:
                  kube_binding=None, kube_elector=None,
                  secure_serving: bool = False,
                  cert_path: str | None = None,
-                 enable_cert_reload: bool = False):
+                 enable_cert_reload: bool = False,
+                 fleet=None):
         self.cfg = cfg
+        # Fleet worker identity (router/fleet.py FleetWorkerSpec): when set,
+        # this gateway is one shard of a multi-process fleet — it may share
+        # the listen port via SO_REUSEPORT, serve a private admin listener
+        # for the supervisor's fan-in plane, and (as a follower) replicate
+        # the leader's pool snapshots instead of scraping. None (the
+        # default, and fleet.workers: 1) is the single-process router,
+        # bit-identical to the pre-fleet gateway.
+        self.fleet = fleet
         # Secure serving (reference runserver.go:136-171): one identity for
         # the HTTP listener and the ext-proc gRPC port; self-signed fallback
         # when no cert dir is mounted.
@@ -199,7 +213,8 @@ class Gateway:
                     self.datastore.endpoint_list()))
             admission = FlowControlAdmissionController(
                 self.flow_controller, evictor=self.evictor,
-                overload=self.overload if self.overload.enabled else None)
+                overload=self.overload if self.overload.enabled else None,
+                shard=fleet.index if fleet is not None else None)
             if self.overload.enabled:
                 # Queue depth + measured drain rate feed the feasibility
                 # estimate; the queues gain unmeetable eviction + priority
@@ -256,6 +271,10 @@ class Gateway:
             web.get("/debug/transfers", self.transfers),
         ])
         self._runner: web.AppRunner | None = None
+        # Fleet snapshot IPC endpoints (router/fleet.py): the datalayer
+        # leader publishes PoolSnapshot epochs, followers apply them.
+        self._snapshot_pub = None
+        self._snapshot_sub = None
         self._client: httpx.AsyncClient | None = None
         self.draining = False   # SIGTERM drain: readiness flips not-ready
         self._inflight = 0      # live proxied requests (drain gate)
@@ -307,7 +326,26 @@ class Gateway:
             self.datastore.objective_set(obj)
         for rw in self.cfg.model_rewrites:
             self.datastore.rewrite_set(rw)
-        await self.dl_runtime.start()
+        if self.fleet is None or self.fleet.runs_datalayer:
+            await self.dl_runtime.start()
+            if self.fleet is not None and self.fleet.ipc_path is not None:
+                # Datalayer leader: the ONLY process scraping the engines;
+                # every snapshot epoch broadcasts to the follower workers.
+                from .fleet import SnapshotPublisher
+
+                self._snapshot_pub = SnapshotPublisher(
+                    self.datastore, self.fleet.ipc_path)
+                await self._snapshot_pub.start()
+        else:
+            # Fleet follower: pool state (membership + scrape metrics +
+            # producer attributes) arrives as leader-published PoolSnapshot
+            # epochs over IPC — no collectors, no per-worker SSE
+            # subscriptions, so N workers impose 1x load on every engine.
+            from .fleet import SnapshotSubscriber
+
+            self._snapshot_sub = SnapshotSubscriber(
+                self.datastore, self.fleet.ipc_path)
+            self._snapshot_sub.start()
         if self.flow_controller is not None:
             await self.flow_controller.start()
         # Verification policy from tlsClient config (default skip-verify:
@@ -328,9 +366,20 @@ class Gateway:
         self._runner = web.AppRunner(self.app, shutdown_timeout=5.0)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port,
+                           reuse_port=(True if self.fleet is not None
+                                       and self.fleet.reuse_port else None),
                            ssl_context=self.tls.ssl_context
                            if self.tls else None)
         await site.start()
+        if self.fleet is not None and self.fleet.admin_port is not None:
+            # Private per-worker admin listener: under SO_REUSEPORT the
+            # supervisor cannot address one worker through the shared data
+            # port, so the fan-in plane (merged /metrics, /debug lookups)
+            # reaches each shard here. Same app — every route, loopback
+            # only.
+            admin_site = web.TCPSite(self._runner, self.fleet.admin_host,
+                                     self.fleet.admin_port)
+            await admin_site.start()
         self._flusher = asyncio.get_running_loop().create_task(self._flush_pool_gauges())
         # Loop-lag heartbeat: the stall token relays experience, live on
         # /metrics (router_loop_lag_seconds) — the number the scheduler
@@ -365,6 +414,10 @@ class Gateway:
             await self.elector.stop()
         if self.flow_controller is not None:
             await self.flow_controller.stop()
+        if self._snapshot_pub is not None:
+            await self._snapshot_pub.stop()
+        if self._snapshot_sub is not None:
+            await self._snapshot_sub.stop()
         if self._runner:
             await self._runner.cleanup()
         if self._client:
@@ -917,6 +970,8 @@ class Gateway:
             H_DESTINATION_SERVED: endpoint.metadata.address_port,
             "content-type": resp.headers.get("content-type", "application/json"),
         }
+        if self.fleet is not None:
+            out_headers[H_ROUTER_SHARD] = str(self.fleet.index)
         out_headers.update(self._decision_headers(ireq))  # x-debug-decision echo
         if ireq is not None and "x-session-token" in ireq.headers:
             # Session stickiness: return the (scheduling-stamped) encoded
@@ -1297,7 +1352,8 @@ def build_gateway(config_text: str | None, *, host: str = "127.0.0.1",
                   kube: dict | None = None,
                   secure_serving: bool = False,
                   cert_path: str | None = None,
-                  enable_cert_reload: bool = False) -> Gateway:
+                  enable_cert_reload: bool = False,
+                  fleet=None) -> Gateway:
     datastore = Datastore()
     dl_runtime = DataLayerRuntime(datastore, poll_interval=poll_interval)
     handle = Handle(datastore=datastore, dl_runtime=dl_runtime)
@@ -1307,9 +1363,20 @@ def build_gateway(config_text: str | None, *, host: str = "127.0.0.1",
     cfg = load_config(config_text, handle)
     # Endpoint lifecycle plugins (per-pod subscribers, LRU teardown — the
     # reference's EndpointExtractors, runtime.go:361) ride datastore events.
-    for plugin in cfg.plugins_by_name.values():
-        if hasattr(plugin, "endpoint_added") or hasattr(plugin, "endpoint_removed"):
-            dl_runtime.register_lifecycle(plugin)
+    # Fleet followers skip them: a per-pod SSE subscription in every worker
+    # would put the N x engine load back that the snapshot IPC removes.
+    # The trade is real and documented (docs/performance.md §Scale-out):
+    # engine-CONFIRMED kv-event state (the precise scorer's KvBlockIndex)
+    # is plugin-local and does NOT ride the snapshot frames, so followers
+    # see only their own short-TTL speculative pre_request stamps. Pools
+    # leaning on precise-prefix fidelity should run `balancer: hash`
+    # (flow-sticky shards keep each flow's stamps on its owner) or
+    # `snapshotIpc: false` (every worker subscribes — the N x load trade,
+    # made explicitly).
+    if fleet is None or fleet.runs_datalayer:
+        for plugin in cfg.plugins_by_name.values():
+            if hasattr(plugin, "endpoint_added") or hasattr(plugin, "endpoint_removed"):
+                dl_runtime.register_lifecycle(plugin)
     kube_binding = None
     # Endpoint discovery needs a pool to scope the pod selector; a kube dict
     # without one is lease-only (HA election against the API server while
@@ -1351,7 +1418,8 @@ def build_gateway(config_text: str | None, *, host: str = "127.0.0.1",
                    config_watch_path=config_watch_path,
                    secure_serving=secure_serving,
                    cert_path=cert_path,
-                   enable_cert_reload=enable_cert_reload)
+                   enable_cert_reload=enable_cert_reload,
+                   fleet=fleet)
 
 
 async def run_gateway(gw: Gateway, drain_timeout_s: float = 30.0):
@@ -1439,12 +1507,49 @@ def main(argv: list[str] | None = None):
                    help="seconds to let in-flight proxied requests finish "
                         "after SIGTERM (readiness flips not-ready and the "
                         "lease is released immediately)")
+    p.add_argument("--fleet-workers", type=int, default=None,
+                   help="override fleet.workers: >1 runs the multi-process "
+                        "sharded fleet (router/fleet.py) instead of a "
+                        "single gateway process")
     args = p.parse_args(argv)
 
     text = args.config_text
     if args.config_file:
         with open(args.config_file) as f:
             text = f.read()
+
+    # Multi-process fleet delegation (router/fleet.py): `fleet.workers > 1`
+    # (or --fleet-workers) spawns N full gateway workers behind one port.
+    # workers: 1 — the default — continues below, bit-identical to the
+    # pre-fleet router.
+    from .config.loader import load_raw_config
+    from .fleet import FleetConfig
+
+    fleet_spec = dict(load_raw_config(text).fleet)
+    if args.fleet_workers is not None:
+        fleet_spec["workers"] = args.fleet_workers
+    fleet_cfg = FleetConfig.from_spec(fleet_spec)
+    if fleet_cfg.workers > 1:
+        unsupported = {
+            "--grpc-ext-proc-port": args.grpc_ext_proc_port,
+            "--grpc-health-port": args.grpc_health_port,
+            "--kube-api-url": args.kube_api_url,
+            "--ha-lease-path": args.ha_lease_path,
+            "--secure-serving": args.secure_serving or None,
+            "--watch-config": args.watch_config or None,
+            "--endpoints": args.endpoints,
+        }
+        bad = [flag for flag, v in unsupported.items() if v]
+        if bad:
+            p.error(f"fleet mode (workers={fleet_cfg.workers}) does not "
+                    f"support {', '.join(bad)} yet; run workers: 1 or drop "
+                    "the flag(s)")
+        from .fleet import run_fleet
+
+        logging.basicConfig(level=logging.INFO)
+        run_fleet(text, host=args.host, port=args.port, fleet=fleet_cfg,
+                  drain_timeout_s=args.drain_timeout)
+        return
 
     from .kube import DEFAULT_TOKEN_PATH
 

@@ -210,3 +210,44 @@ QUEUE_DRAIN_RATE = Gauge(
     "Measured flow-control dispatch rate (requests/second, EWMA) feeding "
     "the overload controller's queue-wait and Retry-After estimates",
     registry=REGISTRY)
+# Multi-process sharded gateway (router/fleet.py): each worker exposes the
+# pool-snapshot epoch it last built (leader) or applied from the IPC stream
+# (follower) — the supervisor re-labels it per shard, making snapshot-IPC
+# staleness graphable fleet-wide.
+SNAPSHOT_EPOCH = Gauge(
+    "router_snapshot_epoch",
+    "Pool-snapshot epoch this process last built (datalayer leader / "
+    "single-process router) or applied from the fleet leader's IPC stream "
+    "(follower worker)", registry=REGISTRY)
+
+# Fleet-supervisor registry (router/fleet.py): families that exist only in
+# the supervisor process — worker liveness, per-shard request/epoch views
+# derived from the admin-plane scrapes, and the hash balancer's connection
+# counts. A SEPARATE registry: the supervisor must not re-emit the router
+# families above with zero values next to the workers' merged real ones.
+FLEET_REGISTRY = CollectorRegistry()
+
+FLEET_WORKERS = Gauge(
+    "router_fleet_workers",
+    "Configured gateway worker processes in the fleet",
+    registry=FLEET_REGISTRY)
+SHARD_UP = Gauge(
+    "router_shard_up",
+    "Per-shard worker liveness as seen by the fleet supervisor (1 = the "
+    "worker process is alive and its admin plane answers)",
+    ("shard",), registry=FLEET_REGISTRY)
+SHARD_SNAPSHOT_EPOCH = Gauge(
+    "router_shard_snapshot_epoch",
+    "router_snapshot_epoch per worker, re-labeled by shard at merge time — "
+    "a follower lagging the leader's epoch is visible as a gap",
+    ("shard",), registry=FLEET_REGISTRY)
+SHARD_REQUESTS = Counter(
+    "router_shard_requests",
+    "Requests handled per shard (derived from each worker's "
+    "inference_extension_request_total at merge time)",
+    ("shard",), registry=FLEET_REGISTRY)
+FLEET_BALANCER_CONNECTIONS = Counter(
+    "router_fleet_balancer_connections",
+    "Connections routed per shard by the hash-by-flow-id front balancer "
+    "(fleet.balancer: hash; absent under SO_REUSEPORT kernel balancing)",
+    ("shard",), registry=FLEET_REGISTRY)

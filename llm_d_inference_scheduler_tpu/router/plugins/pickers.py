@@ -14,8 +14,21 @@ class _PickerBase(PluginBase):
     # Thread-safety audit (scheduler-pool offload, router/schedpool.py):
     # config fields written once at configure(); the shared random.Random's
     # C-level draws are GIL-atomic (interleaved draws change tie-break
-    # outcomes, never corrupt state).
+    # outcomes, never corrupt state). Seeded mode derives a private
+    # per-request Random, so it is trivially safe.
     THREAD_SAFE = True
+
+    # Seeded tie-break mode: when set (per-picker `pickSeed` parameter, or
+    # the `scheduling.pickSeed` config knob applied to every picker by the
+    # loader), every draw comes from a Random seeded by (pickSeed,
+    # request_id) — a pure function of the request, independent of draw
+    # order, process, and interleaving. That is what makes picks
+    # bit-identical between a single-process run and a sharded fleet run
+    # over the same request stream (router/fleet.py, SCHED_SCALEOUT.json):
+    # a shared sequential RNG would entangle every pick with global request
+    # order, which sharding necessarily changes. None (the default) keeps
+    # the historical shared-RNG behavior bit-identical.
+    pick_seed: int | None = None
 
     def __init__(self, name: str | None = None):
         super().__init__(name)
@@ -24,6 +37,16 @@ class _PickerBase(PluginBase):
 
     def configure(self, params: dict[str, Any], handle: Any) -> None:
         self.max_endpoints = int(params.get("maxNumOfEndpoints", 1))
+        if params.get("pickSeed") is not None:
+            self.pick_seed = int(params["pickSeed"])
+
+    def _rng_for(self, request: Any) -> random.Random:
+        if self.pick_seed is None:
+            return self._rng
+        # str seeding hashes via SHA-512: deterministic across processes
+        # (unlike hash(), which is salted per interpreter).
+        rid = getattr(request, "request_id", "") or ""
+        return random.Random(f"{self.pick_seed}:{rid}")
 
 
 @register_plugin("max-score-picker")
@@ -34,7 +57,7 @@ class MaxScorePicker(_PickerBase):
         if not scored:
             return []
         pool = list(scored)
-        self._rng.shuffle(pool)  # randomize tie order
+        self._rng_for(request).shuffle(pool)  # randomize tie order
         pool.sort(key=lambda s: s.score, reverse=True)
         return [s.endpoint for s in pool[: self.max_endpoints]]
 
@@ -44,7 +67,8 @@ class RandomPicker(_PickerBase):
     def pick(self, ctx, state, request, scored: list[ScoredEndpoint]):
         if not scored:
             return []
-        picked = self._rng.sample(scored, k=min(self.max_endpoints, len(scored)))
+        picked = self._rng_for(request).sample(
+            scored, k=min(self.max_endpoints, len(scored)))
         return [s.endpoint for s in picked]
 
 
@@ -55,14 +79,15 @@ class WeightedRandomPicker(_PickerBase):
     def pick(self, ctx, state, request, scored: list[ScoredEndpoint]):
         pool = list(scored)
         out = []
+        rng = self._rng_for(request)
         while pool and len(out) < self.max_endpoints:
             total = sum(max(s.score, 0.0) for s in pool)
             if total <= 0:
                 out.extend(s.endpoint for s in
-                           self._rng.sample(pool, k=min(self.max_endpoints - len(out),
-                                                        len(pool))))
+                           rng.sample(pool, k=min(self.max_endpoints - len(out),
+                                                  len(pool))))
                 break
-            r = self._rng.uniform(0, total)
+            r = rng.uniform(0, total)
             acc = 0.0
             for i, s in enumerate(pool):
                 acc += max(s.score, 0.0)

@@ -130,6 +130,28 @@ class PoolSnapshot:
              _copy_dict(ep.attributes._data))
             for ep in endpoints]
 
+    @classmethod
+    def from_entries(cls, epoch: int,
+                     entries: Iterable[tuple[EndpointMetadata, Metrics, dict]]
+                     ) -> "PoolSnapshot":
+        """Rehydrate a snapshot from already-materialized entries — the
+        fleet's snapshot-IPC path (router/fleet.py): a follower worker
+        installs the leader's published epoch verbatim instead of rebuilding
+        its own, so a batch dispatched in any worker schedules against the
+        same epoch it would have seen single-process."""
+        snap = cls.__new__(cls)
+        snap.epoch = epoch
+        snap.built_at = time.monotonic()
+        snap._entries = [(meta, metrics, dict(attrs))
+                         for meta, metrics, attrs in entries]
+        return snap
+
+    def entries(self) -> list[tuple[EndpointMetadata, Metrics, dict]]:
+        """The raw (metadata, metrics, attrs) entries — the serialization
+        unit the fleet's snapshot publisher pickles onto the IPC socket.
+        Treat as immutable: the tuples are shared with live views."""
+        return self._entries
+
     def __len__(self) -> int:
         return len(self._entries)
 

@@ -63,13 +63,21 @@ REQUIRED_FAMILIES = {
     ("router_degraded_requests", "router"),
     ("router_retry_after_seconds", "router"),
     ("router_queue_drain_rate", "router"),
+    # Multi-process sharded fleet (ISSUE 9): per-worker snapshot epoch and
+    # the supervisor's shard-labeled liveness/request/epoch families.
+    ("router_snapshot_epoch", "router"),
+    ("router_fleet_workers", "fleet"),
+    ("router_shard_up", "fleet"),
+    ("router_shard_snapshot_epoch", "fleet"),
+    ("router_shard_requests", "fleet"),
+    ("router_fleet_balancer_connections", "fleet"),
 }
 
 # Registries whose every family must have a docs/metrics.md row (the
 # registry↔docs sync lint below). The engine's jetstream:* step families are
-# documented in bulk in observability.md, so only the router and sidecar
-# surfaces are pinned row-by-row.
-DOC_SYNCED_SOURCES = {"router", "sidecar"}
+# documented in bulk in observability.md, so only the router, sidecar, and
+# fleet-supervisor surfaces are pinned row-by-row.
+DOC_SYNCED_SOURCES = {"router", "sidecar", "fleet"}
 
 
 def _families(registry, source: str):
@@ -97,7 +105,10 @@ def _families(registry, source: str):
 def collect_registries():
     """(name, registry) for every component registry in the tree."""
     from llm_d_inference_scheduler_tpu.engine.telemetry import EngineTelemetry
-    from llm_d_inference_scheduler_tpu.router.metrics import REGISTRY
+    from llm_d_inference_scheduler_tpu.router.metrics import (
+        FLEET_REGISTRY,
+        REGISTRY,
+    )
     from llm_d_inference_scheduler_tpu.router.sidecar.proxy import (
         Sidecar,
         SidecarConfig,
@@ -109,6 +120,7 @@ def collect_registries():
         ("router", REGISTRY),
         ("engine", engine.registry),
         ("sidecar", sidecar.metrics_registry),
+        ("fleet", FLEET_REGISTRY),
     ]
 
 
@@ -157,15 +169,55 @@ def check() -> list[str]:
     return errors
 
 
+def lint_exposition(text: str) -> list[str]:
+    """Lint one text exposition — notably the fleet supervisor's MERGED
+    /metrics — for duplicate family declarations (a family whose HELP/TYPE
+    block appears twice makes the scrape ambiguous; Prometheus keeps one
+    arbitrarily) and for unparseable content."""
+    from prometheus_client.parser import text_string_to_metric_families
+
+    errors: list[str] = []
+    try:
+        names = [fam.name for fam in text_string_to_metric_families(text)]
+    except Exception as e:
+        return [f"merged exposition does not parse: {e}"]
+    seen: set[str] = set()
+    for name in names:
+        if name in seen:
+            errors.append(f"duplicate family {name!r} in merged exposition")
+        seen.add(name)
+    return errors
+
+
+def check_merged_exposition() -> list[str]:
+    """Merge the live router registry with itself through the fleet's
+    exposition merger (router/fleet.py merge_expositions + the supervisor's
+    FLEET_REGISTRY tail) and lint the result — the static twin of the
+    supervisor's /metrics fan-in."""
+    from prometheus_client import generate_latest
+
+    from llm_d_inference_scheduler_tpu.router.fleet import merge_expositions
+    from llm_d_inference_scheduler_tpu.router.metrics import (
+        FLEET_REGISTRY,
+        REGISTRY,
+    )
+
+    worker = generate_latest(REGISTRY).decode()
+    merged = (merge_expositions([worker, worker])
+              + generate_latest(FLEET_REGISTRY).decode())
+    return lint_exposition(merged)
+
+
 def main() -> int:
-    errors = check()
+    errors = check() + check_merged_exposition()
     for e in errors:
         print(f"verify-metrics: {e}", file=sys.stderr)
     if errors:
         return 1
     n = sum(len(list(reg.collect())) for _, reg in collect_registries())
-    print(f"verify-metrics: {n} families across router/engine/sidecar "
-          "registries — no duplicates, no high-cardinality labels")
+    print(f"verify-metrics: {n} families across router/engine/sidecar/"
+          "fleet registries — no duplicates, no high-cardinality labels, "
+          "merged fleet exposition clean")
     return 0
 
 

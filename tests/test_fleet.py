@@ -1,0 +1,614 @@
+"""Multi-process sharded gateway fleet (ISSUE 9, router/fleet.py).
+
+Hermetic tiers: pure-function units (flow sharding, seeded picks, the
+exposition/SLO mergers), the snapshot-IPC pub/sub loop in one process, the
+fan-in admin plane against stub workers, and one real 2-worker fleet e2e
+(spawned processes, hash balancer, snapshot IPC, sim engines).
+"""
+
+import asyncio
+import json
+import os
+import sys
+
+import httpx
+import pytest
+from aiohttp import web
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts"))
+
+from llm_d_inference_scheduler_tpu.router.datalayer.datastore import Datastore
+from llm_d_inference_scheduler_tpu.router.fleet import (
+    FleetAdmin,
+    FleetConfig,
+    SnapshotPublisher,
+    SnapshotSubscriber,
+    flow_shard,
+    merge_expositions,
+    merge_slo,
+)
+from llm_d_inference_scheduler_tpu.router.framework.datalayer import (
+    EndpointMetadata,
+    Metrics,
+)
+
+GW, E1, E2 = 19070, 19071, 19072
+ADMIN = 19080
+STUB_A, STUB_B, STUB_ADMIN = 19060, 19061, 19062
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ---- flow sharding ------------------------------------------------------
+
+def test_flow_shard_stable_and_disjoint():
+    # Deterministic across calls (and, because it's xxh64 not hash(),
+    # across processes — the property the balancer and bench rely on).
+    assert flow_shard("flow-a", 4) == flow_shard("flow-a", 4)
+    assert flow_shard("anything", 1) == 0
+    # Every flow owned by exactly one shard; a 64-flow population touches
+    # every shard of a 4-way fleet.
+    owners = {f"flow-{i}": flow_shard(f"flow-{i}", 4) for i in range(64)}
+    assert set(owners.values()) == {0, 1, 2, 3}
+    assert all(0 <= s < 4 for s in owners.values())
+
+
+# ---- seeded picker (scheduling.pickSeed satellite) ----------------------
+
+def _scored(n=8, score=1.0):
+    from llm_d_inference_scheduler_tpu.router.framework.scheduling import (
+        ScoredEndpoint,
+    )
+
+    class _Ep:
+        def __init__(self, i):
+            self.metadata = EndpointMetadata(name=f"e{i}",
+                                             address=f"10.0.0.{i}", port=80)
+
+    return [ScoredEndpoint(_Ep(i), score) for i in range(n)]
+
+
+def test_pick_seed_is_per_request_deterministic():
+    from llm_d_inference_scheduler_tpu.router.framework.scheduling import (
+        InferenceRequest,
+        InferenceRequestBody,
+    )
+    from llm_d_inference_scheduler_tpu.router.plugins.pickers import (
+        MaxScorePicker,
+    )
+
+    def req(rid):
+        return InferenceRequest(request_id=rid, target_model="m",
+                                body=InferenceRequestBody())
+
+    a, b = MaxScorePicker("a"), MaxScorePicker("b")
+    a.configure({"pickSeed": 7}, None)
+    b.configure({"pickSeed": 7}, None)
+    # All-tied scores: the pick is pure tie-break RNG. Same (seed,
+    # request_id) must pick identically NO MATTER the draw order — picker b
+    # burns draws on other requests first (the sharded-fleet situation:
+    # each worker sees a different interleaving of the stream).
+    for other in ("r-x", "r-y", "r-z"):
+        b.pick(None, None, req(other), _scored())
+    for rid in ("r-1", "r-2", "r-3"):
+        pa = a.pick(None, None, req(rid), _scored())
+        pb = b.pick(None, None, req(rid), _scored())
+        assert [e.metadata.name for e in pa] == [e.metadata.name for e in pb]
+    # Unseeded pickers keep the historical shared-RNG behavior (the
+    # kill-switch: pick_seed defaults to None).
+    assert MaxScorePicker("c").pick_seed is None
+
+
+def test_pick_seed_flows_from_config():
+    import llm_d_inference_scheduler_tpu.router.plugins  # noqa: F401
+    from llm_d_inference_scheduler_tpu.router.config.loader import (
+        Handle,
+        load_config,
+    )
+    from llm_d_inference_scheduler_tpu.router.datalayer.runtime import (
+        DataLayerRuntime,
+    )
+
+    ds = Datastore()
+    cfg = load_config("scheduling: {pickSeed: 42}\n",
+                      Handle(datastore=ds, dl_runtime=DataLayerRuntime(ds)))
+    assert cfg.scheduler.profiles["default"].picker.pick_seed == 42
+    # A per-picker pickSeed parameter wins over the profile-wide knob.
+    ds2 = Datastore()
+    cfg2 = load_config(
+        "scheduling: {pickSeed: 42}\n"
+        "plugins:\n"
+        "  - {type: max-score-picker, parameters: {pickSeed: 9}}\n"
+        "schedulingProfiles:\n"
+        "  - name: default\n"
+        "    plugins: [{pluginRef: max-score-picker}]\n",
+        Handle(datastore=ds2, dl_runtime=DataLayerRuntime(ds2)))
+    assert cfg2.scheduler.profiles["default"].picker.pick_seed == 9
+
+
+# ---- fleet config -------------------------------------------------------
+
+def test_fleet_config_spec():
+    cfg = FleetConfig.from_spec(None)
+    assert (cfg.workers, cfg.balancer, cfg.snapshot_ipc) == (1, "reuseport",
+                                                            True)
+    cfg = FleetConfig.from_spec({"workers": 4, "balancer": "hash",
+                                 "snapshotIpc": False, "adminPort": 9911})
+    assert (cfg.workers, cfg.balancer, cfg.snapshot_ipc,
+            cfg.admin_port) == (4, "hash", False, 9911)
+    with pytest.raises(ValueError):
+        FleetConfig.from_spec({"balancer": "round-robin"})
+
+
+def test_fleet_cli_workers_1_override_pins_single_process(monkeypatch):
+    """`…router.fleet --workers 1 --poll-interval …` against a config
+    declaring workers: 4 must run ONE plain gateway (not re-enter fleet
+    mode via the config) and honor the poll interval."""
+    import llm_d_inference_scheduler_tpu.router.fleet as fleet_mod
+    import llm_d_inference_scheduler_tpu.router.gateway as gateway_mod
+
+    captured: dict = {}
+
+    def fake_build(text, *, host, port, poll_interval, **kw):
+        captured.update(host=host, port=port, poll_interval=poll_interval)
+        return "gw"
+
+    async def fake_run(gw, drain_timeout_s):
+        captured["ran"] = gw
+
+    monkeypatch.setattr(gateway_mod, "build_gateway", fake_build)
+    monkeypatch.setattr(gateway_mod, "run_gateway", fake_run)
+    monkeypatch.setattr(
+        fleet_mod, "FleetSupervisor",
+        lambda *a, **k: (_ for _ in ()).throw(
+            AssertionError("fleet mode entered despite --workers 1")))
+    fleet_mod.main(["--workers", "1", "--poll-interval", "1.0",
+                    "--config-text", "fleet: {workers: 4}\n"])
+    assert captured["ran"] == "gw"
+    assert captured["poll_interval"] == 1.0
+
+
+# ---- exposition merge ---------------------------------------------------
+
+def test_merge_expositions_sums_and_dedupes():
+    from prometheus_client import (
+        CollectorRegistry,
+        Counter,
+        Gauge,
+        Histogram,
+        generate_latest,
+    )
+    from prometheus_client.parser import text_string_to_metric_families
+
+    from verify_metrics import lint_exposition
+
+    def worker(v):
+        r = CollectorRegistry()
+        c = Counter("req", "Requests", ("model",), registry=r)
+        c.labels("a").inc(v)
+        g = Gauge("inference_pool_ready_pods", "Pods", registry=r)
+        g.set(2)  # replicated: every worker sees the same pool
+        q = Gauge("queued", "Queued", registry=r)
+        q.set(v)  # per-worker: sums
+        h = Histogram("lat", "Latency", registry=r, buckets=(0.1, 1))
+        h.observe(v / 10)
+        return generate_latest(r).decode()
+
+    merged = merge_expositions([worker(3), worker(4)])
+    assert lint_exposition(merged) == []
+    fams = {f.name: f for f in text_string_to_metric_families(merged)}
+    assert [s.value for s in fams["req"].samples
+            if s.name == "req_total"] == [7.0]
+    assert fams["req"].type == "counter"
+    assert [s.value for s in fams["inference_pool_ready_pods"].samples] == [2.0]
+    assert [s.value for s in fams["queued"].samples] == [7.0]
+    assert [s.value for s in fams["lat"].samples
+            if s.name == "lat_count"] == [2.0]
+    assert [s.value for s in fams["lat"].samples
+            if s.name == "lat_sum"] == [0.7]
+
+
+def test_merge_bounded_gauges_take_max_not_sum():
+    """Ratio and enum gauges must never leave their domain on the merged
+    exposition: two workers at 0.9 attainment is 0.9 fleet-wide (worst/
+    best-state view; the request-weighted merge lives in /debug/slo), and
+    two open breakers (state 2) are state 2, not 4."""
+    from prometheus_client import CollectorRegistry, Gauge, generate_latest
+    from prometheus_client.parser import text_string_to_metric_families
+
+    def worker(attain, breaker):
+        r = CollectorRegistry()
+        a = Gauge("router_slo_attainment", "A", ("endpoint",), registry=r)
+        a.labels("10.0.0.1:8000").set(attain)
+        b = Gauge("router_endpoint_circuit_breaker_state", "B",
+                  ("endpoint",), registry=r)
+        b.labels("10.0.0.1:8000").set(breaker)
+        return generate_latest(r).decode()
+
+    merged = merge_expositions([worker(0.9, 2), worker(0.8, 1)])
+    fams = {f.name: f for f in text_string_to_metric_families(merged)}
+    assert [s.value for s in fams["router_slo_attainment"].samples] == [0.9]
+    assert [s.value for s in
+            fams["router_endpoint_circuit_breaker_state"].samples] == [2.0]
+
+
+def test_balancer_flow_id_parses_bare_colon_headers():
+    """RFC 7230 allows 'name:value' with no space after the colon; the
+    balancer must still see the flow header (falling back to the peer
+    address would fragment the flow across shards per-connection)."""
+    from llm_d_inference_scheduler_tpu.router.fleet import HashBalancer
+
+    bal = HashBalancer("127.0.0.1", 0, [("127.0.0.1", 1)])
+    head = (b"POST /v1/completions HTTP/1.1\r\n"
+            b"host: x\r\n"
+            b"x-gateway-inference-fairness-id:flow-7\r\n\r\n")
+    assert bal._flow_id(head, ("1.2.3.4", 55555)) == "flow-7"
+    head_spaced = head.replace(b"id:flow-7", b"id: flow-7")
+    assert bal._flow_id(head_spaced, ("1.2.3.4", 55555)) == "flow-7"
+    # Anonymous fallback: peer ADDRESS only — the ephemeral port would
+    # randomize shard affinity per connection.
+    assert bal._flow_id(b"GET / HTTP/1.1\r\n\r\n",
+                        ("1.2.3.4", 55555)) == "1.2.3.4"
+
+
+# ---- /debug/slo merge ---------------------------------------------------
+
+def _slo_doc(requests, met, tokens, ep="10.0.0.1:8000", n_pred=0):
+    agg = {"requests": requests, "slo_met": met, "shed": 0,
+           "attainment": round(met / requests, 4) if requests else None,
+           "output_tokens": tokens, "goodput_tokens": tokens,
+           "predictor": {"ttft": ({"n": n_pred, "mae_ms": 100.0,
+                                   "mean_signed_ms": -10.0} if n_pred
+                                  else {"n": 0}),
+                         "tpot": {"n": 0}}}
+    return {"enabled": True, "since_unix": 1000.0, "totals": dict(agg),
+            "endpoints": {ep: dict(agg)}, "bands": {"0": {
+                "requests": requests, "slo_met": met, "shed": 0,
+                "output_tokens": tokens, "goodput_tokens": tokens}},
+            "miss_reasons": {"ttft": requests - met}, "shed_reasons": {}}
+
+
+def test_merge_slo_equals_sum_of_ledgers():
+    merged = merge_slo([_slo_doc(4, 3, 40, n_pred=2),
+                        _slo_doc(6, 6, 60, n_pred=4)])
+    t = merged["totals"]
+    assert (t["requests"], t["slo_met"], t["output_tokens"]) == (10, 9, 100)
+    assert t["attainment"] == 0.9          # recomputed, never averaged
+    assert t["goodput_ratio"] == 1.0
+    assert t["predictor"]["ttft"]["n"] == 6
+    assert t["predictor"]["ttft"]["mae_ms"] == 100.0
+    ep = merged["endpoints"]["10.0.0.1:8000"]
+    assert (ep["requests"], ep["slo_met"]) == (10, 9)
+    assert merged["bands"]["0"]["requests"] == 10
+    assert merged["miss_reasons"] == {"ttft": 1}
+    assert merged["workers"] == 2
+
+
+# ---- remote snapshots (datastore unit) ----------------------------------
+
+def _entries(*specs):
+    out = []
+    for addr, queue in specs:
+        meta = EndpointMetadata(name=addr, address=addr.split(":")[0],
+                                port=int(addr.split(":")[1]))
+        out.append((meta, Metrics(waiting_queue_size=queue), {"warm": True}))
+    return out
+
+
+def test_apply_remote_snapshot_installs_leader_epoch():
+    ds = Datastore()
+    ds.apply_remote_snapshot(42, _entries(("10.0.0.1:8000", 5)))
+    assert ds.snapshot().epoch == 42
+    ep = ds.endpoint_get("10.0.0.1:8000")
+    assert ep is not None and ep.metrics.waiting_queue_size == 5
+    view = ds.snapshot().view()
+    assert view[0].attributes.get("warm") is True
+    # Remote mode: local dirty flags no longer mint local epochs (the
+    # leader's numbering is authoritative)...
+    ds.mark_snapshot_dirty()
+    assert ds.snapshot().epoch == 42
+    # ...membership follows the NEXT frame, including deletions.
+    ds.apply_remote_snapshot(43, _entries(("10.0.0.2:8000", 1)))
+    assert ds.snapshot().epoch == 43
+    assert ds.endpoint_get("10.0.0.1:8000") is None
+    assert ds.endpoint_get("10.0.0.2:8000") is not None
+    assert len(ds.snapshot()) == 1
+
+
+def test_snapshot_ipc_round_trip(tmp_path):
+    async def body():
+        path = str(tmp_path / "snap.sock")
+        leader, follower = Datastore(), Datastore()
+        leader.endpoint_add_or_update(EndpointMetadata(
+            name="e1", address="10.0.0.1", port=8000))
+        leader.endpoint_get("10.0.0.1:8000").metrics.waiting_queue_size = 5
+        pub = SnapshotPublisher(leader, path, interval_s=0.01)
+        await pub.start()
+        sub = SnapshotSubscriber(follower, path, retry_s=0.02)
+        sub.start()
+        try:
+            for _ in range(200):
+                if follower.endpoint_get("10.0.0.1:8000") is not None:
+                    break
+                await asyncio.sleep(0.01)
+            fep = follower.endpoint_get("10.0.0.1:8000")
+            assert fep is not None and fep.metrics.waiting_queue_size == 5
+            assert follower.snapshot().epoch == leader.snapshot().epoch
+            # A scrape landing publishes a NEW epoch with the new metrics.
+            leader.endpoint_get("10.0.0.1:8000").metrics.waiting_queue_size = 9
+            leader.mark_snapshot_dirty()
+            for _ in range(200):
+                if (follower.endpoint_get("10.0.0.1:8000")
+                        .metrics.waiting_queue_size == 9):
+                    break
+                await asyncio.sleep(0.01)
+            assert (follower.endpoint_get("10.0.0.1:8000")
+                    .metrics.waiting_queue_size) == 9
+            # Membership deletions propagate too.
+            leader.endpoint_delete("10.0.0.1:8000")
+            for _ in range(200):
+                if follower.endpoint_get("10.0.0.1:8000") is None:
+                    break
+                await asyncio.sleep(0.01)
+            assert follower.endpoint_get("10.0.0.1:8000") is None
+            assert len(follower.snapshot()) == 0
+        finally:
+            await sub.stop()
+            await pub.stop()
+
+    run(body())
+
+
+# ---- fan-in admin plane against stub workers ----------------------------
+
+STUB_METRICS = """\
+# HELP inference_extension_request_total Requests handled
+# TYPE inference_extension_request_total counter
+inference_extension_request_total{{model="tiny",target_model="tiny"}} {req}
+# HELP router_snapshot_epoch Snapshot epoch
+# TYPE router_snapshot_epoch gauge
+router_snapshot_epoch {epoch}
+# HELP inference_pool_ready_pods Pods
+# TYPE inference_pool_ready_pods gauge
+inference_pool_ready_pods 2.0
+"""
+
+
+def _stub_worker(port, *, req, epoch, decision_rid=None):
+    app = web.Application()
+
+    async def metrics(request):
+        return web.Response(text=STUB_METRICS.format(req=req, epoch=epoch),
+                            content_type="text/plain")
+
+    async def decision(request):
+        rid = request.match_info["request_id"]
+        if rid != decision_rid:
+            return web.json_response({"error": "not here"}, status=404)
+        return web.json_response({"request_id": rid, "final": {"code": 200}})
+
+    async def slo(request):
+        return web.json_response(_slo_doc(req, req, req * 4))
+
+    async def transfers(request):
+        return web.json_response({"pairs": [{"prefill": "p:1", "decode": "d:1",
+                                             "pull_ms": 2.0}]})
+
+    async def health(request):
+        return web.json_response({"status": "ok"})
+
+    app.add_routes([web.get("/metrics", metrics),
+                    web.get("/debug/decisions/{request_id}", decision),
+                    web.get("/debug/slo", slo),
+                    web.get("/debug/transfers", transfers),
+                    web.get("/health", health)])
+    return app, port
+
+
+def test_fleet_admin_fan_in_with_stub_workers():
+    from prometheus_client.parser import text_string_to_metric_families
+
+    from verify_metrics import lint_exposition
+
+    async def body():
+        runners = []
+        for app, port in (_stub_worker(STUB_A, req=3, epoch=7,
+                                       decision_rid=None),
+                          _stub_worker(STUB_B, req=5, epoch=7,
+                                       decision_rid="req-owned-by-b")):
+            runner = web.AppRunner(app)
+            await runner.setup()
+            await web.TCPSite(runner, "127.0.0.1", port).start()
+            runners.append(runner)
+        admin = FleetAdmin([("127.0.0.1", STUB_A), ("127.0.0.1", STUB_B)],
+                           host="127.0.0.1", port=STUB_ADMIN)
+        await admin.start()
+        try:
+            async with httpx.AsyncClient(timeout=10) as c:
+                base = f"http://127.0.0.1:{STUB_ADMIN}"
+                # Merged /metrics: parses, no duplicate families, counters
+                # summed, replicated pool gauge NOT summed, shard families
+                # present.
+                r = await c.get(base + "/metrics")
+                assert r.status_code == 200
+                assert lint_exposition(r.text) == []
+                fams = {f.name: f
+                        for f in text_string_to_metric_families(r.text)}
+                req_total = [s.value
+                             for s in fams["inference_extension_request"].samples
+                             if s.name.endswith("_total")]
+                assert req_total == [8.0]
+                assert [s.value for s in
+                        fams["inference_pool_ready_pods"].samples] == [2.0]
+                up = {s.labels["shard"]: s.value
+                      for s in fams["router_shard_up"].samples}
+                assert up == {"0": 1.0, "1": 1.0}
+                epochs = {s.labels["shard"]: s.value
+                          for s in fams["router_shard_snapshot_epoch"].samples}
+                assert epochs == {"0": 7.0, "1": 7.0}
+                shard_req = {s.labels["shard"]: s.value
+                             for s in fams["router_shard_requests"].samples
+                             if s.name.endswith("_total")}
+                assert shard_req["0"] >= 3.0 and shard_req["1"] >= 5.0
+                # Record lookup routes to the owning shard (worker B).
+                r = await c.get(base + "/debug/decisions/req-owned-by-b")
+                assert r.status_code == 200
+                assert r.json()["shard"] == 1
+                r = await c.get(base + "/debug/decisions/req-nowhere")
+                assert r.status_code == 404
+                # /debug/slo equals the sum of the per-worker ledgers.
+                r = await c.get(base + "/debug/slo")
+                totals = r.json()["totals"]
+                assert (totals["requests"], totals["slo_met"]) == (8, 8)
+                assert totals["output_tokens"] == 32
+                # /debug/transfers: per-shard rows, shard-annotated.
+                r = await c.get(base + "/debug/transfers")
+                pairs = r.json()["pairs"]
+                assert len(pairs) == 2
+                assert {p["shard"] for p in pairs} == {0, 1}
+                # /health aggregates worker states.
+                r = await c.get(base + "/health")
+                assert r.status_code == 200
+                assert r.json()["workers_ready"] == 2
+                # Counter monotonicity across a worker outage: with shard B
+                # down, the merge serves B's last-seen families instead of
+                # letting fleet *_total counters dip (Prometheus would read
+                # the dip + recovery as a counter reset and spike rate()).
+                await runners[1].cleanup()
+                r = await c.get(base + "/metrics")
+                fams = {f.name: f
+                        for f in text_string_to_metric_families(r.text)}
+                req_total = [s.value
+                             for s in fams["inference_extension_request"].samples
+                             if s.name.endswith("_total")]
+                assert req_total == [8.0]  # B's 5.0 still contributes
+                up = {s.labels["shard"]: s.value
+                      for s in fams["router_shard_up"].samples}
+                assert up == {"0": 1.0, "1": 0.0}
+        finally:
+            await admin.stop()
+            for runner in runners:
+                await runner.cleanup()
+
+    run(body())
+
+
+# ---- real 2-worker fleet e2e --------------------------------------------
+
+FLEET_CFG = f"""
+pool:
+  endpoints:
+    - {{address: 127.0.0.1, port: {E1}}}
+    - {{address: 127.0.0.1, port: {E2}}}
+scheduling: {{pickSeed: 7}}
+"""
+
+
+def test_fleet_e2e_two_workers_hash_balancer():
+    """The full shape: 2 spawned gateway workers behind the hash balancer,
+    snapshot IPC from the worker-0 leader, sim engines, and the
+    supervisor's fan-in plane — merged /metrics parses clean, the decision
+    lookup resolves through the supervisor to whichever shard served, and
+    the follower tracks the leader's snapshot epochs."""
+    from prometheus_client.parser import text_string_to_metric_families
+
+    from llm_d_inference_scheduler_tpu.engine import EngineConfig
+    from llm_d_inference_scheduler_tpu.engine.server import EngineServer
+    from llm_d_inference_scheduler_tpu.router.fleet import FleetSupervisor
+    from verify_metrics import lint_exposition
+
+    async def body():
+        engines = []
+        for port in (E1, E2):
+            s = EngineServer(EngineConfig(backend="sim", model="tiny",
+                                          port=port, max_batch=4,
+                                          sim_decode_ms_per_token=1.0))
+            await s.start()
+            engines.append(s)
+        sup = FleetSupervisor(
+            FLEET_CFG, host="127.0.0.1", port=GW,
+            fleet=FleetConfig(workers=2, balancer="hash", admin_port=ADMIN),
+            poll_interval=0.02, drain_timeout_s=2.0)
+        await sup.start()
+        try:
+            served_shards = set()
+            rids = []
+            for i in range(4):
+                rid = f"fleet-e2e-{i}"
+                rids.append(rid)
+                # One client per request = one connection per request, so
+                # the balancer routes each flow independently (keep-alive
+                # connections are flow-sticky by design).
+                async with httpx.AsyncClient(timeout=30) as c:
+                    r = await c.post(
+                        f"http://127.0.0.1:{GW}/v1/completions",
+                        headers={"x-request-id": rid,
+                                 "x-gateway-inference-fairness-id":
+                                     f"flow-{i}"},
+                        json={"model": "tiny", "prompt": f"hello {i}",
+                              "max_tokens": 4})
+                assert r.status_code == 200
+                assert r.headers["x-router-shard"] in ("0", "1")
+                served_shards.add(r.headers["x-router-shard"])
+            # flow-0..3 hash across both shards (fixed xxh64 assignment).
+            assert served_shards == {"0", "1"}
+
+            async with httpx.AsyncClient(timeout=30) as c:
+                base = f"http://127.0.0.1:{ADMIN}"
+                r = await c.get(base + "/metrics")
+                assert r.status_code == 200
+                assert lint_exposition(r.text) == []
+                fams = {f.name: f
+                        for f in text_string_to_metric_families(r.text)}
+                served = sum(
+                    s.value for s in fams["inference_extension_request"].samples
+                    if s.name.endswith("_total"))
+                assert served == 4.0
+                # Snapshot IPC: the follower's applied epoch tracks the
+                # leader's published one (both shards report a live epoch).
+                epochs = {s.labels["shard"]: s.value
+                          for s in fams["router_shard_snapshot_epoch"].samples}
+                assert set(epochs) == {"0", "1"}
+                assert all(v >= 1.0 for v in epochs.values())
+                assert {s.labels["shard"]: s.value
+                        for s in fams["router_shard_up"].samples} == {
+                            "0": 1.0, "1": 1.0}
+                # Hash balancer counted each flow's connection.
+                bal = sum(s.value for s in
+                          fams["router_fleet_balancer_connections"].samples
+                          if s.name.endswith("_total"))
+                assert bal >= 4.0
+                # Every request's decision record resolves through the
+                # supervisor to the shard that served it.
+                for rid in rids:
+                    r = await c.get(base + f"/debug/decisions/{rid}")
+                    assert r.status_code == 200, rid
+                    assert r.json()["shard"] in (0, 1)
+                # The merged list view covers all shards' records,
+                # shard-annotated, newest first.
+                r = await c.get(base + "/debug/decisions")
+                doc = r.json()
+                assert doc["count"] == 4 and doc["enabled"]
+                listed = {d["request_id"] for d in doc["decisions"]}
+                assert set(rids) <= listed
+                assert all("shard" in d for d in doc["decisions"])
+                stamps = [d["start_unix"] for d in doc["decisions"]]
+                assert stamps == sorted(stamps, reverse=True)
+                # ?n bounds the MERGED page, not n-per-worker.
+                r = await c.get(base + "/debug/decisions?n=1")
+                assert len(r.json()["decisions"]) == 1
+                # Fleet SLO rollup saw all four requests.
+                r = await c.get(base + "/debug/slo")
+                assert r.json()["totals"]["requests"] == 4
+                r = await c.get(base + "/health")
+                assert r.status_code == 200
+                assert r.json()["workers_ready"] == 2
+        finally:
+            await sup.stop()
+            for e in engines:
+                await e.stop()
+
+    run(body())

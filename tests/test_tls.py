@@ -13,6 +13,11 @@ import httpx
 import pytest
 from aiohttp import web
 
+# The whole module mints/verifies real certificates: without the
+# cryptography wheel every test here would ERROR at setup (longstanding
+# tier-1 noise on slim images) — report 6 clean skips instead.
+pytest.importorskip("cryptography")
+
 from llm_d_inference_scheduler_tpu.engine import EngineConfig
 from llm_d_inference_scheduler_tpu.engine.server import EngineServer
 from llm_d_inference_scheduler_tpu.router.gateway import build_gateway
