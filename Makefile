@@ -6,17 +6,18 @@ PY ?= python
 
 .PHONY: test test-fast test-unit test-dist test-chaos bench bench-flowcontrol \
 	bench-router-sse bench-decisions bench-sched bench-sched-offload \
-	bench-scaleout bench-slo bench-overload dryrun render-chart compile-check \
+	bench-scaleout bench-slo bench-overload bench-kvobs dryrun render-chart \
+	compile-check \
 	verify-metrics verify-decisions verify-hotpath verify-threadsafe \
-	verify-slo
+	verify-slo verify-debug
 
 # Full hermetic suite (virtual 8-device CPU mesh; no TPU or cluster needed —
 # the reference needs envtest + kind for the equivalent coverage).
-test: verify-metrics verify-decisions verify-hotpath verify-threadsafe verify-slo
+test: verify-metrics verify-decisions verify-hotpath verify-threadsafe verify-slo verify-debug
 	$(PY) -m pytest tests/ -q
 
 # Everything except the spawned-process distributed tests (the slow tail).
-test-fast: verify-metrics verify-decisions verify-hotpath verify-threadsafe
+test-fast: verify-metrics verify-decisions verify-hotpath verify-threadsafe verify-debug
 	$(PY) -m pytest tests/ -q --deselect tests/test_multihost.py \
 		--deselect tests/test_multihost_pd.py
 
@@ -51,6 +52,13 @@ verify-threadsafe:
 # tests/test_slo.py).
 verify-slo:
 	$(PY) scripts/verify_slo.py
+
+# Debug-surface lint: every registered /debug route (gateway + fleet
+# supervisor) must answer JSON and have a row in docs/observability.md's
+# "Debug surfaces" index table — the debug-plane twin of verify-metrics'
+# docs-sync lint (also hooked into pytest via tests/test_kvobs.py).
+verify-debug:
+	$(PY) scripts/verify_debug.py
 
 # Recorder-overhead microbench on the flow-control dispatch path (CPU-only;
 # writes benchmarks/DECISIONS_MICRO.json — target <3%, kill-switch ~0%).
@@ -96,6 +104,16 @@ bench-slo:
 # overload wasted-token fraction < 0.15, with every shed explained.
 bench-overload:
 	$(PY) bench.py --overload-ramp
+
+# KV-cache observability bench (CPU-only): the cache ledger's per-request
+# hook cost vs the scheduling-cycle floor (kill-switch ~0%), then a
+# shared-prefix workload (cold round, warm round) through a real gateway +
+# sim engines reporting hit-prediction MAE warm vs cold and the actual hit
+# ratio the engines confirmed. Writes benchmarks/KV_OBS.json — the
+# measurement groundwork ROADMAP item 2's prefill classifier is judged
+# against.
+bench-kvobs:
+	$(PY) bench.py --kv-obs
 
 test-unit: test-fast
 

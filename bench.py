@@ -1817,6 +1817,250 @@ schedulingProfiles:
     }
 
 
+def kv_obs_bench(quick: bool = False) -> dict:
+    """KV-cache & prefix-reuse observability bench (CPU-only, no chip).
+
+    Two phases, written to benchmarks/KV_OBS.json:
+
+    - **micro**: one request's full cache-ledger lifecycle
+      (``CacheLedger.record_scheduled`` + the header-time and terminal
+      ``observe_response`` joins) timed in a tight loop, as a percentage of
+      the measured scheduling-cycle floor (the 128-endpoint × 64-block
+      per-request cost from benchmarks/SCHED_HOTPATH.json the acceptance
+      names); the ``kvCache: {enabled: false}`` kill-switch path timed the
+      same way, ≈0%.
+    - **workload**: a real gateway (approx prefix producer + prefix scorer)
+      over two sim engines, driven with a shared-prefix multi-user
+      workload — every prompt sent cold then again warm — and the
+      per-request DecisionRecord ``cache`` blocks read back to compute the
+      hit-prediction MAE (ratio units, unit-free across char-mode
+      prediction vs token-mode actual) cold vs warm, plus the
+      engine-confirmed actual hit ratio on the warm round (> 0 is the
+      ledger-populated contract). A kill-switch run confirms zero stamps.
+    """
+    import asyncio
+    import gc
+
+    from llm_d_inference_scheduler_tpu.router.framework.datalayer import (
+        Endpoint,
+        EndpointMetadata,
+    )
+    from llm_d_inference_scheduler_tpu.router.framework.scheduling import (
+        InferenceRequest,
+        InferenceRequestBody,
+        ProfileRunResult,
+        SchedulingResult,
+    )
+    from llm_d_inference_scheduler_tpu.router.kvobs import (
+        CacheLedger,
+        KvObsConfig,
+    )
+    from llm_d_inference_scheduler_tpu.router.plugins.attributes import (
+        PREFIX_ATTRIBUTE_KEY,
+        PrefixCacheMatchInfo,
+    )
+
+    # ---- micro: per-request hook cost vs the scheduling-cycle floor ----
+    here = os.path.dirname(os.path.abspath(__file__))
+    floor_us = 2000.0  # conservative default: the PR 4 128x64 cycle cost
+    try:
+        with open(os.path.join(here, "benchmarks",
+                               "SCHED_HOTPATH.json")) as f:
+            sweep = json.load(f)["sweep"]
+        floor_us = min(r["us_per_req_after"] for r in sweep
+                       if r.get("endpoints") == 128 and r.get("blocks") == 64)
+    except (OSError, KeyError, ValueError):
+        pass
+
+    ep = Endpoint(EndpointMetadata(name="m", address="127.0.0.1", port=9000))
+    ep.attributes.put(PREFIX_ATTRIBUTE_KEY, PrefixCacheMatchInfo(3, 4, 16))
+    result = SchedulingResult(
+        profile_results={"default": ProfileRunResult(target_endpoints=[ep])},
+        primary_profile_name="default")
+    headers = {"x-kv-hit-tokens": "48", "x-kv-hit-blocks": "3"}
+    usage = {"prompt_tokens": 64,
+             "prompt_tokens_details": {"cached_tokens": 48}}
+
+    def one_lifecycle(ledger, req) -> None:
+        req.cache = None
+        ledger.record_scheduled(req, result)
+        ledger.observe_response(req, ep, headers)          # header-time join
+        ledger.observe_response(req, ep, headers, usage)   # terminal check
+
+    reps = 50_000 if not quick else 5_000
+    req = InferenceRequest(request_id="bench", target_model="tiny",
+                           body=InferenceRequestBody(
+                               completions={"prompt": "p"}))
+    ledger_on = CacheLedger(KvObsConfig(enabled=True))
+    ledger_off = CacheLedger(KvObsConfig(enabled=False))
+    gc.disable()
+    try:
+        best_on = best_off = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                one_lifecycle(ledger_on, req)
+            best_on = min(best_on, (time.perf_counter() - t0) / reps)
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                one_lifecycle(ledger_off, req)
+            best_off = min(best_off, (time.perf_counter() - t0) / reps)
+    finally:
+        gc.enable()
+    micro = {
+        "hook_us_per_request": round(best_on * 1e6, 3),
+        "hook_pct_of_cycle_floor": round(best_on * 1e6 / floor_us * 100, 4),
+        "killswitch_us_per_request": round(best_off * 1e6, 3),
+        "killswitch_pct_of_cycle_floor": round(
+            best_off * 1e6 / floor_us * 100, 4),
+        "cycle_floor_us": round(floor_us, 1),
+        "reps": reps,
+    }
+    print(json.dumps({"phase": "kvobs-micro", **micro}))
+
+    # ---- workload: shared-prefix cold/warm rounds ----------------------
+    E0, E1, GW = 18780, 18781, 18782
+    N_USERS = 16 if not quick else 6
+    SHARED = ("You are a meticulous assistant. Follow the policies below "
+              "precisely and answer in the user's language. ") * 4
+
+    def _cfg(enabled: bool) -> str:
+        return f"""
+kvCache: {{enabled: {str(enabled).lower()}}}
+pool:
+  endpoints:
+    - {{address: 127.0.0.1, port: {E0}}}
+    - {{address: 127.0.0.1, port: {E1}}}
+plugins:
+  - {{type: approx-prefix-cache-producer}}
+  - {{type: prefix-cache-scorer}}
+  - {{type: queue-scorer}}
+schedulingProfiles:
+  - name: default
+    plugins:
+      - {{pluginRef: prefix-cache-scorer, weight: 3}}
+      - {{pluginRef: queue-scorer}}
+"""
+
+    async def run_workload(enabled: bool) -> dict:
+        import httpx
+
+        from llm_d_inference_scheduler_tpu.engine import EngineConfig
+        from llm_d_inference_scheduler_tpu.engine.server import EngineServer
+        from llm_d_inference_scheduler_tpu.router.gateway import build_gateway
+
+        engines = [EngineServer(EngineConfig(
+            backend="sim", model="tiny", port=p, max_batch=8))
+            for p in (E0, E1)]
+        for e in engines:
+            await e.start()
+        gw = build_gateway(_cfg(enabled), port=GW, poll_interval=0.02)
+        await gw.start()
+        try:
+            await asyncio.sleep(0.2)
+            async with httpx.AsyncClient(timeout=60) as c:
+
+                async def one(rid: str, prompt: str, stream: bool) -> None:
+                    body = {"model": "tiny", "prompt": prompt,
+                            "max_tokens": 4}
+                    if stream:
+                        body["stream"] = True
+                        async with c.stream(
+                                "POST",
+                                f"http://127.0.0.1:{GW}/v1/completions",
+                                json=body,
+                                headers={"x-request-id": rid}) as r:
+                            async for _ in r.aiter_lines():
+                                pass
+                    else:
+                        await c.post(f"http://127.0.0.1:{GW}/v1/completions",
+                                     json=body,
+                                     headers={"x-request-id": rid})
+
+                # Three reuse regimes: "cold" prompts are user-salted from
+                # position 0 (no reuse possible), "warm" repeats them
+                # verbatim (full-depth reuse), "shared" sends FRESH users
+                # whose prompts share the long system prefix (partial
+                # cross-user reuse — the PPD multi-turn shape).
+                def salted(i: int) -> str:
+                    return f"User {i} private context {i}: {SHARED}ask {i}."
+
+                def shared(i: int) -> str:
+                    return f"{SHARED}New user {1000 + i} asks question."
+
+                rounds: dict[str, dict] = {}
+                for tag, prompt_of in (("cold", salted), ("warm", salted),
+                                       ("shared", shared)):
+                    # Sequential sends: each round's pre_request stamps must
+                    # land before the next request of the SAME prompt scores
+                    # (the warm round's predictions are the subject).
+                    for i in range(N_USERS):
+                        await one(f"kvobs-{tag}-{i}", prompt_of(i),
+                                  stream=bool(i % 2))
+                    errs_abs: list[float] = []
+                    actuals: list[float] = []
+                    joined = 0
+                    for i in range(N_USERS):
+                        r = await c.get(f"http://127.0.0.1:{GW}"
+                                        f"/debug/decisions/kvobs-{tag}-{i}")
+                        cache = (r.json() or {}).get("cache") or {}
+                        actual = cache.get("actual")
+                        if actual is None:
+                            continue
+                        joined += 1
+                        a_ratio = actual.get("ratio")
+                        chosen = cache.get("chosen") or ""
+                        pred = (cache.get("predicted") or {}).get(chosen, {})
+                        p_ratio = pred.get("ratio")
+                        if a_ratio is not None:
+                            actuals.append(a_ratio)
+                            if p_ratio is not None:
+                                errs_abs.append(abs(p_ratio - a_ratio))
+                    rounds[tag] = {
+                        "requests": N_USERS,
+                        "joined": joined,
+                        "hit_prediction_mae_ratio": (
+                            round(sum(errs_abs) / len(errs_abs), 4)
+                            if errs_abs else None),
+                        "mean_actual_hit_ratio": (
+                            round(sum(actuals) / len(actuals), 4)
+                            if actuals else None),
+                    }
+                    print(json.dumps({"phase": f"kvobs-{tag}",
+                                      **rounds[tag]}))
+                kv = (await c.get(
+                    f"http://127.0.0.1:{GW}/debug/kv")).json()
+                return {"rounds": rounds,
+                        "debug_kv": {k: kv.get(k) for k in
+                                     ("enabled", "predicted_stamps",
+                                      "confirmed_joins", "prediction",
+                                      "prediction_ratio")}}
+        finally:
+            await gw.stop()
+            for e in engines:
+                await e.stop()
+
+    workload = asyncio.run(run_workload(True))
+    killswitch = asyncio.run(run_workload(False))
+    warm = workload["rounds"].get("warm") or {}
+    return {
+        "micro": micro,
+        "workload": workload,
+        "killswitch": {"debug_kv": killswitch["debug_kv"]},
+        "acceptance": {
+            "hook_pct_of_cycle_floor": micro["hook_pct_of_cycle_floor"],
+            "hook_under_1pct": micro["hook_pct_of_cycle_floor"] < 1.0,
+            "killswitch_pct_of_cycle_floor":
+                micro["killswitch_pct_of_cycle_floor"],
+            "warm_actual_hit_ratio": warm.get("mean_actual_hit_ratio"),
+            "warm_hit_ratio_positive":
+                (warm.get("mean_actual_hit_ratio") or 0) > 0,
+            "killswitch_stamps":
+                killswitch["debug_kv"].get("predicted_stamps"),
+        },
+    }
+
+
 def overload_ramp_bench(quick: bool = False) -> dict:
     """Goodput-max overload control bench (CPU-only, no chip needed).
 
@@ -2051,6 +2295,14 @@ def main() -> None:
         os.makedirs(os.path.join(here, "benchmarks"), exist_ok=True)
         res = slo_obs_bench(quick="--quick" in sys.argv)
         with open(os.path.join(here, "benchmarks", "SLO_OBS.json"), "w") as f:
+            json.dump(res, f, indent=1)
+        return
+    if "--kv-obs" in sys.argv:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")  # no chip needed
+        here = os.path.dirname(os.path.abspath(__file__))
+        os.makedirs(os.path.join(here, "benchmarks"), exist_ok=True)
+        res = kv_obs_bench(quick="--quick" in sys.argv)
+        with open(os.path.join(here, "benchmarks", "KV_OBS.json"), "w") as f:
             json.dump(res, f, indent=1)
         return
     if "--overload-ramp" in sys.argv:

@@ -42,7 +42,7 @@ from .config import EngineConfig
 from .multihost import ChannelBroken
 from .request import EngineRequest, FinishReason, TokenEvent
 from .sampling import sample_tokens
-from .telemetry import EngineTelemetry
+from .telemetry import EngineTelemetry, PrefixHitLog
 from .tokenizer import get_tokenizer
 
 log = logging.getLogger("engine.core")
@@ -216,6 +216,13 @@ class TpuEngine:
         # aggregates. Bounded ring; individually GIL-atomic dict/deque ops.
         self.kv_import_stats: dict[str, dict[str, Any]] = {}
         self._kv_import_order: collections.deque[str] = collections.deque()
+        # Per-request ACTUAL prefix-hit accounting (telemetry.PrefixHitLog,
+        # shared with the sim), recorded once at prefill admission — the
+        # engine-confirmed number the router's prefix scorers only PREDICT.
+        # The server pops entries for the x-kv-hit-blocks/-tokens response
+        # headers, reads them for usage.prompt_tokens_details, and serves
+        # the bounded ring at GET /debug/kv.
+        self.kv_hits = PrefixHitLog(self.telemetry, self.mcfg.kv_block_size)
         if cfg.kv_transfer in ("auto", "device"):
             try:
                 self.kv_transfer_server = _get_transfer_server()
@@ -1240,6 +1247,10 @@ class TpuEngine:
             for k, (i, req, out, loop, need, pre, blocks) in enumerate(entries):
                 prompt, hashes, _ = pre
                 self.telemetry.prompt_tokens.inc(len(prompt))
+                # Batched entries are hit-free by construction (_flush_
+                # admissions reroutes prefix hits to the single path) but
+                # still count into the admitted-token denominator.
+                self._note_prefix_hit(req.request_id, 0, len(prompt))
                 slot = _Slot(req=req, out=out, loop=loop, blocks=blocks,
                              position=len(prompt), generated=[], last_token=-1,
                              cached_tokens=0, pending_tok=tok_dev, pending_idx=k,
@@ -1315,6 +1326,9 @@ class TpuEngine:
                 max_match = (len(prompt) - 1) // block
                 hit_ratio = (len(matched_bids) / max_match) if max_match else 1.0
                 if hit_ratio < req.cache_hit_threshold:
+                    self._note_prefix_hit(req.request_id,
+                                          len(matched_bids) * block,
+                                          len(prompt), kind="probe")
                     self._emit_to(out, loop, TokenEvent(
                         request_id=req.request_id, token_id=None,
                         finish_reason=FinishReason.CACHE_THRESHOLD,
@@ -1335,6 +1349,7 @@ class TpuEngine:
 
         cached_tokens = len(matched_bids) * block
         suffix = prompt[cached_tokens:]
+        self._note_prefix_hit(req.request_id, cached_tokens, len(prompt))
 
         win = self._prefill_window()
         if win and len(suffix) > win and req.mm_embeds is None:
@@ -1599,6 +1614,14 @@ class TpuEngine:
         }
         while len(self._kv_import_order) > self.KV_IMPORT_STATS_CAP:
             self.kv_import_stats.pop(self._kv_import_order.popleft(), None)
+
+    def _note_prefix_hit(self, request_id: str, hit_tokens: int,
+                         prompt_tokens: int, *, kind: str = "prefill") -> None:
+        """Record the ACTUAL prefix-cache hit depth for one request at
+        prefill admission (matched blocks x block size over the full
+        prompt) — see telemetry.PrefixHitLog for the record/eviction
+        discipline shared with the sim."""
+        self.kv_hits.note(request_id, hit_tokens, prompt_tokens, kind=kind)
 
     def _fetch_inner(self, pi, ktp):
         """The fetch-thread body: resolve a transfer route, move the bytes

@@ -146,6 +146,7 @@ class EngineServer:
             web.get("/ec/{request_id}", self.ec_fetch),
             web.get("/kv_events", self.kv_events_stream),
             web.get("/debug/traces", self.traces),
+            web.get("/debug/kv", self.kv_debug),
         ])
         # E/PD encode store: request_id -> staged encoder output
         # {"embeds": float32 [rows, D], "indices": global item indices}
@@ -468,6 +469,9 @@ class EngineServer:
                 "total_tokens": n_prompt + n_completion,
             },
         }
+        details = self._kv_hit_usage(req)
+        if details is not None:
+            resp["usage"]["prompt_tokens_details"] = details
         if kv_params is not None:
             resp["kv_transfer_params"] = kv_params
         return resp
@@ -552,11 +556,17 @@ class EngineServer:
                 final_choice = ({"delta": {}, "index": 0, "finish_reason": ev.finish_reason.value}
                                 if chat else
                                 {"text": "", "index": 0, "finish_reason": ev.finish_reason.value})
+                usage = {"prompt_tokens": prompt_tokens,
+                         "completion_tokens": ev.completion_tokens,
+                         "total_tokens": prompt_tokens + ev.completion_tokens}
+                # Streamed responses sent their headers before the prefill
+                # ran; the hit depth rides the terminal usage record.
+                details = self._kv_hit_usage(req)
+                if details is not None:
+                    usage["prompt_tokens_details"] = details
                 chunk = {"id": req.request_id, "object": obj, "created": created,
                          "model": self.engine.model_name, "choices": [final_choice],
-                         "usage": {"prompt_tokens": prompt_tokens,
-                                   "completion_tokens": ev.completion_tokens,
-                                   "total_tokens": prompt_tokens + ev.completion_tokens}}
+                         "usage": usage}
                 await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
                 await resp.write(b"data: [DONE]\n\n")
                 break
@@ -607,6 +617,32 @@ class EngineServer:
                 "x-kv-pull-bytes": str(stats["bytes"]),
                 "x-kv-pull-route": stats["route"]}
 
+    def _kv_hit_headers(self, req: EngineRequest) -> dict[str, str]:
+        """ACTUAL prefix-hit depth measured at prefill admission
+        (engine/core.py ``_note_prefix_hit``), stamped on non-streaming
+        responses as ``x-kv-hit-blocks`` / ``x-kv-hit-tokens`` so the
+        sidecar (prefill leg / local-decode fallback) and the router's
+        CacheLedger can join it with the schedule-time prediction. A P/D
+        decode leg that IMPORTED remote KV has no entry — an import is not
+        a prefix-cache hit. Streaming responses send headers at prepare
+        time; their hit rides ``usage.prompt_tokens_details`` instead."""
+        log = getattr(self.engine, "kv_hits", None)
+        rec = log.pop(req.request_id) if log is not None else None
+        if rec is None:
+            return {}
+        return {"x-kv-hit-blocks": str(rec["hit_blocks"]),
+                "x-kv-hit-tokens": str(rec["hit_tokens"])}
+
+    def _kv_hit_usage(self, req: EngineRequest) -> dict[str, int] | None:
+        """``usage.prompt_tokens_details`` payload (the vLLM/OpenAI
+        ``cached_tokens`` shape) — non-destructive read so the header pop
+        above still finds the entry."""
+        log = getattr(self.engine, "kv_hits", None)
+        rec = log.get(req.request_id) if log is not None else None
+        if rec is None:
+            return None
+        return {"cached_tokens": rec["hit_tokens"]}
+
     async def completions(self, request: web.Request) -> web.StreamResponse:
         body = await _json_body(request)
         with self._request_span(request) as span:
@@ -627,7 +663,8 @@ class EngineServer:
                 else:
                     resp = web.json_response(
                         await self._collect(req, out, stops, timing),
-                        headers=self._kv_pull_headers(req))
+                        headers={**self._kv_pull_headers(req),
+                                 **self._kv_hit_headers(req)})
             except (asyncio.CancelledError, ConnectionResetError):
                 self.engine.abort(req.request_id)  # client went away: stop decoding
                 raise
@@ -662,7 +699,8 @@ class EngineServer:
         resp["object"] = "chat.completion"
         text = resp["choices"][0].pop("text")
         resp["choices"][0]["message"] = {"role": "assistant", "content": text}
-        return web.json_response(resp, headers=self._kv_pull_headers(req))
+        return web.json_response(resp, headers={**self._kv_pull_headers(req),
+                                                **self._kv_hit_headers(req)})
 
     async def embeddings(self, request: web.Request) -> web.Response:
         """OpenAI /v1/embeddings: mean-pooled final-hidden-state vectors
@@ -849,6 +887,32 @@ class EngineServer:
         return web.json_response({"service": "engine",
                                   "engine_id": self.engine.engine_id,
                                   "spans": tracer.snapshot()})
+
+    async def kv_debug(self, request: web.Request) -> web.Response:
+        """Bounded per-request prefix-hit ring (engine/core.py
+        ``_note_prefix_hit``): the engine half of the router's /debug/kv —
+        each row is one prefill admission's engine-confirmed hit depth,
+        newest first, plus the running admitted/hit token totals behind the
+        ``jetstream:prefill_tokens`` / ``jetstream:prefix_hit_tokens``
+        counter pair. ``?n=`` bounds the page (default 64)."""
+        try:
+            n = max(1, int(request.query.get("n", "64")))
+        except ValueError:
+            n = 64
+        log = getattr(self.engine, "kv_hits", None)
+        ring = list(log.ring) if log is not None else []
+        totals = dict(log.totals) if log is not None else {}
+        if totals.get("prefill_tokens"):
+            totals["actual_hit_ratio"] = round(
+                totals.get("prefix_hit_tokens", 0)
+                / totals["prefill_tokens"], 4)
+        return web.json_response({
+            "engine_id": self.engine.engine_id,
+            "block_size": self.engine.mcfg.kv_block_size,
+            "count": len(ring),
+            "totals": totals,
+            "recent": ring[-n:][::-1],
+        })
 
     async def health(self, request: web.Request) -> web.Response:
         warming = bool(getattr(self.engine, "warming", False))

@@ -12,11 +12,13 @@ from __future__ import annotations
 import asyncio
 import time
 import uuid
+from collections import OrderedDict
 from typing import Any
 
+from ..utils.hashing import chain_block_hashes
 from .config import EngineConfig
 from .request import EngineRequest, FinishReason, TokenEvent
-from .telemetry import EngineTelemetry
+from .telemetry import EngineTelemetry, PrefixHitLog
 from .tokenizer import get_tokenizer
 
 _LOREM = "lorem ipsum dolor sit amet "
@@ -39,6 +41,14 @@ class SimEngine:
         self.kv_exports: dict[str, dict[str, Any]] = {}
         self._tasks: dict[str, asyncio.Task] = {}
         self._gen_tokens = self.tokenizer.encode(_LOREM, add_bos=False)
+        # Prefix-reuse accounting parity with the real engine: a
+        # capacity-bounded LRU of served block hashes stands in for the
+        # PrefixCachingAllocator, feeding the SAME PrefixHitLog surfaces
+        # (x-kv-hit-* headers, the /debug/kv ring, the
+        # jetstream:prefill_tokens / prefix_hit_tokens counter pair) so
+        # warm repeat prompts confirm real hit depths CPU-only.
+        self._prefix_lru: OrderedDict[int, None] = OrderedDict()
+        self.kv_hits = PrefixHitLog(self.telemetry, block)
 
     async def start(self):
         pass
@@ -93,6 +103,32 @@ class SimEngine:
         if task is not None:
             task.cancel()
 
+    def _note_prefix_hit(self, req: EngineRequest) -> int:
+        """Match the prompt's block-hash chain against the served-block LRU
+        (consecutive from the start, >=1 suffix token kept — the same
+        matchable-prefix rule as the real allocator), commit the prompt's
+        complete blocks, and record the hit through the shared
+        PrefixHitLog. Returns the hit depth in tokens."""
+        block = self.mcfg.kv_block_size
+        prompt = req.prompt_token_ids
+        hashes = chain_block_hashes(self.model_name, prompt, "", block)
+        max_match = (len(prompt) - 1) // block if prompt else 0
+        match = 0
+        for h in hashes[:max_match]:
+            if h in self._prefix_lru:
+                self._prefix_lru.move_to_end(h)
+                match += 1
+            else:
+                break
+        for h in hashes:
+            self._prefix_lru[h] = None
+            self._prefix_lru.move_to_end(h)
+        while len(self._prefix_lru) > max(self.n_blocks, 1):
+            self._prefix_lru.popitem(last=False)
+        hit_tokens = match * block
+        self.kv_hits.note(req.request_id, hit_tokens, len(prompt))
+        return hit_tokens
+
     def release_kv_export(self, request_id: str) -> None:
         rec = self.kv_exports.pop(request_id, None)
         if rec:
@@ -120,6 +156,7 @@ class SimEngine:
             n_blocks = -(-max(prompt_len + req.max_tokens, 1) // block)
             self._blocks_used += n_blocks
             self._update_gauges()
+            self._note_prefix_hit(req)
             try:
                 await asyncio.sleep(self.cfg.sim_prefill_ms_per_token * prompt_len / 1000)
                 self.telemetry.prefill_step.observe(

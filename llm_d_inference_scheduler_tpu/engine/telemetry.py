@@ -9,6 +9,10 @@ fleets via its mapping registry).
 
 from __future__ import annotations
 
+import collections
+import time
+from typing import Any
+
 from prometheus_client import CollectorRegistry, Counter, Gauge, Histogram, generate_latest
 
 WAITING = "jetstream:num_requests_waiting"
@@ -69,6 +73,22 @@ class EngineTelemetry:
         self.prefix_cached_tokens = Counter(
             "jetstream:prefix_cached_tokens_total",
             "Prompt tokens served from the prefix cache", registry=self.registry)
+        # Prefix-reuse observability pair (docs/observability.md §KV-cache
+        # observability): incremented TOGETHER at prefill admission — one
+        # point, one request, once — so hit/total is a per-pod actual hit
+        # ratio the router's /debug/kv can derive from two scraped counters.
+        # (prompt_tokens/prefix_cached_tokens above count COMPUTE-side work:
+        # suffix tokens per dispatch, window chunks separately — a ratio of
+        # those two mixes accounting bases.)
+        self.prefill_tokens_admitted = Counter(
+            "jetstream:prefill_tokens",
+            "Prompt tokens admitted to prefill (cache hits + computed), "
+            "counted once per request at admission", registry=self.registry)
+        self.prefix_hit_tokens = Counter(
+            "jetstream:prefix_hit_tokens",
+            "Prompt tokens covered by the prefix cache at prefill admission "
+            "(the engine-confirmed actual behind x-kv-hit-tokens)",
+            registry=self.registry)
         self.generation_tokens = Counter("jetstream:generation_tokens_total", "Decoded tokens",
                                          registry=self.registry)
         self.ttft = Histogram("jetstream:time_to_first_token_seconds", "TTFT",
@@ -87,3 +107,69 @@ class EngineTelemetry:
 
     def render(self) -> bytes:
         return generate_latest(self.registry)
+
+
+class PrefixHitLog:
+    """Per-request ACTUAL prefix-hit accounting, shared by the real engine
+    and the sim so the two cannot drift: each prefill admission records its
+    engine-confirmed hit depth exactly once into
+
+    - ``stats`` (request_id → record), popped by the server for the
+      ``x-kv-hit-blocks`` / ``x-kv-hit-tokens`` response headers and read
+      for ``usage.prompt_tokens_details``;
+    - ``ring``, the bounded newest-last view behind engine ``/debug/kv``;
+    - ``totals`` + the ``jetstream:prefill_tokens`` /
+      ``jetstream:prefix_hit_tokens`` counter pair (incremented together,
+      so hit/total is the pod's cumulative actual hit ratio).
+
+    ``kind="probe"`` marks a shared-storage cache_hit_threshold probe that
+    bailed with CACHE_THRESHOLD: it lands in the ring (the probe verdict is
+    worth seeing) but NOT in the admitted-token counters — no prefill
+    happened, and the retry after the remote prefill leg is counted when it
+    does. Written by the serving thread, read by server handlers:
+    individually GIL-atomic dict/deque ops."""
+
+    RING_CAP = 512
+
+    def __init__(self, telemetry: EngineTelemetry, block_size: int,
+                 ring_cap: int = RING_CAP):
+        self.telemetry = telemetry
+        self.block = max(block_size, 1)
+        self.stats: dict[str, dict[str, Any]] = {}
+        self._order: collections.deque[str] = collections.deque()
+        self.ring: collections.deque[dict[str, Any]] = \
+            collections.deque(maxlen=ring_cap)
+        self.totals = {"requests": 0, "prefill_tokens": 0,
+                       "prefix_hit_tokens": 0}
+
+    def note(self, request_id: str, hit_tokens: int, prompt_tokens: int, *,
+             kind: str = "prefill") -> dict[str, Any]:
+        rec = {"request_id": request_id, "kind": kind,
+               "hit_tokens": int(hit_tokens),
+               "hit_blocks": int(hit_tokens) // self.block,
+               "prompt_tokens": int(prompt_tokens),
+               "unix": round(time.time(), 3)}
+        if kind == "prefill":
+            self.telemetry.prefill_tokens_admitted.inc(prompt_tokens)
+            self.totals["requests"] += 1
+            self.totals["prefill_tokens"] += int(prompt_tokens)
+            if hit_tokens:
+                self.telemetry.prefix_hit_tokens.inc(hit_tokens)
+                self.totals["prefix_hit_tokens"] += int(hit_tokens)
+        # A re-dispatched request id overwrites its entry instead of minting
+        # a duplicate ring slot (the _note_kv_import dedup discipline: a
+        # stale first occurrence reaching the front must not evict the live
+        # entry).
+        if request_id not in self.stats:
+            self._order.append(request_id)
+        self.stats[request_id] = rec
+        while len(self._order) > self.ring.maxlen:
+            self.stats.pop(self._order.popleft(), None)
+        self.ring.append(rec)
+        return rec
+
+    def pop(self, request_id: str) -> dict[str, Any] | None:
+        return self.stats.pop(request_id, None)
+
+    def get(self, request_id: str) -> dict[str, Any] | None:
+        return self.stats.get(request_id)

@@ -48,6 +48,7 @@ class RawConfig:
     decisions: dict[str, Any]
     slo: dict[str, Any]
     overload: dict[str, Any]
+    kv_cache: dict[str, Any]
     tls_client: dict[str, Any]
     pool: dict[str, Any]
     objectives: list[dict[str, Any]]
@@ -98,6 +99,11 @@ class RouterConfig:
     # enabled: false (the default) is the kill-switch that keeps behavior
     # bit-identical to the pre-overload router).
     overload: dict[str, Any]
+    # kvCache: the KV-cache & prefix-reuse observability knobs
+    # (router/kvobs.py KvObsConfig — {enabled, capacity, topCandidates};
+    # enabled: false is the kill-switch that removes the predicted-vs-
+    # confirmed hit ledger's hooks entirely).
+    kv_cache: dict[str, Any]
     tls_client: dict[str, Any]
     static_endpoints: list[EndpointMetadata]
     pool: EndpointPool
@@ -130,6 +136,7 @@ def load_raw_config(text: str | None) -> RawConfig:
         decisions=doc.get("decisions") or {},
         slo=doc.get("slo") or {},
         overload=doc.get("overload") or {},
+        kv_cache=doc.get("kvCache") or {},
         tls_client=doc.get("tlsClient") or {},
         pool=doc.get("pool") or {},
         objectives=doc.get("objectives") or [],
@@ -309,6 +316,7 @@ def instantiate(raw: RawConfig, handle: Handle,
         decisions=raw.decisions,
         slo=raw.slo,
         overload=raw.overload,
+        kv_cache=raw.kv_cache,
         tls_client=raw.tls_client,
         static_endpoints=static_endpoints,
         pool=pool,

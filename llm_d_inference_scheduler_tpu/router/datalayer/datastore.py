@@ -77,6 +77,14 @@ class Datastore:
         # pull stats, served at /debug/transfers, readable by future
         # transfer-cost scorers (ROADMAP item 3).
         self.transfers = TransferTable()
+        # Per-pod measured prefix-reuse table (router/kvobs.py): actual
+        # hit-rate + signed prediction-error EWMAs fed by the gateway's
+        # CacheLedger, served at /debug/kv, readable by future scheduling
+        # plugins (ROADMAP item 2's prefill classifier). Imported lazily to
+        # keep the datalayer package import-light.
+        from ..kvobs import KvHitTable
+
+        self.kv_obs = KvHitTable()
         # Copy-on-write scheduling snapshot (router/snapshot.py). Two dirty
         # levels: membership changes (add/delete/resync) force a rebuild on
         # the next snapshot() call — a deleted endpoint must leave the

@@ -39,6 +39,10 @@ class MetricMapping:
     # Free-block depth (engine telemetry beyond the five-signal contract);
     # engines without the family simply leave Metrics.free_kv_blocks at -1.
     free_blocks: MetricSpec | None = None
+    # Prefix-reuse counter pair (incremented together at prefill admission;
+    # hit/total = the pod's actual hit ratio, surfaced at /debug/kv).
+    prefill_tokens: MetricSpec | None = None
+    prefix_hit_tokens: MetricSpec | None = None
 
 
 JETSTREAM_MAPPING = MetricMapping(
@@ -48,6 +52,8 @@ JETSTREAM_MAPPING = MetricMapping(
     lora_info=MetricSpec("jetstream:lora_requests_info"),
     cache_config=MetricSpec("jetstream:cache_config_info"),
     free_blocks=MetricSpec("jetstream:num_free_kv_blocks"),
+    prefill_tokens=MetricSpec("jetstream:prefill_tokens_total"),
+    prefix_hit_tokens=MetricSpec("jetstream:prefix_hit_tokens_total"),
 )
 
 VLLM_MAPPING = MetricMapping(
@@ -143,6 +149,14 @@ class CoreMetricsExtractor(PluginBase):
             v, _ = _sample_value(families, mapping.free_blocks)
             if v is not None:
                 m.free_kv_blocks = int(v)
+        if mapping.prefill_tokens:
+            v, _ = _sample_value(families, mapping.prefill_tokens)
+            if v is not None:
+                m.prefill_tokens = float(v)
+        if mapping.prefix_hit_tokens:
+            v, _ = _sample_value(families, mapping.prefix_hit_tokens)
+            if v is not None:
+                m.prefix_hit_tokens = float(v)
         if mapping.cache_config:
             v, labels = _sample_value(families, mapping.cache_config)
             if v is not None and labels:

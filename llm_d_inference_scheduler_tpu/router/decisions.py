@@ -54,7 +54,7 @@ class DecisionRecord:
     __slots__ = ("request_id", "model", "target_model", "priority",
                  "_start", "_admission", "_producers",
                  "_rounds", "_attempts", "_final", "_outcome", "_shed",
-                 "top_k")
+                 "_cache", "top_k")
 
     # Container fields are lazily created (None until first write): a record
     # is opened on EVERY request, and five eager container allocations per
@@ -96,6 +96,7 @@ class DecisionRecord:
         self._final = None
         self._outcome = None
         self._shed = None
+        self._cache = None
 
     @property
     def start_unix(self) -> float:
@@ -130,6 +131,10 @@ class DecisionRecord:
     @property
     def shed(self) -> dict[str, Any]:
         return self._shed if self._shed is not None else self._EMPTY_DICT
+
+    @property
+    def cache(self) -> dict[str, Any]:
+        return self._cache if self._cache is not None else self._EMPTY_DICT
 
     # ---- layer hooks ----------------------------------------------------
 
@@ -284,6 +289,14 @@ class DecisionRecord:
             block["prior"] = self._shed
             self._shed = block
 
+    def record_cache(self, block: dict[str, Any]) -> None:
+        """KV-cache observability block (router/kvobs.py CacheLedger): the
+        per-candidate schedule-time predicted hit depth, joined in place
+        with the engine-confirmed actual on completion (the ledger mutates
+        the SAME dict, so no second stamp is needed). First stamp wins."""
+        if self._cache is None:
+            self._cache = block
+
     def record_outcome(self, outcome: dict[str, Any]) -> None:
         """SLO-ledger serving outcome (router/slo.py): predicted vs actual
         TTFT/TPOT vs SLO targets, slo_met verdict, miss reason, and (on the
@@ -321,6 +334,8 @@ class DecisionRecord:
         }
         if self._shed is not None:
             doc["shed"] = self._shed
+        if self._cache is not None:
+            doc["cache"] = self._cache
         if compact:
             doc["summary"] = self.summary_line()
             return doc
@@ -388,6 +403,18 @@ class DecisionRecord:
                 parts.append(f"queue_ms={self.admission['queue_ms']:.3f}")
         if self._shed is not None:
             parts.append(f"overload={self._shed.get('action')}")
+        cache = self._cache
+        if cache is not None:
+            # Cache verdict beside the pick: predicted vs engine-confirmed
+            # hit blocks (actual absent until the join lands — streamed
+            # responses confirm only at the terminal usage record).
+            pred = (cache.get("predicted") or {}).get(
+                cache.get("chosen") or "", {})
+            verdict = f"cache=pred:{pred.get('blocks', '?')}"
+            actual = cache.get("actual")
+            if actual is not None:
+                verdict += f"/act:{actual.get('blocks', '?')}"
+            parts.append(verdict)
         drops = []
         for rnd in list(self.rounds):
             for pname, sec in self._live_items(rnd["profiles"]):
@@ -428,6 +455,56 @@ class DecisionRecord:
                              for a in self.attempts],
             }))
         return events
+
+
+def record_matches(doc: dict[str, Any], *, verdict: str | None = None,
+                   endpoint: str | None = None,
+                   outcome: str | None = None) -> bool:
+    """Operator-side list-view filters over a rendered record dict (the
+    gateway's ``/debug/decisions?verdict=&endpoint=&outcome=`` — and the
+    fleet fan-in forwards the same params to every worker):
+
+    - ``verdict``: the SLO ledger's serving verdict (met | missed | error |
+      shed), read from the outcome block;
+    - ``endpoint``: the destination that served (``final.destination``) or
+      any endpoint in the attempt trail — find every record that TOUCHED a
+      pod, not just the ones it ultimately served;
+    - ``outcome``: convenience aliases — ``miss`` (SLO missed or error: any
+      served-but-failed row) and ``shed`` (refused at admission).
+
+    All given filters must match (AND)."""
+    out = doc.get("outcome") or {}
+    v = out.get("verdict")
+    if v is None and out:
+        # Records written before the verdict field existed: derive it.
+        if out.get("shed"):
+            v = "shed"
+        elif out.get("slo_met"):
+            v = "met"
+        elif out.get("reason"):
+            v = "error"
+        else:
+            v = "missed"
+    if doc.get("shed") and v is None:
+        v = "shed"
+    if verdict is not None and v != verdict:
+        return False
+    if outcome is not None:
+        if outcome == "shed":
+            if v != "shed" and not doc.get("shed"):
+                return False
+        elif outcome == "miss":
+            if v not in ("missed", "error"):
+                return False
+        else:
+            return False  # unknown alias matches nothing, loudly-by-empty
+    if endpoint is not None:
+        final = doc.get("final") or {}
+        if final.get("destination") != endpoint and not any(
+                a.get("endpoint") == endpoint
+                for a in doc.get("attempts") or []):
+            return False
+    return True
 
 
 @dataclasses.dataclass

@@ -64,6 +64,7 @@ from .metrics import (
     FLEET_BALANCER_CONNECTIONS,
     FLEET_REGISTRY,
     FLEET_WORKERS,
+    KV_INDEX_DIVERGENCE,
     SHARD_REQUESTS,
     SHARD_SNAPSHOT_EPOCH,
     SHARD_UP,
@@ -479,6 +480,85 @@ def _merge_agg(target: dict[str, Any], agg: dict[str, Any]) -> None:
                        agg["predictor"].get(kind, {"n": 0}))
 
 
+def shard_index_divergence(leader: dict[str, Any],
+                           follower: dict[str, Any]) -> float:
+    """Fraction of the leader's engine-CONFIRMED KvBlockIndex blocks a
+    follower's index view (confirmed + short-TTL speculative stamps) cannot
+    account for, compared pod by pod on the /debug/kv payloads. 0 = the
+    follower's view covers everything the leader confirmed (or the leader
+    has confirmed nothing yet); 1 = no overlap at all. Counts, not
+    contents — the stamp SETS are process-local — so this is a coverage
+    bound, which is exactly the fidelity caveat ROADMAP item 1 documents
+    (followers hold only their own speculative stamps; run ``balancer:
+    hash`` or ``snapshotIpc: false`` when precise fidelity matters)."""
+    leader_pods = leader.get("pods") or {}
+    follower_pods = follower.get("pods") or {}
+    confirmed = covered = 0
+    for pod, row in leader_pods.items():
+        n = int(row.get("confirmed_blocks") or 0)
+        if n <= 0:
+            continue
+        confirmed += n
+        frow = follower_pods.get(pod) or {}
+        known = (int(frow.get("confirmed_blocks") or 0)
+                 + int(frow.get("speculative_blocks") or 0))
+        covered += min(known, n)
+    if confirmed <= 0:
+        return 0.0
+    return round(1.0 - covered / confirmed, 4)
+
+
+def merge_kv(docs: list[tuple[int, dict[str, Any]]]) -> dict[str, Any]:
+    """Fleet /debug/kv: shard-annotated per-worker snapshots, summed stamp/
+    join totals, n-weighted prediction MAE, and the per-shard divergence
+    gauge versus the datalayer leader's confirmed index (shard 0)."""
+    out: dict[str, Any] = {
+        "workers": len(docs),
+        "enabled": any(d.get("enabled") for _, d in docs),
+        "predicted_stamps": 0,
+        "confirmed_joins": 0,
+        "prediction": {"n": 0},
+        "prediction_ratio": {"n": 0},
+        "shards": [],
+        "index_divergence": {},
+    }
+    leader = next((d for shard, d in docs if shard == 0), None)
+    n_tot = sum_abs = sum_signed = 0.0
+    rn_tot = rsum_abs = rsum_signed = 0.0
+    for shard, doc in docs:
+        pred = doc.get("prediction") or {}
+        n = pred.get("n", 0)
+        if n:
+            n_tot += n
+            sum_abs += pred.get("mae_blocks", 0.0) * n
+            sum_signed += pred.get("mean_signed_blocks", 0.0) * n
+        rpred = doc.get("prediction_ratio") or {}
+        rn = rpred.get("n", 0)
+        if rn:
+            rn_tot += rn
+            rsum_abs += rpred.get("mae_ratio", 0.0) * rn
+            rsum_signed += rpred.get("mean_signed_ratio", 0.0) * rn
+        out["predicted_stamps"] += doc.get("predicted_stamps", 0)
+        out["confirmed_joins"] += doc.get("confirmed_joins", 0)
+        div = (0.0 if shard == 0 or leader is None
+               else shard_index_divergence(leader, doc))
+        out["index_divergence"][str(shard)] = div
+        KV_INDEX_DIVERGENCE.labels(str(shard)).set(div)
+        out["shards"].append({"shard": shard, **doc,
+                              "index_divergence": div})
+    if n_tot:
+        out["prediction"] = {"n": int(n_tot),
+                             "mae_blocks": round(sum_abs / n_tot, 3),
+                             "mean_signed_blocks": round(
+                                 sum_signed / n_tot, 3)}
+    if rn_tot:
+        out["prediction_ratio"] = {"n": int(rn_tot),
+                                   "mae_ratio": round(rsum_abs / rn_tot, 4),
+                                   "mean_signed_ratio": round(
+                                       rsum_signed / rn_tot, 4)}
+    return out
+
+
 def merge_slo(docs: list[dict[str, Any]]) -> dict[str, Any]:
     """Fleet /debug/slo: the sum of the per-worker ledgers — totals,
     per-endpoint and per-band rollups, miss/shed reason tallies — with
@@ -532,6 +612,7 @@ class FleetAdmin:
             web.get("/debug/decisions/{request_id}", self.decision_detail),
             web.get("/debug/slo", self.slo),
             web.get("/debug/transfers", self.transfers),
+            web.get("/debug/kv", self.kv),
         ])
         self._runner: web.AppRunner | None = None
         self._session = None
@@ -660,7 +741,16 @@ class FleetAdmin:
             n = max(1, int(request.query.get("n", "50")))
         except ValueError:
             n = 50
-        results = await self._fan_out(f"/debug/decisions?n={n}")
+        # Operator filters (?verdict=/?endpoint=/?outcome=) forward to every
+        # worker so each shard filters ring-side; the merge trims the union.
+        from urllib.parse import urlencode
+
+        params = {"n": str(n)}
+        for key in ("verdict", "endpoint", "outcome"):
+            v = request.query.get(key)
+            if v:
+                params[key] = v
+        results = await self._fan_out(f"/debug/decisions?{urlencode(params)}")
         merged: list[dict] = []
         enabled = False
         count = 0
@@ -696,6 +786,14 @@ class FleetAdmin:
         results = await self._fan_out("/debug/slo")
         return web.json_response(merge_slo(
             [doc for status, doc in results
+             if status == 200 and isinstance(doc, dict)]))
+
+    async def kv(self, request: web.Request) -> web.Response:
+        """Fleet /debug/kv: per-shard cache-ledger snapshots with the
+        follower-vs-leader index divergence gauge (merge_kv)."""
+        results = await self._fan_out("/debug/kv")
+        return web.json_response(merge_kv(
+            [(shard, doc) for shard, (status, doc) in enumerate(results)
              if status == 200 and isinstance(doc, dict)]))
 
     async def transfers(self, request: web.Request) -> web.Response:

@@ -70,6 +70,12 @@ class Metrics:
     # Engine free-list depth (jetstream:num_free_kv_blocks); -1 = unknown
     # (engine doesn't publish the family / not yet scraped).
     free_kv_blocks: int = -1
+    # Prefix-reuse counter pair (jetstream:prefill_tokens /
+    # jetstream:prefix_hit_tokens, incremented together at prefill
+    # admission): hit/total is the pod's ACTUAL cumulative hit ratio,
+    # served per pod at /debug/kv. -1 = engine doesn't publish them.
+    prefill_tokens: float = -1.0
+    prefix_hit_tokens: float = -1.0
     update_time: float = 0.0
 
     def clone(self) -> "Metrics":

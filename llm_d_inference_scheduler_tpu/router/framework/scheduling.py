@@ -117,6 +117,12 @@ class InferenceRequest:
     # queue time and per-request predictions into it, and the gateway closes
     # it exactly once on every terminal path. None = ledger kill-switch.
     outcome: Any = None
+    # KV-cache observation (router/kvobs.py CacheObservation), opened by the
+    # gateway after scheduling when the cache ledger is enabled: carries the
+    # per-candidate predicted hit depth until the engine-confirmed actual
+    # (x-kv-hit-* headers / usage.prompt_tokens_details) joins it exactly
+    # once on completion. None = kvCache kill-switch or no prefix signal.
+    cache: Any = None
     # Prefix-hash memo (router/hashmemo.py PrefixHashMemo), lazily attached
     # by the first producer/scorer that needs a hash chain and reused by
     # every later consumer of the cycle — including failover reschedules of

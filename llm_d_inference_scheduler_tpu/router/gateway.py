@@ -69,6 +69,7 @@ from .requestcontrol.director import (
     H_REQUEST_ID,
     RequestError,
 )
+from .kvobs import H_KV_HIT_BLOCKS, H_KV_HIT_TOKENS, CacheLedger, KvObsConfig
 from .overload import OverloadConfig, OverloadController
 from .schedpool import LoopLagMonitor, SchedulerPool, SchedulingConfig
 from .slo import SloConfig, SloLedger, finite_float_or_none
@@ -155,6 +156,14 @@ class Gateway:
         # closing the predict→observe loop. `slo: {enabled: false}` removes
         # the per-chunk hook from the streaming path entirely.
         self.slo_ledger = SloLedger(SloConfig.from_spec(cfg.slo))
+
+        # KV-cache & prefix-reuse observability (router/kvobs.py): the
+        # predicted-vs-confirmed hit ledger behind /debug/kv. `kvCache:
+        # {enabled: false}` is the kill-switch; the per-pod EWMA table
+        # lives on the datastore (plugins can read measured reuse).
+        self.kv_ledger = CacheLedger(KvObsConfig.from_spec(cfg.kv_cache),
+                                     datastore=datastore)
+        self.kv_ledger.attach_plugins(cfg.plugins_by_name.values())
 
         # Goodput-max overload controller (router/overload.py): predictive
         # SLO admission, degrade ladder, Retry-After shedding. Disabled by
@@ -269,6 +278,7 @@ class Gateway:
             web.get("/debug/decisions/{request_id}", self.decision_detail),
             web.get("/debug/slo", self.slo),
             web.get("/debug/transfers", self.transfers),
+            web.get("/debug/kv", self.kv),
         ])
         self._runner: web.AppRunner | None = None
         # Fleet snapshot IPC endpoints (router/fleet.py): the datalayer
@@ -476,18 +486,57 @@ class Gateway:
     async def decisions(self, request: web.Request) -> web.Response:
         """Recent decision records (compact). ?n=N bounds the page (default
         50); each entry carries the one-line summary plus admission/final
-        sections — the full record lives at /debug/decisions/<request-id>."""
+        sections — the full record lives at /debug/decisions/<request-id>.
+        Operator filters (decisions.record_matches): ?verdict=met|missed|
+        error|shed (the SLO ledger's serving verdict), ?endpoint=<ip:port>
+        (the destination that served), ?outcome=miss|shed (convenience
+        aliases) — so records are findable without client-side scans."""
+        from .decisions import record_matches
+
         try:
             n = int(request.query.get("n", "50"))
         except ValueError:
             n = 50
-        recs = self.decision_recorder.snapshot(max(1, n))
+        n = max(1, n)
+        verdict = request.query.get("verdict") or None
+        endpoint = request.query.get("endpoint") or None
+        outcome = request.query.get("outcome") or None
+        filtered = verdict is not None or endpoint is not None \
+            or outcome is not None
+        # Filtering scans the WHOLE ring (the n newest matches, not the
+        # matches within the n newest); the unfiltered path keeps the
+        # cheap bounded snapshot.
+        recs = self.decision_recorder.snapshot(None if filtered else n)
+        docs = []
+        for r in recs:
+            doc = r.to_dict(compact=True)
+            if filtered:
+                # The endpoint filter also matches the attempt trail, which
+                # the compact form omits — graft the raw attempt list onto
+                # the probe (zero-copy; record_matches only reads
+                # a["endpoint"]) so failed-over pods are findable too.
+                probe = (doc if endpoint is None
+                         else {**doc, "attempts": r.attempts})
+                if not record_matches(probe, verdict=verdict,
+                                      endpoint=endpoint, outcome=outcome):
+                    continue
+            docs.append(doc)
+            if len(docs) >= n:
+                break
         return web.json_response({
             "schema_version": SCHEMA_VERSION,
             "enabled": self.decision_recorder.enabled,
             "count": len(self.decision_recorder),
-            "decisions": [r.to_dict(compact=True) for r in recs],
+            "decisions": docs,
         })
+
+    async def kv(self, request: web.Request) -> web.Response:
+        """KV-cache & prefix-reuse observability rollup (router/kvobs.py):
+        per-pod measured hit-rate and signed-prediction-error EWMAs, index
+        occupancy (approx LRU blocks, precise confirmed/speculative stamp
+        counts), scraped engine hit counters, and the prediction MAE over
+        all predicted→confirmed joins."""
+        return web.json_response(self.kv_ledger.snapshot())
 
     async def slo(self, request: web.Request) -> web.Response:
         """Fleet SLO/goodput rollup (router/slo.py): per-endpoint and
@@ -640,6 +689,11 @@ class Gateway:
                 body["retry_after_s"] = retry_after
             return web.json_response(body, status=e.code, headers=headers)
 
+        # Cache ledger (router/kvobs.py): stamp the per-candidate predicted
+        # hit depth the scorers just routed on; the engine-confirmed actual
+        # joins it on completion.
+        self.kv_ledger.record_scheduled(ireq, result)
+
         # Repackage through the parser (director.go:289-306) only when the
         # bytes must change: model rewrite, or a translating (non-OpenAI)
         # parser; otherwise forward the raw body untouched (hot path).
@@ -785,6 +839,10 @@ class Gateway:
                 result = self.director.reschedule(None, ireq,
                                                   exclude=attempted | blocked)
                 if result is not None:
+                    # Fresh candidates merge into the cache block: the
+                    # actual may be confirmed by a pod the first scheduling
+                    # pass never ranked.
+                    self.kv_ledger.record_scheduled(ireq, result)
                     candidates = list(result.primary().target_endpoints)
                     continue
             if target is None:
@@ -955,8 +1013,25 @@ class Gateway:
                 raise UpstreamFailure("read", 0, "upstream-read-error",
                                       str(e)) from e
 
+        # Non-streaming responses hold their full body (and so the usage
+        # record) before any header goes out: parse it once here — the
+        # cache-ledger join below and the token metrics in `finally` both
+        # reuse it.
+        usage: dict[str, int] = {}
+        if not streaming_body and data is not None:
+            usage = _usage_from_json(data) or {}
         if ireq is not None:
             self.director.handle_response_received(None, ireq, endpoint, resp.status)
+            if not streaming_body:
+                # Join the engine-confirmed hit NOW, with the exact
+                # prompt_tokens from the parsed usage, so the actual ratio
+                # is token-exact and the x-decision-summary echo built
+                # below shows predicted vs actual in one line. Streamed
+                # responses join once in the terminal accounting instead
+                # (their usage arrives with the final SSE event, and the
+                # relayed hit headers are still in hand there).
+                self.kv_ledger.observe_response(ireq, endpoint, resp.headers,
+                                                usage)
             if ireq.decision is not None:
                 # The relayed attempt is recorded BEFORE the response headers
                 # are built so the x-decision-summary echo below agrees with
@@ -970,6 +1045,13 @@ class Gateway:
             H_DESTINATION_SERVED: endpoint.metadata.address_port,
             "content-type": resp.headers.get("content-type", "application/json"),
         }
+        # Relay the engine-confirmed prefix-hit depth to the client beside
+        # the served-endpoint echo (curl-level cache debugging; the full
+        # predicted-vs-actual join is on /debug/decisions/<id>).
+        for h in (H_KV_HIT_BLOCKS, H_KV_HIT_TOKENS):
+            v = resp.headers.get(h)
+            if v is not None:
+                out_headers[h] = v
         if self.fleet is not None:
             out_headers[H_ROUTER_SHARD] = str(self.fleet.index)
         out_headers.update(self._decision_headers(ireq))  # x-debug-decision echo
@@ -977,7 +1059,6 @@ class Gateway:
             # Session stickiness: return the (scheduling-stamped) encoded
             # token to the client (reference session_affinity.go ResponseBody).
             out_headers["x-session-token"] = ireq.headers["x-session-token"]
-        usage: dict[str, int] = {}
         first_byte_at: float | None = None
         # SLO-ledger observation: None when the kill-switch is off, so the
         # per-chunk hook below costs exactly one `is None` check.
@@ -1064,7 +1145,6 @@ class Gateway:
                 first_byte_at = time.monotonic()
                 TTFT_SECONDS.labels(model_label).observe(first_byte_at - t_start)
                 data = _rewrite_model_name(data, ireq, original_model)
-                usage = _usage_from_json(data) or {}
                 return web.Response(body=data, status=resp.status,
                                     headers=out_headers)
         finally:
@@ -1089,6 +1169,12 @@ class Gateway:
                 # the sidecar's response headers, then the SLO verdict
                 # (met/missed, or error for relayed 4xx/5xx and aborts).
                 transfer = self._record_transfer(ireq, endpoint, resp.headers)
+                # Streamed responses confirm the hit via the terminal usage
+                # record (prompt_tokens_details.cached_tokens); the early
+                # header-time join above already marked non-streamed ones
+                # done, so this is one attribute check for them.
+                self.kv_ledger.observe_response(ireq, endpoint, resp.headers,
+                                                usage)
                 self.slo_ledger.complete(ireq, status=resp.status,
                                          endpoint=endpoint, usage=usage,
                                          transfer=transfer)

@@ -1,0 +1,397 @@
+"""KV-cache & prefix-reuse observability: the predicted-vs-confirmed hit
+ledger behind ``GET /debug/kv``.
+
+The router routes on a *prediction* of prefix-cache reuse — the approx
+producer's per-pod LRU and the precise scorer's event-fed KvBlockIndex both
+estimate a hit depth before scheduling — and the engine computes the
+*actual* matched depth at prefill admission (engine/core.py
+``_note_prefix_hit``), but until this module the two numbers never met:
+we routed on a prediction whose accuracy nobody could see. PPD
+(arXiv:2603.13358) makes the stakes concrete — multi-turn routing quality
+hinges on knowing the hit depth *before* scheduling, so the prefill
+classifier ROADMAP item 2 builds must be judged against a *measured*
+prediction error, not an assumed one.
+
+One ``CacheObservation`` rides each scheduled InferenceRequest
+(``request.cache``):
+
+- opened by the gateway after scheduling: the per-candidate predicted hit
+  depth (PrefixCacheMatchInfo attribute + precise-scorer raw scores) is
+  stamped into the DecisionRecord as a ``cache`` block;
+- joined exactly once with the engine-confirmed actual — the
+  ``x-kv-hit-blocks`` / ``x-kv-hit-tokens`` response headers the sidecar
+  relays from the prefill leg (``x-kv-prefiller`` names the pod the hit
+  belongs to), or ``usage.prompt_tokens_details.cached_tokens`` on the
+  streamed path;
+- aggregated into per-pod hit-rate and signed-prediction-error EWMAs on the
+  Datastore (``KvHitTable`` — readable by future scheduling plugins, the
+  same contract as the TransferTable) and the metric families
+  ``router_kv_predicted_hit_blocks`` / ``router_kv_hit_prediction_error`` /
+  ``router_kv_actual_hit_ratio``.
+
+``kvCache: {enabled: false}`` is the kill-switch: every hook degrades to a
+single attribute check (``bench.py --kv-obs`` measures both sides against
+the scheduling-cycle floor → benchmarks/KV_OBS.json). In fleet mode the
+supervisor fans /debug/kv in per shard and derives the
+``router_kv_index_divergence`` gauge — each follower's speculative-only
+index view measured against the leader's engine-confirmed KvBlockIndex
+(router/fleet.py), turning the ROADMAP item-1 "run ``balancer: hash`` when
+precise-prefix fidelity matters" caveat into a number.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import OrderedDict
+from typing import Any
+
+from .metrics import (
+    KV_ACTUAL_HIT_RATIO,
+    KV_HIT_PREDICTION_ERROR,
+    KV_PREDICTED_HIT_BLOCKS,
+)
+from .plugins.attributes import PREFIX_ATTRIBUTE_KEY
+from .slo import finite_float_or_none
+
+# Engine-confirmed actual hit depth, stamped by the engine server on
+# non-streaming responses and relayed by the sidecar from the prefill leg
+# (or the local-decode fallback) beside x-prefill-duration-ms.
+H_KV_HIT_BLOCKS = "x-kv-hit-blocks"
+H_KV_HIT_TOKENS = "x-kv-hit-tokens"
+# The pod the hit belongs to on the disagg path (the sidecar's
+# served-prefiller stamp): the prefill engine measured the hit, not the
+# decode endpoint the gateway proxied to.
+H_KV_PREFILLER = "x-kv-prefiller"
+
+
+@dataclasses.dataclass
+class KvObsConfig:
+    """The YAML ``kvCache:`` section — same shape as ``slo:``
+    (router/slo.py). ``enabled: false`` is the kill-switch the overhead
+    contract requires; ``capacity`` bounds the per-pod EWMA table (pod
+    churn mints fresh ip:ports forever, same rationale as
+    SloLedger.MAX_ENDPOINTS)."""
+
+    enabled: bool = True
+    capacity: int = 256
+    # Ranked candidates whose predictions are recorded per request (the
+    # DecisionRecord cache block must stay bounded on wide pools).
+    top_candidates: int = 16
+
+    @classmethod
+    def from_spec(cls, spec: dict[str, Any] | None) -> "KvObsConfig":
+        spec = spec or {}
+        return cls(enabled=bool(spec.get("enabled", True)),
+                   capacity=max(1, int(spec.get("capacity", 256))),
+                   top_candidates=max(1, int(spec.get("topCandidates", 16))))
+
+
+class CacheObservation:
+    """One request's predicted-vs-confirmed cache observation. ``block`` is
+    the SAME dict the DecisionRecord references, so the completion-time
+    join lands in /debug/decisions/<id> without a second stamp."""
+
+    __slots__ = ("predicted", "chosen", "block", "done")
+
+    def __init__(self, predicted: dict[str, dict[str, Any]], chosen: str):
+        self.predicted = predicted
+        self.chosen = chosen
+        self.block: dict[str, Any] = {"predicted": predicted,
+                                      "chosen": chosen}
+        self.done = False
+
+
+class _ErrAgg:
+    """Signed prediction-error accumulator. Two instances per ledger: one
+    in blocks (raw depth — unit-skewed when the predictor hashes chars and
+    the engine counts token blocks) and one in hit-ratio units (unit-free,
+    the number the warm-vs-cold bench gates on)."""
+
+    __slots__ = ("unit", "n", "sum_signed", "sum_abs")
+
+    def __init__(self, unit: str = "blocks"):
+        self.unit = unit
+        self.n = 0
+        self.sum_signed = 0.0
+        self.sum_abs = 0.0
+
+    def add(self, signed: float) -> None:
+        self.n += 1
+        self.sum_signed += signed
+        self.sum_abs += abs(signed)
+
+    def render(self) -> dict[str, Any]:
+        if not self.n:
+            return {"n": 0}
+        return {"n": self.n,
+                f"mae_{self.unit}": round(self.sum_abs / self.n, 4),
+                f"mean_signed_{self.unit}": round(
+                    self.sum_signed / self.n, 4)}
+
+
+class _PodCacheStats:
+    """EWMA cache observations for one pod."""
+
+    __slots__ = ("n", "ewma_hit_ratio", "ewma_signed_error", "last_unix")
+
+    def __init__(self):
+        self.n = 0
+        self.ewma_hit_ratio: float | None = None
+        self.ewma_signed_error: float | None = None
+        self.last_unix = 0.0
+
+    def render(self) -> dict[str, Any]:
+        doc: dict[str, Any] = {"n": self.n, "last_unix": self.last_unix}
+        if self.ewma_hit_ratio is not None:
+            doc["ewma_hit_ratio"] = round(self.ewma_hit_ratio, 4)
+        if self.ewma_signed_error is not None:
+            # predicted − actual, in hit-ratio units: positive = the
+            # scorers promise more reuse than the engine finds.
+            doc["ewma_signed_error"] = round(self.ewma_signed_error, 4)
+        return doc
+
+
+class KvHitTable:
+    """Bounded LRU of per-pod hit-rate / prediction-error EWMAs. Lives on
+    the Datastore (like the breaker registry and the TransferTable) so
+    scheduling plugins — notably ROADMAP item 2's prefill classifier — can
+    read measured reuse instead of assuming it. Writers run on the gateway
+    event loop; no locking needed."""
+
+    ALPHA = 0.2
+
+    def __init__(self, max_pods: int = 256):
+        self.max_pods = max_pods
+        self._pods: OrderedDict[str, _PodCacheStats] = OrderedDict()
+
+    def record(self, pod: str, *, hit_ratio: float | None,
+               signed_error: float | None) -> None:
+        stats = self._pods.get(pod)
+        if stats is None:
+            while len(self._pods) >= self.max_pods:
+                self._pods.popitem(last=False)
+            stats = self._pods[pod] = _PodCacheStats()
+        else:
+            self._pods.move_to_end(pod)
+        stats.n += 1
+        stats.last_unix = time.time()
+        a = self.ALPHA
+        if hit_ratio is not None:
+            stats.ewma_hit_ratio = (
+                hit_ratio if stats.ewma_hit_ratio is None
+                else (1 - a) * stats.ewma_hit_ratio + a * hit_ratio)
+        if signed_error is not None:
+            stats.ewma_signed_error = (
+                signed_error if stats.ewma_signed_error is None
+                else (1 - a) * stats.ewma_signed_error + a * signed_error)
+
+    def pod(self, pod: str) -> _PodCacheStats | None:
+        """Plugin-facing lookup (no LRU touch: reading a pod's stats must
+        not pin it against eviction)."""
+        return self._pods.get(pod)
+
+    def rows(self) -> dict[str, dict[str, Any]]:
+        return {pod: stats.render() for pod, stats in self._pods.items()}
+
+    def __len__(self) -> int:
+        return len(self._pods)
+
+
+class CacheLedger:
+    """The gateway-level join point: schedule-time predictions in,
+    engine-confirmed actuals out, /debug/kv rollup in the middle."""
+
+    def __init__(self, cfg: KvObsConfig | None = None, *, datastore=None):
+        self.cfg = cfg or KvObsConfig()
+        self.datastore = datastore
+        self.table: KvHitTable = (
+            datastore.kv_obs if datastore is not None else KvHitTable())
+        self.table.max_pods = self.cfg.capacity
+        self._stamps = 0          # predictions recorded (speculative)
+        self._joins = 0           # engine-confirmed actuals joined
+        self._err = _ErrAgg("blocks")
+        self._err_ratio = _ErrAgg("ratio")
+        # Index-occupancy sources discovered from the configured plugin set
+        # (attach_plugins): approx producers expose per-pod LRU sizes,
+        # precise scorers expose confirmed/speculative stamp counts.
+        self._approx: list[Any] = []
+        self._precise: list[Any] = []
+
+    @property
+    def enabled(self) -> bool:
+        return self.cfg.enabled
+
+    def attach_plugins(self, plugins) -> None:
+        for p in plugins:
+            if hasattr(p, "index_sizes"):
+                self._approx.append(p)
+            if hasattr(p, "index_counts"):
+                self._precise.append(p)
+
+    # ---- schedule-time: predicted hit depth per candidate ---------------
+
+    def record_scheduled(self, request: Any, result: Any) -> None:
+        """Stamp the per-candidate predicted hit depth into the request's
+        DecisionRecord ``cache`` block. Called again on a failover
+        reschedule: the fresh candidates MERGE into the block (the actual
+        may be served by a pod the first pass never ranked)."""
+        if not self.cfg.enabled or result is None:
+            return
+        precise: dict[str, float] = {}
+        for pr in result.profile_results.values():
+            for name, scores in pr.raw_scores.items():
+                if "precise-prefix" in name:
+                    precise.update(scores)
+        predicted: dict[str, dict[str, Any]] = {}
+        for ep in result.all_endpoints()[: self.cfg.top_candidates]:
+            addr = ep.metadata.address_port
+            entry: dict[str, Any] = {}
+            info = ep.attributes.get(PREFIX_ATTRIBUTE_KEY)
+            if info is not None:
+                entry = {"blocks": info.match_blocks,
+                         "total": info.total_blocks,
+                         "ratio": round(info.hit_ratio, 4),
+                         "block_tokens": info.block_size_tokens}
+            if addr in precise:
+                entry["precise_ratio"] = round(precise[addr], 4)
+            if entry:
+                predicted[addr] = entry
+        if not predicted:
+            return  # no prefix plugin produced a signal — nothing to join
+        primary = result.primary().target_endpoints
+        chosen = primary[0].metadata.address_port if primary else ""
+        obs: CacheObservation | None = getattr(request, "cache", None)
+        if obs is not None:
+            if not obs.done:
+                obs.predicted.update(predicted)
+                obs.chosen = chosen
+                obs.block["chosen"] = chosen
+            return
+        obs = CacheObservation(predicted, chosen)
+        request.cache = obs
+        self._stamps += 1
+        cp = predicted.get(chosen)
+        if cp is not None and "blocks" in cp:
+            KV_PREDICTED_HIT_BLOCKS.observe(cp["blocks"])
+        rec = getattr(request, "decision", None)
+        if rec is not None and hasattr(rec, "record_cache"):
+            rec.record_cache(obs.block)
+
+    # ---- completion-time: engine-confirmed actual -----------------------
+
+    def observe_response(self, request: Any, endpoint: Any, headers: Any,
+                         usage: dict[str, Any] | None = None) -> None:
+        """Join the engine-confirmed actual (first signal wins): the
+        relayed ``x-kv-hit-*`` headers on non-streaming responses, or the
+        terminal usage record's ``prompt_tokens_details.cached_tokens`` on
+        streams. Called once when the response headers land (so the
+        ``x-debug-decision`` summary echo can carry the verdict) and again
+        from the proxy's terminal accounting with the parsed usage — a
+        request with neither signal simply never joins."""
+        obs: CacheObservation | None = getattr(request, "cache", None)
+        if obs is None or obs.done:
+            return
+        ht = hb = None
+        source = None
+        v = finite_float_or_none(headers.get(H_KV_HIT_TOKENS)
+                                 if headers is not None else None)
+        if v is not None and v >= 0:
+            ht = int(v)
+            vb = finite_float_or_none(headers.get(H_KV_HIT_BLOCKS))
+            hb = int(vb) if vb is not None and vb >= 0 else None
+            source = "headers"
+        else:
+            details = (usage or {}).get("prompt_tokens_details") or {}
+            ct = details.get("cached_tokens")
+            if isinstance(ct, (int, float)) and ct >= 0:
+                ht = int(ct)
+                source = "usage"
+        if ht is None:
+            return
+        obs.done = True
+        self._joins += 1
+        pod = ""
+        if headers is not None:
+            pod = headers.get(H_KV_PREFILLER) or ""
+        if not pod and endpoint is not None:
+            pod = endpoint.metadata.address_port
+        pred = obs.predicted.get(pod)
+        block_tokens = int((pred or {}).get("block_tokens") or 16)
+        if hb is None:
+            hb = ht // max(block_tokens, 1)
+        prompt_tokens = int((usage or {}).get("prompt_tokens") or 0)
+        ratio: float | None = None
+        if prompt_tokens > 0:
+            ratio = min(ht / prompt_tokens, 1.0)
+        elif pred is not None and pred.get("total"):
+            ratio = min(hb / pred["total"], 1.0)
+        actual: dict[str, Any] = {"pod": pod, "blocks": hb, "tokens": ht,
+                                  "source": source}
+        if ratio is not None:
+            actual["ratio"] = round(ratio, 4)
+            KV_ACTUAL_HIT_RATIO.observe(ratio)
+        signed_ratio: float | None = None
+        if pred is not None:
+            if "blocks" in pred:
+                signed_blocks = pred["blocks"] - hb
+                KV_HIT_PREDICTION_ERROR.observe(abs(signed_blocks))
+                actual["prediction_error_blocks"] = signed_blocks
+                self._err.add(signed_blocks)
+            pr = pred.get("ratio")
+            if pr is not None and ratio is not None:
+                signed_ratio = pr - ratio
+                self._err_ratio.add(signed_ratio)
+        self.table.record(pod or "(unknown)", hit_ratio=ratio,
+                          signed_error=signed_ratio)
+        obs.block["actual"] = actual
+
+    # ---- render ---------------------------------------------------------
+
+    def snapshot(self) -> dict[str, Any]:
+        """The /debug/kv payload: per-pod EWMAs + index occupancy +
+        scraped engine counters, speculative-vs-confirmed stamp counts, and
+        the prediction MAE. ``index_divergence`` is 0 in a process that
+        holds its own engine-confirmed index (single-process router, fleet
+        leader); the fleet supervisor recomputes it per follower shard."""
+        pods: dict[str, dict[str, Any]] = {
+            pod: dict(row) for pod, row in self.table.rows().items()}
+
+        def _row(addr: str) -> dict[str, Any]:
+            return pods.setdefault(addr, {})
+
+        for producer in self._approx:
+            for addr, blocks in producer.index_sizes().items():
+                _row(addr)["approx_index_blocks"] = blocks
+        confirmed_total = speculative_total = 0
+        for scorer in self._precise:
+            for addr, counts in scorer.index_counts().items():
+                row = _row(addr)
+                row["confirmed_blocks"] = counts["confirmed"]
+                row["speculative_blocks"] = counts["speculative"]
+                confirmed_total += counts["confirmed"]
+                speculative_total += counts["speculative"]
+        if self.datastore is not None:
+            for ep in self.datastore.endpoint_list():
+                m = ep.metrics
+                if m.prefill_tokens < 0:
+                    continue
+                scraped: dict[str, Any] = {
+                    "prefill_tokens": int(m.prefill_tokens),
+                    "prefix_hit_tokens": int(max(m.prefix_hit_tokens, 0)),
+                }
+                if m.prefill_tokens > 0:
+                    scraped["actual_hit_ratio"] = round(
+                        max(m.prefix_hit_tokens, 0) / m.prefill_tokens, 4)
+                _row(ep.metadata.address_port)["scraped"] = scraped
+        return {
+            "enabled": self.cfg.enabled,
+            "predicted_stamps": self._stamps,
+            "confirmed_joins": self._joins,
+            "prediction": self._err.render(),
+            "prediction_ratio": self._err_ratio.render(),
+            "index": {"confirmed_blocks": confirmed_total,
+                      "speculative_blocks": speculative_total},
+            "pods": pods,
+            "index_divergence": 0.0,
+        }

@@ -210,6 +210,27 @@ QUEUE_DRAIN_RATE = Gauge(
     "Measured flow-control dispatch rate (requests/second, EWMA) feeding "
     "the overload controller's queue-wait and Retry-After estimates",
     registry=REGISTRY)
+# KV-cache & prefix-reuse observability (router/kvobs.py): the
+# predicted-vs-confirmed hit ledger behind /debug/kv. Per-request detail
+# (per-candidate predictions, the engine-confirmed actual, signed error)
+# lives in the DecisionRecord cache block; these are the graphable
+# aggregates.
+KV_PREDICTED_HIT_BLOCKS = Histogram(
+    "router_kv_predicted_hit_blocks",
+    "Schedule-time predicted prefix-hit depth (blocks) for the chosen "
+    "endpoint (approx producer / precise scorer prediction)",
+    registry=REGISTRY, buckets=(0, 1, 2, 4, 8, 16, 32, 64, 128))
+KV_HIT_PREDICTION_ERROR = Histogram(
+    "router_kv_hit_prediction_error",
+    "Absolute error (blocks) between the predicted hit depth and the "
+    "engine-confirmed actual (x-kv-hit-blocks); signed bias is in the "
+    "/debug/kv rollup",
+    registry=REGISTRY, buckets=(0, 1, 2, 4, 8, 16, 32, 64))
+KV_ACTUAL_HIT_RATIO = Histogram(
+    "router_kv_actual_hit_ratio",
+    "Engine-confirmed prefix-hit ratio (hit tokens / prompt tokens) per "
+    "completed request",
+    registry=REGISTRY, buckets=(0.0, .1, .25, .5, .75, .9, 1.0))
 # Multi-process sharded gateway (router/fleet.py): each worker exposes the
 # pool-snapshot epoch it last built (leader) or applied from the IPC stream
 # (follower) — the supervisor re-labels it per shard, making snapshot-IPC
@@ -251,3 +272,11 @@ FLEET_BALANCER_CONNECTIONS = Counter(
     "Connections routed per shard by the hash-by-flow-id front balancer "
     "(fleet.balancer: hash; absent under SO_REUSEPORT kernel balancing)",
     ("shard",), registry=FLEET_REGISTRY)
+KV_INDEX_DIVERGENCE = Gauge(
+    "router_kv_index_divergence",
+    "Per-shard KV-index divergence derived at /debug/kv fan-in time: the "
+    "fraction of the leader's engine-confirmed KvBlockIndex blocks a "
+    "follower's (speculative-only) view cannot account for — 0 on the "
+    "leader, 1 on a follower with no overlapping stamps. Measures the "
+    "ROADMAP item-1 follower-fidelity caveat (run balancer: hash when it "
+    "matters)", ("shard",), registry=FLEET_REGISTRY)

@@ -159,6 +159,25 @@ class KvBlockIndex:
             entries = self._by_pod.get(pod, {})
             return sum(1 for exp in entries.values() if exp > now)
 
+    def counts(self) -> dict[str, dict[str, int]]:
+        """Per-pod live confirmed/speculative stamp counts — the precise
+        half of /debug/kv's index-occupancy view, and the quantity the
+        fleet supervisor's divergence gauge compares across shards (a
+        follower holds only speculative stamps; the leader's confirmed
+        entries are what it is diverging from)."""
+        now = time.monotonic()
+        with self._lock:
+            out = {pod: {"confirmed": sum(1 for exp in entries.values()
+                                          if exp > now),
+                         "speculative": 0}
+                   for pod, entries in self._by_pod.items()}
+            for (pod, _h), exp in self._speculative.items():
+                if exp > now:
+                    row = out.setdefault(pod,
+                                         {"confirmed": 0, "speculative": 0})
+                    row["speculative"] += 1
+            return out
+
 
 @register_plugin("precise-prefix-cache-scorer")
 class PrecisePrefixCacheScorer(PluginBase):
@@ -184,6 +203,11 @@ class PrecisePrefixCacheScorer(PluginBase):
         # sync socket); a blocking recv loop with RCVTIMEO is boring and
         # reliable, and the index is lock-protected for cross-thread reads.
         self._subs: dict[str, tuple[threading.Thread, threading.Event]] = {}
+
+    def index_counts(self) -> dict[str, dict[str, int]]:
+        """Per-pod confirmed/speculative stamp counts for the CacheLedger's
+        /debug/kv view (router/kvobs.py)."""
+        return self.index.counts()
 
     def configure(self, params: dict[str, Any], handle: Any) -> None:
         self.block_size_tokens = int(params.get("blockSizeTokens",

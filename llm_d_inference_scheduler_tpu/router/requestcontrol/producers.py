@@ -128,6 +128,12 @@ class ApproxPrefixCacheProducer(PluginBase):
             for h in self._hashes(request, bs):
                 lru.add(h)
 
+    def index_sizes(self) -> dict[str, int]:
+        """Per-pod speculative index occupancy (block hashes this router
+        believes each pod holds) — the approx half of /debug/kv's
+        index-occupancy view (router/kvobs.py CacheLedger)."""
+        return {pod: len(lru) for pod, lru in self._indexes.items()}
+
     def endpoint_removed(self, endpoint: Endpoint) -> None:
         self._indexes.pop(endpoint.metadata.address_port, None)
 

@@ -602,6 +602,7 @@ class Sidecar:
         # out) the request falls back to aggregated local decode.
         ktp = None
         served_prefiller = None
+        hit_headers: dict[str, str] = {}
         attempts = 0
         for i, prefiller in enumerate(prefillers):
             if deadline is not None and deadline.expired:
@@ -623,6 +624,16 @@ class Sidecar:
                 if r.status_code == 200:
                     ktp = r.json().get("kv_transfer_params")
                     served_prefiller = prefiller
+                    # The PREFILL leg is where the prefix-cache hit actually
+                    # happened on a P/D split — relay its engine-confirmed
+                    # depth (engine server _kv_hit_headers) so the router's
+                    # cache ledger joins it against the prediction. The
+                    # decode leg's own headers (absent for KV imports) must
+                    # not shadow these.
+                    for h in ("x-kv-hit-blocks", "x-kv-hit-tokens"):
+                        v = r.headers.get(h)
+                        if v is not None:
+                            hit_headers[h] = v
                     span.set_attribute("prefill_endpoint", prefiller)
                     break
                 log.warning("prefill at %s returned %d; %s", prefiller,
@@ -643,7 +654,7 @@ class Sidecar:
         span.set_attribute("prefill_duration_ms", round(prefill_ms, 1))
         span.set_attribute("prefill_attempts", attempts)
         span.set_attribute("fallback_to_decode", ktp is None)
-        extra = {"x-prefill-duration-ms": f"{prefill_ms:.1f}"}
+        extra = {"x-prefill-duration-ms": f"{prefill_ms:.1f}", **hit_headers}
         if served_prefiller is not None:
             # Pair identity for the router's /debug/transfers table: the
             # prefill candidate that actually served (post-failover), not
@@ -704,6 +715,14 @@ class Sidecar:
             v = finite_float_or_none(pull_ms)
             if v is not None:
                 self._h_kv_transfer.observe(v)
+        # Local-decode fallback (and passthrough/monolithic fronting): the
+        # decode engine's own prefix-hit headers relay unless a prefill
+        # leg already supplied the authoritative pair (extra_headers).
+        if "x-kv-hit-tokens" not in out_headers:
+            for h in ("x-kv-hit-blocks", "x-kv-hit-tokens"):
+                v = resp.headers.get(h)
+                if v is not None:
+                    out_headers[h] = v
         try:
             if "text/event-stream" in out_headers["content-type"]:
                 ws = web.StreamResponse(status=resp.status_code, headers=out_headers)

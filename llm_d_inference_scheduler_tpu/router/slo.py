@@ -467,6 +467,10 @@ class SloLedger:
                         "tpot_ms": obs.slo_tpot_ms,
                         "defined": slo_defined},
                 "slo_met": met,
+                # The ledger's verdict enum (met | missed | error | shed),
+                # spelled out so /debug/decisions list filters don't have
+                # to re-derive it from slo_met/reason/shed.
+                "verdict": verdict,
                 "streamed": obs.streamed,
             }
             if shed:

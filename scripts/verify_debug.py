@@ -1,0 +1,146 @@
+"""Debug-surface lint: every registered /debug route must answer JSON and
+have a row in the docs index table.
+
+The debug planes are the zero-egress operator story — a route an operator
+can hit but cannot look up (or one that silently starts returning HTML
+tracebacks) is drift, exactly like an undocumented metric family. This is
+the debug-surface twin of ``scripts/verify_metrics.py``'s registry↔docs
+sync lint:
+
+- boots a real gateway (no engines needed — empty-pool payloads are still
+  valid JSON) and GETs every route registered under ``/debug`` on its app
+  router, substituting a dummy id for parameterized routes (a JSON 404 is
+  a pass; an HTML error page is not);
+- boots a ``FleetAdmin`` fan-in plane against zero workers and does the
+  same for the supervisor-only routes (``/debug/fleet``, the merged
+  views);
+- asserts every route's base path has a row in the
+  ``docs/observability.md`` "Debug surfaces" index table.
+
+Run via ``make verify-debug``; tests/test_kvobs.py hooks it into the
+pytest run so CI catches debug-surface drift.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+GW_PORT, ADMIN_PORT = 18770, 18771
+
+# /debug/profile blocks for ?seconds=N wall-clock; drive it with an invalid
+# value so it answers immediately — the JSON 400 error is exactly the
+# "answers JSON" contract this lint checks.
+QUERY_OVERRIDES = {"/debug/profile": "?seconds=0"}
+
+CFG = """
+pool:
+  endpoints: []
+plugins:
+  - {type: approx-prefix-cache-producer}
+  - {type: prefix-cache-scorer}
+schedulingProfiles:
+  - name: default
+    plugins:
+      - {pluginRef: prefix-cache-scorer}
+"""
+
+
+def _debug_paths(app) -> list[str]:
+    """Canonical /debug route paths registered on one aiohttp app."""
+    paths = set()
+    for resource in app.router.resources():
+        canonical = resource.canonical
+        if canonical.startswith("/debug"):
+            paths.add(canonical)
+    return sorted(paths)
+
+
+def _probe_path(path: str) -> str:
+    """Request path for a canonical route (dummy ids for parameters)."""
+    probe = path.replace("{request_id}", "verify-debug-nonexistent")
+    return probe + QUERY_OVERRIDES.get(path, "")
+
+
+def _docs_table() -> str:
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    try:
+        with open(os.path.join(here, "docs", "observability.md")) as f:
+            return f.read()
+    except OSError:
+        return ""
+
+
+def _base_route(path: str) -> str:
+    """Docs-table key for a route: parameterized detail routes fold into
+    their list route (/debug/decisions/{request_id} → /debug/decisions)."""
+    if "{" in path:
+        path = path.split("{", 1)[0].rstrip("/")
+    return path
+
+
+async def _drive() -> list[str]:
+    import aiohttp
+
+    from llm_d_inference_scheduler_tpu.router.fleet import FleetAdmin
+    from llm_d_inference_scheduler_tpu.router.gateway import build_gateway
+
+    errors: list[str] = []
+    docs = _docs_table()
+
+    gw = build_gateway(CFG, port=GW_PORT, poll_interval=60.0)
+    await gw.start()
+    admin = FleetAdmin([], host="127.0.0.1", port=ADMIN_PORT)
+    await admin.start()
+    try:
+        async with aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=10.0)) as session:
+            for port, app, tag in ((GW_PORT, gw.app, "gateway"),
+                                   (ADMIN_PORT, admin.app, "fleet-admin")):
+                paths = _debug_paths(app)
+                if not paths:
+                    errors.append(f"{tag}: no /debug routes registered?")
+                for path in paths:
+                    url = f"http://127.0.0.1:{port}{_probe_path(path)}"
+                    try:
+                        async with session.get(url) as resp:
+                            try:
+                                await resp.json(content_type=None)
+                            except Exception:
+                                errors.append(
+                                    f"{tag} {path}: {resp.status} response "
+                                    "is not JSON")
+                    except Exception as e:
+                        errors.append(f"{tag} {path}: unreachable ({e})")
+                    base = _base_route(path)
+                    if f"`{base}`" not in docs:
+                        errors.append(
+                            f"{tag} {path}: no row for `{base}` in the "
+                            "docs/observability.md debug-surfaces table")
+    finally:
+        await admin.stop()
+        await gw.stop()
+    return errors
+
+
+def check() -> list[str]:
+    import asyncio
+
+    return asyncio.run(_drive())
+
+
+def main() -> int:
+    errors = check()
+    for e in errors:
+        print(f"verify-debug: {e}", file=sys.stderr)
+    if errors:
+        return 1
+    print("verify-debug: every registered /debug route answers JSON and "
+          "has a docs/observability.md index row")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -63,6 +63,14 @@ REQUIRED_FAMILIES = {
     ("router_degraded_requests", "router"),
     ("router_retry_after_seconds", "router"),
     ("router_queue_drain_rate", "router"),
+    # KV-cache & prefix-reuse observability (ISSUE 10): predicted hit depth
+    # at schedule time, predicted-vs-confirmed error, engine-confirmed
+    # actual hit ratio, and the fleet supervisor's per-shard index
+    # divergence gauge.
+    ("router_kv_predicted_hit_blocks", "router"),
+    ("router_kv_hit_prediction_error", "router"),
+    ("router_kv_actual_hit_ratio", "router"),
+    ("router_kv_index_divergence", "fleet"),
     # Multi-process sharded fleet (ISSUE 9): per-worker snapshot epoch and
     # the supervisor's shard-labeled liveness/request/epoch families.
     ("router_snapshot_epoch", "router"),

@@ -142,6 +142,48 @@ def test_disagg_path_end_to_end():
                 m = await c.get(f"http://127.0.0.1:{GW}/metrics")
                 assert "router_kv_transfer_ms_count" in m.text
                 assert 'router_goodput_tokens_total{model="tiny"}' in m.text
+
+                # Golden cache block, P/D split (router/kvobs.py): the
+                # first long-prompt request ran the 2-phase protocol, so
+                # the sidecar relayed the PREFILL leg's engine-confirmed
+                # hit headers (beside x-prefill-duration-ms, with
+                # x-kv-prefiller naming the pod) and the DecisionRecord
+                # joined them against the schedule-time per-candidate
+                # prediction — decode pick AND prefill candidate.
+                d = (await c.get(
+                    f"http://127.0.0.1:{GW}/debug/decisions/disagg-slo-1")
+                    ).json()
+                cache = d["cache"]
+                assert f"127.0.0.1:{SC}" in cache["predicted"]
+                assert f"127.0.0.1:{PRE}" in cache["predicted"]
+                assert cache["chosen"] == f"127.0.0.1:{SC}"
+                actual = cache["actual"]
+                assert actual["pod"] == f"127.0.0.1:{PRE}"  # x-kv-prefiller
+                assert actual["source"] == "headers"
+                assert actual["tokens"] == 0  # cold prefill engine
+
+                # Warm repeat: the approx index now knows the decode pod
+                # holds the blocks, so the PD decider keeps it local — the
+                # sidecar's local-decode fallback relays the DECODE
+                # engine's hit headers instead, and the join attributes
+                # the (real, >0) hit to the decode pod.
+                r = await c.post(f"http://127.0.0.1:{GW}/v1/completions",
+                                 json={"model": "tiny", "prompt": LONG_PROMPT,
+                                       "max_tokens": 6, "temperature": 0},
+                                 headers={"x-request-id": "disagg-kv-2"})
+                assert r.status_code == 200
+                assert int(r.headers["x-kv-hit-tokens"]) > 0  # relayed
+                d = (await c.get(
+                    f"http://127.0.0.1:{GW}/debug/decisions/disagg-kv-2")
+                    ).json()
+                actual = d["cache"]["actual"]
+                assert actual["pod"] == f"127.0.0.1:{SC}"
+                assert actual["source"] == "headers"
+                assert actual["tokens"] > 0 and actual["ratio"] > 0
+                kv = (await c.get(f"http://127.0.0.1:{GW}/debug/kv")).json()
+                assert kv["confirmed_joins"] >= 2
+                assert f"127.0.0.1:{PRE}" in kv["pods"]
+                assert f"127.0.0.1:{SC}" in kv["pods"]
         finally:
             await gw.stop()
             await sc.stop()

@@ -496,6 +496,102 @@ def test_fleet_admin_fan_in_with_stub_workers():
     run(body())
 
 
+def test_fleet_admin_kv_fan_in_and_divergence():
+    """Merged /debug/kv against stub workers: shard-annotated snapshots,
+    n-weighted MAE merge, and the leader-vs-follower index-divergence
+    gauge (the follower's speculative-only view measured against the
+    leader's engine-confirmed KvBlockIndex counts)."""
+    from llm_d_inference_scheduler_tpu.router.fleet import (
+        shard_index_divergence,
+    )
+    from llm_d_inference_scheduler_tpu.router.metrics import (
+        KV_INDEX_DIVERGENCE,
+    )
+
+    leader_doc = {
+        "enabled": True, "predicted_stamps": 10, "confirmed_joins": 8,
+        "prediction": {"n": 8, "mae_blocks": 2.0,
+                       "mean_signed_blocks": 1.0},
+        "prediction_ratio": {"n": 8, "mae_ratio": 0.1,
+                             "mean_signed_ratio": 0.05},
+        "pods": {"p:1": {"confirmed_blocks": 100, "speculative_blocks": 0},
+                 "p:2": {"confirmed_blocks": 60, "speculative_blocks": 0}},
+        "index_divergence": 0.0,
+    }
+    follower_doc = {
+        "enabled": True, "predicted_stamps": 4, "confirmed_joins": 4,
+        "prediction": {"n": 4, "mae_blocks": 5.0,
+                       "mean_signed_blocks": -2.0},
+        "prediction_ratio": {"n": 4, "mae_ratio": 0.4,
+                             "mean_signed_ratio": -0.2},
+        # Speculative-only view covering 40 of the leader's 160 confirmed.
+        "pods": {"p:1": {"confirmed_blocks": 0, "speculative_blocks": 30},
+                 "p:2": {"confirmed_blocks": 0, "speculative_blocks": 10}},
+        "index_divergence": 0.0,
+    }
+    # Unit: 40/160 covered → divergence 0.75; full coverage → 0.
+    assert shard_index_divergence(leader_doc, follower_doc) == 0.75
+    assert shard_index_divergence(leader_doc, leader_doc) == 0.0
+    assert shard_index_divergence({"pods": {}}, follower_doc) == 0.0
+
+    def _kv_stub(port, doc):
+        app = web.Application()
+
+        async def kv(request):
+            return web.json_response(doc)
+
+        async def health(request):
+            return web.json_response({"status": "ok"})
+
+        app.add_routes([web.get("/debug/kv", kv),
+                        web.get("/health", health)])
+        return app, port
+
+    async def body():
+        runners = []
+        for app, port in (_kv_stub(STUB_A, leader_doc),
+                          _kv_stub(STUB_B, follower_doc)):
+            runner = web.AppRunner(app)
+            await runner.setup()
+            await web.TCPSite(runner, "127.0.0.1", port).start()
+            runners.append(runner)
+        admin = FleetAdmin([("127.0.0.1", STUB_A), ("127.0.0.1", STUB_B)],
+                           host="127.0.0.1", port=STUB_ADMIN)
+        await admin.start()
+        try:
+            async with httpx.AsyncClient(timeout=10) as c:
+                r = await c.get(
+                    f"http://127.0.0.1:{STUB_ADMIN}/debug/kv")
+                assert r.status_code == 200
+                doc = r.json()
+                assert doc["workers"] == 2 and doc["enabled"]
+                assert doc["predicted_stamps"] == 14
+                assert doc["confirmed_joins"] == 12
+                # n-weighted MAE merge: (8*2 + 4*5) / 12 = 3.0.
+                assert doc["prediction"] == {
+                    "n": 12, "mae_blocks": 3.0, "mean_signed_blocks": 0.0}
+                assert doc["prediction_ratio"]["mae_ratio"] == 0.2
+                # Shard annotation + per-shard divergence, and the gauge.
+                assert [s["shard"] for s in doc["shards"]] == [0, 1]
+                assert doc["index_divergence"] == {"0": 0.0, "1": 0.75}
+                assert doc["shards"][1]["index_divergence"] == 0.75
+                m = (await c.get(
+                    f"http://127.0.0.1:{STUB_ADMIN}/metrics")).text
+                assert ('router_kv_index_divergence{shard="1"} 0.75'
+                        in m)
+        finally:
+            await admin.stop()
+            for runner in runners:
+                await runner.cleanup()
+            for shard in ("0", "1"):
+                try:
+                    KV_INDEX_DIVERGENCE.remove(shard)
+                except KeyError:
+                    pass
+
+    run(body())
+
+
 # ---- real 2-worker fleet e2e --------------------------------------------
 
 FLEET_CFG = f"""
@@ -603,6 +699,14 @@ def test_fleet_e2e_two_workers_hash_balancer():
                 # Fleet SLO rollup saw all four requests.
                 r = await c.get(base + "/debug/slo")
                 assert r.json()["totals"]["requests"] == 4
+                # Fleet /debug/kv: live on the supervisor with the
+                # per-shard divergence gauge present for every shard
+                # (leader shard 0 reports 0 by definition).
+                r = await c.get(base + "/debug/kv")
+                kv = r.json()
+                assert kv["workers"] == 2
+                assert set(kv["index_divergence"]) == {"0", "1"}
+                assert kv["index_divergence"]["0"] == 0.0
                 r = await c.get(base + "/health")
                 assert r.status_code == 200
                 assert r.json()["workers_ready"] == 2

@@ -16,6 +16,29 @@ def _cfg(port, role="both", **kw):
                         max_model_len=256, role=role, kv_events_port=0, **kw)
 
 
+def _device_transfer_available() -> bool:
+    """True when jax.experimental.transfer can actually start a transfer
+    server on this backend. On CPU images the module is absent (or the
+    server refuses to start), so the device-pull tests below cannot
+    exercise their subject — skip them cleanly instead of failing (the
+    same precedent as test_tls's ``importorskip("cryptography")``)."""
+    try:
+        from llm_d_inference_scheduler_tpu.engine.core import (
+            _get_transfer_server,
+        )
+
+        _get_transfer_server()
+        return True
+    except Exception:
+        return False
+
+
+requires_device_transfer = pytest.mark.skipif(
+    not _device_transfer_available(),
+    reason="jax.experimental.transfer server unavailable on this backend "
+           "(CPU image): device-to-device KV pull cannot run")
+
+
 PROMPT = [1] + [(i * 11) % 400 + 3 for i in range(40)]
 
 
@@ -44,6 +67,7 @@ async def _run_pd(pre_port, dec_port, mutate_ktp=None):
         return ktp, r2.json()
 
 
+@requires_device_transfer
 def test_device_path_used_and_matches_monolithic():
     async def body():
         mono = EngineServer(_cfg(18731))
@@ -110,6 +134,7 @@ def test_device_pull_failure_falls_back_to_http():
     asyncio.run(body())
 
 
+@requires_device_transfer
 def test_sharded_pull_tp_pair_matches_monolithic():
     """tp-sharded P/D pair (VERDICT r2 missing #6, single-process half):
     the prefiller registers one descriptor per unique page shard
@@ -142,6 +167,7 @@ def test_sharded_pull_tp_pair_matches_monolithic():
     asyncio.run(body())
 
 
+@requires_device_transfer
 def test_sharded_pull_pp_pair_matches_monolithic():
     """pp-sharded P/D pair: pages shard the LAYER axis over pp stages
     (pp_serve.PAGE_SPEC); the prefiller stages one descriptor per unique
@@ -176,6 +202,7 @@ def test_sharded_pull_pp_pair_matches_monolithic():
     asyncio.run(body())
 
 
+@requires_device_transfer
 def test_sharded_geometry_mismatch_falls_back_to_host():
     """tp=2 exporter, unsharded importer: geometry mismatch must degrade to
     the host-staged path (numpy resharding), not fail the request."""
