@@ -6,7 +6,8 @@ PY ?= python
 
 .PHONY: test test-fast test-unit test-dist test-chaos bench bench-flowcontrol \
 	bench-router-sse bench-decisions bench-sched bench-sched-offload \
-	bench-scaleout bench-slo bench-overload bench-kvobs dryrun render-chart \
+	bench-scaleout bench-slo bench-overload bench-kvobs bench-multiturn \
+	dryrun render-chart \
 	compile-check \
 	verify-metrics verify-decisions verify-hotpath verify-threadsafe \
 	verify-slo verify-debug
@@ -114,6 +115,18 @@ bench-overload:
 # against.
 bench-kvobs:
 	$(PY) bench.py --kv-obs
+
+# Multi-turn conversation scenario (CPU-only): N users x M turns with a
+# shared system prompt and per-user history growth through the full
+# gateway -> sidecar -> P/D sim topology, session-sticky via
+# x-session-token. Compares warm-turn TTFT with the session-aware prefill
+# classifier (skip the P/D hop) against the always-disagg baseline,
+# best-of-N reps per the shared-box precedent. Writes
+# benchmarks/MULTITURN.json — targets: warm-turn TTFT p50 >= 25% better,
+# cold turns within noise, classifier precision >= 0.9 judged against the
+# CacheLedger's engine-confirmed actual hit depths.
+bench-multiturn:
+	$(PY) bench.py --multi-turn
 
 test-unit: test-fast
 

@@ -2061,6 +2061,297 @@ schedulingProfiles:
     }
 
 
+def multi_turn_bench(quick: bool = False) -> dict:
+    """Multi-turn conversation scenario (CPU-only, no chip): warm-turn TTFT
+    with the session-aware prefill classifier vs the always-disagg baseline.
+
+    Written to benchmarks/MULTITURN.json. PPD (arXiv:2603.13358) premise:
+    multi-turn traffic splits into cache-hit prefills (cheap,
+    decode-adjacent) and cold prefills (expensive, prefill-pool work). In
+    an always-disagg P/D topology a warm turn pays a prefill-pod round
+    trip plus a KV pull for blocks the decode pod already holds; the
+    classifier (router/plugins/disagg.py) routes confident cache-hit
+    prefills straight to the decode pod instead.
+
+    Topology: 1 prefill sim + 2 decode sims each fronted by a sidecar, the
+    full 2-phase tpu-dcn protocol live. The sims price the physics
+    (sim_prefill_ms_per_token on COLD tokens only, sim_kv_pull_ms_per_block
+    on the import leg) so the hop's cost is modeled, not assumed.
+
+    Workload: N users x M turns; each user's prompt carries a user-salted
+    head (turn 1 is genuinely cold), the shared system policy, and the
+    growing conversation history; turns ride the x-session-token sticky
+    path. A warmup wave (same shape, separate users) fills the approx
+    index and the KvHitTable trust signal first — the classifier is judged
+    at steady state, the PR 5/8 best-of-N discipline across reps handles
+    the shared box.
+
+    Acceptance: warm-turn (turn >= 2) TTFT p50 improves >= 25% vs the
+    always-disagg baseline, cold-turn TTFT does not regress beyond noise,
+    classifier precision >= 0.9 judged against the CacheLedger's
+    engine-confirmed actual hit depths, and the classifier.enabled: false
+    run takes the P/D hop on every turn (0 skips, 0 classifier verdicts)."""
+    import asyncio
+    import statistics
+
+    PE, D0, D1, S0, S1, GW = 18880, 18881, 18882, 18883, 18884, 18885
+    REPS = 1 if quick else 3
+    WARM_USERS, WARM_TURNS = (3, 2) if quick else (6, 3)
+    N_USERS, TURNS = (4, 3) if quick else (8, 4)
+    PREFILL_MS_TOK = 0.4      # cold-token prefill cost (byte tokenizer)
+    PULL_MS_BLOCK = 0.75      # simulated KV-pull cost per imported block
+    SYSTEM = ("You are a meticulous support assistant. Follow the policies "
+              "below precisely, cite the relevant clause for every answer, "
+              "and reply in the user's language. Policy 1: never disclose "
+              "internal tooling. Policy 2: escalate billing disputes over "
+              "the threshold. Policy 3: summarise each resolution in one "
+              "sentence. ") * 4  # ~1400 chars -> ~1400 sim tokens
+
+    def _cfg(enabled: bool) -> str:
+        return f"""
+disagg:
+  classifier:
+    enabled: {str(enabled).lower()}
+    coldTokenThreshold: 96
+    minConfidence: 0.5
+kvCache: {{enabled: true}}
+pool:
+  endpoints:
+    - {{address: 127.0.0.1, port: {S0}, labels: {{llm-d.ai/role: decode}}}}
+    - {{address: 127.0.0.1, port: {S1}, labels: {{llm-d.ai/role: decode}}}}
+    - {{address: 127.0.0.1, port: {PE}, labels: {{llm-d.ai/role: prefill}}}}
+plugins:
+  - {{type: decode-filter}}
+  - {{type: prefill-filter}}
+  - {{type: approx-prefix-cache-producer}}
+  - {{type: prefix-cache-scorer}}
+  - {{type: session-affinity-scorer}}
+  - {{type: queue-scorer}}
+  - type: disagg-profile-handler
+    parameters:
+      pdDecider: {{type: always-disagg-pd-decider}}
+schedulingProfiles:
+  - name: decode
+    plugins:
+      - {{pluginRef: decode-filter}}
+      - {{pluginRef: session-affinity-scorer, weight: 4}}
+      - {{pluginRef: prefix-cache-scorer, weight: 3}}
+      - {{pluginRef: queue-scorer, weight: 1}}
+  - name: prefill
+    plugins:
+      - {{pluginRef: prefill-filter}}
+      - {{pluginRef: queue-scorer}}
+"""
+
+    def _metric_value(text: str, family: str) -> float:
+        for line in text.splitlines():
+            if line.startswith(family + " ") or \
+                    line.startswith(family + "_total "):
+                return float(line.split()[-1])
+        return 0.0
+
+    async def run_mode(enabled: bool, user_salt: str) -> dict:
+        import httpx
+
+        from llm_d_inference_scheduler_tpu.engine import EngineConfig
+        from llm_d_inference_scheduler_tpu.engine.server import EngineServer
+        from llm_d_inference_scheduler_tpu.router.gateway import build_gateway
+        from llm_d_inference_scheduler_tpu.router.sidecar import (
+            Sidecar,
+            SidecarConfig,
+        )
+
+        def _sim(port: int, role: str) -> EngineServer:
+            return EngineServer(EngineConfig(
+                backend="sim", model="tiny", port=port, role=role,
+                max_batch=16, max_model_len=4096,
+                sim_prefill_ms_per_token=PREFILL_MS_TOK,
+                sim_decode_ms_per_token=1.0,
+                sim_kv_pull_ms_per_block=PULL_MS_BLOCK))
+
+        engines = [_sim(PE, "prefill"), _sim(D0, "decode"), _sim(D1, "decode")]
+        for e in engines:
+            await e.start()
+        sidecars = [
+            Sidecar(SidecarConfig(port=S0, decoder_url=f"http://127.0.0.1:{D0}")),
+            Sidecar(SidecarConfig(port=S1, decoder_url=f"http://127.0.0.1:{D1}")),
+        ]
+        for s in sidecars:
+            await s.start()
+        gw = build_gateway(_cfg(enabled), port=GW, poll_interval=0.02)
+        await gw.start()
+        try:
+            await asyncio.sleep(0.2)
+            async with httpx.AsyncClient(timeout=120) as c:
+
+                async def one_turn(prompt: str, session: str | None
+                                   ) -> tuple[float, str | None]:
+                    """Streamed completion; returns (client-measured TTFT ms,
+                    x-session-token to carry into the next turn)."""
+                    body = {"model": "tiny", "prompt": prompt,
+                            "max_tokens": 8, "stream": True}
+                    headers = {}
+                    if session:
+                        headers["x-session-token"] = session
+                    t0 = time.perf_counter()
+                    ttft = None
+                    async with c.stream(
+                            "POST", f"http://127.0.0.1:{GW}/v1/completions",
+                            json=body, headers=headers) as r:
+                        token = r.headers.get("x-session-token")
+                        async for line in r.aiter_lines():
+                            if (ttft is None and line.startswith("data: ")
+                                    and line != "data: [DONE]"):
+                                ttft = (time.perf_counter() - t0) * 1e3
+                    return ttft if ttft is not None else float("nan"), token
+
+                async def conversation(uid: str, turns: int,
+                                       record: dict[int, list[float]] | None
+                                       ) -> None:
+                    # User-salted head: turn 1 is cold by construction; the
+                    # shared policy prompt and the per-user history grow
+                    # the reusable prefix every turn.
+                    history = f"[conversation {uid}] {SYSTEM}"
+                    session = None
+                    for t in range(1, turns + 1):
+                        history += (f"\nuser: In turn {t} I need the exact "
+                                    f"policy clause for case {uid}-{t} and "
+                                    "the standard resolution summary.")
+                        ttft, session = await one_turn(
+                            history + "\nassistant:", session)
+                        history += "\nassistant: resolved per policy."
+                        if record is not None:
+                            record.setdefault(t, []).append(ttft)
+
+                # Warmup wave: fills the approx prefix index, the sidecar
+                # connection pools, and (classifier mode) the KvHitTable
+                # trust EWMAs the skip verdict gates on. Not measured.
+                await asyncio.gather(*[
+                    conversation(f"warm-{user_salt}-{i}", WARM_TURNS, None)
+                    for i in range(WARM_USERS)])
+
+                m0 = (await c.get(f"http://127.0.0.1:{GW}/metrics")).text
+                skips0 = _metric_value(m0, "router_pd_hop_skipped")
+                turn_ttfts: dict[int, list[float]] = {}
+                await asyncio.gather(*[
+                    conversation(f"user-{user_salt}-{i}", TURNS, turn_ttfts)
+                    for i in range(N_USERS)])
+
+                m1 = (await c.get(f"http://127.0.0.1:{GW}/metrics")).text
+                kv = (await c.get(f"http://127.0.0.1:{GW}/debug/kv")).json()
+                pre_tokens = (await c.get(
+                    f"http://127.0.0.1:{PE}/metrics")).text
+                return {
+                    "turn_ttfts_ms": {str(t): [round(v, 2) for v in vals]
+                                      for t, vals in
+                                      sorted(turn_ttfts.items())},
+                    "measured_hop_skips": (
+                        _metric_value(m1, "router_pd_hop_skipped") - skips0),
+                    "classifier": kv.get("classifier") or {},
+                    "prefill_pod_prompt_tokens": _metric_value(
+                        pre_tokens, "jetstream:prompt_tokens"),
+                }
+        finally:
+            await gw.stop()
+            for s in sidecars:
+                await s.stop()
+            for e in engines:
+                await e.stop()
+
+    def _p50(vals: list[float]) -> float:
+        clean = [v for v in vals if v == v]  # drop NaNs
+        return round(statistics.median(clean), 2) if clean else float("nan")
+
+    reps: list[dict] = []
+    for rep in range(REPS):
+        clf = asyncio.run(run_mode(True, f"clf{rep}"))
+        base = asyncio.run(run_mode(False, f"base{rep}"))
+        warm_clf = [v for t, vals in clf["turn_ttfts_ms"].items()
+                    if int(t) >= 2 for v in vals]
+        warm_base = [v for t, vals in base["turn_ttfts_ms"].items()
+                     if int(t) >= 2 for v in vals]
+        row = {
+            "rep": rep,
+            "classifier": {
+                "warm_ttft_p50_ms": _p50(warm_clf),
+                "cold_ttft_p50_ms": _p50(clf["turn_ttfts_ms"].get("1", [])),
+                "hop_skips": clf["measured_hop_skips"],
+                "judge": clf["classifier"],
+            },
+            "baseline": {
+                "warm_ttft_p50_ms": _p50(warm_base),
+                "cold_ttft_p50_ms": _p50(base["turn_ttfts_ms"].get("1", [])),
+                "hop_skips": base["measured_hop_skips"],
+                "judge": base["classifier"],
+            },
+            "detail": {"classifier": clf, "baseline": base},
+        }
+        reps.append(row)
+        print(json.dumps({"phase": "multiturn-rep", "rep": rep,
+                          "clf_warm_p50": row["classifier"]["warm_ttft_p50_ms"],
+                          "base_warm_p50": row["baseline"]["warm_ttft_p50_ms"],
+                          "clf_cold_p50": row["classifier"]["cold_ttft_p50_ms"],
+                          "base_cold_p50": row["baseline"]["cold_ttft_p50_ms"],
+                          "skips": row["classifier"]["hop_skips"]}))
+
+    # Best-of-N (PR 5/8 shared-box precedent): the min p50 per mode is the
+    # least throttle-noise estimate of each mode's steady state.
+    clf_warm = min(r["classifier"]["warm_ttft_p50_ms"] for r in reps)
+    base_warm = min(r["baseline"]["warm_ttft_p50_ms"] for r in reps)
+    clf_cold = min(r["classifier"]["cold_ttft_p50_ms"] for r in reps)
+    base_cold = min(r["baseline"]["cold_ttft_p50_ms"] for r in reps)
+    # Classifier accuracy: confusion counts summed over reps,
+    # precision/recall recomputed from the sums.
+    counts = {"skip_correct": 0, "skip_wrong": 0,
+              "keep_missed_skip": 0, "keep_necessary": 0}
+    for r in reps:
+        for k, v in (r["classifier"]["judge"].get("counts") or {}).items():
+            if k in counts:
+                counts[k] += int(v)
+    tp, fp = counts["skip_correct"], counts["skip_wrong"]
+    precision = tp / (tp + fp) if tp + fp else None
+    recall = (tp / (tp + counts["keep_missed_skip"])
+              if tp + counts["keep_missed_skip"] else None)
+    warm_improvement = (1.0 - clf_warm / base_warm) if base_warm else 0.0
+    cold_ratio = (clf_cold / base_cold) if base_cold else float("nan")
+    killswitch_inert = all(
+        r["baseline"]["hop_skips"] == 0
+        and (r["baseline"]["judge"].get("judged") or 0) == 0 for r in reps)
+    return {
+        "scenario": {
+            "users": N_USERS, "turns": TURNS,
+            "warmup_users": WARM_USERS, "warmup_turns": WARM_TURNS,
+            "reps": REPS, "system_prompt_chars": len(SYSTEM),
+            "sim_prefill_ms_per_token": PREFILL_MS_TOK,
+            "sim_kv_pull_ms_per_block": PULL_MS_BLOCK,
+            "topology": "1 prefill sim + 2 (sidecar + decode sim) pods",
+        },
+        "reps": reps,
+        "acceptance": {
+            "warm_ttft_p50_ms": {"classifier": clf_warm,
+                                 "always_disagg": base_warm},
+            "warm_ttft_p50_improvement": round(warm_improvement, 4),
+            "warm_improvement_over_25pct": warm_improvement >= 0.25,
+            "cold_ttft_p50_ms": {"classifier": clf_cold,
+                                 "always_disagg": base_cold},
+            "cold_ttft_ratio": round(cold_ratio, 4),
+            # "Within noise" = the classifier must not REGRESS cold turns
+            # (a cold-turn improvement via shared-prefix reuse is a win,
+            # not a violation).
+            "cold_within_noise": cold_ratio <= 1.15,
+            "classifier_precision": (round(precision, 4)
+                                     if precision is not None else None),
+            "classifier_recall": (round(recall, 4)
+                                  if recall is not None else None),
+            "precision_over_0_9": (precision or 0.0) >= 0.9,
+            "judge_counts": counts,
+            "hop_skips_total": sum(r["classifier"]["hop_skips"]
+                                   for r in reps),
+            "killswitch_inert": killswitch_inert,
+        },
+    }
+
+
 def overload_ramp_bench(quick: bool = False) -> dict:
     """Goodput-max overload control bench (CPU-only, no chip needed).
 
@@ -2295,6 +2586,15 @@ def main() -> None:
         os.makedirs(os.path.join(here, "benchmarks"), exist_ok=True)
         res = slo_obs_bench(quick="--quick" in sys.argv)
         with open(os.path.join(here, "benchmarks", "SLO_OBS.json"), "w") as f:
+            json.dump(res, f, indent=1)
+        return
+    if "--multi-turn" in sys.argv:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")  # no chip needed
+        here = os.path.dirname(os.path.abspath(__file__))
+        os.makedirs(os.path.join(here, "benchmarks"), exist_ok=True)
+        res = multi_turn_bench(quick="--quick" in sys.argv)
+        with open(os.path.join(here, "benchmarks",
+                               "MULTITURN.json"), "w") as f:
             json.dump(res, f, indent=1)
         return
     if "--kv-obs" in sys.argv:

@@ -23,6 +23,13 @@ class EngineConfig:
     # e2e suite, /root/reference test/e2e — SURVEY §4):
     sim_prefill_ms_per_token: float = 0.02
     sim_decode_ms_per_token: float = 2.0
+    # Simulated P/D KV-import cost per block pulled from the prefill pod's
+    # staged export (the decode leg of the 2-phase tpu-dcn protocol). Real
+    # engines measure this pull (x-kv-pull-ms, PR 6); the sim sleeps it so
+    # CPU-only P/D benches price the hop — notably the multi-turn scenario
+    # (bench.py --multi-turn), where a warm turn routed through the hop
+    # pays this pull for blocks the decode pod already holds.
+    sim_kv_pull_ms_per_block: float = 0.2
     # P/D role advertised to the router via labels/metadata.
     role: str = "both"            # "prefill" | "decode" | "both" | "encode"
     engine_id: str = ""

@@ -49,6 +49,13 @@ class SimEngine:
         # warm repeat prompts confirm real hit depths CPU-only.
         self._prefix_lru: OrderedDict[int, None] = OrderedDict()
         self.kv_hits = PrefixHitLog(self.telemetry, block)
+        # Simulated KV-import measurements (the real engine's
+        # kv_import_stats contract, engine/core.py): the server pops these
+        # for the x-kv-pull-ms/-bytes response headers the sidecar relays
+        # into the router's per-pair TransferTable. Bounded: entries are
+        # popped at response time; streamed legs (whose headers leave
+        # early) are swept by the cap.
+        self.kv_import_stats: OrderedDict[str, dict[str, Any]] = OrderedDict()
 
     async def start(self):
         pass
@@ -103,6 +110,21 @@ class SimEngine:
         if task is not None:
             task.cancel()
 
+    def _commit_prefix_blocks(self, req: EngineRequest) -> None:
+        """Commit the prompt's block-hash chain into the served-block LRU
+        without recording a hit — the P/D KV-import path: the decode pod
+        really holds the blocks afterwards (a warm follow-up turn finds
+        them), but an import is not a prefix-cache hit (engine/core.py
+        contract — the import legs carry no x-kv-hit-* headers)."""
+        block = self.mcfg.kv_block_size
+        hashes = chain_block_hashes(self.model_name, req.prompt_token_ids,
+                                    "", block)
+        for h in hashes:
+            self._prefix_lru[h] = None
+            self._prefix_lru.move_to_end(h)
+        while len(self._prefix_lru) > max(self.n_blocks, 1):
+            self._prefix_lru.popitem(last=False)
+
     def _note_prefix_hit(self, req: EngineRequest) -> int:
         """Match the prompt's block-hash chain against the served-block LRU
         (consecutive from the start, >=1 suffix token kept — the same
@@ -156,15 +178,45 @@ class SimEngine:
             n_blocks = -(-max(prompt_len + req.max_tokens, 1) // block)
             self._blocks_used += n_blocks
             self._update_gauges()
-            self._note_prefix_hit(req)
+            ktp = req.kv_transfer_params or {}
+            # P/D decode leg with a staged remote export: the KV arrives
+            # over the (simulated) pull instead of being recomputed — sleep
+            # the per-block transfer cost, commit the blocks (the pod
+            # really holds them afterwards) and record no hit. Everything
+            # else prefills locally, paying compute only for the tokens the
+            # served-block LRU does NOT already hold — cache-hit prefills
+            # are cheap, cold prefills expensive (the PPD premise the
+            # multi-turn bench measures).
+            imported = (bool(ktp.get("remote_block_ids"))
+                        and not ktp.get("do_remote_decode"))
+            if imported:
+                self._commit_prefix_blocks(req)
+                n_pull = len(ktp["remote_block_ids"])
+                pull_s = self.cfg.sim_kv_pull_ms_per_block * n_pull / 1000
+                self.kv_import_stats[req.request_id] = {
+                    "ms": pull_s * 1e3,
+                    "bytes": n_pull * block * 1024,  # nominal 1KiB/token
+                    "route": "sim"}
+                while len(self.kv_import_stats) > 512:
+                    self.kv_import_stats.popitem(last=False)
+            else:
+                hit_tokens = self._note_prefix_hit(req)
+                pull_s = 0.0
             try:
-                await asyncio.sleep(self.cfg.sim_prefill_ms_per_token * prompt_len / 1000)
-                self.telemetry.prefill_step.observe(
-                    self.cfg.sim_prefill_ms_per_token * prompt_len / 1000)
+                if imported:
+                    await asyncio.sleep(pull_s)
+                else:
+                    cold_tokens = max(prompt_len - hit_tokens, 0)
+                    prefill_s = (self.cfg.sim_prefill_ms_per_token
+                                 * cold_tokens / 1000)
+                    await asyncio.sleep(prefill_s)
+                    # Import legs record no prefill-step sample (the real
+                    # engine observes only actual prefill dispatches — a
+                    # zero-valued sample would drag the histogram's
+                    # quantiles to ~0 on P/D decode pods).
+                    self.telemetry.prefill_step.observe(prefill_s)
                 self.telemetry.prompt_tokens.inc(prompt_len)
                 self.telemetry.ttft.observe(time.monotonic() - req.arrival_time)
-
-                ktp = req.kv_transfer_params or {}
                 first = self._gen_tokens[0]
                 if ktp.get("do_remote_decode"):
                     self.kv_exports[req.request_id] = {

@@ -49,6 +49,7 @@ class RawConfig:
     slo: dict[str, Any]
     overload: dict[str, Any]
     kv_cache: dict[str, Any]
+    disagg: dict[str, Any]
     tls_client: dict[str, Any]
     pool: dict[str, Any]
     objectives: list[dict[str, Any]]
@@ -104,6 +105,13 @@ class RouterConfig:
     # enabled: false is the kill-switch that removes the predicted-vs-
     # confirmed hit ledger's hooks entirely).
     kv_cache: dict[str, Any]
+    # disagg: P/D-disaggregation placement knobs. `classifier:` configures
+    # the session-aware prefill classifier (router/plugins/disagg.py
+    # PdClassifierConfig — {enabled, coldTokenThreshold, minConfidence});
+    # enabled: false (the default) keeps the disagg handler bit-identical
+    # to the always-run-the-decider router. Applied post-instantiation to
+    # every plugin exposing set_classifier (the pickSeed precedent).
+    disagg: dict[str, Any]
     tls_client: dict[str, Any]
     static_endpoints: list[EndpointMetadata]
     pool: EndpointPool
@@ -137,6 +145,7 @@ def load_raw_config(text: str | None) -> RawConfig:
         slo=doc.get("slo") or {},
         overload=doc.get("overload") or {},
         kv_cache=doc.get("kvCache") or {},
+        disagg=doc.get("disagg") or {},
         tls_client=doc.get("tlsClient") or {},
         pool=doc.get("pool") or {},
         objectives=doc.get("objectives") or [],
@@ -223,6 +232,19 @@ def instantiate(raw: RawConfig, handle: Handle,
             if (hasattr(prof.picker, "_rng_for")
                     and prof.picker.pick_seed is None):
                 prof.picker.pick_seed = int(pick_seed)
+
+    # disagg.classifier: the session-aware prefill classifier config is a
+    # top-level section (it gates a placement *stage*, not one plugin
+    # instance's parameters) applied to every handler exposing the
+    # set_classifier hook — the scheduling.pickSeed application precedent.
+    cls_spec = (raw.disagg or {}).get("classifier")
+    if cls_spec is not None:
+        from ..plugins.disagg import PdClassifierConfig
+
+        classifier_cfg = PdClassifierConfig.from_spec(cls_spec)
+        for plugin in plugins_by_name.values():
+            if hasattr(plugin, "set_classifier"):
+                plugin.set_classifier(classifier_cfg)
 
     # Profile handler: explicit plugin wins; else single-profile-handler.
     for plugin in plugins_by_name.values():
@@ -317,6 +339,7 @@ def instantiate(raw: RawConfig, handle: Handle,
         slo=raw.slo,
         overload=raw.overload,
         kv_cache=raw.kv_cache,
+        disagg=raw.disagg,
         tls_client=raw.tls_client,
         static_endpoints=static_endpoints,
         pool=pool,

@@ -54,7 +54,7 @@ class DecisionRecord:
     __slots__ = ("request_id", "model", "target_model", "priority",
                  "_start", "_admission", "_producers",
                  "_rounds", "_attempts", "_final", "_outcome", "_shed",
-                 "_cache", "top_k")
+                 "_cache", "_classifier", "top_k")
 
     # Container fields are lazily created (None until first write): a record
     # is opened on EVERY request, and five eager container allocations per
@@ -97,6 +97,7 @@ class DecisionRecord:
         self._outcome = None
         self._shed = None
         self._cache = None
+        self._classifier = None
 
     @property
     def start_unix(self) -> float:
@@ -135,6 +136,11 @@ class DecisionRecord:
     @property
     def cache(self) -> dict[str, Any]:
         return self._cache if self._cache is not None else self._EMPTY_DICT
+
+    @property
+    def classifier(self) -> dict[str, Any]:
+        return (self._classifier if self._classifier is not None
+                else self._EMPTY_DICT)
 
     # ---- layer hooks ----------------------------------------------------
 
@@ -297,6 +303,16 @@ class DecisionRecord:
         if self._cache is None:
             self._cache = block
 
+    def record_classifier(self, block: dict[str, Any]) -> None:
+        """Prefill-classifier verdict block (router/plugins/disagg.py):
+        predicted hit depth, trust discount, threshold, and the skip/keep
+        verdict. The handler mutates the SAME dict on a failover
+        re-classification and the CacheLedger's post-hoc judge adds the
+        ``judged`` sub-block in place, so one stamp suffices. First stamp
+        wins (same contract as record_cache)."""
+        if self._classifier is None:
+            self._classifier = block
+
     def record_outcome(self, outcome: dict[str, Any]) -> None:
         """SLO-ledger serving outcome (router/slo.py): predicted vs actual
         TTFT/TPOT vs SLO targets, slo_met verdict, miss reason, and (on the
@@ -336,6 +352,8 @@ class DecisionRecord:
             doc["shed"] = self._shed
         if self._cache is not None:
             doc["cache"] = self._cache
+        if self._classifier is not None:
+            doc["classifier"] = self._classifier
         if compact:
             doc["summary"] = self.summary_line()
             return doc
@@ -403,6 +421,8 @@ class DecisionRecord:
                 parts.append(f"queue_ms={self.admission['queue_ms']:.3f}")
         if self._shed is not None:
             parts.append(f"overload={self._shed.get('action')}")
+        if self._classifier is not None:
+            parts.append(f"pd={self._classifier.get('verdict')}")
         cache = self._cache
         if cache is not None:
             # Cache verdict beside the pick: predicted vs engine-confirmed
@@ -457,12 +477,24 @@ class DecisionRecord:
         return events
 
 
+def _profile_picked(doc: dict[str, Any], name: str) -> bool:
+    """Did any scheduling round's ``name`` profile produce a pick? Works on
+    both rendered and raw round dicts (the gateway grafts the raw rounds
+    onto compact list-view probes, the endpoint-filter precedent)."""
+    for rnd in doc.get("rounds") or []:
+        sec = (rnd.get("profiles") or {}).get(name)
+        if sec is not None and sec.get("outcome") == "picked":
+            return True
+    return False
+
+
 def record_matches(doc: dict[str, Any], *, verdict: str | None = None,
                    endpoint: str | None = None,
-                   outcome: str | None = None) -> bool:
+                   outcome: str | None = None,
+                   profile: str | None = None) -> bool:
     """Operator-side list-view filters over a rendered record dict (the
-    gateway's ``/debug/decisions?verdict=&endpoint=&outcome=`` — and the
-    fleet fan-in forwards the same params to every worker):
+    gateway's ``/debug/decisions?verdict=&endpoint=&outcome=&profile=`` —
+    and the fleet fan-in forwards the same params to every worker):
 
     - ``verdict``: the SLO ledger's serving verdict (met | missed | error |
       shed), read from the outcome block;
@@ -470,7 +502,12 @@ def record_matches(doc: dict[str, Any], *, verdict: str | None = None,
       any endpoint in the attempt trail — find every record that TOUCHED a
       pod, not just the ones it ultimately served;
     - ``outcome``: convenience aliases — ``miss`` (SLO missed or error: any
-      served-but-failed row) and ``shed`` (refused at admission).
+      served-but-failed row) and ``shed`` (refused at admission);
+    - ``profile``: the disaggregation shape the request took — ``prefill``
+      (a prefill profile produced a pick: the P/D hop ran), ``decode``
+      (decode-only: the decider kept it local or the classifier skipped),
+      ``skip-hop`` (decode-only specifically because the prefill
+      classifier's verdict was ``skip``).
 
     All given filters must match (AND)."""
     out = doc.get("outcome") or {}
@@ -504,6 +541,20 @@ def record_matches(doc: dict[str, Any], *, verdict: str | None = None,
                 a.get("endpoint") == endpoint
                 for a in doc.get("attempts") or []):
             return False
+    if profile is not None:
+        cls_verdict = (doc.get("classifier") or {}).get("verdict")
+        if profile == "prefill":
+            if not _profile_picked(doc, "prefill"):
+                return False
+        elif profile == "decode":
+            if (not _profile_picked(doc, "decode")
+                    or _profile_picked(doc, "prefill")):
+                return False
+        elif profile in ("skip-hop", "skip"):
+            if cls_verdict != "skip" or _profile_picked(doc, "prefill"):
+                return False
+        else:
+            return False  # unknown value matches nothing, loudly-by-empty
     return True
 
 

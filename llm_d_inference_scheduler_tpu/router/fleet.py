@@ -525,7 +525,15 @@ def merge_kv(docs: list[tuple[int, dict[str, Any]]]) -> dict[str, Any]:
     leader = next((d for shard, d in docs if shard == 0), None)
     n_tot = sum_abs = sum_signed = 0.0
     rn_tot = rsum_abs = rsum_signed = 0.0
+    # Prefill-classifier accuracy: confusion counts sum across shards;
+    # precision/recall are recomputed from the sums, never averaged.
+    cls_counts = {"skip_correct": 0, "skip_wrong": 0,
+                  "keep_missed_skip": 0, "keep_necessary": 0}
     for shard, doc in docs:
+        for k, v in ((doc.get("classifier") or {}).get("counts")
+                     or {}).items():
+            if k in cls_counts:
+                cls_counts[k] += int(v)
         pred = doc.get("prediction") or {}
         n = pred.get("n", 0)
         if n:
@@ -556,6 +564,15 @@ def merge_kv(docs: list[tuple[int, dict[str, Any]]]) -> dict[str, Any]:
                                    "mae_ratio": round(rsum_abs / rn_tot, 4),
                                    "mean_signed_ratio": round(
                                        rsum_signed / rn_tot, 4)}
+    tp, fp = cls_counts["skip_correct"], cls_counts["skip_wrong"]
+    fn = cls_counts["keep_missed_skip"]
+    cls_doc: dict[str, Any] = {"judged": sum(cls_counts.values()),
+                               "counts": cls_counts}
+    if tp + fp:
+        cls_doc["precision"] = round(tp / (tp + fp), 4)
+    if tp + fn:
+        cls_doc["recall"] = round(tp / (tp + fn), 4)
+    out["classifier"] = cls_doc
     return out
 
 
@@ -741,12 +758,13 @@ class FleetAdmin:
             n = max(1, int(request.query.get("n", "50")))
         except ValueError:
             n = 50
-        # Operator filters (?verdict=/?endpoint=/?outcome=) forward to every
-        # worker so each shard filters ring-side; the merge trims the union.
+        # Operator filters (?verdict=/?endpoint=/?outcome=/?profile=)
+        # forward to every worker so each shard filters ring-side; the
+        # merge trims the union.
         from urllib.parse import urlencode
 
         params = {"n": str(n)}
-        for key in ("verdict", "endpoint", "outcome"):
+        for key in ("verdict", "endpoint", "outcome", "profile"):
             v = request.query.get(key)
             if v:
                 params[key] = v

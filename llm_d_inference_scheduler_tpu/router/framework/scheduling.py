@@ -128,6 +128,13 @@ class InferenceRequest:
     # every later consumer of the cycle — including failover reschedules of
     # the same request object.
     prefix_hashes: Any = None
+    # Prefill-classifier verdict block (router/plugins/disagg.py): stamped
+    # by the DisaggProfileHandler's classifier stage when `disagg:
+    # {classifier: {enabled: true}}` — the same dict the DecisionRecord
+    # references, so the CacheLedger's post-hoc judgement (predicted vs
+    # engine-confirmed cold tokens) lands in /debug/decisions/<id> in
+    # place. None = classifier kill-switch (the default) or no decode pick.
+    classifier: Any = None
 
 
 class CycleState:

@@ -490,7 +490,10 @@ class Gateway:
         Operator filters (decisions.record_matches): ?verdict=met|missed|
         error|shed (the SLO ledger's serving verdict), ?endpoint=<ip:port>
         (the destination that served), ?outcome=miss|shed (convenience
-        aliases) — so records are findable without client-side scans."""
+        aliases), ?profile=prefill|decode|skip-hop (the disaggregation
+        shape the request took — skip-hop isolates the prefill
+        classifier's skipped P/D hops) — so records are findable without
+        client-side scans."""
         from .decisions import record_matches
 
         try:
@@ -501,8 +504,9 @@ class Gateway:
         verdict = request.query.get("verdict") or None
         endpoint = request.query.get("endpoint") or None
         outcome = request.query.get("outcome") or None
+        profile = request.query.get("profile") or None
         filtered = verdict is not None or endpoint is not None \
-            or outcome is not None
+            or outcome is not None or profile is not None
         # Filtering scans the WHOLE ring (the n newest matches, not the
         # matches within the n newest); the unfiltered path keeps the
         # cheap bounded snapshot.
@@ -511,14 +515,22 @@ class Gateway:
         for r in recs:
             doc = r.to_dict(compact=True)
             if filtered:
-                # The endpoint filter also matches the attempt trail, which
-                # the compact form omits — graft the raw attempt list onto
+                # The endpoint filter also matches the attempt trail and
+                # the profile filter the per-round profile sections — both
+                # omitted from the compact form. Graft the raw lists onto
                 # the probe (zero-copy; record_matches only reads
-                # a["endpoint"]) so failed-over pods are findable too.
-                probe = (doc if endpoint is None
-                         else {**doc, "attempts": r.attempts})
+                # a["endpoint"] / each round's profile outcome) so
+                # failed-over pods and P/D shapes are findable too.
+                probe = doc
+                if endpoint is not None or profile is not None:
+                    probe = dict(doc)
+                    if endpoint is not None:
+                        probe["attempts"] = r.attempts
+                    if profile is not None:
+                        probe["rounds"] = r.rounds
                 if not record_matches(probe, verdict=verdict,
-                                      endpoint=endpoint, outcome=outcome):
+                                      endpoint=endpoint, outcome=outcome,
+                                      profile=profile):
                     continue
             docs.append(doc)
             if len(docs) >= n:

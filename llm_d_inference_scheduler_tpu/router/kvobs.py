@@ -152,6 +152,108 @@ class _PodCacheStats:
         return doc
 
 
+class _JudgeCounts:
+    """Confusion-matrix counts for one pod's (or the whole pool's)
+    classifier verdicts, judged against the engine-confirmed actual."""
+
+    __slots__ = ("skip_correct", "skip_wrong", "keep_missed_skip",
+                 "keep_necessary")
+
+    def __init__(self):
+        self.skip_correct = 0      # tp: skipped, and the turn WAS warm
+        self.skip_wrong = 0        # fp: skipped a turn that was cold
+        self.keep_missed_skip = 0  # fn: kept the hop on a warm turn
+        self.keep_necessary = 0    # tn: kept the hop on a cold turn
+
+    def add(self, *, skipped: bool, should_skip: bool) -> None:
+        if skipped:
+            if should_skip:
+                self.skip_correct += 1
+            else:
+                self.skip_wrong += 1
+        elif should_skip:
+            self.keep_missed_skip += 1
+        else:
+            self.keep_necessary += 1
+
+    def render(self) -> dict[str, Any]:
+        tp, fp = self.skip_correct, self.skip_wrong
+        fn, tn = self.keep_missed_skip, self.keep_necessary
+        doc: dict[str, Any] = {
+            "judged": tp + fp + fn + tn,
+            "counts": {"skip_correct": tp, "skip_wrong": fp,
+                       "keep_missed_skip": fn, "keep_necessary": tn},
+        }
+        if tp + fp:
+            doc["precision"] = round(tp / (tp + fp), 4)
+        if tp + fn:
+            doc["recall"] = round(tp / (tp + fn), 4)
+        return doc
+
+
+class _ClassifierJudge:
+    """Post-hoc accuracy of the prefill classifier (router/plugins/
+    disagg.py): every skip/keep verdict is joined against the
+    engine-confirmed actual hit depth the CacheLedger lands, yielding
+    per-pod (and overall) precision/recall at /debug/kv.
+
+    Precision is exact for skips — a skipped request is served by the very
+    decode pod whose cache was predicted. Recall is a proxy for keeps: the
+    hop ran, so the actual was measured on the PREFILL pod, which
+    under-counts warm turns the decode pod could have served (documented
+    in docs/disaggregation.md)."""
+
+    MAX_PODS = 256
+
+    def __init__(self):
+        self.overall = _JudgeCounts()
+        self._pods: OrderedDict[str, _JudgeCounts] = OrderedDict()
+
+    def judge(self, cls: dict[str, Any], *, hit_tokens: int,
+              prompt_tokens: int) -> None:
+        """Judge one verdict block IN PLACE (the ``judged`` sub-block lands
+        in /debug/decisions/<id> through the shared dict). The actual cold
+        estimate is computed in the classifier's own units — the engine's
+        token count and the router's estimate can differ by the chars/4
+        heuristic, so the actual hit RATIO is applied to the router-side
+        ``input_tokens`` rather than comparing raw engine tokens against a
+        router-unit threshold."""
+        thr = cls.get("threshold")
+        input_est = cls.get("input_tokens") or 0
+        if thr is None or input_est <= 0 or "judged" in cls:
+            return
+        if prompt_tokens > 0:
+            actual_ratio = min(hit_tokens / prompt_tokens, 1.0)
+            cold_actual = input_est * (1.0 - actual_ratio)
+        else:
+            actual_ratio = None
+            cold_actual = max(input_est - hit_tokens, 0)
+        should_skip = cold_actual < thr
+        skipped = cls.get("verdict") == "skip"
+        judged: dict[str, Any] = {
+            "actual_hit_tokens": hit_tokens,
+            "actual_cold_tokens": round(cold_actual, 1),
+            "should_skip": should_skip,
+            "correct": skipped == should_skip,
+        }
+        if actual_ratio is not None:
+            judged["actual_ratio"] = round(actual_ratio, 4)
+        cls["judged"] = judged
+        self.overall.add(skipped=skipped, should_skip=should_skip)
+        pod = cls.get("pod") or "(unknown)"
+        counts = self._pods.get(pod)
+        if counts is None:
+            while len(self._pods) >= self.MAX_PODS:
+                self._pods.popitem(last=False)
+            counts = self._pods[pod] = _JudgeCounts()
+        else:
+            self._pods.move_to_end(pod)
+        counts.add(skipped=skipped, should_skip=should_skip)
+
+    def rows(self) -> dict[str, dict[str, Any]]:
+        return {pod: c.render() for pod, c in self._pods.items()}
+
+
 class KvHitTable:
     """Bounded LRU of per-pod hit-rate / prediction-error EWMAs. Lives on
     the Datastore (like the breaker registry and the TransferTable) so
@@ -164,6 +266,13 @@ class KvHitTable:
     def __init__(self, max_pods: int = 256):
         self.max_pods = max_pods
         self._pods: OrderedDict[str, _PodCacheStats] = OrderedDict()
+        # Pool-wide aggregate: every join also lands here. The prefill
+        # classifier falls back to it for pods with no row of their own —
+        # a decode pod that always rides the P/D hop never lands its own
+        # joins (the actual is confirmed on the prefill pod), so without
+        # the pool row the classifier could never bootstrap out of
+        # always-disagg.
+        self._overall = _PodCacheStats()
 
     def record(self, pod: str, *, hit_ratio: float | None,
                signed_error: float | None) -> None:
@@ -174,22 +283,28 @@ class KvHitTable:
             stats = self._pods[pod] = _PodCacheStats()
         else:
             self._pods.move_to_end(pod)
-        stats.n += 1
-        stats.last_unix = time.time()
-        a = self.ALPHA
-        if hit_ratio is not None:
-            stats.ewma_hit_ratio = (
-                hit_ratio if stats.ewma_hit_ratio is None
-                else (1 - a) * stats.ewma_hit_ratio + a * hit_ratio)
-        if signed_error is not None:
-            stats.ewma_signed_error = (
-                signed_error if stats.ewma_signed_error is None
-                else (1 - a) * stats.ewma_signed_error + a * signed_error)
+        for s in (stats, self._overall):
+            s.n += 1
+            s.last_unix = time.time()
+            a = self.ALPHA
+            if hit_ratio is not None:
+                s.ewma_hit_ratio = (
+                    hit_ratio if s.ewma_hit_ratio is None
+                    else (1 - a) * s.ewma_hit_ratio + a * hit_ratio)
+            if signed_error is not None:
+                s.ewma_signed_error = (
+                    signed_error if s.ewma_signed_error is None
+                    else (1 - a) * s.ewma_signed_error + a * signed_error)
 
     def pod(self, pod: str) -> _PodCacheStats | None:
         """Plugin-facing lookup (no LRU touch: reading a pod's stats must
         not pin it against eviction)."""
         return self._pods.get(pod)
+
+    def overall(self) -> _PodCacheStats:
+        """Pool-wide aggregate row (never evicted; n == 0 until the first
+        join lands anywhere)."""
+        return self._overall
 
     def rows(self) -> dict[str, dict[str, Any]]:
         return {pod: stats.render() for pod, stats in self._pods.items()}
@@ -212,6 +327,9 @@ class CacheLedger:
         self._joins = 0           # engine-confirmed actuals joined
         self._err = _ErrAgg("blocks")
         self._err_ratio = _ErrAgg("ratio")
+        # Prefill-classifier accuracy (router/plugins/disagg.py): verdicts
+        # judged against the engine-confirmed actual as each join lands.
+        self.judge = _ClassifierJudge()
         # Index-occupancy sources discovered from the configured plugin set
         # (attach_plugins): approx producers expose per-pod LRU sizes,
         # precise scorers expose confirmed/speculative stamp counts.
@@ -345,6 +463,13 @@ class CacheLedger:
         self.table.record(pod or "(unknown)", hit_ratio=ratio,
                           signed_error=signed_ratio)
         obs.block["actual"] = actual
+        # Judge the prefill classifier's verdict against this
+        # engine-confirmed actual (the `judged` sub-block lands in the
+        # DecisionRecord's classifier block through the shared dict).
+        cls = getattr(request, "classifier", None)
+        if cls is not None:
+            self.judge.judge(cls, hit_tokens=ht,
+                             prompt_tokens=prompt_tokens)
 
     # ---- render ---------------------------------------------------------
 
@@ -359,6 +484,9 @@ class CacheLedger:
 
         def _row(addr: str) -> dict[str, Any]:
             return pods.setdefault(addr, {})
+
+        for addr, judged in self.judge.rows().items():
+            _row(addr)["classifier"] = judged
 
         for producer in self._approx:
             for addr, blocks in producer.index_sizes().items():
@@ -390,6 +518,10 @@ class CacheLedger:
             "confirmed_joins": self._joins,
             "prediction": self._err.render(),
             "prediction_ratio": self._err_ratio.render(),
+            # Prefill-classifier accuracy: skip/keep verdicts judged
+            # against the engine-confirmed actual hit depth (per-pod rows
+            # carry their own `classifier` sub-doc).
+            "classifier": self.judge.overall.render(),
             "index": {"confirmed_blocks": confirmed_total,
                       "speculative_blocks": speculative_total},
             "pods": pods,

@@ -231,6 +231,23 @@ KV_ACTUAL_HIT_RATIO = Histogram(
     "Engine-confirmed prefix-hit ratio (hit tokens / prompt tokens) per "
     "completed request",
     registry=REGISTRY, buckets=(0.0, .1, .25, .5, .75, .9, 1.0))
+# Session-aware prefill classifier (router/plugins/disagg.py): the
+# ledger-driven placement stage that routes high-confidence cache-hit
+# prefills straight to the decode pod (skip the P/D hop). Verdicts are a
+# fixed small enum; the per-request detail (predicted depth, trust
+# discount, threshold, post-hoc judgement) is the DecisionRecord
+# classifier block, and per-pod precision/recall is on /debug/kv.
+PD_CLASSIFIER_DECISIONS_TOTAL = Counter(
+    "router_pd_classifier_decisions_total",
+    "Prefill-classifier verdicts per evaluation (verdict: skip = route "
+    "straight to the decode pod, keep = run the P/D decider as usual, "
+    "low_confidence = not enough measured trust to act on the prediction)",
+    ("verdict",), registry=REGISTRY)
+PD_HOP_SKIPPED_TOTAL = Counter(
+    "router_pd_hop_skipped_total",
+    "Requests routed straight to the decode pod by the prefill classifier "
+    "(no prefill leg, no KV pull — the P/D hop skipped)",
+    registry=REGISTRY)
 # Multi-process sharded gateway (router/fleet.py): each worker exposes the
 # pool-snapshot epoch it last built (leader) or applied from the IPC stream
 # (follower) — the supervisor re-labels it per shard, making snapshot-IPC

@@ -15,6 +15,7 @@ disagg_profile_handler.go:246-444) and its decider sub-plugins
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 from typing import Any
 
@@ -25,12 +26,55 @@ from ..framework.scheduling import (
     ProfileRunResult,
     SchedulingResult,
 )
-from ..metrics import DISAGG_DECISION_TOTAL
+from ..metrics import (
+    DISAGG_DECISION_TOTAL,
+    PD_CLASSIFIER_DECISIONS_TOTAL,
+    PD_HOP_SKIPPED_TOTAL,
+)
 from ..requestcontrol.director import H_DATA_PARALLEL, H_ENCODERS, H_PREFILLER
 from .attributes import PREFIX_ATTRIBUTE_KEY, PrefixCacheMatchInfo, estimate_input_tokens
 from .profile_handlers import SchedulingError
 
 log = logging.getLogger("router.disagg")
+
+
+@dataclasses.dataclass
+class PdClassifierConfig:
+    """The YAML ``disagg: {classifier: ...}`` section (config/loader.py
+    applies it to every handler exposing ``set_classifier``, the
+    ``scheduling.pickSeed`` precedent).
+
+    The classifier is the session-aware prefill stage PPD
+    (arXiv:2603.13358) motivates: multi-turn traffic splits into cache-hit
+    prefills (cheap, decode-adjacent) and cold prefills (expensive,
+    prefill-pool work). When the *confidence-adjusted* cold-token estimate
+    for the chosen decode pod falls under ``cold_token_threshold``, the
+    P/D hop is skipped entirely — no prefill leg, no KV pull for blocks
+    the decode pod already holds. ``enabled: false`` (the default) is the
+    kill-switch: the handler behaves bit-identically to the pre-classifier
+    always-run-the-decider path."""
+
+    enabled: bool = False
+    # Confidence-adjusted cold tokens below this → skip the hop. Same
+    # units as PrefixBasedPdDecider.thresholdTokens: the router-side
+    # estimate (exact when a token producer tokenized the prompt, chars/4
+    # otherwise).
+    cold_token_threshold: int = 256
+    # Minimum trust in the hit prediction before the classifier may act.
+    # Confidence saturates with joined predicted→confirmed observations
+    # (CacheLedger → Datastore.kv_obs): n / (n + PRIOR_N), so the default
+    # 0.5 requires PRIOR_N measured joins before the first skip.
+    min_confidence: float = 0.5
+
+    @classmethod
+    def from_spec(cls, spec: dict[str, Any] | None) -> "PdClassifierConfig":
+        spec = spec or {}
+        return cls(
+            enabled=bool(spec.get("enabled", False)),
+            cold_token_threshold=max(
+                0, int(spec.get("coldTokenThreshold", 256))),
+            min_confidence=min(max(
+                float(spec.get("minConfidence", 0.5)), 0.0), 1.0))
 
 
 @register_plugin("prefix-based-pd-decider")
@@ -184,14 +228,36 @@ class DisaggProfileHandler(PluginBase):
     # declare their own THREAD_SAFE audits. A decider declaring False makes
     # this handler unsafe too — the scheduler pool enforces that at bind
     # time (schedpool._handler_threadsafe trampolines the whole handler).
+    # The classifier stage keeps the audit: KvHitTable.pod()/overall() are
+    # single GIL-atomic dict reads, the verdict stamp is one attribute
+    # store on the request, the DecisionRecord write is one slot set, and
+    # prometheus counters are thread-safe.
     THREAD_SAFE = True
+
+    # Confidence prior for the trust gate: confidence = n / (n + PRIOR_N)
+    # over the pod's (or, before the pod has its own record, the pool-wide)
+    # joined predicted→confirmed observation count. With the default
+    # minConfidence 0.5 the classifier will not skip until PRIOR_N joins
+    # have been measured.
+    CONFIDENCE_PRIOR_N = 4
 
     def __init__(self, name: str | None = None):
         super().__init__(name)
         self.pd_decider: Any = None
         self.encode_decider: Any = None
+        # Session-aware prefill classifier (PdClassifierConfig): None or
+        # enabled: false keeps the handler bit-identical to the
+        # pre-classifier router. The loader injects the `disagg:
+        # {classifier: ...}` config post-instantiation (set_classifier).
+        self.classifier_cfg: PdClassifierConfig | None = None
+        self._datastore: Any = None
 
     def configure(self, params: dict[str, Any], handle: Any) -> None:
+        # The KvHitTable trust signal lives on the datastore
+        # (Datastore.kv_obs, PR 10 — built explicitly as this classifier's
+        # input); tests constructing the handler directly may leave it None
+        # (the classifier then runs with zero measured trust).
+        self._datastore = getattr(handle, "datastore", None)
         spec = params.get("pdDecider") or {"type": "prefix-based-pd-decider"}
         if isinstance(spec, str):
             spec = {"type": spec}
@@ -205,6 +271,122 @@ class DisaggProfileHandler(PluginBase):
             self.encode_decider = global_registry.instantiate(
                 enc["type"], enc.get("name") or enc["type"],
                 enc.get("parameters") or {}, handle)
+
+    def set_classifier(self, cfg: PdClassifierConfig,
+                       datastore: Any = None) -> None:
+        """Loader hook (config/loader.py): apply the top-level ``disagg:
+        {classifier: ...}`` section. ``datastore`` override is for tests."""
+        self.classifier_cfg = cfg
+        if datastore is not None:
+            self._datastore = datastore
+
+    # ---- prefill classifier (PPD, arXiv:2603.13358) ---------------------
+
+    def _classify(self, request: InferenceRequest, decode_ep: Endpoint,
+                  decode_res: ProfileRunResult | None) -> dict[str, Any] | None:
+        """Classify the chosen decode pod's prefill: estimate its expected
+        prefix-hit depth from the same per-candidate signals the CacheLedger
+        stamps (PrefixCacheMatchInfo attribute, precise-prefix raw scores),
+        discount it by the pod's measured KvHitTable signed-error EWMA, and
+        verdict ``skip`` when the confidence-adjusted cold-token estimate
+        falls under the threshold. Returns the explainable verdict block
+        (recorded on the DecisionRecord, judged post-hoc by the
+        CacheLedger), or None when the stage is disabled."""
+        cfg = self.classifier_cfg
+        if cfg is None or not cfg.enabled:
+            return None
+        addr = decode_ep.metadata.address_port
+        input_tokens = estimate_input_tokens(request)
+
+        # Predicted hit ratio: the approx producer's per-request attribute
+        # and/or the precise scorer's event-fed raw score — take the more
+        # optimistic signal (both under-predict in distinct blind spots:
+        # approx is LRU-bounded, precise sees only event-fed pods).
+        info: PrefixCacheMatchInfo | None = decode_ep.attributes.get(
+            PREFIX_ATTRIBUTE_KEY)
+        predicted_ratio = info.hit_ratio if info is not None else 0.0
+        source = "approx" if info is not None else "none"
+        if decode_res is not None:
+            for name, scores in decode_res.raw_scores.items():
+                if "precise-prefix" in name:
+                    pr = scores.get(addr)
+                    if pr is not None and pr > predicted_ratio:
+                        predicted_ratio = min(max(pr, 0.0), 1.0)
+                        source = "precise"
+
+        # Trust, two-scope: the signed-error DISCOUNT is pod-scoped when
+        # the pod has its own predicted-vs-confirmed record (pool-wide
+        # otherwise — a decode pod that always rides the P/D hop never
+        # lands its own joins, the actual is confirmed on the prefill pod,
+        # so without the fallback the classifier could never bootstrap out
+        # of always-disagg). CONFIDENCE is pool-scoped deliberately: it
+        # gates on how much the predict→confirm loop has measured AT ALL,
+        # and a pod's first own join must not reset an established pool
+        # record back below the gate (n flipping 6 → 1 would re-close a
+        # classifier that just started skipping).
+        table = getattr(self._datastore, "kv_obs", None)
+        pod_stats = table.pod(addr) if table is not None else None
+        pool_stats = table.overall() if table is not None else None
+        pod_n = pod_stats.n if pod_stats is not None else 0
+        pool_n = pool_stats.n if pool_stats is not None else 0
+        if pod_n > 0 and pod_stats.ewma_signed_error is not None:
+            signed, scope = pod_stats.ewma_signed_error, "pod"
+        elif pool_n > 0 and pool_stats.ewma_signed_error is not None:
+            signed, scope = pool_stats.ewma_signed_error, "pool"
+        else:
+            signed, scope = 0.0, "none"
+        confidence = pool_n / (pool_n + self.CONFIDENCE_PRIOR_N)
+
+        # Trust discount: signed error is predicted − actual in hit-ratio
+        # units; positive = the scorers promise more reuse than the engine
+        # finds, so subtract it. A pod that under-promises (negative) is
+        # NOT inflated — the discount only ever makes the estimate more
+        # conservative.
+        adjusted_ratio = min(max(predicted_ratio - max(signed, 0.0), 0.0), 1.0)
+        expected_cold = input_tokens * (1.0 - adjusted_ratio)
+
+        if predicted_ratio <= 0.0:
+            verdict = "keep"      # no reuse signal — nothing to act on
+        elif confidence < cfg.min_confidence:
+            verdict = "low_confidence"
+        elif expected_cold < cfg.cold_token_threshold:
+            verdict = "skip"
+        else:
+            verdict = "keep"
+        return {
+            "verdict": verdict,
+            "pod": addr,
+            "input_tokens": input_tokens,
+            "predicted_ratio": round(predicted_ratio, 4),
+            "predicted_source": source,
+            "trust": {"scope": scope, "pod_n": pod_n, "pool_n": pool_n,
+                      "ewma_signed_error": round(signed, 4),
+                      "confidence": round(confidence, 4)},
+            "adjusted_ratio": round(adjusted_ratio, 4),
+            "expected_cold_tokens": round(expected_cold, 1),
+            "threshold": cfg.cold_token_threshold,
+            "min_confidence": cfg.min_confidence,
+        }
+
+    def _stamp_classifier(self, request: InferenceRequest,
+                          block: dict[str, Any]) -> None:
+        """Stamp the verdict where the observability stack reads it: the
+        request (the CacheLedger's post-hoc judge), the DecisionRecord
+        (/debug/decisions/<id>), and the aggregate counters. A failover
+        reschedule re-classifies against the fresh decode pick; the stamped
+        dict is updated IN PLACE so the record and the judge follow the
+        verdict that actually served (unless the response already landed
+        and judged it — then the verdict is history)."""
+        PD_CLASSIFIER_DECISIONS_TOTAL.labels(block["verdict"]).inc()
+        prev = getattr(request, "classifier", None)
+        if prev is None:
+            request.classifier = block
+            rec = getattr(request, "decision", None)
+            if rec is not None and hasattr(rec, "record_classifier"):
+                rec.record_classifier(block)
+        elif "judged" not in prev:
+            prev.clear()
+            prev.update(block)
 
     # ---- ProfileHandler ------------------------------------------------
 
@@ -226,9 +408,20 @@ class DisaggProfileHandler(PluginBase):
                 and self.encode_decider.disaggregate(ctx, request, decode_ep)):
             to_run[self.ENCODE] = profiles[self.ENCODE]
         if (self.PREFILL in profiles and self.PREFILL not in results
-                and self.pd_decider is not None
-                and self.pd_decider.disaggregate(ctx, request, decode_ep)):
-            to_run[self.PREFILL] = profiles[self.PREFILL]
+                and self.pd_decider is not None):
+            # Prefill-classifier stage (PPD): a confident cache-hit prefill
+            # routes straight to the decode pod — the prefill profile never
+            # runs, so pre_request writes no x-prefiller header and the
+            # sidecar decodes locally (no prefill leg, no KV pull). Any
+            # other verdict (keep / low_confidence / classifier disabled)
+            # falls through to the configured PD decider unchanged.
+            block = self._classify(request, decode_ep, decode_res)
+            if block is not None:
+                self._stamp_classifier(request, block)
+            if block is not None and block["verdict"] == "skip":
+                PD_HOP_SKIPPED_TOTAL.inc()
+            elif self.pd_decider.disaggregate(ctx, request, decode_ep):
+                to_run[self.PREFILL] = profiles[self.PREFILL]
         return to_run
 
     def process_results(self, ctx, request, results) -> SchedulingResult:

@@ -138,6 +138,81 @@ def check() -> list[str]:
     if checked == 0:
         errors.append("no filter/scorer/picker plugin types registered — "
                       "registry import broken?")
+    errors.extend(_check_classifier_block(handle, recorder))
+    return errors
+
+
+def _check_classifier_block(handle, recorder) -> list[str]:
+    """The prefill classifier's verdict must be explainable: with the
+    ``disagg.classifier`` stage enabled, a scheduled P/D request's
+    DecisionRecord must carry the ``classifier`` block (verdict + the
+    inputs that produced it). A stage that routes around the recorder
+    would make every skipped hop undebuggable."""
+    from llm_d_inference_scheduler_tpu.router.framework.plugin import (
+        global_registry,
+    )
+    from llm_d_inference_scheduler_tpu.router.plugins.attributes import (
+        PREFIX_ATTRIBUTE_KEY,
+        PrefixCacheMatchInfo,
+    )
+    from llm_d_inference_scheduler_tpu.router.plugins.disagg import (
+        PdClassifierConfig,
+    )
+    from llm_d_inference_scheduler_tpu.router.scheduling.scheduler import (
+        Scheduler,
+        SchedulerProfile,
+    )
+
+    errors: list[str] = []
+    handler = global_registry.instantiate(
+        "disagg-profile-handler", "disagg-profile-handler",
+        {"pdDecider": {"type": "always-disagg-pd-decider"}}, handle)
+    handler.set_classifier(PdClassifierConfig(
+        enabled=True, cold_token_threshold=256, min_confidence=0.0))
+
+    def _picker():
+        return global_registry.instantiate(
+            "max-score-picker", "max-score-picker", {}, handle)
+
+    decode_f = global_registry.instantiate("decode-filter", "decode-filter",
+                                           {}, handle)
+    prefill_f = global_registry.instantiate("prefill-filter",
+                                            "prefill-filter", {}, handle)
+    sched = Scheduler(
+        {"decode": SchedulerProfile("decode", [decode_f], [], _picker()),
+         "prefill": SchedulerProfile("prefill", [prefill_f], [], _picker())},
+        handler)
+    endpoints = _endpoints()  # roles: decode, prefill, encode, both, ""
+    # Warm decode candidates (the decode filter keeps decode + both; the
+    # picker may choose either): the classifier must see a reuse
+    # prediction on whichever pod wins.
+    for ep in endpoints:
+        if ep.metadata.labels.get("llm-d.ai/role") in ("decode", "both"):
+            ep.attributes.put(PREFIX_ATTRIBUTE_KEY,
+                              PrefixCacheMatchInfo(7, 8, 16))
+    rec = recorder.start("vd-classifier", "tiny")
+    req = _request(999, rec)
+    try:
+        sched.schedule(None, req, endpoints)
+    except Exception as e:
+        errors.append(f"classifier-enabled disagg schedule raised {e!r}")
+        return errors
+    doc = rec.to_dict()
+    block = doc.get("classifier")
+    if not block:
+        errors.append("disagg.classifier enabled but the scheduled request's "
+                      "DecisionRecord has no `classifier` block "
+                      "(recorder bypass)")
+    else:
+        missing = [k for k in ("verdict", "predicted_ratio", "trust",
+                               "expected_cold_tokens", "threshold")
+                   if k not in block]
+        if missing:
+            errors.append("classifier block is missing explanatory "
+                          f"field(s) {missing}: {block}")
+        if block.get("verdict") != "skip":
+            errors.append("warm decode candidate with zero-trust-gate config "
+                          f"should classify skip, got {block.get('verdict')!r}")
     return errors
 
 

@@ -71,6 +71,10 @@ REQUIRED_FAMILIES = {
     ("router_kv_hit_prediction_error", "router"),
     ("router_kv_actual_hit_ratio", "router"),
     ("router_kv_index_divergence", "fleet"),
+    # Session-aware prefill classifier (ISSUE 11): verdict counts and the
+    # skipped P/D hops the classifier routed straight to the decode pod.
+    ("router_pd_classifier_decisions", "router"),
+    ("router_pd_hop_skipped", "router"),
     # Multi-process sharded fleet (ISSUE 9): per-worker snapshot epoch and
     # the supervisor's shard-labeled liveness/request/epoch families.
     ("router_snapshot_epoch", "router"),
