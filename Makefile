@@ -7,6 +7,7 @@ PY ?= python
 .PHONY: test test-fast test-unit test-dist test-chaos bench bench-flowcontrol \
 	bench-router-sse bench-decisions bench-sched bench-sched-offload \
 	bench-scaleout bench-slo bench-overload bench-kvobs bench-multiturn \
+	bench-timeline \
 	dryrun render-chart \
 	compile-check \
 	verify-metrics verify-decisions verify-hotpath verify-threadsafe \
@@ -115,6 +116,15 @@ bench-overload:
 # against.
 bench-kvobs:
 	$(PY) bench.py --kv-obs
+
+# Fleet flight recorder bench (CPU-only): sampler tick cost vs the
+# scheduling-cycle floor (kill-switch ~0%), an overload-ramp replay whose
+# 4x band must trip exactly ONE burn-rate incident (dedup/cooldown) with
+# the shed excursion + a shed DecisionRecord in its snapshot, and a
+# 2-worker fleet whose merged /debug/timeline gap-marks a worker restart.
+# Writes benchmarks/TIMELINE.json.
+bench-timeline:
+	$(PY) bench.py --timeline
 
 # Multi-turn conversation scenario (CPU-only): N users x M turns with a
 # shared system prompt and per-user history growth through the full

@@ -2543,6 +2543,305 @@ overload:
     return out
 
 
+def timeline_bench(quick: bool = False) -> dict:
+    """Fleet flight recorder bench (CPU-only, no chip needed).
+
+    Three phases, written to benchmarks/TIMELINE.json:
+
+    - **micro**: one sampler tick (counter deltas + burn-rate update + rule
+      evaluation over wired slo/kv/flow/datastore sources) timed in a
+      tight loop, as a percentage of the measured scheduling-cycle floor
+      (the 128-endpoint x 64-block per-request cost from
+      benchmarks/SCHED_HOTPATH.json); the `timeline: {enabled: false}`
+      kill-switch path (one attribute check) timed the same way, ~0%.
+    - **overload replay**: the --slo-ramp machinery at 1x then 4x measured
+      capacity with the overload controller AND the timeline's burn-rate
+      monitor on. Acceptance: the 4x band trips EXACTLY ONE burn_rate
+      incident (dedup/cooldown — a sustained overload is one incident),
+      and its /debug/incidents snapshot contains the shed-rate excursion
+      (window samples with shed > 0) plus >= 1 shed DecisionRecord.
+    - **fleet gap e2e**: a real 2-worker fleet (hash balancer, snapshot
+      IPC) with a fast timeline tick; worker 1 is killed mid-run and
+      restarted by the supervisor. The merged /debug/timeline must show
+      wall-clock buckets where shard 1 is gap-marked while shard 0 kept
+      sampling (no interpolation).
+    """
+    import asyncio
+    import gc
+
+    from llm_d_inference_scheduler_tpu.router.kvobs import (
+        CacheLedger,
+        KvObsConfig,
+    )
+    from llm_d_inference_scheduler_tpu.router.slo import (
+        SloConfig,
+        SloLedger,
+    )
+    from llm_d_inference_scheduler_tpu.router.timeline import (
+        TimelineConfig,
+        TimelineSampler,
+    )
+
+    # ---- micro: tick cost vs the scheduling-cycle floor ----------------
+    here = os.path.dirname(os.path.abspath(__file__))
+    floor_us = 2000.0  # conservative default: the PR 4 128x64 cycle cost
+    try:
+        with open(os.path.join(here, "benchmarks",
+                               "SCHED_HOTPATH.json")) as f:
+            sweep = json.load(f)["sweep"]
+        floor_us = min(r["us_per_req_after"] for r in sweep
+                       if r.get("endpoints") == 128 and r.get("blocks") == 64)
+    except (OSError, KeyError, ValueError):
+        pass
+
+    from llm_d_inference_scheduler_tpu.router.datalayer.datastore import (
+        Datastore,
+    )
+
+    def make_sampler(enabled: bool) -> TimelineSampler:
+        ledger = SloLedger(SloConfig())
+        # Seed the counters the tick takes deltas over (a zero-delta tick
+        # would under-price the by_role walk).
+        ledger._totals.requests = 100
+        ledger._totals.slo_met = 90
+        ledger._totals.shed = 5
+        ledger._totals.output_tokens = 4000
+        ledger._totals.goodput_tokens = 3600
+        ledger.prompt_tokens_total = 8000
+        ledger.tokens_by_role = {"prefill": (6000, 0),
+                                 "decode": (2000, 4000)}
+        ds = Datastore()
+        ds.transfers.record("p:1", "d:1", pull_ms=3.0, nbytes=4096)
+        ds.transfers.record("p:1", "d:2", pull_ms=7.0, nbytes=4096)
+        kv = CacheLedger(KvObsConfig(enabled=True), datastore=ds)
+        kv.table.record("d:1", hit_ratio=0.8, signed_error=0.05)
+        cfg = TimelineConfig.from_spec(
+            {"enabled": enabled, "tickS": 1.0, "retentionS": 600})
+        return TimelineSampler(cfg, slo_ledger=ledger, kv_ledger=kv,
+                               datastore=ds, inflight_fn=lambda: 7,
+                               drain_rate_fn=lambda: 42.0,
+                               degraded_fn=lambda: 3)
+
+    reps = 20_000 if not quick else 2_000
+    on, off = make_sampler(True), make_sampler(False)
+    gc.disable()
+    try:
+        best_on = best_off = float("inf")
+        for _ in range(5):
+            t = 1_700_000_000.0
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                t += 1.0
+                on.tick(wall=t)
+            best_on = min(best_on, (time.perf_counter() - t0) / reps)
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                off.tick(wall=t)
+            best_off = min(best_off, (time.perf_counter() - t0) / reps)
+    finally:
+        gc.enable()
+        on.gc_pause.stop()
+        off.gc_pause.stop()
+    micro = {
+        "tick_us": round(best_on * 1e6, 3),
+        "tick_pct_of_cycle_floor": round(best_on * 1e6 / floor_us * 100, 4),
+        "killswitch_us": round(best_off * 1e6, 3),
+        "killswitch_pct_of_cycle_floor": round(
+            best_off * 1e6 / floor_us * 100, 4),
+        "cycle_floor_us": round(floor_us, 1),
+        "reps": reps,
+    }
+    print(json.dumps({"phase": "timeline-micro", **micro}))
+
+    # ---- overload replay: one burn-rate incident at 4x -----------------
+    E0, E1, GW = 18940, 18941, 18942
+    MAX_TOKENS, DECODE_MS, SLOTS = 32, 4.0, 2
+    SLO_TTFT_MS, SLO_TPOT_MS = 800, 50
+    band_seconds = 6.0 if not quick else 4.0
+
+    # Burn windows sized to the bench bands: the fast window (2s) catches
+    # the 4x flood inside the band, the slow window (5s) is pure-4x by the
+    # band's end; the 1x band's burn (~1-1.5 on this harness) stays under
+    # both thresholds. Cooldown 60s >> band length = the sustained flood
+    # is ONE incident.
+    cfg = f"""
+featureGates: {{flowControl: true}}
+pool:
+  endpoints:
+    - {{address: 127.0.0.1, port: {E0}}}
+    - {{address: 127.0.0.1, port: {E1}}}
+plugins:
+  - {{type: predicted-latency-producer}}
+  - {{type: queue-scorer}}
+schedulingProfiles:
+  - name: default
+    plugins:
+      - {{pluginRef: queue-scorer}}
+saturationDetector:
+  type: utilization-detector
+  parameters: {{queueDepthThreshold: 1}}
+overload:
+  enabled: true
+  headroomFactor: 0.55
+  degrade: {{maxTokensClamp: 8, admitRatio: 1.1}}
+  retryAfterMaxS: 10
+timeline:
+  tickS: 0.5
+  retentionS: 120
+  burnRate: {{target: 0.9, fastWindowS: 2, slowWindowS: 5,
+              fastBurn: 3.0, slowBurn: 3.0}}
+  incidents: {{contextTicks: 10, cooldownS: 60, maxDecisions: 8}}
+"""
+
+    async def replay() -> dict:
+        import httpx
+
+        from llm_d_inference_scheduler_tpu.engine import EngineConfig
+        from llm_d_inference_scheduler_tpu.engine.server import EngineServer
+        from llm_d_inference_scheduler_tpu.router.gateway import build_gateway
+
+        engines = [EngineServer(EngineConfig(
+            backend="sim", model="tiny", port=p, max_batch=SLOTS,
+            sim_decode_ms_per_token=DECODE_MS)) for p in (E0, E1)]
+        for e in engines:
+            await e.start()
+        gw = build_gateway(cfg, port=GW, poll_interval=0.02)
+        await gw.start()
+        try:
+            limits = httpx.Limits(max_connections=1024)
+            async with httpx.AsyncClient(timeout=60, limits=limits) as c:
+                ramp = await _drive_ramp(
+                    c, GW, band_factors=(1.0, 4.0),
+                    band_seconds=band_seconds,
+                    slo_headers={"x-slo-ttft-ms": str(SLO_TTFT_MS),
+                                 "x-slo-tpot-ms": str(SLO_TPOT_MS)},
+                    max_tokens=MAX_TOKENS, quick=quick,
+                    phase_tag="timeline")
+                inc = (await c.get(
+                    f"http://127.0.0.1:{GW}/debug/incidents")).json()
+                tl = (await c.get(
+                    f"http://127.0.0.1:{GW}/debug/timeline")).json()
+        finally:
+            await gw.stop()
+            for e in engines:
+                await e.stop()
+        burn_incidents = [i for i in inc["incidents"]
+                          if i["rule"] == "burn_rate"]
+        doc: dict = {
+            "bands": ramp["bands"],
+            "incident_count": inc["count"],
+            "burn_rate_incidents": len(burn_incidents),
+            "timeline_ticks": tl["ticks"],
+        }
+        if burn_incidents:
+            i0 = burn_incidents[0]
+            window_shed = [s.get("shed", 0) for s in i0.get("window", [])]
+            shed_decisions = [
+                d for d in i0.get("decisions", [])
+                if (d.get("outcome") or {}).get("verdict") == "shed"]
+            doc["incident"] = {
+                "id": i0["id"],
+                "detail": i0["detail"],
+                "ticks": i0["ticks"],
+                "window_ticks": len(i0.get("window", [])),
+                "window_shed_max": max(window_shed, default=0),
+                "shed_decisions": len(shed_decisions),
+                "has_slo_rollup": "slo" in i0,
+                "has_kv_rollup": "kv" in i0,
+                "example_shed_decision": (shed_decisions[0]
+                                          if shed_decisions else None),
+            }
+        return doc
+
+    replay_doc = asyncio.run(replay())
+    print(json.dumps({"phase": "timeline-replay",
+                      **{k: v for k, v in replay_doc.items()
+                         if k != "bands"}}))
+
+    # ---- fleet gap e2e: merged timeline across a worker restart --------
+    GF_E, GF_GW, GF_ADMIN = 18950, 18951, 18960
+    fleet_cfg = f"""
+pool:
+  endpoints:
+    - {{address: 127.0.0.1, port: {GF_E}}}
+timeline: {{tickS: 0.25, retentionS: 60}}
+scheduling: {{pickSeed: 7}}
+"""
+
+    async def fleet_gap() -> dict:
+        import httpx
+
+        from llm_d_inference_scheduler_tpu.engine import EngineConfig
+        from llm_d_inference_scheduler_tpu.engine.server import EngineServer
+        from llm_d_inference_scheduler_tpu.router.fleet import (
+            FleetConfig,
+            FleetSupervisor,
+        )
+
+        engine = EngineServer(EngineConfig(backend="sim", model="tiny",
+                                           port=GF_E, max_batch=4,
+                                           sim_decode_ms_per_token=1.0))
+        await engine.start()
+        sup = FleetSupervisor(
+            fleet_cfg, host="127.0.0.1", port=GF_GW,
+            fleet=FleetConfig(workers=2, balancer="hash",
+                              admin_port=GF_ADMIN),
+            poll_interval=0.02, drain_timeout_s=2.0)
+        await sup.start()
+        try:
+            await asyncio.sleep(1.5)  # both shards accumulate ticks
+            # Kill shard 1: its ring (and its pre-restart samples) die
+            # with the process; the supervisor respawns it within ~1s.
+            sup._procs[1].terminate()
+            sup._procs[1].join(timeout=5.0)
+            await asyncio.sleep(3.0)  # outage + restart + fresh ticks
+            async with httpx.AsyncClient(timeout=30) as c:
+                tl = (await c.get(
+                    f"http://127.0.0.1:{GF_ADMIN}/debug/timeline")).json()
+        finally:
+            await sup.stop()
+            await engine.stop()
+        buckets = tl.get("buckets", [])
+        shard1_gaps = sum(1 for b in buckets if 1 in (b.get("gaps") or []))
+        shard0_present = sum(1 for b in buckets if "0" in b["shards"])
+        both_present = sum(
+            1 for b in buckets
+            if "0" in b["shards"] and "1" in b["shards"])
+        return {
+            "workers": tl.get("workers"),
+            "buckets": len(buckets),
+            "gap_buckets": tl.get("gap_buckets"),
+            "shard1_gap_buckets": shard1_gaps,
+            "shard0_sample_buckets": shard0_present,
+            "both_shards_buckets": both_present,
+        }
+
+    fleet_doc = asyncio.run(fleet_gap())
+    print(json.dumps({"phase": "timeline-fleet-gap", **fleet_doc}))
+
+    incident = replay_doc.get("incident") or {}
+    return {
+        "micro": micro,
+        "replay": replay_doc,
+        "fleet": fleet_doc,
+        "acceptance": {
+            "tick_pct_of_cycle_floor": micro["tick_pct_of_cycle_floor"],
+            "tick_under_1pct": micro["tick_pct_of_cycle_floor"] < 1.0,
+            "killswitch_pct_of_cycle_floor":
+                micro["killswitch_pct_of_cycle_floor"],
+            "burn_rate_incidents": replay_doc["burn_rate_incidents"],
+            "exactly_one_burn_incident":
+                replay_doc["burn_rate_incidents"] == 1,
+            "incident_has_shed_excursion":
+                incident.get("window_shed_max", 0) > 0,
+            "incident_has_shed_decision":
+                incident.get("shed_decisions", 0) >= 1,
+            "fleet_gap_marked": fleet_doc["shard1_gap_buckets"] > 0,
+            "fleet_leader_continuous": fleet_doc["shard0_sample_buckets"] > 0,
+        },
+    }
+
+
 def main() -> None:
     if len(sys.argv) > 3 and sys.argv[1] == "--child":
         child(sys.argv[2], int(sys.argv[3]))
@@ -2603,6 +2902,14 @@ def main() -> None:
         os.makedirs(os.path.join(here, "benchmarks"), exist_ok=True)
         res = kv_obs_bench(quick="--quick" in sys.argv)
         with open(os.path.join(here, "benchmarks", "KV_OBS.json"), "w") as f:
+            json.dump(res, f, indent=1)
+        return
+    if "--timeline" in sys.argv:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")  # no chip needed
+        here = os.path.dirname(os.path.abspath(__file__))
+        os.makedirs(os.path.join(here, "benchmarks"), exist_ok=True)
+        res = timeline_bench(quick="--quick" in sys.argv)
+        with open(os.path.join(here, "benchmarks", "TIMELINE.json"), "w") as f:
             json.dump(res, f, indent=1)
         return
     if "--overload-ramp" in sys.argv:

@@ -50,10 +50,15 @@ class RawConfig:
     overload: dict[str, Any]
     kv_cache: dict[str, Any]
     disagg: dict[str, Any]
+    timeline: dict[str, Any]
     tls_client: dict[str, Any]
     pool: dict[str, Any]
     objectives: list[dict[str, Any]]
     model_rewrites: list[dict[str, Any]]
+    # The parsed YAML document verbatim — /debug/config serves a redacted
+    # view of it and router_config_info{hash} fingerprints it, so an
+    # operator can see what config a running worker actually loaded.
+    doc: dict[str, Any] = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass
@@ -112,6 +117,14 @@ class RouterConfig:
     # to the always-run-the-decider router. Applied post-instantiation to
     # every plugin exposing set_classifier (the pickSeed precedent).
     disagg: dict[str, Any]
+    # timeline: the fleet flight recorder knobs (router/timeline.py
+    # TimelineConfig — {enabled, tickS, retentionS, burnRate, rules,
+    # incidents}; enabled: false is the kill-switch that removes the
+    # sampler task and the /debug/timeline history entirely).
+    timeline: dict[str, Any]
+    # The parsed YAML verbatim: /debug/config serves a redacted view and
+    # router_config_info{hash} fingerprints it.
+    raw_doc: dict[str, Any]
     tls_client: dict[str, Any]
     static_endpoints: list[EndpointMetadata]
     pool: EndpointPool
@@ -146,10 +159,12 @@ def load_raw_config(text: str | None) -> RawConfig:
         overload=doc.get("overload") or {},
         kv_cache=doc.get("kvCache") or {},
         disagg=doc.get("disagg") or {},
+        timeline=doc.get("timeline") or {},
         tls_client=doc.get("tlsClient") or {},
         pool=doc.get("pool") or {},
         objectives=doc.get("objectives") or [],
         model_rewrites=doc.get("modelRewrites") or [],
+        doc=doc,
     )
 
 
@@ -340,6 +355,8 @@ def instantiate(raw: RawConfig, handle: Handle,
         overload=raw.overload,
         kv_cache=raw.kv_cache,
         disagg=raw.disagg,
+        timeline=raw.timeline,
+        raw_doc=raw.doc,
         tls_client=raw.tls_client,
         static_endpoints=static_endpoints,
         pool=pool,

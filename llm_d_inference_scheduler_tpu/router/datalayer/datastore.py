@@ -111,6 +111,13 @@ class Datastore:
 
     SNAPSHOT_MIN_REFRESH_S = 0.01
 
+    @property
+    def snapshot_epoch(self) -> int:
+        """The epoch last built (or applied from the fleet leader) —
+        WITHOUT forcing a rebuild the way snapshot() can; the timeline
+        sampler reads this every tick."""
+        return self._snapshot_epoch
+
     def mark_snapshot_dirty(self) -> None:
         """A scrape landed: refresh the snapshot once the rate-limit floor
         passes (soft staleness — pool membership is unchanged)."""

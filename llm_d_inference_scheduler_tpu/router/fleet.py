@@ -53,6 +53,7 @@ import socket
 import struct
 import tempfile
 import time
+from collections import deque
 from typing import Any, Callable, Iterable
 
 import xxhash
@@ -378,6 +379,11 @@ MAX_MERGED_GAUGES = {
     "router_snapshot_epoch",
     "router_slo_attainment",
     "router_endpoint_circuit_breaker_state",
+    # Burn rate is a ratio: two workers each burning 5x must read as 5x,
+    # not 10x (the request-weighted view is the merged /debug/timeline's
+    # job). RSS/FDs stay summed — fleet-total footprint is the useful
+    # aggregate for per-worker process gauges.
+    "router_slo_burn_rate",
 }
 
 
@@ -612,14 +618,36 @@ def merge_slo(docs: list[dict[str, Any]]) -> dict[str, Any]:
 class FleetAdmin:
     """The supervisor's fan-in admin plane, separable from process
     management (tests drive it against stub workers): merged /metrics and
-    the /debug record lookups routed to the owning shard."""
+    the /debug record lookups routed to the owning shard.
+
+    With a ``timeline`` config the admin also runs the SUPERVISOR side of
+    the fleet flight recorder (router/timeline.py): a grid-aligned poll
+    that derives the per-shard KV-index divergence series — a worker
+    cannot see its own divergence, only the fan-in can compute it — and
+    evaluates the divergence bound rule into supervisor-owned incidents.
+    The merged ``/debug/timeline`` then carries the worker rings bucketed
+    by wall clock (gaps marked when a shard was down) beside the
+    supervisor's divergence series, so a kill-the-leader chaos run reads
+    as one timeline with the excursion and the incident that recorded
+    it."""
 
     def __init__(self, worker_admin: list[tuple[str, int]], *,
                  host: str = "127.0.0.1", port: int = 9081,
-                 worker_alive: Callable[[int], bool] | None = None):
+                 worker_alive: Callable[[int], bool] | None = None,
+                 timeline: Any = None):
+        from .timeline import IncidentRecorder, TimelineConfig
+
         self.worker_admin = worker_admin
         self.host, self.port = host, port
         self.worker_alive = worker_alive or (lambda i: True)
+        self.timeline_cfg = timeline or TimelineConfig()
+        self._sup_ring: "deque[dict[str, Any]]" = deque(
+            maxlen=self.timeline_cfg.ring_capacity)
+        self._last_kv_doc: dict[str, Any] | None = None
+        self._sup_incidents = IncidentRecorder(
+            self.timeline_cfg,
+            kv_snapshot_fn=lambda: self._last_kv_doc or {})
+        self._timeline_task: asyncio.Task | None = None
         self.app = web.Application()
         self.app.add_routes([
             web.get("/metrics", self.metrics),
@@ -630,6 +658,10 @@ class FleetAdmin:
             web.get("/debug/slo", self.slo),
             web.get("/debug/transfers", self.transfers),
             web.get("/debug/kv", self.kv),
+            web.get("/debug/traces", self.traces),
+            web.get("/debug/timeline", self.timeline),
+            web.get("/debug/incidents", self.incidents),
+            web.get("/debug/config", self.config),
         ])
         self._runner: web.AppRunner | None = None
         self._session = None
@@ -654,14 +686,70 @@ class FleetAdmin:
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)
         await site.start()
+        if self.timeline_cfg.enabled and self.worker_admin:
+            self._timeline_task = asyncio.get_running_loop().create_task(
+                self._timeline_loop())
 
     async def stop(self) -> None:
+        if self._timeline_task is not None:
+            self._timeline_task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._timeline_task
+            self._timeline_task = None
         if self._runner is not None:
             await self._runner.cleanup()
             self._runner = None
         if self._session is not None:
             await self._session.close()
             self._session = None
+
+    async def _timeline_loop(self) -> None:
+        """Supervisor half of the flight recorder: one grid-aligned tick
+        deriving the per-shard divergence series from the /debug/kv
+        fan-in (merge_kv also sets the router_kv_index_divergence gauges)
+        and evaluating the divergence bound rule into supervisor-owned
+        incidents."""
+        tick = self.timeline_cfg.tick_s
+        try:
+            while True:
+                now = time.time()
+                next_t = (int(now / tick) + 1) * tick
+                await asyncio.sleep(max(next_t - now, 0.0))
+                with contextlib.suppress(Exception):
+                    await self._timeline_tick()
+        except asyncio.CancelledError:
+            pass
+
+    async def _timeline_tick(self) -> None:
+        from .timeline import RULE_DIVERGENCE
+
+        results = await self._fan_out("/debug/kv")
+        docs = [(shard, doc)
+                for shard, (status, doc) in enumerate(results)
+                if status == 200 and isinstance(doc, dict)]
+        if not docs:
+            return
+        merged = merge_kv(docs)
+        self._last_kv_doc = merged
+        div = merged.get("index_divergence") or {}
+        sample: dict[str, Any] = {
+            "t_unix": time.time(),
+            "kv_index_divergence": {str(k): v for k, v in div.items()},
+            "kv_index_divergence_max": max(div.values(), default=0.0),
+            "shards_responding": sorted(s for s, _ in docs),
+        }
+        self._sup_ring.append(sample)
+        tripped: dict[str, str] = {}
+        cfg = self.timeline_cfg
+        if (cfg.divergence_max > 0
+                and sample["kv_index_divergence_max"] > cfg.divergence_max):
+            tripped[RULE_DIVERGENCE] = (
+                f"max shard divergence "
+                f"{sample['kv_index_divergence_max']:.4f} > "
+                f"{cfg.divergence_max}")
+        self._sup_incidents.observe(
+            tripped, sample,
+            lambda: list(self._sup_ring)[-cfg.context_ticks - 1:-1])
 
     async def _fetch(self, shard: int, path: str) -> tuple[int, Any]:
         """(status, json-or-text) from one worker's admin plane; (0, None)
@@ -824,6 +912,99 @@ class FleetAdmin:
                 row["shard"] = shard
                 pairs.append(row)
         return web.json_response({"pairs": pairs})
+
+    async def traces(self, request: web.Request) -> web.Response:
+        """Cross-shard trace fan-in: every worker's /debug/traces merged,
+        deduped by span_id. The query string forwards verbatim, so
+        ``?merge=1`` additionally pulls each worker's POOL endpoints
+        (sidecars/engines) through the workers' own merge path — before
+        this, traces stopped at the worker boundary while every other
+        fan-in table re-served its surface."""
+        qs = request.query_string
+        path = "/debug/traces" + (f"?{qs}" if qs else "")
+        results = await self._fan_out(path)
+        seen: set[str] = set()
+        spans: list[dict] = []
+        for shard, (status, doc) in enumerate(results):
+            if status != 200 or not isinstance(doc, dict):
+                continue
+            for s in doc.get("spans") or []:
+                if isinstance(s, dict) and s.get("span_id") not in seen:
+                    seen.add(s.get("span_id"))
+                    s["shard"] = shard
+                    spans.append(s)
+        return web.json_response({"spans": spans})
+
+    async def timeline(self, request: web.Request) -> web.Response:
+        """Merged fleet timeline: per-worker rings bucketed by wall clock
+        (gaps marked when a shard was down — no interpolation) beside the
+        supervisor's divergence series (router/timeline.py
+        merge_timeline)."""
+        from .slo import finite_float_or_none
+        from .timeline import merge_timeline
+
+        qs = request.query_string
+        path = "/debug/timeline" + (f"?{qs}" if qs else "")
+        results = await self._fan_out(path)
+        docs = [(shard, doc)
+                for shard, (status, doc) in enumerate(results)
+                if status == 200 and isinstance(doc, dict)]
+        # The ?window_s trim the workers applied must also bound the
+        # supervisor's divergence series, or a windowed query pays for —
+        # and correlates against — supervisor samples whose wall-clock
+        # range has no worker buckets at all.
+        sup = list(self._sup_ring)
+        window_s = finite_float_or_none(request.query.get("window_s"))
+        if window_s and window_s > 0 and sup:
+            cutoff = sup[-1]["t_unix"] - window_s
+            sup = [s for s in sup if s["t_unix"] >= cutoff]
+        return web.json_response(merge_timeline(
+            docs, workers=len(self.worker_admin), supervisor=sup))
+
+    async def incidents(self, request: web.Request) -> web.Response:
+        """All incident snapshots: each worker's ring shard-annotated,
+        plus the supervisor's own (divergence-rule) incidents, newest
+        first."""
+        results = await self._fan_out("/debug/incidents")
+        merged: list[dict] = []
+        for shard, (status, doc) in enumerate(results):
+            if status != 200 or not isinstance(doc, dict):
+                continue
+            for inc in doc.get("incidents") or []:
+                inc["shard"] = shard
+                merged.append(inc)
+        for inc in self._sup_incidents.snapshot()["incidents"]:
+            inc = dict(inc)
+            inc["shard"] = "supervisor"
+            merged.append(inc)
+        merged.sort(key=lambda i: i.get("first_unix") or 0, reverse=True)
+        return web.json_response({"count": len(merged),
+                                  "incidents": merged})
+
+    async def config(self, request: web.Request) -> web.Response:
+        """Fleet config-skew check: every worker's effective-config hash
+        side by side (consistent = all responding shards agree), with the
+        redacted snapshot served once from the lowest responding shard."""
+        results = await self._fan_out("/debug/config")
+        shards: list[dict] = []
+        snapshot = None
+        hashes: set[str] = set()
+        for shard, (status, doc) in enumerate(results):
+            if status != 200 or not isinstance(doc, dict):
+                shards.append({"shard": shard, "hash": None})
+                continue
+            h = doc.get("hash")
+            hashes.add(h)
+            shards.append({"shard": shard, "hash": h})
+            if snapshot is None:
+                snapshot = doc.get("config")
+        return web.json_response({
+            "workers": len(self.worker_admin),
+            # <= 1: zero responding shards is "no skew observed", not skew.
+            "consistent": len(hashes) <= 1,
+            "shards": shards,
+            "config": snapshot,
+        })
 
 
 # ---------------------------------------------------------------------------
@@ -1051,9 +1232,14 @@ class FleetSupervisor:
             for i in range(self.fleet.workers):
                 self._spawn(i)
             await self._wait_ready()
-            self.admin = FleetAdmin(self.worker_admin, host="127.0.0.1",
-                                    port=self.admin_port,
-                                    worker_alive=self.worker_alive)
+            from .config.loader import load_raw_config
+            from .timeline import TimelineConfig
+
+            self.admin = FleetAdmin(
+                self.worker_admin, host="127.0.0.1", port=self.admin_port,
+                worker_alive=self.worker_alive,
+                timeline=TimelineConfig.from_spec(
+                    load_raw_config(self.config_text).timeline))
             await self.admin.start()
             if self.fleet.balancer == "hash":
                 self.balancer = HashBalancer(

@@ -384,6 +384,18 @@ class FlowController:
     def queued_requests(self) -> int:
         return sum(s.total_requests for s in self.shards)
 
+    def queued_by_band(self) -> dict[int, int]:
+        """Queued items per priority band across shards (bands are
+        implicit — derived from live queues, so an idle band is simply
+        absent). Read by the timeline sampler once per tick."""
+        bands: dict[int, int] = {}
+        for s in self.shards:
+            for key, q in s.queues.items():
+                n = len(q)
+                if n:
+                    bands[key.priority] = bands.get(key.priority, 0) + n
+        return bands
+
     def shed_queued(self, n: int) -> list[str]:
         """Shed up to n queued sheddable items across shards; returns the
         victims' request ids."""

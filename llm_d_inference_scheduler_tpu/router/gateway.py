@@ -70,9 +70,15 @@ from .requestcontrol.director import (
     RequestError,
 )
 from .kvobs import H_KV_HIT_BLOCKS, H_KV_HIT_TOKENS, CacheLedger, KvObsConfig
-from .overload import OverloadConfig, OverloadController
+from .overload import DrainRateEstimator, OverloadConfig, OverloadController
 from .schedpool import LoopLagMonitor, SchedulerPool, SchedulingConfig
 from .slo import SloConfig, SloLedger, finite_float_or_none
+from .timeline import (
+    TimelineConfig,
+    TimelineSampler,
+    config_hash,
+    redact_config,
+)
 from .datalayer.data_graph import validate_and_order_producers
 
 log = logging.getLogger("router.gateway")
@@ -263,6 +269,44 @@ class Gateway:
             sched_pool=self.sched_pool,
             overload=self.overload if self.overload.enabled else None)
 
+        # Fleet flight recorder (router/timeline.py): the /debug/timeline
+        # history + burn-rate monitor + /debug/incidents ring. Default-on
+        # (the kvCache precedent); `timeline: {enabled: false}` removes the
+        # sampler task entirely — the disabled sampler object only exists
+        # so /debug/timeline still answers JSON.
+        tl_cfg = TimelineConfig.from_spec(cfg.timeline)
+        drain_fn = None
+        if tl_cfg.enabled and self.flow_controller is not None:
+            if self.overload.enabled:
+                # The overload controller already measures drain; reuse it.
+                drain_fn = self.overload.drain.rate
+            else:
+                # Overload off: the timeline keeps its own estimator on
+                # the dispatch observer (single slot, nothing else owns it
+                # when overload is disabled).
+                est = DrainRateEstimator()
+                self.flow_controller.dispatch_observer = est.note
+                drain_fn = est.rate
+        self.timeline = TimelineSampler(
+            tl_cfg,
+            slo_ledger=self.slo_ledger,
+            kv_ledger=self.kv_ledger,
+            datastore=datastore,
+            flow=self.flow_controller,
+            inflight_fn=lambda: self._inflight,
+            drain_rate_fn=drain_fn,
+            degraded_fn=(lambda: self.overload.degraded_total)
+            if self.overload.enabled else None,
+            decisions_fn=self._recent_bad_decisions)
+
+        # Effective-config identity: the hash covers the UNREDACTED loaded
+        # doc (config skew across fleet shards must show even when only
+        # secrets differ); /debug/config serves the redacted snapshot.
+        self.config_hash = config_hash(cfg.raw_doc)
+        from .metrics import CONFIG_INFO
+
+        CONFIG_INFO.labels(self.config_hash).set(1)
+
         self.app = web.Application()
         self.app.add_routes([
             web.post("/v1/completions", self.handle_inference),
@@ -279,6 +323,9 @@ class Gateway:
             web.get("/debug/slo", self.slo),
             web.get("/debug/transfers", self.transfers),
             web.get("/debug/kv", self.kv),
+            web.get("/debug/timeline", self.timeline_view),
+            web.get("/debug/incidents", self.incidents_view),
+            web.get("/debug/config", self.config_view),
         ])
         self._runner: web.AppRunner | None = None
         # Fleet snapshot IPC endpoints (router/fleet.py): the datalayer
@@ -395,6 +442,9 @@ class Gateway:
         # /metrics (router_loop_lag_seconds) — the number the scheduler
         # offload exists to shrink.
         self.loop_lag.start()
+        # Fleet flight recorder: grid-aligned sampler ticks (no-op under
+        # the timeline kill-switch).
+        self.timeline.start()
         if self.grpc_health is not None:
             await self.grpc_health.start()
         if self.grpc_ext_proc is not None:
@@ -410,6 +460,7 @@ class Gateway:
 
     async def stop(self):
         self.loop_lag.stop()
+        await self.timeline.stop()
         if self._flusher:
             self._flusher.cancel()
         if self.grpc_health is not None:
@@ -542,6 +593,48 @@ class Gateway:
             "decisions": docs,
         })
 
+    def _recent_bad_decisions(self, k: int) -> list[dict[str, Any]]:
+        """The last K missed/shed DecisionRecords (compact), newest first —
+        the incident recorder embeds them in each snapshot so "what broke"
+        comes with "which requests it broke"."""
+        out: list[dict[str, Any]] = []
+        for rec in self.decision_recorder.snapshot(None):
+            outcome = rec.outcome or {}
+            verdict = outcome.get("verdict")
+            if verdict in ("missed", "shed", "error"):
+                out.append(rec.to_dict(compact=True))
+                if len(out) >= k:
+                    break
+        return out
+
+    async def timeline_view(self, request: web.Request) -> web.Response:
+        """Fleet flight recorder history (router/timeline.py): raw ticks
+        plus windowed aggregates; ?window_s=N bounds the returned window
+        (default: the whole retained ring)."""
+        window_s = finite_float_or_none(request.query.get("window_s"))
+        return web.json_response(self.timeline.snapshot(
+            window_s=window_s if window_s and window_s > 0 else None))
+
+    async def incidents_view(self, request: web.Request) -> web.Response:
+        """Triggered incident snapshots (router/timeline.py): timeline
+        window ±N ticks, the last K missed/shed DecisionRecords, and the
+        /debug/slo + /debug/kv rollups captured at trigger time."""
+        return web.json_response({
+            "enabled": self.timeline.enabled,
+            **self.timeline.incidents.snapshot(),
+        })
+
+    async def config_view(self, request: web.Request) -> web.Response:
+        """Redacted effective-config snapshot: what config THIS worker
+        actually loaded (secrets masked, paths reduced to basenames), plus
+        the hash router_config_info carries — the fleet fan-in compares it
+        across shards."""
+        return web.json_response({
+            "hash": self.config_hash,
+            "shard": self.fleet.index if self.fleet is not None else None,
+            "config": redact_config(self.cfg.raw_doc),
+        })
+
     async def kv(self, request: web.Request) -> web.Response:
         """KV-cache & prefix-reuse observability rollup (router/kvobs.py):
         per-pod measured hit-rate and signed-prediction-error EWMAs, index
@@ -576,7 +669,10 @@ class Gateway:
 
     async def profile(self, request: web.Request) -> web.Response:
         """CPU profile of the router process for ?seconds=N (pprof analogue;
-        reference mounts pprof handlers behind --enable-pprof, SURVEY §5)."""
+        reference mounts pprof handlers behind --enable-pprof, SURVEY §5).
+        ``?format=json`` returns the top-N cumulative rows as structured
+        data instead of the pstats text dump (machine-readable for CI and
+        the verify-debug probe, which drives this route's REAL path)."""
         import cProfile
         import io
         import pstats
@@ -602,8 +698,30 @@ class Gateway:
                 # Cancellation/shutdown must not leave the C profile hook
                 # installed on the event-loop thread.
                 prof.disable()
+        try:
+            top_n = max(1, min(int(request.query.get("n", "40")), 500))
+        except ValueError:
+            top_n = 40
+        if request.query.get("format") == "json":
+            stats = pstats.Stats(prof)
+            rows = []
+            for (fname, line, func), (cc, nc, tt, ct, _callers) in \
+                    stats.stats.items():  # type: ignore[attr-defined]
+                rows.append({
+                    "function": f"{fname}:{line}({func})",
+                    "ncalls": nc,
+                    "primitive_calls": cc,
+                    "tottime_s": round(tt, 6),
+                    "cumtime_s": round(ct, 6),
+                })
+            rows.sort(key=lambda r: r["cumtime_s"], reverse=True)
+            return web.json_response({
+                "seconds": seconds,
+                "functions_profiled": len(rows),
+                "rows": rows[:top_n],
+            })
         buf = io.StringIO()
-        pstats.Stats(prof, stream=buf).sort_stats("cumulative").print_stats(40)
+        pstats.Stats(prof, stream=buf).sort_stats("cumulative").print_stats(top_n)
         return web.Response(text=buf.getvalue(), content_type="text/plain")
 
     async def handle_inference(self, request: web.Request) -> web.StreamResponse:

@@ -340,6 +340,17 @@ class CacheLedger:
     def enabled(self) -> bool:
         return self.cfg.enabled
 
+    @property
+    def stamps(self) -> int:
+        """Predictions recorded (timeline sampler delta source)."""
+        return self._stamps
+
+    @property
+    def joins(self) -> int:
+        """Engine-confirmed actuals joined (timeline sampler delta
+        source)."""
+        return self._joins
+
     def attach_plugins(self, plugins) -> None:
         for p in plugins:
             if hasattr(p, "index_sizes"):

@@ -248,6 +248,49 @@ PD_HOP_SKIPPED_TOTAL = Counter(
     "Requests routed straight to the decode pod by the prefill classifier "
     "(no prefill leg, no KV pull — the P/D hop skipped)",
     registry=REGISTRY)
+# Fleet flight recorder (router/timeline.py): the /debug/timeline sampler,
+# the multi-window SLO burn-rate monitor, and the /debug/incidents ring.
+# The per-tick detail lives in the timeline samples; these are the
+# graphable aggregates (and the liveness signal that the sampler ticks).
+TIMELINE_TICKS = Counter(
+    "router_timeline_ticks_total",
+    "Timeline sampler ticks recorded (liveness of the flight recorder; "
+    "absent/frozen under the timeline kill-switch)", registry=REGISTRY)
+SLO_BURN_RATE = Gauge(
+    "router_slo_burn_rate",
+    "Multi-window SLO error-budget burn rate ((1 - met/arrivals) / "
+    "(1 - target); arrivals include sheds — the arrival-relative goodput "
+    "view, deliberately stricter than /debug/slo's served-relative "
+    "attainment)", ("window",), registry=REGISTRY)  # window: fast | slow
+INCIDENTS_TOTAL = Counter(
+    "router_incidents_total",
+    "Triggered incident snapshots captured into the /debug/incidents ring "
+    "(rule: burn_rate | shed_rate | drain_collapse | divergence); "
+    "dedup/cooldown means a sustained episode counts once",
+    ("rule",), registry=REGISTRY)
+# Process self-telemetry feeding the timeline: before these the only
+# process-health signal was router_loop_lag_seconds.
+PROCESS_RSS_BYTES = Gauge(
+    "router_process_rss_bytes",
+    "Resident set size of the router process (/proc/self/statm, sampled "
+    "per timeline tick)", registry=REGISTRY)
+PROCESS_OPEN_FDS = Gauge(
+    "router_process_open_fds",
+    "Open file descriptors of the router process (sockets, pipes, files; "
+    "sampled per timeline tick)", registry=REGISTRY)
+GC_PAUSE_SECONDS = Counter(
+    "router_gc_pause_seconds_total",
+    "Cumulative stop-the-world garbage-collection pause time "
+    "(gc.callbacks; every pause stalls the event loop and all scheduler "
+    "workers)", registry=REGISTRY)
+# Effective-config identity (/debug/config): the hash label changes only
+# with the loaded config, so cardinality is one series per process — the
+# fleet fan-in compares hashes across shards to catch config skew.
+CONFIG_INFO = Gauge(
+    "router_config_info",
+    "Constant 1, labeled with the xxh64 hash of the effective loaded "
+    "config — scrape-joinable config-skew detection (redacted snapshot at "
+    "/debug/config)", ("hash",), registry=REGISTRY)
 # Multi-process sharded gateway (router/fleet.py): each worker exposes the
 # pool-snapshot epoch it last built (leader) or applied from the IPC stream
 # (follower) — the supervisor re-labels it per shard, making snapshot-IPC

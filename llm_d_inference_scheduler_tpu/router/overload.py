@@ -308,6 +308,10 @@ class OverloadController:
         self._bias_ms: float | None = None
         self._bias_at: float = 0.0  # last update (wall-clock decay anchor)
         self._clock = clock
+        # Flat counter for the timeline sampler (requests that took a
+        # degrade rung — the Prometheus family is per-action, this is the
+        # per-request total the per-tick delta wants).
+        self.degraded_total = 0
         self.queue_policy = (QueueOverloadPolicy(
             eviction_enabled=cfg.queue_eviction,
             decay_per_s=max(cfg.priority_decay_per_s, 0.0))
@@ -548,6 +552,7 @@ class OverloadController:
         for action in applied:
             DEGRADED_REQUESTS_TOTAL.labels(action).inc()
         if applied:
+            self.degraded_total += 1
             # The gateway must re-serialize the mutated payload instead of
             # forwarding the raw client bytes.
             request.degraded = True

@@ -242,10 +242,24 @@ class SloLedger:
         self._miss_reasons: dict[str, int] = {}
         self._shed_reasons: dict[str, int] = {}
         self._start_unix = time.time()
+        # Flat counters the timeline sampler (router/timeline.py) reads
+        # every tick: prompt-token total and the per-role prompt/completion
+        # token split — the prefill:decode mix is the P/D rebalancer's
+        # controller input (ROADMAP item 5), and reading raw counters
+        # keeps the tick path off the full snapshot() render.
+        self.prompt_tokens_total = 0
+        self.tokens_by_role: dict[str, tuple[int, int]] = {}
 
     @property
     def enabled(self) -> bool:
         return self.cfg.enabled
+
+    @property
+    def totals(self) -> _Agg:
+        """The cumulative rollup accumulator (requests / slo_met / shed /
+        output_tokens / goodput_tokens) — the timeline sampler's per-tick
+        delta source."""
+        return self._totals
 
     # ---- open -----------------------------------------------------------
 
@@ -378,6 +392,15 @@ class SloLedger:
             OUTPUT_TOKENS_TOTAL.labels(obs.model).inc(tokens)
             if met:
                 GOODPUT_TOKENS_TOTAL.labels(obs.model).inc(tokens)
+        # Token-mix counters for the timeline (prompt tokens ≈ prefill
+        # work, completion tokens ≈ decode work; per serving role so a
+        # disagg pool's P:D split is readable as counter deltas).
+        prompt_tokens = int((usage or {}).get("prompt_tokens") or 0)
+        if prompt_tokens or tokens:
+            self.prompt_tokens_total += prompt_tokens
+            role_key = obs.role or "default"
+            p, c = self.tokens_by_role.get(role_key, (0, 0))
+            self.tokens_by_role[role_key] = (p + prompt_tokens, c + tokens)
 
         # Predictor calibration: signed error feeds the rollup (bias), the
         # absolute error feeds the histogram family. Only meaningful when

@@ -1,0 +1,909 @@
+"""Fleet flight recorder: the /debug/timeline telemetry history, the
+SLO burn-rate monitor, and triggered incident snapshots.
+
+Every observability surface the router has built so far — traces,
+decisions, /debug/slo, /debug/kv — is point-in-time: ask "what happened at
+t=40s of the overload ramp" and nothing can answer. P/D-Serve
+(arXiv:2408.08147) argues fine-grained per-stage monitoring *over time* is
+what makes disaggregated serving operable at scale, and the ROADMAP's
+chaos-run and P/D-rebalancer items both need history — divergence bounds
+"held" is a claim about a series, and the rebalancer's defining input is
+the prefill:decode token mix *as it swings* mid-run.
+
+Three pieces, one module:
+
+- **TimelineSampler** — ticks on the event loop (``timeline: {enabled,
+  tickS, retentionS}``, default-on like ``kvCache``) and appends one
+  bounded-ring sample of the signals the closed loops already compute:
+  drain rate + in-flight + per-band queue depth, served/shed/degraded
+  deltas, goodput vs raw token deltas, the per-role prefill:decode token
+  mix (the rebalancer input, derived from counter deltas), pool-level KV
+  hit/signed-error EWMAs, transfer-pair EWMAs, loop lag (the tick's own
+  sleep overshoot), snapshot epoch, and process self-telemetry (RSS, open
+  FDs, GC pause). Served at ``GET /debug/timeline`` with raw ticks plus
+  windowed aggregates (p50/p99, rate of change).
+- **BurnRateMonitor** — SRE-style multi-window burn rate over the
+  attainment series: burn = (1 − met/arrivals) / error budget, where
+  arrivals include sheds (a shed burns the arrival-relative goodput
+  budget even though /debug/slo's served-relative attainment excludes it
+  — that asymmetry is deliberate: the monitor answers "are users getting
+  goodput", the ledger answers "is the pool serving what it admitted").
+  An incident trips only when BOTH the fast and slow windows exceed their
+  thresholds — fast catches the onset, slow confirms it is not a blip.
+- **IncidentRecorder** — bounded ``/debug/incidents`` ring. On a rule
+  trip (burn rate, shed-rate spike, drain collapse, divergence bound) it
+  captures the timeline window ±N ticks, the last K missed/shed
+  DecisionRecords, and the /debug/slo + /debug/kv rollups at trigger
+  time. Dedup/cooldown: a sustained overload extends ONE incident (ticks
+  count + post-trigger window grow in place); a re-trip inside the
+  cooldown window reopens the same incident instead of minting a new one.
+
+Fleet mode fans both in (router/fleet.py): per-worker rings merge by
+wall-clock bucket at the FleetAdmin — ticks are grid-aligned so the same
+bucket index means the same wall second in every worker — with gaps marked
+when a shard was down (no interpolation; the monotonic-merge precedent),
+and a supervisor-side divergence series rides beside the worker buckets so
+a kill-the-leader chaos run reads as one timeline with the divergence
+excursion and the incident that recorded it.
+
+``timeline: {enabled: false}`` is the kill-switch: no background task, no
+ring, and ``tick()`` degrades to a single attribute check — ``bench.py
+--timeline`` measures both sides against the SCHED_HOTPATH cycle floor.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import dataclasses
+import gc as _gc
+import json
+import os
+import time
+from collections import deque
+from typing import Any, Callable
+
+import xxhash
+
+from .metrics import (
+    GC_PAUSE_SECONDS,
+    INCIDENTS_TOTAL,
+    PROCESS_OPEN_FDS,
+    PROCESS_RSS_BYTES,
+    SLO_BURN_RATE,
+    TIMELINE_TICKS,
+)
+
+# Incident rule names (the {rule} label on router_incidents_total —
+# bounded cardinality).
+RULE_BURN_RATE = "burn_rate"
+RULE_SHED_RATE = "shed_rate"
+RULE_DRAIN_COLLAPSE = "drain_collapse"
+RULE_DIVERGENCE = "divergence"
+
+
+@dataclasses.dataclass
+class BurnRateConfig:
+    """The ``timeline.burnRate:`` section. ``target`` is the SLO attainment
+    objective the error budget derives from (budget = 1 − target); the
+    fast window catches onset, the slow window confirms sustained burn."""
+
+    target: float = 0.9
+    fast_window_s: float = 60.0
+    slow_window_s: float = 300.0
+    fast_burn: float = 4.0
+    slow_burn: float = 2.0
+
+    @classmethod
+    def from_spec(cls, spec: dict[str, Any] | None) -> "BurnRateConfig":
+        spec = spec or {}
+        cfg = cls(target=float(spec.get("target", 0.9)),
+                  fast_window_s=float(spec.get("fastWindowS", 60.0)),
+                  slow_window_s=float(spec.get("slowWindowS", 300.0)),
+                  fast_burn=float(spec.get("fastBurn", 4.0)),
+                  slow_burn=float(spec.get("slowBurn", 2.0)))
+        if not 0.0 < cfg.target < 1.0:
+            raise ValueError("timeline.burnRate.target must be in (0, 1)")
+        if cfg.fast_window_s > cfg.slow_window_s:
+            raise ValueError("timeline.burnRate: fastWindowS must be <= "
+                             "slowWindowS")
+        return cfg
+
+
+@dataclasses.dataclass
+class TimelineConfig:
+    """The YAML ``timeline:`` section. Default-on (the ``kvCache``
+    precedent); ``enabled: false`` is the kill-switch — no task, no ring,
+    ``tick()`` is one attribute check."""
+
+    enabled: bool = True
+    tick_s: float = 1.0
+    retention_s: float = 600.0
+    burn: BurnRateConfig = dataclasses.field(default_factory=BurnRateConfig)
+    # Bound rules (0 disables each): shed rate in sheds/s, drain collapse
+    # (queued work waiting while the measured drain rate sits below the
+    # floor), per-shard KV-index divergence (evaluated supervisor-side —
+    # a worker cannot see its own divergence, the fan-in computes it).
+    shed_rate_max: float = 0.0
+    drain_min_rps: float = 0.0
+    divergence_max: float = 0.0
+    # Incident capture.
+    incident_capacity: int = 64
+    context_ticks: int = 10
+    cooldown_s: float = 120.0
+    max_decisions: int = 8
+
+    @classmethod
+    def from_spec(cls, spec: dict[str, Any] | None) -> "TimelineConfig":
+        spec = spec or {}
+        rules = spec.get("rules") or {}
+        inc = spec.get("incidents") or {}
+        cfg = cls(
+            enabled=bool(spec.get("enabled", True)),
+            tick_s=float(spec.get("tickS", 1.0)),
+            retention_s=float(spec.get("retentionS", 600.0)),
+            burn=BurnRateConfig.from_spec(spec.get("burnRate")),
+            shed_rate_max=float(rules.get("shedRateMax", 0.0)),
+            drain_min_rps=float(rules.get("drainMinRps", 0.0)),
+            divergence_max=float(rules.get("divergenceMax", 0.0)),
+            incident_capacity=max(1, int(inc.get("capacity", 64))),
+            context_ticks=max(1, int(inc.get("contextTicks", 10))),
+            cooldown_s=float(inc.get("cooldownS", 120.0)),
+            max_decisions=max(1, int(inc.get("maxDecisions", 8))),
+        )
+        if cfg.tick_s <= 0:
+            raise ValueError("timeline.tickS must be > 0")
+        if cfg.retention_s < cfg.tick_s:
+            raise ValueError("timeline.retentionS must be >= tickS")
+        return cfg
+
+    @property
+    def ring_capacity(self) -> int:
+        return max(1, int(self.retention_s / self.tick_s))
+
+
+# ---------------------------------------------------------------------------
+# Process self-telemetry: RSS, open FDs, GC pause time.
+# ---------------------------------------------------------------------------
+
+_PAGE_SIZE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
+
+# One persistently-open fd for /proc/self/statm: procfs serves fresh
+# content on every pread(fd, …, 0), so the per-sample cost is one syscall
+# instead of open+read+close (the open dominates).
+_STATM_FD: int | None = None
+try:
+    _STATM_FD = os.open("/proc/self/statm", os.O_RDONLY)
+except OSError:
+    _STATM_FD = None
+
+
+def rss_bytes() -> int:
+    """Current resident set size. /proc/self/statm is the live number on
+    Linux; the resource module's ru_maxrss is the PEAK, so it is only the
+    fallback (documented as such by reporting 0 when neither works)."""
+    if _STATM_FD is not None:
+        try:
+            return int(os.pread(_STATM_FD, 128, 0).split()[1]) * _PAGE_SIZE
+        except (OSError, ValueError, IndexError):
+            pass
+    try:
+        import resource
+        import sys
+
+        # ru_maxrss units are platform-dependent: bytes on Darwin,
+        # kilobytes on Linux/BSD — and Darwin is the platform where this
+        # fallback actually runs (no /proc), so the unit guard matters.
+        scale = 1 if sys.platform == "darwin" else 1024
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * scale
+    except Exception:
+        return 0
+
+
+def open_fds() -> int:
+    try:
+        return len(os.listdir("/proc/self/fd"))
+    except OSError:
+        return 0
+
+
+class GcPauseTracker:
+    """Cumulative stop-the-world GC pause time via ``gc.callbacks``. The
+    callback is two clock reads — it must stay that cheap, it runs inside
+    every collection. ``stop()`` removes the callback (tests boot many
+    gateways in one process; a leaked callback would double-count)."""
+
+    def __init__(self):
+        self.pause_s_total = 0.0
+        self._t0: float | None = None
+        self._installed = False
+
+    def _cb(self, phase: str, info: dict) -> None:
+        if phase == "start":
+            self._t0 = time.perf_counter()
+        elif self._t0 is not None:
+            self.pause_s_total += time.perf_counter() - self._t0
+            self._t0 = None
+
+    def start(self) -> None:
+        if not self._installed:
+            _gc.callbacks.append(self._cb)
+            self._installed = True
+
+    def stop(self) -> None:
+        if self._installed:
+            with contextlib.suppress(ValueError):
+                _gc.callbacks.remove(self._cb)
+            self._installed = False
+
+
+# ---------------------------------------------------------------------------
+# Redacted config snapshot (/debug/config).
+# ---------------------------------------------------------------------------
+
+# Key-name fragments whose values are masked outright (tokens, credentials,
+# certificate material) — matched case-insensitively on the key.
+_SECRET_KEY_FRAGMENTS = ("token", "secret", "password", "credential", "cert")
+# Keys whose values are filesystem paths: the path layout leaks deployment
+# internals (mount points, cluster names) the debug plane has no business
+# serving; the basename stays so the operator can still tell WHICH file.
+_PATH_KEY_SUFFIX = "path"
+
+REDACTED = "***"
+
+
+def redact_config(doc: Any) -> Any:
+    """Deep-copy ``doc`` with secrets and paths masked. Secrets redact
+    fully; path values keep their basename (``/etc/certs/ca.pem`` →
+    ``***/ca.pem``) so the snapshot stays diagnosable without leaking the
+    filesystem layout."""
+    if isinstance(doc, dict):
+        out = {}
+        for k, v in doc.items():
+            lk = str(k).lower()
+            if any(f in lk for f in _SECRET_KEY_FRAGMENTS):
+                out[k] = REDACTED if v is not None else None
+            elif lk.endswith(_PATH_KEY_SUFFIX) and isinstance(v, str) and v:
+                out[k] = f"{REDACTED}/{os.path.basename(v)}"
+            else:
+                out[k] = redact_config(v)
+        return out
+    if isinstance(doc, list):
+        return [redact_config(v) for v in doc]
+    if isinstance(doc, str) and doc.startswith("/") and "/" in doc[1:]:
+        return f"{REDACTED}/{os.path.basename(doc)}"
+    return doc
+
+
+def config_hash(doc: Any) -> str:
+    """Stable hash of the UNREDACTED effective config — two workers whose
+    redacted views agree but whose secrets differ must NOT report the same
+    hash (that mismatch is exactly what the fleet fan-in exists to catch).
+    xxh64 over canonical JSON; process-stable (the flow_shard rationale)."""
+    canon = json.dumps(doc, sort_keys=True, default=str)
+    return xxhash.xxh64_hexdigest(canon.encode())
+
+
+# ---------------------------------------------------------------------------
+# Burn-rate monitor.
+# ---------------------------------------------------------------------------
+
+class _WindowSum:
+    """One burn window: a bounded deque of per-tick deltas with RUNNING
+    sums, so add() and burn() are O(1) — the tick path must stay well
+    under the <1%-of-cycle-floor budget, and re-summing a 300-tick window
+    by deque indexing every tick is O(n²)."""
+
+    __slots__ = ("ticks", "_dq", "arrivals", "met")
+
+    def __init__(self, ticks: int):
+        self.ticks = ticks
+        self._dq: deque[tuple[int, int]] = deque()
+        self.arrivals = 0
+        self.met = 0
+
+    def add(self, arrivals: int, met: int) -> None:
+        self._dq.append((arrivals, met))
+        self.arrivals += arrivals
+        self.met += met
+        if len(self._dq) > self.ticks:
+            oa, om = self._dq.popleft()
+            self.arrivals -= oa
+            self.met -= om
+
+    def burn(self, budget: float) -> float:
+        if self.arrivals <= 0:
+            return 0.0
+        return (1.0 - self.met / self.arrivals) / budget
+
+
+class BurnRateMonitor:
+    """Multi-window SLO burn rate over per-tick (arrivals, met) deltas.
+
+    burn(window) = (1 − met/arrivals over the window) / (1 − target).
+    Arrivals include sheds — see the module docstring for why the monitor
+    burns arrival-relative while /debug/slo stays served-relative. A
+    window with no arrivals reports burn 0 (an idle router is not burning
+    budget)."""
+
+    def __init__(self, cfg: TimelineConfig):
+        self.cfg = cfg
+        self._budget = max(1.0 - cfg.burn.target, 1e-6)
+        self._fast = _WindowSum(
+            max(1, int(cfg.burn.fast_window_s / cfg.tick_s)))
+        self._slow = _WindowSum(
+            max(1, int(cfg.burn.slow_window_s / cfg.tick_s)))
+
+    def add(self, arrivals: int, met: int) -> None:
+        self._fast.add(arrivals, met)
+        self._slow.add(arrivals, met)
+
+    def rates(self) -> tuple[float, float]:
+        return self._fast.burn(self._budget), self._slow.burn(self._budget)
+
+    def tripped(self, fast: float, slow: float) -> bool:
+        return (fast >= self.cfg.burn.fast_burn
+                and slow >= self.cfg.burn.slow_burn)
+
+
+# ---------------------------------------------------------------------------
+# Incident recorder.
+# ---------------------------------------------------------------------------
+
+class _RuleState:
+    __slots__ = ("incident", "active", "cooldown_until")
+
+    def __init__(self):
+        self.incident: dict[str, Any] | None = None
+        self.active = False
+        self.cooldown_until = 0.0
+
+
+class IncidentRecorder:
+    """Bounded incident ring with per-rule dedup/cooldown.
+
+    One rule, one live incident: while a rule keeps tripping on
+    consecutive evaluations the SAME incident updates in place (tick
+    count, last_unix, the post-trigger half of the ±N window); after it
+    clears, a re-trip inside ``cooldownS`` reopens it rather than minting
+    a new entry — a sustained overload is one incident, not four hundred."""
+
+    def __init__(self, cfg: TimelineConfig, *,
+                 slo_snapshot_fn: Callable[[], dict] | None = None,
+                 kv_snapshot_fn: Callable[[], dict] | None = None,
+                 decisions_fn: Callable[[int], list] | None = None,
+                 wall: Callable[[], float] = time.time):
+        self.cfg = cfg
+        self._wall = wall
+        self._slo_fn = slo_snapshot_fn
+        self._kv_fn = kv_snapshot_fn
+        self._decisions_fn = decisions_fn
+        self._ring: deque[dict[str, Any]] = deque(
+            maxlen=cfg.incident_capacity)
+        self._rules: dict[str, _RuleState] = {}
+        self._seq = 0
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    def observe(self, tripped: dict[str, str], sample: dict[str, Any],
+                context_fn: Callable[[], list[dict[str, Any]]]) -> None:
+        """Evaluate one tick's rule verdicts. ``tripped`` maps rule name →
+        human detail for rules firing THIS tick; rules absent from it
+        clear (starting their cooldown). ``context_fn`` lazily yields the
+        pre-trigger tail of the timeline ring (the −N half of the ±N
+        window) — lazy because copying the ring tail every quiet tick
+        would dominate the tick budget."""
+        now = self._wall()
+        for rule, detail in tripped.items():
+            st = self._rules.get(rule)
+            if st is None:
+                st = self._rules[rule] = _RuleState()
+            if st.active and st.incident is not None:
+                self._extend(st.incident, sample, now)
+            elif (st.incident is not None and now < st.cooldown_until
+                  and st.incident in self._ring):
+                # Re-trip inside the cooldown: the same episode flapping,
+                # not a new incident.
+                st.active = True
+                st.incident["retrips"] = st.incident.get("retrips", 0) + 1
+                self._extend(st.incident, sample, now)
+            else:
+                st.active = True
+                st.incident = self._open(rule, detail, sample,
+                                         context_fn(), now)
+        for rule, st in self._rules.items():
+            if rule not in tripped and st.active:
+                st.active = False
+                st.cooldown_until = now + self.cfg.cooldown_s
+                if st.incident is not None:
+                    st.incident["cleared_unix"] = now
+
+    def _open(self, rule: str, detail: str, sample: dict[str, Any],
+              context: list[dict[str, Any]], now: float) -> dict[str, Any]:
+        self._seq += 1
+        INCIDENTS_TOTAL.labels(rule).inc()
+        incident: dict[str, Any] = {
+            "id": f"inc-{self._seq}",
+            "rule": rule,
+            "detail": detail,
+            "first_unix": now,
+            "last_unix": now,
+            "ticks": 1,
+            "trigger": sample,
+            # Pre-trigger context plus the trigger tick; the post-trigger
+            # half fills in as the incident stays active (_extend), up to
+            # ±N total.
+            "window": list(context) + [sample],
+        }
+        if self._decisions_fn is not None:
+            incident["decisions"] = self._decisions_fn(
+                self.cfg.max_decisions)
+        if self._slo_fn is not None:
+            incident["slo"] = self._slo_fn()
+        if self._kv_fn is not None:
+            incident["kv"] = self._kv_fn()
+        self._ring.append(incident)
+        return incident
+
+    def _extend(self, incident: dict[str, Any], sample: dict[str, Any],
+                now: float) -> None:
+        incident["last_unix"] = now
+        incident["ticks"] += 1
+        window = incident["window"]
+        if len(window) < 2 * self.cfg.context_ticks + 1:
+            window.append(sample)
+
+    def snapshot(self) -> dict[str, Any]:
+        return {"count": len(self._ring),
+                "incidents": list(reversed(self._ring))}
+
+
+# ---------------------------------------------------------------------------
+# The sampler.
+# ---------------------------------------------------------------------------
+
+class _Baseline:
+    """Previous-tick counter values (delta computation)."""
+
+    __slots__ = ("requests", "met", "shed", "out_tokens",
+                 "good_tokens", "prompt_tokens", "degraded", "kv_stamps",
+                 "kv_joins", "gc_pause_s", "by_role")
+
+    def __init__(self):
+        self.requests = 0
+        self.met = 0
+        self.shed = 0
+        self.out_tokens = 0
+        self.good_tokens = 0
+        self.prompt_tokens = 0
+        self.degraded = 0
+        self.kv_stamps = 0
+        self.kv_joins = 0
+        self.gc_pause_s = 0.0
+        self.by_role: dict[str, tuple[int, int]] = {}
+
+
+class TimelineSampler:
+    """One bounded-ring telemetry history for this process.
+
+    All sources are read on the event loop (the same single-writer
+    discipline as the ledgers), so no locking. ``tick()`` is synchronous
+    and injectable-clock testable; ``start()`` runs it on a grid-aligned
+    asyncio task so fleet workers' buckets line up by wall clock."""
+
+    # Transfer pairs inlined per sample before folding to a summary (a
+    # 512-pair table copied 600 times would dominate ring memory); the
+    # fold is logged in the sample itself (pairs_truncated) — no silent
+    # caps.
+    MAX_SAMPLE_PAIRS = 16
+    # /proc self-telemetry cadence in ticks (see tick(): the open-FD walk
+    # is a real syscall cost, the signal drifts on a minutes scale).
+    PROC_SAMPLE_EVERY = 30
+
+    def __init__(self, cfg: TimelineConfig, *,
+                 slo_ledger: Any = None,
+                 kv_ledger: Any = None,
+                 datastore: Any = None,
+                 flow: Any = None,
+                 inflight_fn: Callable[[], int] | None = None,
+                 drain_rate_fn: Callable[[], float] | None = None,
+                 degraded_fn: Callable[[], int] | None = None,
+                 decisions_fn: Callable[[int], list] | None = None,
+                 divergence_fn: Callable[[], float] | None = None,
+                 wall: Callable[[], float] = time.time):
+        self.cfg = cfg
+        self.slo_ledger = slo_ledger
+        self.kv_ledger = kv_ledger
+        self.datastore = datastore
+        self.flow = flow
+        self.inflight_fn = inflight_fn
+        self.drain_rate_fn = drain_rate_fn
+        self.degraded_fn = degraded_fn
+        self.divergence_fn = divergence_fn
+        self._wall = wall
+        self.ring: deque[dict[str, Any]] = deque(maxlen=cfg.ring_capacity)
+        self.burn = BurnRateMonitor(cfg)
+        self.incidents = IncidentRecorder(
+            cfg,
+            slo_snapshot_fn=(slo_ledger.snapshot if slo_ledger is not None
+                             else None),
+            kv_snapshot_fn=(kv_ledger.snapshot if kv_ledger is not None
+                            else None),
+            decisions_fn=decisions_fn,
+            wall=wall)
+        self.gc_pause = GcPauseTracker()
+        self._prev = _Baseline()
+        self._task: asyncio.Task | None = None
+        self._last_tick_mono: float | None = None
+        # Label children resolved once: a .labels() call is a dict lookup
+        # under a lock, too slow for a path budgeted at <1% of the cycle
+        # floor.
+        self._burn_fast_g = SLO_BURN_RATE.labels("fast")
+        self._burn_slow_g = SLO_BURN_RATE.labels("slow")
+        self._tick_count = 0
+        self._proc_cache = (0, 0)  # (rss_bytes, open_fds)
+        # One bound context thunk instead of a fresh closure per tick.
+        self._context_fn = (
+            lambda: list(self.ring)[-self.cfg.context_ticks - 1:-1])
+
+    @property
+    def enabled(self) -> bool:
+        return self.cfg.enabled
+
+    # ---- lifecycle ------------------------------------------------------
+
+    def start(self) -> None:
+        if not self.cfg.enabled or self._task is not None:
+            return
+        self.gc_pause.start()
+        self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._task
+            self._task = None
+        self.gc_pause.stop()
+
+    async def _run(self) -> None:
+        tick = self.cfg.tick_s
+        try:
+            while True:
+                # Grid alignment: sleep to the NEXT multiple of tickS on
+                # the wall clock, so every fleet worker's samples land in
+                # the same wall-clock bucket (merge_timeline keys on
+                # round(t/tick)) without any cross-process coordination.
+                now = self._wall()
+                next_t = (int(now / tick) + 1) * tick
+                await asyncio.sleep(max(next_t - now, 0.0))
+                self.tick()
+        except asyncio.CancelledError:
+            pass
+
+    # ---- one tick -------------------------------------------------------
+
+    def tick(self, wall: float | None = None) -> dict[str, Any] | None:
+        """Collect one sample, append it to the ring, feed the burn-rate
+        monitor, and evaluate the incident rules. Kill-switch: one
+        attribute check."""
+        if not self.cfg.enabled:
+            return None
+        now = wall if wall is not None else self._wall()
+        mono = time.monotonic()
+        prev = self._prev
+        sample: dict[str, Any] = {"t_unix": now}
+
+        # Loop lag: the tick task slept toward a known wall-clock target;
+        # the overshoot past the grid IS the loop's scheduling stall at
+        # tick granularity (the LoopLagMonitor's heartbeat, reused free).
+        if self._last_tick_mono is not None:
+            gap = mono - self._last_tick_mono
+            sample["loop_lag_ms"] = round(
+                max(gap - self.cfg.tick_s, 0.0) * 1e3, 3)
+        self._last_tick_mono = mono
+
+        # Queue/backlog/drain (overload.py's inputs, historized).
+        if self.inflight_fn is not None:
+            sample["inflight"] = self.inflight_fn()
+        if self.flow is not None:
+            sample["queued"] = self.flow.queued_requests
+            sample["queued_by_band"] = self.flow.queued_by_band()
+        if self.drain_rate_fn is not None:
+            sample["drain_rate_rps"] = round(self.drain_rate_fn(), 4)
+
+        # SLO ledger deltas → rates (slo.py counters, read raw — calling
+        # snapshot() per tick would render the whole rollup).
+        arrivals = met = 0
+        led = self.slo_ledger
+        if led is not None:
+            t = led.totals
+            arrivals = t.requests - prev.requests
+            met = t.slo_met - prev.met
+            sample["requests"] = arrivals
+            sample["slo_met"] = met
+            sample["shed"] = t.shed - prev.shed
+            sample["output_tokens"] = t.output_tokens - prev.out_tokens
+            sample["goodput_tokens"] = (t.goodput_tokens
+                                        - prev.good_tokens)
+            prev.requests, prev.met, prev.shed = (t.requests, t.slo_met,
+                                                  t.shed)
+            prev.out_tokens, prev.good_tokens = (t.output_tokens,
+                                                 t.goodput_tokens)
+            served = arrivals - sample["shed"]
+            sample["attainment"] = (round(met / served, 4)
+                                    if served > 0 else None)
+            # Per-role prefill:decode token mix — the P/D rebalancer's
+            # controller input (ROADMAP item 5), as counter deltas.
+            d_prompt = led.prompt_tokens_total - prev.prompt_tokens
+            prev.prompt_tokens = led.prompt_tokens_total
+            by_role: dict[str, dict[str, int]] = {}
+            for role, (p_tot, c_tot) in led.tokens_by_role.items():
+                bp, bc = prev.by_role.get(role, (0, 0))
+                dp, dc = p_tot - bp, c_tot - bc
+                prev.by_role[role] = (p_tot, c_tot)
+                if dp or dc:
+                    by_role[role] = {"prompt": dp, "completion": dc}
+            d_completion = sample["output_tokens"]
+            mix: dict[str, Any] = {"prefill_tokens": d_prompt,
+                                   "decode_tokens": d_completion}
+            if d_prompt + d_completion > 0:
+                mix["prefill_fraction"] = round(
+                    d_prompt / (d_prompt + d_completion), 4)
+            if by_role:
+                mix["by_role"] = by_role
+            sample["token_mix"] = mix
+
+        if self.degraded_fn is not None:
+            d = self.degraded_fn()
+            sample["degraded"] = d - prev.degraded
+            prev.degraded = d
+
+        # KV ledger: stamp/join deltas + the pool-level measured-reuse
+        # EWMAs (per-pod rows are in /debug/kv; the timeline keeps the
+        # pool series bounded).
+        kv = self.kv_ledger
+        if kv is not None and kv.enabled:
+            row: dict[str, Any] = {
+                "stamps": kv.stamps - prev.kv_stamps,
+                "joins": kv.joins - prev.kv_joins,
+            }
+            prev.kv_stamps, prev.kv_joins = kv.stamps, kv.joins
+            overall = kv.table.overall()
+            if overall.ewma_hit_ratio is not None:
+                row["ewma_hit_ratio"] = round(overall.ewma_hit_ratio, 4)
+            if overall.ewma_signed_error is not None:
+                row["ewma_signed_error"] = round(
+                    overall.ewma_signed_error, 4)
+            sample["kv"] = row
+
+        # Transfer-pair EWMAs (datalayer TransferTable): inline while the
+        # table is small, fold to a summary when it is not.
+        ds = self.datastore
+        if ds is not None:
+            table = ds.transfers
+            n_pairs = len(table)
+            if n_pairs:
+                if n_pairs <= self.MAX_SAMPLE_PAIRS:
+                    sample["transfers"] = {
+                        f"{p}->{d}": round(s.ewma_pull_ms, 3)
+                        for (p, d), s in table._pairs.items()
+                        if s.ewma_pull_ms is not None}
+                else:
+                    pulls = [s.ewma_pull_ms
+                             for s in table._pairs.values()
+                             if s.ewma_pull_ms is not None]
+                    sample["transfers"] = {
+                        "pairs": n_pairs,
+                        "pairs_truncated": True,
+                        "ewma_pull_ms_min": round(min(pulls), 3)
+                        if pulls else None,
+                        "ewma_pull_ms_max": round(max(pulls), 3)
+                        if pulls else None,
+                    }
+            sample["snapshot_epoch"] = ds.snapshot_epoch
+
+        if self.divergence_fn is not None:
+            sample["kv_index_divergence"] = self.divergence_fn()
+
+        # Process self-telemetry (gauges + the timeline series). The /proc
+        # reads are real syscalls (~15-25µs together), so they run every
+        # PROC_SAMPLE_EVERY ticks and the cached values ride the ticks in
+        # between — RSS/FD drift is a minutes-scale signal, the tick
+        # budget is microseconds. GC pause accumulates per tick regardless
+        # (reading the tracker's float is free).
+        if self._tick_count % self.PROC_SAMPLE_EVERY == 0:
+            rss, fds = rss_bytes(), open_fds()
+            self._proc_cache = (rss, fds)
+            PROCESS_RSS_BYTES.set(rss)
+            PROCESS_OPEN_FDS.set(fds)
+        else:
+            rss, fds = self._proc_cache
+        self._tick_count += 1
+        pause = self.gc_pause.pause_s_total
+        d_pause = pause - prev.gc_pause_s
+        prev.gc_pause_s = pause
+        if d_pause > 0:
+            GC_PAUSE_SECONDS.inc(d_pause)
+        sample["process"] = {"rss_bytes": rss, "open_fds": fds,
+                             "gc_pause_ms": round(d_pause * 1e3, 3)}
+
+        # Burn rate (fed BEFORE rule evaluation so the trip sees the tick
+        # that crossed the threshold).
+        self.burn.add(arrivals, met)
+        fast, slow = self.burn.rates()
+        sample["burn"] = {"fast": round(fast, 3), "slow": round(slow, 3)}
+        self._burn_fast_g.set(fast)
+        self._burn_slow_g.set(slow)
+
+        self.ring.append(sample)
+        TIMELINE_TICKS.inc()
+        self._evaluate_rules(sample, fast, slow)
+        return sample
+
+    def _evaluate_rules(self, sample: dict[str, Any], fast: float,
+                        slow: float) -> None:
+        """Build the tick's tripped-rule map and hand it to the incident
+        recorder (which owns dedup/cooldown)."""
+        cfg = self.cfg
+        tripped: dict[str, str] = {}
+        if self.burn.tripped(fast, slow):
+            tripped[RULE_BURN_RATE] = (
+                f"burn rate fast={fast:.2f} (>= {cfg.burn.fast_burn}) and "
+                f"slow={slow:.2f} (>= {cfg.burn.slow_burn}) over target "
+                f"{cfg.burn.target}")
+        shed = sample.get("shed", 0)
+        if cfg.shed_rate_max > 0 and shed / cfg.tick_s > cfg.shed_rate_max:
+            tripped[RULE_SHED_RATE] = (
+                f"shed rate {shed / cfg.tick_s:.2f}/s > "
+                f"{cfg.shed_rate_max}/s")
+        if (cfg.drain_min_rps > 0 and sample.get("queued", 0) > 0
+                and sample.get("drain_rate_rps", 0.0) < cfg.drain_min_rps):
+            tripped[RULE_DRAIN_COLLAPSE] = (
+                f"{sample['queued']} queued with drain "
+                f"{sample.get('drain_rate_rps', 0.0):.3f} rps < "
+                f"{cfg.drain_min_rps}")
+        div = sample.get("kv_index_divergence")
+        if cfg.divergence_max > 0 and div is not None \
+                and div > cfg.divergence_max:
+            tripped[RULE_DIVERGENCE] = (
+                f"kv index divergence {div:.4f} > {cfg.divergence_max}")
+        # The context tail copy is deferred into the recorder: it only
+        # materializes when an incident actually OPENS (excluding the
+        # trigger tick itself, which the recorder appends).
+        self.incidents.observe(tripped, sample, self._context_fn)
+
+    # ---- render ---------------------------------------------------------
+
+    def snapshot(self, *, window_s: float | None = None) -> dict[str, Any]:
+        """The /debug/timeline payload: raw ticks plus windowed aggregates
+        (p50/p99/min/max and rate of change per numeric series) over the
+        requested window (default: the whole retained ring)."""
+        cfg = self.cfg
+        samples = list(self.ring)
+        if window_s is not None and samples:
+            cutoff = samples[-1]["t_unix"] - window_s
+            samples = [s for s in samples if s["t_unix"] >= cutoff]
+        doc: dict[str, Any] = {
+            "enabled": cfg.enabled,
+            "tick_s": cfg.tick_s,
+            "retention_s": cfg.retention_s,
+            "ticks": len(samples),
+            "samples": samples,
+            "aggregates": _aggregates(samples),
+            "incident_count": len(self.incidents),
+        }
+        if samples:
+            fast, slow = self.burn.rates()
+            doc["burn"] = {"fast": round(fast, 3), "slow": round(slow, 3),
+                           "target": cfg.burn.target}
+        return doc
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(int(q * (len(sorted_vals) - 1) + 0.5), len(sorted_vals) - 1)
+    return sorted_vals[idx]
+
+
+def _aggregates(samples: list[dict[str, Any]]) -> dict[str, Any]:
+    """Windowed aggregates over every top-level numeric series: n, min,
+    max, p50, p99, and rate of change (last − first over the window's
+    span). Computed at render time — the per-tick path never pays for
+    them."""
+    if len(samples) < 2:
+        return {}
+    series: dict[str, list[tuple[float, float]]] = {}
+    for s in samples:
+        t = s["t_unix"]
+        for k, v in s.items():
+            if k != "t_unix" and isinstance(v, (int, float)) \
+                    and not isinstance(v, bool):
+                series.setdefault(k, []).append((t, float(v)))
+    out: dict[str, Any] = {}
+    for k, pts in series.items():
+        if len(pts) < 2:
+            continue
+        vals = sorted(v for _, v in pts)
+        span = pts[-1][0] - pts[0][0]
+        out[k] = {
+            "n": len(vals),
+            "min": round(vals[0], 4),
+            "max": round(vals[-1], 4),
+            "p50": round(_percentile(vals, 0.5), 4),
+            "p99": round(_percentile(vals, 0.99), 4),
+            "rate_per_s": (round((pts[-1][1] - pts[0][1]) / span, 4)
+                           if span > 0 else None),
+        }
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Fleet fan-in: merge per-worker rings by wall-clock bucket.
+# ---------------------------------------------------------------------------
+
+def merge_timeline(docs: list[tuple[int, dict[str, Any]]], *,
+                   workers: int,
+                   supervisor: list[dict[str, Any]] | None = None
+                   ) -> dict[str, Any]:
+    """Merge N workers' /debug/timeline payloads into one wall-clock
+    bucketed view. Ticks are grid-aligned in every worker, so the bucket
+    index round(t/tick) names the same wall second everywhere. A bucket a
+    shard did not report is a GAP — marked, never interpolated (the
+    monotonic-merge precedent: inventing samples for a dead shard would
+    hide exactly the outage the timeline exists to show). A worker that
+    restarts loses its pre-restart ring, so the merged view honestly shows
+    its whole down-and-before window as gaps for that shard."""
+    tick_s = next((d.get("tick_s") for _, d in docs if d.get("tick_s")),
+                  1.0)
+    enabled = any(d.get("enabled") for _, d in docs)
+    buckets: dict[int, dict[str, Any]] = {}
+    responding = {shard for shard, _ in docs}
+    # Two of one shard's ticks can round into the same bucket (a stalled
+    # loop firing late, then the next tick on time). Keep the sample
+    # closest to the bucket center and COUNT the displaced one — losing a
+    # sample silently would read as "covered" when it wasn't, and
+    # overwriting blindly could leave the previous bucket a false gap for
+    # a shard that was up.
+    collapsed: dict[str, int] = {}
+    for shard, doc in docs:
+        key = str(shard)
+        for s in doc.get("samples") or []:
+            b = int(round(s["t_unix"] / tick_s))
+            row = buckets.get(b)
+            if row is None:
+                row = buckets[b] = {"t_unix": round(b * tick_s, 3),
+                                    "shards": {}}
+            existing = row["shards"].get(key)
+            if existing is None:
+                row["shards"][key] = s
+            else:
+                center = row["t_unix"]
+                if (abs(s["t_unix"] - center)
+                        < abs(existing["t_unix"] - center)):
+                    row["shards"][key] = s
+                collapsed[key] = collapsed.get(key, 0) + 1
+    all_shards = set(range(workers))
+    merged = []
+    for b in sorted(buckets):
+        row = buckets[b]
+        missing = sorted(all_shards
+                         - {int(k) for k in row["shards"]})
+        if missing:
+            row["gaps"] = missing
+        merged.append(row)
+    out: dict[str, Any] = {
+        "workers": workers,
+        "responding": sorted(responding),
+        "enabled": enabled,
+        "tick_s": tick_s,
+        "buckets": merged,
+        "gap_buckets": sum(1 for r in merged if r.get("gaps")),
+    }
+    if collapsed:
+        out["collapsed_samples"] = collapsed
+    if supervisor:
+        out["supervisor"] = supervisor
+    return out

@@ -30,10 +30,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 GW_PORT, ADMIN_PORT = 18770, 18771
 
-# /debug/profile blocks for ?seconds=N wall-clock; drive it with an invalid
-# value so it answers immediately — the JSON 400 error is exactly the
-# "answers JSON" contract this lint checks.
-QUERY_OVERRIDES = {"/debug/profile": "?seconds=0"}
+# /debug/profile blocks for ?seconds=N wall-clock: drive the REAL path
+# with a short window and the structured output (?format=json) so CI
+# exercises the profiler capture + row rendering, not just the 400
+# branch a `?seconds=0` probe used to hit.
+QUERY_OVERRIDES = {"/debug/profile": "?seconds=0.1&format=json"}
 
 CFG = """
 pool:

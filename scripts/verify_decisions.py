@@ -183,11 +183,14 @@ def _check_classifier_block(handle, recorder) -> list[str]:
          "prefill": SchedulerProfile("prefill", [prefill_f], [], _picker())},
         handler)
     endpoints = _endpoints()  # roles: decode, prefill, encode, both, ""
-    # Warm decode candidates (the decode filter keeps decode + both; the
-    # picker may choose either): the classifier must see a reuse
-    # prediction on whichever pod wins.
+    # Warm EVERY decode-capable candidate (the decode filter keeps decode,
+    # both, AND unlabeled pods — DecodeFilter.MATCH_UNLABELED; the
+    # scorerless profile tie-breaks by RNG): the classifier must see a
+    # reuse prediction on whichever pod wins, or the check flakes with the
+    # global RNG's draw order.
     for ep in endpoints:
-        if ep.metadata.labels.get("llm-d.ai/role") in ("decode", "both"):
+        if ep.metadata.labels.get("llm-d.ai/role") in ("decode", "both",
+                                                       None, ""):
             ep.attributes.put(PREFIX_ATTRIBUTE_KEY,
                               PrefixCacheMatchInfo(7, 8, 16))
     rec = recorder.start("vd-classifier", "tiny")

@@ -75,6 +75,16 @@ REQUIRED_FAMILIES = {
     # skipped P/D hops the classifier routed straight to the decode pod.
     ("router_pd_classifier_decisions", "router"),
     ("router_pd_hop_skipped", "router"),
+    # Fleet flight recorder (ISSUE 12): the timeline sampler's liveness
+    # tick, the multi-window burn-rate gauge, triggered incident counts,
+    # process self-telemetry, and the effective-config info gauge.
+    ("router_timeline_ticks", "router"),
+    ("router_slo_burn_rate", "router"),
+    ("router_incidents", "router"),
+    ("router_process_rss_bytes", "router"),
+    ("router_process_open_fds", "router"),
+    ("router_gc_pause_seconds", "router"),
+    ("router_config_info", "router"),
     # Multi-process sharded fleet (ISSUE 9): per-worker snapshot epoch and
     # the supervisor's shard-labeled liveness/request/epoch families.
     ("router_snapshot_epoch", "router"),

@@ -1,0 +1,523 @@
+"""Fleet flight recorder (ISSUE 12, router/timeline.py).
+
+Hermetic tiers: pure units (ring bounds, bucket alignment, burn-rate
+windows, incident dedup/cooldown, config redaction, the fleet bucket
+merge), one real gateway driving /debug/timeline + /debug/incidents +
+/debug/config (+ the kill-switch contract), and the FleetAdmin fan-in
+against stub workers (gap-marked merge, traces fan-in, config-skew
+check)."""
+
+import asyncio
+import json
+import os
+import sys
+
+import httpx
+import pytest
+from aiohttp import web
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts"))
+
+from llm_d_inference_scheduler_tpu.router.datalayer.datastore import Datastore
+from llm_d_inference_scheduler_tpu.router.kvobs import CacheLedger, KvObsConfig
+from llm_d_inference_scheduler_tpu.router.slo import SloConfig, SloLedger
+from llm_d_inference_scheduler_tpu.router.timeline import (
+    RULE_BURN_RATE,
+    RULE_SHED_RATE,
+    BurnRateMonitor,
+    IncidentRecorder,
+    TimelineConfig,
+    TimelineSampler,
+    config_hash,
+    merge_timeline,
+    redact_config,
+)
+
+GW_A, GW_B = 19170, 19171
+STUB_A, STUB_B, STUB_ADMIN = 19180, 19181, 19182
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def _sampler(cfg: TimelineConfig, **kw) -> TimelineSampler:
+    return TimelineSampler(cfg, **kw)
+
+
+# ---- config -------------------------------------------------------------
+
+def test_config_defaults_and_validation():
+    cfg = TimelineConfig.from_spec(None)
+    assert cfg.enabled and cfg.tick_s == 1.0 and cfg.retention_s == 600.0
+    assert cfg.ring_capacity == 600
+    cfg = TimelineConfig.from_spec(
+        {"tickS": 0.5, "retentionS": 30,
+         "burnRate": {"target": 0.99, "fastWindowS": 5, "slowWindowS": 60},
+         "rules": {"shedRateMax": 2.5},
+         "incidents": {"capacity": 8, "cooldownS": 7}})
+    assert cfg.ring_capacity == 60
+    assert cfg.burn.target == 0.99
+    assert cfg.shed_rate_max == 2.5
+    assert cfg.incident_capacity == 8 and cfg.cooldown_s == 7.0
+    with pytest.raises(ValueError):
+        TimelineConfig.from_spec({"tickS": 0})
+    with pytest.raises(ValueError):
+        TimelineConfig.from_spec({"burnRate": {"target": 1.5}})
+    with pytest.raises(ValueError):
+        TimelineConfig.from_spec(
+            {"burnRate": {"fastWindowS": 60, "slowWindowS": 5}})
+
+
+# ---- ring bounds + bucket alignment ------------------------------------
+
+def test_ring_bounds_and_killswitch():
+    cfg = TimelineConfig.from_spec({"tickS": 1.0, "retentionS": 5})
+    s = _sampler(cfg)
+    for i in range(50):
+        s.tick(wall=1000.0 + i)
+    assert len(s.ring) == 5  # retentionS / tickS, older ticks evicted
+    assert [x["t_unix"] for x in s.ring] == [1045.0, 1046.0, 1047.0,
+                                             1048.0, 1049.0]
+    # Kill-switch: tick() is inert, snapshot still answers.
+    off = _sampler(TimelineConfig.from_spec({"enabled": False}))
+    assert off.tick(wall=1.0) is None
+    assert len(off.ring) == 0
+    snap = off.snapshot()
+    assert snap["enabled"] is False and snap["ticks"] == 0
+
+
+def test_grid_alignment_shared_across_processes():
+    """Two samplers ticking on the same wall grid land in the same
+    merge_timeline bucket — the property that makes the fleet merge a
+    pure function of wall time, no cross-process coordination."""
+    cfg = TimelineConfig.from_spec({"tickS": 0.5, "retentionS": 10})
+    a, b = _sampler(cfg), _sampler(cfg)
+    for i in range(4):
+        t = 2000.0 + i * 0.5
+        a.tick(wall=t)
+        b.tick(wall=t + 0.01)  # scheduling jitter inside the same bucket
+    merged = merge_timeline(
+        [(0, a.snapshot()), (1, b.snapshot())], workers=2)
+    assert len(merged["buckets"]) == 4
+    assert all(set(r["shards"]) == {"0", "1"} for r in merged["buckets"])
+    assert merged["gap_buckets"] == 0
+
+
+def test_snapshot_window_and_aggregates():
+    cfg = TimelineConfig.from_spec({"tickS": 1.0, "retentionS": 60})
+    s = _sampler(cfg, inflight_fn=iter(range(100)).__next__)
+    for i in range(20):
+        s.tick(wall=3000.0 + i)
+    snap = s.snapshot(window_s=5.0)
+    assert snap["ticks"] == 6  # samples inside the trailing 5 s
+    agg = snap["aggregates"]["inflight"]
+    assert agg["min"] == 14 and agg["max"] == 19
+    assert agg["rate_per_s"] == 1.0  # inflight_fn advances 1/tick
+    assert "p99" in agg and "p50" in agg
+
+
+# ---- burn-rate windows --------------------------------------------------
+
+def test_burn_rate_fast_and_slow_windows():
+    cfg = TimelineConfig.from_spec(
+        {"tickS": 1.0,
+         "burnRate": {"target": 0.9, "fastWindowS": 2, "slowWindowS": 10,
+                      "fastBurn": 4.0, "slowBurn": 2.0}})
+    mon = BurnRateMonitor(cfg)
+    # Healthy traffic: 10 arrivals/tick, 10 met → burn 0 everywhere.
+    for _ in range(10):
+        mon.add(10, 10)
+    assert mon.rates() == (0.0, 0.0)
+    # Total outage for 2 ticks: the FAST window sees 100% miss (burn 10 =
+    # 1.0/0.1); the slow window still holds 8 healthy ticks so it lags.
+    mon.add(10, 0)
+    mon.add(10, 0)
+    fast, slow = mon.rates()
+    assert fast == pytest.approx(10.0)
+    assert slow == pytest.approx((20 / 100) / 0.1)  # 2 bad of 10 ticks
+    # Trip requires BOTH: a 2-tick blip does not confirm on the slow
+    # window (slow 2.0 is exactly at threshold → tripped, so check the
+    # one-tick case instead).
+    assert mon.tripped(10.0, 1.0) is False
+    assert mon.tripped(10.0, 2.0) is True
+    # Idle window: no arrivals → burn 0, not NaN/latch.
+    empty = BurnRateMonitor(cfg)
+    assert empty.rates() == (0.0, 0.0)
+
+
+def test_burn_counts_sheds_as_budget_burn():
+    """Arrival-relative by design: a shed request burns the user-facing
+    goodput budget even though /debug/slo's served-relative attainment
+    excludes it."""
+    cfg = TimelineConfig.from_spec(
+        {"tickS": 1.0, "burnRate": {"target": 0.9, "fastWindowS": 1,
+                                    "slowWindowS": 1}})
+    mon = BurnRateMonitor(cfg)
+    mon.add(10, 5)  # 5 met, 5 shed (none "missed" in ledger terms)
+    fast, _ = mon.rates()
+    assert fast == pytest.approx(5.0)
+
+
+# ---- incident trigger / dedup / cooldown --------------------------------
+
+def _mk_recorder(cfg, clock):
+    return IncidentRecorder(cfg, slo_snapshot_fn=lambda: {"slo": 1},
+                            kv_snapshot_fn=lambda: {"kv": 1},
+                            decisions_fn=lambda k: [{"d": i}
+                                                    for i in range(k)],
+                            wall=clock)
+
+
+def test_incident_trigger_dedup_and_cooldown():
+    t = [5000.0]
+    cfg = TimelineConfig.from_spec(
+        {"incidents": {"capacity": 4, "contextTicks": 2, "cooldownS": 30,
+                       "maxDecisions": 3}})
+    rec = _mk_recorder(cfg, lambda: t[0])
+
+    def obs(tripped, sample, ctx=()):
+        rec.observe(tripped, sample, lambda: list(ctx))
+        t[0] += 1.0
+
+    # Trip sustained over 5 ticks → ONE incident with ticks=5 and the
+    # context + trigger + post-trigger samples in the window (± N bound).
+    ctx = [{"t_unix": 1}, {"t_unix": 2}]
+    obs({RULE_BURN_RATE: "hot"}, {"t_unix": 3}, ctx)
+    for i in range(4):
+        obs({RULE_BURN_RATE: "hot"}, {"t_unix": 4 + i})
+    snap = rec.snapshot()
+    assert snap["count"] == 1
+    inc = snap["incidents"][0]
+    assert inc["ticks"] == 5
+    assert inc["rule"] == RULE_BURN_RATE
+    # window = 2 pre-trigger + trigger + post-trigger ticks, ≤ 2N+1 = 5.
+    assert [w["t_unix"] for w in inc["window"]] == [1, 2, 3, 4, 5]
+    assert inc["slo"] == {"slo": 1} and inc["kv"] == {"kv": 1}
+    assert len(inc["decisions"]) == 3
+    # Clear, then re-trip INSIDE the cooldown: same incident, retrip
+    # counted, not a new ring entry.
+    obs({}, {"t_unix": 9})
+    assert "cleared_unix" in rec.snapshot()["incidents"][0]
+    obs({RULE_BURN_RATE: "hot again"}, {"t_unix": 10})
+    snap = rec.snapshot()
+    assert snap["count"] == 1
+    assert snap["incidents"][0]["retrips"] == 1
+    # Clear, jump PAST the cooldown: a fresh trip mints a new incident.
+    obs({}, {"t_unix": 11})
+    t[0] += 100.0
+    obs({RULE_BURN_RATE: "new episode"}, {"t_unix": 12})
+    snap = rec.snapshot()
+    assert snap["count"] == 2
+    assert snap["incidents"][0]["id"] != snap["incidents"][1]["id"]
+
+
+def test_incident_rules_independent_and_ring_bounded():
+    t = [6000.0]
+    cfg = TimelineConfig.from_spec(
+        {"incidents": {"capacity": 3, "cooldownS": 0.0}})
+    rec = _mk_recorder(cfg, lambda: t[0])
+    # Two different rules tripping the same tick → two incidents.
+    rec.observe({RULE_BURN_RATE: "a", RULE_SHED_RATE: "b"},
+                {"t_unix": 1}, list)
+    assert rec.snapshot()["count"] == 2
+    # Flapping one rule past the (zero) cooldown floods… into the bounded
+    # ring.
+    for i in range(10):
+        t[0] += 1.0
+        rec.observe({}, {"t_unix": 2 + i}, list)
+        t[0] += 1.0
+        rec.observe({RULE_SHED_RATE: "flap"}, {"t_unix": 2 + i}, list)
+    assert rec.snapshot()["count"] == 3  # capacity bound holds
+
+
+# ---- sampler end-to-end over wired sources ------------------------------
+
+def test_sampler_signals_and_shed_rule():
+    ledger = SloLedger(SloConfig())
+    ds = Datastore()
+    kv = CacheLedger(KvObsConfig(enabled=True), datastore=ds)
+    cfg = TimelineConfig.from_spec(
+        {"tickS": 1.0, "retentionS": 60,
+         "rules": {"shedRateMax": 2.0},
+         # Burn thresholds out of reach: this test isolates the shed rule
+         # (a shed spike inherently burns arrival-relative budget too).
+         "burnRate": {"fastBurn": 1e9, "slowBurn": 1e9},
+         "incidents": {"cooldownS": 300}})
+    s = _sampler(cfg, slo_ledger=ledger, kv_ledger=kv, datastore=ds,
+                 inflight_fn=lambda: 4, drain_rate_fn=lambda: 9.5,
+                 degraded_fn=lambda: 2)
+    ledger._totals.requests = 10
+    ledger._totals.slo_met = 8
+    ledger._totals.output_tokens = 100
+    ledger._totals.goodput_tokens = 90
+    ledger.prompt_tokens_total = 50
+    ledger.tokens_by_role = {"decode": (50, 100)}
+    sample = s.tick(wall=7000.0)
+    assert sample["requests"] == 10 and sample["slo_met"] == 8
+    assert sample["attainment"] == 0.8
+    assert sample["inflight"] == 4
+    assert sample["drain_rate_rps"] == 9.5
+    assert sample["degraded"] == 2
+    assert sample["token_mix"] == {
+        "prefill_tokens": 50, "decode_tokens": 100,
+        "prefill_fraction": round(50 / 150, 4),
+        "by_role": {"decode": {"prompt": 50, "completion": 100}}}
+    assert sample["kv"] == {"stamps": 0, "joins": 0}
+    assert sample["process"]["rss_bytes"] > 0
+    # Deltas reset: an idle second tick reports zeros, not cumulative.
+    sample2 = s.tick(wall=7001.0)
+    assert sample2["requests"] == 0 and sample2["token_mix"][
+        "prefill_tokens"] == 0
+    # Shed-rate excursion trips the rule into an incident.
+    ledger._totals.requests = 20
+    ledger._totals.shed = 8
+    s.tick(wall=7002.0)
+    snap = s.incidents.snapshot()
+    assert snap["count"] == 1
+    assert snap["incidents"][0]["rule"] == RULE_SHED_RATE
+    assert snap["incidents"][0]["trigger"]["shed"] == 8
+
+
+# ---- fleet merge: gaps marked, no interpolation -------------------------
+
+def test_merge_timeline_marks_gaps():
+    tick = 1.0
+
+    def doc(ts):
+        return {"enabled": True, "tick_s": tick,
+                "samples": [{"t_unix": t, "inflight": 1} for t in ts]}
+
+    # Shard 1 missing the middle two buckets (down), and shard 2 never
+    # responded at all (not in docs) — every bucket gap-marks it.
+    merged = merge_timeline(
+        [(0, doc([100.0, 101.0, 102.0, 103.0])),
+         (1, doc([100.0, 103.0]))],
+        workers=3)
+    assert merged["workers"] == 3 and merged["responding"] == [0, 1]
+    gaps = {r["t_unix"]: r.get("gaps") for r in merged["buckets"]}
+    assert gaps == {100.0: [2], 101.0: [1, 2], 102.0: [1, 2],
+                    103.0: [2]}
+    assert merged["gap_buckets"] == 4
+    # No interpolation: absent means absent.
+    mid = [r for r in merged["buckets"] if r["t_unix"] == 101.0][0]
+    assert "1" not in mid["shards"]
+    # Supervisor series rides beside the worker buckets.
+    sup = [{"t_unix": 101.0, "kv_index_divergence_max": 0.4}]
+    merged = merge_timeline([(0, doc([100.0]))], workers=1, supervisor=sup)
+    assert merged["supervisor"] == sup
+    # Bucket collision (a stalled loop's late tick rounding into the next
+    # tick's bucket): the closest-to-center sample wins and the displaced
+    # one is COUNTED, not silently dropped.
+    merged = merge_timeline([(0, doc([100.0, 100.6, 101.0]))], workers=1)
+    assert [r["t_unix"] for r in merged["buckets"]] == [100.0, 101.0]
+    assert merged["buckets"][1]["shards"]["0"]["t_unix"] == 101.0
+    assert merged["collapsed_samples"] == {"0": 1}
+    assert merged["gap_buckets"] == 0
+
+
+# ---- config redaction + hash -------------------------------------------
+
+def test_redact_config_and_hash():
+    doc = {
+        "tlsClient": {"caCertPath": "/etc/certs/ca.pem",
+                      "insecureSkipVerify": False},
+        "kube": {"tokenPath": "/var/run/secrets/token"},
+        "watchPath": "/opt/router/config.yaml",
+        "pool": {"endpoints": [{"address": "10.0.0.1", "port": 8200}]},
+        "scheduling": {"pickSeed": 7},
+    }
+    red = redact_config(doc)
+    flat = json.dumps(red)
+    assert "/etc/certs" not in flat and "/var/run" not in flat
+    assert "/opt/router" not in flat
+    assert red["tlsClient"]["caCertPath"] == "***"       # secret fragment
+    assert red["watchPath"] == "***/config.yaml"         # path: basename kept
+    assert red["scheduling"]["pickSeed"] == 7            # knobs untouched
+    assert red["pool"]["endpoints"][0]["address"] == "10.0.0.1"
+    # The hash covers the UNREDACTED doc: secret-only differences must
+    # change it (fleet skew detection), and it is stable across calls.
+    other = json.loads(json.dumps(doc))
+    other["kube"]["tokenPath"] = "/var/run/secrets/other"
+    assert config_hash(doc) == config_hash(json.loads(json.dumps(doc)))
+    assert config_hash(doc) != config_hash(other)
+    assert redact_config(red) == red  # idempotent
+
+
+# ---- gateway e2e: routes + kill-switch ---------------------------------
+
+GW_CFG = """
+pool:
+  endpoints: []
+timeline:
+  tickS: 0.05
+  retentionS: 10
+slo: {defaultTtftMs: 100}
+"""
+
+KILL_CFG = """
+pool:
+  endpoints: []
+timeline: {enabled: false}
+"""
+
+
+def test_gateway_timeline_surfaces():
+    from llm_d_inference_scheduler_tpu.router.gateway import build_gateway
+
+    async def body():
+        gw = build_gateway(GW_CFG, port=GW_A, poll_interval=60.0)
+        await gw.start()
+        try:
+            await asyncio.sleep(0.4)
+            async with httpx.AsyncClient(timeout=10) as c:
+                base = f"http://127.0.0.1:{GW_A}"
+                tl = (await c.get(base + "/debug/timeline")).json()
+                assert tl["enabled"] and tl["ticks"] >= 3
+                assert tl["tick_s"] == 0.05
+                last = tl["samples"][-1]
+                assert "process" in last and "burn" in last
+                assert "snapshot_epoch" in last
+                # Windowed view trims; aggregates render.
+                tl2 = (await c.get(
+                    base + "/debug/timeline?window_s=0.1")).json()
+                assert tl2["ticks"] <= tl["ticks"]
+                inc = (await c.get(base + "/debug/incidents")).json()
+                assert inc == {"enabled": True, "count": 0,
+                               "incidents": []}
+                cfgdoc = (await c.get(base + "/debug/config")).json()
+                assert cfgdoc["hash"] == gw.config_hash
+                assert cfgdoc["config"]["timeline"]["tickS"] == 0.05
+                # /debug/profile structured output (the verify-debug probe
+                # drives this same real path).
+                prof = (await c.get(
+                    base + "/debug/profile?seconds=0.05&format=json&n=5"
+                )).json()
+                assert prof["seconds"] == 0.05
+                assert 0 < len(prof["rows"]) <= 5
+                assert {"function", "ncalls",
+                        "cumtime_s"} <= set(prof["rows"][0])
+        finally:
+            await gw.stop()
+
+    run(body())
+
+
+def test_gateway_timeline_killswitch_inert():
+    from llm_d_inference_scheduler_tpu.router.gateway import build_gateway
+
+    async def body():
+        gw = build_gateway(KILL_CFG, port=GW_B, poll_interval=60.0)
+        await gw.start()
+        try:
+            await asyncio.sleep(0.15)
+            # No sampler task, no gc callback installed, empty ring — and
+            # the surfaces still answer JSON.
+            assert gw.timeline._task is None
+            assert not gw.timeline.gc_pause._installed
+            async with httpx.AsyncClient(timeout=10) as c:
+                base = f"http://127.0.0.1:{GW_B}"
+                tl = (await c.get(base + "/debug/timeline")).json()
+                assert tl["enabled"] is False and tl["ticks"] == 0
+                inc = (await c.get(base + "/debug/incidents")).json()
+                assert inc["enabled"] is False and inc["count"] == 0
+        finally:
+            await gw.stop()
+
+    run(body())
+
+
+# ---- fleet admin fan-in against stub workers ----------------------------
+
+def _stub(port, *, samples, spans, cfg_hash):
+    app = web.Application()
+
+    async def timeline(request):
+        return web.json_response({"enabled": True, "tick_s": 1.0,
+                                  "samples": samples})
+
+    async def incidents(request):
+        return web.json_response(
+            {"enabled": True, "count": 1,
+             "incidents": [{"id": f"inc-{port}", "rule": "burn_rate",
+                            "first_unix": port}]})
+
+    async def config(request):
+        return web.json_response({"hash": cfg_hash, "config": {"p": port}})
+
+    async def traces(request):
+        return web.json_response({"spans": spans})
+
+    app.add_routes([web.get("/debug/timeline", timeline),
+                    web.get("/debug/incidents", incidents),
+                    web.get("/debug/config", config),
+                    web.get("/debug/traces", traces)])
+    return app, port
+
+
+def test_fleet_admin_timeline_incidents_config_traces():
+    from llm_d_inference_scheduler_tpu.router.fleet import FleetAdmin
+
+    async def body():
+        shared = {"span_id": "s-shared", "name": "gateway.request"}
+        runners = []
+        for app, port in (
+                _stub(STUB_A, samples=[{"t_unix": 100.0, "inflight": 1},
+                                       {"t_unix": 101.0, "inflight": 1}],
+                      spans=[shared, {"span_id": "s-a", "name": "a"}],
+                      cfg_hash="h1"),
+                _stub(STUB_B, samples=[{"t_unix": 100.0, "inflight": 2}],
+                      spans=[shared, {"span_id": "s-b", "name": "b"}],
+                      cfg_hash="h2")):
+            runner = web.AppRunner(app)
+            await runner.setup()
+            await web.TCPSite(runner, "127.0.0.1", port).start()
+            runners.append(runner)
+        admin = FleetAdmin([("127.0.0.1", STUB_A), ("127.0.0.1", STUB_B)],
+                           host="127.0.0.1", port=STUB_ADMIN)
+        await admin.start()
+        try:
+            async with httpx.AsyncClient(timeout=10) as c:
+                base = f"http://127.0.0.1:{STUB_ADMIN}"
+                # Merged timeline: bucket 100 has both shards, bucket 101
+                # gap-marks shard 1 (down — no interpolation).
+                tl = (await c.get(base + "/debug/timeline")).json()
+                assert tl["workers"] == 2
+                by_t = {r["t_unix"]: r for r in tl["buckets"]}
+                assert set(by_t[100.0]["shards"]) == {"0", "1"}
+                assert by_t[101.0].get("gaps") == [1]
+                assert tl["gap_buckets"] == 1
+                # Incidents: shard-annotated union, newest first.
+                inc = (await c.get(base + "/debug/incidents")).json()
+                assert inc["count"] == 2
+                assert {i["shard"] for i in inc["incidents"]} == {0, 1}
+                firsts = [i["first_unix"] for i in inc["incidents"]]
+                assert firsts == sorted(firsts, reverse=True)
+                # Config skew: two hashes → consistent false.
+                cfg = (await c.get(base + "/debug/config")).json()
+                assert cfg["consistent"] is False
+                assert [s["hash"] for s in cfg["shards"]] == ["h1", "h2"]
+                assert cfg["config"] == {"p": STUB_A}
+                # Traces fan-in: dedup by span_id across shards.
+                tr = (await c.get(base + "/debug/traces")).json()
+                ids = [s["span_id"] for s in tr["spans"]]
+                assert sorted(ids) == ["s-a", "s-b", "s-shared"]
+        finally:
+            await admin.stop()
+            for runner in runners:
+                await runner.cleanup()
+
+    run(body())
+
+
+# ---- CI hook ------------------------------------------------------------
+
+def test_verify_debug_probes_profile_real_path():
+    """The satellite contract: verify_debug drives /debug/profile through
+    the REAL capture path (?seconds>0&format=json), not the 400 branch."""
+    import verify_debug
+
+    q = verify_debug.QUERY_OVERRIDES["/debug/profile"]
+    assert "format=json" in q
+    assert "seconds=0&" not in q and not q.endswith("seconds=0")
