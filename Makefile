@@ -7,20 +7,23 @@ PY ?= python
 .PHONY: test test-fast test-unit test-dist test-chaos bench bench-flowcontrol \
 	bench-router-sse bench-decisions bench-sched bench-sched-offload \
 	bench-scaleout bench-slo bench-overload bench-kvobs bench-multiturn \
-	bench-timeline \
+	bench-timeline bench-fleet-chaos \
 	dryrun render-chart \
 	compile-check \
 	verify-metrics verify-decisions verify-hotpath verify-threadsafe \
-	verify-slo verify-debug
+	verify-slo verify-debug verify-fleet
 
 # Full hermetic suite (virtual 8-device CPU mesh; no TPU or cluster needed —
 # the reference needs envtest + kind for the equivalent coverage).
 test: verify-metrics verify-decisions verify-hotpath verify-threadsafe verify-slo verify-debug
 	$(PY) -m pytest tests/ -q
 
-# Everything except the spawned-process distributed tests (the slow tail).
+# Everything except the spawned-process distributed tests (the slow tail)
+# and the slow-marked multi-process fleet drills (those ride
+# make test-chaos / make verify-fleet).
 test-fast: verify-metrics verify-decisions verify-hotpath verify-threadsafe verify-debug
-	$(PY) -m pytest tests/ -q --deselect tests/test_multihost.py \
+	$(PY) -m pytest tests/ -q -m "not slow" \
+		--deselect tests/test_multihost.py \
 		--deselect tests/test_multihost_pd.py
 
 # Static registry lint: duplicate family names / high-cardinality labels /
@@ -61,6 +64,14 @@ verify-slo:
 # docs-sync lint (also hooked into pytest via tests/test_kvobs.py).
 verify-debug:
 	$(PY) scripts/verify_debug.py
+
+# Fleet failover drill: boot a 2-worker fleet, SIGKILL the datalayer
+# leader, and fail unless the supervisor promotes the follower and it is
+# SERVING snapshots (its epoch advancing) within the bound, with the
+# ex-leader rejoining as a follower (also hooked into pytest via
+# tests/test_fleet.py, slow-marked).
+verify-fleet:
+	$(PY) scripts/verify_fleet.py
 
 # Recorder-overhead microbench on the flow-control dispatch path (CPU-only;
 # writes benchmarks/DECISIONS_MICRO.json — target <3%, kill-switch ~0%).
@@ -138,6 +149,17 @@ bench-timeline:
 bench-multiturn:
 	$(PY) bench.py --multi-turn
 
+# Kill-the-leader chaos bench (CPU-only): a 3-worker fleet with
+# confirmed-index replication under live traffic — SIGKILL the datalayer
+# leader and gate on failover window <= bound, zero non-balancer client
+# errors, post-promotion divergence ~0, exactly one divergence incident
+# with the outage gap-marked on the merged timeline; then the
+# SCHED_SCALEOUT churn cell re-run with the replication stream live vs
+# off (gate: >=0.9x aggregate throughput). Writes
+# benchmarks/FLEET_CHAOS.json.
+bench-fleet-chaos:
+	$(PY) bench.py --fleet-chaos
+
 test-unit: test-fast
 
 # The multi-process jax.distributed suites only.
@@ -145,10 +167,12 @@ test-dist:
 	$(PY) -m pytest tests/test_multihost.py tests/test_multihost_pd.py -q
 
 # Fault-injection suite with a fixed seed: chaos decisions hash
-# (CHAOS_SEED, fault kind, request id), so reruns are bit-identical.
+# (CHAOS_SEED, fault kind, request id), so reruns are bit-identical; the
+# fleet leader-kill drill (3 workers, election + divergence recovery +
+# /debug/fleet role table) rides along via tests/test_fleet.py.
 test-chaos: verify-metrics
 	CHAOS_SEED=11 $(PY) -m pytest tests/test_resilience.py \
-		tests/test_engine_robustness.py -q -k chaos
+		tests/test_engine_robustness.py tests/test_fleet.py -q -k chaos
 
 # Serving benchmark on the real chip (one JSON line; the driver's entry).
 bench:

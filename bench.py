@@ -1386,7 +1386,13 @@ def sched_scaleout_child(spec_json: str) -> None:
         return {"picks": picks, "n": len(picks)}
 
     async def churn() -> dict:
-        ds, _producer, _precise, sched = build()
+        from llm_d_inference_scheduler_tpu.router.fleet import (
+            KvReplicationSource,
+            SnapshotPublisher,
+            SnapshotSubscriber,
+        )
+
+        ds, _producer, precise, sched = build()
         pool = SchedulerPool(sched, SchedulingConfig(workers=0))
         reqs = [r for _f, r in mine]
         cycles = 0
@@ -1400,6 +1406,72 @@ def sched_scaleout_child(spec_json: str) -> None:
         loop = asyncio.get_running_loop()
         window_start = time.time()
         stop_at = loop.time() + spec["churn_s"]
+
+        # Replication pricing (ISSUE 13): shard 0 runs the leader half of
+        # the snapshot-IPC stream — snapshot epochs at the scrape-landing
+        # cadence PLUS the confirmed-index delta stream under live
+        # kv-event churn — and every other shard runs the follower half
+        # (frames applied into its own datastore + KvBlockIndex) WHILE
+        # churning scheduling cycles. The off run is the PR 8 shape: no
+        # IPC anywhere.
+        # Replication pricing runs the same LEADER WORKLOAD in both arms —
+        # kv-event churn on a thread (in production events land on the SSE
+        # subscriber threads, contending with scoring for the GIL and the
+        # index lock) and scrape-landing snapshot dirtying — and differs
+        # ONLY in the stream: `stream: true` adds the KvReplicationSource
+        # tap + publisher on shard 0 and a subscriber (snapshot + delta
+        # frames applied into the local datastore/index) on every other
+        # shard. The ratio therefore isolates the delta-stream IPC cost,
+        # not the cost of having engines publish events at all (PR 8's
+        # leader already paid that).
+        repl = spec.get("repl")
+        pub = sub = None
+        side_tasks: list = []
+        churn_thread = None
+        churn_stop = None
+        if repl and shard == 0:
+            import threading
+
+            if repl["stream"]:
+                src = KvReplicationSource(precise.index)
+                pub = SnapshotPublisher(ds, repl["path"], interval_s=0.01,
+                                        kv_source=src,
+                                        kv_checkpoint_s=repl["checkpoint_s"])
+                await pub.start()
+            pods = [ep.metadata.address_port for ep in ds.endpoint_list()]
+            churn_stop = threading.Event()
+
+            def kv_churn():
+                # Confirmed-block churn at a busy-pool rate: ~50 stored
+                # events/s x 32 blocks with trailing evictions.
+                i = 0
+                while not churn_stop.is_set():
+                    base = 10_000_000 + i * 64
+                    precise.index.add(pods[i % len(pods)],
+                                      list(range(base, base + 32)))
+                    if i >= 8:
+                        old = 10_000_000 + (i - 8) * 64
+                        precise.index.remove(pods[(i - 8) % len(pods)],
+                                             list(range(old, old + 32)))
+                    i += 1
+                    churn_stop.wait(0.02)
+
+            churn_thread = threading.Thread(target=kv_churn, daemon=True)
+            churn_thread.start()
+
+            async def snap_churn():
+                # Scrape-landing emulation: each landing dirties the
+                # snapshot; with the stream on, the publisher broadcasts
+                # the resulting epochs.
+                while loop.time() < stop_at:
+                    ds.mark_snapshot_dirty()
+                    await asyncio.sleep(0.05)
+
+            side_tasks = [loop.create_task(snap_churn())]
+        elif repl and repl["stream"]:
+            sub = SnapshotSubscriber(ds, repl["path"], retry_s=0.05,
+                                     kv_index=precise.index)
+            sub.start()
 
         async def one(k: int):
             nonlocal cycles
@@ -1415,12 +1487,23 @@ def sched_scaleout_child(spec_json: str) -> None:
         try:
             await asyncio.gather(*[one(k) for k in range(CONCURRENCY)])
         finally:
+            if churn_stop is not None:
+                churn_stop.set()
+                churn_thread.join(timeout=5.0)
+            for t in side_tasks:
+                t.cancel()
+            if sub is not None:
+                await sub.stop()
+            if pub is not None:
+                await pub.stop()
             pool.shutdown()
         # The measured wall-clock window: the parent verifies sibling
         # windows actually OVERLAPPED (a child that missed the start gate
         # churns uncontended and would inflate the aggregate).
         return {"cycles": cycles, "requests": len(reqs),
-                "window": [window_start, time.time()]}
+                "window": [window_start, time.time()],
+                "applied_kv_seq": (sub.applied_kv_seq
+                                   if sub is not None else None)}
 
     result = asyncio.run(parity() if spec["mode"] == "parity" else churn())
     result.update(shard=shard, workers=workers)
@@ -2842,6 +2925,320 @@ scheduling: {{pickSeed: 7}}
     }
 
 
+def fleet_chaos_bench(quick: bool = False) -> dict:
+    """``--fleet-chaos`` → benchmarks/FLEET_CHAOS.json (ISSUE 13): the
+    kill-the-leader acceptance artifact.
+
+    Phase A — chaos: a 3-worker fleet (hash balancer, precise-prefix
+    scoring, confirmed-index replication, timeline divergence rule) under
+    continuous live traffic. Wait until every shard's index view covers
+    the leader's confirmed KvBlockIndex (divergence ~0), SIGKILL the
+    leader, and measure: the failover window (kill → promoted leader
+    serving), the client-visible error profile (only the balancer's
+    documented 503 blip is allowed), post-promotion divergence recovery,
+    and the flight-recorder record of the outage (timeline gap-marks for
+    the dead shard, EXACTLY one supervisor divergence incident).
+
+    Phase B — IPC pricing: the SCHED_SCALEOUT 4-worker saturation-churn
+    cell re-run with the replication stream live (shard 0 publishes
+    snapshot epochs + confirmed-index deltas under kv-event churn, shards
+    1-3 apply them while churning) against the PR 8 no-IPC shape. Gate:
+    aggregate throughput with replication on ≥ 0.9x off."""
+    import asyncio
+
+    FAILOVER_BOUND_S = 15.0
+    DIVERGENCE_OK = 0.05
+    GW, E1, E2, ADMIN = 18980, 18981, 18982, 18985
+
+    cfg = f"""
+pool:
+  endpoints:
+    - {{address: 127.0.0.1, port: {E1}}}
+    - {{address: 127.0.0.1, port: {E2}}}
+scheduling: {{pickSeed: 7}}
+timeline: {{tickS: 0.5, rules: {{divergenceMax: 0.2}}}}
+plugins:
+  - {{type: token-producer}}
+  - {{type: precise-prefix-cache-scorer}}
+  - {{type: queue-scorer}}
+schedulingProfiles:
+  - name: default
+    plugins:
+      - {{pluginRef: precise-prefix-cache-scorer, weight: 2}}
+      - {{pluginRef: queue-scorer, weight: 1}}
+"""
+
+    async def chaos() -> dict:
+        import httpx
+
+        from llm_d_inference_scheduler_tpu.engine import EngineConfig
+        from llm_d_inference_scheduler_tpu.engine.server import EngineServer
+        from llm_d_inference_scheduler_tpu.router.fleet import (
+            FleetConfig,
+            FleetSupervisor,
+        )
+
+        engines = [EngineServer(EngineConfig(
+            backend="sim", model="tiny", port=p, max_batch=8,
+            sim_decode_ms_per_token=1.0)) for p in (E1, E2)]
+        for e in engines:
+            await e.start()
+        sup = FleetSupervisor(
+            cfg, host="127.0.0.1", port=GW,
+            fleet=FleetConfig(workers=3, balancer="hash", admin_port=ADMIN,
+                              kv_checkpoint_s=1.0),
+            poll_interval=0.02, drain_timeout_s=2.0)
+        await sup.start()
+        statuses: list[tuple[float, int]] = []
+        stop_traffic = asyncio.Event()
+
+        async def traffic() -> None:
+            i = 0
+            while not stop_traffic.is_set():
+                try:
+                    # One connection per request: the balancer routes each
+                    # flow independently (keep-alive is shard-sticky).
+                    async with httpx.AsyncClient(timeout=15) as c:
+                        r = await c.post(
+                            f"http://127.0.0.1:{GW}/v1/completions",
+                            headers={"x-request-id": f"fc-{i}",
+                                     "x-gateway-inference-fairness-id":
+                                         f"flow-{i % 6}"},
+                            json={"model": "tiny",
+                                  "prompt": f"shared warm prefix "
+                                            f"{'x' * 96} tail {i % 6}",
+                                  "max_tokens": 2})
+                        statuses.append((time.time(), r.status_code))
+                except httpx.HTTPError:
+                    # Transport cut = the balancer's connection to a dying
+                    # shard; counted beside the 503 blip, never as a 5xx.
+                    statuses.append((time.time(), -1))
+                i += 1
+                await asyncio.sleep(0.05)
+
+        async def kv_doc(c) -> dict:
+            return (await c.get(
+                f"http://127.0.0.1:{ADMIN}/debug/kv")).json()
+
+        async def wait_converged(c, bound: float) -> tuple[bool, dict]:
+            deadline = time.monotonic() + bound
+            doc: dict = {}
+            while time.monotonic() < deadline:
+                doc = await kv_doc(c)
+                div = doc.get("index_divergence") or {}
+                leader_doc = next(
+                    (s for s in doc.get("shards") or []
+                     if s.get("shard") == doc.get("leader_shard")), {})
+                confirmed = sum(
+                    int((row or {}).get("confirmed_blocks") or 0)
+                    for row in (leader_doc.get("pods") or {}).values())
+                if (len(div) == 3 and confirmed > 0
+                        and all(v <= DIVERGENCE_OK for v in div.values())):
+                    return True, doc
+                await asyncio.sleep(0.25)
+            return False, doc
+
+        traffic_task = asyncio.get_running_loop().create_task(traffic())
+        doc: dict = {}
+        try:
+            async with httpx.AsyncClient(timeout=15) as c:
+                ok, pre = await wait_converged(c, 30.0)
+                if not ok:
+                    raise RuntimeError(f"replication never converged "
+                                       f"pre-kill: {pre}")
+                pre_incidents = (await c.get(
+                    f"http://127.0.0.1:{ADMIN}/debug/incidents")).json()
+                pre_div_incidents = [
+                    i for i in pre_incidents["incidents"]
+                    if i.get("rule") == "divergence"]
+
+                t_kill = time.time()
+                sup._procs[sup.leader_index].kill()
+                promoted_at = None
+                deadline = time.monotonic() + FAILOVER_BOUND_S
+                while time.monotonic() < deadline:
+                    await asyncio.sleep(0.2)
+                    fleet_doc = (await c.get(
+                        f"http://127.0.0.1:{ADMIN}/debug/fleet")).json()
+                    if fleet_doc.get("leader") == 1:
+                        promoted_at = time.time()
+                        break
+                failover_window_s = (round(promoted_at - t_kill, 2)
+                                     if promoted_at else None)
+                recovered, post = await wait_converged(c, 40.0)
+                recovery_s = round(time.time() - t_kill, 2)
+                # Let the flight recorder tick over the recovered state,
+                # with traffic still live.
+                await asyncio.sleep(3.0)
+                fleet_doc = (await c.get(
+                    f"http://127.0.0.1:{ADMIN}/debug/fleet")).json()
+                incidents = (await c.get(
+                    f"http://127.0.0.1:{ADMIN}/debug/incidents")).json()
+                tl = (await c.get(
+                    f"http://127.0.0.1:{ADMIN}/debug/timeline")).json()
+                doc = {
+                    "t_kill": t_kill,
+                    "failover_window_s": failover_window_s,
+                    "divergence_recovered": recovered,
+                    "divergence_recovery_s": recovery_s,
+                    "post_divergence": post.get("index_divergence"),
+                    "pre_divergence_incidents": len(pre_div_incidents),
+                    "fleet": {
+                        "leader": fleet_doc.get("leader"),
+                        "elections_total": fleet_doc.get("elections_total"),
+                        "roles": {w["shard"]: w["role"]
+                                  for w in fleet_doc.get("admin") or []},
+                    },
+                    "incidents": incidents,
+                    "timeline": tl,
+                }
+        finally:
+            stop_traffic.set()
+            await traffic_task
+            await sup.stop()
+            for e in engines:
+                await e.stop()
+
+        t_kill = doc["t_kill"]
+        div_incidents = [i for i in doc["incidents"]["incidents"]
+                         if i.get("rule") == "divergence"
+                         and i.get("shard") == "supervisor"]
+        post_kill = [i for i in div_incidents
+                     if (i.get("first_unix") or 0) >= t_kill - 1.0]
+        buckets = doc["timeline"].get("buckets") or []
+        dead_shard_gaps = sum(1 for b in buckets
+                              if 0 in (b.get("gaps") or []))
+        codes: dict[str, int] = {}
+        for _t, s in statuses:
+            key = str(s) if s > 0 else "transport_error"
+            codes[key] = codes.get(key, 0) + 1
+        non_balancer_errors = sum(
+            n for code, n in codes.items()
+            if code not in ("200", "503", "transport_error"))
+        return {
+            "failover_bound_s": FAILOVER_BOUND_S,
+            "failover_window_s": doc["failover_window_s"],
+            "divergence_recovered": doc["divergence_recovered"],
+            "divergence_recovery_s": doc["divergence_recovery_s"],
+            "post_divergence": doc["post_divergence"],
+            "fleet": doc["fleet"],
+            "client_status_counts": codes,
+            "non_balancer_errors": non_balancer_errors,
+            "balancer_503_blip": codes.get("503", 0),
+            "pre_kill_divergence_incidents": doc[
+                "pre_divergence_incidents"],
+            "divergence_incidents_post_kill": len(post_kill),
+            "incident_detail": (post_kill[0].get("detail")
+                                if post_kill else None),
+            "dead_shard_gap_buckets": dead_shard_gaps,
+        }
+
+    chaos_doc = asyncio.run(chaos())
+    print(json.dumps({"phase": "fleet-chaos", **{
+        k: v for k, v in chaos_doc.items()
+        if k not in ("client_status_counts",)}}))
+
+    # ---- Phase B: SCHED_SCALEOUT churn cell, replication off vs on -----
+    churn_s = 1.5 if quick else 3.0
+    reps = 2 if quick else 3
+    WORKERS = 4
+
+    def run_children(repl_dir: str | None) -> list[dict]:
+        start_at = time.time() + 6.0
+        procs = []
+        for shard in range(WORKERS):
+            spec = {"mode": "churn", "shard": shard, "workers": WORKERS,
+                    "total": SCALEOUT_STREAM, "pick_seed": 7,
+                    "churn_s": churn_s, "start_at": start_at,
+                    # Both arms run the leader's kv-event churn; only the
+                    # `stream` flag (tap + publisher + subscribers)
+                    # differs — the ratio prices the IPC, not the events.
+                    "repl": {"stream": repl_dir is not None,
+                             "path": (os.path.join(repl_dir, "snap.sock")
+                                      if repl_dir is not None else None),
+                             "checkpoint_s": 1.0}}
+            procs.append(subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__),
+                 "--scaleout-child", json.dumps(spec)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+                env={**os.environ, "JAX_PLATFORMS": "cpu"}))
+        out = []
+        try:
+            for p in procs:
+                stdout, stderr = p.communicate(timeout=180 + churn_s)
+                if p.returncode != 0 or not stdout.strip():
+                    raise RuntimeError(
+                        f"scaleout child failed rc={p.returncode}: "
+                        f"{stderr[-2000:]}")
+                out.append(json.loads(stdout.strip().splitlines()[-1]))
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    try:
+                        p.communicate(timeout=10)
+                    except Exception:
+                        pass
+        return out
+
+    import tempfile
+
+    def best_of(repl: bool) -> dict:
+        runs = []
+        frames = None
+        for _ in range(reps):
+            if repl:
+                with tempfile.TemporaryDirectory(
+                        prefix="router-fleet-bench-") as d:
+                    res = run_children(d)
+            else:
+                res = run_children(None)
+            runs.append(round(sum(r["cycles"] for r in res) / churn_s, 1))
+            if repl:
+                frames = max(
+                    (r.get("applied_kv_seq") or 0 for r in res),
+                    default=0)
+            time.sleep(1.0)
+        return {"cycles_per_sec": max(runs), "runs": runs,
+                **({"follower_applied_kv_seq": frames} if repl else {})}
+
+    off = best_of(repl=False)
+    on = best_of(repl=True)
+    ratio = round(on["cycles_per_sec"] / off["cycles_per_sec"], 3)
+    print(json.dumps({"phase": "scaleout-replication",
+                      "off": off, "on": on, "ratio_on_vs_off": ratio}))
+
+    return {
+        "metric": "fleet_chaos",
+        "config": {"workers_chaos": 3, "workers_scaleout": WORKERS,
+                   "kv_checkpoint_s": 1.0, "divergence_rule_max": 0.2,
+                   "churn_seconds": churn_s, "reps_best_of": reps},
+        "chaos": chaos_doc,
+        "scaleout_replication": {"off": off, "on": on,
+                                 "ratio_on_vs_off": ratio},
+        "acceptance": {
+            "failover_bound_s": chaos_doc["failover_bound_s"],
+            "failover_window_s": chaos_doc["failover_window_s"],
+            "failover_within_bound": (
+                chaos_doc["failover_window_s"] is not None
+                and chaos_doc["failover_window_s"]
+                <= chaos_doc["failover_bound_s"]),
+            "zero_non_balancer_client_errors":
+                chaos_doc["non_balancer_errors"] == 0,
+            "post_promotion_divergence_recovered":
+                chaos_doc["divergence_recovered"],
+            "exactly_one_divergence_incident":
+                chaos_doc["divergence_incidents_post_kill"] == 1
+                and chaos_doc["pre_kill_divergence_incidents"] == 0,
+            "outage_gap_marked":
+                chaos_doc["dead_shard_gap_buckets"] > 0,
+            "required_replication_throughput_ratio": 0.9,
+            "replication_throughput_ratio": ratio,
+            "replication_ratio_ok": ratio >= 0.9,
+        },
+    }
+
+
 def main() -> None:
     if len(sys.argv) > 3 and sys.argv[1] == "--child":
         child(sys.argv[2], int(sys.argv[3]))
@@ -2910,6 +3307,15 @@ def main() -> None:
         os.makedirs(os.path.join(here, "benchmarks"), exist_ok=True)
         res = timeline_bench(quick="--quick" in sys.argv)
         with open(os.path.join(here, "benchmarks", "TIMELINE.json"), "w") as f:
+            json.dump(res, f, indent=1)
+        return
+    if "--fleet-chaos" in sys.argv:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")  # no chip needed
+        here = os.path.dirname(os.path.abspath(__file__))
+        os.makedirs(os.path.join(here, "benchmarks"), exist_ok=True)
+        res = fleet_chaos_bench(quick="--quick" in sys.argv)
+        with open(os.path.join(here, "benchmarks",
+                               "FLEET_CHAOS.json"), "w") as f:
             json.dump(res, f, indent=1)
         return
     if "--overload-ramp" in sys.argv:

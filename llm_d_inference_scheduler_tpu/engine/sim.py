@@ -24,6 +24,34 @@ from .tokenizer import get_tokenizer
 _LOREM = "lorem ipsum dolor sit amet "
 
 
+class _HubOnlyKvEvents:
+    """SSE-only kv-event publisher for the sim: same duck type as
+    engine/kv_events.KvEventPublisher (the server attaches ``hub`` and the
+    /kv_events route streams it) WITHOUT the ZMQ bind — a sim fleet in the
+    test suite must not claim real TCP ports at serving-port+1000. This is
+    what lets the router's precise-prefix KvBlockIndex (and the fleet's
+    confirmed-index replication on top of it, router/fleet.py) run
+    CPU-only against sims."""
+
+    def __init__(self, engine_id: str):
+        self.engine_id = engine_id
+        self.hub = None  # attached by the engine server at start
+
+    def publish(self, event: str, hashes: list[int]) -> None:
+        if hashes and self.hub is not None:
+            self.hub.push({"event": event, "engine_id": self.engine_id,
+                           "hashes": hashes})
+
+    def stored(self, hashes: list[int]) -> None:
+        self.publish("stored", hashes)
+
+    def removed(self, hashes: list[int]) -> None:
+        self.publish("removed", hashes)
+
+    def close(self) -> None:
+        self.hub = None
+
+
 class SimEngine:
     def __init__(self, cfg: EngineConfig):
         self.cfg = cfg
@@ -49,6 +77,13 @@ class SimEngine:
         # warm repeat prompts confirm real hit depths CPU-only.
         self._prefix_lru: OrderedDict[int, None] = OrderedDict()
         self.kv_hits = PrefixHitLog(self.telemetry, block)
+        # KV-event parity with the real engine (core.py): stored/removed
+        # events for the served-block LRU plus the 1 s idempotent snapshot
+        # re-publication that heals subscriber losses. SSE-hub-only (no
+        # ZMQ bind); gated on the same resolved_kv_events_port knob.
+        self.kv_events = (_HubOnlyKvEvents(self.engine_id)
+                          if cfg.resolved_kv_events_port() else None)
+        self._last_kv_snapshot = 0.0
         # Simulated KV-import measurements (the real engine's
         # kv_import_stats contract, engine/core.py): the server pops these
         # for the x-kv-pull-ms/-bytes response headers the sidecar relays
@@ -77,6 +112,7 @@ class SimEngine:
 
     def _update_gauges(self):
         self._sweep_exports()
+        self._maybe_kv_snapshot()
         self.telemetry.waiting.set(self._waiting)
         self.telemetry.running.set(self._running)
         usable = max(self.n_blocks - 1, 1)
@@ -110,6 +146,35 @@ class SimEngine:
         if task is not None:
             task.cancel()
 
+    def _commit_lru(self, hashes: list[int]) -> None:
+        """Commit block hashes into the served-block LRU, publishing
+        stored/removed kv events for the delta (the real allocator's
+        publication points, core.py)."""
+        stored = []
+        for h in hashes:
+            if h not in self._prefix_lru:
+                stored.append(h)
+            self._prefix_lru[h] = None
+            self._prefix_lru.move_to_end(h)
+        evicted = []
+        while len(self._prefix_lru) > max(self.n_blocks, 1):
+            evicted.append(self._prefix_lru.popitem(last=False)[0])
+        if self.kv_events is not None:
+            self.kv_events.stored(stored)
+            self.kv_events.removed(evicted)
+
+    def _maybe_kv_snapshot(self) -> None:
+        """Idempotent 1 s re-publication of the whole served-block set
+        (engine/core.py contract): SSE subscribers that dropped or missed
+        `stored` events re-converge within one period."""
+        if self.kv_events is None:
+            return
+        now = time.monotonic()
+        if now - self._last_kv_snapshot < 1.0:
+            return
+        self._last_kv_snapshot = now
+        self.kv_events.stored(list(self._prefix_lru))
+
     def _commit_prefix_blocks(self, req: EngineRequest) -> None:
         """Commit the prompt's block-hash chain into the served-block LRU
         without recording a hit — the P/D KV-import path: the decode pod
@@ -119,11 +184,7 @@ class SimEngine:
         block = self.mcfg.kv_block_size
         hashes = chain_block_hashes(self.model_name, req.prompt_token_ids,
                                     "", block)
-        for h in hashes:
-            self._prefix_lru[h] = None
-            self._prefix_lru.move_to_end(h)
-        while len(self._prefix_lru) > max(self.n_blocks, 1):
-            self._prefix_lru.popitem(last=False)
+        self._commit_lru(hashes)
 
     def _note_prefix_hit(self, req: EngineRequest) -> int:
         """Match the prompt's block-hash chain against the served-block LRU
@@ -142,11 +203,7 @@ class SimEngine:
                 match += 1
             else:
                 break
-        for h in hashes:
-            self._prefix_lru[h] = None
-            self._prefix_lru.move_to_end(h)
-        while len(self._prefix_lru) > max(self.n_blocks, 1):
-            self._prefix_lru.popitem(last=False)
+        self._commit_lru(hashes)
         hit_tokens = match * block
         self.kv_hits.note(req.request_id, hit_tokens, len(prompt))
         return hit_tokens

@@ -162,6 +162,14 @@ class Datastore:
         self._remote_snapshots = True
         SNAPSHOT_EPOCH.set(epoch)
 
+    def resume_local_snapshots(self) -> None:
+        """Fleet leader promotion (router/fleet.py): this follower now owns
+        the datalayer, so snapshot epochs are minted locally again. Epoch
+        numbering CONTINUES from the last applied remote epoch — follower
+        epoch gauges must never run backwards across an election."""
+        self._remote_snapshots = False
+        self._snapshot_dirty = True
+
     # ---- pool ----------------------------------------------------------
 
     def pool_set(self, pool: EndpointPool | None) -> None:

@@ -14,7 +14,7 @@ gateway processes in front of the shared pool:
   connection balancing), or sit behind a thin hash-by-flow-id front
   balancer (``fleet.balancer: hash`` — the portable fallback, and the mode
   that gives *strict* flow→shard ownership).
-- **Pool state replicates instead of multiplying**: worker 0 is the
+- **Pool state replicates instead of multiplying**: one worker is the
   datalayer leader — the only process running the scrape + kv-event SSE
   pipeline — and publishes ``PoolSnapshot`` epochs over a unix-socket IPC
   stream (the copy-on-write snapshot from router/snapshot.py is already
@@ -24,6 +24,17 @@ gateway processes in front of the shared pool:
   against the same epoch it would have seen single-process. The staleness
   bound is the publish poll (= ``Datastore.SNAPSHOT_MIN_REFRESH_S``) on
   top of the soft-dirty window the single-process router already has.
+  With ``fleet.replication`` (default on) the same stream carries the
+  leader's engine-confirmed KvBlockIndex as sequence-numbered deltas +
+  periodic full-index checkpoints, so precise-prefix scoring behaves
+  identically in every shard (``router_kv_index_divergence`` ~0).
+- **The leader is a role, not a process**: worker 0 leads at boot; when
+  the leader dies the supervisor promotes the lowest-index live follower
+  (``fleet.election``) onto a fresh snapshot socket, re-targets the
+  remaining subscribers event-driven, and respawns the ex-leader as a
+  follower — kill-the-leader is a measured drill (``make
+  bench-fleet-chaos``), not an outage (docs/resilience.md §Fleet
+  failover).
 - **Observability fans back in**: the supervisor serves one merged
   ``/metrics`` (counters/histograms summed across workers, replicated pool
   gauges deduplicated, ``router_shard_*`` families labeled per shard) and
@@ -52,6 +63,7 @@ import signal
 import socket
 import struct
 import tempfile
+import threading
 import time
 from collections import deque
 from typing import Any, Callable, Iterable
@@ -63,9 +75,12 @@ from prometheus_client.parser import text_string_to_metric_families
 
 from .metrics import (
     FLEET_BALANCER_CONNECTIONS,
+    FLEET_LEADER,
     FLEET_REGISTRY,
     FLEET_WORKERS,
     KV_INDEX_DIVERGENCE,
+    KV_INDEX_RESYNCS,
+    LEADER_ELECTIONS,
     SHARD_REQUESTS,
     SHARD_SNAPSHOT_EPOCH,
     SHARD_UP,
@@ -106,6 +121,17 @@ class FleetConfig:
     balancer: str = "reuseport"   # reuseport | hash
     snapshot_ipc: bool = True     # leader publishes PoolSnapshot epochs
     admin_port: int | None = None  # default: data port + 1000
+    # Confirmed-index replication (ISSUE 13a): the leader appends
+    # sequence-numbered KvBlockIndex add/remove deltas + periodic
+    # full-index checkpoints to the snapshot frame stream; followers apply
+    # them so router_kv_index_divergence reads ~0 steady-state. `off` is
+    # the kill-switch back to PR 8's speculative-only followers.
+    replication: bool = True
+    kv_checkpoint_s: float = 2.0
+    # Leader re-election (ISSUE 13b): when the datalayer leader dies the
+    # supervisor promotes the lowest-index live follower instead of
+    # freezing every follower's pool view behind the leader's restart.
+    election: bool = True
 
     @classmethod
     def from_spec(cls, spec: dict[str, Any] | None) -> "FleetConfig":
@@ -114,12 +140,31 @@ class FleetConfig:
         if balancer not in ("reuseport", "hash"):
             raise ValueError(f"fleet.balancer must be 'reuseport' or 'hash', "
                              f"got {balancer!r}")
+        ckpt = float(spec.get("kvCheckpointS", 2.0))
+        # Replica confirmed entries are renewed ONLY by checkpoints (the
+        # engines' idempotent 1 s re-publication is deliberately
+        # change-free, so steady state produces no delta traffic): a
+        # cadence at or beyond the confirmed TTL would let every
+        # follower's replica expire between checkpoints — divergence
+        # sawtoothing to ~1.0 with no error pointing at the config. Half
+        # the TTL keeps at least one renewal comfortably inside it.
+        from .plugins.precise_prefix import KvBlockIndex
+
+        ttl = KvBlockIndex.CONFIRMED_TTL_S
+        if not 0 < ckpt <= ttl / 2:
+            raise ValueError(
+                f"fleet.kvCheckpointS must be in (0, {ttl / 2:g}] — the "
+                f"checkpoint cadence renews follower replicas whose "
+                f"confirmed TTL is {ttl:g}s")
         return cls(
             workers=max(1, int(spec.get("workers", 1))),
             balancer=balancer,
             snapshot_ipc=bool(spec.get("snapshotIpc", True)),
             admin_port=(int(spec["adminPort"])
-                        if spec.get("adminPort") is not None else None))
+                        if spec.get("adminPort") is not None else None),
+            replication=bool(spec.get("replication", True)),
+            kv_checkpoint_s=ckpt,
+            election=bool(spec.get("election", True)))
 
 
 @dataclasses.dataclass
@@ -134,6 +179,14 @@ class FleetWorkerSpec:
     admin_host: str = "127.0.0.1"
     admin_port: int | None = None  # private per-worker admin listener
     reuse_port: bool = False
+    # Confirmed-index replication on the snapshot stream (fleet.replication)
+    replication: bool = True
+    kv_checkpoint_s: float = 2.0
+    # Shared per-fleet-run secret for the /fleet/promote + /fleet/retarget
+    # control routes: the loopback peer check alone is spoofable through
+    # the hash balancer's splice (the worker sees the balancer's loopback
+    # address, not the client's).
+    control_token: str | None = None
 
     @property
     def runs_datalayer(self) -> bool:
@@ -145,10 +198,62 @@ class FleetWorkerSpec:
 
 # ---------------------------------------------------------------------------
 # Snapshot IPC: leader publishes PoolSnapshot epochs, followers apply them.
+# Frames are tagged tuples on one length-prefixed pickle stream:
+#   ("snap",   epoch, entries)  — pool snapshot (membership + scrape state)
+#   ("kv",     seq,   deltas)   — confirmed KvBlockIndex deltas, deltas =
+#                                 [(op, pod, hashes)], op: add|remove|drop,
+#                                 seq strictly consecutive per publisher
+#   ("kvsync", seq,   dump)     — periodic full confirmed-index checkpoint
+#                                 ({pod: [hashes]}), the resync point for
+#                                 mid-stream joiners and gap-detected
+#                                 followers; seq re-anchors continuity
 # ---------------------------------------------------------------------------
 
 _FRAME_LEN = struct.Struct("!I")
 _FRAME_MAX = 256 << 20  # sanity bound on one pickled pool frame
+
+
+def _pack(frame: tuple) -> bytes:
+    payload = pickle.dumps(frame, protocol=pickle.HIGHEST_PROTOCOL)
+    return _FRAME_LEN.pack(len(payload)) + payload
+
+
+class KvReplicationSource:
+    """Leader-side tap on the precise scorer's engine-confirmed
+    ``KvBlockIndex`` (router/plugins/precise_prefix.py): the index fires
+    (op, pod, hashes) on confirmed-state *changes* — from the kv-event
+    subscriber threads — and this buffer turns them into sequence-numbered
+    delta batches the SnapshotPublisher drains on its poll cadence, plus
+    the periodic full-index checkpoint a joiner resyncs from."""
+
+    def __init__(self, index: Any):
+        self.index = index
+        self._lock = threading.Lock()
+        self._pending: list[tuple[str, str, list[int]]] = []
+        self.seq = 0  # last sequence number handed out
+        index.set_delta_listener(self._on_delta)
+
+    def _on_delta(self, op: str, pod: str, hashes: list[int]) -> None:
+        with self._lock:
+            self._pending.append((op, pod, hashes))
+
+    def drain(self) -> tuple[int, list] | None:
+        """(seq, deltas) for the next ``kv`` frame, or None when idle."""
+        with self._lock:
+            if not self._pending:
+                return None
+            batch, self._pending = self._pending, []
+            self.seq += 1
+            return self.seq, batch
+
+    def checkpoint(self) -> tuple[int, dict[str, list[int]]]:
+        """(seq, full confirmed dump) for a ``kvsync`` frame. Takes the
+        lock so the dump's seq anchor can't race a concurrent drain()."""
+        with self._lock:
+            return self.seq, self.index.dump_confirmed()
+
+    def close(self) -> None:
+        self.index.set_delta_listener(None)
 
 
 def _encode_frame(epoch: int, entries: list, bad_keys: set[str]) -> bytes:
@@ -157,8 +262,7 @@ def _encode_frame(epoch: int, entries: list, bad_keys: set[str]) -> bytes:
     from the frame (with its key cached so the common case stays one
     whole-frame pickle)."""
     try:
-        payload = pickle.dumps((epoch, entries),
-                               protocol=pickle.HIGHEST_PROTOCOL)
+        return _pack(("snap", epoch, entries))
     except Exception:
         sanitized = []
         for meta, metrics, attrs in entries:
@@ -175,28 +279,39 @@ def _encode_frame(epoch: int, entries: list, bad_keys: set[str]) -> bytes:
                                 "endpoint attribute %r from published "
                                 "frames", k)
             sanitized.append((meta, metrics, keep))
-        payload = pickle.dumps((epoch, sanitized),
-                               protocol=pickle.HIGHEST_PROTOCOL)
-    return _FRAME_LEN.pack(len(payload)) + payload
+        return _pack(("snap", epoch, sanitized))
 
 
 class SnapshotPublisher:
     """Datalayer-leader side: poll the datastore's COW snapshot at the
     soft-dirty cadence and broadcast each NEW epoch to every connected
     follower over a unix socket. A follower that connects mid-stream gets
-    the current epoch immediately (no warm-up gap)."""
+    the current epoch immediately (no warm-up gap).
+
+    With a ``kv_source`` (fleet.replication, KvReplicationSource) the same
+    poll also drains the engine-confirmed KvBlockIndex delta buffer into
+    sequence-numbered ``kv`` frames and emits a full-index ``kvsync``
+    checkpoint every ``kv_checkpoint_s`` — the resync point for mid-stream
+    joiners (a restarted worker) and followers that detected a sequence
+    gap. The checkpoint cadence is therefore the follower-divergence bound
+    after any stream discontinuity."""
 
     def __init__(self, datastore: Any, path: str,
-                 interval_s: float | None = None):
+                 interval_s: float | None = None,
+                 kv_source: KvReplicationSource | None = None,
+                 kv_checkpoint_s: float = 2.0):
         self.datastore = datastore
         self.path = path
         self.interval_s = (interval_s if interval_s is not None
                            else type(datastore).SNAPSHOT_MIN_REFRESH_S)
+        self.kv_source = kv_source
+        self.kv_checkpoint_s = kv_checkpoint_s
         self._server: asyncio.AbstractServer | None = None
         self._task: asyncio.Task | None = None
         self._writers: list[asyncio.StreamWriter] = []
         self._frame: bytes | None = None
         self._epoch = -1
+        self._next_checkpoint = 0.0
         self._bad_keys: set[str] = set()
 
     async def start(self) -> None:
@@ -218,6 +333,8 @@ class SnapshotPublisher:
             with contextlib.suppress(Exception):
                 w.close()
         self._writers.clear()
+        if self.kv_source is not None:
+            self.kv_source.close()
         with contextlib.suppress(OSError):
             os.unlink(self.path)
 
@@ -257,9 +374,30 @@ class SnapshotPublisher:
                         # ever-staler data with no error anywhere.
                         log.exception("snapshot publish failed for epoch "
                                       "%s; skipping it", snap.epoch)
+                if self.kv_source is not None:
+                    try:
+                        await self._publish_kv()
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception:
+                        log.exception("kv delta publish failed; skipping "
+                                      "this batch")
                 await asyncio.sleep(self.interval_s)
         except asyncio.CancelledError:
             pass
+
+    async def _publish_kv(self) -> None:
+        """Drain pending confirmed-index deltas into one ``kv`` frame, and
+        emit the periodic ``kvsync`` full-index checkpoint."""
+        drained = self.kv_source.drain()
+        if drained is not None:
+            seq, deltas = drained
+            await self._broadcast(_pack(("kv", seq, deltas)))
+        now = time.monotonic()
+        if now >= self._next_checkpoint:
+            self._next_checkpoint = now + self.kv_checkpoint_s
+            seq, dump = self.kv_source.checkpoint()
+            await self._broadcast(_pack(("kvsync", seq, dump)))
 
     # A follower that stops draining (paused process, swap storm) must not
     # stall publication to the REST of the fleet: its drain is bounded, and
@@ -286,19 +424,43 @@ class SnapshotPublisher:
 class SnapshotSubscriber:
     """Follower side: connect to the leader's snapshot socket (retrying —
     the leader may still be booting, or restarting) and apply each frame
-    via ``Datastore.apply_remote_snapshot``."""
+    via ``Datastore.apply_remote_snapshot``.
+
+    With a ``kv_index`` (fleet.replication, the follower's own
+    KvBlockIndex) the subscriber also applies the leader's confirmed-index
+    ``kv`` delta frames and ``kvsync`` checkpoints. Continuity is tracked
+    by sequence number *within a connection*: deltas apply from the first
+    frame seen (adds are idempotent, removes of absent hashes harmless —
+    the base is healed by the next checkpoint), but once a GAP is detected
+    the follower stops applying deltas (``router_kv_index_resyncs_total``)
+    and waits for the next checkpoint rather than mutating an uncertain
+    base. A reconnect or a leader change resets continuity the same way,
+    so the divergence window after any discontinuity is bounded by the
+    publisher's checkpoint cadence.
+
+    ``retarget(path)`` is the promotion notice (ISSUE 13 satellite): the
+    supervisor elected a new leader on a fresh socket, and the subscriber
+    must re-aim NOW — including mid-backoff against the dead socket, which
+    would otherwise be retried for up to RETRY_MAX_S more."""
 
     RETRY_MAX_S = 5.0  # backoff ceiling for consecutive apply failures
 
-    def __init__(self, datastore: Any, path: str, retry_s: float = 0.25):
+    def __init__(self, datastore: Any, path: str, retry_s: float = 0.25,
+                 kv_index: Any = None):
         self.datastore = datastore
         self.path = path
         self.retry_s = retry_s
+        self.kv_index = kv_index
         self._task: asyncio.Task | None = None
         self.applied_epoch = 0
+        self.applied_kv_seq: int | None = None
+        self.kv_dirty = False  # gap detected: deltas parked until kvsync
         self._consecutive_failures = 0
+        self._retargeted: asyncio.Event | None = None
+        self._cur_writer: asyncio.StreamWriter | None = None
 
     def start(self) -> None:
+        self._retargeted = asyncio.Event()
         self._task = asyncio.get_running_loop().create_task(self._run())
 
     async def stop(self) -> None:
@@ -308,6 +470,19 @@ class SnapshotSubscriber:
                 await self._task
             self._task = None
 
+    def retarget(self, path: str) -> None:
+        """Promotion notice: aim at the new leader's socket immediately —
+        wake a pending backoff sleep and cut any connection still open to
+        the old (dead) leader."""
+        self.path = path
+        self._consecutive_failures = 0
+        if self._retargeted is not None:
+            self._retargeted.set()
+        w = self._cur_writer
+        if w is not None:
+            with contextlib.suppress(Exception):
+                w.close()
+
     async def _run(self) -> None:
         try:
             while True:
@@ -315,8 +490,15 @@ class SnapshotSubscriber:
                     reader, writer = await asyncio.open_unix_connection(
                         path=self.path)
                 except (OSError, ConnectionError):
-                    await asyncio.sleep(self.retry_s)
+                    await self._sleep(self.retry_s)
                     continue
+                self._cur_writer = writer
+                # Fresh connection = fresh delta continuity: deltas apply
+                # optimistically from the first frame (a gap parked on the
+                # PREVIOUS connection does not carry over), full fidelity
+                # returns at the next checkpoint.
+                self.applied_kv_seq = None
+                self.kv_dirty = False
                 try:
                     await self._consume(reader)
                 except (asyncio.IncompleteReadError, ConnectionError,
@@ -337,13 +519,26 @@ class SnapshotSubscriber:
                                   "(%d consecutive); reconnecting",
                                   self._consecutive_failures)
                 finally:
+                    self._cur_writer = None
                     with contextlib.suppress(Exception):
                         writer.close()
-                await asyncio.sleep(min(
+                await self._sleep(min(
                     self.retry_s * (2 ** self._consecutive_failures),
                     self.RETRY_MAX_S))
         except asyncio.CancelledError:
             pass
+
+    async def _sleep(self, delay: float) -> None:
+        """Backoff that a retarget() can interrupt: a promotion notice
+        must not wait out an exponential backoff aimed at a socket that
+        will never return."""
+        ev = self._retargeted
+        if ev is None:
+            await asyncio.sleep(delay)
+            return
+        with contextlib.suppress(asyncio.TimeoutError):
+            await asyncio.wait_for(ev.wait(), timeout=delay)
+        ev.clear()
 
     async def _consume(self, reader: asyncio.StreamReader) -> None:
         while True:
@@ -352,10 +547,48 @@ class SnapshotSubscriber:
             if not 0 < length <= _FRAME_MAX:
                 raise ConnectionError(f"bad snapshot frame length {length}")
             payload = await reader.readexactly(length)
-            epoch, entries = pickle.loads(payload)
-            self.datastore.apply_remote_snapshot(epoch, entries)
-            self.applied_epoch = epoch
+            frame = pickle.loads(payload)
+            kind = frame[0]
+            if kind == "snap":
+                _, epoch, entries = frame
+                self.datastore.apply_remote_snapshot(epoch, entries)
+                self.applied_epoch = epoch
+            elif kind == "kv":
+                self._apply_kv_deltas(frame[1], frame[2])
+            elif kind == "kvsync":
+                self._apply_kv_checkpoint(frame[1], frame[2])
+            else:
+                raise ConnectionError(f"unknown frame kind {kind!r}")
             self._consecutive_failures = 0
+
+    def _apply_kv_deltas(self, seq: int, deltas: list) -> None:
+        if self.kv_index is None:
+            return
+        expected = self.applied_kv_seq
+        self.applied_kv_seq = seq
+        if expected is not None and seq != expected + 1 and not self.kv_dirty:
+            # Dropped/reordered frame: applying further deltas would
+            # mutate an uncertain base. Park until the next checkpoint.
+            self.kv_dirty = True
+            KV_INDEX_RESYNCS.inc()
+            log.warning("kv delta gap (expected seq %d, got %d); waiting "
+                        "for the next checkpoint", expected + 1, seq)
+        if self.kv_dirty:
+            return
+        for op, pod, hashes in deltas:
+            if op == "add":
+                self.kv_index.add(pod, hashes)
+            elif op == "remove":
+                self.kv_index.remove(pod, hashes)
+            elif op == "drop":
+                self.kv_index.drop_pod(pod)
+
+    def _apply_kv_checkpoint(self, seq: int, dump: dict) -> None:
+        if self.kv_index is None:
+            return
+        self.kv_index.apply_checkpoint(dump)
+        self.applied_kv_seq = seq
+        self.kv_dirty = False
 
 
 # ---------------------------------------------------------------------------
@@ -489,14 +722,16 @@ def _merge_agg(target: dict[str, Any], agg: dict[str, Any]) -> None:
 def shard_index_divergence(leader: dict[str, Any],
                            follower: dict[str, Any]) -> float:
     """Fraction of the leader's engine-CONFIRMED KvBlockIndex blocks a
-    follower's index view (confirmed + short-TTL speculative stamps) cannot
-    account for, compared pod by pod on the /debug/kv payloads. 0 = the
-    follower's view covers everything the leader confirmed (or the leader
-    has confirmed nothing yet); 1 = no overlap at all. Counts, not
-    contents — the stamp SETS are process-local — so this is a coverage
-    bound, which is exactly the fidelity caveat ROADMAP item 1 documents
-    (followers hold only their own speculative stamps; run ``balancer:
-    hash`` or ``snapshotIpc: false`` when precise fidelity matters)."""
+    follower's index view (replicated confirmed entries + short-TTL
+    speculative stamps) cannot account for, compared pod by pod on the
+    /debug/kv payloads. 0 = the follower's view covers everything the
+    leader confirmed (or the leader has confirmed nothing yet); 1 = no
+    overlap at all. Counts, not contents — the stamp SETS are
+    process-local — so this is a coverage bound. With
+    ``fleet.replication`` on it reads ~0 steady-state (followers apply the
+    leader's delta stream); excursions mark discontinuities — a mid-stream
+    joiner before its first checkpoint, or ``replication: off`` (PR 8's
+    speculative-only followers, the state PR 10 measured)."""
     leader_pods = leader.get("pods") or {}
     follower_pods = follower.get("pods") or {}
     confirmed = covered = 0
@@ -514,13 +749,16 @@ def shard_index_divergence(leader: dict[str, Any],
     return round(1.0 - covered / confirmed, 4)
 
 
-def merge_kv(docs: list[tuple[int, dict[str, Any]]]) -> dict[str, Any]:
+def merge_kv(docs: list[tuple[int, dict[str, Any]]],
+             leader_shard: int = 0) -> dict[str, Any]:
     """Fleet /debug/kv: shard-annotated per-worker snapshots, summed stamp/
     join totals, n-weighted prediction MAE, and the per-shard divergence
-    gauge versus the datalayer leader's confirmed index (shard 0)."""
+    gauge versus the datalayer leader's confirmed index
+    (``leader_shard`` — shard 0 until a re-election moves it)."""
     out: dict[str, Any] = {
         "workers": len(docs),
         "enabled": any(d.get("enabled") for _, d in docs),
+        "leader_shard": leader_shard,
         "predicted_stamps": 0,
         "confirmed_joins": 0,
         "prediction": {"n": 0},
@@ -528,7 +766,7 @@ def merge_kv(docs: list[tuple[int, dict[str, Any]]]) -> dict[str, Any]:
         "shards": [],
         "index_divergence": {},
     }
-    leader = next((d for shard, d in docs if shard == 0), None)
+    leader = next((d for shard, d in docs if shard == leader_shard), None)
     n_tot = sum_abs = sum_signed = 0.0
     rn_tot = rsum_abs = rsum_signed = 0.0
     # Prefill-classifier accuracy: confusion counts sum across shards;
@@ -554,7 +792,7 @@ def merge_kv(docs: list[tuple[int, dict[str, Any]]]) -> dict[str, Any]:
             rsum_signed += rpred.get("mean_signed_ratio", 0.0) * rn
         out["predicted_stamps"] += doc.get("predicted_stamps", 0)
         out["confirmed_joins"] += doc.get("confirmed_joins", 0)
-        div = (0.0 if shard == 0 or leader is None
+        div = (0.0 if shard == leader_shard or leader is None
                else shard_index_divergence(leader, doc))
         out["index_divergence"][str(shard)] = div
         KV_INDEX_DIVERGENCE.labels(str(shard)).set(div)
@@ -634,12 +872,19 @@ class FleetAdmin:
     def __init__(self, worker_admin: list[tuple[str, int]], *,
                  host: str = "127.0.0.1", port: int = 9081,
                  worker_alive: Callable[[int], bool] | None = None,
-                 timeline: Any = None):
+                 timeline: Any = None,
+                 fleet_state: Callable[[], dict[str, Any]] | None = None):
         from .timeline import IncidentRecorder, TimelineConfig
 
         self.worker_admin = worker_admin
         self.host, self.port = host, port
         self.worker_alive = worker_alive or (lambda i: True)
+        # Supervisor role/election state for the fan-in surfaces: leader
+        # shard (divergence is measured against it), election count,
+        # per-worker restart tallies. Stubs default to the static PR 8
+        # shape (shard 0 leads, no elections).
+        self.fleet_state = fleet_state or (lambda: {"leader": 0,
+                                                    "elections": 0})
         self.timeline_cfg = timeline or TimelineConfig()
         self._sup_ring: "deque[dict[str, Any]]" = deque(
             maxlen=self.timeline_cfg.ring_capacity)
@@ -729,14 +974,27 @@ class FleetAdmin:
                 if status == 200 and isinstance(doc, dict)]
         if not docs:
             return
-        merged = merge_kv(docs)
+        merged = merge_kv(docs, leader_shard=int(
+            self.fleet_state().get("leader", 0)))
         self._last_kv_doc = merged
-        div = merged.get("index_divergence") or {}
+        div = {str(k): v
+               for k, v in (merged.get("index_divergence") or {}).items()}
+        # A shard the supervisor knows exists but that did not answer is
+        # FULLY diverged for the series: its index view covers nothing
+        # while it is down (a killed leader, a crashed follower mid-boot),
+        # which is exactly the excursion a kill-the-leader chaos run must
+        # record. /debug/kv itself keeps reporting responding shards only;
+        # shards_responding says which values were measured vs imputed.
+        responding = {shard for shard, _ in docs}
+        for shard in range(len(self.worker_admin)):
+            if shard not in responding:
+                div[str(shard)] = 1.0
+                KV_INDEX_DIVERGENCE.labels(str(shard)).set(1.0)
         sample: dict[str, Any] = {
             "t_unix": time.time(),
-            "kv_index_divergence": {str(k): v for k, v in div.items()},
+            "kv_index_divergence": div,
             "kv_index_divergence_max": max(div.values(), default=0.0),
-            "shards_responding": sorted(s for s, _ in docs),
+            "shards_responding": sorted(responding),
         }
         self._sup_ring.append(sample)
         tripped: dict[str, str] = {}
@@ -831,10 +1089,22 @@ class FleetAdmin:
             status=200 if ok else 503)
 
     async def fleet_view(self, request: web.Request) -> web.Response:
+        """The fleet role table: who leads the datalayer (divergence is
+        measured against that shard), how many elections have run, and the
+        per-worker liveness/restart tallies a kill-the-leader chaos run
+        asserts against."""
+        state = self.fleet_state()
+        leader = int(state.get("leader", 0))
+        restarts = state.get("restarts") or []
         return web.json_response({
             "workers": len(self.worker_admin),
+            "leader": leader,
+            "elections_total": int(state.get("elections", 0)),
             "admin": [{"shard": i, "host": h, "port": p,
-                       "alive": self.worker_alive(i)}
+                       "alive": self.worker_alive(i),
+                       "role": "leader" if i == leader else "follower",
+                       "restarts": (restarts[i] if i < len(restarts)
+                                    else 0)}
                       for i, (h, p) in enumerate(self.worker_admin)],
         })
 
@@ -896,11 +1166,13 @@ class FleetAdmin:
 
     async def kv(self, request: web.Request) -> web.Response:
         """Fleet /debug/kv: per-shard cache-ledger snapshots with the
-        follower-vs-leader index divergence gauge (merge_kv)."""
+        follower-vs-leader index divergence gauge (merge_kv), measured
+        against the CURRENT datalayer leader (elections move it)."""
         results = await self._fan_out("/debug/kv")
         return web.json_response(merge_kv(
             [(shard, doc) for shard, (status, doc) in enumerate(results)
-             if status == 200 and isinstance(doc, dict)]))
+             if status == 200 and isinstance(doc, dict)],
+            leader_shard=int(self.fleet_state().get("leader", 0))))
 
     async def transfers(self, request: web.Request) -> web.Response:
         results = await self._fan_out("/debug/transfers")
@@ -1189,6 +1461,32 @@ class FleetSupervisor:
         self.balancer: HashBalancer | None = None
         self._monitor: asyncio.Task | None = None
         self._stopping = False
+        # Datalayer leadership (ISSUE 13b): worker 0 leads at boot; when
+        # the leader process dies the monitor promotes the lowest-index
+        # live follower onto a FRESH snapshot socket and re-targets the
+        # rest. A restarted ex-leader rejoins as a follower (its respawn
+        # spec is computed from leader_index at spawn time) — no
+        # thrash-back.
+        self.leader_index = 0
+        self.elections_total = 0
+        self._ipc_gen = 0
+        self._election_session = None  # aiohttp session for promote/retarget
+        # Followers whose retarget notice failed (e.g. caught mid-restart):
+        # retried every monitor tick until acknowledged — a follower left
+        # aimed at the dead leader's socket would otherwise retry it
+        # forever.
+        self._retarget_pending: set[int] = set()
+        # An unacknowledged promotion (shard, path): a promote whose ack
+        # was lost (timeout) may still have LANDED — the worker is a
+        # de-facto leader. Until this resolves, the same (shard, path) is
+        # re-sent each tick (promote is idempotent worker-side) and the
+        # dead ex-leader is NOT respawned — respawning it as a leader
+        # beside a half-promoted follower would split-brain the datalayer
+        # with no reconciliation path.
+        self._pending_promote: tuple[int, str] | None = None
+        import secrets
+
+        self._control_token = secrets.token_hex(16)
 
     def _worker_spec(self, i: int) -> dict[str, Any]:
         return {
@@ -1201,11 +1499,18 @@ class FleetSupervisor:
             "worker": {
                 "index": i,
                 "workers": self.fleet.workers,
-                "role": "leader" if i == 0 else "follower",
+                # Role follows CURRENT leadership, not the boot layout: a
+                # worker respawned after a re-election must rejoin as a
+                # follower of the promoted leader, not thrash leadership
+                # back by scraping + publishing beside it.
+                "role": "leader" if i == self.leader_index else "follower",
                 "ipc_path": self.ipc_path,
                 "admin_host": self.worker_admin[i][0],
                 "admin_port": self.worker_admin[i][1],
                 "reuse_port": self.fleet.balancer == "reuseport",
+                "replication": self.fleet.replication,
+                "kv_checkpoint_s": self.fleet.kv_checkpoint_s,
+                "control_token": self._control_token,
             },
         }
 
@@ -1225,6 +1530,7 @@ class FleetSupervisor:
 
     async def start(self) -> None:
         FLEET_WORKERS.set(self.fleet.workers)
+        self._set_leader_gauge()
         if self.fleet.snapshot_ipc and self.fleet.workers > 1:
             self._ipc_dir = tempfile.mkdtemp(prefix="router-fleet-")
             self.ipc_path = os.path.join(self._ipc_dir, "snapshot.sock")
@@ -1239,7 +1545,10 @@ class FleetSupervisor:
                 self.worker_admin, host="127.0.0.1", port=self.admin_port,
                 worker_alive=self.worker_alive,
                 timeline=TimelineConfig.from_spec(
-                    load_raw_config(self.config_text).timeline))
+                    load_raw_config(self.config_text).timeline),
+                fleet_state=lambda: {"leader": self.leader_index,
+                                     "elections": self.elections_total,
+                                     "restarts": list(self._restarts)})
             await self.admin.start()
             if self.fleet.balancer == "hash":
                 self.balancer = HashBalancer(
@@ -1286,10 +1595,122 @@ class FleetSupervisor:
                 f"fleet workers {sorted(pending)} not ready after "
                 f"{WORKER_READY_TIMEOUT_S:.0f}s")
 
+    def _set_leader_gauge(self) -> None:
+        for i in range(self.fleet.workers):
+            FLEET_LEADER.labels(str(i)).set(
+                1.0 if i == self.leader_index else 0.0)
+
+    def _restart_allowed(self, i: int) -> bool:
+        """The restart budget bounds follower crash loops; the CURRENT
+        datalayer leader is exempt — a permanently dead leader freezes
+        every follower's pool view, so it always respawns (the 1 s monitor
+        tick is the backoff). The exemption follows LEADERSHIP, not the
+        literal index 0: a promoted leader that crash-loops would
+        otherwise be budget-killed and freeze the fleet exactly like the
+        dead-worker-0 bug this PR fixes."""
+        return i == self.leader_index or self._restarts[i] < MAX_WORKER_RESTARTS
+
+    async def _elect_leader(self) -> None:
+        """The dead datalayer leader's replacement: promote the
+        lowest-index live follower onto a FRESH snapshot socket, then
+        notify the remaining followers to re-target (event-driven — their
+        subscribers would otherwise back off against a socket that will
+        never answer again). On promotion failure the leader index is left
+        unchanged and the next monitor tick retries."""
+        if self._pending_promote is not None:
+            # Resolve the in-flight promotion before anything else: the
+            # lost ack may have been a completed promote (split-brain if
+            # we elect elsewhere or respawn the old leader as leader).
+            new_leader, new_path = self._pending_promote
+            if not self.worker_alive(new_leader):
+                # The half-promoted candidate died; its respawn spec is a
+                # follower of whoever wins next, so the slate is clean.
+                self._pending_promote = None
+                return
+        else:
+            candidates = [i for i in range(self.fleet.workers)
+                          if i != self.leader_index and self.worker_alive(i)]
+            if not candidates:
+                # Nobody to promote: the old leader respawns as leader on
+                # the existing socket path (the pre-election behavior).
+                return
+            new_leader = min(candidates)
+            self._ipc_gen += 1
+            new_path = os.path.join(self._ipc_dir,
+                                    f"snapshot-{self._ipc_gen}.sock")
+            self._pending_promote = (new_leader, new_path)
+        try:
+            await self._fleet_control(new_leader, "promote", new_path)
+        except Exception:
+            log.exception("promoting shard %d to datalayer leader failed; "
+                          "retrying the same promotion next tick",
+                          new_leader)
+            return
+        self._pending_promote = None
+        old = self.leader_index
+        self.leader_index = new_leader
+        self.ipc_path = new_path
+        self.elections_total += 1
+        LEADER_ELECTIONS.inc()
+        self._set_leader_gauge()
+        log.warning("datalayer leader re-elected: shard %d -> %d "
+                    "(election %d, socket %s)", old, new_leader,
+                    self.elections_total, new_path)
+        self._retarget_pending = {i for i in range(self.fleet.workers)
+                                  if i != new_leader}
+        await self._drain_retargets()
+
+    async def _fleet_control(self, shard: int, action: str,
+                             path: str) -> None:
+        import aiohttp
+
+        if self._election_session is None:
+            self._election_session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=5.0))
+        host, port = self.worker_admin[shard]
+        async with self._election_session.post(
+                f"http://{host}:{port}/fleet/{action}",
+                json={"ipcPath": path},
+                headers={"x-fleet-token": self._control_token}) as resp:
+            if resp.status != 200:
+                raise RuntimeError(f"{action} returned {resp.status}")
+
+    async def _drain_retargets(self) -> None:
+        """Deliver the promotion notice to every pending follower. A
+        failure (worker mid-restart, admin briefly down) keeps the shard
+        pending and the next monitor tick retries — a follower must never
+        be left aimed at the dead leader's socket indefinitely. Workers
+        that are DEAD right now leave the set too: their respawn spec
+        already carries the new path."""
+        for i in sorted(self._retarget_pending):
+            if i == self.leader_index:
+                self._retarget_pending.discard(i)
+                continue
+            if not self.worker_alive(i):
+                self._retarget_pending.discard(i)
+                continue
+            try:
+                await self._fleet_control(i, "retarget", self.ipc_path)
+                self._retarget_pending.discard(i)
+            except Exception:
+                log.warning("re-targeting shard %d to the new leader "
+                            "socket failed; retrying next tick", i)
+
     async def _monitor_loop(self) -> None:
         try:
             while True:
                 await asyncio.sleep(1.0)
+                if self._stopping:
+                    continue
+                # Election BEFORE the respawn pass: the dead ex-leader must
+                # respawn as a follower of the promoted leader (its spec is
+                # computed from leader_index at spawn time).
+                if (self.fleet.election and self.fleet.snapshot_ipc
+                        and self.fleet.workers > 1 and self.ipc_path
+                        and not self.worker_alive(self.leader_index)):
+                    await self._elect_leader()
+                if self._retarget_pending:
+                    await self._drain_retargets()
                 for i in range(self.fleet.workers):
                     # router_shard_up has ONE writer — the admin /metrics
                     # fan-in (scrape success implies process alive AND
@@ -1297,17 +1718,22 @@ class FleetSupervisor:
                     alive = self.worker_alive(i)
                     if alive or self._stopping:
                         continue
-                    # The restart budget bounds follower crash loops; the
-                    # DATALAYER LEADER (shard 0) is exempt — a permanently
-                    # dead leader freezes every follower's pool view, so it
-                    # always respawns (the 1 s monitor tick is the backoff).
-                    if (i != 0 and self._restarts[i] >= MAX_WORKER_RESTARTS):
+                    if (i == self.leader_index
+                            and self._pending_promote is not None):
+                        # An unresolved promotion may already have a
+                        # de-facto leader elsewhere: respawning the dead
+                        # ex-leader AS a leader now would split-brain the
+                        # datalayer. It respawns (as a follower) once the
+                        # election resolves.
+                        continue
+                    if not self._restart_allowed(i):
                         continue
                     self._restarts[i] += 1
                     log.warning(
                         "gateway shard %d died (exitcode %s); restart %d%s",
                         i, self._procs[i].exitcode, self._restarts[i],
-                        "" if i == 0 else f"/{MAX_WORKER_RESTARTS}")
+                        "" if i == self.leader_index
+                        else f"/{MAX_WORKER_RESTARTS}")
                     self._spawn(i)
         except asyncio.CancelledError:
             pass
@@ -1317,6 +1743,9 @@ class FleetSupervisor:
         if self._monitor is not None:
             self._monitor.cancel()
             self._monitor = None
+        if self._election_session is not None:
+            await self._election_session.close()
+            self._election_session = None
         if self.balancer is not None:
             await self.balancer.stop()
             self.balancer = None

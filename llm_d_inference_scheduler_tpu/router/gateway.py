@@ -326,6 +326,12 @@ class Gateway:
             web.get("/debug/timeline", self.timeline_view),
             web.get("/debug/incidents", self.incidents_view),
             web.get("/debug/config", self.config_view),
+            # Fleet control plane (router/fleet.py, loopback-guarded): the
+            # supervisor's leader-election notices — promote this follower
+            # to datalayer leader / re-aim the snapshot subscriber at a
+            # freshly-elected leader's socket.
+            web.post("/fleet/promote", self.fleet_promote),
+            web.post("/fleet/retarget", self.fleet_retarget),
         ])
         self._runner: web.AppRunner | None = None
         # Fleet snapshot IPC endpoints (router/fleet.py): the datalayer
@@ -388,20 +394,22 @@ class Gateway:
             if self.fleet is not None and self.fleet.ipc_path is not None:
                 # Datalayer leader: the ONLY process scraping the engines;
                 # every snapshot epoch broadcasts to the follower workers.
-                from .fleet import SnapshotPublisher
-
-                self._snapshot_pub = SnapshotPublisher(
-                    self.datastore, self.fleet.ipc_path)
-                await self._snapshot_pub.start()
+                await self._start_snapshot_publisher(self.fleet.ipc_path)
         else:
             # Fleet follower: pool state (membership + scrape metrics +
             # producer attributes) arrives as leader-published PoolSnapshot
             # epochs over IPC — no collectors, no per-worker SSE
             # subscriptions, so N workers impose 1x load on every engine.
+            # With fleet.replication the same stream carries the leader's
+            # engine-confirmed KvBlockIndex deltas + checkpoints, applied
+            # into this worker's own index so precise-prefix scoring (and
+            # everything built on it) behaves identically in every shard.
             from .fleet import SnapshotSubscriber
 
             self._snapshot_sub = SnapshotSubscriber(
-                self.datastore, self.fleet.ipc_path)
+                self.datastore, self.fleet.ipc_path,
+                kv_index=(self._precise_index()
+                          if self.fleet.replication else None))
             self._snapshot_sub.start()
         if self.flow_controller is not None:
             await self.flow_controller.start()
@@ -634,6 +642,123 @@ class Gateway:
             "shard": self.fleet.index if self.fleet is not None else None,
             "config": redact_config(self.cfg.raw_doc),
         })
+
+    # ---- fleet control plane (router/fleet.py leader election) ---------
+
+    def _precise_index(self):
+        """The precise-prefix scorer's engine-confirmed KvBlockIndex, when
+        one is configured — the replication unit of fleet.replication
+        (same discovery contract as CacheLedger.attach_plugins)."""
+        found = [p for p in self.cfg.plugins_by_name.values()
+                 if hasattr(p, "index_counts") and hasattr(p, "index")]
+        if len(found) > 1:
+            log.warning("fleet.replication: %d precise-prefix scorers "
+                        "configured; replicating only %r",
+                        len(found), found[0].name)
+        return found[0].index if found else None
+
+    async def _start_snapshot_publisher(self, path: str) -> None:
+        from .fleet import KvReplicationSource, SnapshotPublisher
+
+        kv_source = None
+        if self.fleet.replication:
+            index = self._precise_index()
+            if index is not None:
+                kv_source = KvReplicationSource(index)
+        self._snapshot_pub = SnapshotPublisher(
+            self.datastore, path, kv_source=kv_source,
+            kv_checkpoint_s=self.fleet.kv_checkpoint_s)
+        await self._snapshot_pub.start()
+
+    def _fleet_request_allowed(self, request: web.Request) -> str | None:
+        """Guard for the supervisor-only control routes: fleet mode with
+        snapshot IPC, loopback peers, AND the per-fleet-run shared token —
+        the loopback check alone is spoofable through the hash balancer's
+        splice (the worker sees the balancer's loopback address, not the
+        client's), and the same app serves the public data port."""
+        if self.fleet is None or self.fleet.ipc_path is None:
+            return "not a fleet worker (no snapshot IPC)"
+        peer = (request.transport.get_extra_info("peername")
+                if request.transport is not None else None)
+        if (isinstance(peer, (tuple, list)) and peer
+                and peer[0] not in ("127.0.0.1", "::1", "localhost")):
+            return f"fleet control refused for non-loopback peer {peer[0]}"
+        token = getattr(self.fleet, "control_token", None)
+        if token and request.headers.get("x-fleet-token") != token:
+            return "fleet control refused: bad or missing x-fleet-token"
+        return None
+
+    async def fleet_promote(self, request: web.Request) -> web.Response:
+        """Supervisor promotion notice (leader re-election): this follower
+        becomes the datalayer leader — start the scrape collectors +
+        kv-event SSE lifecycle, resume local snapshot-epoch minting
+        (continuing the dead leader's numbering), and publish on the fresh
+        socket the supervisor advertises. Idempotent: a re-delivered
+        promotion for the path already served returns 200."""
+        err = self._fleet_request_allowed(request)
+        if err is not None:
+            return web.json_response({"error": err}, status=403)
+        try:
+            path = str((await request.json())["ipcPath"])
+        except Exception:
+            return web.json_response({"error": "ipcPath required"},
+                                     status=400)
+        if self.fleet.role == "leader" and self._snapshot_pub is not None:
+            if self._snapshot_pub.path != path:
+                # Re-promotion onto a fresh socket (e.g. a supervisor
+                # retry that lost the first ack): move the publisher.
+                await self._snapshot_pub.stop()
+                self._snapshot_pub = None
+                await self._start_snapshot_publisher(path)
+            self.fleet.ipc_path = path
+            return web.json_response({"role": "leader", "ipcPath": path})
+        log.warning("promoted to datalayer leader (publishing on %s)", path)
+        if self._snapshot_sub is not None:
+            await self._snapshot_sub.stop()
+            self._snapshot_sub = None
+        self.datastore.resume_local_snapshots()
+        # The lifecycle plugins build_gateway skipped for followers (per-pod
+        # kv-event subscribers, LRU teardown) register now — and their
+        # endpoint_added hooks fire for the pool that already exists, since
+        # the datastore events that normally drive them are long past.
+        for plugin in self.cfg.plugins_by_name.values():
+            if (hasattr(plugin, "endpoint_added")
+                    or hasattr(plugin, "endpoint_removed")):
+                # Guard against a supervisor promote retry that lost the
+                # first ack mid-setup: registration must stay idempotent.
+                if plugin in self.dl_runtime.lifecycle_plugins:
+                    continue
+                self.dl_runtime.register_lifecycle(plugin)
+                added = getattr(plugin, "endpoint_added", None)
+                if added is not None:
+                    for ep in self.datastore.endpoint_list():
+                        try:
+                            added(ep)
+                        except Exception:
+                            log.exception("lifecycle plugin failure "
+                                          "(promotion add)")
+        await self.dl_runtime.start()
+        self.fleet.role = "leader"
+        self.fleet.ipc_path = path
+        await self._start_snapshot_publisher(path)
+        return web.json_response({"role": "leader", "ipcPath": path})
+
+    async def fleet_retarget(self, request: web.Request) -> web.Response:
+        """Supervisor re-target notice: a new leader was elected on a
+        fresh snapshot socket; aim the subscriber there NOW (event-driven —
+        not after an exponential backoff against the dead socket)."""
+        err = self._fleet_request_allowed(request)
+        if err is not None:
+            return web.json_response({"error": err}, status=403)
+        try:
+            path = str((await request.json())["ipcPath"])
+        except Exception:
+            return web.json_response({"error": "ipcPath required"},
+                                     status=400)
+        self.fleet.ipc_path = path
+        if self._snapshot_sub is not None:
+            self._snapshot_sub.retarget(path)
+        return web.json_response({"role": self.fleet.role, "ipcPath": path})
 
     async def kv(self, request: web.Request) -> web.Response:
         """KV-cache & prefix-reuse observability rollup (router/kvobs.py):
@@ -1581,14 +1706,13 @@ def build_gateway(config_text: str | None, *, host: str = "127.0.0.1",
     # reference's EndpointExtractors, runtime.go:361) ride datastore events.
     # Fleet followers skip them: a per-pod SSE subscription in every worker
     # would put the N x engine load back that the snapshot IPC removes.
-    # The trade is real and documented (docs/performance.md §Scale-out):
-    # engine-CONFIRMED kv-event state (the precise scorer's KvBlockIndex)
-    # is plugin-local and does NOT ride the snapshot frames, so followers
-    # see only their own short-TTL speculative pre_request stamps. Pools
-    # leaning on precise-prefix fidelity should run `balancer: hash`
-    # (flow-sticky shards keep each flow's stamps on its owner) or
-    # `snapshotIpc: false` (every worker subscribes — the N x load trade,
-    # made explicitly).
+    # Engine-CONFIRMED kv-event state (the precise scorer's KvBlockIndex)
+    # reaches followers anyway: with `fleet.replication` (default on) the
+    # leader appends confirmed-index deltas + periodic checkpoints to the
+    # snapshot stream and the follower's SnapshotSubscriber applies them
+    # into its own index (docs/performance.md §Scale-out). A promoted
+    # follower registers these plugins at /fleet/promote time instead
+    # (leader re-election, docs/resilience.md §Fleet failover).
     if fleet is None or fleet.runs_datalayer:
         for plugin in cfg.plugins_by_name.values():
             if hasattr(plugin, "endpoint_added") or hasattr(plugin, "endpoint_removed"):

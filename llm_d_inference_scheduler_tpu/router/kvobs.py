@@ -33,10 +33,13 @@ One ``CacheObservation`` rides each scheduled InferenceRequest
 single attribute check (``bench.py --kv-obs`` measures both sides against
 the scheduling-cycle floor → benchmarks/KV_OBS.json). In fleet mode the
 supervisor fans /debug/kv in per shard and derives the
-``router_kv_index_divergence`` gauge — each follower's speculative-only
-index view measured against the leader's engine-confirmed KvBlockIndex
-(router/fleet.py), turning the ROADMAP item-1 "run ``balancer: hash`` when
-precise-prefix fidelity matters" caveat into a number.
+``router_kv_index_divergence`` gauge — each shard's index view (replicated
+confirmed entries + its own speculative stamps) measured against the
+current leader's engine-confirmed KvBlockIndex (router/fleet.py). With
+``fleet.replication`` on it reads ~0 steady-state; excursions mark stream
+discontinuities (a joiner before its first checkpoint) or the
+``replication: off`` kill-switch — the speculative-only state this gauge
+was first built to measure.
 """
 
 from __future__ import annotations

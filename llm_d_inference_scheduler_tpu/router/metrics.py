@@ -291,6 +291,16 @@ CONFIG_INFO = Gauge(
     "Constant 1, labeled with the xxh64 hash of the effective loaded "
     "config — scrape-joinable config-skew detection (redacted snapshot at "
     "/debug/config)", ("hash",), registry=REGISTRY)
+# Confirmed-index replication (router/fleet.py): a follower that detects a
+# sequence gap in the leader's KV delta stream stops applying deltas and
+# waits for the next full-index checkpoint frame to resync. Worker-side —
+# the fleet /metrics merge sums it across shards.
+KV_INDEX_RESYNCS = Counter(
+    "router_kv_index_resyncs_total",
+    "Confirmed KV-index delta-stream resyncs in this worker: a sequence "
+    "gap (dropped frame, leader change, reconnect) was detected and the "
+    "replica waited for the next full-index checkpoint instead of "
+    "applying deltas onto an uncertain base", registry=REGISTRY)
 # Multi-process sharded gateway (router/fleet.py): each worker exposes the
 # pool-snapshot epoch it last built (leader) or applied from the IPC stream
 # (follower) — the supervisor re-labels it per shard, making snapshot-IPC
@@ -332,6 +342,17 @@ FLEET_BALANCER_CONNECTIONS = Counter(
     "Connections routed per shard by the hash-by-flow-id front balancer "
     "(fleet.balancer: hash; absent under SO_REUSEPORT kernel balancing)",
     ("shard",), registry=FLEET_REGISTRY)
+FLEET_LEADER = Gauge(
+    "router_fleet_leader",
+    "Datalayer-leader role per shard (1 = this worker runs the scrape + "
+    "kv-event pipeline and publishes snapshot/KV-delta frames; moves on "
+    "leader re-election when the leader process dies)",
+    ("shard",), registry=FLEET_REGISTRY)
+LEADER_ELECTIONS = Counter(
+    "router_leader_elections",
+    "Datalayer-leader re-elections performed by the fleet supervisor (a "
+    "dead leader was replaced by promoting the lowest-index live "
+    "follower)", registry=FLEET_REGISTRY)
 KV_INDEX_DIVERGENCE = Gauge(
     "router_kv_index_divergence",
     "Per-shard KV-index divergence derived at /debug/kv fan-in time: the "

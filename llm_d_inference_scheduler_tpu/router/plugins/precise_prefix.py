@@ -75,29 +75,57 @@ class KvBlockIndex:
         self._lock = threading.Lock()
         self._next_pod_sweep: dict[str, float] = {}  # per-pod cadence
         self._next_spec_sweep = 0.0
+        # Fleet confirmed-index replication tap (router/fleet.py
+        # KvReplicationSource): fired OUTSIDE the lock with
+        # (op, pod, hashes) on confirmed-state CHANGES only — the engines'
+        # 1 s idempotent snapshot re-publication produces no deltas, so the
+        # replica stream carries churn, not steady-state re-sends.
+        self._on_delta = None
+
+    def set_delta_listener(self, fn) -> None:
+        """fn(op, pod, hashes) with op in {'add', 'remove', 'drop'};
+        called from whichever thread mutated the index (listener must be
+        thread-safe)."""
+        self._on_delta = fn
 
     def add(self, pod: str, hashes: list[int]) -> None:
         expiry = time.monotonic() + self.CONFIRMED_TTL_S
+        # Capture the listener once: a concurrent set_delta_listener(None)
+        # (publisher teardown while subscriber threads still deliver)
+        # must not turn the post-lock call into None(...).
+        listener = self._on_delta
+        fresh: list[int] | None = [] if listener is not None else None
         with self._lock:
             entries = self._by_pod.setdefault(pod, {})
+            now = expiry - self.CONFIRMED_TTL_S
             for h in hashes:
+                if fresh is not None:
+                    prev = entries.get(h)
+                    if prev is None or prev <= now:
+                        fresh.append(h)  # new OR expired-dead: a change
                 entries[h] = expiry
                 self._speculative.pop((pod, h), None)  # confirmed
             # The speculative sweep rides the subscriber threads' writes,
             # never the scheduler's scoring path.
-            now = expiry - self.CONFIRMED_TTL_S
             if now >= self._next_spec_sweep:
                 self._next_spec_sweep = now + self.SWEEP_INTERVAL_S
                 dead = [k for k, exp in self._speculative.items()
                         if exp <= now]
                 for k in dead:
                     del self._speculative[k]
+        if fresh:
+            listener("add", pod, fresh)
 
     def remove(self, pod: str, hashes: list[int]) -> None:
+        listener = self._on_delta
+        gone: list[int] = []
         with self._lock:
             entries = self._by_pod.get(pod, {})
             for h in hashes:
-                entries.pop(h, None)
+                if entries.pop(h, None) is not None and listener is not None:
+                    gone.append(h)
+        if gone and listener is not None:
+            listener("remove", pod, gone)
 
     def add_speculative(self, pod: str, hashes: list[int]) -> None:
         expiry = time.monotonic() + SPECULATIVE_TTL_S
@@ -147,11 +175,42 @@ class KvBlockIndex:
         return self.match_prefix(pod, [h]) == 1
 
     def drop_pod(self, pod: str) -> None:
+        listener = self._on_delta  # captured: see add()
+        dropped = False
         with self._lock:
-            self._by_pod.pop(pod, None)
+            dropped = self._by_pod.pop(pod, None) is not None
             self._next_pod_sweep.pop(pod, None)
             self._speculative = {k: v for k, v in self._speculative.items()
                                  if k[0] != pod}
+        if dropped and listener is not None:
+            listener("drop", pod, [])
+
+    # ---- fleet confirmed-index replication (router/fleet.py) -----------
+
+    def dump_confirmed(self) -> dict[str, list[int]]:
+        """Live confirmed entries per pod — the periodic full-index
+        checkpoint frame a mid-stream joiner (or a gap-detected follower)
+        resyncs from."""
+        now = time.monotonic()
+        with self._lock:
+            return {pod: [h for h, exp in entries.items() if exp > now]
+                    for pod, entries in self._by_pod.items()}
+
+    def apply_checkpoint(self, dump: dict[str, list[int]]) -> None:
+        """Install a leader-published full-index checkpoint: the replica's
+        confirmed view is REPLACED wholesale (pods absent from the dump are
+        dropped). Speculative stamps are process-local and untouched.
+        Replica entries carry the normal CONFIRMED_TTL_S — the checkpoint
+        cadence (< TTL) is the renewal, so a dead leader's replica decays
+        instead of poisoning routing forever."""
+        expiry = time.monotonic() + self.CONFIRMED_TTL_S
+        replaced = {pod: {h: expiry for h in hashes}
+                    for pod, hashes in dump.items()}
+        with self._lock:
+            self._by_pod = replaced
+            for pod, entries in replaced.items():
+                for h in entries:
+                    self._speculative.pop((pod, h), None)
 
     def pod_block_count(self, pod: str) -> int:
         now = time.monotonic()
@@ -162,9 +221,10 @@ class KvBlockIndex:
     def counts(self) -> dict[str, dict[str, int]]:
         """Per-pod live confirmed/speculative stamp counts — the precise
         half of /debug/kv's index-occupancy view, and the quantity the
-        fleet supervisor's divergence gauge compares across shards (a
-        follower holds only speculative stamps; the leader's confirmed
-        entries are what it is diverging from)."""
+        fleet supervisor's divergence gauge compares across shards (with
+        fleet.replication a follower's confirmed entries are replicas of
+        the leader's, so the gauge reads ~0; without it the follower
+        holds only speculative stamps)."""
         now = time.monotonic()
         with self._lock:
             out = {pod: {"confirmed": sum(1 for exp in entries.values()

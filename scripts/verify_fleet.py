@@ -1,0 +1,157 @@
+"""Fleet failover check: kill the datalayer leader, require a new leader
+to be serving snapshots within the bound.
+
+PR 8's fleet made worker 0 a single point of failure: its death froze
+every follower's pool view until a supervisor restart. ISSUE 13 adds
+leader re-election — the supervisor promotes the lowest-index live
+follower, which starts the scrape/SSE pipeline and publishes on a fresh
+snapshot socket, and the remaining subscribers re-target on notice. This
+check drives the REAL machinery end to end: a 2-worker fleet against one
+sim engine, SIGKILL the leader process, and fail unless within
+``FAILOVER_BOUND_S``:
+
+- ``/debug/fleet`` reports the promoted leader (shard 1) with exactly one
+  election and the restarted ex-leader rejoining as a *follower*;
+- the promoted leader is actually SERVING snapshots — its
+  ``router_shard_snapshot_epoch`` advances past its pre-kill value (the
+  epochs now minted by its own scrape pipeline, not replayed IPC ones).
+
+Run via ``make verify-fleet``; tests/test_fleet.py hooks it into the
+pytest run (slow-marked — excluded from the tier-1 ``-m 'not slow'``
+sweep, exercised beside ``make test-chaos``).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+GW, ENG, ADMIN = 18760, 18761, 18765
+
+FAILOVER_BOUND_S = 20.0
+
+CFG = f"""
+pool:
+  endpoints:
+    - {{address: 127.0.0.1, port: {ENG}}}
+scheduling: {{pickSeed: 7}}
+"""
+
+
+async def _epoch(client, shard: str) -> float:
+    from prometheus_client.parser import text_string_to_metric_families
+
+    r = await client.get(f"http://127.0.0.1:{ADMIN}/metrics")
+    for fam in text_string_to_metric_families(r.text):
+        if fam.name == "router_shard_snapshot_epoch":
+            for s in fam.samples:
+                if s.labels.get("shard") == shard:
+                    return s.value
+    return -1.0
+
+
+async def _drive() -> list[str]:
+    import asyncio
+
+    import httpx
+
+    from llm_d_inference_scheduler_tpu.engine import EngineConfig
+    from llm_d_inference_scheduler_tpu.engine.server import EngineServer
+    from llm_d_inference_scheduler_tpu.router.fleet import (
+        FleetConfig,
+        FleetSupervisor,
+    )
+
+    errors: list[str] = []
+    eng = EngineServer(EngineConfig(backend="sim", model="tiny", port=ENG,
+                                    sim_decode_ms_per_token=1.0))
+    await eng.start()
+    sup = FleetSupervisor(
+        CFG, host="127.0.0.1", port=GW,
+        fleet=FleetConfig(workers=2, balancer="hash", admin_port=ADMIN),
+        poll_interval=0.02, drain_timeout_s=2.0)
+    await sup.start()
+    try:
+        async with httpx.AsyncClient(timeout=10) as c:
+            pre_kill_epoch = await _epoch(c, "1")
+            if pre_kill_epoch < 1.0:
+                # The follower must have applied at least one IPC epoch
+                # before the drill means anything.
+                for _ in range(100):
+                    await asyncio.sleep(0.1)
+                    pre_kill_epoch = await _epoch(c, "1")
+                    if pre_kill_epoch >= 1.0:
+                        break
+            if pre_kill_epoch < 1.0:
+                errors.append("follower never applied a snapshot epoch "
+                              "before the kill")
+                return errors
+
+            sup._procs[0].kill()
+            t_kill = time.monotonic()
+            promoted = serving = False
+            while time.monotonic() - t_kill < FAILOVER_BOUND_S:
+                await asyncio.sleep(0.25)
+                r = await c.get(f"http://127.0.0.1:{ADMIN}/debug/fleet")
+                doc = r.json()
+                if doc.get("leader") == 1:
+                    promoted = True
+                    if await _epoch(c, "1") > pre_kill_epoch:
+                        serving = True
+                        break
+            window = time.monotonic() - t_kill
+            if not promoted:
+                errors.append(f"no leader promoted within "
+                              f"{FAILOVER_BOUND_S:.0f}s of the kill")
+            elif not serving:
+                errors.append("promoted leader never advanced its snapshot "
+                              f"epoch past {pre_kill_epoch} within the "
+                              f"{FAILOVER_BOUND_S:.0f}s bound")
+            else:
+                print(f"verify-fleet: failover complete in {window:.1f}s")
+            r = await c.get(f"http://127.0.0.1:{ADMIN}/debug/fleet")
+            doc = r.json()
+            if doc.get("elections_total") != 1:
+                errors.append(f"elections_total "
+                              f"{doc.get('elections_total')} != 1")
+            roles = {w["shard"]: w["role"] for w in doc.get("admin") or []}
+            if roles != {0: "follower", 1: "leader"}:
+                errors.append(f"role table {roles} != "
+                              "{0: follower, 1: leader}")
+            # The restarted ex-leader must rejoin (as a follower) too.
+            rejoined = False
+            while time.monotonic() - t_kill < FAILOVER_BOUND_S * 2:
+                if sup.worker_alive(0):
+                    rejoined = True
+                    break
+                await asyncio.sleep(0.25)
+            if not rejoined:
+                errors.append("ex-leader worker 0 never respawned")
+    finally:
+        await sup.stop()
+        await eng.stop()
+    return errors
+
+
+def check() -> list[str]:
+    import asyncio
+
+    return asyncio.run(_drive())
+
+
+def main() -> int:
+    errors = check()
+    for e in errors:
+        print(f"verify-fleet: {e}", file=sys.stderr)
+    if errors:
+        return 1
+    print("verify-fleet: leader killed, follower promoted, snapshots "
+          "serving again inside the bound, ex-leader rejoined as follower")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -93,6 +93,12 @@ REQUIRED_FAMILIES = {
     ("router_shard_snapshot_epoch", "fleet"),
     ("router_shard_requests", "fleet"),
     ("router_fleet_balancer_connections", "fleet"),
+    # Leader failover & confirmed-index replication (ISSUE 13): the role
+    # gauge + election counter on the supervisor, and the follower-side
+    # delta-stream resync counter.
+    ("router_fleet_leader", "fleet"),
+    ("router_leader_elections", "fleet"),
+    ("router_kv_index_resyncs", "router"),
 }
 
 # Registries whose every family must have a docs/metrics.md row (the
