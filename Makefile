@@ -7,7 +7,7 @@ PY ?= python
 .PHONY: test test-fast test-unit test-dist test-chaos bench bench-flowcontrol \
 	bench-router-sse bench-decisions bench-sched bench-sched-offload \
 	bench-scaleout bench-slo bench-overload bench-kvobs bench-multiturn \
-	bench-timeline bench-fleet-chaos \
+	bench-timeline bench-fleet-chaos bench-shadow \
 	dryrun render-chart \
 	compile-check \
 	verify-metrics verify-decisions verify-hotpath verify-threadsafe \
@@ -148,6 +148,16 @@ bench-timeline:
 # CacheLedger's engine-confirmed actual hit depths.
 bench-multiturn:
 	$(PY) bench.py --multi-turn
+
+# Shadow-policy evaluation bench (CPU-only): the live-path hook cost vs
+# the scheduling-cycle floor (kill-switch ~0%), then a skewed transfer
+# topology (per-peer sim pull maps: 2 fast pairs, N slow) where the
+# transfer-pair shadow policy's estimated regret is validated against a
+# live A/B arm running transfer-aware-pair-scorer for real — sign
+# agreement + the documented error band, every divergent pick explained
+# at /debug/decisions?divergent=1. Writes benchmarks/SHADOW.json.
+bench-shadow:
+	$(PY) bench.py --shadow
 
 # Kill-the-leader chaos bench (CPU-only): a 3-worker fleet with
 # confirmed-index replication under live traffic — SIGKILL the datalayer

@@ -2435,6 +2435,436 @@ schedulingProfiles:
     }
 
 
+def shadow_bench(quick: bool = False) -> dict:
+    """Shadow policy evaluation bench (CPU-only, no chip). Three phases,
+    written to benchmarks/SHADOW.json:
+
+    - **micro**: the live-path hook (one request's submit + terminal
+      observe enqueues, with the transfer-pair policy registered) timed in
+      a tight loop as a percentage of the SCHED_HOTPATH 128x64 cycle
+      floor; the no-policies kill-switch path timed the same way, ~0%.
+    - **shadow arm (A)**: a skewed transfer topology — 2 decode pods, 2
+      prefill pods, per-peer sim pull maps giving each decode pod one FAST
+      prefill peer and one SLOW one (2 fast pairs, 2 slow) — with the
+      default (queue-scored, pair-blind) prefill profile live and the
+      transfer-pair policy in shadow. Warmup traffic measures all 4 pair
+      EWMAs; a measured wave collects client TTFTs and the shadow
+      ledger's estimated regret; every divergent pick is re-read from
+      /debug/decisions?divergent=1 and must carry the judged block; the
+      FleetAdmin fan-in re-serves /debug/shadow merged.
+    - **live A/B arm (B)**: identical topology + traffic with
+      transfer-aware-pair-scorer activated for real in the prefill
+      profile (the policy's config-activatable twin, docs/shadow.md).
+
+    Acceptance: the shadow ledger's estimated mean regret per measured
+    request and the measured mean TTFT delta (arm A - arm B) agree in
+    SIGN, with their ratio inside the documented error band [0.2, 5] (the
+    estimate prices only the KV pull from EWMAs; the measured delta adds
+    prefill-leg scheduling and shared-box noise). Arm B's own shadow
+    evaluation must agree with its live picks (self-consistency), and the
+    shadow.enabled:false run stamps nothing."""
+    import asyncio
+    import gc
+    import statistics
+    import types
+
+    from llm_d_inference_scheduler_tpu.router.datalayer.transfers import (
+        TransferTable,
+    )
+    from llm_d_inference_scheduler_tpu.router.framework.datalayer import (
+        Endpoint,
+        EndpointMetadata,
+    )
+    from llm_d_inference_scheduler_tpu.router.framework.scheduling import (
+        InferenceRequest,
+        InferenceRequestBody,
+        ProfileRunResult,
+        SchedulingResult,
+    )
+    from llm_d_inference_scheduler_tpu.router.shadow import (
+        ShadowConfig,
+        ShadowEvaluator,
+    )
+
+    # ---- micro: live-path hook cost vs the scheduling-cycle floor ------
+    here = os.path.dirname(os.path.abspath(__file__))
+    floor_us = 2000.0  # conservative default: the PR 4 128x64 cycle cost
+    try:
+        with open(os.path.join(here, "benchmarks",
+                               "SCHED_HOTPATH.json")) as f:
+            sweep = json.load(f)["sweep"]
+        floor_us = min(r["us_per_req_after"] for r in sweep
+                       if r.get("endpoints") == 128 and r.get("blocks") == 64)
+    except (OSError, KeyError, ValueError):
+        pass
+
+    def _ep(addr):
+        host, _, port = addr.rpartition(":")
+        return Endpoint(EndpointMetadata(name=addr, address=host,
+                                         port=int(port)))
+
+    pre_addrs = [f"10.0.0.{i}:8200" for i in range(8)]
+    dec_addr = "10.0.1.1:8000"
+    ds = types.SimpleNamespace(transfers=TransferTable())
+    for i, p in enumerate(pre_addrs):
+        ds.transfers.record(p, dec_addr, pull_ms=1.0 + i)
+    result = SchedulingResult(
+        profile_results={
+            "decode": ProfileRunResult(target_endpoints=[_ep(dec_addr)]),
+            "prefill": ProfileRunResult(
+                target_endpoints=[_ep(pre_addrs[0])],
+                totals={p: 1.0 for p in pre_addrs}),
+        },
+        primary_profile_name="decode")
+    transfer_row = {"prefill": pre_addrs[0], "decode": dec_addr,
+                    "pull_ms": 4.2}
+    req = InferenceRequest(request_id="shadow-micro", target_model="tiny",
+                           body=InferenceRequestBody(
+                               completions={"prompt": "p"}))
+
+    def one_lifecycle(ev) -> None:
+        req.shadow = None
+        ev.submit(req, result)
+        ev.observe_response(req, transfer=transfer_row, status=200)
+
+    # Chunked under the evaluator's MAX_QUEUE backlog bound (2 events per
+    # lifecycle): a tight loop past the bound would time the shed path,
+    # not the enqueue the hook contract is about. Drain between chunks,
+    # outside the timed window.
+    reps = 1_000 if quick else 1_500
+    chunks = 5 if quick else 12
+    ev_on = ShadowEvaluator(
+        ShadowConfig.from_spec({"policies": ["transfer-pair"]}),
+        datastore=ds)
+    ev_off = ShadowEvaluator(ShadowConfig.from_spec(None), datastore=ds)
+    gc.disable()
+    try:
+        best_on = best_off = float("inf")
+        for _ in range(chunks):
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                one_lifecycle(ev_on)
+            best_on = min(best_on, (time.perf_counter() - t0) / reps)
+            ev_on.flush(timeout=60)  # drain between chunks, outside timing
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                one_lifecycle(ev_off)
+            best_off = min(best_off, (time.perf_counter() - t0) / reps)
+        dropped = ev_on.snapshot().get("dropped_events", 0)
+    finally:
+        gc.enable()
+        ev_on.stop()
+        ev_off.stop()
+    micro = {
+        "hook_us_per_request": round(best_on * 1e6, 3),
+        "hook_pct_of_cycle_floor": round(best_on * 1e6 / floor_us * 100, 4),
+        "killswitch_us_per_request": round(best_off * 1e6, 3),
+        "killswitch_pct_of_cycle_floor": round(
+            best_off * 1e6 / floor_us * 100, 4),
+        "cycle_floor_us": round(floor_us, 1),
+        "reps": reps,
+        "chunks": chunks,
+        # Backlog sheds during the micro loop (must stay 0 — the timed
+        # path has to be the real enqueue, not the shed guard).
+        "dropped_events": dropped,
+    }
+    print(json.dumps({"phase": "shadow-micro", **micro}))
+
+    # ---- workload: skewed topology, shadow arm vs live A/B arm ---------
+    P0, P1, D0, D1, S0, S1, GW, ADMIN = (19060, 19061, 19062, 19063,
+                                         19064, 19065, 19066, 19067)
+    FAST_MS_BLOCK, SLOW_MS_BLOCK = 0.1, 1.2
+    PREFILL_MS_TOK = 0.05
+    N_WARM = 8 if quick else 20
+    N_WAVE = 12 if quick else 40
+    REPS = 1 if quick else 2
+    PROMPT_CHARS = 2000  # ~500 byte-tokens -> ~31 blocks of 16
+
+    def _cfg(live_scorer: bool, shadow_enabled: bool = True) -> str:
+        pair_plugin = ("\n  - {type: transfer-aware-pair-scorer}"
+                       if live_scorer else "")
+        pair_ref = ("\n      - {pluginRef: transfer-aware-pair-scorer, "
+                    "weight: 2}" if live_scorer else "")
+        return f"""
+shadow:
+  enabled: {str(shadow_enabled).lower()}
+  sampleRate: 1.0
+  policies:
+    - {{type: transfer-pair, parameters: {{weight: 2.0}}}}
+scheduling:
+  pickSeed: 424242
+pool:
+  endpoints:
+    - {{address: 127.0.0.1, port: {S0}, labels: {{llm-d.ai/role: decode}}}}
+    - {{address: 127.0.0.1, port: {S1}, labels: {{llm-d.ai/role: decode}}}}
+    - {{address: 127.0.0.1, port: {P0}, labels: {{llm-d.ai/role: prefill}}}}
+    - {{address: 127.0.0.1, port: {P1}, labels: {{llm-d.ai/role: prefill}}}}
+plugins:
+  - {{type: decode-filter}}
+  - {{type: prefill-filter}}
+  - {{type: queue-scorer}}{pair_plugin}
+  - type: disagg-profile-handler
+    parameters:
+      pdDecider: {{type: always-disagg-pd-decider}}
+schedulingProfiles:
+  - name: decode
+    plugins:
+      - {{pluginRef: decode-filter}}
+      - {{pluginRef: queue-scorer}}
+  - name: prefill
+    plugins:
+      - {{pluginRef: prefill-filter}}
+      - {{pluginRef: queue-scorer}}{pair_ref}
+"""
+
+    async def run_arm(tag: str, live_scorer: bool,
+                      shadow_enabled: bool = True,
+                      fan_in: bool = False) -> dict:
+        import httpx
+
+        from llm_d_inference_scheduler_tpu.engine import EngineConfig
+        from llm_d_inference_scheduler_tpu.router.fleet import FleetAdmin
+        from llm_d_inference_scheduler_tpu.engine.server import EngineServer
+        from llm_d_inference_scheduler_tpu.router.gateway import build_gateway
+        from llm_d_inference_scheduler_tpu.router.sidecar import (
+            Sidecar,
+            SidecarConfig,
+        )
+
+        pre0, pre1 = f"127.0.0.1:{P0}", f"127.0.0.1:{P1}"
+
+        def _sim(port, role, pull_map=None):
+            return EngineServer(EngineConfig(
+                backend="sim", model="tiny", port=port, role=role,
+                max_batch=16, max_model_len=4096,
+                sim_prefill_ms_per_token=PREFILL_MS_TOK,
+                sim_decode_ms_per_token=1.0,
+                sim_kv_pull_ms_per_block=SLOW_MS_BLOCK,
+                sim_kv_pull_ms_per_peer=pull_map or {}))
+
+        # The skew: each decode pod has ONE fast prefill peer — 2 fast
+        # pairs, 2 slow — and the skew is ANTI-aligned with the seeded
+        # tie-break (the per-request pick RNG draws the same index in both
+        # profiles, so the pair-blind baseline lands on (k, k) pairs:
+        # exactly the slow ones here). The pair-aware arm must cross over.
+        engines = [
+            _sim(P0, "prefill"), _sim(P1, "prefill"),
+            _sim(D0, "decode", {pre0: SLOW_MS_BLOCK, pre1: FAST_MS_BLOCK}),
+            _sim(D1, "decode", {pre0: FAST_MS_BLOCK, pre1: SLOW_MS_BLOCK}),
+        ]
+        for e in engines:
+            await e.start()
+        sidecars = [
+            Sidecar(SidecarConfig(port=S0,
+                                  decoder_url=f"http://127.0.0.1:{D0}")),
+            Sidecar(SidecarConfig(port=S1,
+                                  decoder_url=f"http://127.0.0.1:{D1}")),
+        ]
+        for s in sidecars:
+            await s.start()
+        gw = build_gateway(_cfg(live_scorer, shadow_enabled), port=GW,
+                           poll_interval=0.02)
+        await gw.start()
+        admin = None
+        try:
+            await asyncio.sleep(0.2)
+            async with httpx.AsyncClient(timeout=120) as c:
+
+                def prompt(i: int) -> str:
+                    head = f"[user {tag}-{i}] "
+                    return head + "policy clause review " * (
+                        (PROMPT_CHARS - len(head)) // 21)
+
+                async def one(rid: str, text: str, stream: bool,
+                              subset: str | None = None) -> float:
+                    body = {"model": "tiny", "prompt": text, "max_tokens": 4}
+                    headers = {"x-request-id": rid}
+                    if subset:
+                        headers["x-gateway-destination-endpoint-subset"] = \
+                            subset
+                    t0 = time.perf_counter()
+                    if not stream:
+                        r = await c.post(
+                            f"http://127.0.0.1:{GW}/v1/completions",
+                            json=body, headers=headers)
+                        assert r.status_code == 200, r.text
+                        return (time.perf_counter() - t0) * 1e3
+                    body["stream"] = True
+                    ttft = float("nan")
+                    async with c.stream(
+                            "POST", f"http://127.0.0.1:{GW}/v1/completions",
+                            json=body, headers=headers) as r:
+                        async for line in r.aiter_lines():
+                            if (ttft != ttft and line.startswith("data: ")
+                                    and line != "data: [DONE]"):
+                                ttft = (time.perf_counter() - t0) * 1e3
+                    return ttft
+
+                # Measurement warmup (non-streamed so the engine pull
+                # stats land in the TransferTable): the subset hint forces
+                # each of the 4 (prefill, decode) combinations in turn so
+                # EVERY pair carries a measured pull EWMA before either
+                # arm is judged — without forced coverage the pair-aware
+                # arm could never discover an unmeasured fast pair (ties
+                # keep it on the measured slow ones).
+                combos = [(p, d) for d in (f"127.0.0.1:{S0}",
+                                           f"127.0.0.1:{S1}")
+                          for p in (pre0, pre1)]
+                sent = 0
+                while sent < N_WARM * 3:
+                    p, d = combos[sent % 4]
+                    await one(f"shadow-{tag}-warm-{sent}",
+                              prompt(1000 + sent), stream=False,
+                              subset=f"{p},{d}")
+                    sent += 1
+                    if sent >= N_WARM:
+                        t = (await c.get(f"http://127.0.0.1:{GW}"
+                                         "/debug/transfers")).json()
+                        measured = sum(1 for row in t["pairs"]
+                                       if row.get("ewma_pull_ms") is not None)
+                        if measured >= 4:
+                            break
+                snap0 = (await c.get(
+                    f"http://127.0.0.1:{GW}/debug/shadow")).json()
+
+                # Measured wave: client TTFT over streamed requests.
+                ttfts = []
+                for i in range(N_WAVE):
+                    ttfts.append(await one(f"shadow-{tag}-m-{i}", prompt(i),
+                                           stream=True))
+                snap1 = (await c.get(
+                    f"http://127.0.0.1:{GW}/debug/shadow")).json()
+
+                def _policy(snap):
+                    return (snap.get("policies") or {}).get(
+                        "transfer-pair") or {}
+
+                def _regret_sum(snap):
+                    return (_policy(snap).get("est_regret_ms")
+                            or {}).get("sum", 0.0)
+
+                doc = {
+                    "ttft_ms": [round(v, 2) for v in ttfts],
+                    "ttft_mean_ms": round(statistics.fmean(ttfts), 2),
+                    "ttft_p50_ms": round(statistics.median(ttfts), 2),
+                    "warmup_requests": sent,
+                    "shadow": _policy(snap1),
+                    "submitted": snap1.get("submitted", 0),
+                    "wave_regret_ms": round(
+                        _regret_sum(snap1) - _regret_sum(snap0), 3),
+                    "wave_divergences": (
+                        (_policy(snap1).get("judged") or {}).get(
+                            "divergences", 0)
+                        - (_policy(snap0).get("judged") or {}).get(
+                            "divergences", 0)),
+                }
+
+                if shadow_enabled:
+                    # Explainability: every divergent record carries the
+                    # judged shadow block.
+                    lst = (await c.get(
+                        f"http://127.0.0.1:{GW}/debug/decisions"
+                        "?divergent=1&n=500")).json()["decisions"]
+                    doc["divergent_records"] = len(lst)
+                    doc["divergent_all_judged"] = all(
+                        "judged" in (rec["shadow"]["policies"]
+                                     .get("transfer-pair") or {})
+                        for rec in lst)
+
+                if fan_in:
+                    admin = FleetAdmin([("127.0.0.1", GW)],
+                                       host="127.0.0.1", port=ADMIN)
+                    await admin.start()
+                    merged = (await c.get(
+                        f"http://127.0.0.1:{ADMIN}/debug/shadow")).json()
+                    doc["fleet_fan_in"] = {
+                        "workers": merged.get("workers"),
+                        "submitted": merged.get("submitted"),
+                        "divergences": (merged.get("policies", {})
+                                        .get("transfer-pair", {})
+                                        .get("divergences")),
+                    }
+                return doc
+        finally:
+            if admin is not None:
+                await admin.stop()
+            await gw.stop()
+            for s in sidecars:
+                await s.stop()
+            for e in engines:
+                await e.stop()
+
+    reps_out = []
+    for rep in range(REPS):
+        arm_a = asyncio.run(run_arm(f"a{rep}", live_scorer=False,
+                                    fan_in=(rep == 0)))
+        arm_b = asyncio.run(run_arm(f"b{rep}", live_scorer=True))
+        row = {"rep": rep, "shadow_arm": arm_a, "live_arm": arm_b}
+        reps_out.append(row)
+        print(json.dumps({
+            "phase": "shadow-rep", "rep": rep,
+            "arm_a_ttft_mean": arm_a["ttft_mean_ms"],
+            "arm_b_ttft_mean": arm_b["ttft_mean_ms"],
+            "wave_regret_ms": arm_a["wave_regret_ms"],
+            "wave_divergences": arm_a["wave_divergences"],
+        }))
+
+    killswitch = asyncio.run(run_arm("ks", live_scorer=False,
+                                     shadow_enabled=False))
+
+    # Best-of-N (shared-box precedent): the rep whose arm-A mean TTFT is
+    # lowest carries the least throttle noise; the estimate/measured
+    # comparison uses matched reps.
+    best = min(reps_out,
+               key=lambda r: r["shadow_arm"]["ttft_mean_ms"])
+    a, b = best["shadow_arm"], best["live_arm"]
+    n_wave = len(a["ttft_ms"])
+    est_mean_regret = (a["wave_regret_ms"] / n_wave) if n_wave else 0.0
+    measured_delta = a["ttft_mean_ms"] - b["ttft_mean_ms"]
+    sign_agrees = (est_mean_regret > 0) == (measured_delta > 0)
+    ratio = (est_mean_regret / measured_delta
+             if measured_delta not in (0, 0.0) else float("inf"))
+    b_agree = (b["shadow"].get("agreement_rate") or 0.0)
+    return {
+        "scenario": {
+            "topology": "2 prefill + 2 (sidecar + decode) pods, per-peer "
+                        "pull skew: each decode has ONE fast prefill peer",
+            "fast_ms_per_block": FAST_MS_BLOCK,
+            "slow_ms_per_block": SLOW_MS_BLOCK,
+            "prompt_chars": PROMPT_CHARS,
+            "wave_requests": N_WAVE, "reps": REPS,
+        },
+        "micro": micro,
+        "reps": reps_out,
+        "killswitch": {"submitted": killswitch["submitted"],
+                       "shadow": killswitch["shadow"]},
+        "acceptance": {
+            "hook_pct_of_cycle_floor": micro["hook_pct_of_cycle_floor"],
+            "hook_under_1pct": micro["hook_pct_of_cycle_floor"] < 1.0,
+            "killswitch_pct_of_cycle_floor":
+                micro["killswitch_pct_of_cycle_floor"],
+            "est_mean_regret_ms_per_request": round(est_mean_regret, 3),
+            "measured_ttft_delta_ms_per_request": round(measured_delta, 3),
+            # The documented error band (docs/shadow.md §Bench): the
+            # estimate prices only the KV pull from EWMAs, the measured
+            # delta adds prefill-leg effects and box noise.
+            "sign_agrees": sign_agrees,
+            "est_over_measured_ratio": round(ratio, 3),
+            "ratio_in_band_0p2_to_5": 0.2 <= ratio <= 5.0,
+            "divergent_records": a.get("divergent_records", 0),
+            "divergent_all_judged": a.get("divergent_all_judged", False),
+            "fleet_fan_in_populated": bool(
+                (reps_out[0]["shadow_arm"].get("fleet_fan_in") or {})
+                .get("divergences")),
+            # Self-consistency: arm B's shadow evaluation of its own live
+            # pair-scored picks must agree with them.
+            "live_arm_shadow_agreement_rate": round(b_agree, 4),
+            "live_arm_self_consistent": b_agree >= 0.9,
+            "killswitch_submitted": killswitch["submitted"],
+        },
+    }
+
+
 def overload_ramp_bench(quick: bool = False) -> dict:
     """Goodput-max overload control bench (CPU-only, no chip needed).
 
@@ -3299,6 +3729,14 @@ def main() -> None:
         os.makedirs(os.path.join(here, "benchmarks"), exist_ok=True)
         res = kv_obs_bench(quick="--quick" in sys.argv)
         with open(os.path.join(here, "benchmarks", "KV_OBS.json"), "w") as f:
+            json.dump(res, f, indent=1)
+        return
+    if "--shadow" in sys.argv:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")  # no chip needed
+        here = os.path.dirname(os.path.abspath(__file__))
+        os.makedirs(os.path.join(here, "benchmarks"), exist_ok=True)
+        res = shadow_bench(quick="--quick" in sys.argv)
+        with open(os.path.join(here, "benchmarks", "SHADOW.json"), "w") as f:
             json.dump(res, f, indent=1)
         return
     if "--timeline" in sys.argv:

@@ -30,6 +30,15 @@ class EngineConfig:
     # (bench.py --multi-turn), where a warm turn routed through the hop
     # pays this pull for blocks the decode pod already holds.
     sim_kv_pull_ms_per_block: float = 0.2
+    # Per-peer override of the flat scalar above: maps the PREFILL peer's
+    # "host:port" (the staged export's remote_host:remote_port) to its own
+    # ms/block pull cost, so CPU-only benches can shape SKEWED transfer
+    # topologies — 2 fast pairs, N slow (bench.py --shadow, the
+    # NetKV/ROADMAP-item-2 scenario). Peers absent from the map fall back
+    # to sim_kv_pull_ms_per_block; an empty map (the default) is
+    # bit-identical to the flat-scalar behavior.
+    sim_kv_pull_ms_per_peer: dict[str, float] = dataclasses.field(
+        default_factory=dict)
     # P/D role advertised to the router via labels/metadata.
     role: str = "both"            # "prefill" | "decode" | "both" | "encode"
     engine_id: str = ""

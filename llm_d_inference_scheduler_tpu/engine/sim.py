@@ -249,7 +249,17 @@ class SimEngine:
             if imported:
                 self._commit_prefix_blocks(req)
                 n_pull = len(ktp["remote_block_ids"])
-                pull_s = self.cfg.sim_kv_pull_ms_per_block * n_pull / 1000
+                # Per-peer transfer topology: the prefill peer that staged
+                # the export (remote_host:remote_port) may carry its own
+                # ms/block rate — skewed-pair benches price fast and slow
+                # pairs differently; flat-scalar config is unchanged.
+                rate = self.cfg.sim_kv_pull_ms_per_block
+                peers = self.cfg.sim_kv_pull_ms_per_peer
+                if peers:
+                    rate = peers.get(
+                        f"{ktp.get('remote_host')}:{ktp.get('remote_port')}",
+                        rate)
+                pull_s = rate * n_pull / 1000
                 self.kv_import_stats[req.request_id] = {
                     "ms": pull_s * 1e3,
                     "bytes": n_pull * block * 1024,  # nominal 1KiB/token

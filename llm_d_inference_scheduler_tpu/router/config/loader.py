@@ -51,6 +51,7 @@ class RawConfig:
     kv_cache: dict[str, Any]
     disagg: dict[str, Any]
     timeline: dict[str, Any]
+    shadow: dict[str, Any]
     tls_client: dict[str, Any]
     pool: dict[str, Any]
     objectives: list[dict[str, Any]]
@@ -122,6 +123,12 @@ class RouterConfig:
     # incidents}; enabled: false is the kill-switch that removes the
     # sampler task and the /debug/timeline history entirely).
     timeline: dict[str, Any]
+    # shadow: the counterfactual scheduling ledger knobs (router/shadow.py
+    # ShadowConfig — {enabled, policies, sampleRate, capacity}; no policies
+    # configured (the default) is inert, enabled: false is the hard
+    # kill-switch. Policies evaluate every live scheduling cycle in shadow
+    # and are judged against the measured feeds at /debug/shadow).
+    shadow: dict[str, Any]
     # The parsed YAML verbatim: /debug/config serves a redacted view and
     # router_config_info{hash} fingerprints it.
     raw_doc: dict[str, Any]
@@ -160,6 +167,7 @@ def load_raw_config(text: str | None) -> RawConfig:
         kv_cache=doc.get("kvCache") or {},
         disagg=doc.get("disagg") or {},
         timeline=doc.get("timeline") or {},
+        shadow=doc.get("shadow") or {},
         tls_client=doc.get("tlsClient") or {},
         pool=doc.get("pool") or {},
         objectives=doc.get("objectives") or [],
@@ -356,6 +364,7 @@ def instantiate(raw: RawConfig, handle: Handle,
         kv_cache=raw.kv_cache,
         disagg=raw.disagg,
         timeline=raw.timeline,
+        shadow=raw.shadow,
         raw_doc=raw.doc,
         tls_client=raw.tls_client,
         static_endpoints=static_endpoints,

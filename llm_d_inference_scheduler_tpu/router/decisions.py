@@ -54,7 +54,7 @@ class DecisionRecord:
     __slots__ = ("request_id", "model", "target_model", "priority",
                  "_start", "_admission", "_producers",
                  "_rounds", "_attempts", "_final", "_outcome", "_shed",
-                 "_cache", "_classifier", "top_k")
+                 "_cache", "_classifier", "_shadow", "top_k")
 
     # Container fields are lazily created (None until first write): a record
     # is opened on EVERY request, and five eager container allocations per
@@ -98,6 +98,7 @@ class DecisionRecord:
         self._shed = None
         self._cache = None
         self._classifier = None
+        self._shadow = None
 
     @property
     def start_unix(self) -> float:
@@ -141,6 +142,10 @@ class DecisionRecord:
     def classifier(self) -> dict[str, Any]:
         return (self._classifier if self._classifier is not None
                 else self._EMPTY_DICT)
+
+    @property
+    def shadow(self) -> dict[str, Any]:
+        return self._shadow if self._shadow is not None else self._EMPTY_DICT
 
     # ---- layer hooks ----------------------------------------------------
 
@@ -313,6 +318,17 @@ class DecisionRecord:
         if self._classifier is None:
             self._classifier = block
 
+    def record_shadow(self, block: dict[str, Any]) -> None:
+        """Shadow-policy counterfactual block (router/shadow.py
+        ShadowEvaluator): per-policy shadow pick, verdict, and win margin,
+        with the ``judged`` sub-blocks landing in place at terminal
+        accounting through the shared per-policy dicts (the record_cache
+        contract). Written from the shadow worker thread — a single slot
+        store, GIL-atomic like the scheduler's off-loop round writes.
+        First stamp wins."""
+        if self._shadow is None:
+            self._shadow = block
+
     def record_outcome(self, outcome: dict[str, Any]) -> None:
         """SLO-ledger serving outcome (router/slo.py): predicted vs actual
         TTFT/TPOT vs SLO targets, slo_met verdict, miss reason, and (on the
@@ -354,12 +370,27 @@ class DecisionRecord:
             doc["cache"] = self._cache
         if self._classifier is not None:
             doc["classifier"] = self._classifier
+        if self._shadow is not None:
+            doc["shadow"] = self._render_shadow()
         if compact:
             doc["summary"] = self.summary_line()
             return doc
         doc["producers"] = self.producers
         doc["rounds"] = [self._render_round(r) for r in list(self.rounds)]
         doc["attempts"] = list(self.attempts)
+        return doc
+
+    def _render_shadow(self) -> dict[str, Any]:
+        """Point-in-time copy of the shadow block: the shadow WORKER
+        thread mutates these dicts in place (judged inserts, failover
+        re-evaluation) — same off-loop-writer rule as the scheduler's
+        round dicts, so the render must snapshot via _live_items instead
+        of handing the live dicts to the serializer."""
+        doc = dict(self._shadow)
+        pols = doc.get("policies")
+        if isinstance(pols, dict):
+            doc["policies"] = {name: dict(entry)
+                               for name, entry in self._live_items(pols)}
         return doc
 
     def _render_admission(self) -> dict[str, Any]:
@@ -423,6 +454,23 @@ class DecisionRecord:
             parts.append(f"overload={self._shed.get('action')}")
         if self._classifier is not None:
             parts.append(f"pd={self._classifier.get('verdict')}")
+        shadow = self._shadow
+        if shadow is not None:
+            # Counterfactual verdict beside the pick: which registered
+            # shadow policies would have picked differently. A block whose
+            # every policy abstained (no measured signal yet) must not
+            # read as an endorsement. ONE snapshot for both reads — two
+            # could straddle a worker-side re-evaluation and disagree.
+            items = self._live_items(shadow.get("policies") or {})
+            verdicts = [e.get("verdict") for _, e in items]
+            diverged = [name for name, e in items
+                        if e.get("verdict") == "diverge"]
+            if diverged:
+                parts.append("shadow=diverge:" + ",".join(diverged))
+            elif "agree" in verdicts:
+                parts.append("shadow=agree")
+            else:
+                parts.append("shadow=no_signal")
         cache = self._cache
         if cache is not None:
             # Cache verdict beside the pick: predicted vs engine-confirmed
@@ -491,7 +539,8 @@ def _profile_picked(doc: dict[str, Any], name: str) -> bool:
 def record_matches(doc: dict[str, Any], *, verdict: str | None = None,
                    endpoint: str | None = None,
                    outcome: str | None = None,
-                   profile: str | None = None) -> bool:
+                   profile: str | None = None,
+                   divergent: Any = None) -> bool:
     """Operator-side list-view filters over a rendered record dict (the
     gateway's ``/debug/decisions?verdict=&endpoint=&outcome=&profile=`` —
     and the fleet fan-in forwards the same params to every worker):
@@ -507,7 +556,11 @@ def record_matches(doc: dict[str, Any], *, verdict: str | None = None,
       (a prefill profile produced a pick: the P/D hop ran), ``decode``
       (decode-only: the decider kept it local or the classifier skipped),
       ``skip-hop`` (decode-only specifically because the prefill
-      classifier's verdict was ``skip``).
+      classifier's verdict was ``skip``);
+    - ``divergent``: shadow-policy counterfactual filter (``?divergent=1``)
+      — records where at least one registered shadow policy would have
+      picked differently (the ``shadow`` block's ``diverged`` flag,
+      router/shadow.py).
 
     All given filters must match (AND)."""
     out = doc.get("outcome") or {}
@@ -555,6 +608,11 @@ def record_matches(doc: dict[str, Any], *, verdict: str | None = None,
                 return False
         else:
             return False  # unknown value matches nothing, loudly-by-empty
+    if divergent is not None:
+        if not isinstance(divergent, bool):
+            return False  # unknown value matches nothing, loudly-by-empty
+        if bool((doc.get("shadow") or {}).get("diverged")) != divergent:
+            return False
     return True
 
 

@@ -820,6 +820,63 @@ def merge_kv(docs: list[tuple[int, dict[str, Any]]],
     return out
 
 
+def merge_transfers(docs: list[tuple[int, dict[str, Any]]]) -> dict[str, Any]:
+    """Fleet /debug/transfers: one row per (prefill, decode) pair across
+    shards. The same pair observed by multiple shards used to render as
+    duplicate shard-annotated rows; here the EWMAs merge n-weighted by each
+    shard's measured pull count (the merge_kv precedent), pull/byte totals
+    sum, ``last_unix`` keeps the freshest observation, and ``shards`` lists
+    every worker that contributed. ``ewma_mb_per_s`` is recomputed from the
+    merged EWMAs, never averaged."""
+    merged: dict[tuple[str, str], dict[str, Any]] = {}
+    weights: dict[tuple[str, str], dict[str, float]] = {}
+    for shard, doc in docs:
+        for row in doc.get("pairs") or []:
+            key = (row.get("prefill", ""), row.get("decode", ""))
+            out = merged.get(key)
+            if out is None:
+                out = merged[key] = {"prefill": key[0], "decode": key[1],
+                                     "pulls": 0, "bytes_total": 0,
+                                     "last_unix": 0.0, "shards": []}
+                weights[key] = {"pull": 0.0, "bytes": 0.0, "prefill": 0.0}
+            w = weights[key]
+            pulls = int(row.get("pulls") or 0)
+            out["pulls"] += pulls
+            out["bytes_total"] += int(row.get("bytes_total") or 0)
+            out["last_unix"] = max(out["last_unix"],
+                                   float(row.get("last_unix") or 0.0))
+            out["shards"].append(shard)
+            # EWMA fields weight by the shard's measured pull count; a
+            # prefill-only row (streamed responses carry no engine pull
+            # stats, so pulls == 0) still contributes its prefill EWMA at
+            # weight 1.
+            pw = float(max(pulls, 1))
+            for field, wkey, wval in (("ewma_pull_ms", "pull", float(pulls)),
+                                      ("ewma_bytes", "bytes", float(pulls)),
+                                      ("ewma_prefill_ms", "prefill", pw)):
+                v = row.get(field)
+                if v is None or wval <= 0:
+                    continue
+                prev_w = w[wkey]
+                prev_v = out.get(field)
+                out[field] = (v if prev_v is None or prev_w == 0
+                              else (prev_v * prev_w + v * wval)
+                              / (prev_w + wval))
+                w[wkey] = prev_w + wval
+    pairs = []
+    for out in merged.values():
+        for field in ("ewma_pull_ms", "ewma_bytes", "ewma_prefill_ms"):
+            if out.get(field) is not None:
+                out[field] = round(out[field], 3)
+        if out.get("ewma_bytes") is not None and out.get("ewma_pull_ms"):
+            out["ewma_mb_per_s"] = round(
+                out["ewma_bytes"] / out["ewma_pull_ms"] / 1e3, 3)
+        out["shards"] = sorted(set(out["shards"]))
+        pairs.append(out)
+    pairs.sort(key=lambda r: (r["prefill"], r["decode"]))
+    return {"workers": len(docs), "pairs": pairs}
+
+
 def merge_slo(docs: list[dict[str, Any]]) -> dict[str, Any]:
     """Fleet /debug/slo: the sum of the per-worker ledgers — totals,
     per-endpoint and per-band rollups, miss/shed reason tallies — with
@@ -903,6 +960,7 @@ class FleetAdmin:
             web.get("/debug/slo", self.slo),
             web.get("/debug/transfers", self.transfers),
             web.get("/debug/kv", self.kv),
+            web.get("/debug/shadow", self.shadow),
             web.get("/debug/traces", self.traces),
             web.get("/debug/timeline", self.timeline),
             web.get("/debug/incidents", self.incidents),
@@ -1122,7 +1180,8 @@ class FleetAdmin:
         from urllib.parse import urlencode
 
         params = {"n": str(n)}
-        for key in ("verdict", "endpoint", "outcome", "profile"):
+        for key in ("verdict", "endpoint", "outcome", "profile",
+                    "divergent"):
             v = request.query.get(key)
             if v:
                 params[key] = v
@@ -1175,15 +1234,23 @@ class FleetAdmin:
             leader_shard=int(self.fleet_state().get("leader", 0))))
 
     async def transfers(self, request: web.Request) -> web.Response:
+        """Fleet /debug/transfers: per-pair EWMAs merged n-weighted across
+        shards (merge_transfers) — the same (prefill, decode) pair seen by
+        multiple shards is ONE row, not duplicates."""
         results = await self._fan_out("/debug/transfers")
-        pairs: list[dict] = []
-        for shard, (status, doc) in enumerate(results):
-            if status != 200 or not isinstance(doc, dict):
-                continue
-            for row in doc.get("pairs") or []:
-                row["shard"] = shard
-                pairs.append(row)
-        return web.json_response({"pairs": pairs})
+        return web.json_response(merge_transfers(
+            [(shard, doc) for shard, (status, doc) in enumerate(results)
+             if status == 200 and isinstance(doc, dict)]))
+
+    async def shadow(self, request: web.Request) -> web.Response:
+        """Fleet /debug/shadow: per-policy counterfactual rollups merged
+        n-weighted across shards (router/shadow.py merge_shadow)."""
+        from .shadow import merge_shadow
+
+        results = await self._fan_out("/debug/shadow")
+        return web.json_response(merge_shadow(
+            [(shard, doc) for shard, (status, doc) in enumerate(results)
+             if status == 200 and isinstance(doc, dict)]))
 
     async def traces(self, request: web.Request) -> web.Response:
         """Cross-shard trace fan-in: every worker's /debug/traces merged,

@@ -123,6 +123,17 @@ class InferenceRequest:
     # (x-kv-hit-* headers / usage.prompt_tokens_details) joins it exactly
     # once on completion. None = kvCache kill-switch or no prefix signal.
     cache: Any = None
+    # Shadow-policy observation (router/shadow.py ShadowObservation),
+    # attached by the ShadowEvaluator when the request is sampled for
+    # counterfactual evaluation; the gateway's terminal accounting hands
+    # the measured outcome to the judge through it. None = shadow inert
+    # (no policies configured / kill-switch) or not sampled.
+    shadow: Any = None
+    # Chosen decode pod's address_port, stamped by the disagg profile
+    # handler BEFORE the prefill profile runs — what lets prefill-profile
+    # scorers (transfer-aware-pair-scorer) and shadow policies score the
+    # (prefill, decode) PAIR instead of the legs independently.
+    decode_pick: str | None = None
     # Prefix-hash memo (router/hashmemo.py PrefixHashMemo), lazily attached
     # by the first producer/scorer that needs a hash chain and reused by
     # every later consumer of the cycle — including failover reschedules of
@@ -163,6 +174,12 @@ class ProfileRunResult:
     target_endpoints: list[Endpoint]
     raw_scores: dict[str, dict[str, float]] = dataclasses.field(default_factory=dict)
     # raw_scores: scorer type -> endpoint address_port -> [0,1] score
+    # Weighted per-candidate totals the picker ranked (address_port ->
+    # sum of weight × clamped score). Zero-copy reference to the cycle's
+    # totals dict, frozen after the cycle — shadow policies
+    # (router/shadow.py) re-score counterfactuals from it without
+    # re-running the profile.
+    totals: dict[str, float] = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass

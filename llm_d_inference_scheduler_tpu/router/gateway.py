@@ -72,6 +72,7 @@ from .requestcontrol.director import (
 from .kvobs import H_KV_HIT_BLOCKS, H_KV_HIT_TOKENS, CacheLedger, KvObsConfig
 from .overload import DrainRateEstimator, OverloadConfig, OverloadController
 from .schedpool import LoopLagMonitor, SchedulerPool, SchedulingConfig
+from .shadow import ShadowConfig, ShadowEvaluator
 from .slo import SloConfig, SloLedger, finite_float_or_none
 from .timeline import (
     TimelineConfig,
@@ -171,6 +172,13 @@ class Gateway:
                                      datastore=datastore)
         self.kv_ledger.attach_plugins(cfg.plugins_by_name.values())
 
+        # Shadow policy evaluation (router/shadow.py): the counterfactual
+        # scheduling ledger behind /debug/shadow. Default-on but inert
+        # until `shadow: {policies: [...]}` lists a policy; the live path
+        # pays only an enqueue onto the shadow worker.
+        self.shadow_eval = ShadowEvaluator(ShadowConfig.from_spec(cfg.shadow),
+                                           datastore=datastore)
+
         # Goodput-max overload controller (router/overload.py): predictive
         # SLO admission, degrade ladder, Retry-After shedding. Disabled by
         # default (`overload: {enabled: true}` opts in); the predictor is
@@ -267,7 +275,8 @@ class Gateway:
             response_complete=cfg.response_complete,
             recorder=self.decision_recorder,
             sched_pool=self.sched_pool,
-            overload=self.overload if self.overload.enabled else None)
+            overload=self.overload if self.overload.enabled else None,
+            shadow=self.shadow_eval if self.shadow_eval.active else None)
 
         # Fleet flight recorder (router/timeline.py): the /debug/timeline
         # history + burn-rate monitor + /debug/incidents ring. Default-on
@@ -297,7 +306,8 @@ class Gateway:
             drain_rate_fn=drain_fn,
             degraded_fn=(lambda: self.overload.degraded_total)
             if self.overload.enabled else None,
-            decisions_fn=self._recent_bad_decisions)
+            decisions_fn=self._recent_bad_decisions,
+            shadow=self.shadow_eval if self.shadow_eval.active else None)
 
         # Effective-config identity: the hash covers the UNREDACTED loaded
         # doc (config skew across fleet shards must show even when only
@@ -323,6 +333,7 @@ class Gateway:
             web.get("/debug/slo", self.slo),
             web.get("/debug/transfers", self.transfers),
             web.get("/debug/kv", self.kv),
+            web.get("/debug/shadow", self.shadow_view),
             web.get("/debug/timeline", self.timeline_view),
             web.get("/debug/incidents", self.incidents_view),
             web.get("/debug/config", self.config_view),
@@ -494,6 +505,7 @@ class Gateway:
         if getattr(self, "_upstream", None) is not None:
             await self._upstream.close()
         await self.dl_runtime.stop()
+        self.shadow_eval.stop()
         self.sched_pool.shutdown()
         if self.tls is not None:
             self.tls.close()
@@ -564,8 +576,19 @@ class Gateway:
         endpoint = request.query.get("endpoint") or None
         outcome = request.query.get("outcome") or None
         profile = request.query.get("profile") or None
+        # ?divergent=1 — shadow-policy counterfactual filter: only records
+        # where a registered shadow policy would have picked differently
+        # (?divergent=0 inverts; any other value matches nothing,
+        # loudly-by-empty — the sibling filters' convention).
+        # router/shadow.py, docs/shadow.md.
+        div_q = request.query.get("divergent")
+        divergent: Any = (None if div_q in (None, "")
+                          else True if div_q in ("1", "true")
+                          else False if div_q in ("0", "false")
+                          else "invalid")
         filtered = verdict is not None or endpoint is not None \
-            or outcome is not None or profile is not None
+            or outcome is not None or profile is not None \
+            or divergent is not None
         # Filtering scans the WHOLE ring (the n newest matches, not the
         # matches within the n newest); the unfiltered path keeps the
         # cheap bounded snapshot.
@@ -589,7 +612,7 @@ class Gateway:
                         probe["rounds"] = r.rounds
                 if not record_matches(probe, verdict=verdict,
                                       endpoint=endpoint, outcome=outcome,
-                                      profile=profile):
+                                      profile=profile, divergent=divergent):
                     continue
             docs.append(doc)
             if len(docs) >= n:
@@ -759,6 +782,13 @@ class Gateway:
         if self._snapshot_sub is not None:
             self._snapshot_sub.retarget(path)
         return web.json_response({"role": self.fleet.role, "ipcPath": path})
+
+    async def shadow_view(self, request: web.Request) -> web.Response:
+        """Shadow-policy counterfactual ledger rollup (router/shadow.py):
+        per-policy agreement rate, coverage, signed estimated-regret ms,
+        and the recent-divergence ring — every registered policy's regret
+        curve, measured in shadow before a config activates it live."""
+        return web.json_response(self.shadow_eval.snapshot())
 
     async def kv(self, request: web.Request) -> web.Response:
         """KV-cache & prefix-reuse observability rollup (router/kvobs.py):
@@ -990,6 +1020,8 @@ class Gateway:
                     ireq.decision.finalize(429, reason=EVICTED_REASON)
                 self.slo_ledger.complete(ireq, status=429,
                                          reason=EVICTED_REASON)
+                self.shadow_eval.observe_response(ireq, transfer=None,
+                                                  status=429)
                 return web.json_response(
                     {"error": EVICTED_REASON}, status=429,
                     headers={X_REMOVAL_REASON: EVICTED_REASON,
@@ -1000,6 +1032,8 @@ class Gateway:
             # stream is slo_met=false, not an absent row).
             self.slo_ledger.complete(ireq, status=499,
                                      reason="cancelled-mid-stream")
+            self.shadow_eval.observe_response(ireq, transfer=None,
+                                              status=499)
             raise
         finally:
             self.evictor.deregister(evict_key)
@@ -1162,6 +1196,14 @@ class Gateway:
         # last failure with the canonical x-removal-reason contract.
         if ireq is not None:
             self.director.handle_response_complete(None, ireq, last_target, {})
+            # Shadow judge on the FAILED terminal too: a sampled
+            # divergence on a request that then timed out must not stay
+            # unjudged forever — that would bias the regret curve toward
+            # successful requests. No transfer row; the judge's EWMA
+            # fallback exists for exactly this.
+            self.shadow_eval.observe_response(
+                ireq, transfer=None,
+                status=failure.status if failure is not None else 503)
         dec_headers = self._decision_headers(ireq)
         if failure is not None and failure.kind == "deadline":
             DEADLINE_EXCEEDED_TOTAL.inc()
@@ -1433,6 +1475,11 @@ class Gateway:
                 self.slo_ledger.complete(ireq, status=resp.status,
                                          endpoint=endpoint, usage=usage,
                                          transfer=transfer)
+                # Shadow judge (router/shadow.py): hand the measured
+                # outcome to the counterfactual ledger — one attribute
+                # check for unsampled requests, an enqueue otherwise.
+                self.shadow_eval.observe_response(ireq, transfer=transfer,
+                                                  status=resp.status)
                 if (self.overload.enabled and resp.status < 400
                         and (obs is None or obs.abort_reason is None)):
                     # Served-outcome feedback for the overload controller:

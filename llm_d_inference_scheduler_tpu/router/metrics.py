@@ -291,6 +291,28 @@ CONFIG_INFO = Gauge(
     "Constant 1, labeled with the xxh64 hash of the effective loaded "
     "config — scrape-joinable config-skew detection (redacted snapshot at "
     "/debug/config)", ("hash",), registry=REGISTRY)
+# Shadow policy evaluation (router/shadow.py): counterfactual scheduling
+# verdicts and the signed estimated-regret distribution per registered
+# policy. Policy/verdict label sets are bounded by the configured policy
+# list and the fixed verdict enum; per-request detail is the DecisionRecord
+# shadow block, the per-policy rollup is GET /debug/shadow.
+SHADOW_DECISIONS_TOTAL = Counter(
+    "router_shadow_decisions_total",
+    "Shadow-policy counterfactual verdicts per evaluated scheduling cycle "
+    "(verdict: agree = shadow pick matches the live pick, diverge = the "
+    "policy would have picked differently, no_signal = the policy's "
+    "measured feed has no data yet)",
+    ("policy", "verdict"), registry=REGISTRY)
+SHADOW_REGRET_MS = Histogram(
+    "router_shadow_regret_ms",
+    "Signed estimated regret of the LIVE policy per judged divergent pick "
+    "(live measured cost minus the shadow arm's estimate from the measured "
+    "feeds; positive = the shadow policy would have been cheaper). Only "
+    "judged divergences observe — agreements credit both arms at "
+    "/debug/shadow instead",
+    ("policy",), registry=REGISTRY,
+    buckets=(-250, -100, -50, -25, -10, -5, -1, 0,
+             1, 5, 10, 25, 50, 100, 250))
 # Confirmed-index replication (router/fleet.py): a follower that detects a
 # sequence gap in the leader's KV delta stream stops applying deltas and
 # waits for the next full-index checkpoint frame to resync. Worker-side —

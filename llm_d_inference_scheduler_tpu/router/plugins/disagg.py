@@ -403,6 +403,13 @@ class DisaggProfileHandler(PluginBase):
 
         to_run: dict[str, Any] = {}
         decode_ep = decode_res.target_endpoints[0]
+        # Pair-scoring hook: the chosen decode pod, stamped BEFORE the
+        # prefill profile runs, is what lets prefill-profile scorers
+        # (transfer-aware-pair-scorer) and shadow policies
+        # (router/shadow.py) score the (prefill, decode) PAIR instead of
+        # the legs independently — NetKV (arXiv:2606.03910), ROADMAP item
+        # 2. One attribute store; thread-safe for off-loop cycles.
+        request.decode_pick = decode_ep.metadata.address_port
         if (self.ENCODE in profiles and self.ENCODE not in results
                 and self.encode_decider is not None
                 and self.encode_decider.disaggregate(ctx, request, decode_ep)):

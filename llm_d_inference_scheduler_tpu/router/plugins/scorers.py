@@ -8,6 +8,7 @@ from typing import Any
 from ..framework.datalayer import Endpoint
 from ..framework.plugin import PluginBase, register_plugin
 from ..framework.scheduling import CycleState, InferenceRequest
+from ..shadow import transfer_pair_scores
 from .attributes import (
     INFLIGHT_ATTRIBUTE_KEY,
     PREFIX_ATTRIBUTE_KEY,
@@ -25,6 +26,44 @@ def _normalized_inverse(values: dict[str, float]) -> dict[str, float]:
     if hi == lo:
         return {k: 1.0 for k in values}
     return {k: (hi - v) / (hi - lo) for k, v in values.items()}
+
+
+@register_plugin("transfer-aware-pair-scorer")
+class TransferAwarePairScorer(PluginBase):
+    """Transfer-cost-aware joint P/D pair scoring (NetKV, arXiv:2606.03910
+    — ROADMAP item 2): scores PREFILL candidates by the measured KV-pull
+    cost of the (candidate, chosen-decode) pair, read from the Datastore's
+    per-pair TransferTable EWMAs. The decode pick the disagg handler
+    stamped (``request.decode_pick``) fixes the other half of the pair, so
+    adding this scorer to the prefill profile makes the pick jointly
+    pair-aware.
+
+    The scoring function is shared with the ``transfer-pair`` shadow
+    policy (router/shadow.py ``transfer_pair_scores``) — the shadow ledger
+    proves this scorer's regret curve BEFORE a config activates it live
+    (docs/shadow.md). No signal (no decode pick / no measured pairs yet)
+    scores nothing: the base scorers keep ranking alone."""
+
+    # Audited: score() reads one request attribute and the TransferTable's
+    # plain dict + stat fields — each access is a GIL-atomic load, and the
+    # gateway's loop-bound writer never tears a row mid-read.
+    THREAD_SAFE = True
+
+    def __init__(self, name: str | None = None):
+        super().__init__(name)
+        self._datastore: Any = None
+
+    def configure(self, params: dict[str, Any], handle: Any) -> None:
+        self._datastore = getattr(handle, "datastore", None)
+
+    def score(self, ctx, state, request, endpoints):
+        decode = getattr(request, "decode_pick", None)
+        if self._datastore is None or not decode:
+            return {}
+        scores = transfer_pair_scores(
+            self._datastore.transfers, decode,
+            [ep.metadata.address_port for ep in endpoints])
+        return scores or {}
 
 
 @register_plugin("queue-scorer", "queue")

@@ -67,7 +67,8 @@ class Director:
                  response_complete: list[Any] | None = None,
                  recorder: Any = None,
                  sched_pool: Any = None,
-                 overload: Any = None):
+                 overload: Any = None,
+                 shadow: Any = None):
         self.datastore = datastore
         self.scheduler = scheduler
         self.admission = admission
@@ -83,6 +84,11 @@ class Director:
         # None or disabled → request.decision stays None and every layer
         # hook costs one `is None` check.
         self.recorder = recorder
+        # Shadow policy evaluator (router/shadow.py): every live scheduling
+        # result is handed to the counterfactual ledger AFTER the cycle —
+        # the hot path pays only an enqueue; None or inert (no policies)
+        # costs one attribute check.
+        self.shadow = shadow
         self.producers = producers or []
         self.admit_plugins = admit_plugins or []
         self.pre_request_plugins = pre_request_plugins or []
@@ -250,6 +256,12 @@ class Director:
             raise RequestError(503, f"scheduling failed: {e}") from None
         request.scheduling_result = result
 
+        # 7b. shadow policy evaluation (router/shadow.py): submit the live
+        # cycle's frozen result to the counterfactual ledger. Enqueue-only
+        # on this path; evaluation runs on the shadow worker.
+        if self.shadow is not None:
+            self.shadow.submit(request, result)
+
         # 8. prepare: destination header + PreRequest plugins (director.go:347-372)
         primary = result.primary().target_endpoints
         request.headers[H_DESTINATION] = ",".join(
@@ -339,6 +351,12 @@ class Director:
         # candidates, DP rank) match the re-scheduled result.
         for p in self.pre_request_plugins:
             p.pre_request(ctx, request, result)
+        # Shadow re-evaluation against the pick that will actually serve
+        # (router/shadow.py; the PR 11 classifier re-classification
+        # precedent) — judging the measured outcome against the
+        # pre-failover pick would bias the regret curve.
+        if self.shadow is not None:
+            self.shadow.submit(request, result, resubmit=True)
         return result
 
     # ---- fallback & response path ----------------------------------------

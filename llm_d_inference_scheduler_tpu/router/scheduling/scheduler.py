@@ -190,7 +190,8 @@ class SchedulerProfile:
             rec.profile_picker(rec_sec, pname, picked_keys, totals)
         if not picked:
             return None
-        return ProfileRunResult(target_endpoints=picked, raw_scores=raw_scores)
+        return ProfileRunResult(target_endpoints=picked,
+                                raw_scores=raw_scores, totals=totals)
 
 
 class Scheduler:

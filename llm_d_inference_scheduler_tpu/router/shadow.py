@@ -1,0 +1,741 @@
+"""Shadow policy evaluation: the counterfactual scheduling ledger.
+
+Every placement change so far shipped with its own one-off A/B bench; the
+ROADMAP's remaining placement items — transfer-cost-aware joint P/D pairing
+(NetKV, arXiv:2606.03910) and the self-balancing pool (P/D-Serve,
+arXiv:2408.08147) — all change *what the router picks*, which until this
+module could only be evaluated by flipping the policy live and hoping. The
+missing observability layer is counterfactual: run a candidate policy in
+shadow on every live scheduling cycle, record where it diverges from the
+live pick, and judge its estimated benefit against the measured ground
+truth the ledgers already collect (TransferTable pull EWMAs, KvHitTable hit
+EWMAs, the SLO ledger's measured outcomes) — so every future placement PR
+lands with its regret curve already measured instead of argued.
+
+Mechanics:
+
+- the Director submits every scheduling result to the ``ShadowEvaluator``
+  (``shadow: {enabled, policies, sampleRate, capacity}``; no policies
+  configured = inert, one attribute check — the kvCache/timeline
+  default-on precedent). The hot path pays only an enqueue: evaluation,
+  judging, and every rollup mutation run on ONE dedicated shadow worker
+  thread (single-writer ledger discipline — the PR 5 scheduler pool has N
+  workers, so funnelling through it would need locks on every counter);
+- the shadow policy re-scores over the SAME immutable inputs the live
+  cycle produced: the per-profile weighted totals (``ProfileRunResult
+  .totals``, frozen after the cycle) over the PR 5 snapshot views, plus
+  the measured feeds on the Datastore. No second scheduling cycle, no
+  metric pollution, bit-reproducible;
+- the shadow pick, win margin, and divergence land as a ``shadow`` block
+  on the DecisionRecord (``/debug/decisions/<id>``, ``shadow=`` in the
+  summary echo, ``?divergent=1`` list filter);
+- the judge **never assumes**: on agreement the request's measured outcome
+  credits both arms; on divergence the shadow arm's cost is estimated from
+  the measured feeds (per-pair TransferTable pull EWMAs) while the live
+  arm uses this request's own measured ``x-kv-transfer-ms`` where present.
+  Per-policy agreement rate, coverage, and signed estimated-regret ms roll
+  up at ``GET /debug/shadow`` with ``router_shadow_decisions_total``
+  / ``router_shadow_regret_ms`` families, a timeline series, and fleet
+  fan-in (``merge_shadow``, n-weighted across shards).
+
+The first registered policy is ROADMAP item 2 itself: the transfer-cost-
+aware joint P/D pair scorer. The decode pick stays fixed (it is driven by
+cache affinity — overriding it in shadow would discard the reuse the
+session/prefix scorers placed for); the PREFILL leg is re-picked by pair
+score = live prefill profile total + weight × measured-pull-cost score for
+the (candidate, chosen-decode) pair. Its live twin —
+``transfer-aware-pair-scorer`` (plugins/scorers.py) — computes the SAME
+score as a config-activatable scheduling plugin, so a future PR activates
+the policy by adding one pluginRef to the prefill profile;
+``bench.py --shadow`` validates that the shadow ledger's estimated regret
+agrees (sign + documented error band) with a live A/B arm running exactly
+that activation. See docs/shadow.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import queue
+import threading
+from collections import deque
+from typing import Any
+
+import xxhash
+
+from .metrics import SHADOW_DECISIONS_TOTAL, SHADOW_REGRET_MS
+
+log = logging.getLogger("router.shadow")
+
+# Score handed to a (prefill, decode) pair with no measured transfer row
+# yet: neutral — an unmeasured pair is neither punished nor favored over
+# the measured field (exploration stays with the base scorers).
+UNMEASURED_PAIR_SCORE = 0.5
+
+
+def transfer_pair_scores(table: Any, decode: str,
+                         candidates: list[str]) -> dict[str, float] | None:
+    """Normalized [0, 1] transfer-cost scores for pairing each PREFILL
+    candidate with the chosen ``decode`` pod — higher = cheaper measured
+    pull. The single scoring function shared by the shadow transfer-pair
+    policy and its live ``transfer-aware-pair-scorer`` twin, so the shadow
+    verdict is exactly the live activation's behavior.
+
+    Returns None when NO candidate pair has a measured pull EWMA (no
+    signal — the policy abstains rather than scoring noise); pairs without
+    their own row score ``UNMEASURED_PAIR_SCORE``.
+    """
+    costs: dict[str, float] = {}
+    for p in candidates:
+        stats = table.pair(p, decode)
+        if stats is not None and stats.ewma_pull_ms is not None:
+            costs[p] = stats.ewma_pull_ms
+    if not costs:
+        return None
+    lo, hi = min(costs.values()), max(costs.values())
+    if hi == lo:
+        # One distinct measured cost carries no COMPARATIVE signal — score
+        # everything neutral. Awarding the sole measured pair 1.0 over
+        # unmeasured 0.5 would self-reinforce: the (possibly slow)
+        # measured pair keeps winning, stays the only measured pair, and
+        # faster pairs are never explored.
+        return {p: UNMEASURED_PAIR_SCORE for p in candidates}
+    out: dict[str, float] = {}
+    for p in candidates:
+        c = costs.get(p)
+        out[p] = (UNMEASURED_PAIR_SCORE if c is None
+                  else (hi - c) / (hi - lo))
+    return out
+
+
+@dataclasses.dataclass
+class ShadowConfig:
+    """The YAML ``shadow:`` section. Default-on but inert until a policy is
+    listed (the kvCache precedent: the kill-switch restores the
+    zero-overhead baseline, and an empty policy list IS the baseline).
+
+    - ``policies``: list of policy specs — a bare name (``transfer-pair``)
+      or ``{type, parameters}``;
+    - ``sampleRate``: fraction of scheduling cycles evaluated, derived
+      deterministically from the request id (process-stable, the
+      flow_shard rationale) so fleet shards sample identically;
+    - ``capacity``: per-policy bound on the recent-divergence ring served
+      at /debug/shadow.
+    """
+
+    enabled: bool = True
+    policies: list[Any] = dataclasses.field(default_factory=list)
+    sample_rate: float = 1.0
+    capacity: int = 128
+
+    @classmethod
+    def from_spec(cls, spec: dict[str, Any] | None) -> "ShadowConfig":
+        spec = spec or {}
+        rate = float(spec.get("sampleRate", 1.0))
+        if not 0.0 <= rate <= 1.0:
+            raise ValueError("shadow.sampleRate must be in [0, 1]")
+        return cls(enabled=bool(spec.get("enabled", True)),
+                   policies=list(spec.get("policies") or []),
+                   sample_rate=rate,
+                   capacity=max(1, int(spec.get("capacity", 128))))
+
+
+class TransferAwarePairPolicy:
+    """ROADMAP item 2 in shadow: score the (prefill, decode) *pair*, not
+    the legs. The decode pick is kept (cache affinity placed it); the
+    prefill leg is re-picked by ``live prefill total + weight ×
+    transfer_pair_scores`` — byte-identical to what the live profile would
+    compute with ``transfer-aware-pair-scorer`` appended at ``weight``.
+
+    Judge semantics (docs/shadow.md): regret is the estimated KV-pull
+    delta in ms per diverging request — the live arm's measured
+    ``x-kv-transfer-ms`` (falling back to the live pair's pull EWMA on
+    streamed responses, which carry no engine pull stats) minus the shadow
+    pair's pull EWMA. Positive regret = the live policy paid more than the
+    shadow pair would have.
+    """
+
+    name = "transfer-pair"
+
+    def __init__(self, params: dict[str, Any] | None, datastore: Any):
+        params = params or {}
+        self.datastore = datastore
+        self.weight = float(params.get("weight", 2.0))
+        self.prefill_profile = str(params.get("prefillProfile", "prefill"))
+        self.decode_profile = str(params.get("decodeProfile", "decode"))
+
+    # ---- evaluation (shadow worker thread) ------------------------------
+
+    def evaluate(self, request: Any, result: Any) -> dict[str, Any] | None:
+        """One counterfactual pass over the live cycle's frozen outputs.
+        Returns the explainable entry dict (stamped into the
+        DecisionRecord shadow block), or None when the request is
+        ineligible (no P/D hop — decode-only, classifier skip)."""
+        pr = result.profile_results.get(self.prefill_profile)
+        dr = result.profile_results.get(self.decode_profile)
+        if (pr is None or dr is None or not pr.target_endpoints
+                or not dr.target_endpoints or not pr.totals):
+            return None
+        decode = dr.target_endpoints[0].metadata.address_port
+        live = pr.target_endpoints[0].metadata.address_port
+        totals = pr.totals
+        entry: dict[str, Any] = {
+            "live": {"prefill": live, "decode": decode},
+        }
+        tscores = transfer_pair_scores(self.datastore.transfers, decode,
+                                       list(totals))
+        if tscores is None:
+            entry["verdict"] = "no_signal"
+            return entry
+        # When the live twin (transfer-aware-pair-scorer) is ALREADY in
+        # the profile, the live totals include its weighted contribution —
+        # re-adding it would score base + 2w×t and mint false divergences
+        # against the very policy that is live. The counterfactual then
+        # IS the live policy: evaluate the totals as-is (activation
+        # monitoring — verdicts degenerate to agreement unless something
+        # else, e.g. a failover, moved the pick).
+        live_twin = any("transfer-aware-pair-scorer" in name
+                        for name in pr.raw_scores)
+        if live_twin:
+            entry["live_twin_active"] = True
+            shadow_totals = dict(totals)
+        else:
+            shadow_totals = {p: totals[p] + self.weight * tscores[p]
+                             for p in totals}
+        # Stable argmax with the live pick winning ties: a tie must never
+        # mint a divergence (there is no counterfactual benefit to judge).
+        best, best_v = live, shadow_totals.get(live, float("-inf"))
+        for p, v in shadow_totals.items():
+            if v > best_v + 1e-12:
+                best, best_v = p, v
+        live_v = shadow_totals.get(live, 0.0)
+        entry["shadow"] = {"prefill": best}
+        entry["margin"] = round(best_v - live_v, 6)
+        entry["verdict"] = "diverge" if best != live else "agree"
+        return entry
+
+    # ---- judge (shadow worker thread, at terminal accounting) -----------
+
+    def judge(self, entry: dict[str, Any],
+              outcome: dict[str, Any]) -> tuple[str, float | None] | None:
+        """Judge one entry against the measured outcome, mutating the
+        SAME dict (the ``judged`` sub-block lands in /debug/decisions/<id>
+        through the shared reference — the kvobs precedent). Returns
+        (verdict, value): agreement value = the measured pull crediting
+        both arms; divergence value = signed estimated-regret ms, or None
+        when no estimate exists for the shadow pair."""
+        if entry.get("verdict") == "no_signal" or "judged" in entry:
+            return None
+        table = self.datastore.transfers
+        decode = entry["live"]["decode"]
+        transfer = outcome.get("transfer") or {}
+        live_ms = transfer.get("pull_ms")
+        live_source = "measured"
+        if live_ms is None:
+            # Streamed responses carry no engine pull stats — fall back to
+            # the live pair's own measured EWMA.
+            stats = table.pair(entry["live"]["prefill"], decode)
+            live_ms = stats.ewma_pull_ms if stats is not None else None
+            live_source = "ewma"
+        if entry["verdict"] == "agree":
+            judged: dict[str, Any] = {"agreed": True}
+            if live_ms is not None:
+                judged["pull_ms"] = round(live_ms, 3)
+                judged["source"] = live_source
+            entry["judged"] = judged
+            # Only a genuinely MEASURED pull credits the agree-measured
+            # tally — feeding the EWMA fallback back in would blend the
+            # table's own estimates into a field documented as measured.
+            return ("agree",
+                    live_ms if live_source == "measured" else None)
+        stats = table.pair(entry["shadow"]["prefill"], decode)
+        est_shadow = stats.ewma_pull_ms if stats is not None else None
+        if live_ms is None or est_shadow is None:
+            entry["judged"] = {"estimate": "unavailable"}
+            return ("diverge", None)
+        regret = live_ms - est_shadow
+        entry["judged"] = {
+            "live_pull_ms": round(live_ms, 3),
+            "live_source": live_source,
+            "shadow_est_pull_ms": round(est_shadow, 3),
+            "est_regret_ms": round(regret, 3),
+        }
+        return ("diverge", regret)
+
+
+# Shadow policy registry: name → factory(params, datastore). Future
+# placement PRs register here and flip on via `shadow.policies` config.
+SHADOW_POLICIES: dict[str, Any] = {
+    TransferAwarePairPolicy.name: TransferAwarePairPolicy,
+}
+
+
+class _PolicyStats:
+    """One policy's rollup. Mutated ONLY on the shadow worker thread
+    (single-writer); /debug/shadow renders a point-in-time view from the
+    event loop (int/float reads are GIL-atomic)."""
+
+    __slots__ = ("evaluated", "agreements", "divergences", "no_signal",
+                 "judged_agree", "judged_diverge", "estimate_missing",
+                 "regret_n", "regret_sum", "regret_abs",
+                 "agree_measured_n", "agree_measured_sum", "ring")
+
+    def __init__(self, capacity: int):
+        self.evaluated = 0
+        self.agreements = 0
+        self.divergences = 0
+        self.no_signal = 0
+        self.judged_agree = 0
+        self.judged_diverge = 0
+        self.estimate_missing = 0
+        self.regret_n = 0
+        self.regret_sum = 0.0
+        self.regret_abs = 0.0
+        self.agree_measured_n = 0
+        self.agree_measured_sum = 0.0
+        self.ring: deque[dict[str, Any]] = deque(maxlen=capacity)
+
+    def render(self, submitted: int) -> dict[str, Any]:
+        decided = self.agreements + self.divergences
+        doc: dict[str, Any] = {
+            "evaluated": self.evaluated,
+            "agreements": self.agreements,
+            "divergences": self.divergences,
+            "no_signal": self.no_signal,
+            "agreement_rate": (round(self.agreements / decided, 4)
+                               if decided else None),
+            # Coverage: fraction of submitted scheduling cycles this policy
+            # produced a verdict for (sampling × eligibility × signal).
+            "coverage": (round(decided / submitted, 4) if submitted
+                         else None),
+            "judged": {"agreements": self.judged_agree,
+                       "divergences": self.judged_diverge,
+                       "estimate_missing": self.estimate_missing},
+        }
+        if self.regret_n:
+            doc["est_regret_ms"] = {
+                "n": self.regret_n,
+                "sum": round(self.regret_sum, 3),
+                "mean": round(self.regret_sum / self.regret_n, 3),
+                "mean_abs": round(self.regret_abs / self.regret_n, 3),
+            }
+        else:
+            doc["est_regret_ms"] = {"n": 0}
+        if self.agree_measured_n:
+            doc["agree_measured_pull_ms_mean"] = round(
+                self.agree_measured_sum / self.agree_measured_n, 3)
+            # The count the mean was taken over — judged agreements whose
+            # live pull was actually measured (streamed responses with no
+            # pair EWMA yet judge without one). merge_shadow MUST weight
+            # by this, not by judged agreements.
+            doc["agree_measured_n"] = self.agree_measured_n
+        doc["recent_divergences"] = list(self.ring)
+        return doc
+
+
+class ShadowObservation:
+    """Per-request shadow state riding ``request.shadow``: created
+    synchronously at submit (so the completion hook knows the request was
+    sampled), entries + the record block filled by the worker, ``done``
+    guards the terminal enqueue to exactly once. ``entries == {}`` (empty,
+    not None) marks an evaluation where no policy produced an entry — the
+    terminal hook then skips its enqueue entirely."""
+
+    __slots__ = ("entries", "block", "done")
+
+    def __init__(self):
+        self.entries: dict[str, dict[str, Any]] | None = None
+        self.block: dict[str, Any] | None = None
+        self.done = False
+
+
+_SENTINEL = object()
+
+
+class ShadowEvaluator:
+    """The counterfactual ledger. Hot-path contract: ``submit`` /
+    ``observe_response`` cost one attribute check when inert (no policies
+    or kill-switch) and one ``SimpleQueue.put`` when active — evaluation,
+    judging, and all rollup writes happen on the dedicated shadow worker
+    thread (see module docstring for the single-writer rationale;
+    ``bench.py --shadow`` measures the hook against the SCHED_HOTPATH
+    cycle floor). Backlog is BOUNDED: a worker that falls behind the
+    arrival rate (a stalled future policy) sheds new events instead of
+    pinning request graphs until OOM — drops are counted and visible at
+    /debug/shadow, never silent."""
+
+    # Worker backlog bound: each queued event pins its request +
+    # SchedulingResult graph, so the queue must not grow without limit
+    # when a policy is slower than the arrival rate. Shadow evaluation is
+    # advisory — shedding it is always safe.
+    MAX_QUEUE = 4096
+
+    def __init__(self, cfg: ShadowConfig | None = None, *,
+                 datastore: Any = None):
+        self.cfg = cfg or ShadowConfig()
+        self.datastore = datastore
+        self._policies: list[Any] = []
+        self._by_name: dict[str, Any] = {}
+        self._stats: dict[str, _PolicyStats] = {}
+        for spec in self.cfg.policies:
+            if isinstance(spec, str):
+                spec = {"type": spec}
+            ptype = spec.get("type") or spec.get("name")
+            factory = SHADOW_POLICIES.get(ptype)
+            if factory is None:
+                raise ValueError(
+                    f"unknown shadow policy {ptype!r} "
+                    f"(registered: {sorted(SHADOW_POLICIES)})")
+            policy = factory(spec.get("parameters") or {}, datastore)
+            if policy.name in self._by_name:
+                raise ValueError(f"duplicate shadow policy {policy.name!r}")
+            self._policies.append(policy)
+            self._by_name[policy.name] = policy
+            self._stats[policy.name] = _PolicyStats(self.cfg.capacity)
+        self._active = bool(self.cfg.enabled and self._policies)
+        # Deterministic per-request sampling threshold (permille of 10k).
+        self._sample_bound = int(self.cfg.sample_rate * 10_000)
+        self._q: queue.SimpleQueue = queue.SimpleQueue()
+        self._worker: threading.Thread | None = None
+        # Flat counters for the timeline sampler's per-tick deltas (worker
+        # writes, loop reads — int/float loads are GIL-atomic).
+        self._submitted = 0
+        self._evaluated_total = 0
+        self._diverged_total = 0
+        self._regret_ms_sum = 0.0
+        self._dropped = 0
+
+    # ---- hot-path hooks (event loop / scheduler workers) ----------------
+
+    @property
+    def active(self) -> bool:
+        return self._active
+
+    @property
+    def evaluated_total(self) -> int:
+        return self._evaluated_total
+
+    @property
+    def diverged_total(self) -> int:
+        return self._diverged_total
+
+    @property
+    def regret_ms_sum(self) -> float:
+        return self._regret_ms_sum
+
+    def submit(self, request: Any, result: Any, *,
+               resubmit: bool = False) -> None:
+        """Enqueue one live scheduling cycle for shadow evaluation. The
+        result's profile totals/raw scores are frozen after the cycle, so
+        the worker reads them race-free (the PR 5 snapshot contract).
+
+        ``resubmit`` is the failover-reschedule path (the Director): the
+        SAME request re-evaluates against the fresh result and the worker
+        REPLACES the prior verdict in place — the judge must grade the
+        pick that actually serves, not the pre-failover one (the PR 11
+        classifier's re-classification precedent). A reschedule of an
+        unsampled request stays unsampled."""
+        if not self._active or result is None:
+            return
+        obs: ShadowObservation | None = getattr(request, "shadow", None)
+        if obs is not None:
+            # Re-evaluation of an already-sampled request (failover).
+            if not obs.done and not self._shed():
+                self._q.put(("sched", request, result))
+            return
+        if resubmit:
+            return  # the original cycle was not sampled
+        self._submitted += 1
+        if self._sample_bound < 10_000 and (
+                xxhash.xxh64_intdigest(request.request_id) % 10_000
+                >= self._sample_bound):
+            return
+        if self._shed():
+            return  # backlog full — sampled-but-shed, counted
+        request.shadow = ShadowObservation()
+        if self._worker is None:
+            self._start_worker()
+        self._q.put(("sched", request, result))
+
+    def _shed(self) -> bool:
+        """Backlog guard: True when the worker queue is over the bound
+        (the event is dropped and counted — shadow work is advisory)."""
+        if self._q.qsize() < self.MAX_QUEUE:
+            return False
+        self._dropped += 1
+        return True
+
+    def observe_response(self, request: Any, *,
+                         transfer: dict[str, Any] | None = None,
+                         status: int = 0) -> None:
+        """Terminal hook (the gateway's proxy accounting): hand the
+        measured outcome to the judge. One attribute check for unsampled
+        requests."""
+        obs: ShadowObservation | None = getattr(request, "shadow", None)
+        if obs is None or obs.done:
+            return
+        obs.done = True
+        if obs.entries is not None and not obs.entries:
+            # Evaluated, but no policy produced an entry (ineligible
+            # traffic — decode-only, classifier skip): nothing to judge,
+            # skip the worker wakeup entirely.
+            return
+        if self._shed():
+            return
+        self._q.put(("done", request,
+                     {"transfer": transfer, "status": status}))
+
+    # ---- worker ---------------------------------------------------------
+
+    def _start_worker(self) -> None:
+        # submit() runs on the event loop only (the Director), so lazy
+        # start needs no lock.
+        t = threading.Thread(target=self._run, name="shadow-worker",
+                             daemon=True)
+        self._worker = t
+        t.start()
+
+    def stop(self) -> None:
+        if self._worker is not None:
+            self._q.put(_SENTINEL)
+            self._worker.join(timeout=2.0)
+            self._worker = None
+
+    def flush(self, timeout: float = 5.0) -> bool:
+        """Block until every event enqueued so far has been processed
+        (tests and the bench use it; never called on the serving path)."""
+        if self._worker is None:
+            return True
+        ev = threading.Event()
+        self._q.put(("flush", ev))
+        return ev.wait(timeout)
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is _SENTINEL:
+                return
+            try:
+                kind = item[0]
+                if kind == "sched":
+                    self._evaluate(item[1], item[2])
+                elif kind == "done":
+                    self._judge(item[1], item[2])
+                elif kind == "flush":
+                    item[1].set()
+            except Exception:
+                log.exception("shadow worker event failed")
+
+    def _count_verdict(self, stats: _PolicyStats, verdict: str,
+                       sign: int) -> None:
+        stats.evaluated += sign
+        self._evaluated_total += sign
+        if verdict == "diverge":
+            stats.divergences += sign
+            self._diverged_total += sign
+        elif verdict == "agree":
+            stats.agreements += sign
+        else:
+            stats.no_signal += sign
+
+    def _evaluate(self, request: Any, result: Any) -> None:
+        # Captured BEFORE evaluating: a re-submitted request object (or a
+        # caller clearing request.shadow) must not crash the worker —
+        # verdicts still count, only the per-request stamp is skipped.
+        obs: ShadowObservation | None = getattr(request, "shadow", None)
+        prior = (obs.entries if obs is not None else None) or {}
+        entries: dict[str, dict[str, Any]] = {}
+        for policy in self._policies:
+            stats = self._stats[policy.name]
+            try:
+                entry = policy.evaluate(request, result)
+            except Exception:
+                log.exception("shadow policy %s evaluate failed",
+                              policy.name)
+                continue
+            if entry is None:
+                continue
+            # Failover re-evaluation REPLACES the prior verdict (an
+            # unjudged one — once the response landed the verdict is
+            # history): the ledger must grade the pick that serves, so
+            # back the superseded verdict out of the rollup. Prometheus
+            # counters stay cumulative (every evaluation is an event).
+            old = prior.get(policy.name)
+            if old is not None and "judged" not in old:
+                self._count_verdict(stats, old["verdict"], -1)
+            self._count_verdict(stats, entry["verdict"], +1)
+            SHADOW_DECISIONS_TOTAL.labels(policy.name,
+                                          entry["verdict"]).inc()
+            entries[policy.name] = entry
+        if obs is None:
+            return
+        if obs.entries is None:
+            obs.entries = entries
+        else:
+            # Failover re-evaluation: a policy that ABSTAINED this round
+            # (e.g. the reschedule produced a decode-only result) must
+            # not keep its stale pre-failover verdict — judging it
+            # against the new pick's measured outcome would mint regret
+            # for a pair that never served. Back it out and drop it.
+            for name, old in list(obs.entries.items()):
+                if name not in entries and "judged" not in old:
+                    st = self._stats.get(name)
+                    if st is not None:
+                        self._count_verdict(st, old["verdict"], -1)
+                    del obs.entries[name]
+            obs.entries.update(entries)
+        diverged = any(e["verdict"] == "diverge"
+                       for e in obs.entries.values())
+        if obs.block is not None:
+            # The record references this dict (record_shadow is
+            # first-wins): refresh it in place.
+            obs.block["diverged"] = diverged
+            obs.block["policies"] = obs.entries
+        elif obs.entries:
+            obs.block = {"diverged": diverged, "policies": obs.entries}
+            rec = getattr(request, "decision", None)
+            if rec is not None and hasattr(rec, "record_shadow"):
+                rec.record_shadow(obs.block)
+
+    def _judge(self, request: Any, outcome: dict[str, Any]) -> None:
+        obs: ShadowObservation | None = getattr(request, "shadow", None)
+        if obs is None or obs.entries is None:
+            return
+        for name, entry in obs.entries.items():
+            policy = self._by_name.get(name)
+            stats = self._stats.get(name)
+            if policy is None or stats is None:
+                continue
+            try:
+                res = policy.judge(entry, outcome)
+            except Exception:
+                log.exception("shadow policy %s judge failed", name)
+                continue
+            if res is None:
+                continue
+            kind, value = res
+            if kind == "agree":
+                stats.judged_agree += 1
+                if value is not None:
+                    stats.agree_measured_n += 1
+                    stats.agree_measured_sum += value
+            elif kind == "diverge":
+                if value is None:
+                    stats.estimate_missing += 1
+                    continue
+                stats.judged_diverge += 1
+                stats.regret_n += 1
+                stats.regret_sum += value
+                stats.regret_abs += abs(value)
+                self._regret_ms_sum += value
+                SHADOW_REGRET_MS.labels(name).observe(value)
+                stats.ring.append({
+                    "request_id": request.request_id,
+                    "live": entry.get("live"),
+                    "shadow": entry.get("shadow"),
+                    "margin": entry.get("margin"),
+                    "est_regret_ms": round(value, 3),
+                })
+
+    # ---- render ---------------------------------------------------------
+
+    def snapshot(self) -> dict[str, Any]:
+        """The /debug/shadow payload. Read from the event loop while the
+        worker writes — every field is a GIL-atomic load, and the recent
+        ring is snapshotted via list() (the _live_items precedent)."""
+        doc: dict[str, Any] = {
+            "enabled": self.cfg.enabled,
+            "active": self._active,
+            "sample_rate": self.cfg.sample_rate,
+            "submitted": self._submitted,
+            "policies": {p.name: self._stats[p.name].render(self._submitted)
+                         for p in self._policies},
+        }
+        if self._dropped:
+            # Backlog sheds (worker slower than arrivals) — never silent.
+            doc["dropped_events"] = self._dropped
+        return doc
+
+
+# ---------------------------------------------------------------------------
+# Fleet fan-in: n-weighted merge of per-shard /debug/shadow payloads.
+# ---------------------------------------------------------------------------
+
+# Recent divergences kept per shard / total in the merged view (bounded;
+# the full ring stays on each worker's own /debug/shadow).
+MERGE_RECENT_PER_SHARD = 8
+MERGE_RECENT_TOTAL = 32
+
+
+def merge_shadow(docs: list[tuple[int, dict[str, Any]]]) -> dict[str, Any]:
+    """Fleet /debug/shadow: counters summed across shards, agreement rate
+    and coverage recomputed from the sums (never averaged), regret merged
+    by summing (n, sum) — the n-weighted merge_kv precedent — and recent
+    divergences concatenated shard-annotated, bounded."""
+    out: dict[str, Any] = {
+        "workers": len(docs),
+        "enabled": any(d.get("enabled") for _, d in docs),
+        "submitted": 0,
+        "policies": {},
+    }
+    acc: dict[str, dict[str, Any]] = {}
+    for shard, doc in docs:
+        out["submitted"] += doc.get("submitted", 0)
+        for name, row in (doc.get("policies") or {}).items():
+            a = acc.setdefault(name, {
+                "evaluated": 0, "agreements": 0, "divergences": 0,
+                "no_signal": 0,
+                "judged": {"agreements": 0, "divergences": 0,
+                           "estimate_missing": 0},
+                "regret_n": 0, "regret_sum": 0.0, "regret_abs": 0.0,
+                "agree_n": 0, "agree_sum": 0.0,
+                "recent": [],
+            })
+            for k in ("evaluated", "agreements", "divergences", "no_signal"):
+                a[k] += row.get(k, 0)
+            for k in ("agreements", "divergences", "estimate_missing"):
+                a["judged"][k] += (row.get("judged") or {}).get(k, 0)
+            reg = row.get("est_regret_ms") or {}
+            n = reg.get("n", 0)
+            if n:
+                a["regret_n"] += n
+                a["regret_sum"] += reg.get("sum", 0.0)
+                a["regret_abs"] += abs(reg.get("mean_abs", 0.0)) * n
+            am = row.get("agree_measured_pull_ms_mean")
+            # Weight by the count the mean was taken over (judged
+            # agreements without a measured pull are excluded from it).
+            an = row.get("agree_measured_n", 0)
+            if am is not None and an:
+                a["agree_n"] += an
+                a["agree_sum"] += am * an
+            for div in (row.get("recent_divergences")
+                        or [])[-MERGE_RECENT_PER_SHARD:]:
+                a["recent"].append({**div, "shard": shard})
+    for name, a in acc.items():
+        decided = a["agreements"] + a["divergences"]
+        row: dict[str, Any] = {
+            "evaluated": a["evaluated"],
+            "agreements": a["agreements"],
+            "divergences": a["divergences"],
+            "no_signal": a["no_signal"],
+            "agreement_rate": (round(a["agreements"] / decided, 4)
+                               if decided else None),
+            "coverage": (round(decided / out["submitted"], 4)
+                         if out["submitted"] else None),
+            "judged": a["judged"],
+        }
+        if a["regret_n"]:
+            row["est_regret_ms"] = {
+                "n": a["regret_n"],
+                "sum": round(a["regret_sum"], 3),
+                "mean": round(a["regret_sum"] / a["regret_n"], 3),
+                "mean_abs": round(a["regret_abs"] / a["regret_n"], 3),
+            }
+        else:
+            row["est_regret_ms"] = {"n": 0}
+        if a["agree_n"]:
+            row["agree_measured_pull_ms_mean"] = round(
+                a["agree_sum"] / a["agree_n"], 3)
+        row["recent_divergences"] = a["recent"][-MERGE_RECENT_TOTAL:]
+        out["policies"][name] = row
+    return out

@@ -468,7 +468,8 @@ class _Baseline:
 
     __slots__ = ("requests", "met", "shed", "out_tokens",
                  "good_tokens", "prompt_tokens", "degraded", "kv_stamps",
-                 "kv_joins", "gc_pause_s", "by_role")
+                 "kv_joins", "gc_pause_s", "by_role",
+                 "shadow_eval", "shadow_div", "shadow_regret")
 
     def __init__(self):
         self.requests = 0
@@ -482,6 +483,9 @@ class _Baseline:
         self.kv_joins = 0
         self.gc_pause_s = 0.0
         self.by_role: dict[str, tuple[int, int]] = {}
+        self.shadow_eval = 0
+        self.shadow_div = 0
+        self.shadow_regret = 0.0
 
 
 class TimelineSampler:
@@ -511,6 +515,7 @@ class TimelineSampler:
                  degraded_fn: Callable[[], int] | None = None,
                  decisions_fn: Callable[[int], list] | None = None,
                  divergence_fn: Callable[[], float] | None = None,
+                 shadow: Any = None,
                  wall: Callable[[], float] = time.time):
         self.cfg = cfg
         self.slo_ledger = slo_ledger
@@ -521,6 +526,10 @@ class TimelineSampler:
         self.drain_rate_fn = drain_rate_fn
         self.degraded_fn = degraded_fn
         self.divergence_fn = divergence_fn
+        # Shadow evaluator (router/shadow.py): flat counters read per tick
+        # — evaluated/diverged/regret deltas become the counterfactual
+        # series the flight recorder correlates against goodput swings.
+        self.shadow = shadow
         self._wall = wall
         self.ring: deque[dict[str, Any]] = deque(maxlen=cfg.ring_capacity)
         self.burn = BurnRateMonitor(cfg)
@@ -706,6 +715,21 @@ class TimelineSampler:
 
         if self.divergence_fn is not None:
             sample["kv_index_divergence"] = self.divergence_fn()
+
+        # Shadow-policy counterfactual deltas (router/shadow.py): worker-
+        # written flat counters, read as GIL-atomic loads — a tick racing
+        # an in-flight judge lands the delta on the next tick instead.
+        sh = self.shadow
+        if sh is not None and sh.active:
+            ev, dv, rg = (sh.evaluated_total, sh.diverged_total,
+                          sh.regret_ms_sum)
+            sample["shadow"] = {
+                "evaluated": ev - prev.shadow_eval,
+                "diverged": dv - prev.shadow_div,
+                "regret_ms": round(rg - prev.shadow_regret, 3),
+            }
+            prev.shadow_eval, prev.shadow_div = ev, dv
+            prev.shadow_regret = rg
 
         # Process self-telemetry (gauges + the timeline series). The /proc
         # reads are real syscalls (~15-25µs together), so they run every

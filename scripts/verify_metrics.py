@@ -93,6 +93,11 @@ REQUIRED_FAMILIES = {
     ("router_shard_snapshot_epoch", "fleet"),
     ("router_shard_requests", "fleet"),
     ("router_fleet_balancer_connections", "fleet"),
+    # Shadow policy evaluation (ISSUE 14): counterfactual verdicts per
+    # policy and the signed estimated-regret distribution the shadow
+    # ledger judges against the measured feeds.
+    ("router_shadow_decisions", "router"),
+    ("router_shadow_regret_ms", "router"),
     # Leader failover & confirmed-index replication (ISSUE 13): the role
     # gauge + election counter on the supervisor, and the follower-side
     # delta-stream resync counter.

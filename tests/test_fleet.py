@@ -27,6 +27,7 @@ from llm_d_inference_scheduler_tpu.router.fleet import (
     flow_shard,
     merge_expositions,
     merge_slo,
+    merge_transfers,
 )
 from llm_d_inference_scheduler_tpu.router.framework.datalayer import (
     EndpointMetadata,
@@ -586,6 +587,42 @@ def test_worker_spec_role_follows_leader():
     assert spec0["replication"] is True
 
 
+def test_merge_transfers_nweighted_per_pair():
+    """The same (prefill, decode) pair observed by two shards merges into
+    ONE row: EWMAs n-weighted by each shard's pull count (the merge_kv
+    precedent), totals summed, last_unix freshest, shards annotated —
+    no more duplicate rows per shard."""
+    doc_a = {"pairs": [
+        {"prefill": "p:1", "decode": "d:1", "pulls": 3, "bytes_total": 300,
+         "last_unix": 100.0, "ewma_pull_ms": 10.0, "ewma_bytes": 100.0},
+        {"prefill": "p:2", "decode": "d:1", "pulls": 1, "bytes_total": 10,
+         "last_unix": 90.0, "ewma_pull_ms": 2.0},
+    ]}
+    doc_b = {"pairs": [
+        {"prefill": "p:1", "decode": "d:1", "pulls": 1, "bytes_total": 100,
+         "last_unix": 120.0, "ewma_pull_ms": 50.0, "ewma_bytes": 200.0},
+        # Prefill-only row (streamed responses): pulls == 0 but the
+        # prefill EWMA still contributes at weight 1.
+        {"prefill": "p:3", "decode": "d:2", "pulls": 0, "bytes_total": 0,
+         "last_unix": 80.0, "ewma_prefill_ms": 42.0},
+    ]}
+    out = merge_transfers([(0, doc_a), (1, doc_b)])
+    pairs = {(p["prefill"], p["decode"]): p for p in out["pairs"]}
+    assert len(pairs) == 3  # p:1/d:1 merged, not duplicated
+    merged = pairs[("p:1", "d:1")]
+    assert merged["pulls"] == 4 and merged["bytes_total"] == 400
+    assert merged["last_unix"] == 120.0
+    assert merged["shards"] == [0, 1]
+    # n-weighted by pulls: (10*3 + 50*1) / 4.
+    assert merged["ewma_pull_ms"] == pytest.approx(20.0)
+    assert merged["ewma_bytes"] == pytest.approx((100 * 3 + 200 * 1) / 4)
+    # Derived wire speed recomputed from the MERGED EWMAs.
+    assert merged["ewma_mb_per_s"] == pytest.approx(
+        merged["ewma_bytes"] / merged["ewma_pull_ms"] / 1e3, abs=1e-3)
+    assert pairs[("p:2", "d:1")]["shards"] == [0]
+    assert pairs[("p:3", "d:2")]["ewma_prefill_ms"] == 42.0
+
+
 def test_merge_kv_leader_shard_param():
     """Divergence is measured against the CURRENT leader shard — after an
     election the promoted shard's confirmed index is the reference."""
@@ -645,7 +682,9 @@ def _stub_worker(port, *, req, epoch, decision_rid=None):
 
     async def transfers(request):
         return web.json_response({"pairs": [{"prefill": "p:1", "decode": "d:1",
-                                             "pull_ms": 2.0}]})
+                                             "pulls": 2, "bytes_total": 200,
+                                             "last_unix": 50.0,
+                                             "ewma_pull_ms": 2.0}]})
 
     async def health(request):
         return web.json_response({"status": "ok"})
@@ -714,11 +753,14 @@ def test_fleet_admin_fan_in_with_stub_workers():
                 totals = r.json()["totals"]
                 assert (totals["requests"], totals["slo_met"]) == (8, 8)
                 assert totals["output_tokens"] == 32
-                # /debug/transfers: per-shard rows, shard-annotated.
+                # /debug/transfers: the same pair observed by both shards
+                # is ONE merged row (n-weighted), shard-list annotated.
                 r = await c.get(base + "/debug/transfers")
                 pairs = r.json()["pairs"]
-                assert len(pairs) == 2
-                assert {p["shard"] for p in pairs} == {0, 1}
+                assert len(pairs) == 1
+                assert pairs[0]["shards"] == [0, 1]
+                assert pairs[0]["pulls"] == 4
+                assert pairs[0]["ewma_pull_ms"] == 2.0
                 # /health aggregates worker states.
                 r = await c.get(base + "/health")
                 assert r.status_code == 200
