@@ -7,7 +7,7 @@ PY ?= python
 .PHONY: test test-fast test-unit test-dist test-chaos bench bench-flowcontrol \
 	bench-router-sse bench-decisions bench-sched bench-sched-offload \
 	bench-scaleout bench-slo bench-overload bench-kvobs bench-multiturn \
-	bench-timeline bench-fleet-chaos bench-shadow \
+	bench-timeline bench-fleet-chaos bench-shadow bench-rebalance \
 	dryrun render-chart \
 	compile-check \
 	verify-metrics verify-decisions verify-hotpath verify-threadsafe \
@@ -158,6 +158,18 @@ bench-multiturn:
 # at /debug/decisions?divergent=1. Writes benchmarks/SHADOW.json.
 bench-shadow:
 	$(PY) bench.py --shadow
+
+# Self-balancing pool bench (CPU-only): an open-loop ramp whose
+# prefill:decode mix swings hard prefill-heavy -> hard decode-heavy
+# mid-run through the full gateway -> sidecar -> P/D sim topology.
+# Three arms: a balanced-mix static baseline, the static-split
+# kill-switch arm (the drowning role's attainment collapses per phase),
+# and the rebalancer arm (drain-cycle role flips hold BOTH roles'
+# attainment within the acceptance band of the balanced baseline) —
+# every flip drains with zero client-visible errors and is explained at
+# /debug/rebalance. Writes benchmarks/REBALANCE.json.
+bench-rebalance:
+	$(PY) bench.py --rebalance
 
 # Kill-the-leader chaos bench (CPU-only): a 3-worker fleet with
 # confirmed-index replication under live traffic — SIGKILL the datalayer

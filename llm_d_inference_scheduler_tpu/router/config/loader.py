@@ -52,6 +52,7 @@ class RawConfig:
     disagg: dict[str, Any]
     timeline: dict[str, Any]
     shadow: dict[str, Any]
+    rebalance: dict[str, Any]
     tls_client: dict[str, Any]
     pool: dict[str, Any]
     objectives: list[dict[str, Any]]
@@ -129,6 +130,12 @@ class RouterConfig:
     # kill-switch. Policies evaluate every live scheduling cycle in shadow
     # and are judged against the measured feeds at /debug/shadow).
     shadow: dict[str, Any]
+    # rebalance: the self-balancing pool knobs (router/rebalance.py
+    # RebalanceConfig — {enabled, tickS, minDwellS, headroomTarget,
+    # maxConcurrentFlips, advice, ...}; enabled: false (the default) is the
+    # kill-switch — the pool's P/D role split stays bit-identical static
+    # config).
+    rebalance: dict[str, Any]
     # The parsed YAML verbatim: /debug/config serves a redacted view and
     # router_config_info{hash} fingerprints it.
     raw_doc: dict[str, Any]
@@ -168,6 +175,7 @@ def load_raw_config(text: str | None) -> RawConfig:
         disagg=doc.get("disagg") or {},
         timeline=doc.get("timeline") or {},
         shadow=doc.get("shadow") or {},
+        rebalance=doc.get("rebalance") or {},
         tls_client=doc.get("tlsClient") or {},
         pool=doc.get("pool") or {},
         objectives=doc.get("objectives") or [],
@@ -204,6 +212,39 @@ def instantiate(raw: RawConfig, handle: Handle,
             "plugins": [{"pluginRef": s["type"], "weight": s.get("weight", 1)}
                         for s in DEFAULT_PROFILE_PLUGINS],
         }]
+
+    # Default P/D profile pairing: transfer-aware-pair-scorer joins every
+    # disagg config's "prefill" profile unless already declared or
+    # disabled (`disagg: {pairScorer: {enabled: false}}`). Shadow-proven
+    # in the counterfactual ledger (docs/shadow.md: estimate/actual ratio
+    # 0.97 against a live A/B arm), and safe as a default because of
+    # unmeasured-pair neutrality: on a cold TransferTable the scorer
+    # scores nothing, so totals and picks are bit-identical. The profile
+    # SPEC is amended (not the built profile — SchedulerProfile freezes
+    # its scorer metadata at construction) on a copy, never the raw doc
+    # (/debug/config and router_config_info serve the doc verbatim).
+    pair_spec = (raw.disagg or {}).get("pairScorer") or {}
+    if bool(pair_spec.get("enabled", True)):
+        has_disagg = any(spec.get("type") in ("disagg-profile-handler",
+                                              "pd-profile-handler")
+                         for spec in plugin_specs)
+        pair_names = {spec.get("name") or spec["type"]
+                      for spec in plugin_specs
+                      if spec.get("type") == "transfer-aware-pair-scorer"}
+        for i, pspec in enumerate(profiles_spec):
+            if not has_disagg or pspec.get("name") != "prefill":
+                continue
+            refs = list(pspec.get("plugins") or [])
+            if any(r.get("pluginRef") in pair_names
+                   or r.get("pluginRef") == "transfer-aware-pair-scorer"
+                   for r in refs):
+                continue
+            if not pair_names:
+                plugin_specs.append({"type": "transfer-aware-pair-scorer"})
+                pair_names.add("transfer-aware-pair-scorer")
+            refs.append({"pluginRef": next(iter(pair_names)),
+                         "weight": float(pair_spec.get("weight", 2.0))})
+            profiles_spec[i] = {**pspec, "plugins": refs}
 
     # Instantiate declared plugins.
     plugins_by_name: dict[str, Any] = {}
@@ -365,6 +406,7 @@ def instantiate(raw: RawConfig, handle: Handle,
         disagg=raw.disagg,
         timeline=raw.timeline,
         shadow=raw.shadow,
+        rebalance=raw.rebalance,
         raw_doc=raw.doc,
         tls_client=raw.tls_client,
         static_endpoints=static_endpoints,

@@ -13,7 +13,12 @@ import random
 import time
 from typing import Callable, Iterable
 
-from ..framework.datalayer import Endpoint, EndpointMetadata
+from ..framework.datalayer import (
+    DRAINING_LABEL,
+    ROLE_LABEL,
+    Endpoint,
+    EndpointMetadata,
+)
 from ..metrics import SNAPSHOT_EPOCH
 from ..resilience import BreakerRegistry
 from ..snapshot import PoolSnapshot
@@ -101,6 +106,17 @@ class Datastore:
         self._snapshot_dirty = True   # hard: membership changed
         self._snapshot_stale = False  # soft: scrape data landed
         self._snapshot_epoch = 0
+        # Rebalancer-owned label overlays (router/rebalance.py), keyed by
+        # address_port: a role flip / draining mark must survive an
+        # external resync (kube pod event, config-file reconcile) that
+        # rebuilds metadata from the pre-flip source of truth — otherwise
+        # any watch event silently reverts the flip (or un-drains a pod
+        # mid-drain-cycle) while the controller still reports it active.
+        # The overlay wins until the pod leaves the pool or the
+        # controller republishes. Fleet followers never write overlays
+        # (their controllers are view-only), so leader frames applied via
+        # apply_remote_snapshot pass through untouched.
+        self._label_overrides: dict[str, dict[str, str]] = {}
         # Fleet follower mode (router/fleet.py): once a leader-published
         # snapshot has been applied, this datastore stops building its own
         # epochs — membership and scrape state both arrive via IPC frames,
@@ -191,6 +207,10 @@ class Datastore:
     def endpoint_add_or_update(self, meta: EndpointMetadata) -> Endpoint:
         key = meta.address_port
         self._snapshot_dirty = True
+        overrides = self._label_overrides.get(key)
+        if overrides:
+            meta = dataclasses.replace(
+                meta, labels={**meta.labels, **overrides})
         ep = self._endpoints.get(key)
         if ep is None:
             ep = Endpoint(meta)
@@ -203,6 +223,7 @@ class Datastore:
 
     def endpoint_delete(self, address_port: str) -> None:
         ep = self._endpoints.pop(address_port, None)
+        self._label_overrides.pop(address_port, None)
         if ep is not None:
             self._snapshot_dirty = True
             self.breakers.remove(address_port)
@@ -215,6 +236,56 @@ class Datastore:
 
     def endpoint_get(self, address_port: str) -> Endpoint | None:
         return self._endpoints.get(address_port)
+
+    def _republish_labels(self, address_port: str,
+                          labels: dict[str, str]) -> bool:
+        """Replace one endpoint's metadata with new labels (metrics,
+        attributes, and the live Endpoint object are preserved) and dirty
+        the snapshot — the routing-attribute republish half of the
+        rebalancer's drain cycle. A whole new metadata object is installed
+        (never an in-place label mutation): published PoolSnapshots share
+        metadata by reference, so an in-flight scheduling cycle must keep
+        seeing the epoch it started with."""
+        ep = self._endpoints.get(address_port)
+        if ep is None:
+            return False
+        ep.metadata = dataclasses.replace(ep.metadata, labels=labels)
+        self._snapshot_dirty = True
+        return True
+
+    def set_endpoint_draining(self, address_port: str,
+                              draining: bool) -> bool:
+        """Mark/clear the drain-cycle label (router/rebalance.py): the
+        role filters exclude a draining pod from every new pick while its
+        in-flight work clears. Returns False when the pod is unknown."""
+        ep = self._endpoints.get(address_port)
+        if ep is None:
+            return False
+        labels = dict(ep.metadata.labels)
+        overrides = self._label_overrides.setdefault(address_port, {})
+        if draining:
+            labels[DRAINING_LABEL] = "true"
+            overrides[DRAINING_LABEL] = "true"
+        else:
+            labels.pop(DRAINING_LABEL, None)
+            overrides.pop(DRAINING_LABEL, None)
+        return self._republish_labels(address_port, labels)
+
+    def set_endpoint_role(self, address_port: str, role: str) -> bool:
+        """Republish one endpoint's ``llm-d.ai/role`` routing attribute
+        (the final step of a drain-cycle role flip), clearing any draining
+        mark in the same republish so the pod rejoins scheduling under its
+        new role atomically."""
+        ep = self._endpoints.get(address_port)
+        if ep is None:
+            return False
+        labels = dict(ep.metadata.labels)
+        labels[ROLE_LABEL] = role
+        labels.pop(DRAINING_LABEL, None)
+        overrides = self._label_overrides.setdefault(address_port, {})
+        overrides[ROLE_LABEL] = role
+        overrides.pop(DRAINING_LABEL, None)
+        return self._republish_labels(address_port, labels)
 
     def resync(self, metas: Iterable[EndpointMetadata]) -> None:
         """Replace the endpoint set (pool change / reconciler resync)."""

@@ -98,6 +98,20 @@ class TransferTable:
         pair's cost must not pin it against eviction)."""
         return self._pairs.get((prefill, decode))
 
+    def cheapest_pull_ms(self, decode: str) -> float | None:
+        """Cheapest measured pull EWMA INTO one decode pod over every
+        measured (prefill, decode) pair — the prefill classifier's
+        pair-cost margin input (a cheap available pull weakens the case
+        for skipping the P/D hop). None when no pair into the pod has a
+        measured pull yet. Bounded O(MAX_PAIRS) scan, paid only while the
+        classifier's pairCostRefMs coupling is configured on."""
+        best: float | None = None
+        for (_p, d), stats in self._pairs.items():
+            if d == decode and stats.ewma_pull_ms is not None \
+                    and (best is None or stats.ewma_pull_ms < best):
+                best = stats.ewma_pull_ms
+        return best
+
     def snapshot(self) -> dict[str, Any]:
         return {"pairs": [{"prefill": p, "decode": d, **stats.render()}
                           for (p, d), stats in self._pairs.items()]}

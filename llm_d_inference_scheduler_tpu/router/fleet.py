@@ -887,6 +887,7 @@ def merge_slo(docs: list[dict[str, Any]]) -> dict[str, Any]:
         "totals": {},
         "endpoints": {},
         "bands": {},
+        "workloads": {},
         "miss_reasons": {},
         "shed_reasons": {},
     }
@@ -900,6 +901,8 @@ def merge_slo(docs: list[dict[str, Any]]) -> dict[str, Any]:
             _merge_agg(out["endpoints"].setdefault(ep, {}), agg)
         for band, agg in (doc.get("bands") or {}).items():
             _merge_agg(out["bands"].setdefault(band, {}), agg)
+        for wl, agg in (doc.get("workloads") or {}).items():
+            _merge_agg(out["workloads"].setdefault(wl, {}), agg)
         for key in ("miss_reasons", "shed_reasons"):
             for reason, n in (doc.get(key) or {}).items():
                 out[key][reason] = out[key].get(reason, 0) + n
@@ -964,6 +967,7 @@ class FleetAdmin:
             web.get("/debug/traces", self.traces),
             web.get("/debug/timeline", self.timeline),
             web.get("/debug/incidents", self.incidents),
+            web.get("/debug/rebalance", self.rebalance),
             web.get("/debug/config", self.config),
         ])
         self._runner: web.AppRunner | None = None
@@ -1249,6 +1253,17 @@ class FleetAdmin:
 
         results = await self._fan_out("/debug/shadow")
         return web.json_response(merge_shadow(
+            [(shard, doc) for shard, (status, doc) in enumerate(results)
+             if status == 200 and isinstance(doc, dict)]))
+
+    async def rebalance(self, request: web.Request) -> web.Response:
+        """Fleet /debug/rebalance: the datalayer-owning worker's controller
+        doc (flips, headroom, advice) merged with every follower's compact
+        row (router/rebalance.py merge_rebalance)."""
+        from .rebalance import merge_rebalance
+
+        results = await self._fan_out("/debug/rebalance")
+        return web.json_response(merge_rebalance(
             [(shard, doc) for shard, (status, doc) in enumerate(results)
              if status == 200 and isinstance(doc, dict)]))
 

@@ -17,6 +17,11 @@ from typing import Any, Iterable, Protocol, runtime_checkable
 
 ROLE_LABEL = "llm-d.ai/role"
 ENGINE_TYPE_LABEL = "llm-d.ai/engine-type"
+# Drain-cycle mark (router/rebalance.py): a pod mid-role-flip carries this
+# label so the role filters exclude it from every new pick while its
+# in-flight work runs to completion. Set/cleared only through the
+# Datastore's set_endpoint_draining / set_endpoint_role republish helpers.
+DRAINING_LABEL = "llm-d.ai/draining"
 
 
 @dataclasses.dataclass

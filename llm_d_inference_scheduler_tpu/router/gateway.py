@@ -71,6 +71,7 @@ from .requestcontrol.director import (
 )
 from .kvobs import H_KV_HIT_BLOCKS, H_KV_HIT_TOKENS, CacheLedger, KvObsConfig
 from .overload import DrainRateEstimator, OverloadConfig, OverloadController
+from .rebalance import RebalanceConfig, RebalanceController
 from .schedpool import LoopLagMonitor, SchedulerPool, SchedulingConfig
 from .shadow import ShadowConfig, ShadowEvaluator
 from .slo import SloConfig, SloLedger, finite_float_or_none
@@ -284,18 +285,40 @@ class Gateway:
         # sampler task entirely — the disabled sampler object only exists
         # so /debug/timeline still answers JSON.
         tl_cfg = TimelineConfig.from_spec(cfg.timeline)
+        rb_cfg = RebalanceConfig.from_spec(cfg.rebalance)
         drain_fn = None
-        if tl_cfg.enabled and self.flow_controller is not None:
+        if (tl_cfg.enabled or rb_cfg.enabled) \
+                and self.flow_controller is not None:
             if self.overload.enabled:
                 # The overload controller already measures drain; reuse it.
                 drain_fn = self.overload.drain.rate
             else:
-                # Overload off: the timeline keeps its own estimator on
-                # the dispatch observer (single slot, nothing else owns it
-                # when overload is disabled).
+                # Overload off: the timeline/rebalancer keep one shared
+                # estimator on the dispatch observer (single slot, nothing
+                # else owns it when overload is disabled).
                 est = DrainRateEstimator()
                 self.flow_controller.dispatch_observer = est.note
                 drain_fn = est.rate
+
+        # Self-balancing pool (router/rebalance.py): dynamic P/D role
+        # rebalancing through drain-cycle flips + scaling advice. Disabled
+        # by default (`rebalance: {enabled: true}` opts in); in fleet mode
+        # only the datalayer-owning worker acts — a follower's flip would
+        # be overwritten by the next leader snapshot (promote() arms it on
+        # leader re-election).
+        disagg_handlers = [p for p in cfg.plugins_by_name.values()
+                           if hasattr(p, "hop_skips")]
+        self.rebalancer = RebalanceController(
+            rb_cfg,
+            datastore=datastore,
+            slo_ledger=self.slo_ledger,
+            flow=self.flow_controller,
+            drain_rate_fn=drain_fn,
+            hop_skips_fn=((lambda: sum(p.hop_skips
+                                       for p in disagg_handlers))
+                          if disagg_handlers else None),
+            acting=(fleet is None or fleet.runs_datalayer))
+
         self.timeline = TimelineSampler(
             tl_cfg,
             slo_ledger=self.slo_ledger,
@@ -307,7 +330,8 @@ class Gateway:
             degraded_fn=(lambda: self.overload.degraded_total)
             if self.overload.enabled else None,
             decisions_fn=self._recent_bad_decisions,
-            shadow=self.shadow_eval if self.shadow_eval.active else None)
+            shadow=self.shadow_eval if self.shadow_eval.active else None,
+            rebalance=self.rebalancer if self.rebalancer.enabled else None)
 
         # Effective-config identity: the hash covers the UNREDACTED loaded
         # doc (config skew across fleet shards must show even when only
@@ -336,6 +360,7 @@ class Gateway:
             web.get("/debug/shadow", self.shadow_view),
             web.get("/debug/timeline", self.timeline_view),
             web.get("/debug/incidents", self.incidents_view),
+            web.get("/debug/rebalance", self.rebalance_view),
             web.get("/debug/config", self.config_view),
             # Fleet control plane (router/fleet.py, loopback-guarded): the
             # supervisor's leader-election notices — promote this follower
@@ -464,6 +489,9 @@ class Gateway:
         # Fleet flight recorder: grid-aligned sampler ticks (no-op under
         # the timeline kill-switch).
         self.timeline.start()
+        # Self-balancing pool controller (no-op when disabled or when this
+        # worker is a fleet follower — promote() arms it on re-election).
+        self.rebalancer.start()
         if self.grpc_health is not None:
             await self.grpc_health.start()
         if self.grpc_ext_proc is not None:
@@ -480,6 +508,7 @@ class Gateway:
     async def stop(self):
         self.loop_lag.stop()
         await self.timeline.stop()
+        await self.rebalancer.stop()
         if self._flusher:
             self._flusher.cancel()
         if self.grpc_health is not None:
@@ -655,6 +684,12 @@ class Gateway:
             **self.timeline.incidents.snapshot(),
         })
 
+    async def rebalance_view(self, request: web.Request) -> web.Response:
+        """Self-balancing pool controller (router/rebalance.py): per-role
+        headroom series, flip history with full DecisionRecord-style
+        inputs, active drain cycles, and the current scaling advice."""
+        return web.json_response(self.rebalancer.snapshot())
+
     async def config_view(self, request: web.Request) -> web.Response:
         """Redacted effective-config snapshot: what config THIS worker
         actually loaded (secrets masked, paths reduced to basenames), plus
@@ -764,6 +799,9 @@ class Gateway:
         self.fleet.role = "leader"
         self.fleet.ipc_path = path
         await self._start_snapshot_publisher(path)
+        # The promoted worker now owns the datalayer, so the rebalance
+        # controller (if configured) may act on pool metadata.
+        self.rebalancer.promote()
         return web.json_response({"role": "leader", "ipcPath": path})
 
     async def fleet_retarget(self, request: web.Request) -> web.Response:

@@ -313,6 +313,30 @@ SHADOW_REGRET_MS = Histogram(
     ("policy",), registry=REGISTRY,
     buckets=(-250, -100, -50, -25, -10, -5, -1, 0,
              1, 5, 10, 25, 50, 100, 250))
+# Self-balancing pool (router/rebalance.py): dynamic P/D role rebalancing
+# with drain-cycle role flips and predictive scaling advice. Role/direction
+# label sets are fixed small enums; the per-flip detail (full controller
+# inputs) is served at /debug/rebalance.
+REBALANCE_HEADROOM = Gauge(
+    "router_rebalance_headroom",
+    "Per-role goodput headroom computed by the rebalance controller each "
+    "tick (0 = saturated, 1 = idle; 1 - max(engine queue pressure, "
+    "workload SLO miss rate) — full inputs at /debug/rebalance)",
+    ("role",), registry=REGISTRY)
+ROLE_FLIPS_TOTAL = Counter(
+    "router_role_flips",
+    "Completed drain-cycle pod role flips (llm-d.ai/role republished "
+    "after in-flight work cleared); every flip's full inputs are at "
+    "/debug/rebalance",
+    ("from", "to"), registry=REGISTRY)
+POOL_ADVICE = Gauge(
+    "router_pool_advice",
+    "Predictive scaling advice per role (1 = advised): direction=up when "
+    "a role starves and no role flip can help, direction=down when a role "
+    "idles against a healthy peer (for prefill, a sustained hop-skip rate "
+    "is extra evidence) — the autoscaler hook a k8s InferencePool "
+    "reconciler would consume",
+    ("role", "direction"), registry=REGISTRY)
 # Confirmed-index replication (router/fleet.py): a follower that detects a
 # sequence gap in the leader's KV delta stream stops applying deltas and
 # waits for the next full-index checkpoint frame to resync. Worker-side —

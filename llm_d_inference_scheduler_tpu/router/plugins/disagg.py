@@ -65,6 +65,14 @@ class PdClassifierConfig:
     # (CacheLedger → Datastore.kv_obs): n / (n + PRIOR_N), so the default
     # 0.5 requires PRIOR_N measured joins before the first skip.
     min_confidence: float = 0.5
+    # Measured-pair-cost coupling (ROADMAP item 1's noted extension): the
+    # cheapest measured KV-pull EWMA into the chosen decode pod scales the
+    # skip threshold by clamp(pull_ms / pairCostRefMs, MARGIN band) — a
+    # cheap measured pull weakens the case for skipping the hop (the hop
+    # costs little), an expensive one strengthens it. 0 (the default)
+    # disables the coupling; with no measured pair into the pod the margin
+    # is neutral either way (bit-identical on a cold TransferTable).
+    pair_cost_ref_ms: float = 0.0
 
     @classmethod
     def from_spec(cls, spec: dict[str, Any] | None) -> "PdClassifierConfig":
@@ -74,7 +82,9 @@ class PdClassifierConfig:
             cold_token_threshold=max(
                 0, int(spec.get("coldTokenThreshold", 256))),
             min_confidence=min(max(
-                float(spec.get("minConfidence", 0.5)), 0.0), 1.0))
+                float(spec.get("minConfidence", 0.5)), 0.0), 1.0),
+            pair_cost_ref_ms=max(
+                0.0, float(spec.get("pairCostRefMs", 0.0))))
 
 
 @register_plugin("prefix-based-pd-decider")
@@ -240,6 +250,11 @@ class DisaggProfileHandler(PluginBase):
     # minConfidence 0.5 the classifier will not skip until PRIOR_N joins
     # have been measured.
     CONFIDENCE_PRIOR_N = 4
+    # Pair-cost margin clamp band: the measured-pull/reference ratio can at
+    # most halve or double the skip threshold — a single extreme EWMA must
+    # not swing the classifier to always/never skipping.
+    PAIR_MARGIN_MIN = 0.5
+    PAIR_MARGIN_MAX = 2.0
 
     def __init__(self, name: str | None = None):
         super().__init__(name)
@@ -251,6 +266,12 @@ class DisaggProfileHandler(PluginBase):
         # {classifier: ...}` config post-instantiation (set_classifier).
         self.classifier_cfg: PdClassifierConfig | None = None
         self._datastore: Any = None
+        # Flat skip counter beside the Prometheus family: the rebalance
+        # controller (router/rebalance.py) reads it per tick — a sustained
+        # skip rate is evidence the prefill pool is over-provisioned for
+        # the live mix (the degraded_total precedent). += under the GIL;
+        # a racing off-loop cycle at worst defers one count a tick.
+        self.hop_skips = 0
 
     def configure(self, params: dict[str, Any], handle: Any) -> None:
         # The KvHitTable trust signal lives on the datastore
@@ -345,15 +366,40 @@ class DisaggProfileHandler(PluginBase):
         adjusted_ratio = min(max(predicted_ratio - max(signed, 0.0), 0.0), 1.0)
         expected_cold = input_tokens * (1.0 - adjusted_ratio)
 
+        # Measured-pair-cost margin (ROADMAP item 1's noted extension):
+        # skipping the hop avoids the KV pull, so the skip/keep bar should
+        # track what that pull actually costs TO THIS decode pod. The
+        # cheapest measured pair EWMA scales the threshold — cheap pull →
+        # lower threshold (keep the hop more often), expensive pull →
+        # higher (skip more eagerly). No measured pair → neutral margin,
+        # bit-identical to the uncoupled classifier.
+        threshold = float(cfg.cold_token_threshold)
+        pair_block: dict[str, Any] | None = None
+        if cfg.pair_cost_ref_ms > 0:
+            table_t = getattr(self._datastore, "transfers", None)
+            min_pull = (table_t.cheapest_pull_ms(addr)
+                        if table_t is not None else None)
+            if min_pull is not None:
+                margin = min(max(min_pull / cfg.pair_cost_ref_ms,
+                                 self.PAIR_MARGIN_MIN),
+                             self.PAIR_MARGIN_MAX)
+                threshold = cfg.cold_token_threshold * margin
+                pair_block = {
+                    "min_ewma_pull_ms": round(min_pull, 3),
+                    "ref_ms": cfg.pair_cost_ref_ms,
+                    "margin": round(margin, 4),
+                    "effective_threshold": round(threshold, 1),
+                }
+
         if predicted_ratio <= 0.0:
             verdict = "keep"      # no reuse signal — nothing to act on
         elif confidence < cfg.min_confidence:
             verdict = "low_confidence"
-        elif expected_cold < cfg.cold_token_threshold:
+        elif expected_cold < threshold:
             verdict = "skip"
         else:
             verdict = "keep"
-        return {
+        block: dict[str, Any] = {
             "verdict": verdict,
             "pod": addr,
             "input_tokens": input_tokens,
@@ -367,6 +413,9 @@ class DisaggProfileHandler(PluginBase):
             "threshold": cfg.cold_token_threshold,
             "min_confidence": cfg.min_confidence,
         }
+        if pair_block is not None:
+            block["pair_cost"] = pair_block
+        return block
 
     def _stamp_classifier(self, request: InferenceRequest,
                           block: dict[str, Any]) -> None:
@@ -427,6 +476,7 @@ class DisaggProfileHandler(PluginBase):
                 self._stamp_classifier(request, block)
             if block is not None and block["verdict"] == "skip":
                 PD_HOP_SKIPPED_TOTAL.inc()
+                self.hop_skips += 1
             elif self.pd_decider.disaggregate(ctx, request, decode_ep):
                 to_run[self.PREFILL] = profiles[self.PREFILL]
         return to_run

@@ -4,7 +4,7 @@ from __future__ import annotations
 
 from typing import Any
 
-from ..framework.datalayer import ROLE_LABEL, Endpoint
+from ..framework.datalayer import DRAINING_LABEL, ROLE_LABEL, Endpoint
 from ..framework.plugin import PluginBase, register_plugin
 from ..framework.scheduling import CycleState, InferenceRequest
 
@@ -23,7 +23,14 @@ class _RoleFilter(PluginBase):
                endpoints: list[Endpoint]) -> list[Endpoint]:
         out = []
         for ep in endpoints:
-            role = ep.metadata.labels.get(ROLE_LABEL)
+            labels = ep.metadata.labels
+            if labels.get(DRAINING_LABEL):
+                # Mid-role-flip drain cycle (router/rebalance.py): the pod
+                # is between roles — no new picks of either role until the
+                # flip republishes its metadata. Hard exclusion, not
+                # fail-open: the rebalancer never drains a role's last pod.
+                continue
+            role = labels.get(ROLE_LABEL)
             if role in self.ROLES or (role in (None, "") and self.MATCH_UNLABELED):
                 out.append(ep)
         return out

@@ -1,0 +1,742 @@
+"""Self-balancing pool: dynamic P/D role rebalancing with drain-cycle role
+flips and predictive scaling advice.
+
+The pool's prefill/decode split is static config everywhere else in the
+router: when the traffic mix swings (prefill-heavy cold bursts vs
+decode-heavy chat steady state) one role idles while the other queues and
+sheds. P/D-Serve (arXiv:2408.08147) shows the P:D ratio must track the live
+mix to hold goodput — and every input such a controller needs is already
+measured and closed-loop in this tree:
+
+- the per-workload token mix and attainment counters on the SloLedger
+  (``SloLedger.by_workload`` — prefill-heavy vs decode-heavy requests,
+  classified by their own prompt:completion token split);
+- the scraped per-pod engine queues (``Endpoint.metrics`` waiting/running,
+  per role);
+- the flow-control per-band queue depths and the measured drain rate
+  (router/overload.py ``DrainRateEstimator``);
+- the per-(prefill, decode)-pair TransferTable EWMAs (PR 6/14) for
+  transfer-aware flip-victim selection;
+- the prefill classifier's hop-skip counter (PR 11): a sustained
+  ``router_pd_hop_skipped_total`` rate means prefill work is being served
+  decode-side — evidence the prefill pool is over-provisioned for the live
+  mix.
+
+``RebalanceController`` is a grid-tick controller (the timeline-sampler
+precedent: wall-clock aligned ticks, synchronous injectable-clock
+``tick()``). Each tick it computes per-role goodput **headroom** and, when
+one role's headroom collapses while the other's idles for ``minDwellS``,
+flips one pod's ``llm-d.ai/role`` routing attribute through a safe drain
+cycle:
+
+1. **drain** — mark the pod draining in the Datastore
+   (``llm-d.ai/draining`` metadata label): the role filters exclude it
+   from every new pick while in-flight work runs to completion;
+2. **wait** — a scrape landing after the drain started must report the
+   engine idle (running == waiting == 0); ``drainTimeoutS`` bounds the
+   wait (the flip then completes anyway — the engine serves both paths,
+   live streams keep running under the new label);
+3. **republish** — the Datastore republishes the pod's metadata with the
+   new role (and the draining mark cleared), the snapshot goes dirty, and
+   the next scheduling epoch sees the new split.
+
+The flip victim is picked **transfer-aware** from the measured pair
+EWMAs: a decode pod flipping to prefill prefers the candidate whose
+(candidate, remaining-decode) pairs pull cheapest; a prefill pod flipping
+to decode prefers giving up the pod whose measured pairs are most
+expensive. Unmeasured pairs stay neutral (the ``transfer_pair_scores``
+contract) and load breaks ties (the least-loaded pod drains fastest).
+
+The same feasibility math exports as **scaling advice**: when a role
+starves and the other role has nothing to donate, a flip cannot help and
+``router_pool_advice{role,direction="up"}`` raises; when a role idles
+against a healthy peer (for prefill, a sustained hop-skip rate is extra
+evidence), ``direction="down"`` raises — the autoscaler hook a k8s
+InferencePool reconciler would consume. ``GET /debug/rebalance`` serves
+the whole story: the per-role headroom series, every flip with its full
+inputs (headroom, queue depths, drain rate, pair EWMAs, hop-skip rate —
+DecisionRecord-style explanations), and the current advice; the fleet
+supervisor fans it in (``merge_rebalance``).
+
+``rebalance: {enabled: false}`` (the default) is the kill-switch: no
+task, no ring, ``tick()`` is one attribute check, and the pool's roles
+are bit-identical static config.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import dataclasses
+import logging
+import time
+from collections import deque
+from typing import Any, Callable
+
+from .framework.datalayer import ROLE_LABEL
+from .metrics import POOL_ADVICE, REBALANCE_HEADROOM, ROLE_FLIPS_TOTAL
+
+log = logging.getLogger("router.rebalance")
+
+PREFILL, DECODE = "prefill", "decode"
+ROLES = (PREFILL, DECODE)
+
+# Per-pod engine-queue depth at which queue pressure reads ~0.5 (the
+# saturating knee of util_queue = q / (q + QUEUE_REF)).
+QUEUE_REF = 4.0
+# Hop-skip EWMA weight (per tick).
+SKIP_ALPHA = 0.3
+# Minimum hop-skip rate (skips/s) that counts as over-provisioning
+# evidence. The EWMA decays exponentially but never reaches exactly 0.0,
+# so a single ancient skip would satisfy a bare `> 0` check for
+# thousands of ticks — "sustained" means the residue is above a real
+# floor, not merely positive.
+SKIP_RATE_MIN = 0.05
+# Per-tick completions needed for the workload miss rate to count at full
+# strength. A role's workload class can miss through the OTHER role's
+# congestion (a prefill-heavy request's e2e includes its decode leg's
+# queue wait), so a single straggler completing in a quiet tick must not
+# read as role starvation — miss evidence scales by served/MISS_CONF until
+# the tick carries a real sample.
+MISS_CONF_SERVED = 3.0
+
+
+@dataclasses.dataclass
+class RebalanceConfig:
+    """The YAML ``rebalance:`` section. ``enabled: false`` (the default)
+    is the kill-switch — bit-identical static roles."""
+
+    enabled: bool = False
+    tick_s: float = 1.0
+    # Minimum seconds between flip starts (and from controller start to the
+    # first flip) — the anti-thrash dwell.
+    min_dwell_s: float = 30.0
+    # A role whose headroom falls under this is starving.
+    headroom_target: float = 0.25
+    # The donor role must clear this much headroom before it gives up a
+    # pod (a sustained hop-skip rate relaxes the bar for a prefill donor).
+    donor_headroom: float = 0.6
+    # Consecutive ticks the imbalance must hold before a flip starts.
+    sustain_ticks: int = 3
+    max_concurrent_flips: int = 1
+    # Bound on the drain wait; past it the flip completes anyway (the
+    # engine serves both paths — live streams finish under the new label).
+    drain_timeout_s: float = 30.0
+    # Export router_pool_advice and the /debug/rebalance advice block.
+    advice: bool = True
+    # Headroom-series retention (ring capacity = history_s / tick_s).
+    history_s: float = 300.0
+    max_flip_history: int = 64
+
+    @classmethod
+    def from_spec(cls, spec: dict[str, Any] | None) -> "RebalanceConfig":
+        spec = spec or {}
+        cfg = cls(
+            enabled=bool(spec.get("enabled", False)),
+            tick_s=float(spec.get("tickS", 1.0)),
+            min_dwell_s=float(spec.get("minDwellS", 30.0)),
+            headroom_target=float(spec.get("headroomTarget", 0.25)),
+            donor_headroom=float(spec.get("donorHeadroom", 0.6)),
+            sustain_ticks=max(1, int(spec.get("sustainTicks", 3))),
+            max_concurrent_flips=max(
+                1, int(spec.get("maxConcurrentFlips", 1))),
+            drain_timeout_s=float(spec.get("drainTimeoutS", 30.0)),
+            advice=bool(spec.get("advice", True)),
+            history_s=float(spec.get("historyS", 300.0)),
+            max_flip_history=max(1, int(spec.get("maxFlipHistory", 64))),
+        )
+        if cfg.tick_s <= 0:
+            raise ValueError("rebalance.tickS must be > 0")
+        if not 0.0 < cfg.headroom_target < 1.0:
+            raise ValueError("rebalance.headroomTarget must be in (0, 1)")
+        if not cfg.headroom_target <= cfg.donor_headroom < 1.0:
+            raise ValueError("rebalance.donorHeadroom must be in "
+                             "[headroomTarget, 1)")
+        if cfg.drain_timeout_s < 0:
+            raise ValueError("rebalance.drainTimeoutS must be >= 0")
+        return cfg
+
+    @property
+    def ring_capacity(self) -> int:
+        return max(1, int(self.history_s / self.tick_s))
+
+
+@dataclasses.dataclass
+class FlipOp:
+    """One drain-cycle role flip, explainable end to end: ``inputs`` is
+    the DecisionRecord-style block /debug/rebalance serves — the full
+    controller evidence at start time."""
+
+    pod: str
+    from_role: str
+    to_role: str
+    started_unix: float
+    start_mono: float
+    inputs: dict[str, Any]
+    state: str = "draining"           # draining | completed | aborted
+    drained_unix: float | None = None
+    completed_unix: float | None = None
+    drain_timed_out: bool = False
+    aborted_reason: str | None = None
+
+    def render(self) -> dict[str, Any]:
+        doc: dict[str, Any] = {
+            "pod": self.pod,
+            "from": self.from_role,
+            "to": self.to_role,
+            "state": self.state,
+            "started_unix": self.started_unix,
+            "inputs": self.inputs,
+        }
+        if self.drained_unix is not None:
+            doc["drained_unix"] = self.drained_unix
+            doc["drain_s"] = round(self.drained_unix - self.started_unix, 3)
+        if self.completed_unix is not None:
+            doc["completed_unix"] = self.completed_unix
+        if self.drain_timed_out:
+            doc["drain_timed_out"] = True
+        if self.aborted_reason:
+            doc["aborted_reason"] = self.aborted_reason
+        return doc
+
+
+class _WorkloadBaseline:
+    """Previous-tick SloLedger.by_workload counter values (per-class
+    deltas)."""
+
+    __slots__ = ("rows",)
+
+    def __init__(self):
+        self.rows: dict[str, tuple[int, int, int]] = {}
+
+
+class RebalanceController:
+    """The self-balancing-pool controller (module docstring). All state is
+    mutated on the gateway's event loop (the tick task and the /debug
+    reader share it single-writer, the ledger discipline); ``tick()`` is
+    synchronous and injectable-clock testable."""
+
+    def __init__(self, cfg: RebalanceConfig, *,
+                 datastore: Any = None,
+                 slo_ledger: Any = None,
+                 flow: Any = None,
+                 drain_rate_fn: Callable[[], float] | None = None,
+                 hop_skips_fn: Callable[[], int] | None = None,
+                 acting: bool = True,
+                 clock: Callable[[], float] = time.monotonic,
+                 wall: Callable[[], float] = time.time):
+        self.cfg = cfg
+        self.datastore = datastore
+        self.slo_ledger = slo_ledger
+        self.flow = flow
+        self.drain_rate_fn = drain_rate_fn
+        self.hop_skips_fn = hop_skips_fn
+        # Fleet: only the worker that owns the datalayer may mutate pool
+        # metadata (a follower's flip would be overwritten by the next
+        # leader snapshot) — followers hold the controller non-acting and
+        # promote() arms it on leader re-election.
+        self.acting = acting
+        self._clock = clock
+        self._wall = wall
+        self.series: deque[dict[str, Any]] = deque(maxlen=cfg.ring_capacity)
+        self._flips: deque[FlipOp] = deque(maxlen=cfg.max_flip_history)
+        self._active: list[FlipOp] = []
+        self._wl_prev = _WorkloadBaseline()
+        self._skips_prev = 0
+        self._skip_rate = 0.0
+        self._imbalance_ticks = 0
+        self._imbalance_key: tuple[str, str] | None = None
+        # Dwell anchor: the controller start counts as a flip event, so a
+        # freshly-booted pool gets minDwellS of observation before the
+        # first flip.
+        self._last_flip_mono = clock()
+        self._advice: dict[str, dict[str, Any]] = {}
+        # Flat counters for the timeline sampler's per-tick deltas.
+        self.flips_total = 0
+        self.aborted_total = 0
+        self.last_headroom: dict[str, float] = {}
+        self._task: asyncio.Task | None = None
+        # Label children resolved once (the timeline precedent).
+        self._g_headroom = {r: REBALANCE_HEADROOM.labels(r) for r in ROLES}
+        self._g_advice = {(r, d): POOL_ADVICE.labels(r, d)
+                          for r in ROLES for d in ("up", "down")}
+
+    # ---- lifecycle ------------------------------------------------------
+
+    @property
+    def enabled(self) -> bool:
+        return self.cfg.enabled
+
+    @property
+    def active_count(self) -> int:
+        return len(self._active)
+
+    def start(self) -> None:
+        if not self.cfg.enabled or not self.acting or self._task is not None:
+            return
+        self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._task
+            self._task = None
+
+    def promote(self) -> None:
+        """Fleet leader promotion (gateway /fleet/promote): this worker now
+        owns the datalayer, so the controller may act. Idempotent."""
+        self.acting = True
+        self._last_flip_mono = self._clock()  # fresh dwell on a new leader
+        if self.cfg.enabled and self._task is None:
+            try:
+                self.start()
+            except RuntimeError:
+                pass  # no running loop (tests driving tick() by hand)
+
+    async def _run(self) -> None:
+        tick = self.cfg.tick_s
+        try:
+            while True:
+                # Grid alignment (timeline precedent): fleet shards' ticks
+                # land in the same wall-clock bucket.
+                now = self._wall()
+                next_t = (int(now / tick) + 1) * tick
+                await asyncio.sleep(max(next_t - now, 0.0))
+                try:
+                    self.tick()
+                except Exception:
+                    log.exception("rebalance tick failed")
+        except asyncio.CancelledError:
+            pass
+
+    # ---- controller inputs ----------------------------------------------
+
+    def _role_pods(self) -> dict[str, list[Any]]:
+        """Non-draining pool endpoints grouped by exact role label. Pods
+        labeled ``both`` (or unlabeled) serve either path already and are
+        not rebalanced; a draining pod belongs to neither side until its
+        flip completes."""
+        out: dict[str, list[Any]] = {PREFILL: [], DECODE: []}
+        if self.datastore is None:
+            return out
+        draining = {f.pod for f in self._active}
+        for ep in self.datastore.endpoint_list():
+            addr = ep.metadata.address_port
+            if addr in draining:
+                continue
+            role = ep.metadata.labels.get(ROLE_LABEL)
+            if role in out:
+                out[role].append(ep)
+        return out
+
+    def _workload_deltas(self) -> dict[str, dict[str, int]]:
+        """Per-tick deltas of the SloLedger's per-workload-class counters
+        (requests / slo_met / shed for prefill-heavy vs decode-heavy
+        traffic) — the attainment half of the headroom input."""
+        led = self.slo_ledger
+        out: dict[str, dict[str, int]] = {}
+        if led is None:
+            return out
+        for cls_name, agg in getattr(led, "by_workload", {}).items():
+            cur = (agg.requests, agg.slo_met, agg.shed)
+            prev = self._wl_prev.rows.get(cls_name, (0, 0, 0))
+            self._wl_prev.rows[cls_name] = cur
+            out[cls_name] = {"requests": cur[0] - prev[0],
+                             "slo_met": cur[1] - prev[1],
+                             "shed": cur[2] - prev[2]}
+        return out
+
+    def _headroom(self, pods: list[Any],
+                  wl: dict[str, int] | None) -> dict[str, Any] | None:
+        """One role's goodput headroom, 0 (saturated) .. 1 (idle), with
+        every input inlined for the /debug explanation:
+
+        - ``util_queue``: scraped engine queue depth per pod against the
+          saturating QUEUE_REF knee — the leading congestion signal;
+        - ``miss_rate``: 1 − windowed attainment of the role's workload
+          class (served-relative; the SLO ledger's verdicts) — the lagging
+          goodput signal, confidence-scaled by the tick's sample size
+          (MISS_CONF_SERVED) so a lone cross-role-contaminated straggler
+          cannot fake starvation;
+        - headroom = 1 − max(util_queue, miss_rate).
+        """
+        n = len(pods)
+        if n == 0:
+            return None
+        queued = sum(ep.metrics.waiting_queue_size for ep in pods)
+        running = sum(ep.metrics.running_requests_size for ep in pods)
+        kv = sum(ep.metrics.kv_cache_usage_percent for ep in pods) / n
+        q_per_pod = queued / n
+        util_queue = q_per_pod / (q_per_pod + QUEUE_REF)
+        miss_rate = 0.0
+        if wl:
+            served = wl["requests"] - wl["shed"]
+            if served > 0:
+                miss_rate = ((1.0 - wl["slo_met"] / served)
+                             * min(1.0, served / MISS_CONF_SERVED))
+            elif wl["shed"] > 0:
+                # Everything shed: the role is drowning (same confidence
+                # scale — one shed in a quiet tick is not a collapse).
+                miss_rate = min(1.0, wl["shed"] / MISS_CONF_SERVED)
+        util = max(util_queue, miss_rate)
+        return {
+            "n": n,
+            "queued": queued,
+            "running": running,
+            "kv_usage": round(kv, 4),
+            "util_queue": round(util_queue, 4),
+            "miss_rate": round(miss_rate, 4),
+            "headroom": round(max(0.0, 1.0 - util), 4),
+        }
+
+    # ---- one tick -------------------------------------------------------
+
+    def tick(self, wall: float | None = None) -> dict[str, Any] | None:
+        """Compute the per-role headroom sample, advance in-flight drain
+        cycles, start a flip when the imbalance sustained, and refresh the
+        advice. Kill-switch: one attribute check."""
+        if not self.cfg.enabled:
+            return None
+        now_wall = wall if wall is not None else self._wall()
+        now_mono = self._clock()
+        roles = self._role_pods()
+        wl = self._workload_deltas()
+        sample: dict[str, Any] = {"t_unix": now_wall, "headroom": {}}
+        for role in ROLES:
+            # Workload class keys match the role names deliberately:
+            # prefill-heavy traffic is prefill-pool demand.
+            h = self._headroom(roles[role], wl.get(role))
+            if h is not None:
+                sample["headroom"][role] = h
+                self._g_headroom[role].set(h["headroom"])
+                self.last_headroom[role] = h["headroom"]
+            else:
+                self.last_headroom.pop(role, None)
+        if wl:
+            sample["workloads"] = wl
+        if self.flow is not None:
+            sample["queued_by_band"] = self.flow.queued_by_band()
+        if self.drain_rate_fn is not None:
+            sample["drain_rate_rps"] = round(self.drain_rate_fn(), 4)
+        if self.hop_skips_fn is not None:
+            skips = self.hop_skips_fn()
+            rate = (skips - self._skips_prev) / self.cfg.tick_s
+            self._skips_prev = skips
+            self._skip_rate += SKIP_ALPHA * (rate - self._skip_rate)
+            sample["hop_skip_rate"] = round(self._skip_rate, 4)
+        if self._active:
+            sample["draining"] = [f.pod for f in self._active]
+        self.series.append(sample)
+
+        self._advance_flips(now_wall, now_mono)
+        if self.acting:
+            self._maybe_flip(sample, roles, now_wall, now_mono)
+        if self.cfg.advice:
+            self._advise(sample)
+        return sample
+
+    # ---- drain-cycle state machine --------------------------------------
+
+    def _advance_flips(self, now_wall: float, now_mono: float) -> None:
+        still: list[FlipOp] = []
+        for flip in self._active:
+            ep = (self.datastore.endpoint_get(flip.pod)
+                  if self.datastore is not None else None)
+            if ep is None:
+                flip.state = "aborted"
+                flip.aborted_reason = "pod left the pool mid-drain"
+                self.aborted_total += 1
+                continue
+            m = ep.metrics
+            # Drained = a scrape landed AFTER the drain started and reports
+            # the engine idle — in-flight work (including live streams the
+            # drain must never cut) has run to completion.
+            drained = (m.update_time > flip.start_mono
+                       and m.running_requests_size == 0
+                       and m.waiting_queue_size == 0)
+            timed_out = (now_mono - flip.start_mono
+                         >= self.cfg.drain_timeout_s)
+            if not drained and not timed_out:
+                still.append(flip)
+                continue
+            if drained:
+                flip.drained_unix = now_wall
+            else:
+                # The engine serves both paths, so completing the flip is
+                # safe for whatever would not drain: live streams keep
+                # running; only NEW picks see the new role.
+                flip.drain_timed_out = True
+            self.datastore.set_endpoint_role(flip.pod, flip.to_role)
+            flip.state = "completed"
+            flip.completed_unix = now_wall
+            self.flips_total += 1
+            ROLE_FLIPS_TOTAL.labels(flip.from_role, flip.to_role).inc()
+            log.info("role flip completed: %s %s -> %s (drain %s)",
+                     flip.pod, flip.from_role, flip.to_role,
+                     "timed out" if flip.drain_timed_out else
+                     f"{(flip.drained_unix or now_wall) - flip.started_unix:.2f}s")
+        self._active = still
+
+    def _maybe_flip(self, sample: dict[str, Any],
+                    roles: dict[str, list[Any]],
+                    now_wall: float, now_mono: float) -> None:
+        hp = sample["headroom"].get(PREFILL)
+        hd = sample["headroom"].get(DECODE)
+        if hp is None or hd is None:
+            self._reset_imbalance()
+            return
+        # Starved = the lower-headroom role under the target; the other
+        # side must have something to donate.
+        if hp["headroom"] <= hd["headroom"]:
+            starved, donor, h_starved, h_donor = PREFILL, DECODE, hp, hd
+        else:
+            starved, donor, h_starved, h_donor = DECODE, PREFILL, hd, hp
+        donor_bar = self.cfg.donor_headroom
+        skip_evidence = False
+        if donor == PREFILL and self._skip_rate >= SKIP_RATE_MIN:
+            # The classifier is already serving prefill work decode-side:
+            # the prefill pool is over-provisioned for the live mix, so a
+            # merely-healthy (not fully idle) prefill pool may donate.
+            donor_bar = self.cfg.headroom_target
+            skip_evidence = True
+        # Queue corroboration: a flip adds service slots, which only helps
+        # work that is QUEUED. A role can miss its SLO with empty queues
+        # (service itself over budget, or cross-role contamination via the
+        # P/D legs) — extra pods cannot fix either, so miss evidence alone
+        # never starts a flip.
+        imbalanced = (h_starved["headroom"] < self.cfg.headroom_target
+                      and h_starved["queued"] > 0
+                      and h_donor["headroom"] >= donor_bar
+                      and h_donor["n"] >= 2)
+        key = (donor, starved)
+        if not imbalanced:
+            self._reset_imbalance()
+            return
+        if self._imbalance_key != key:
+            self._imbalance_key = key
+            self._imbalance_ticks = 0
+        self._imbalance_ticks += 1
+        if (self._imbalance_ticks < self.cfg.sustain_ticks
+                or len(self._active) >= self.cfg.max_concurrent_flips
+                or now_mono - self._last_flip_mono < self.cfg.min_dwell_s):
+            return
+        victim, candidates = self._pick_victim(donor, roles)
+        if victim is None:
+            return
+        inputs = {
+            "reason": (f"{starved} headroom "
+                       f"{h_starved['headroom']} < target "
+                       f"{self.cfg.headroom_target} while {donor} holds "
+                       f"{h_donor['headroom']} (bar {donor_bar})"),
+            "headroom": sample["headroom"],
+            "queued_by_band": sample.get("queued_by_band"),
+            "drain_rate_rps": sample.get("drain_rate_rps"),
+            "hop_skip_rate": sample.get("hop_skip_rate"),
+            "skip_evidence": skip_evidence,
+            "sustained_ticks": self._imbalance_ticks,
+            "pair_ewmas": candidates,
+            "workloads": sample.get("workloads"),
+        }
+        self._start_flip(victim, donor, starved, inputs, now_wall, now_mono)
+
+    def _reset_imbalance(self) -> None:
+        self._imbalance_ticks = 0
+        self._imbalance_key = None
+
+    def _start_flip(self, pod: str, from_role: str, to_role: str,
+                    inputs: dict[str, Any], now_wall: float,
+                    now_mono: float) -> None:
+        if not self.datastore.set_endpoint_draining(pod, True):
+            return  # pod vanished between selection and mark
+        flip = FlipOp(pod=pod, from_role=from_role, to_role=to_role,
+                      started_unix=now_wall, start_mono=now_mono,
+                      inputs=inputs)
+        self._active.append(flip)
+        self._flips.append(flip)
+        self._last_flip_mono = now_mono
+        self._reset_imbalance()
+        log.info("role flip started: %s %s -> %s (%s)", pod, from_role,
+                 to_role, inputs["reason"])
+
+    # ---- transfer-aware victim selection --------------------------------
+
+    def _pick_victim(self, donor: str, roles: dict[str, list[Any]]
+                     ) -> tuple[str | None, dict[str, Any]]:
+        """Choose which donor-role pod flips, scored against the measured
+        pair EWMAs (TransferTable):
+
+        - decode → prefill: the candidate will PAIR with the remaining
+          decode pods — prefer the cheapest measured mean pull;
+        - prefill → decode: the pool LOSES the candidate's pairs — prefer
+          giving up the most expensive ones.
+
+        Unmeasured pairs score neutral (the mean of the measured field, or
+        flat when nothing is measured) and current load breaks ties — the
+        least-loaded pod drains fastest."""
+        pods = roles.get(donor) or []
+        if len(pods) < 2:
+            return None, {}
+        table = getattr(self.datastore, "transfers", None)
+        rows: dict[str, Any] = {}
+        means: dict[str, float | None] = {}
+        for ep in pods:
+            addr = ep.metadata.address_port
+            # Both directions score the candidate AS A PREFILL POD (the
+            # TransferTable key order): decode→prefill pairs it with the
+            # remaining decode pods (its future peers); prefill→decode
+            # reads the pairs the pool is about to lose.
+            if donor == DECODE:
+                peers = [p.metadata.address_port for p in pods if p is not ep]
+            else:
+                peers = [p.metadata.address_port
+                         for p in roles.get(DECODE) or []]
+            pulls: dict[str, float] = {}
+            if table is not None:
+                for peer in peers:
+                    stats = table.pair(addr, peer)
+                    if stats is not None and stats.ewma_pull_ms is not None:
+                        pulls[peer] = round(stats.ewma_pull_ms, 3)
+            load = (ep.metrics.waiting_queue_size
+                    + ep.metrics.running_requests_size)
+            mean = (sum(pulls.values()) / len(pulls)) if pulls else None
+            means[addr] = mean
+            rows[addr] = {"mean_pair_pull_ms": (round(mean, 3)
+                                                if mean is not None
+                                                else None),
+                          "pair_ewmas": pulls, "load": load}
+        measured = [m for m in means.values() if m is not None]
+        neutral = (sum(measured) / len(measured)) if measured else 0.0
+
+        def key(ep):
+            addr = ep.metadata.address_port
+            mean = means[addr] if means[addr] is not None else neutral
+            # decode→prefill wants the CHEAPEST future pairs; prefill→
+            # decode gives up the MOST EXPENSIVE existing ones.
+            primary = mean if donor == DECODE else -mean
+            return (primary, rows[addr]["load"], addr)
+
+        victim = min(pods, key=key).metadata.address_port
+        rows[victim]["chosen"] = True
+        return victim, rows
+
+    # ---- advice ---------------------------------------------------------
+
+    def _advise(self, sample: dict[str, Any]) -> None:
+        """Scale advice from the same feasibility math: UP when a role
+        starves and no flip can help (the peer has nothing to donate);
+        DOWN when a role idles against a healthy peer (plus the hop-skip
+        evidence for prefill). Gauges carry the verdict; the inputs live
+        in the /debug/rebalance advice block."""
+        cfg = self.cfg
+        advice: dict[str, dict[str, Any]] = {}
+        for role in ROLES:
+            other = DECODE if role == PREFILL else PREFILL
+            h = sample["headroom"].get(role)
+            ho = sample["headroom"].get(other)
+            direction = "hold"
+            why = "headroom inside the target band"
+            if h is None:
+                advice[role] = {"direction": "hold",
+                                "why": "no pods in role"}
+                self._g_advice[(role, "up")].set(0)
+                self._g_advice[(role, "down")].set(0)
+                continue
+            flip_possible = (ho is not None and ho["n"] >= 2
+                             and ho["headroom"] >= cfg.donor_headroom)
+            if h["headroom"] < cfg.headroom_target and not flip_possible:
+                direction = "up"
+                why = (f"headroom {h['headroom']} < target "
+                       f"{cfg.headroom_target} and {other} has nothing to "
+                       "donate")
+            elif (h["headroom"] >= cfg.donor_headroom and ho is not None
+                  and ho["headroom"] >= cfg.headroom_target
+                  and h["n"] >= 2):
+                direction = "down"
+                why = (f"headroom {h['headroom']} >= {cfg.donor_headroom} "
+                       f"while {other} is healthy")
+                if role == PREFILL and self._skip_rate >= SKIP_RATE_MIN:
+                    why += (f"; hop-skip rate {self._skip_rate:.2f}/s says "
+                            "prefill work is already served decode-side")
+            advice[role] = {"direction": direction, "why": why,
+                            "headroom": h["headroom"]}
+            self._g_advice[(role, "up")].set(1 if direction == "up" else 0)
+            self._g_advice[(role, "down")].set(
+                1 if direction == "down" else 0)
+        self._advice = advice
+
+    # ---- render ---------------------------------------------------------
+
+    def snapshot(self, *, series_n: int | None = 60) -> dict[str, Any]:
+        """The /debug/rebalance payload."""
+        cfg = self.cfg
+        doc: dict[str, Any] = {
+            "enabled": cfg.enabled,
+            "acting": self.acting,
+            "config": {
+                "tick_s": cfg.tick_s,
+                "min_dwell_s": cfg.min_dwell_s,
+                "headroom_target": cfg.headroom_target,
+                "donor_headroom": cfg.donor_headroom,
+                "sustain_ticks": cfg.sustain_ticks,
+                "max_concurrent_flips": cfg.max_concurrent_flips,
+                "drain_timeout_s": cfg.drain_timeout_s,
+                "advice": cfg.advice,
+            },
+            "ticks": len(self.series),
+            "flips_total": self.flips_total,
+            "aborted_total": self.aborted_total,
+        }
+        if self.series:
+            doc["current"] = self.series[-1]
+            samples = list(self.series)
+            if series_n is not None:
+                samples = samples[-series_n:]
+            doc["series"] = samples
+        if self.cfg.advice:
+            doc["advice"] = self._advice
+        doc["active_flips"] = [f.render() for f in self._active]
+        doc["flips"] = [f.render() for f in reversed(self._flips)]
+        return doc
+
+
+# ---------------------------------------------------------------------------
+# Fleet fan-in.
+# ---------------------------------------------------------------------------
+
+MERGE_FLIPS_TOTAL = 32
+
+
+def merge_rebalance(docs: list[tuple[int, dict[str, Any]]]) -> dict[str, Any]:
+    """Fleet /debug/rebalance: only the datalayer-owning worker acts (its
+    doc carries the flips and the live advice); the merged view annotates
+    every flip with its shard, sums the counters, and keeps each shard's
+    compact row so a non-acting follower is visibly a follower rather than
+    silently empty."""
+    out: dict[str, Any] = {
+        "workers": len(docs),
+        "enabled": any(d.get("enabled") for _, d in docs),
+        "acting_shards": [s for s, d in docs if d.get("acting")],
+        "flips_total": sum(d.get("flips_total", 0) for _, d in docs),
+        "shards": {},
+        "flips": [],
+    }
+    for shard, doc in docs:
+        row: dict[str, Any] = {
+            "enabled": doc.get("enabled"),
+            "acting": doc.get("acting"),
+            "flips_total": doc.get("flips_total", 0),
+        }
+        if doc.get("current"):
+            row["current"] = doc["current"]
+        if doc.get("advice"):
+            row["advice"] = doc["advice"]
+        out["shards"][str(shard)] = row
+        for flip in doc.get("flips") or []:
+            out["flips"].append({**flip, "shard": shard})
+        if doc.get("acting") and doc.get("advice"):
+            out["advice"] = doc["advice"]
+    out["flips"] = sorted(out["flips"],
+                          key=lambda f: f.get("started_unix", 0.0),
+                          reverse=True)[:MERGE_FLIPS_TOTAL]
+    return out

@@ -249,6 +249,15 @@ class SloLedger:
         # keeps the tick path off the full snapshot() render.
         self.prompt_tokens_total = 0
         self.tokens_by_role: dict[str, tuple[int, int]] = {}
+        # Per-WORKLOAD-CLASS aggregates ("prefill"-heavy vs "decode"-heavy
+        # requests, classified by their own prompt:completion token split
+        # at completion). Distinct from the per-serving-role split above:
+        # a P/D request terminates on its decode pod, so serving-role
+        # attainment can never say "prefill-shaped traffic is missing its
+        # SLO" — which is exactly the starvation signal the rebalance
+        # controller (router/rebalance.py) keys its per-role headroom on.
+        # Public flat state, read per tick (the tokens_by_role precedent).
+        self.by_workload: dict[str, _Agg] = {}
 
     @property
     def enabled(self) -> bool:
@@ -428,10 +437,19 @@ class SloLedger:
             PREDICTOR_ERROR_MS.labels("tpot", role_label).observe(
                 abs(tpot_signed))
 
+        # Workload class: which pool role's capacity this request mostly
+        # consumed — prompt-dominant requests are prefill-pool work,
+        # completion-dominant ones decode-pool work (the rebalance
+        # controller's per-role attainment input; see by_workload above).
+        # Requests with no token evidence (errors, sheds) file under
+        # decode: they cannot claim prefill starvation.
+        workload = "prefill" if prompt_tokens > tokens else "decode"
+
         # Rollup.
         for agg in (self._totals,
                     self._endpoint_agg(obs.endpoint or "(unrouted)"),
-                    self._agg(self._by_band, obs.band)):
+                    self._agg(self._by_band, obs.band),
+                    self._agg(self.by_workload, workload)):
             agg.requests += 1
             if shed:
                 agg.shed += 1
@@ -541,6 +559,10 @@ class SloLedger:
                           for ep, a in sorted(self._by_endpoint.items())},
             "bands": {str(b): a.render(predictor=False)
                       for b, a in sorted(self._by_band.items())},
+            # Prefill-heavy vs decode-heavy attainment (the rebalance
+            # controller's starvation signal — see by_workload).
+            "workloads": {w: a.render(predictor=False)
+                          for w, a in sorted(self.by_workload.items())},
             "miss_reasons": dict(sorted(self._miss_reasons.items())),
             "shed_reasons": dict(sorted(self._shed_reasons.items())),
         }

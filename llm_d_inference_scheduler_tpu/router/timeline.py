@@ -469,7 +469,7 @@ class _Baseline:
     __slots__ = ("requests", "met", "shed", "out_tokens",
                  "good_tokens", "prompt_tokens", "degraded", "kv_stamps",
                  "kv_joins", "gc_pause_s", "by_role",
-                 "shadow_eval", "shadow_div", "shadow_regret")
+                 "shadow_eval", "shadow_div", "shadow_regret", "flips")
 
     def __init__(self):
         self.requests = 0
@@ -486,6 +486,7 @@ class _Baseline:
         self.shadow_eval = 0
         self.shadow_div = 0
         self.shadow_regret = 0.0
+        self.flips = 0
 
 
 class TimelineSampler:
@@ -516,6 +517,7 @@ class TimelineSampler:
                  decisions_fn: Callable[[int], list] | None = None,
                  divergence_fn: Callable[[], float] | None = None,
                  shadow: Any = None,
+                 rebalance: Any = None,
                  wall: Callable[[], float] = time.time):
         self.cfg = cfg
         self.slo_ledger = slo_ledger
@@ -530,6 +532,10 @@ class TimelineSampler:
         # — evaluated/diverged/regret deltas become the counterfactual
         # series the flight recorder correlates against goodput swings.
         self.shadow = shadow
+        # Rebalance controller (router/rebalance.py): per-role headroom +
+        # flip deltas become the series that explains a mid-run P:D
+        # reshape next to the token-mix swing that caused it.
+        self.rebalance = rebalance
         self._wall = wall
         self.ring: deque[dict[str, Any]] = deque(maxlen=cfg.ring_capacity)
         self.burn = BurnRateMonitor(cfg)
@@ -730,6 +736,17 @@ class TimelineSampler:
             }
             prev.shadow_eval, prev.shadow_div = ev, dv
             prev.shadow_regret = rg
+
+        # Self-balancing pool (router/rebalance.py): per-role headroom +
+        # completed-flip deltas — flat reads, the controller owns the math.
+        rb = self.rebalance
+        if rb is not None and rb.enabled:
+            row: dict[str, Any] = {"flips": rb.flips_total - prev.flips,
+                                   "draining": rb.active_count}
+            prev.flips = rb.flips_total
+            if rb.last_headroom:
+                row["headroom"] = dict(rb.last_headroom)
+            sample["rebalance"] = row
 
         # Process self-telemetry (gauges + the timeline series). The /proc
         # reads are real syscalls (~15-25µs together), so they run every

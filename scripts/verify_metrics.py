@@ -104,6 +104,12 @@ REQUIRED_FAMILIES = {
     ("router_fleet_leader", "fleet"),
     ("router_leader_elections", "fleet"),
     ("router_kv_index_resyncs", "router"),
+    # Self-balancing pool (ISSUE 15): the per-role headroom gauge, the
+    # drain-cycle role-flip counter, and the predictive scaling-advice
+    # gauge a k8s InferencePool reconciler would consume.
+    ("router_rebalance_headroom", "router"),
+    ("router_role_flips", "router"),
+    ("router_pool_advice", "router"),
 }
 
 # Registries whose every family must have a docs/metrics.md row (the

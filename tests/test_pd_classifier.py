@@ -164,6 +164,88 @@ class TestClassifierVerdicts:
         assert block["verdict"] == "skip"
 
 
+class TestPairCostMargin:
+    """Measured-pair-cost coupling (ROADMAP item 1's noted extension): the
+    cheapest measured KV-pull EWMA into the chosen decode pod scales the
+    skip threshold — cheap pull → keep the hop more often, expensive pull
+    → skip more eagerly; no measured pair → bit-identical neutrality."""
+
+    def _warm_handler(self, ref_ms: float, ds: Datastore | None = None):
+        ds = ds or Datastore()
+        h = _handler(PdClassifierConfig(enabled=True, min_confidence=0.0,
+                                        cold_token_threshold=64,
+                                        pair_cost_ref_ms=ref_ms),
+                     datastore=ds)
+        # Borderline pod: expected_cold lands between threshold/2 and
+        # threshold, so the margin direction decides the verdict.
+        ep = _ep(9000, "decode")
+        ep.attributes.put(PREFIX_ATTRIBUTE_KEY,
+                          PrefixCacheMatchInfo(13, 16, 16))
+        return h, ds, ep
+
+    def _expected_cold(self, h, ep) -> float:
+        return h._classify(_req(), ep, None)["expected_cold_tokens"]
+
+    def test_cheap_pull_weakens_the_skip(self):
+        h, ds, ep = self._warm_handler(25.0)
+        cold = self._expected_cold(h, ep)
+        assert 32 < cold < 64  # borderline by construction
+        # No measured pair: neutral margin → skip at the base threshold.
+        block = h._classify(_req(), ep, None)
+        assert block["verdict"] == "skip"
+        assert "pair_cost" not in block
+        # A CHEAP measured pull into this decode pod halves the bar: the
+        # hop costs little, so the same borderline prefill keeps it.
+        ds.transfers.record("127.0.0.1:7000", "127.0.0.1:9000", pull_ms=1.0)
+        block = h._classify(_req(), ep, None)
+        assert block["verdict"] == "keep"
+        pc = block["pair_cost"]
+        assert pc["min_ewma_pull_ms"] == 1.0
+        assert pc["margin"] == 0.5  # clamped floor
+        assert pc["effective_threshold"] == 32.0
+
+    def test_expensive_pull_strengthens_the_skip(self):
+        h, ds, ep = self._warm_handler(25.0)
+        # Push the pod colder so the base threshold would KEEP …
+        ep.attributes.put(PREFIX_ATTRIBUTE_KEY,
+                          PrefixCacheMatchInfo(10, 16, 16))
+        assert h._classify(_req(), ep, None)["verdict"] == "keep"
+        # … but an expensive measured pull doubles the bar → skip.
+        ds.transfers.record("127.0.0.1:7000", "127.0.0.1:9000",
+                            pull_ms=500.0)
+        block = h._classify(_req(), ep, None)
+        assert block["verdict"] == "skip"
+        assert block["pair_cost"]["margin"] == 2.0  # clamped ceiling
+        assert block["pair_cost"]["effective_threshold"] == 128.0
+
+    def test_cheapest_pair_wins(self):
+        h, ds, ep = self._warm_handler(25.0)
+        ds.transfers.record("127.0.0.1:7000", "127.0.0.1:9000",
+                            pull_ms=100.0)
+        ds.transfers.record("127.0.0.1:7001", "127.0.0.1:9000",
+                            pull_ms=12.5)
+        block = h._classify(_req(), ep, None)
+        # min over measured pairs INTO the pod; 12.5/25 → margin 0.5.
+        assert block["pair_cost"]["min_ewma_pull_ms"] == 12.5
+        assert block["pair_cost"]["margin"] == 0.5
+        # Pairs into OTHER decode pods don't count.
+        assert ds.transfers.cheapest_pull_ms("127.0.0.1:9999") is None
+
+    def test_coupling_disabled_is_bit_identical(self):
+        h, ds, ep = self._warm_handler(0.0)
+        ds.transfers.record("127.0.0.1:7000", "127.0.0.1:9000", pull_ms=1.0)
+        block = h._classify(_req(), ep, None)
+        assert block["verdict"] == "skip"
+        assert "pair_cost" not in block
+
+    def test_loader_threads_pair_cost_ref(self):
+        cfg_text = TestLoaderPlumbing.CFG.replace(
+            "minConfidence: 0.25", "minConfidence: 0.25, pairCostRefMs: 40")
+        cfg = load_config(cfg_text, Handle(datastore=Datastore()))
+        h = cfg.plugins_by_name["disagg-profile-handler"]
+        assert h.classifier_cfg.pair_cost_ref_ms == 40.0
+
+
 class TestPickProfilesIntegration:
     """The classifier stage inside pick_profiles: skip suppresses the
     prefill profile; keep falls through to the decider; the verdict is

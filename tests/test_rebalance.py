@@ -1,0 +1,867 @@
+"""Self-balancing pool (router/rebalance.py): headroom math, the
+drain-cycle role-flip state machine, transfer-aware victim selection,
+scaling advice, the kill-switch, minDwellS anti-thrash, the loader's
+default transfer-aware-pair-scorer injection (+ its shadow twin's
+live_twin_active path), and the live e2e where a decode pod flips to
+prefill under traffic with zero client-visible errors.
+"""
+
+import asyncio
+import time
+
+import httpx
+import pytest
+
+from llm_d_inference_scheduler_tpu.router.config.loader import (
+    Handle,
+    load_config,
+)
+from llm_d_inference_scheduler_tpu.router.datalayer.datastore import Datastore
+from llm_d_inference_scheduler_tpu.router.framework.datalayer import (
+    DRAINING_LABEL,
+    ROLE_LABEL,
+    EndpointMetadata,
+)
+from llm_d_inference_scheduler_tpu.router.rebalance import (
+    RebalanceConfig,
+    RebalanceController,
+    merge_rebalance,
+)
+
+import llm_d_inference_scheduler_tpu.router.plugins  # noqa: F401  (register)
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+def _pool(ds: Datastore, spec: dict[str, str]) -> None:
+    """spec: address_port -> role."""
+    for addr, role in spec.items():
+        host, _, port = addr.rpartition(":")
+        ds.endpoint_add_or_update(EndpointMetadata(
+            name=addr, address=host, port=int(port),
+            labels={ROLE_LABEL: role}))
+
+
+def _load(ds: Datastore, addr: str, *, waiting: int = 0, running: int = 0,
+          scraped_at: float | None = None) -> None:
+    ep = ds.endpoint_get(addr)
+    ep.metrics.waiting_queue_size = waiting
+    ep.metrics.running_requests_size = running
+    if scraped_at is not None:
+        ep.metrics.update_time = scraped_at
+
+
+def _controller(ds: Datastore, clock: FakeClock, **over) -> RebalanceController:
+    cfg = RebalanceConfig(enabled=True, tick_s=1.0, min_dwell_s=5.0,
+                          headroom_target=0.25, donor_headroom=0.6,
+                          sustain_ticks=2, drain_timeout_s=30.0)
+    for k, v in over.items():
+        setattr(cfg, k, v)
+    return RebalanceController(cfg, datastore=ds, clock=clock,
+                               wall=lambda: clock.t + 1e9)
+
+
+class TestConfig:
+    def test_defaults_off(self):
+        cfg = RebalanceConfig.from_spec(None)
+        assert cfg.enabled is False
+        assert cfg.min_dwell_s == 30.0
+
+    def test_spec_roundtrip(self):
+        cfg = RebalanceConfig.from_spec({
+            "enabled": True, "tickS": 0.5, "minDwellS": 10,
+            "headroomTarget": 0.3, "maxConcurrentFlips": 2,
+            "advice": False})
+        assert (cfg.enabled, cfg.tick_s, cfg.min_dwell_s) == (True, 0.5, 10.0)
+        assert cfg.max_concurrent_flips == 2
+        assert cfg.advice is False
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            RebalanceConfig.from_spec({"tickS": 0})
+        with pytest.raises(ValueError):
+            RebalanceConfig.from_spec({"headroomTarget": 1.5})
+        with pytest.raises(ValueError):
+            RebalanceConfig.from_spec({"headroomTarget": 0.7,
+                                       "donorHeadroom": 0.3})
+
+
+class TestKillSwitch:
+    def test_disabled_tick_is_inert(self):
+        ds = Datastore()
+        _pool(ds, {"10.0.0.1:8000": "decode", "10.0.0.2:8000": "prefill"})
+        clock = FakeClock()
+        c = RebalanceController(RebalanceConfig(enabled=False),
+                                datastore=ds, clock=clock,
+                                wall=lambda: clock.t)
+        assert c.tick() is None
+        assert c.flips_total == 0
+        assert len(c.series) == 0
+        doc = c.snapshot()
+        assert doc["enabled"] is False
+        assert doc["flips"] == []
+        # Roles untouched.
+        assert ds.endpoint_get("10.0.0.1:8000").metadata.labels[
+            ROLE_LABEL] == "decode"
+
+
+class TestHeadroom:
+    def test_idle_pool_full_headroom(self):
+        ds = Datastore()
+        _pool(ds, {"10.0.0.1:8000": "decode", "10.0.0.2:8000": "prefill"})
+        c = _controller(ds, FakeClock())
+        s = c.tick()
+        assert s["headroom"]["decode"]["headroom"] == 1.0
+        assert s["headroom"]["prefill"]["headroom"] == 1.0
+
+    def test_queue_pressure_collapses_headroom(self):
+        ds = Datastore()
+        _pool(ds, {"10.0.0.1:8000": "decode", "10.0.0.2:8000": "prefill"})
+        _load(ds, "10.0.0.1:8000", waiting=36)  # util = 36/40 = 0.9
+        c = _controller(ds, FakeClock())
+        s = c.tick()
+        assert s["headroom"]["decode"]["headroom"] == pytest.approx(0.1)
+        assert s["headroom"]["decode"]["util_queue"] == pytest.approx(0.9)
+
+    def test_low_volume_miss_is_confidence_scaled(self):
+        """A single straggler completing late in a quiet tick must not
+        read as role starvation: its workload class can miss through the
+        OTHER role's congestion (a prefill request's e2e includes its
+        decode leg's queue wait)."""
+        from llm_d_inference_scheduler_tpu.router.slo import _Agg
+
+        ds = Datastore()
+        _pool(ds, {"10.0.0.1:8000": "decode", "10.0.0.2:8000": "prefill"})
+
+        class Led:
+            by_workload = {"prefill": _Agg()}
+
+        led = Led()
+        led.by_workload["prefill"].requests = 1    # one served, one miss
+        clock = FakeClock()
+        c = RebalanceController(
+            RebalanceConfig(enabled=True), datastore=ds, slo_ledger=led,
+            clock=clock, wall=lambda: clock.t)
+        s = c.tick()
+        # miss 1.0 scaled by served/MISS_CONF_SERVED = 1/3.
+        assert s["headroom"]["prefill"]["miss_rate"] == pytest.approx(
+            1 / 3, abs=1e-4)
+        assert s["headroom"]["prefill"]["headroom"] == pytest.approx(
+            2 / 3, abs=1e-4)
+
+    def test_miss_without_queue_never_flips(self):
+        """Queue corroboration: a flip adds service slots, which only
+        helps QUEUED work — full-strength miss evidence with an empty
+        queue (service over budget / cross-role contamination) must not
+        start a flip, however long it sustains."""
+        from llm_d_inference_scheduler_tpu.router.slo import _Agg
+
+        ds = Datastore()
+        _pool(ds, {"10.0.0.1:8000": "decode", "10.0.0.2:8000": "decode",
+                   "10.0.0.3:8000": "prefill"})
+
+        class Led:
+            by_workload = {"prefill": _Agg()}
+
+        led = Led()
+        clock = FakeClock()
+        c = RebalanceController(
+            RebalanceConfig(enabled=True, min_dwell_s=0.0, sustain_ticks=1),
+            datastore=ds, slo_ledger=led, clock=clock,
+            wall=lambda: clock.t)
+        for _ in range(5):
+            led.by_workload["prefill"].requests += 10   # 10 served/tick,
+            s = c.tick()                                # all missed
+        assert s["headroom"]["prefill"]["miss_rate"] == 1.0
+        assert not c._active and c.flips_total == 0
+        # The same starvation WITH queued work flips immediately.
+        _load(ds, "10.0.0.3:8000", waiting=8)
+        led.by_workload["prefill"].requests += 10
+        c.tick()
+        assert len(c._active) == 1
+
+    def test_workload_miss_rate_collapses_headroom(self):
+        from llm_d_inference_scheduler_tpu.router.slo import _Agg
+
+        ds = Datastore()
+        _pool(ds, {"10.0.0.1:8000": "decode", "10.0.0.2:8000": "prefill"})
+
+        class Led:
+            by_workload = {"prefill": _Agg()}
+
+        led = Led()
+        led.by_workload["prefill"].requests = 10
+        led.by_workload["prefill"].slo_met = 2
+        clock = FakeClock()
+        c = RebalanceController(
+            RebalanceConfig(enabled=True), datastore=ds, slo_ledger=led,
+            clock=clock, wall=lambda: clock.t)
+        s = c.tick()
+        # 8 of 10 prefill-heavy requests missed → prefill headroom 0.2.
+        assert s["headroom"]["prefill"]["miss_rate"] == pytest.approx(0.8)
+        assert s["headroom"]["prefill"]["headroom"] == pytest.approx(0.2)
+        assert s["workloads"]["prefill"]["requests"] == 10
+        # Second tick: deltas, not cumulative counts.
+        s2 = c.tick()
+        assert s2["workloads"]["prefill"]["requests"] == 0
+        assert s2["headroom"]["prefill"]["miss_rate"] == 0.0
+
+
+class TestFlipLifecycle:
+    def _starved_decode(self) -> tuple[Datastore, FakeClock,
+                                       RebalanceController]:
+        """3 prefill (idle) + 1 decode (drowning): the controller should
+        flip prefill pods to decode (one per dwell window)."""
+        ds = Datastore()
+        _pool(ds, {"10.0.0.1:8000": "prefill", "10.0.0.2:8000": "prefill",
+                   "10.0.0.4:8000": "prefill", "10.0.0.3:8000": "decode"})
+        _load(ds, "10.0.0.3:8000", waiting=50)
+        clock = FakeClock()
+        c = _controller(ds, clock)
+        clock.advance(5.0)  # past the boot dwell
+        return ds, clock, c
+
+    def test_flip_runs_the_drain_cycle(self):
+        ds, clock, c = self._starved_decode()
+        c.tick()                      # sustain 1/2
+        assert not c._active
+        c.tick()                      # sustain 2/2 → flip starts
+        assert len(c._active) == 1
+        flip = c._active[0]
+        assert (flip.from_role, flip.to_role) == ("prefill", "decode")
+        victim = flip.pod
+        # Draining mark republished into the metadata (role filters key
+        # off it) and the flip carries its full explanation.
+        assert ds.endpoint_get(victim).metadata.labels[
+            DRAINING_LABEL] == "true"
+        for key in ("reason", "headroom", "pair_ewmas", "sustained_ticks"):
+            assert key in flip.inputs
+        # Not drained yet: no post-drain scrape landed.
+        clock.advance(1.0)
+        c.tick()
+        assert flip.state == "draining"
+        # An idle scrape lands after the drain started → the flip
+        # completes and the role republishes atomically.
+        _load(ds, victim, waiting=0, running=0, scraped_at=clock.t)
+        clock.advance(1.0)
+        c.tick()
+        assert flip.state == "completed"
+        labels = ds.endpoint_get(victim).metadata.labels
+        assert labels[ROLE_LABEL] == "decode"
+        assert DRAINING_LABEL not in labels
+        assert c.flips_total == 1
+        assert c.snapshot()["flips"][0]["state"] == "completed"
+
+    def test_draining_pod_excluded_from_role_filters(self):
+        from llm_d_inference_scheduler_tpu.router.plugins.filters import (
+            DecodeFilter,
+            PrefillFilter,
+        )
+
+        ds, clock, c = self._starved_decode()
+        c.tick()
+        c.tick()
+        victim = c._active[0].pod
+        eps = ds.endpoint_list()
+        kept_prefill = PrefillFilter().filter(None, None, None, eps)
+        kept_decode = DecodeFilter().filter(None, None, None, eps)
+        assert victim not in [e.metadata.address_port for e in kept_prefill]
+        assert victim not in [e.metadata.address_port for e in kept_decode]
+
+    def test_min_dwell_prevents_thrash(self):
+        ds, clock, c = self._starved_decode()
+        c.tick()
+        c.tick()
+        victim = c._active[0].pod
+        _load(ds, victim, waiting=0, running=0, scraped_at=clock.t + 0.5)
+        clock.advance(1.0)
+        c.tick()
+        assert c.flips_total == 1
+        # The pool is STILL imbalanced (decode queue never moved in this
+        # synthetic pool) — but the dwell must hold the next flip back.
+        for _ in range(10):
+            clock.advance(0.2)
+            c.tick()
+        assert c.flips_total == 1 and not c._active
+        # Past the dwell the controller may act again.
+        clock.advance(5.0)
+        c.tick()
+        c.tick()
+        assert len(c._active) == 1
+
+    def test_never_drains_the_last_donor_pod(self):
+        ds = Datastore()
+        _pool(ds, {"10.0.0.1:8000": "prefill", "10.0.0.3:8000": "decode"})
+        _load(ds, "10.0.0.3:8000", waiting=50)
+        clock = FakeClock()
+        c = _controller(ds, clock)
+        clock.advance(10.0)
+        for _ in range(5):
+            c.tick()
+        assert not c._active and c.flips_total == 0
+
+    def test_drain_timeout_completes_anyway(self):
+        ds, clock, c = self._starved_decode()
+        c.tick()
+        c.tick()
+        flip = c._active[0]
+        _load(ds, flip.pod, waiting=0, running=3,
+              scraped_at=clock.t + 0.5)  # never goes idle
+        clock.advance(31.0)  # past drainTimeoutS
+        c.tick()
+        assert flip.state == "completed"
+        assert flip.drain_timed_out is True
+        assert ds.endpoint_get(flip.pod).metadata.labels[
+            ROLE_LABEL] == "decode"
+
+    def test_non_acting_follower_never_flips(self):
+        ds, clock, _ = self._starved_decode()
+        c = RebalanceController(
+            RebalanceConfig(enabled=True, min_dwell_s=0.0, sustain_ticks=1),
+            datastore=ds, acting=False, clock=clock,
+            wall=lambda: clock.t)
+        for _ in range(5):
+            s = c.tick()
+        assert s is not None and not c._active  # observes, never acts
+        c.promote()
+        assert c.acting is True
+        c.tick()
+        assert len(c._active) == 1
+
+
+class TestVictimSelection:
+    def test_decode_to_prefill_prefers_cheapest_future_pairs(self):
+        """3 decode pods, prefill starving: the victim should be the pod
+        whose measured (victim-as-prefill, remaining-decode) pulls are
+        cheapest; the unmeasured candidate scores neutral."""
+        ds = Datastore()
+        _pool(ds, {"10.0.0.1:8000": "decode", "10.0.0.2:8000": "decode",
+                   "10.0.0.3:8000": "decode", "10.0.0.9:8000": "prefill"})
+        _load(ds, "10.0.0.9:8000", waiting=50)
+        # d1 pairs expensive, d2 cheap; d3 unmeasured (neutral mean).
+        for peer in ("10.0.0.2:8000", "10.0.0.3:8000"):
+            ds.transfers.record("10.0.0.1:8000", peer, pull_ms=40.0)
+        for peer in ("10.0.0.1:8000", "10.0.0.3:8000"):
+            ds.transfers.record("10.0.0.2:8000", peer, pull_ms=1.0)
+        clock = FakeClock()
+        c = _controller(ds, clock, sustain_ticks=1, min_dwell_s=0.0)
+        clock.advance(1.0)
+        c.tick()
+        assert len(c._active) == 1
+        flip = c._active[0]
+        assert flip.pod == "10.0.0.2:8000"
+        rows = flip.inputs["pair_ewmas"]
+        assert rows["10.0.0.2:8000"]["chosen"] is True
+        assert rows["10.0.0.1:8000"]["mean_pair_pull_ms"] == 40.0
+        assert rows["10.0.0.3:8000"]["mean_pair_pull_ms"] is None
+
+    def test_prefill_to_decode_gives_up_most_expensive_pairs(self):
+        ds = Datastore()
+        _pool(ds, {"10.0.0.1:8000": "prefill", "10.0.0.2:8000": "prefill",
+                   "10.0.0.3:8000": "decode"})
+        _load(ds, "10.0.0.3:8000", waiting=50)
+        ds.transfers.record("10.0.0.1:8000", "10.0.0.3:8000", pull_ms=1.0)
+        ds.transfers.record("10.0.0.2:8000", "10.0.0.3:8000", pull_ms=40.0)
+        clock = FakeClock()
+        c = _controller(ds, clock, sustain_ticks=1, min_dwell_s=0.0)
+        clock.advance(1.0)
+        c.tick()
+        assert c._active[0].pod == "10.0.0.2:8000"  # losing it costs least
+
+    def test_load_breaks_ties(self):
+        ds = Datastore()
+        _pool(ds, {"10.0.0.1:8000": "prefill", "10.0.0.2:8000": "prefill",
+                   "10.0.0.3:8000": "decode"})
+        _load(ds, "10.0.0.3:8000", waiting=50)
+        _load(ds, "10.0.0.1:8000", running=3)  # busier → drains slower
+        clock = FakeClock()
+        c = _controller(ds, clock, sustain_ticks=1, min_dwell_s=0.0)
+        clock.advance(1.0)
+        c.tick()
+        assert c._active[0].pod == "10.0.0.2:8000"
+
+
+class TestAdvice:
+    def test_up_when_no_donor(self):
+        ds = Datastore()
+        _pool(ds, {"10.0.0.1:8000": "prefill", "10.0.0.3:8000": "decode"})
+        _load(ds, "10.0.0.3:8000", waiting=50)
+        _load(ds, "10.0.0.1:8000", waiting=50)
+        c = _controller(ds, FakeClock())
+        c.tick()
+        advice = c.snapshot()["advice"]
+        assert advice["decode"]["direction"] == "up"
+        assert advice["prefill"]["direction"] == "up"
+
+    def test_down_when_idle_against_healthy_peer(self):
+        ds = Datastore()
+        _pool(ds, {"10.0.0.1:8000": "prefill", "10.0.0.2:8000": "prefill",
+                   "10.0.0.3:8000": "decode"})
+        c = _controller(ds, FakeClock())
+        c.tick()
+        advice = c.snapshot()["advice"]
+        assert advice["prefill"]["direction"] == "down"
+        # Single decode pod (n < 2) never advises down.
+        assert advice["decode"]["direction"] == "hold"
+
+    def test_hop_skip_rate_feeds_prefill_down_evidence(self):
+        ds = Datastore()
+        _pool(ds, {"10.0.0.1:8000": "prefill", "10.0.0.2:8000": "prefill",
+                   "10.0.0.3:8000": "decode"})
+        skips = {"n": 0}
+        clock = FakeClock()
+        c = RebalanceController(
+            RebalanceConfig(enabled=True), datastore=ds,
+            hop_skips_fn=lambda: skips["n"], clock=clock,
+            wall=lambda: clock.t)
+        skips["n"] = 10
+        s = c.tick()
+        assert s["hop_skip_rate"] > 0
+        advice = c.snapshot()["advice"]
+        assert "hop-skip" in advice["prefill"]["why"]
+
+    def test_advice_gauges_are_exported(self):
+        from llm_d_inference_scheduler_tpu.router.metrics import REGISTRY
+
+        ds = Datastore()
+        _pool(ds, {"10.0.0.1:8000": "prefill", "10.0.0.2:8000": "prefill",
+                   "10.0.0.3:8000": "decode"})
+        c = _controller(ds, FakeClock())
+        c.tick()
+        assert REGISTRY.get_sample_value(
+            "router_pool_advice",
+            {"role": "prefill", "direction": "down"}) == 1.0
+        assert REGISTRY.get_sample_value(
+            "router_rebalance_headroom", {"role": "decode"}) == 1.0
+
+
+class TestMergeRebalance:
+    def test_merge_annotates_shards(self):
+        leader = {"enabled": True, "acting": True, "flips_total": 2,
+                  "advice": {"prefill": {"direction": "hold"}},
+                  "flips": [{"pod": "a", "started_unix": 5.0},
+                            {"pod": "b", "started_unix": 9.0}]}
+        follower = {"enabled": True, "acting": False, "flips_total": 0,
+                    "flips": []}
+        doc = merge_rebalance([(0, leader), (1, follower)])
+        assert doc["workers"] == 2
+        assert doc["acting_shards"] == [0]
+        assert doc["flips_total"] == 2
+        assert doc["flips"][0] == {"pod": "b", "started_unix": 9.0,
+                                   "shard": 0}
+        assert doc["advice"] == leader["advice"]
+        assert doc["shards"]["1"]["acting"] is False
+
+
+class TestTimelineSeries:
+    def test_sampler_records_rebalance_row(self):
+        from llm_d_inference_scheduler_tpu.router.timeline import (
+            TimelineConfig,
+            TimelineSampler,
+        )
+
+        class Stub:
+            enabled = True
+            flips_total = 3
+            active_count = 1
+            last_headroom = {"prefill": 0.2, "decode": 0.9}
+
+        s = TimelineSampler(TimelineConfig(), rebalance=Stub())
+        sample = s.tick(wall=100.0)
+        assert sample["rebalance"] == {
+            "flips": 3, "draining": 1,
+            "headroom": {"prefill": 0.2, "decode": 0.9}}
+        Stub.flips_total = 4
+        sample = s.tick(wall=101.0)
+        assert sample["rebalance"]["flips"] == 1
+
+
+# ---- loader default pair scorer + shadow live-twin (satellite 1) ----------
+
+PAIR_CFG = """
+shadow:
+  policies: [{type: transfer-pair, parameters: {weight: 2.0}}]
+plugins:
+  - {type: decode-filter}
+  - {type: prefill-filter}
+  - {type: queue-scorer}
+  - type: disagg-profile-handler
+    parameters: {pdDecider: {type: always-disagg-pd-decider}}
+schedulingProfiles:
+  - name: decode
+    plugins: [{pluginRef: decode-filter}, {pluginRef: queue-scorer}]
+  - name: prefill
+    plugins: [{pluginRef: prefill-filter}, {pluginRef: queue-scorer}]
+"""
+
+
+class TestDefaultPairScorer:
+    def test_loader_injects_into_prefill_profile(self):
+        ds = Datastore()
+        cfg = load_config(PAIR_CFG, Handle(datastore=ds))
+        names = [str(ws.scorer.typed_name())
+                 for ws in cfg.scheduler.profiles["prefill"].scorers]
+        assert "transfer-aware-pair-scorer/transfer-aware-pair-scorer" \
+            in names
+        ws = cfg.scheduler.profiles["prefill"].scorers[-1]
+        assert ws.weight == 2.0
+        # The decode profile stays pair-blind.
+        assert not any("transfer-aware" in str(w.scorer.typed_name())
+                       for w in cfg.scheduler.profiles["decode"].scorers)
+        # The raw doc (and so /debug/config + the config hash) is served
+        # verbatim — the injection must not leak into it.
+        assert "transfer-aware" not in str(cfg.raw_doc)
+
+    def test_opt_out_and_explicit_declaration(self):
+        off = PAIR_CFG + "\ndisagg:\n  pairScorer: {enabled: false}\n"
+        cfg = load_config(off, Handle(datastore=Datastore()))
+        assert not any("transfer-aware" in str(w.scorer.typed_name())
+                       for w in cfg.scheduler.profiles["prefill"].scorers)
+        explicit = PAIR_CFG.replace(
+            "  - {type: queue-scorer}",
+            "  - {type: queue-scorer}\n  - {type: transfer-aware-pair-scorer}"
+        ).replace(
+            "plugins: [{pluginRef: prefill-filter}, {pluginRef: queue-scorer}]",
+            "plugins: [{pluginRef: prefill-filter}, "
+            "{pluginRef: transfer-aware-pair-scorer, weight: 7}]")
+        cfg = load_config(explicit, Handle(datastore=Datastore()))
+        pair = [ws for ws in cfg.scheduler.profiles["prefill"].scorers
+                if "transfer-aware" in str(ws.scorer.typed_name())]
+        assert len(pair) == 1 and pair[0].weight == 7.0
+
+    def test_cold_table_scores_nothing(self):
+        """Unmeasured-pair neutrality: on a cold TransferTable the injected
+        scorer returns no scores, so profile totals are bit-identical to
+        the pair-blind profile."""
+        from llm_d_inference_scheduler_tpu.router.framework.datalayer import (
+            Endpoint,
+        )
+
+        ds = Datastore()
+        cfg = load_config(PAIR_CFG, Handle(datastore=ds))
+        scorer = [ws.scorer
+                  for ws in cfg.scheduler.profiles["prefill"].scorers
+                  if "transfer-aware" in str(ws.scorer.typed_name())][0]
+        ep = Endpoint(EndpointMetadata(name="p", address="10.0.0.1",
+                                       port=8200))
+        req = type("R", (), {"decode_pick": "10.0.0.9:8000"})()
+        assert scorer.score(None, None, req, [ep]) == {}
+
+    def test_shadow_twin_takes_live_twin_active_path(self):
+        """With the default injection live, the transfer-pair shadow
+        policy must detect its live twin in the profile's raw scores and
+        evaluate the totals as-is (activation monitoring — no double
+        weighting, no false divergences)."""
+        from llm_d_inference_scheduler_tpu.router.framework.datalayer import (
+            Endpoint,
+        )
+        from llm_d_inference_scheduler_tpu.router.framework.scheduling import (
+            InferenceRequest,
+            InferenceRequestBody,
+            ProfileRunResult,
+            SchedulingResult,
+        )
+        from llm_d_inference_scheduler_tpu.router.shadow import (
+            ShadowConfig,
+            ShadowEvaluator,
+        )
+
+        ds = Datastore()
+        cfg = load_config(PAIR_CFG, Handle(datastore=ds))
+        pair_name = [str(ws.scorer.typed_name())
+                     for ws in cfg.scheduler.profiles["prefill"].scorers
+                     if "transfer-aware" in str(ws.scorer.typed_name())][0]
+        ds.transfers.record("10.0.0.1:8200", "10.0.0.9:8000", pull_ms=1.0)
+        ds.transfers.record("10.0.0.2:8200", "10.0.0.9:8000", pull_ms=40.0)
+
+        def _ep(addr):
+            host, _, port = addr.rpartition(":")
+            return Endpoint(EndpointMetadata(name=addr, address=host,
+                                             port=int(port)))
+
+        result = SchedulingResult(
+            profile_results={
+                "decode": ProfileRunResult(
+                    target_endpoints=[_ep("10.0.0.9:8000")]),
+                "prefill": ProfileRunResult(
+                    target_endpoints=[_ep("10.0.0.1:8200")],
+                    totals={"10.0.0.1:8200": 3.0, "10.0.0.2:8200": 1.0},
+                    raw_scores={pair_name: {"10.0.0.1:8200": 1.0,
+                                            "10.0.0.2:8200": 0.0}}),
+            },
+            primary_profile_name="decode")
+        ev = ShadowEvaluator(ShadowConfig.from_spec(cfg.shadow),
+                             datastore=ds)
+        req = InferenceRequest(request_id="lt-1", target_model="tiny",
+                               body=InferenceRequestBody(
+                                   completions={"prompt": "p"}))
+        ev.submit(req, result)
+        assert ev.flush()
+        ev.stop()
+        entry = req.shadow.entries["transfer-pair"]
+        assert entry["live_twin_active"] is True
+        assert entry["verdict"] == "agree"
+
+
+class TestResyncPreservesOverrides:
+    def test_external_resync_cannot_revert_flip_or_drain(self):
+        """A kube pod event or config-file reconcile rebuilds metadata
+        from the pre-flip source of truth; the rebalancer's role flip and
+        draining mark must survive it (they'd otherwise silently revert
+        while the controller still reports them at /debug/rebalance)."""
+        ds = Datastore()
+        _pool(ds, {"10.0.0.1:8000": "decode", "10.0.0.2:8000": "decode"})
+        source = [EndpointMetadata(name=addr, address=addr.rpartition(":")[0],
+                                   port=8000, labels={ROLE_LABEL: "decode"})
+                  for addr in ("10.0.0.1:8000", "10.0.0.2:8000")]
+        assert ds.set_endpoint_draining("10.0.0.1:8000", True)
+        ds.resync(source)
+        labels = ds.endpoint_get("10.0.0.1:8000").metadata.labels
+        assert labels[DRAINING_LABEL] == "true"
+        # Flip completes (role republish clears the draining mark) — then
+        # another reconcile lands with the stale decode label.
+        assert ds.set_endpoint_role("10.0.0.1:8000", "prefill")
+        ds.resync(source)
+        labels = ds.endpoint_get("10.0.0.1:8000").metadata.labels
+        assert labels[ROLE_LABEL] == "prefill"
+        assert DRAINING_LABEL not in labels
+        # The untouched pod still follows the external source verbatim.
+        assert ds.endpoint_get("10.0.0.2:8000").metadata.labels[
+            ROLE_LABEL] == "decode"
+        # A pod that leaves the pool drops its overlay: a fresh pod at
+        # the same address reads the source of truth again.
+        ds.endpoint_delete("10.0.0.1:8000")
+        ds.resync(source)
+        assert ds.endpoint_get("10.0.0.1:8000").metadata.labels[
+            ROLE_LABEL] == "decode"
+
+
+class TestSkipRateFloor:
+    def test_stale_skip_residue_is_not_donor_evidence(self):
+        """The hop-skip EWMA decays exponentially and never reaches 0.0:
+        a single ancient burst must not keep lowering the prefill donor
+        bar — only a rate above SKIP_RATE_MIN counts as evidence."""
+        ds = Datastore()
+        _pool(ds, {"10.0.0.1:8000": "prefill", "10.0.0.2:8000": "prefill",
+                   "10.0.0.3:8000": "decode"})
+        # Prefill merely healthy: headroom 0.5 — between headroomTarget
+        # (0.25) and donorHeadroom (0.6), so it may donate ONLY with
+        # skip evidence.
+        _load(ds, "10.0.0.1:8000", waiting=4)
+        _load(ds, "10.0.0.2:8000", waiting=4)
+        skips = {"n": 0}
+        clock = FakeClock()
+        cfg = RebalanceConfig(enabled=True, tick_s=1.0, min_dwell_s=0.0,
+                              headroom_target=0.25, donor_headroom=0.6,
+                              sustain_ticks=2, drain_timeout_s=30.0)
+        c = RebalanceController(cfg, datastore=ds, clock=clock,
+                                hop_skips_fn=lambda: skips["n"],
+                                wall=lambda: clock.t + 1e9)
+        clock.advance(5.0)
+        # An old burst, then silence: the EWMA decays below the floor
+        # (3.0 * 0.7^15 ≈ 0.014) while the pool stays balanced.
+        skips["n"] = 10
+        c.tick()
+        for _ in range(15):
+            clock.advance(1.0)
+            c.tick()
+        assert 0.0 < c._skip_rate < 0.05
+        # Decode starves NOW — the residue must not lower the donor bar.
+        _load(ds, "10.0.0.3:8000", waiting=50)
+        for _ in range(4):
+            clock.advance(1.0)
+            c.tick()
+        assert not c._active and c.flips_total == 0
+        # A FRESH sustained skip burst is real evidence: bar drops to the
+        # headroom target and the flip starts.
+        skips["n"] += 10
+        clock.advance(1.0)
+        c.tick()
+        skips["n"] += 10
+        clock.advance(1.0)
+        c.tick()
+        assert len(c._active) == 1
+        assert c._active[0].inputs["skip_evidence"] is True
+
+# ---- live e2e: a decode pod flips to prefill under traffic ----------------
+
+GW, PRE, D1, D2, S1, S2 = 19540, 19541, 19542, 19543, 19544, 19545
+
+E2E_CFG = f"""
+rebalance:
+  enabled: true
+  tickS: 3600            # manual ticks drive the test deterministically
+  minDwellS: 0
+  sustainTicks: 2
+  headroomTarget: 0.5
+  donorHeadroom: 0.6
+  drainTimeoutS: 30
+pool:
+  endpoints:
+    - {{address: 127.0.0.1, port: {S1}, labels: {{llm-d.ai/role: decode}}}}
+    - {{address: 127.0.0.1, port: {S2}, labels: {{llm-d.ai/role: decode}}}}
+    - {{address: 127.0.0.1, port: {PRE}, labels: {{llm-d.ai/role: prefill}}}}
+plugins:
+  - {{type: decode-filter}}
+  - {{type: prefill-filter}}
+  - {{type: queue-scorer}}
+  - type: disagg-profile-handler
+    parameters:
+      pdDecider:
+        type: prefix-based-pd-decider
+        parameters: {{thresholdTokens: 64}}
+schedulingProfiles:
+  - name: decode
+    plugins:
+      - {{pluginRef: decode-filter}}
+      - {{pluginRef: queue-scorer}}
+  - name: prefill
+    plugins:
+      - {{pluginRef: prefill-filter}}
+      - {{pluginRef: queue-scorer}}
+"""
+
+
+def test_decode_pod_flips_to_prefill_under_live_traffic():
+    """The acceptance e2e: prefill starves under a cold-prompt burst while
+    the decode side idles; the controller flips a decode pod through the
+    drain cycle with ZERO client-visible errors, in-flight decode streams
+    on the flipping pod run to ``[DONE]``, and the flip is explainable at
+    /debug/rebalance."""
+    from llm_d_inference_scheduler_tpu.engine import EngineConfig
+    from llm_d_inference_scheduler_tpu.engine.server import EngineServer
+    from llm_d_inference_scheduler_tpu.router.gateway import build_gateway
+    from llm_d_inference_scheduler_tpu.router.sidecar import (
+        Sidecar,
+        SidecarConfig,
+    )
+
+    async def body():
+        def sim(port, role, prefill_ms):
+            return EngineConfig(
+                backend="sim", model="tiny", port=port, role=role,
+                max_batch=1 if role == "prefill" else 4,
+                max_model_len=4096,
+                sim_prefill_ms_per_token=prefill_ms,
+                sim_decode_ms_per_token=10.0)
+
+        engines = [EngineServer(sim(PRE, "prefill", 1.2)),
+                   EngineServer(sim(D1, "decode", 0.05)),
+                   EngineServer(sim(D2, "decode", 0.05))]
+        for e in engines:
+            await e.start()
+        sidecars = [
+            Sidecar(SidecarConfig(port=S1,
+                                  decoder_url=f"http://127.0.0.1:{D1}")),
+            Sidecar(SidecarConfig(port=S2,
+                                  decoder_url=f"http://127.0.0.1:{D2}")),
+        ]
+        for s in sidecars:
+            await s.start()
+        gw = build_gateway(E2E_CFG, port=GW, poll_interval=0.05)
+        await gw.start()
+        statuses: list[int] = []
+        stream_done: list[bool] = []
+        try:
+            async with httpx.AsyncClient(timeout=120) as c:
+
+                async def stream_one(i: int) -> None:
+                    saw_done = False
+                    async with c.stream(
+                            "POST", f"http://127.0.0.1:{GW}/v1/completions",
+                            json={"model": "tiny", "prompt": f"s{i}",
+                                  "max_tokens": 200, "stream": True}
+                    ) as r:
+                        statuses.append(r.status_code)
+                        async for line in r.aiter_lines():
+                            if line == "data: [DONE]":
+                                saw_done = True
+                    stream_done.append(saw_done)
+
+                async def prefill_one(i: int) -> None:
+                    r = await c.post(
+                        f"http://127.0.0.1:{GW}/v1/completions",
+                        json={"model": "tiny",
+                              "prompt": f"cold doc {i} " + "w " * 400,
+                              "max_tokens": 1})
+                    statuses.append(r.status_code)
+
+                # Live decode streams on BOTH decode pods (~2 s each;
+                # staggered so the queue scorer spreads them) + a
+                # cold-prompt burst that drowns the single prefill pod.
+                tasks = []
+                for i in range(4):
+                    tasks.append(asyncio.get_running_loop().create_task(
+                        stream_one(i)))
+                    await asyncio.sleep(0.12)
+                tasks += [asyncio.get_running_loop().create_task(
+                    prefill_one(i)) for i in range(8)]
+                await asyncio.sleep(0.4)  # queues build + scrape lands
+
+                # Manual grid ticks: sustain the imbalance → a decode pod
+                # starts draining while its stream is still live.
+                flip = None
+                for _ in range(40):
+                    gw.rebalancer.tick()
+                    if gw.rebalancer._active:
+                        flip = gw.rebalancer._active[0]
+                        break
+                    await asyncio.sleep(0.1)
+                assert flip is not None, "no flip started"
+                assert (flip.from_role, flip.to_role) == ("decode",
+                                                          "prefill")
+                victim = flip.pod
+                assert gw.datastore.endpoint_get(victim).metadata.labels[
+                    DRAINING_LABEL] == "true"
+
+                # Tick until the drain cycle completes (streams finish,
+                # an idle scrape lands, the role republishes).
+                for _ in range(200):
+                    gw.rebalancer.tick()
+                    if flip.state == "completed":
+                        break
+                    await asyncio.sleep(0.1)
+                assert flip.state == "completed"
+                labels = gw.datastore.endpoint_get(victim).metadata.labels
+                assert labels[ROLE_LABEL] == "prefill"
+                assert DRAINING_LABEL not in labels
+
+                # Every in-flight request (streams included) finished
+                # clean: zero client-visible errors through the flip.
+                await asyncio.gather(*tasks)
+                assert statuses and all(s == 200 for s in statuses)
+                assert stream_done and all(stream_done)
+
+                # The flip is fully explainable at /debug/rebalance.
+                doc = (await c.get(
+                    f"http://127.0.0.1:{GW}/debug/rebalance")).json()
+                assert doc["flips_total"] == 1
+                rec = doc["flips"][0]
+                assert rec["pod"] == victim and rec["state"] == "completed"
+                for key in ("reason", "headroom", "pair_ewmas",
+                            "sustained_ticks"):
+                    assert key in rec["inputs"]
+                assert rec["inputs"]["headroom"]["prefill"][
+                    "headroom"] < 0.5
+                # And the headroom gauge family moved.
+                m = (await c.get(f"http://127.0.0.1:{GW}/metrics")).text
+                assert 'router_role_flips_total{from="decode",to="prefill"}' \
+                    in m
+        finally:
+            await gw.stop()
+            for s in sidecars:
+                await s.stop()
+            for e in engines:
+                await e.stop()
+
+    asyncio.run(body())

@@ -597,6 +597,11 @@ E2E_CFG = f"""
 shadow:
   policies:
     - {{type: transfer-pair, parameters: {{weight: 2.0}}}}
+# This test's premise is a PAIR-BLIND live arm (the shadow policy must
+# diverge from it): opt out of the loader's default transfer-aware-pair
+# -scorer injection, which would make the live pick pair-aware.
+disagg:
+  pairScorer: {{enabled: false}}
 scheduling:
   pickSeed: 1234
 pool:
