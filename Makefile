@@ -8,6 +8,7 @@ PY ?= python
 	bench-router-sse bench-decisions bench-sched bench-sched-offload \
 	bench-scaleout bench-slo bench-overload bench-kvobs bench-multiturn \
 	bench-timeline bench-fleet-chaos bench-shadow bench-rebalance \
+	bench-forecast \
 	dryrun render-chart \
 	compile-check \
 	verify-metrics verify-decisions verify-hotpath verify-threadsafe \
@@ -136,6 +137,12 @@ bench-kvobs:
 # Writes benchmarks/TIMELINE.json.
 bench-timeline:
 	$(PY) bench.py --timeline
+
+# Traffic forecaster & capacity observatory (CPU-only): observe() micro
+# cost vs the scheduling-cycle floor + a compressed diurnal+burst replay
+# judging forecast skill vs persistence (docs/forecast.md).
+bench-forecast:
+	$(PY) bench.py --forecast
 
 # Multi-turn conversation scenario (CPU-only): N users x M turns with a
 # shared system prompt and per-user history growth through the full

@@ -3355,6 +3355,266 @@ scheduling: {{pickSeed: 7}}
     }
 
 
+def forecast_bench(quick: bool = False) -> dict:
+    """``--forecast`` → benchmarks/FORECAST.json (ISSUE 16): the traffic
+    forecaster acceptance artifact.
+
+    - **micro**: one ``ForecastEngine.observe()`` over a representative
+      11-series sample (arrival/drain/inflight/queued + 2 bands/token
+      mix/2 role headrooms), default 3 horizons, timed tight-loop as a
+      percentage of the 128x64 scheduling-cycle floor — the forecaster
+      rides the flight recorder's tick, so its budget is the same <1%
+      bar; the ``forecast: {enabled: false}`` kill-switch path timed the
+      same way.
+    - **diurnal+burst replay**: a real TimelineSampler wired to an SLO
+      ledger whose counters are driven by a compressed diurnal cycle
+      (60 s period at a 0.25 s tick — the configured seasonalPeriodS
+      MUST match the traffic's cycle; that is the deal the config
+      documents) with a square burst riding each period's shoulder plus
+      Gaussian noise. After two warm periods, every joined forecast is
+      judged. Acceptance: skill vs persistence >= 0.2 at the lead
+      horizon, skill > 0 in a window around EVERY ramp inflection
+      (burst onset + release, where persistence is at its worst),
+      interval coverage inside [0.75, 0.99], join coverage ~1.0, and a
+      bit-inert kill-switch (no forecast key in samples, zero stamps).
+    """
+    import gc
+    import math
+    import random
+
+    from llm_d_inference_scheduler_tpu.router.forecast import (
+        ForecastConfig,
+        ForecastEngine,
+    )
+    from llm_d_inference_scheduler_tpu.router.slo import (
+        SloConfig,
+        SloLedger,
+    )
+    from llm_d_inference_scheduler_tpu.router.timeline import (
+        TimelineConfig,
+        TimelineSampler,
+    )
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    floor_us = 2000.0  # conservative default: the PR 4 128x64 cycle cost
+    try:
+        with open(os.path.join(here, "benchmarks",
+                               "SCHED_HOTPATH.json")) as f:
+            sweep = json.load(f)["sweep"]
+        floor_us = min(r["us_per_req_after"] for r in sweep
+                       if r.get("endpoints") == 128 and r.get("blocks") == 64)
+    except (OSError, KeyError, ValueError):
+        pass
+
+    # ---- micro: observe() cost vs the scheduling-cycle floor -----------
+    def rep_sample(t: float) -> dict:
+        return {
+            "t_unix": t, "requests": 42, "drain_rate_rps": 41.5,
+            "inflight": 7, "queued": 3,
+            "queued_by_band": {"premium": 1, "standard": 2},
+            "token_mix": {"prefill_tokens": 5000, "decode_tokens": 1500},
+            "rebalance": {"headroom": {"prefill": 0.4, "decode": 0.6}},
+        }
+
+    reps = 20_000 if not quick else 2_000
+    eng_on = ForecastEngine(ForecastConfig.from_spec({}), tick_s=1.0)
+    eng_off = ForecastEngine(
+        ForecastConfig.from_spec({"enabled": False}), tick_s=1.0)
+    sample = rep_sample(1_700_000_000.0)
+    gc.disable()
+    try:
+        best_on = best_off = float("inf")
+        for _ in range(5):
+            t = sample["t_unix"]
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                t += 1.0
+                sample["t_unix"] = t
+                eng_on.observe(sample)
+            best_on = min(best_on, (time.perf_counter() - t0) / reps)
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                eng_off.observe(sample)
+            best_off = min(best_off, (time.perf_counter() - t0) / reps)
+    finally:
+        gc.enable()
+    micro = {
+        "series": len(eng_on._series),
+        "horizons": list(eng_on.cfg.horizons_s),
+        "tick_us": round(best_on * 1e6, 3),
+        "tick_pct_of_cycle_floor": round(best_on * 1e6 / floor_us * 100, 4),
+        "killswitch_us": round(best_off * 1e6, 3),
+        "killswitch_pct_of_cycle_floor": round(
+            best_off * 1e6 / floor_us * 100, 4),
+        "cycle_floor_us": round(floor_us, 1),
+        "reps": reps,
+    }
+    print(json.dumps({"phase": "forecast-micro", **micro}))
+
+    # ---- diurnal + burst replay through a real sampler -----------------
+    TICK_S = 0.25
+    PERIOD_S = 60.0
+    WARM_PERIODS = 2
+    PERIODS = 10 if not quick else 4
+    BURST_ON, BURST_OFF = 15.0, 25.0  # phase seconds inside each period
+    HORIZONS = [5.0, 15.0]
+    LEAD = "15"
+
+    rng = random.Random(1607)
+
+    def arrival_rps(t: float) -> float:
+        base = 40.0 + 18.0 * math.sin(2 * math.pi * t / PERIOD_S)
+        if BURST_ON <= (t % PERIOD_S) < BURST_OFF:
+            base += 35.0
+        return max(0.0, base + rng.gauss(0.0, 2.0))
+
+    class _Flow:
+        queued_requests = 0
+
+        def queued_by_band(self):
+            return {"standard": self.queued_requests}
+
+    fc_cfg = ForecastConfig.from_spec({
+        "horizons": HORIZONS, "seasonalPeriodS": PERIOD_S,
+        "warmupTicks": 8, "errorWindow": 4000})
+    engine = ForecastEngine(fc_cfg, tick_s=TICK_S)
+
+    def make_sampler(forecast) -> tuple[TimelineSampler, SloLedger, _Flow]:
+        ledger = SloLedger(SloConfig())
+        flow = _Flow()
+        cfg = TimelineConfig.from_spec(
+            {"tickS": TICK_S, "retentionS": PERIOD_S * (PERIODS + 1)})
+        sampler = TimelineSampler(
+            cfg, slo_ledger=ledger, flow=flow,
+            inflight_fn=lambda: flow.queued_requests + 4,
+            drain_rate_fn=lambda: 40.0, forecast=forecast)
+        return sampler, ledger, flow
+
+    def drive(sampler, ledger, flow, ticks: int, t0: float) -> float:
+        t = t0
+        for _ in range(ticks):
+            t += TICK_S
+            lam = arrival_rps(t)
+            n = max(0, int(round(lam * TICK_S)))
+            ledger._totals.requests += n
+            ledger._totals.slo_met += n
+            ledger._totals.output_tokens += n * 30
+            ledger._totals.goodput_tokens += n * 30
+            ledger.prompt_tokens_total += n * 120
+            flow.queued_requests = max(
+                0, int(round((lam - 40.0) * 0.2)))
+            sampler.tick(wall=t)
+        return t
+
+    T0 = 1_700_000_000.0
+    total_ticks = int(PERIOD_S * PERIODS / TICK_S)
+    sampler, ledger, flow = make_sampler(engine)
+    drive(sampler, ledger, flow, total_ticks, T0)
+    measure_start = T0 + PERIOD_S * WARM_PERIODS
+
+    snap = engine.snapshot(joins_n=4000)
+    cell = snap["series"]["arrival_rate"]
+
+    # Exact stats over the measured window, straight from the judged rows
+    # (ring rows: [t, y, yhat, abs_err, naive_abs_err, covered]).
+    rows_by_h = {
+        h: [r for r in cell["joins"][h] if r[0] >= measure_start]
+        for h in cell["joins"]}
+
+    def _skill(rows) -> float | None:
+        abs_sum = sum(r[3] for r in rows)
+        naive_sum = sum(r[4] for r in rows)
+        return (round(1.0 - abs_sum / naive_sum, 4)
+                if naive_sum > 1e-9 else None)
+
+    per_h = {}
+    for h, rows in rows_by_h.items():
+        per_h[h] = {
+            "joins": len(rows),
+            "mae": round(sum(r[3] for r in rows) / len(rows), 4),
+            "naive_mae": round(sum(r[4] for r in rows) / len(rows), 4),
+            "skill": _skill(rows),
+            "coverage": round(sum(r[5] for r in rows) / len(rows), 4),
+        }
+
+    # Windowed skill around every ramp inflection: persistence carries
+    # the pre-ramp value across the step, the seasonal model should not.
+    inflections = []
+    all_rows = [r for rows in rows_by_h.values() for r in rows]
+    for period in range(WARM_PERIODS, PERIODS):
+        for phase, kind in ((BURST_ON, "burst_onset"),
+                            (BURST_OFF, "burst_release")):
+            t_evt = T0 + period * PERIOD_S + phase
+            win = [r for r in all_rows
+                   if t_evt - 2.5 <= r[0] <= t_evt + 10.0]
+            inflections.append({
+                "t": round(t_evt - T0, 1), "kind": kind,
+                "joins": len(win), "skill": _skill(win)})
+
+    # Kill-switch inertness through the same sampler path.
+    eng_dead = ForecastEngine(
+        ForecastConfig.from_spec({"enabled": False}), tick_s=TICK_S)
+    sampler2, ledger2, flow2 = make_sampler(
+        eng_dead if eng_dead.enabled else None)
+    t_end = drive(sampler2, ledger2, flow2, 200, T0)
+    last = list(sampler2.ring)[-1]
+    kill = {
+        "sampler_ticks": 200,
+        "forecast_key_in_samples": "forecast" in last,
+        "stamps_total": eng_dead.stamps_total,
+        "ticks_consumed": eng_dead.ticks,
+    }
+    del t_end
+
+    gateway = {
+        "tick_s": TICK_S, "period_s": PERIOD_S, "periods": PERIODS,
+        "warm_periods": WARM_PERIODS, "horizons_s": HORIZONS,
+        "ticks": total_ticks,
+        "stamps_total": engine.stamps_total,
+        "joins_total": engine.joins_total,
+        "gap_skips_total": engine.gap_skips_total,
+        "join_coverage": snap["join_coverage"],
+        "arrival_rate": per_h,
+        "inflections": inflections,
+        "killswitch": kill,
+    }
+    print(json.dumps({"phase": "forecast-replay",
+                      **{k: v for k, v in gateway.items()
+                         if k != "inflections"}}))
+
+    lead = per_h.get(LEAD, {})
+    inflection_skills = [i["skill"] for i in inflections
+                        if i["skill"] is not None]
+    coverages = [v["coverage"] for v in per_h.values()]
+    return {
+        "micro": micro,
+        "gateway": gateway,
+        "acceptance": {
+            "tick_pct_of_cycle_floor": micro["tick_pct_of_cycle_floor"],
+            "tick_under_1pct": micro["tick_pct_of_cycle_floor"] < 1.0,
+            "lead_horizon_s": float(LEAD),
+            "lead_skill": lead.get("skill"),
+            "lead_skill_ge_0_2": (lead.get("skill") or 0.0) >= 0.2,
+            "inflection_events": len(inflections),
+            "inflection_skill_min": (round(min(inflection_skills), 4)
+                                     if inflection_skills else None),
+            "skill_positive_at_every_inflection": (
+                bool(inflection_skills)
+                and all(s > 0 for s in inflection_skills)),
+            "coverage_min": min(coverages) if coverages else None,
+            "coverage_max": max(coverages) if coverages else None,
+            "coverage_in_band": (
+                bool(coverages)
+                and all(0.75 <= c <= 0.99 for c in coverages)),
+            "join_coverage": snap["join_coverage"],
+            "join_coverage_ok": (snap["join_coverage"] or 0.0) >= 0.99,
+            "killswitch_inert": (not kill["forecast_key_in_samples"]
+                                 and kill["stamps_total"] == 0
+                                 and kill["ticks_consumed"] == 0),
+        },
+    }
+
+
 def rebalance_bench(quick: bool = False) -> dict:
     """``--rebalance`` → benchmarks/REBALANCE.json (ISSUE 15): the
     self-balancing pool acceptance artifact.
@@ -4197,6 +4457,14 @@ def main() -> None:
         os.makedirs(os.path.join(here, "benchmarks"), exist_ok=True)
         res = timeline_bench(quick="--quick" in sys.argv)
         with open(os.path.join(here, "benchmarks", "TIMELINE.json"), "w") as f:
+            json.dump(res, f, indent=1)
+        return
+    if "--forecast" in sys.argv:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")  # no chip needed
+        here = os.path.dirname(os.path.abspath(__file__))
+        os.makedirs(os.path.join(here, "benchmarks"), exist_ok=True)
+        res = forecast_bench(quick="--quick" in sys.argv)
+        with open(os.path.join(here, "benchmarks", "FORECAST.json"), "w") as f:
             json.dump(res, f, indent=1)
         return
     if "--rebalance" in sys.argv:

@@ -53,6 +53,7 @@ class RawConfig:
     timeline: dict[str, Any]
     shadow: dict[str, Any]
     rebalance: dict[str, Any]
+    forecast: dict[str, Any]
     tls_client: dict[str, Any]
     pool: dict[str, Any]
     objectives: list[dict[str, Any]]
@@ -136,6 +137,13 @@ class RouterConfig:
     # kill-switch — the pool's P/D role split stays bit-identical static
     # config).
     rebalance: dict[str, Any]
+    # forecast: the traffic forecaster knobs (router/forecast.py
+    # ForecastConfig — {enabled, horizons, seasonalPeriodS, intervals,
+    # alpha, beta, gamma, damping, warmupTicks, errorWindow}; default-on,
+    # enabled: false is the kill-switch — zero stamps, no model state.
+    # The engine rides the timeline sampler's tick, so disabling the
+    # timeline also silences the forecaster).
+    forecast: dict[str, Any]
     # The parsed YAML verbatim: /debug/config serves a redacted view and
     # router_config_info{hash} fingerprints it.
     raw_doc: dict[str, Any]
@@ -176,6 +184,7 @@ def load_raw_config(text: str | None) -> RawConfig:
         timeline=doc.get("timeline") or {},
         shadow=doc.get("shadow") or {},
         rebalance=doc.get("rebalance") or {},
+        forecast=doc.get("forecast") or {},
         tls_client=doc.get("tlsClient") or {},
         pool=doc.get("pool") or {},
         objectives=doc.get("objectives") or [],
@@ -407,6 +416,7 @@ def instantiate(raw: RawConfig, handle: Handle,
         timeline=raw.timeline,
         shadow=raw.shadow,
         rebalance=raw.rebalance,
+        forecast=raw.forecast,
         raw_doc=raw.doc,
         tls_client=raw.tls_client,
         static_endpoints=static_endpoints,

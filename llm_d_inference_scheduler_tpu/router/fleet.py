@@ -968,6 +968,7 @@ class FleetAdmin:
             web.get("/debug/timeline", self.timeline),
             web.get("/debug/incidents", self.incidents),
             web.get("/debug/rebalance", self.rebalance),
+            web.get("/debug/forecast", self.forecast),
             web.get("/debug/config", self.config),
         ])
         self._runner: web.AppRunner | None = None
@@ -1264,6 +1265,21 @@ class FleetAdmin:
 
         results = await self._fan_out("/debug/rebalance")
         return web.json_response(merge_rebalance(
+            [(shard, doc) for shard, (status, doc) in enumerate(results)
+             if status == 200 and isinstance(doc, dict)]))
+
+    async def forecast(self, request: web.Request) -> web.Response:
+        """Fleet /debug/forecast: every worker's judged forecast ledger
+        merged n-weighted per (series, horizon) — each shard forecasts
+        its own traffic slice, so join counts are the vote weights and
+        skill recomputes from the merged MAEs (router/forecast.py
+        merge_forecast). The query string forwards verbatim (?joins=N)."""
+        from .forecast import merge_forecast
+
+        qs = request.query_string
+        path = "/debug/forecast" + (f"?{qs}" if qs else "")
+        results = await self._fan_out(path)
+        return web.json_response(merge_forecast(
             [(shard, doc) for shard, (status, doc) in enumerate(results)
              if status == 200 and isinstance(doc, dict)]))
 

@@ -71,6 +71,7 @@ from .requestcontrol.director import (
 )
 from .kvobs import H_KV_HIT_BLOCKS, H_KV_HIT_TOKENS, CacheLedger, KvObsConfig
 from .overload import DrainRateEstimator, OverloadConfig, OverloadController
+from .forecast import ForecastConfig, ForecastEngine
 from .rebalance import RebalanceConfig, RebalanceController
 from .schedpool import LoopLagMonitor, SchedulerPool, SchedulingConfig
 from .shadow import ShadowConfig, ShadowEvaluator
@@ -319,6 +320,15 @@ class Gateway:
                           if disagg_handlers else None),
             acting=(fleet is None or fleet.runs_datalayer))
 
+        # Traffic forecaster (router/forecast.py): judged multi-horizon
+        # prediction over the flight recorder. No task of its own — it
+        # rides the sampler's tick (so `forecast.enabled: false` OR
+        # `timeline.enabled: false` means zero stamps), and qualifies
+        # the rebalancer's advice with time-to-saturation leads.
+        fc_cfg = ForecastConfig.from_spec(cfg.forecast)
+        self.forecaster = ForecastEngine(fc_cfg, tick_s=tl_cfg.tick_s)
+        fc_live = fc_cfg.enabled and tl_cfg.enabled
+
         self.timeline = TimelineSampler(
             tl_cfg,
             slo_ledger=self.slo_ledger,
@@ -331,7 +341,10 @@ class Gateway:
             if self.overload.enabled else None,
             decisions_fn=self._recent_bad_decisions,
             shadow=self.shadow_eval if self.shadow_eval.active else None,
-            rebalance=self.rebalancer if self.rebalancer.enabled else None)
+            rebalance=self.rebalancer if self.rebalancer.enabled else None,
+            forecast=self.forecaster if fc_live else None)
+        if fc_live and self.rebalancer.enabled:
+            self.rebalancer.forecast = self.forecaster
 
         # Effective-config identity: the hash covers the UNREDACTED loaded
         # doc (config skew across fleet shards must show even when only
@@ -361,6 +374,7 @@ class Gateway:
             web.get("/debug/timeline", self.timeline_view),
             web.get("/debug/incidents", self.incidents_view),
             web.get("/debug/rebalance", self.rebalance_view),
+            web.get("/debug/forecast", self.forecast_view),
             web.get("/debug/config", self.config_view),
             # Fleet control plane (router/fleet.py, loopback-guarded): the
             # supervisor's leader-election notices — promote this follower
@@ -670,10 +684,18 @@ class Gateway:
     async def timeline_view(self, request: web.Request) -> web.Response:
         """Fleet flight recorder history (router/timeline.py): raw ticks
         plus windowed aggregates; ?window_s=N bounds the returned window
-        (default: the whole retained ring)."""
+        (default: the whole retained ring), ?series=a,b keeps only the
+        named top-level keys, ?step_s=N downsamples ticks into coarser
+        mean buckets (gap-aware: empty buckets stay absent)."""
         window_s = finite_float_or_none(request.query.get("window_s"))
+        series_q = request.query.get("series")
+        series = ([s for s in (p.strip() for p in series_q.split(","))
+                   if s] if series_q else None)
+        step_s = finite_float_or_none(request.query.get("step_s"))
         return web.json_response(self.timeline.snapshot(
-            window_s=window_s if window_s and window_s > 0 else None))
+            window_s=window_s if window_s and window_s > 0 else None,
+            series=series or None,
+            step_s=step_s if step_s and step_s > 0 else None))
 
     async def incidents_view(self, request: web.Request) -> web.Response:
         """Triggered incident snapshots (router/timeline.py): timeline
@@ -689,6 +711,20 @@ class Gateway:
         headroom series, flip history with full DecisionRecord-style
         inputs, active drain cycles, and the current scaling advice."""
         return web.json_response(self.rebalancer.snapshot())
+
+    async def forecast_view(self, request: web.Request) -> web.Response:
+        """Traffic forecaster (router/forecast.py): per-series model
+        state, the latest stamped forecast per horizon, the judged error
+        ledger (MAE/MAPE/bias/coverage + skill vs persistence), and the
+        capacity observatory's per-role saturation projections.
+        ?joins=N inlines the N most recent judged rows per cell."""
+        joins_q = request.query.get("joins")
+        try:
+            joins_n = max(0, min(int(joins_q), 1000)) if joins_q else None
+        except ValueError:
+            joins_n = None
+        return web.json_response(self.forecaster.snapshot(
+            joins_n=joins_n or None))
 
     async def config_view(self, request: web.Request) -> web.Response:
         """Redacted effective-config snapshot: what config THIS worker

@@ -74,7 +74,12 @@ from collections import deque
 from typing import Any, Callable
 
 from .framework.datalayer import ROLE_LABEL
-from .metrics import POOL_ADVICE, REBALANCE_HEADROOM, ROLE_FLIPS_TOTAL
+from .metrics import (
+    POOL_ADVICE,
+    POOL_ADVICE_CHANGES,
+    REBALANCE_HEADROOM,
+    ROLE_FLIPS_TOTAL,
+)
 
 log = logging.getLogger("router.rebalance")
 
@@ -251,6 +256,15 @@ class RebalanceController:
         # first flip.
         self._last_flip_mono = clock()
         self._advice: dict[str, dict[str, Any]] = {}
+        # Last tick's advice direction per role: the transition counter
+        # increments only on state CHANGE (a gauge shows where advice
+        # stands; rate() over the counter shows it flapping).
+        self._advice_prev: dict[str, str] = {}
+        # Forecast engine (router/forecast.py), wired by the gateway when
+        # both subsystems are enabled: advice rows gain lead_s + the
+        # forecast basis so the autoscaler hook knows HOW SOON, not just
+        # which way.
+        self.forecast: Any = None
         # Flat counters for the timeline sampler's per-tick deltas.
         self.flips_total = 0
         self.aborted_total = 0
@@ -637,32 +651,49 @@ class RebalanceController:
             direction = "hold"
             why = "headroom inside the target band"
             if h is None:
-                advice[role] = {"direction": "hold",
-                                "why": "no pods in role"}
-                self._g_advice[(role, "up")].set(0)
-                self._g_advice[(role, "down")].set(0)
-                continue
-            flip_possible = (ho is not None and ho["n"] >= 2
-                             and ho["headroom"] >= cfg.donor_headroom)
-            if h["headroom"] < cfg.headroom_target and not flip_possible:
-                direction = "up"
-                why = (f"headroom {h['headroom']} < target "
-                       f"{cfg.headroom_target} and {other} has nothing to "
-                       "donate")
-            elif (h["headroom"] >= cfg.donor_headroom and ho is not None
-                  and ho["headroom"] >= cfg.headroom_target
-                  and h["n"] >= 2):
-                direction = "down"
-                why = (f"headroom {h['headroom']} >= {cfg.donor_headroom} "
-                       f"while {other} is healthy")
-                if role == PREFILL and self._skip_rate >= SKIP_RATE_MIN:
-                    why += (f"; hop-skip rate {self._skip_rate:.2f}/s says "
-                            "prefill work is already served decode-side")
-            advice[role] = {"direction": direction, "why": why,
-                            "headroom": h["headroom"]}
+                row: dict[str, Any] = {"direction": "hold",
+                                       "why": "no pods in role"}
+            else:
+                flip_possible = (ho is not None and ho["n"] >= 2
+                                 and ho["headroom"] >= cfg.donor_headroom)
+                if (h["headroom"] < cfg.headroom_target
+                        and not flip_possible):
+                    direction = "up"
+                    why = (f"headroom {h['headroom']} < target "
+                           f"{cfg.headroom_target} and {other} has nothing "
+                           "to donate")
+                elif (h["headroom"] >= cfg.donor_headroom and ho is not None
+                      and ho["headroom"] >= cfg.headroom_target
+                      and h["n"] >= 2):
+                    direction = "down"
+                    why = (f"headroom {h['headroom']} >= "
+                           f"{cfg.donor_headroom} while {other} is healthy")
+                    if role == PREFILL and self._skip_rate >= SKIP_RATE_MIN:
+                        why += (f"; hop-skip rate {self._skip_rate:.2f}/s "
+                                "says prefill work is already served "
+                                "decode-side")
+                row = {"direction": direction, "why": why,
+                       "headroom": h["headroom"]}
+            # Forecast qualification: advice with a deadline. lead_s is
+            # the projected time to zero headroom (null when no
+            # saturation is projected) and the forecast block carries
+            # the basis the projection came from.
+            fc = self.forecast
+            if fc is not None:
+                proj = fc.role_projection(role)
+                if proj is not None:
+                    row["lead_s"] = proj["time_to_saturation_s"]
+                    row["forecast"] = proj
+            advice[role] = row
             self._g_advice[(role, "up")].set(1 if direction == "up" else 0)
             self._g_advice[(role, "down")].set(
                 1 if direction == "down" else 0)
+            prev = self._advice_prev.get(role)
+            if direction != prev:
+                self._advice_prev[role] = direction
+                # First-ever verdict is a state, not a change.
+                if prev is not None:
+                    POOL_ADVICE_CHANGES.labels(role, direction).inc()
         self._advice = advice
 
     # ---- render ---------------------------------------------------------

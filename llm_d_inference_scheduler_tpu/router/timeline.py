@@ -372,12 +372,14 @@ class IncidentRecorder:
                  slo_snapshot_fn: Callable[[], dict] | None = None,
                  kv_snapshot_fn: Callable[[], dict] | None = None,
                  decisions_fn: Callable[[int], list] | None = None,
+                 forecast_fn: Callable[[], dict] | None = None,
                  wall: Callable[[], float] = time.time):
         self.cfg = cfg
         self._wall = wall
         self._slo_fn = slo_snapshot_fn
         self._kv_fn = kv_snapshot_fn
         self._decisions_fn = decisions_fn
+        self._forecast_fn = forecast_fn
         self._ring: deque[dict[str, Any]] = deque(
             maxlen=cfg.incident_capacity)
         self._rules: dict[str, _RuleState] = {}
@@ -443,6 +445,11 @@ class IncidentRecorder:
             incident["slo"] = self._slo_fn()
         if self._kv_fn is not None:
             incident["kv"] = self._kv_fn()
+        if self._forecast_fn is not None:
+            # Was-this-predicted: the forecaster's active forecasts and
+            # error rollups AT trigger time, frozen beside the slo/kv
+            # state they would have warned about.
+            incident["forecast"] = self._forecast_fn()
         self._ring.append(incident)
         return incident
 
@@ -518,6 +525,7 @@ class TimelineSampler:
                  divergence_fn: Callable[[], float] | None = None,
                  shadow: Any = None,
                  rebalance: Any = None,
+                 forecast: Any = None,
                  wall: Callable[[], float] = time.time):
         self.cfg = cfg
         self.slo_ledger = slo_ledger
@@ -536,6 +544,10 @@ class TimelineSampler:
         # flip deltas become the series that explains a mid-run P:D
         # reshape next to the token-mix swing that caused it.
         self.rebalance = rebalance
+        # Forecast engine (router/forecast.py): rides THIS tick — the
+        # engine has no task of its own, so it inherits the grid
+        # alignment that makes fleet buckets comparable.
+        self.forecast = forecast
         self._wall = wall
         self.ring: deque[dict[str, Any]] = deque(maxlen=cfg.ring_capacity)
         self.burn = BurnRateMonitor(cfg)
@@ -546,6 +558,8 @@ class TimelineSampler:
             kv_snapshot_fn=(kv_ledger.snapshot if kv_ledger is not None
                             else None),
             decisions_fn=decisions_fn,
+            forecast_fn=(forecast.incident_context
+                         if forecast is not None else None),
             wall=wall)
         self.gc_pause = GcPauseTracker()
         self._prev = _Baseline()
@@ -778,6 +792,17 @@ class TimelineSampler:
         self._burn_fast_g.set(fast)
         self._burn_slow_g.set(slow)
 
+        # Forecast engine: judge + update + stamp against this complete
+        # sample, and embed the compact per-tick row (stamps/joins/gaps)
+        # so the ring itself shows the forecaster working. Runs BEFORE
+        # rule evaluation so an incident opening this tick captures the
+        # post-observe forecast state.
+        fc = self.forecast
+        if fc is not None:
+            fc_row = fc.observe(sample)
+            if fc_row is not None:
+                sample["forecast"] = fc_row
+
         self.ring.append(sample)
         TIMELINE_TICKS.inc()
         self._evaluate_rules(sample, fast, slow)
@@ -817,29 +842,87 @@ class TimelineSampler:
 
     # ---- render ---------------------------------------------------------
 
-    def snapshot(self, *, window_s: float | None = None) -> dict[str, Any]:
+    def snapshot(self, *, window_s: float | None = None,
+                 series: list[str] | None = None,
+                 step_s: float | None = None) -> dict[str, Any]:
         """The /debug/timeline payload: raw ticks plus windowed aggregates
         (p50/p99/min/max and rate of change per numeric series) over the
-        requested window (default: the whole retained ring)."""
+        requested window (default: the whole retained ring).
+
+        ``series`` keeps only the named top-level keys per sample (plus
+        ``t_unix``); ``step_s`` downsamples ticks into coarser buckets
+        (numeric keys average, nested maps drop — select without step_s
+        for full fidelity). Both exist so a long-retention query stops
+        shipping every sample of every series. Aggregates stay computed
+        over the FULL-resolution (post-selection) ticks; a step bucket no
+        tick landed in is simply absent — a gap, never interpolated."""
         cfg = self.cfg
         samples = list(self.ring)
         if window_s is not None and samples:
             cutoff = samples[-1]["t_unix"] - window_s
             samples = [s for s in samples if s["t_unix"] >= cutoff]
+        if series:
+            keep = set(series)
+            samples = [{k: v for k, v in s.items()
+                        if k == "t_unix" or k in keep}
+                       for s in samples]
+        downsample = step_s is not None and step_s > cfg.tick_s
         doc: dict[str, Any] = {
             "enabled": cfg.enabled,
             "tick_s": cfg.tick_s,
             "retention_s": cfg.retention_s,
             "ticks": len(samples),
-            "samples": samples,
+            "samples": (_downsample(samples, step_s) if downsample
+                        else samples),
             "aggregates": _aggregates(samples),
             "incident_count": len(self.incidents),
         }
+        if series:
+            doc["series"] = sorted(set(series))
+        if downsample:
+            doc["step_s"] = step_s
         if samples:
             fast, slow = self.burn.rates()
             doc["burn"] = {"fast": round(fast, 3), "slow": round(slow, 3),
                            "target": cfg.burn.target}
         return doc
+
+
+def _downsample(samples: list[dict[str, Any]],
+                step_s: float) -> list[dict[str, Any]]:
+    """Fold tick samples into step_s-wide buckets: per bucket, the mean
+    of every numeric top-level key present (each key averaged over the
+    ticks that carried it) plus ``n`` (ticks folded in). Buckets nothing
+    landed in do not appear — downsampling must not manufacture data
+    where the ring has a gap."""
+    acc: dict[int, tuple[dict[str, list], list[int]]] = {}
+    order: list[int] = []
+    for s in samples:
+        b = int(s["t_unix"] // step_s)
+        row = acc.get(b)
+        if row is None:
+            row = acc[b] = ({}, [0])
+            order.append(b)
+        keys, count = row
+        count[0] += 1
+        for k, v in s.items():
+            if k != "t_unix" and isinstance(v, (int, float)) \
+                    and not isinstance(v, bool):
+                cell = keys.get(k)
+                if cell is None:
+                    keys[k] = [v, 1]
+                else:
+                    cell[0] += v
+                    cell[1] += 1
+    out = []
+    for b in order:
+        keys, count = acc[b]
+        row: dict[str, Any] = {"t_unix": round(b * step_s, 3),
+                               "n": count[0]}
+        for k, (total, n) in keys.items():
+            row[k] = round(total / n, 4)
+        out.append(row)
+    return out
 
 
 def _percentile(sorted_vals: list[float], q: float) -> float:
@@ -896,9 +979,14 @@ def merge_timeline(docs: list[tuple[int, dict[str, Any]]], *,
     monotonic-merge precedent: inventing samples for a dead shard would
     hide exactly the outage the timeline exists to show). A worker that
     restarts loses its pre-restart ring, so the merged view honestly shows
-    its whole down-and-before window as gaps for that shard."""
-    tick_s = next((d.get("tick_s") for _, d in docs if d.get("tick_s")),
-                  1.0)
+    its whole down-and-before window as gaps for that shard.
+
+    Downsampled payloads (``step_s`` set — the ?step_s= query rode the
+    fan-out to every shard) bucket on the step instead of the tick: the
+    downsampled bucket timestamps are step-aligned, and a step bucket a
+    shard did not report stays a gap exactly like a missing tick."""
+    tick_s = next((d.get("step_s") or d.get("tick_s") for _, d in docs
+                   if d.get("step_s") or d.get("tick_s")), 1.0)
     enabled = any(d.get("enabled") for _, d in docs)
     buckets: dict[int, dict[str, Any]] = {}
     responding = {shard for shard, _ in docs}
@@ -943,6 +1031,10 @@ def merge_timeline(docs: list[tuple[int, dict[str, Any]]], *,
         "buckets": merged,
         "gap_buckets": sum(1 for r in merged if r.get("gaps")),
     }
+    step_s = next((d.get("step_s") for _, d in docs if d.get("step_s")),
+                  None)
+    if step_s:
+        out["step_s"] = step_s
     if collapsed:
         out["collapsed_samples"] = collapsed
     if supervisor:

@@ -110,6 +110,18 @@ REQUIRED_FAMILIES = {
     ("router_rebalance_headroom", "router"),
     ("router_role_flips", "router"),
     ("router_pool_advice", "router"),
+    # Traffic forecaster & capacity observatory (ISSUE 16): the judged
+    # error ledger (MAE / skill-vs-persistence / interval coverage per
+    # series × horizon), the stamp/join/gap lifecycle counters, the
+    # time-to-saturation projection, and the advice transition counter.
+    ("router_forecast_mae", "router"),
+    ("router_forecast_skill", "router"),
+    ("router_forecast_interval_coverage", "router"),
+    ("router_forecast_stamps", "router"),
+    ("router_forecast_joins", "router"),
+    ("router_forecast_gap_skips", "router"),
+    ("router_time_to_saturation_seconds", "router"),
+    ("router_pool_advice_changes", "router"),
 }
 
 # Registries whose every family must have a docs/metrics.md row (the
