@@ -8,7 +8,7 @@ PY ?= python
 	bench-router-sse bench-decisions bench-sched bench-sched-offload \
 	bench-scaleout bench-slo bench-overload bench-kvobs bench-multiturn \
 	bench-timeline bench-fleet-chaos bench-shadow bench-rebalance \
-	bench-forecast \
+	bench-forecast bench-autoscale \
 	dryrun render-chart \
 	compile-check \
 	verify-metrics verify-decisions verify-hotpath verify-threadsafe \
@@ -189,6 +189,18 @@ bench-rebalance:
 bench-fleet-chaos:
 	$(PY) bench.py --fleet-chaos
 
+# Guarded elastic-fleet actuator bench (CPU-only): a diurnal ramp
+# through four arms on the same trace — predictive (forecast-qualified
+# spawns land BEFORE saturation and attainment holds through the
+# plateau), reactive (the late trigger sheds into the cold-start
+# window), chaos (six drills: spawn failure, retry, burn-rate rollback
+# + freeze, advice flap, stuck drain force-finalized by the watchdog,
+# leadership flip mid-action — zero client errors throughout), and the
+# kill-switch arm (zero ticks, zero actions, bit-identical gateway).
+# Writes benchmarks/AUTOSCALE.json.
+bench-autoscale:
+	$(PY) bench.py --autoscale
+
 test-unit: test-fast
 
 # The multi-process jax.distributed suites only.
@@ -198,10 +210,14 @@ test-dist:
 # Fault-injection suite with a fixed seed: chaos decisions hash
 # (CHAOS_SEED, fault kind, request id), so reruns are bit-identical; the
 # fleet leader-kill drill (3 workers, election + divergence recovery +
-# /debug/fleet role table) rides along via tests/test_fleet.py.
+# /debug/fleet role table) rides along via tests/test_fleet.py, and the
+# actuator's lifecycle drills (spawn_fail / stall_drain / slow_start)
+# via tests/test_autoscale.py.
 test-chaos: verify-metrics
 	CHAOS_SEED=11 $(PY) -m pytest tests/test_resilience.py \
 		tests/test_engine_robustness.py tests/test_fleet.py -q -k chaos
+	CHAOS_SEED=11 $(PY) -m pytest tests/test_autoscale.py -q \
+		-k TestLifecycleChaos
 
 # Serving benchmark on the real chip (one JSON line; the driver's entry).
 bench:

@@ -129,6 +129,16 @@ class EngineServer:
         self.chaos = FaultInjector.from_spec(
             cfg.chaos or os.environ.get("ENGINE_CHAOS", ""),
             seed=cfg.chaos_seed)
+        # Lifecycle chaos (ISSUE 17 actuator drills) decides ONCE per pod
+        # identity — the same seed fails the same spawns every run, and a
+        # per-scrape decision would inflate the triggered tallies.
+        pod_id = f"{cfg.host}:{cfg.port}"
+        lc = lambda kind: (self.chaos.decide_lifecycle(kind, pod_id)
+                           if self.chaos else None)
+        self._chaos_spawn_fail = lc("spawn_fail")
+        self._chaos_slow_start = lc("slow_start")
+        self._chaos_stall_drain = lc("stall_drain")
+        self._ready_at_mono = 0.0  # slow_start: /health 503s until then
         self.app = web.Application(middlewares=[self._resilience_mw])
         self.app.add_routes([
             web.post("/v1/completions", self.completions),
@@ -263,6 +273,15 @@ class EngineServer:
     # ---- lifecycle ----------------------------------------------------
 
     async def start(self):
+        if self._chaos_spawn_fail is not None:
+            # Deliberately broken boot: the actuator's spawn watchdog and
+            # breaker are fed by exactly this failure mode.
+            raise RuntimeError(
+                f"chaos spawn_fail: engine {self.cfg.host}:{self.cfg.port} "
+                "refused to start")
+        if self._chaos_slow_start is not None:
+            self._ready_at_mono = (time.monotonic()
+                                   + self._chaos_slow_start.arg / 1000.0)
         # Attach the SSE event hub before the engine thread starts publishing.
         pub = getattr(self.engine, "kv_events", None)
         if pub is not None:
@@ -875,7 +894,21 @@ class EngineServer:
         }]})
 
     async def metrics(self, request: web.Request) -> web.Response:
-        return web.Response(body=self.engine.telemetry.render(),
+        body = self.engine.telemetry.render()
+        if self._chaos_stall_drain is not None:
+            # Phantom in-flight work: the scrape never observes an empty
+            # pod, so a drain never completes on its own — the actuator's
+            # stuck-drain watchdog must force-finalize. Applied to the
+            # exposition only; the engine itself is genuinely idle.
+            phantom = max(1.0, self._chaos_stall_drain.arg or 1.0)
+            lines = []
+            for line in body.decode().splitlines():
+                if line.startswith("jetstream:num_requests_running "):
+                    val = float(line.rsplit(" ", 1)[1])
+                    line = f"jetstream:num_requests_running {val + phantom}"
+                lines.append(line)
+            body = ("\n".join(lines) + "\n").encode()
+        return web.Response(body=body,
                             content_type="text/plain", charset="utf-8")
 
     async def traces(self, request: web.Request) -> web.Response:
@@ -916,6 +949,8 @@ class EngineServer:
 
     async def health(self, request: web.Request) -> web.Response:
         warming = bool(getattr(self.engine, "warming", False))
+        if time.monotonic() < self._ready_at_mono:
+            warming = True  # chaos slow_start: held not-ready after boot
         degraded = bool(getattr(self.engine, "dist_degraded", False))
         status = ("degraded" if degraded
                   else "draining" if self.draining

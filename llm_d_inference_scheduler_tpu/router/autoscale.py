@@ -1,0 +1,1061 @@
+"""Guarded elastic-fleet actuator: advice-driven pod/worker scaling.
+
+PR 16 gave `/debug/rebalance` advice a deadline (`lead_s`, the forecast
+time-to-saturation); this module is the actuator that consumes it —
+ROADMAP item 2(a), grounded in P/D-Serve (arXiv:2408.08147): at fleet
+scale the fleet SIZE must track traffic, not just the P:D ratio. An
+actuator is first and foremost a robustness problem — a scaling action
+that fires on a bad signal, wedges mid-drain, or flaps is worse than no
+autoscaler at all — so every action flows through one guarded pipeline:
+
+- **preflight** — advice direction sustained >= ``sustainTicks`` AND
+  (for scale-up) the forecast lead still positive; capacity bounds
+  (``minPodsPerRole``/``maxPodsPerRole``, never a role's last pod);
+  per-target backoff circuit closed; actuator not frozen.
+- **bounded budgets** — at most ``maxActionsPerWindow`` actions per
+  ``windowS``, plus ``dwellS`` minimum between OPPOSING actions on the
+  same target dimension, so advice flapping at the
+  ``router_pool_advice_changes_total`` rate can't saw the fleet.
+- **safe execution** — retire reuses the PR 15 drain-cycle discipline
+  (draining mark -> scrape-confirmed empty -> teardown, bounded by
+  ``drainTimeoutS``); spawn registers the pod DRAINING (not
+  pick-eligible) and only clears the mark after health + first scrape.
+- **watchdogs** — a stuck spawn/drain times out, is force-finalized,
+  and opens a per-target backoff circuit (resilience.py breaker).
+- **rollback-on-incident** — a burn-rate trip (PR 12 monitor) or
+  attainment collapse inside the post-action ``observationWindowS``
+  reverses the last action and FREEZES the actuator with the reason on
+  record (``router_autoscale_frozen``).
+
+Every action — including refusals, timeouts, and rollbacks — is a
+DecisionRecord-style ledger entry on ``GET /debug/autoscale`` (inputs:
+advice, lead_s, headroom, budgets; outcome judged post-hoc against the
+realized headroom — the predict->observe discipline every prior loop
+follows), with fleet fan-in via ``merge_autoscale``.
+
+Kill-switch: ``autoscale: {enabled: false}`` (the default) is
+bit-identical — no task, zero ticks, zero actions.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import dataclasses
+import logging
+import time
+from collections import deque
+from typing import Any, Callable
+
+from .metrics import (
+    AUTOSCALE_ACTIONS,
+    AUTOSCALE_FROZEN,
+    FLEET_SIZE,
+)
+from .resilience import CircuitBreaker
+
+log = logging.getLogger(__name__)
+
+PREFILL, DECODE = "prefill", "decode"
+ROLES = (PREFILL, DECODE)
+
+# Ledger action kinds.
+SPAWN_POD = "spawn_pod"
+RETIRE_POD = "retire_pod"
+SPAWN_WORKER = "spawn_worker"
+RETIRE_WORKER = "retire_worker"
+
+_OPPOSITE = {SPAWN_POD: RETIRE_POD, RETIRE_POD: SPAWN_POD,
+             SPAWN_WORKER: RETIRE_WORKER, RETIRE_WORKER: SPAWN_WORKER}
+
+# Terminal record states (the AUTOSCALE_ACTIONS outcome label).
+COMPLETED, ABORTED, REFUSED, ROLLED_BACK = ("completed", "aborted",
+                                            "refused", "rolled_back")
+
+
+@dataclasses.dataclass
+class AutoscaleConfig:
+    """The YAML ``autoscale:`` section (camelCase keys, like every other
+    EndpointPickerConfig surface). Defaults are deliberately cautious —
+    an actuator ships OFF and slow."""
+
+    enabled: bool = False
+    tick_s: float = 1.0
+    # Preflight: advice direction must hold for this many consecutive
+    # actuator ticks before it is actionable.
+    sustain_ticks: int = 3
+    # Scale-up additionally requires a positive forecast lead
+    # (advice.lead_s) when the forecaster is wired; reactive deployments
+    # (no forecast) set requireLead: false and act on sustain alone.
+    require_lead: bool = True
+    # Budgets: max actions per sliding window, and the minimum dwell
+    # between OPPOSING actions on the same target (role, or the worker
+    # dimension) — the anti-flap hysteresis.
+    max_actions_per_window: int = 4
+    window_s: float = 300.0
+    dwell_s: float = 60.0
+    # Post-action observation: burn-rate trip or attainment collapse in
+    # this window rolls the action back and freezes the actuator; after
+    # it closes the action's outcome is judged against realized headroom.
+    observation_window_s: float = 30.0
+    rollback_attainment: float = 0.5
+    # Safe-execution watchdogs.
+    spawn_timeout_s: float = 30.0
+    drain_timeout_s: float = 20.0
+    # Capacity bounds per role.
+    min_pods_per_role: int = 1
+    max_pods_per_role: int = 8
+    # Worker dimension: target worker count tracks ceil(pods /
+    # podsPerWorker) within [minWorkers, provisioned]. 0 disables worker
+    # scaling (the default — pods only).
+    pods_per_worker: int = 0
+    min_workers: int = 1
+    # Per-target backoff circuit opened by watchdog force-finalization.
+    breaker_failure_threshold: int = 2
+    breaker_open_s: float = 60.0
+    ledger_n: int = 256
+
+    @classmethod
+    def from_spec(cls, spec: dict[str, Any] | None) -> "AutoscaleConfig":
+        spec = spec or {}
+        cfg = cls(
+            enabled=bool(spec.get("enabled", False)),
+            tick_s=float(spec.get("tickS", 1.0)),
+            sustain_ticks=max(1, int(spec.get("sustainTicks", 3))),
+            require_lead=bool(spec.get("requireLead", True)),
+            max_actions_per_window=max(
+                1, int(spec.get("maxActionsPerWindow", 4))),
+            window_s=float(spec.get("windowS", 300.0)),
+            dwell_s=float(spec.get("dwellS", 60.0)),
+            observation_window_s=float(
+                spec.get("observationWindowS", 30.0)),
+            rollback_attainment=float(spec.get("rollbackAttainment", 0.5)),
+            spawn_timeout_s=float(spec.get("spawnTimeoutS", 30.0)),
+            drain_timeout_s=float(spec.get("drainTimeoutS", 20.0)),
+            min_pods_per_role=max(1, int(spec.get("minPodsPerRole", 1))),
+            max_pods_per_role=int(spec.get("maxPodsPerRole", 8)),
+            pods_per_worker=max(0, int(spec.get("podsPerWorker", 0))),
+            min_workers=max(1, int(spec.get("minWorkers", 1))),
+            breaker_failure_threshold=max(
+                1, int(spec.get("breakerFailureThreshold", 2))),
+            breaker_open_s=float(spec.get("breakerOpenS", 60.0)),
+            ledger_n=max(16, int(spec.get("ledgerN", 256))),
+        )
+        if cfg.tick_s <= 0:
+            raise ValueError("autoscale.tickS must be > 0")
+        if cfg.window_s <= 0:
+            raise ValueError("autoscale.windowS must be > 0")
+        if cfg.max_pods_per_role < cfg.min_pods_per_role:
+            raise ValueError("autoscale.maxPodsPerRole must be >= "
+                             "minPodsPerRole")
+        if not 0.0 <= cfg.rollback_attainment <= 1.0:
+            raise ValueError(
+                "autoscale.rollbackAttainment must be in [0, 1]")
+        return cfg
+
+
+class SpawnHandle:
+    """What a launcher returns from ``spawn``: the launcher (or the chaos
+    shim standing in for it) flips ``state`` to "ok" once the pod's
+    process is up and its endpoint is registered (DRAINING — the
+    controller clears the mark after the first scrape), or to "failed"
+    with ``error`` set."""
+
+    def __init__(self) -> None:
+        self.state = "pending"       # pending | ok | failed
+        self.address_port: str | None = None
+        self.error: str | None = None
+
+
+class _Action:
+    """One in-flight guarded action (the controller runs at most one at a
+    time — serialized actions are the cheapest mid-action invariant)."""
+
+    def __init__(self, kind: str, role: str, *, inputs: dict[str, Any],
+                 wall: float, mono: float, rollback_of: int | None = None):
+        self.kind = kind
+        self.role = role             # pod role, or "worker"
+        self.inputs = inputs
+        self.started_unix = wall
+        self.start_mono = mono
+        self.rollback_of = rollback_of
+        self.target: str | None = None
+        self.handle: Any = None      # SpawnHandle for spawns
+        self.record: dict[str, Any] = {}
+        self.watchdog = False
+
+
+class ActuatorController:
+    """Grid-tick guarded actuator. ``tick()`` is synchronous and
+    injectable-clock so the full guard pipeline is testable without
+    asyncio (RebalanceController precedent); ``start()`` runs it on the
+    wall-clock grid.
+
+    Collaborators are injected:
+
+    - ``advice_fn`` -> the rebalancer's live per-role advice dict
+      ({role: {direction, why, headroom, lead_s?, forecast?}}).
+    - ``datastore`` -> endpoint census + the draining lifecycle the
+      drain cycle rides (set_endpoint_draining / endpoint_get).
+    - ``launcher`` -> object with ``spawn(role) -> SpawnHandle`` and
+      ``retire(address_port)`` (teardown + endpoint_delete). None means
+      the pod dimension is observed but never acted on (advice-driven
+      refusals still ledger — the dry-run view).
+    - ``worker_scaler`` -> object with ``counts() -> (active,
+      provisioned)``, ``retire() -> str|None`` and ``restore() ->
+      str|None`` (shard id, or None = refused). Fleet mode wires this to
+      the supervisor's ``POST /fleet/scale``.
+    - ``burn_fn`` -> True when the PR 12 burn-rate monitor is tripped;
+      ``attainment_fn`` -> the last tick's attainment (None = no
+      arrivals): the rollback triggers.
+    """
+
+    def __init__(self, cfg: AutoscaleConfig, *,
+                 datastore: Any = None,
+                 advice_fn: Callable[[], dict[str, Any]] | None = None,
+                 launcher: Any = None,
+                 worker_scaler: Any = None,
+                 burn_fn: Callable[[], bool] | None = None,
+                 attainment_fn: Callable[[], float | None] | None = None,
+                 acting: bool = True,
+                 clock: Callable[[], float] = time.monotonic,
+                 wall: Callable[[], float] = time.time):
+        self.cfg = cfg
+        self.datastore = datastore
+        self.advice_fn = advice_fn
+        self.launcher = launcher
+        self.worker_scaler = worker_scaler
+        self.burn_fn = burn_fn
+        self.attainment_fn = attainment_fn
+        self.acting = acting
+        self._clock = clock
+        self._wall = wall
+        self._task: asyncio.Task | None = None
+
+        self.ticks_total = 0
+        self.actions_total = 0
+        self.refusals_total = 0
+        self.rollbacks_total = 0
+        self.watchdog_total = 0
+        self.frozen = False
+        self.frozen_reason: str | None = None
+        self.frozen_unix: float | None = None
+
+        self._records: deque[dict[str, Any]] = deque(maxlen=cfg.ledger_n)
+        self._next_id = 1
+        self._pending: _Action | None = None
+        # Sustain streaks per pod role: (direction, consecutive ticks).
+        self._streak: dict[str, tuple[str, int]] = {}
+        # Budget window: wall times of STARTED actions (refusals are
+        # free — a refusal that consumed budget would starve recovery).
+        self._window: deque[float] = deque()
+        # Dwell anchors per dimension key: (kind, wall time).
+        self._last_kind: dict[str, tuple[str, float]] = {}
+        # Refusal dedup per dimension: last refusal reason -> its record,
+        # so a sustained refusal bumps a count instead of flooding the
+        # ledger every tick.
+        self._last_refusal: dict[str, dict[str, Any]] = {}
+        # Post-action observation: records completed but not yet judged.
+        self._observing: list[dict[str, Any]] = []
+        self._breakers: dict[str, CircuitBreaker] = {}
+        self._g_size = {r: FLEET_SIZE.labels(r) for r in ROLES}
+        self._g_size_worker = FLEET_SIZE.labels("worker")
+        AUTOSCALE_FROZEN.set(0)
+
+    # ---- lifecycle ------------------------------------------------------
+
+    @property
+    def enabled(self) -> bool:
+        return self.cfg.enabled
+
+    def start(self) -> None:
+        if not self.cfg.enabled or self._task is not None:
+            return
+        self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._task
+            self._task = None
+        stop = getattr(self.worker_scaler, "stop", None)
+        if stop is not None:
+            await stop()
+
+    def promote(self) -> None:
+        """This worker just became the acting datalayer leader: arm the
+        actuator. A half-done action belongs to the dead ex-leader's
+        ledger, not ours — the new leader starts with a clean slate and
+        re-anchored dwell (no inherited momentum)."""
+        if not self.cfg.enabled:
+            return
+        self.acting = True
+        now = self._wall()
+        for key in list(self._last_kind):
+            kind, _ = self._last_kind[key]
+            self._last_kind[key] = (kind, now)
+        if self._task is None:
+            with contextlib.suppress(RuntimeError):
+                self.start()
+
+    async def _run(self) -> None:
+        tick = self.cfg.tick_s
+        try:
+            while True:
+                now = self._wall()
+                next_t = (int(now / tick) + 1) * tick
+                await asyncio.sleep(max(next_t - now, 0.0))
+                with contextlib.suppress(Exception):
+                    self.tick()
+        except asyncio.CancelledError:
+            pass
+
+    # ---- census ---------------------------------------------------------
+
+    def _census(self) -> dict[str, dict[str, Any]]:
+        out: dict[str, dict[str, Any]] = {}
+        if self.datastore is not None:
+            out = self.datastore.role_census()
+        for role in ROLES:
+            row = out.get(role) or {"total": 0, "ready": 0, "pods": []}
+            out[role] = row
+            self._g_size[role].set(row["total"])
+        if self.worker_scaler is not None:
+            active, provisioned = self.worker_scaler.counts()
+            out["worker"] = {"total": active, "provisioned": provisioned}
+            self._g_size_worker.set(active)
+        return out
+
+    # ---- ledger ---------------------------------------------------------
+
+    def _record(self, kind: str, role: str, state: str, *,
+                why: str, inputs: dict[str, Any] | None = None,
+                target: str | None = None,
+                watchdog: bool = False,
+                rollback_of: int | None = None) -> dict[str, Any]:
+        rec: dict[str, Any] = {
+            "id": self._next_id,
+            "t_unix": round(self._wall(), 3),
+            "kind": kind,
+            "role": role,
+            "state": state,
+            "why": why,
+            "inputs": inputs or {},
+        }
+        self._next_id += 1
+        if target is not None:
+            rec["target"] = target
+        if watchdog:
+            rec["watchdog"] = True
+        if rollback_of is not None:
+            rec["rollback_of"] = rollback_of
+        self._records.append(rec)
+        if state in (COMPLETED, ABORTED, REFUSED, ROLLED_BACK):
+            AUTOSCALE_ACTIONS.labels(kind, state).inc()
+        return rec
+
+    def _finalize(self, rec: dict[str, Any], state: str) -> None:
+        rec["state"] = state
+        rec["finished_unix"] = round(self._wall(), 3)
+        AUTOSCALE_ACTIONS.labels(rec["kind"], state).inc()
+
+    def _refuse(self, dim: str, kind: str, role: str, why: str,
+                inputs: dict[str, Any]) -> None:
+        """Ledger a refusal, deduped per dimension: the same reason on
+        consecutive ticks bumps a count on the existing record."""
+        self.refusals_total += 1
+        last = self._last_refusal.get(dim)
+        if (last is not None and last["why"] == why
+                and last["kind"] == kind):
+            last["count"] = last.get("count", 1) + 1
+            last["t_unix"] = round(self._wall(), 3)
+            last["inputs"] = inputs
+            return
+        rec = self._record(kind, role, REFUSED, why=why, inputs=inputs)
+        rec["count"] = 1
+        self._last_refusal[dim] = rec
+
+    # ---- breakers -------------------------------------------------------
+
+    def _breaker(self, key: str) -> CircuitBreaker:
+        b = self._breakers.get(key)
+        if b is None:
+            b = CircuitBreaker(
+                failure_threshold=self.cfg.breaker_failure_threshold,
+                open_s=self.cfg.breaker_open_s, clock=self._clock)
+            self._breakers[key] = b
+        return b
+
+    # ---- freeze ---------------------------------------------------------
+
+    def freeze(self, reason: str) -> None:
+        if self.frozen:
+            return
+        self.frozen = True
+        self.frozen_reason = reason
+        self.frozen_unix = round(self._wall(), 3)
+        AUTOSCALE_FROZEN.set(1)
+        log.warning("autoscale frozen: %s", reason)
+
+    def unfreeze(self) -> None:
+        """Operator reset (tests, or a config reload): clear the freeze
+        and start from a clean dwell slate."""
+        self.frozen = False
+        self.frozen_reason = None
+        self.frozen_unix = None
+        AUTOSCALE_FROZEN.set(0)
+
+    # ---- one tick -------------------------------------------------------
+
+    def tick(self, wall: float | None = None) -> None:
+        """One guarded-actuator cycle: advance the in-flight action,
+        check the rollback trigger, judge closed observation windows,
+        then run the preflight pipeline on fresh advice. Kill-switch:
+        one attribute check."""
+        cfg = self.cfg
+        if not cfg.enabled or not self.acting:
+            return
+        self.ticks_total += 1
+        now = wall if wall is not None else self._wall()
+        mono = self._clock()
+
+        # Census AFTER advancing the in-flight action: completing a
+        # retire deletes its endpoint (and completing a spawn clears a
+        # DRAINING mark), and every preflight below must see that —
+        # a stale pre-advance census once let a same-tick follow-up
+        # retire the pool's genuinely last pod.
+        self._advance_pending(now, mono)
+        census = self._census()
+        self._check_rollback(now, census)
+        self._judge_observed(now)
+
+        advice = self.advice_fn() if self.advice_fn is not None else {}
+        self._update_streaks(advice)
+        if self._pending is not None:
+            return      # serialized: one action in flight at a time
+        self._consider_pods(advice, census, now, mono)
+        if self._pending is None:
+            self._consider_workers(census, now, mono)
+
+    # ---- in-flight state machine ---------------------------------------
+
+    def _advance_pending(self, now: float, mono: float) -> None:
+        act = self._pending
+        if act is None:
+            return
+        if act.kind in (SPAWN_POD, SPAWN_WORKER):
+            self._advance_spawn(act, now, mono)
+        else:
+            self._advance_retire(act, now, mono)
+
+    def _advance_spawn(self, act: _Action, now: float, mono: float) -> None:
+        cfg = self.cfg
+        h = act.handle
+        rec = act.record
+        if act.kind == SPAWN_WORKER:
+            # The scaler resolved synchronously (restore() returned the
+            # shard); the spawn completes once the worker is back alive.
+            active, _ = (self.worker_scaler.counts()
+                         if self.worker_scaler is not None else (0, 0))
+            if active >= act.inputs.get("target_workers", 0):
+                self._complete(act)
+            elif mono - act.start_mono > cfg.spawn_timeout_s:
+                self._abort(act, "worker restore did not come up within "
+                            f"spawnTimeoutS={cfg.spawn_timeout_s}")
+            return
+        if h is not None and h.state == "failed":
+            self._abort(act, f"launcher spawn failed: {h.error}")
+            return
+        if h is not None and h.state == "ok" and h.address_port:
+            act.target = h.address_port
+            rec["target"] = h.address_port
+            ep = (self.datastore.endpoint_get(h.address_port)
+                  if self.datastore is not None else None)
+            # Pick-eligibility gate: health (launcher says ok) + first
+            # scrape (the datalayer observed the pod) before the
+            # draining mark is cleared.
+            if ep is not None and ep.metrics.update_time > act.start_mono:
+                self.datastore.set_endpoint_draining(h.address_port, False)
+                self._complete(act)
+                return
+        if mono - act.start_mono > cfg.spawn_timeout_s:
+            self._abort(act, "spawn stuck (no healthy scrape within "
+                        f"spawnTimeoutS={cfg.spawn_timeout_s})",
+                        cleanup=True)
+
+    def _advance_retire(self, act: _Action, now: float, mono: float) -> None:
+        cfg = self.cfg
+        if act.kind == RETIRE_WORKER:
+            active, _ = (self.worker_scaler.counts()
+                         if self.worker_scaler is not None else (0, 0))
+            if active <= act.inputs.get("target_workers", 1 << 30):
+                self._complete(act)
+            elif mono - act.start_mono > cfg.drain_timeout_s:
+                act.watchdog = True
+                self._complete(act, why_suffix="; drain timed out, "
+                               "force-finalized by watchdog")
+            return
+        ep = (self.datastore.endpoint_get(act.target)
+              if self.datastore is not None else None)
+        if ep is None:
+            # Pod vanished under the drain (crash, operator delete):
+            # nothing left to tear down.
+            self._complete(act, why_suffix="; pod vanished mid-drain")
+            return
+        m = ep.metrics
+        drained = (m.update_time > act.start_mono
+                   and m.running_requests_size == 0
+                   and m.waiting_queue_size == 0)
+        if drained:
+            if self.launcher is not None:
+                self.launcher.retire(act.target)
+            self._complete(act)
+            return
+        if mono - act.start_mono > cfg.drain_timeout_s:
+            # Watchdog: force-finalize — tear the pod down anyway (its
+            # residual work is lost, which is exactly what the record
+            # says) and open the backoff circuit for this dimension.
+            act.watchdog = True
+            if self.launcher is not None:
+                self.launcher.retire(act.target)
+            self._breaker(f"pod:{act.role}").record_failure()
+            self._complete(act, why_suffix="; drain timed out, "
+                           "force-finalized by watchdog")
+
+    def _complete(self, act: _Action, *, why_suffix: str = "") -> None:
+        rec = act.record
+        if why_suffix:
+            rec["why"] += why_suffix
+        if act.watchdog:
+            rec["watchdog"] = True
+            rec["drain_timed_out"] = True
+            self.watchdog_total += 1
+        self._finalize(rec, COMPLETED)
+        rec["observe_until"] = round(
+            self._wall() + self.cfg.observation_window_s, 3)
+        # Incident baseline at completion: rollback is attribution, not
+        # alarm-forwarding — an incident already burning when the action
+        # landed (e.g. the very overload a scale-up answers) must not
+        # reverse it; only an incident that APPEARS inside the window is
+        # chargeable to the action.
+        rec["baseline"] = {
+            "burn": bool(self.burn_fn()) if self.burn_fn is not None
+            else False,
+            "attainment": (self.attainment_fn()
+                           if self.attainment_fn is not None else None),
+        }
+        self._observing.append(rec)
+        self._pending = None
+
+    def _abort(self, act: _Action, why: str, *, cleanup: bool = False) -> None:
+        rec = act.record
+        rec["why"] += f"; {why}"
+        rec["watchdog"] = True
+        self.watchdog_total += 1
+        if cleanup and act.handle is not None and act.handle.address_port \
+                and self.launcher is not None:
+            # Undo the half-made pod: the launcher tears down whatever
+            # came up (the endpoint was registered draining, so no pick
+            # ever reached it).
+            with contextlib.suppress(Exception):
+                self.launcher.retire(act.handle.address_port)
+        key = ("worker" if act.kind in (SPAWN_WORKER, RETIRE_WORKER)
+               else f"pod:{act.role}")
+        self._breaker(key).record_failure()
+        self._finalize(rec, ABORTED)
+        self._pending = None
+
+    # ---- rollback + judging ---------------------------------------------
+
+    def _check_rollback(self, now: float, census: dict[str, Any]) -> None:
+        """Burn-rate trip or attainment collapse inside the post-action
+        observation window: reverse the last completed action and freeze."""
+        if self.frozen or self._pending is not None or not self._observing:
+            return
+        rec = self._observing[-1]
+        if now > rec["observe_until"] or rec.get("rollback_of") is not None:
+            return
+        base = rec.get("baseline") or {}
+        tripped = None
+        if (self.burn_fn is not None and self.burn_fn()
+                and not base.get("burn")):
+            tripped = "burn-rate monitor tripped"
+        elif self.attainment_fn is not None:
+            att = self.attainment_fn()
+            base_att = base.get("attainment")
+            was_healthy = (base_att is None
+                           or base_att >= self.cfg.rollback_attainment)
+            if (att is not None and att < self.cfg.rollback_attainment
+                    and was_healthy):
+                tripped = (f"attainment {att:.3f} < rollbackAttainment "
+                           f"{self.cfg.rollback_attainment}")
+        if tripped is None:
+            return
+        reason = (f"{tripped} within {self.cfg.observation_window_s}s of "
+                  f"action #{rec['id']} ({rec['kind']} {rec['role']})")
+        rec["state"] = ROLLED_BACK
+        rec["outcome"] = "regressed"
+        rec["rollback_reason"] = tripped
+        AUTOSCALE_ACTIONS.labels(rec["kind"], ROLLED_BACK).inc()
+        self._observing.remove(rec)
+        self.rollbacks_total += 1
+        self._start_reverse(rec, reason)
+        self.freeze(reason)
+
+    def _start_reverse(self, rec: dict[str, Any], reason: str) -> None:
+        kind = _OPPOSITE[rec["kind"]]
+        inputs = {"reverses": rec["id"], "reason": reason}
+        act = _Action(kind, rec["role"], inputs=inputs, wall=self._wall(),
+                      mono=self._clock(), rollback_of=rec["id"])
+        if kind == RETIRE_POD:
+            target = rec.get("target")
+            if target is None or self.datastore is None \
+                    or self.datastore.endpoint_get(target) is None:
+                return      # nothing concrete to reverse
+            act.target = target
+            self.datastore.set_endpoint_draining(target, True)
+        elif kind == SPAWN_POD:
+            if self.launcher is None:
+                return
+            act.handle = SpawnHandle()
+            try:
+                act.handle = self.launcher.spawn(rec["role"])
+            except Exception as e:
+                self._record(kind, rec["role"], ABORTED,
+                             why=f"rollback spawn failed: {e}",
+                             inputs=inputs, rollback_of=rec["id"])
+                return
+        elif self.worker_scaler is not None:
+            target = (self.worker_scaler.retire()
+                      if kind == RETIRE_WORKER
+                      else self.worker_scaler.restore())
+            if target is None:
+                self._record(kind, "worker", ABORTED,
+                             why="rollback refused by the worker scaler",
+                             inputs=inputs, rollback_of=rec["id"])
+                return
+            act.target = target
+            active, _ = self.worker_scaler.counts()
+            act.inputs["target_workers"] = (
+                active - 1 if kind == RETIRE_WORKER else active + 1)
+        else:
+            return
+        act.record = self._record(
+            act.kind, act.role, "pending",
+            why=f"rollback of action #{rec['id']}: {reason}",
+            inputs=inputs, target=act.target, rollback_of=rec["id"])
+        self._pending = act
+        self.actions_total += 1
+
+    def _judge_observed(self, now: float) -> None:
+        """Close observation windows: judge each completed action's
+        outcome against the realized headroom (predict->observe)."""
+        still: list[dict[str, Any]] = []
+        advice = self.advice_fn() if self.advice_fn is not None else {}
+        for rec in self._observing:
+            if now <= rec["observe_until"]:
+                still.append(rec)
+                continue
+            before = rec["inputs"].get("headroom")
+            after = None
+            row = advice.get(rec["role"]) if rec["role"] in ROLES else None
+            if row is not None:
+                after = row.get("headroom")
+            rec["realized_headroom"] = after
+            if before is None or after is None:
+                rec["outcome"] = "no_change"
+            elif rec["kind"] in (SPAWN_POD, SPAWN_WORKER):
+                rec["outcome"] = ("improved" if after > before + 0.01
+                                  else "no_change")
+            else:
+                # A retire that kept headroom at/above target realized
+                # its bet (capacity was surplus); one that cratered it
+                # regressed — the rollback window usually catches that
+                # first, this is the slow-path verdict.
+                rec["outcome"] = ("regressed" if after < before - 0.25
+                                  else "improved")
+        self._observing = still
+
+    # ---- preflight + dispatch -------------------------------------------
+
+    def _update_streaks(self, advice: dict[str, Any]) -> None:
+        for role in ROLES:
+            row = advice.get(role) or {}
+            direction = row.get("direction", "hold")
+            prev_dir, n = self._streak.get(role, ("hold", 0))
+            self._streak[role] = ((direction, n + 1)
+                                  if direction == prev_dir
+                                  else (direction, 1))
+
+    def _budget_ok(self, now: float) -> tuple[bool, str]:
+        cfg = self.cfg
+        while self._window and now - self._window[0] > cfg.window_s:
+            self._window.popleft()
+        if len(self._window) >= cfg.max_actions_per_window:
+            return False, (f"budget exhausted: {len(self._window)} actions "
+                           f"in the last {cfg.window_s:.0f}s "
+                           f"(max {cfg.max_actions_per_window})")
+        return True, ""
+
+    def _dwell_ok(self, dim: str, kind: str, now: float) -> tuple[bool, str]:
+        last = self._last_kind.get(dim)
+        if last is None:
+            return True, ""
+        last_kind, t = last
+        if last_kind != kind and now - t < self.cfg.dwell_s:
+            return False, (f"dwell: opposing action {last_kind} ran "
+                           f"{now - t:.0f}s ago (< dwellS="
+                           f"{self.cfg.dwell_s:.0f})")
+        return True, ""
+
+    def _consider_pods(self, advice: dict[str, Any],
+                       census: dict[str, Any], now: float,
+                       mono: float) -> None:
+        cfg = self.cfg
+        for role in ROLES:
+            row = advice.get(role) or {}
+            direction = row.get("direction", "hold")
+            if direction not in ("up", "down"):
+                self._last_refusal.pop(f"pod:{role}", None)
+                continue
+            kind = SPAWN_POD if direction == "up" else RETIRE_POD
+            streak_dir, streak_n = self._streak.get(role, ("hold", 0))
+            inputs = {
+                "advice": direction, "why_advice": row.get("why"),
+                "headroom": row.get("headroom"),
+                "lead_s": row.get("lead_s"),
+                "sustained_ticks": streak_n,
+                "budget_used": len(self._window),
+                "pods": census.get(role, {}).get("total", 0),
+            }
+            dim = f"pod:{role}"
+            ok, why = self._preflight_pod(kind, role, row, streak_n,
+                                          census, now)
+            if not ok:
+                self._refuse(dim, kind, role, why, inputs)
+                continue
+            self._last_refusal.pop(dim, None)
+            if kind == SPAWN_POD:
+                self._start_spawn_pod(role, inputs, now, mono)
+            else:
+                self._start_retire_pod(role, inputs, census, now, mono)
+            return      # one action per tick fleet-wide
+
+    def _preflight_pod(self, kind: str, role: str, row: dict[str, Any],
+                       streak_n: int, census: dict[str, Any],
+                       now: float) -> tuple[bool, str]:
+        cfg = self.cfg
+        if self.frozen:
+            return False, f"actuator frozen: {self.frozen_reason}"
+        if self.launcher is None:
+            return False, "no pod launcher wired (dry-run)"
+        if streak_n < cfg.sustain_ticks:
+            # Streak progress lives in inputs.sustained_ticks; keeping it
+            # out of the reason text lets the ledger dedup consecutive
+            # not-yet-sustained refusals into one counted record.
+            return False, (f"advice not sustained for sustainTicks="
+                           f"{cfg.sustain_ticks} yet")
+        if kind == SPAWN_POD and cfg.require_lead:
+            # lead_s is the forecaster's time-to-saturation: None means
+            # no saturation is projected (trend flat/rising) — refuse.
+            # 0.0 means saturated NOW, the most actionable lead of all.
+            lead = row.get("lead_s")
+            if lead is None or lead < 0:
+                return False, ("scale-up requires a projected saturation "
+                               f"(forecast lead_s={lead!r})")
+        n = census.get(role, {}).get("total", 0)
+        if kind == SPAWN_POD and n >= cfg.max_pods_per_role:
+            return False, (f"{role} already at maxPodsPerRole="
+                           f"{cfg.max_pods_per_role}")
+        if kind == RETIRE_POD and n <= cfg.min_pods_per_role:
+            return False, (f"never retire {role}'s last pod(s): "
+                           f"{n} <= minPodsPerRole={cfg.min_pods_per_role}")
+        if not self._breaker(f"pod:{role}").would_allow():
+            return False, (f"backoff circuit open for pod:{role} "
+                           "(a previous action wedged)")
+        ok, why = self._budget_ok(now)
+        if not ok:
+            return False, why
+        return self._dwell_ok(f"pod:{role}", kind, now)
+
+    def _start_spawn_pod(self, role: str, inputs: dict[str, Any],
+                         now: float, mono: float) -> None:
+        act = _Action(SPAWN_POD, role, inputs=inputs, wall=now, mono=mono)
+        try:
+            act.handle = self.launcher.spawn(role)
+        except Exception as e:
+            self._record(SPAWN_POD, role, ABORTED,
+                         why=f"launcher spawn raised: {e}", inputs=inputs)
+            self._breaker(f"pod:{role}").record_failure()
+            self.watchdog_total += 1
+            return
+        act.record = self._record(
+            SPAWN_POD, role, "pending",
+            why=f"sustained up-advice with lead "
+                f"{inputs.get('lead_s')!r}s", inputs=inputs)
+        self._commit(act, f"pod:{role}", now)
+
+    def _start_retire_pod(self, role: str, inputs: dict[str, Any],
+                          census: dict[str, Any], now: float,
+                          mono: float) -> None:
+        pods = census.get(role, {}).get("pods") or []
+        # Victim: the least-loaded pick-eligible pod of the role.
+        eligible = [p for p in pods if not p.get("draining")]
+        if not eligible:
+            self._refuse(f"pod:{role}", RETIRE_POD, role,
+                         "no pick-eligible pod to retire", inputs)
+            return
+        victim = min(eligible, key=lambda p: (p.get("load", 0),
+                                              p["address_port"]))
+        addr = victim["address_port"]
+        act = _Action(RETIRE_POD, role, inputs=inputs, wall=now, mono=mono)
+        act.target = addr
+        self.datastore.set_endpoint_draining(addr, True)
+        act.record = self._record(
+            RETIRE_POD, role, "pending",
+            why="sustained down-advice; draining least-loaded pod",
+            inputs=inputs, target=addr)
+        self._commit(act, f"pod:{role}", now)
+
+    def _consider_workers(self, census: dict[str, Any], now: float,
+                          mono: float) -> None:
+        cfg = self.cfg
+        if cfg.pods_per_worker <= 0 or self.worker_scaler is None:
+            return
+        active, provisioned = self.worker_scaler.counts()
+        if provisioned <= 0:
+            return      # scaler view not populated yet (HTTP refresh)
+        total_pods = sum(census.get(r, {}).get("total", 0) for r in ROLES)
+        want = -(-total_pods // cfg.pods_per_worker)  # ceil
+        want = max(cfg.min_workers, min(want, provisioned))
+        if want == active:
+            self._last_refusal.pop("worker", None)
+            return
+        kind = SPAWN_WORKER if want > active else RETIRE_WORKER
+        inputs = {"active_workers": active, "provisioned": provisioned,
+                  "target_workers": want, "pods": total_pods,
+                  "pods_per_worker": cfg.pods_per_worker,
+                  "budget_used": len(self._window)}
+        if self.frozen:
+            self._refuse("worker", kind, "worker",
+                         f"actuator frozen: {self.frozen_reason}", inputs)
+            return
+        if not self._breaker("worker").would_allow():
+            self._refuse("worker", kind, "worker",
+                         "backoff circuit open for the worker dimension",
+                         inputs)
+            return
+        ok, why = self._budget_ok(now)
+        if ok:
+            ok, why = self._dwell_ok("worker", kind, now)
+        if not ok:
+            self._refuse("worker", kind, "worker", why, inputs)
+            return
+        self._last_refusal.pop("worker", None)
+        target = (self.worker_scaler.restore() if kind == SPAWN_WORKER
+                  else self.worker_scaler.retire())
+        if target is None:
+            self._refuse("worker", kind, "worker",
+                         "worker scaler refused (leader or last worker)",
+                         inputs)
+            return
+        act = _Action(kind, "worker", inputs=inputs, wall=now, mono=mono)
+        act.target = str(target)
+        act.record = self._record(
+            kind, "worker", "pending",
+            why=f"worker count {active} -> {want} tracks "
+                f"{total_pods} pods / podsPerWorker={cfg.pods_per_worker}",
+            inputs=inputs, target=str(target))
+        self._commit(act, "worker", now)
+
+    def _commit(self, act: _Action, dim: str, now: float) -> None:
+        self._pending = act
+        self.actions_total += 1
+        self._window.append(now)
+        self._last_kind[dim] = (act.kind, now)
+
+    # ---- render ---------------------------------------------------------
+
+    def snapshot(self, *, records_n: int | None = 64) -> dict[str, Any]:
+        cfg = self.cfg
+        doc: dict[str, Any] = {
+            "enabled": cfg.enabled,
+            "acting": self.acting,
+            "config": {
+                "tick_s": cfg.tick_s,
+                "sustain_ticks": cfg.sustain_ticks,
+                "require_lead": cfg.require_lead,
+                "max_actions_per_window": cfg.max_actions_per_window,
+                "window_s": cfg.window_s,
+                "dwell_s": cfg.dwell_s,
+                "observation_window_s": cfg.observation_window_s,
+                "rollback_attainment": cfg.rollback_attainment,
+                "spawn_timeout_s": cfg.spawn_timeout_s,
+                "drain_timeout_s": cfg.drain_timeout_s,
+                "min_pods_per_role": cfg.min_pods_per_role,
+                "max_pods_per_role": cfg.max_pods_per_role,
+                "pods_per_worker": cfg.pods_per_worker,
+            },
+            "ticks": self.ticks_total,
+            "actions_total": self.actions_total,
+            "refusals_total": self.refusals_total,
+            "rollbacks_total": self.rollbacks_total,
+            "watchdog_total": self.watchdog_total,
+            "frozen": self.frozen,
+            "budget": {
+                "window_used": len(self._window),
+                "window_max": cfg.max_actions_per_window,
+            },
+        }
+        if self.frozen:
+            doc["frozen_reason"] = self.frozen_reason
+            doc["frozen_unix"] = self.frozen_unix
+        if self.datastore is not None:
+            doc["fleet_size"] = {
+                role: row.get("total", 0)
+                for role, row in self.datastore.role_census().items()}
+        if self.worker_scaler is not None:
+            active, provisioned = self.worker_scaler.counts()
+            doc["workers"] = {"active": active, "provisioned": provisioned}
+        if self._pending is not None:
+            doc["pending"] = self._pending.record
+        breakers = {k: b.state for k, b in self._breakers.items()
+                    if b.state != "closed"}
+        if breakers:
+            doc["breakers"] = breakers
+        records = list(self._records)
+        if records_n is not None:
+            records = records[-records_n:]
+        doc["records"] = list(reversed(records))
+        return doc
+
+
+# ---------------------------------------------------------------------------
+# Fleet-mode worker scaler: the acting worker's view of the supervisor's
+# POST /fleet/scale surface.
+# ---------------------------------------------------------------------------
+
+
+class HttpWorkerScaler:
+    """Worker-dimension scaler over the supervisor's admin plane. The
+    actuator tick is synchronous, so this adapter is deliberately
+    eventually-consistent: ``counts()`` serves a cached view refreshed in
+    the background from ``/debug/fleet`` (worker states), and
+    ``retire()``/``restore()`` fire the ``POST /fleet/scale`` without
+    awaiting it — the action's completion (or a supervisor-side refusal)
+    is observed the same way every worker action is judged: the counts
+    converge to the target, or the spawn/drain watchdog times the action
+    out and opens the breaker."""
+
+    def __init__(self, host: str, port: int, token: str | None = None, *,
+                 refresh_s: float = 2.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self._base = f"http://{host}:{port}"
+        self._token = token
+        self._refresh_s = refresh_s
+        self._clock = clock
+        self._last_refresh = float("-inf")
+        self._counts = (0, 0)     # (active, provisioned); 0 = unknown
+        self._session: Any = None
+
+    def counts(self) -> tuple[int, int]:
+        now = self._clock()
+        if now - self._last_refresh >= self._refresh_s:
+            self._last_refresh = now
+            self._kick(self._refresh())
+        return self._counts
+
+    def retire(self) -> str | None:
+        self._kick(self._post("retire"))
+        return "supervisor"   # provisional: convergence judged via counts
+
+    def restore(self) -> str | None:
+        self._kick(self._post("restore"))
+        return "supervisor"
+
+    def _kick(self, coro: Any) -> None:
+        try:
+            asyncio.get_running_loop().create_task(coro)
+        except RuntimeError:     # no loop (sync tests): stay on cache
+            coro.close()
+
+    async def _ensure_session(self) -> Any:
+        if self._session is None:
+            import aiohttp
+
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=5.0))
+        return self._session
+
+    async def _refresh(self) -> None:
+        with contextlib.suppress(Exception):
+            session = await self._ensure_session()
+            async with session.get(f"{self._base}/debug/fleet") as resp:
+                doc = await resp.json()
+            rows = doc.get("admin") or []
+            active = sum(1 for r in rows if r.get("state") == "up")
+            self._counts = (active, int(doc.get("workers", len(rows))))
+
+    async def _post(self, action: str) -> None:
+        with contextlib.suppress(Exception):
+            session = await self._ensure_session()
+            headers = ({"x-fleet-token": self._token}
+                       if self._token else {})
+            async with session.post(f"{self._base}/fleet/scale",
+                                    json={"action": action},
+                                    headers=headers):
+                pass
+            self._last_refresh = float("-inf")  # re-census promptly
+
+    async def stop(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+
+# ---------------------------------------------------------------------------
+# Fleet fan-in.
+# ---------------------------------------------------------------------------
+
+MERGE_RECORDS_TOTAL = 64
+
+
+def merge_autoscale(docs: list[tuple[int, dict[str, Any]]]) -> dict[str, Any]:
+    """Fleet /debug/autoscale: only the datalayer-owning worker acts (its
+    doc carries the ledger and the live budget); the merged view tags
+    every record with its shard, sums the counters, and keeps each
+    shard's compact row so a non-acting follower is visibly a follower
+    rather than silently empty."""
+    out: dict[str, Any] = {
+        "workers": len(docs),
+        "enabled": any(d.get("enabled") for _, d in docs),
+        "acting_shards": [s for s, d in docs if d.get("acting")],
+        "frozen": any(d.get("frozen") for _, d in docs),
+        "actions_total": sum(d.get("actions_total", 0) for _, d in docs),
+        "refusals_total": sum(d.get("refusals_total", 0) for _, d in docs),
+        "rollbacks_total": sum(d.get("rollbacks_total", 0)
+                               for _, d in docs),
+        "shards": {},
+        "records": [],
+    }
+    for shard, doc in docs:
+        row: dict[str, Any] = {
+            "enabled": doc.get("enabled"),
+            "acting": doc.get("acting"),
+            "actions_total": doc.get("actions_total", 0),
+            "frozen": doc.get("frozen", False),
+        }
+        if doc.get("frozen_reason"):
+            row["frozen_reason"] = doc["frozen_reason"]
+            out["frozen_reason"] = doc["frozen_reason"]
+        if doc.get("fleet_size"):
+            row["fleet_size"] = doc["fleet_size"]
+            out["fleet_size"] = doc["fleet_size"]
+        out["shards"][str(shard)] = row
+        for rec in doc.get("records") or []:
+            out["records"].append({**rec, "shard": shard})
+    out["records"] = sorted(out["records"],
+                            key=lambda r: r.get("t_unix", 0.0),
+                            reverse=True)[:MERGE_RECORDS_TOTAL]
+    return out

@@ -54,6 +54,7 @@ class RawConfig:
     shadow: dict[str, Any]
     rebalance: dict[str, Any]
     forecast: dict[str, Any]
+    autoscale: dict[str, Any]
     tls_client: dict[str, Any]
     pool: dict[str, Any]
     objectives: list[dict[str, Any]]
@@ -144,6 +145,14 @@ class RouterConfig:
     # The engine rides the timeline sampler's tick, so disabling the
     # timeline also silences the forecaster).
     forecast: dict[str, Any]
+    # autoscale: the guarded elastic-fleet actuator knobs
+    # (router/autoscale.py AutoscaleConfig — {enabled, tickS,
+    # sustainTicks, requireLead, maxActionsPerWindow, windowS, dwellS,
+    # observationWindowS, rollbackAttainment, spawnTimeoutS,
+    # drainTimeoutS, minPodsPerRole, maxPodsPerRole, podsPerWorker};
+    # enabled: false (the default) is the kill-switch — no task, zero
+    # ticks, zero actions, bit-identical).
+    autoscale: dict[str, Any]
     # The parsed YAML verbatim: /debug/config serves a redacted view and
     # router_config_info{hash} fingerprints it.
     raw_doc: dict[str, Any]
@@ -185,6 +194,7 @@ def load_raw_config(text: str | None) -> RawConfig:
         shadow=doc.get("shadow") or {},
         rebalance=doc.get("rebalance") or {},
         forecast=doc.get("forecast") or {},
+        autoscale=doc.get("autoscale") or {},
         tls_client=doc.get("tlsClient") or {},
         pool=doc.get("pool") or {},
         objectives=doc.get("objectives") or [],
@@ -417,6 +427,7 @@ def instantiate(raw: RawConfig, handle: Handle,
         shadow=raw.shadow,
         rebalance=raw.rebalance,
         forecast=raw.forecast,
+        autoscale=raw.autoscale,
         raw_doc=raw.doc,
         tls_client=raw.tls_client,
         static_endpoints=static_endpoints,

@@ -11,7 +11,7 @@ from __future__ import annotations
 import dataclasses
 import random
 import time
-from typing import Callable, Iterable
+from typing import Any, Callable, Iterable
 
 from ..framework.datalayer import (
     DRAINING_LABEL,
@@ -286,6 +286,29 @@ class Datastore:
         overrides[ROLE_LABEL] = role
         overrides.pop(DRAINING_LABEL, None)
         return self._republish_labels(address_port, labels)
+
+    def role_census(self) -> dict[str, dict[str, Any]]:
+        """Per-role pod census for the elastic-fleet actuator
+        (router/autoscale.py): pod counts and compact per-pod rows
+        (address, draining mark, current load) grouped by the
+        ``llm-d.ai/role`` routing label. Pods without a role label group
+        under ``""``."""
+        out: dict[str, dict[str, Any]] = {}
+        for ep in self._endpoints.values():
+            role = ep.metadata.labels.get(ROLE_LABEL, "")
+            row = out.setdefault(role, {"total": 0, "ready": 0,
+                                        "pods": []})
+            draining = ep.metadata.labels.get(DRAINING_LABEL) == "true"
+            row["total"] += 1
+            if not draining:
+                row["ready"] += 1
+            row["pods"].append({
+                "address_port": ep.metadata.address_port,
+                "draining": draining,
+                "load": (ep.metrics.running_requests_size
+                         + ep.metrics.waiting_queue_size),
+            })
+        return out
 
     def resync(self, metas: Iterable[EndpointMetadata]) -> None:
         """Replace the endpoint set (pool change / reconciler resync)."""

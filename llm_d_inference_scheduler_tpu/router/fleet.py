@@ -83,6 +83,7 @@ from .metrics import (
     LEADER_ELECTIONS,
     SHARD_REQUESTS,
     SHARD_SNAPSHOT_EPOCH,
+    SHARD_STATE,
     SHARD_UP,
 )
 
@@ -95,6 +96,10 @@ DEFAULT_ADMIN_OFFSET = 1000
 # How long the supervisor waits for every worker's admin plane to answer
 # before declaring the fleet up.
 WORKER_READY_TIMEOUT_S = 30.0
+
+# router_shard_state gauge encoding (docs/metrics.md): a deliberately
+# scaled-in worker must be tellable from a crashed one on the wire.
+_SHARD_STATE_NUM = {"down": 0.0, "up": 1.0, "retiring": 2.0, "retired": 3.0}
 
 # Crash-restart budget per worker: a worker that keeps dying stops being
 # restarted (the shard shows as down in router_shard_up instead of
@@ -187,6 +192,9 @@ class FleetWorkerSpec:
     # the hash balancer's splice (the worker sees the balancer's loopback
     # address, not the client's).
     control_token: str | None = None
+    # Supervisor fan-in admin port: lets the acting worker's autoscale
+    # actuator reach POST /fleet/scale (0 = no supervisor, single-process).
+    sup_admin_port: int = 0
 
     @property
     def runs_datalayer(self) -> bool:
@@ -933,12 +941,25 @@ class FleetAdmin:
                  host: str = "127.0.0.1", port: int = 9081,
                  worker_alive: Callable[[int], bool] | None = None,
                  timeline: Any = None,
-                 fleet_state: Callable[[], dict[str, Any]] | None = None):
+                 fleet_state: Callable[[], dict[str, Any]] | None = None,
+                 worker_state: Callable[[int], str] | None = None,
+                 scale_fn: Callable[[str, int | None], Any] | None = None,
+                 control_token: str | None = None):
         from .timeline import IncidentRecorder, TimelineConfig
 
         self.worker_admin = worker_admin
         self.host, self.port = host, port
         self.worker_alive = worker_alive or (lambda i: True)
+        # Per-shard lifecycle state for health/metrics: up | down |
+        # retiring | retired. Stubs derive it from liveness alone — a
+        # supervisor that scales workers in passes the real state so a
+        # deliberately-retired shard doesn't read as an outage.
+        self.worker_state = worker_state or (
+            lambda i: "up" if self.worker_alive(i) else "down")
+        # Supervisor scale hooks for POST /fleet/scale ("retire"/"restore"
+        # → shard index or None on refusal). Absent on stubs → 501.
+        self.scale_fn = scale_fn
+        self.control_token = control_token
         # Supervisor role/election state for the fan-in surfaces: leader
         # shard (divergence is measured against it), election count,
         # per-worker restart tallies. Stubs default to the static PR 8
@@ -969,7 +990,9 @@ class FleetAdmin:
             web.get("/debug/incidents", self.incidents),
             web.get("/debug/rebalance", self.rebalance),
             web.get("/debug/forecast", self.forecast),
+            web.get("/debug/autoscale", self.autoscale),
             web.get("/debug/config", self.config),
+            web.post("/fleet/scale", self.scale),
         ])
         self._runner: web.AppRunner | None = None
         self._session = None
@@ -1095,6 +1118,8 @@ class FleetAdmin:
         for shard, (status, text) in enumerate(results):
             up = status == 200 and isinstance(text, str)
             SHARD_UP.labels(str(shard)).set(1.0 if up else 0.0)
+            SHARD_STATE.labels(str(shard)).set(
+                _SHARD_STATE_NUM.get(self.worker_state(shard), 0.0))
             if up:
                 families = list(text_string_to_metric_families(text))
                 self._last_families[shard] = families
@@ -1131,13 +1156,21 @@ class FleetAdmin:
         results = await self._fan_out("/health")
         workers = []
         ready = 0
-        all_alive = True
+        all_accounted = True
         for shard, (status, doc) in enumerate(results):
             alive = status != 0 and self.worker_alive(shard)
-            all_alive = all_alive and alive
+            state = self.worker_state(shard)
+            # A shard the actuator deliberately scaled in is ACCOUNTED
+            # FOR, not broken: "retiring" (still draining its flows) and
+            # "retired" (gone on purpose) must not flip fleet readiness
+            # to 503 the way a crashed worker does — else every scale-in
+            # looks like an outage to the probe watching /health.
+            all_accounted = all_accounted and (
+                alive or state in ("retiring", "retired"))
             if status == 200:
                 ready += 1
             workers.append({"shard": shard, "alive": alive,
+                            "state": state,
                             "status": (doc if isinstance(doc, dict)
                                        else None)})
         # A permanently-down shard must surface here, not hide behind the
@@ -1145,7 +1178,7 @@ class FleetAdmin:
         # a dead shard-0 leader freezes every follower's pool view. One
         # transiently-restarting worker flips readiness for a beat — the
         # probe-tolerant kind of honest.
-        ok = ready > 0 and all_alive
+        ok = ready > 0 and all_accounted
         return web.json_response(
             {"status": "ok" if ok else "not-ready",
              "workers_ready": ready, "workers": workers},
@@ -1165,6 +1198,7 @@ class FleetAdmin:
             "elections_total": int(state.get("elections", 0)),
             "admin": [{"shard": i, "host": h, "port": p,
                        "alive": self.worker_alive(i),
+                       "state": self.worker_state(i),
                        "role": "leader" if i == leader else "follower",
                        "restarts": (restarts[i] if i < len(restarts)
                                     else 0)}
@@ -1282,6 +1316,53 @@ class FleetAdmin:
         return web.json_response(merge_forecast(
             [(shard, doc) for shard, (status, doc) in enumerate(results)
              if status == 200 and isinstance(doc, dict)]))
+
+    async def autoscale(self, request: web.Request) -> web.Response:
+        """Fleet /debug/autoscale: the acting shard's actuator ledger
+        (actions, refusals, rollbacks, freeze state) beside every
+        follower's dormant row, shard-tagged and merged newest-first
+        (router/autoscale.py merge_autoscale) — plus the supervisor's
+        own worker states so a scale-in reads end to end."""
+        from .autoscale import merge_autoscale
+
+        results = await self._fan_out("/debug/autoscale")
+        merged = merge_autoscale(
+            [(shard, doc) for shard, (status, doc) in enumerate(results)
+             if status == 200 and isinstance(doc, dict)])
+        merged["worker_states"] = [
+            self.worker_state(i) for i in range(len(self.worker_admin))]
+        return web.json_response(merged)
+
+    async def scale(self, request: web.Request) -> web.Response:
+        """Worker-dimension scale surface for the elastic-fleet actuator:
+        ``{"action": "retire"|"restore", "shard": optional}``. Guarded by
+        the per-run fleet control token (same spoofing argument as
+        /fleet/promote); refusals (leader, last worker) come back 409
+        with the reason so the actuator ledger can record it."""
+        if self.scale_fn is None:
+            return web.json_response(
+                {"error": "no supervisor scale hooks"}, status=501)
+        if (self.control_token
+                and request.headers.get("x-fleet-token")
+                != self.control_token):
+            return web.json_response({"error": "bad token"}, status=403)
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        action = (body or {}).get("action")
+        if action not in ("retire", "restore"):
+            return web.json_response(
+                {"error": "action must be retire|restore"}, status=400)
+        shard = (body or {}).get("shard")
+        shard = int(shard) if shard is not None else None
+        result = self.scale_fn(action, shard)
+        if asyncio.iscoroutine(result):
+            result = await result
+        if result is None:
+            return web.json_response(
+                {"action": action, "refused": True}, status=409)
+        return web.json_response({"action": action, "shard": result})
 
     async def traces(self, request: web.Request) -> web.Response:
         """Cross-shard trace fan-in: every worker's /debug/traces merged,
@@ -1418,10 +1499,30 @@ class HashBalancer:
         self.host, self.port = host, port
         self.targets = targets
         self._server: asyncio.AbstractServer | None = None
+        # Shards the supervisor pulled from rotation (retiring/retired):
+        # NEW connections whose flow hashes there remap onto the alive
+        # set (stable re-hash over the survivors), while splices already
+        # established keep running — that is the drain. An empty set is
+        # the PR 8 behavior bit-for-bit.
+        self.disabled: set[int] = set()
+
+    def disable(self, shard: int) -> None:
+        self.disabled.add(shard)
+
+    def enable(self, shard: int) -> None:
+        self.disabled.discard(shard)
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
             self._handle, self.host, self.port, limit=self.HEAD_MAX)
+
+    def close_listener(self) -> None:
+        """Stop ACCEPTING without tearing down established splices: the
+        first phase of an ordered fleet drain — new connections are
+        refused while in-flight streams keep flowing until the workers
+        finish draining them."""
+        if self._server is not None:
+            self._server.close()
 
     async def stop(self) -> None:
         if self._server is not None:
@@ -1457,9 +1558,22 @@ class HashBalancer:
             except (asyncio.TimeoutError, asyncio.IncompleteReadError,
                     asyncio.LimitOverrunError):
                 return
-            shard = flow_shard(
-                self._flow_id(head, cw.get_extra_info("peername")),
-                len(self.targets))
+            fid = self._flow_id(head, cw.get_extra_info("peername"))
+            shard = flow_shard(fid, len(self.targets))
+            if shard in self.disabled:
+                # Re-hash over the alive shards only: flows owned by a
+                # retiring worker move to a stable survivor; everyone
+                # else keeps their original shard.
+                alive = [i for i in range(len(self.targets))
+                         if i not in self.disabled]
+                if not alive:
+                    cw.write(b"HTTP/1.1 503 Service Unavailable\r\n"
+                             b"content-length: 0\r\n"
+                             b"connection: close\r\n\r\n")
+                    with contextlib.suppress(Exception):
+                        await cw.drain()
+                    return
+                shard = alive[flow_shard(fid, len(alive))]
             FLEET_BALANCER_CONNECTIONS.labels(str(shard)).inc()
             try:
                 ur, uw = await asyncio.open_connection(*self.targets[shard])
@@ -1582,6 +1696,14 @@ class FleetSupervisor:
         # beside a half-promoted follower would split-brain the datalayer
         # with no reconciliation path.
         self._pending_promote: tuple[int, str] | None = None
+        # Elastic-fleet scale-in bookkeeping (ISSUE 17): a shard the
+        # actuator deliberately retires moves up -> retiring (SIGTERM
+        # sent, worker draining its flows) -> retired (process exited on
+        # purpose). The monitor must NOT respawn it, /health must not
+        # read it as an outage, and restore_worker() re-spawns it on a
+        # scale-up.
+        self._retiring: set[int] = set()
+        self._retired: set[int] = set()
         import secrets
 
         self._control_token = secrets.token_hex(16)
@@ -1609,6 +1731,7 @@ class FleetSupervisor:
                 "replication": self.fleet.replication,
                 "kv_checkpoint_s": self.fleet.kv_checkpoint_s,
                 "control_token": self._control_token,
+                "sup_admin_port": self.admin_port,
             },
         }
 
@@ -1625,6 +1748,77 @@ class FleetSupervisor:
     def worker_alive(self, i: int) -> bool:
         p = self._procs[i]
         return p is not None and p.is_alive()
+
+    def worker_state(self, i: int) -> str:
+        """Lifecycle state for the admin plane: ``retiring`` (SIGTERM
+        sent, still draining) and ``retired`` (deliberately gone) are
+        distinct from ``down`` (crashed) — a scale-in is not an
+        outage."""
+        if i in self._retired:
+            return "retired"
+        if i in self._retiring:
+            return "retiring" if self.worker_alive(i) else "retired"
+        return "up" if self.worker_alive(i) else "down"
+
+    def active_workers(self) -> int:
+        """Workers still in rotation: alive and not being drained."""
+        return sum(1 for i in range(self.fleet.workers)
+                   if self.worker_alive(i) and i not in self._retiring
+                   and i not in self._retired)
+
+    def retire_worker(self, shard: int | None = None) -> int | None:
+        """Scale one worker in: pull its NEW flows out of the balancer
+        rotation, then SIGTERM it — run_gateway's drain path flips
+        readiness, waits out in-flight requests (bounded by the drain
+        timeout), and exits. Returns the shard, or None on refusal: the
+        datalayer leader never retires (promote first), nor does the
+        last active worker."""
+        if shard is None:
+            candidates = [i for i in range(self.fleet.workers - 1, -1, -1)
+                          if self.worker_alive(i) and i != self.leader_index
+                          and i not in self._retiring
+                          and i not in self._retired]
+            shard = candidates[0] if candidates else None
+        if (shard is None or shard == self.leader_index
+                or not self.worker_alive(shard)
+                or shard in self._retiring or shard in self._retired
+                or self.active_workers() <= 1):
+            return None
+        self._retiring.add(shard)
+        if self.balancer is not None:
+            self.balancer.disable(shard)
+        self._procs[shard].terminate()  # SIGTERM -> worker-side drain
+        log.info("retiring gateway shard %d (scale-in): flows re-hashed, "
+                 "SIGTERM sent, drain bounded by %.0fs",
+                 shard, self.drain_timeout_s)
+        return shard
+
+    def restore_worker(self, shard: int | None = None) -> int | None:
+        """Scale a retired worker back out: respawn the process (its
+        spec follows CURRENT leadership) and put its hash slice back in
+        rotation. Returns the shard, or None when nothing is retired."""
+        if shard is None:
+            retired = sorted(self._retired
+                             | {i for i in self._retiring
+                                if not self.worker_alive(i)})
+            shard = retired[0] if retired else None
+        if shard is None or self.worker_alive(shard):
+            return None
+        if shard not in self._retired and shard not in self._retiring:
+            return None
+        self._retiring.discard(shard)
+        self._retired.discard(shard)
+        self._spawn(shard)
+        if self.balancer is not None:
+            self.balancer.enable(shard)
+        log.info("restored gateway shard %d (scale-out)", shard)
+        return shard
+
+    def _scale_request(self, action: str, shard: int | None) -> int | None:
+        """POST /fleet/scale dispatch (FleetAdmin scale_fn)."""
+        if action == "retire":
+            return self.retire_worker(shard)
+        return self.restore_worker(shard)
 
     async def start(self) -> None:
         FLEET_WORKERS.set(self.fleet.workers)
@@ -1646,7 +1840,10 @@ class FleetSupervisor:
                     load_raw_config(self.config_text).timeline),
                 fleet_state=lambda: {"leader": self.leader_index,
                                      "elections": self.elections_total,
-                                     "restarts": list(self._restarts)})
+                                     "restarts": list(self._restarts)},
+                worker_state=self.worker_state,
+                scale_fn=self._scale_request,
+                control_token=self._control_token)
             await self.admin.start()
             if self.fleet.balancer == "hash":
                 self.balancer = HashBalancer(
@@ -1814,6 +2011,16 @@ class FleetSupervisor:
                     # fan-in (scrape success implies process alive AND
                     # admin answering); this loop only restarts the dead.
                     alive = self.worker_alive(i)
+                    if not alive and i in self._retiring:
+                        # Deliberate exit, not a crash: the drain
+                        # finished. Settle the state; never respawn.
+                        self._retiring.discard(i)
+                        self._retired.add(i)
+                        log.info("gateway shard %d retired (drain "
+                                 "complete)", i)
+                        continue
+                    if i in self._retired:
+                        continue
                     if alive or self._stopping:
                         continue
                     if (i == self.leader_index
@@ -1844,12 +2051,19 @@ class FleetSupervisor:
         if self._election_session is not None:
             await self._election_session.close()
             self._election_session = None
+        # Ordered drain (supervisor SIGTERM propagates as a graceful
+        # scale-to-zero, not a guillotine): (1) stop ACCEPTING — the
+        # balancer listener closes but established splices keep flowing;
+        # (2) SIGTERM every worker — run_gateway flips readiness and
+        # waits out its in-flight requests bounded by drain_timeout_s;
+        # (3) join, escalating to SIGKILL only past the drain budget;
+        # (4) only THEN tear down the balancer splices and admin plane.
+        # Awaiting balancer.stop() before the workers exit would wait on
+        # (or on older asyncio, silently abandon) splices that are still
+        # carrying live streams — cutting them is exactly the mid-body
+        # client error the drain exists to prevent.
         if self.balancer is not None:
-            await self.balancer.stop()
-            self.balancer = None
-        if self.admin is not None:
-            await self.admin.stop()
-            self.admin = None
+            self.balancer.close_listener()
         for p in self._procs:
             if p is not None and p.is_alive():
                 p.terminate()
@@ -1861,6 +2075,16 @@ class FleetSupervisor:
             if p.is_alive():
                 p.kill()
                 p.join(timeout=5.0)
+        if self.balancer is not None:
+            # Bounded: 3.12+ wait_closed() waits on every handler, and a
+            # client that ignores the worker-side EOF could pin a splice
+            # open forever.
+            with contextlib.suppress(asyncio.TimeoutError):
+                await asyncio.wait_for(self.balancer.stop(), timeout=5.0)
+            self.balancer = None
+        if self.admin is not None:
+            await self.admin.stop()
+            self.admin = None
         if self._ipc_dir is not None:
             shutil.rmtree(self._ipc_dir, ignore_errors=True)
             self._ipc_dir = None

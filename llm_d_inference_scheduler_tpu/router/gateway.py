@@ -71,6 +71,7 @@ from .requestcontrol.director import (
 )
 from .kvobs import H_KV_HIT_BLOCKS, H_KV_HIT_TOKENS, CacheLedger, KvObsConfig
 from .overload import DrainRateEstimator, OverloadConfig, OverloadController
+from .autoscale import ActuatorController, AutoscaleConfig
 from .forecast import ForecastConfig, ForecastEngine
 from .rebalance import RebalanceConfig, RebalanceController
 from .schedpool import LoopLagMonitor, SchedulerPool, SchedulingConfig
@@ -329,6 +330,35 @@ class Gateway:
         self.forecaster = ForecastEngine(fc_cfg, tick_s=tl_cfg.tick_s)
         fc_live = fc_cfg.enabled and tl_cfg.enabled
 
+        # Guarded elastic-fleet actuator (router/autoscale.py): consumes
+        # the rebalancer's sustained, lead-qualified advice and
+        # spawns/retires pods (and workers, when a scaler is wired)
+        # through the preflight/budget/watchdog/rollback pipeline.
+        # Default-OFF kill-switch; the pod launcher is injected by the
+        # embedding harness (bench, tests, a k8s reconciler) — without
+        # one the actuator runs dry (refusals only). In fleet mode only
+        # the datalayer-owning worker acts (promote() arms it).
+        as_cfg = AutoscaleConfig.from_spec(cfg.autoscale)
+        # Worker dimension in fleet mode: the acting worker drives the
+        # supervisor's POST /fleet/scale (token shared via the worker
+        # spec). Single-process or podsPerWorker:0 -> pods only.
+        worker_scaler = None
+        if (as_cfg.enabled and as_cfg.pods_per_worker > 0
+                and fleet is not None
+                and getattr(fleet, "sup_admin_port", 0)):
+            from .autoscale import HttpWorkerScaler
+
+            worker_scaler = HttpWorkerScaler(
+                "127.0.0.1", fleet.sup_admin_port, fleet.control_token)
+        self.autoscaler = ActuatorController(
+            as_cfg,
+            datastore=datastore,
+            advice_fn=self.rebalancer.advice,
+            worker_scaler=worker_scaler,
+            burn_fn=self._burn_tripped,
+            attainment_fn=self._last_attainment,
+            acting=(fleet is None or fleet.runs_datalayer))
+
         self.timeline = TimelineSampler(
             tl_cfg,
             slo_ledger=self.slo_ledger,
@@ -342,7 +372,8 @@ class Gateway:
             decisions_fn=self._recent_bad_decisions,
             shadow=self.shadow_eval if self.shadow_eval.active else None,
             rebalance=self.rebalancer if self.rebalancer.enabled else None,
-            forecast=self.forecaster if fc_live else None)
+            forecast=self.forecaster if fc_live else None,
+            autoscale=self.autoscaler if self.autoscaler.enabled else None)
         if fc_live and self.rebalancer.enabled:
             self.rebalancer.forecast = self.forecaster
 
@@ -375,6 +406,7 @@ class Gateway:
             web.get("/debug/incidents", self.incidents_view),
             web.get("/debug/rebalance", self.rebalance_view),
             web.get("/debug/forecast", self.forecast_view),
+            web.get("/debug/autoscale", self.autoscale_view),
             web.get("/debug/config", self.config_view),
             # Fleet control plane (router/fleet.py, loopback-guarded): the
             # supervisor's leader-election notices — promote this follower
@@ -506,6 +538,8 @@ class Gateway:
         # Self-balancing pool controller (no-op when disabled or when this
         # worker is a fleet follower — promote() arms it on re-election).
         self.rebalancer.start()
+        # Guarded elastic-fleet actuator (kill-switch: no task at all).
+        self.autoscaler.start()
         if self.grpc_health is not None:
             await self.grpc_health.start()
         if self.grpc_ext_proc is not None:
@@ -523,6 +557,7 @@ class Gateway:
         self.loop_lag.stop()
         await self.timeline.stop()
         await self.rebalancer.stop()
+        await self.autoscaler.stop()
         if self._flusher:
             self._flusher.cancel()
         if self.grpc_health is not None:
@@ -681,6 +716,22 @@ class Gateway:
                     break
         return out
 
+    def _burn_tripped(self) -> bool:
+        """The actuator's rollback trigger: is the PR 12 multi-window
+        burn-rate monitor tripped right now? (False under the timeline
+        kill-switch — no monitor, no trigger.)"""
+        if not self.timeline.enabled:
+            return False
+        burn = self.timeline.burn
+        return burn.tripped(*burn.rates())
+
+    def _last_attainment(self) -> float | None:
+        """The most recent timeline tick's SLO attainment (None when the
+        tick had no served arrivals, or under the timeline kill-switch)."""
+        if not self.timeline.enabled or not self.timeline.ring:
+            return None
+        return self.timeline.ring[-1].get("attainment")
+
     async def timeline_view(self, request: web.Request) -> web.Response:
         """Fleet flight recorder history (router/timeline.py): raw ticks
         plus windowed aggregates; ?window_s=N bounds the returned window
@@ -725,6 +776,14 @@ class Gateway:
             joins_n = None
         return web.json_response(self.forecaster.snapshot(
             joins_n=joins_n or None))
+
+    async def autoscale_view(self, request: web.Request) -> web.Response:
+        """Guarded elastic-fleet actuator (router/autoscale.py): the
+        judged action ledger — every action, refusal, timeout, and
+        rollback with its preflight inputs (advice, lead_s, headroom,
+        budgets) and post-hoc outcome — plus the live budget window,
+        breaker states, and the rollback-freeze latch."""
+        return web.json_response(self.autoscaler.snapshot())
 
     async def config_view(self, request: web.Request) -> web.Response:
         """Redacted effective-config snapshot: what config THIS worker
@@ -836,8 +895,10 @@ class Gateway:
         self.fleet.ipc_path = path
         await self._start_snapshot_publisher(path)
         # The promoted worker now owns the datalayer, so the rebalance
-        # controller (if configured) may act on pool metadata.
+        # controller and the elastic-fleet actuator (if configured) may
+        # act on pool metadata.
         self.rebalancer.promote()
+        self.autoscaler.promote()
         return web.json_response({"role": "leader", "ipcPath": path})
 
     async def fleet_retarget(self, request: web.Request) -> web.Response:

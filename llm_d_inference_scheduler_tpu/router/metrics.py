@@ -400,6 +400,28 @@ KV_INDEX_RESYNCS = Counter(
     "gap (dropped frame, leader change, reconnect) was detected and the "
     "replica waited for the next full-index checkpoint instead of "
     "applying deltas onto an uncertain base", registry=REGISTRY)
+# Guarded elastic-fleet actuator (router/autoscale.py): every guarded
+# action's terminal verdict, the rollback-freeze latch, and the live fleet
+# census the actuator is steering.
+AUTOSCALE_ACTIONS = Counter(
+    "router_autoscale_actions",
+    "Guarded actuator actions by terminal outcome (completed / refused / "
+    "aborted / rolled_back) per kind (spawn_pod / retire_pod / "
+    "spawn_worker / retire_worker) — refusals are deduplicated per "
+    "sustained reason episode in the /debug/autoscale ledger but counted "
+    "here per tick", ("kind", "outcome"), registry=REGISTRY)
+AUTOSCALE_FROZEN = Gauge(
+    "router_autoscale_frozen",
+    "1 while the actuator is frozen by rollback-on-incident (a burn-rate "
+    "trip or attainment collapse inside a post-action observation window "
+    "reversed the last action and latched this until operator reset)",
+    registry=REGISTRY)
+FLEET_SIZE = Gauge(
+    "router_fleet_size",
+    "Live fleet census per role as the actuator sees it: engine pods per "
+    "routing role (prefill / decode, draining included) plus the active "
+    "gateway worker count under role=\"worker\" when worker scaling is "
+    "wired", ("role",), registry=REGISTRY)
 # Multi-process sharded gateway (router/fleet.py): each worker exposes the
 # pool-snapshot epoch it last built (leader) or applied from the IPC stream
 # (follower) — the supervisor re-labels it per shard, making snapshot-IPC
@@ -425,6 +447,13 @@ SHARD_UP = Gauge(
     "router_shard_up",
     "Per-shard worker liveness as seen by the fleet supervisor (1 = the "
     "worker process is alive and its admin plane answers)",
+    ("shard",), registry=FLEET_REGISTRY)
+SHARD_STATE = Gauge(
+    "router_shard_state",
+    "Per-shard lifecycle state companion to router_shard_up, so a worker "
+    "retired ON PURPOSE by the scale-in path is distinguishable from a "
+    "crashed one (0 = down/crashed, 1 = up, 2 = retiring — draining its "
+    "flows before exit, 3 = retired — deliberately scaled in)",
     ("shard",), registry=FLEET_REGISTRY)
 SHARD_SNAPSHOT_EPOCH = Gauge(
     "router_shard_snapshot_epoch",

@@ -285,6 +285,11 @@ class RebalanceController:
     def active_count(self) -> int:
         return len(self._active)
 
+    def advice(self) -> dict[str, dict[str, Any]]:
+        """The live per-role scale advice (the /debug/rebalance advice
+        block) — the elastic-fleet actuator's input feed."""
+        return self._advice
+
     def start(self) -> None:
         if not self.cfg.enabled or not self.acting or self._task is not None:
             return

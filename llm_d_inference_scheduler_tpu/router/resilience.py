@@ -360,9 +360,9 @@ class Deadline:
 
 @dataclasses.dataclass
 class FaultRule:
-    kind: str          # "reset" | "http503" | "delay" | "stall"
+    kind: str          # see FaultInjector.KINDS
     pct: float         # 0..100 of request-id hash space
-    arg: float = 0.0   # delay/stall: milliseconds
+    arg: float = 0.0   # delay/stall: ms; slow_start: ms; stall_drain: count
 
 
 class FaultInjector:
@@ -371,9 +371,21 @@ class FaultInjector:
     fault, so chaos tests are hermetic and re-runnable. Spec grammar:
     comma-separated ``kind:pct[:arg]`` — e.g.
     ``"reset:50,http503:25,delay:100:250,stall:25:10"``. First matching rule
-    wins. ``triggered`` counts firings per kind (test observability)."""
+    wins. ``triggered`` counts firings per kind (test observability).
 
-    KINDS = ("reset", "http503", "delay", "stall")
+    The request-plane kinds (reset/http503/delay/stall) decide per
+    request id. The LIFECYCLE kinds drill the elastic-fleet actuator
+    (ISSUE 17) and decide per pod identity instead — ``spawn_fail``
+    makes the engine's listener raise at startup, ``slow_start`` holds
+    /health at 503 for ``arg`` ms after boot (spawn-watchdog food), and
+    ``stall_drain`` pins ``arg`` phantom running requests in the metrics
+    exposition so a drain never observes empty (stuck-drain watchdog
+    food). Same stable-hash determinism: one (seed, kind, pod) always
+    decides the same way."""
+
+    KINDS = ("reset", "http503", "delay", "stall",
+             "spawn_fail", "slow_start", "stall_drain")
+    LIFECYCLE_KINDS = ("spawn_fail", "slow_start", "stall_drain")
 
     def __init__(self, rules: list[FaultRule], seed: int = 0):
         self.rules = rules
@@ -404,7 +416,27 @@ class FaultInjector:
         if not self.enabled:
             return None
         for rule in self.rules:
+            if rule.kind in self.LIFECYCLE_KINDS:
+                # Lifecycle rules key on pod identity, not request ids —
+                # a spawn_fail rule must not also eat request traffic.
+                continue
             h = zlib.crc32(f"{self.seed}:{rule.kind}:{request_id}".encode()) % 10000
+            if h < rule.pct * 100:
+                self.triggered[rule.kind] += 1
+                return rule
+        return None
+
+    def decide_lifecycle(self, kind: str, pod_id: str) -> FaultRule | None:
+        """Per-pod decision for the lifecycle kinds: same stable hash,
+        keyed on the pod's identity (its address:port) so a chaos run
+        fails the SAME spawns every time under a fixed seed."""
+        if not self.enabled:
+            return None
+        for rule in self.rules:
+            if rule.kind != kind:
+                continue
+            h = zlib.crc32(
+                f"{self.seed}:{rule.kind}:{pod_id}".encode()) % 10000
             if h < rule.pct * 100:
                 self.triggered[rule.kind] += 1
                 return rule

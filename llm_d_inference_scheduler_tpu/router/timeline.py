@@ -476,7 +476,8 @@ class _Baseline:
     __slots__ = ("requests", "met", "shed", "out_tokens",
                  "good_tokens", "prompt_tokens", "degraded", "kv_stamps",
                  "kv_joins", "gc_pause_s", "by_role",
-                 "shadow_eval", "shadow_div", "shadow_regret", "flips")
+                 "shadow_eval", "shadow_div", "shadow_regret", "flips",
+                 "as_actions", "as_refusals", "as_rollbacks")
 
     def __init__(self):
         self.requests = 0
@@ -494,6 +495,9 @@ class _Baseline:
         self.shadow_div = 0
         self.shadow_regret = 0.0
         self.flips = 0
+        self.as_actions = 0
+        self.as_refusals = 0
+        self.as_rollbacks = 0
 
 
 class TimelineSampler:
@@ -526,6 +530,7 @@ class TimelineSampler:
                  shadow: Any = None,
                  rebalance: Any = None,
                  forecast: Any = None,
+                 autoscale: Any = None,
                  wall: Callable[[], float] = time.time):
         self.cfg = cfg
         self.slo_ledger = slo_ledger
@@ -548,6 +553,10 @@ class TimelineSampler:
         # engine has no task of its own, so it inherits the grid
         # alignment that makes fleet buckets comparable.
         self.forecast = forecast
+        # Elastic-fleet actuator (router/autoscale.py): flat counter
+        # deltas + the freeze latch, so a scaling action (or rollback)
+        # lands in the same ring tick as the traffic swing it answered.
+        self.autoscale = autoscale
         self._wall = wall
         self.ring: deque[dict[str, Any]] = deque(maxlen=cfg.ring_capacity)
         self.burn = BurnRateMonitor(cfg)
@@ -761,6 +770,21 @@ class TimelineSampler:
             if rb.last_headroom:
                 row["headroom"] = dict(rb.last_headroom)
             sample["rebalance"] = row
+
+        # Elastic-fleet actuator (router/autoscale.py): action/refusal/
+        # rollback deltas + the freeze latch — flat reads, the controller
+        # owns the guard pipeline.
+        ac = self.autoscale
+        if ac is not None and ac.enabled:
+            row = {"actions": ac.actions_total - prev.as_actions,
+                   "refusals": ac.refusals_total - prev.as_refusals,
+                   "rollbacks": ac.rollbacks_total - prev.as_rollbacks}
+            prev.as_actions = ac.actions_total
+            prev.as_refusals = ac.refusals_total
+            prev.as_rollbacks = ac.rollbacks_total
+            if ac.frozen:
+                row["frozen"] = True
+            sample["autoscale"] = row
 
         # Process self-telemetry (gauges + the timeline series). The /proc
         # reads are real syscalls (~15-25µs together), so they run every

@@ -122,6 +122,13 @@ REQUIRED_FAMILIES = {
     ("router_forecast_gap_skips", "router"),
     ("router_time_to_saturation_seconds", "router"),
     ("router_pool_advice_changes", "router"),
+    # Guarded elastic-fleet actuator (ISSUE 17): the action/outcome
+    # ledger counter, the rollback freeze latch, the live per-role pod
+    # count, and the supervisor's per-shard lifecycle state gauge.
+    ("router_autoscale_actions", "router"),
+    ("router_autoscale_frozen", "router"),
+    ("router_fleet_size", "router"),
+    ("router_shard_state", "fleet"),
 }
 
 # Registries whose every family must have a docs/metrics.md row (the

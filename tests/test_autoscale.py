@@ -1,0 +1,1052 @@
+"""Guarded elastic-fleet actuator (router/autoscale.py): config parsing,
+the kill-switch, the preflight pipeline (sustain, lead, bounds, breaker,
+budget, dwell — the advice-flap hysteresis), the spawn/retire state
+machines with their watchdogs, rollback-on-incident + freeze, post-hoc
+outcome judging, the worker dimension, the fleet fan-in + /fleet/scale
+surface, the supervisor retiring state machine (scale-in is not an
+outage), and the lifecycle chaos kinds feeding the drills.
+"""
+
+import asyncio
+
+import httpx
+import pytest
+from aiohttp import web
+
+from llm_d_inference_scheduler_tpu.router.autoscale import (
+    ABORTED,
+    COMPLETED,
+    REFUSED,
+    RETIRE_POD,
+    RETIRE_WORKER,
+    ROLLED_BACK,
+    SPAWN_POD,
+    ActuatorController,
+    AutoscaleConfig,
+    SpawnHandle,
+    merge_autoscale,
+)
+from llm_d_inference_scheduler_tpu.router.datalayer.datastore import Datastore
+from llm_d_inference_scheduler_tpu.router.framework.datalayer import (
+    DRAINING_LABEL,
+    ROLE_LABEL,
+    EndpointMetadata,
+)
+from llm_d_inference_scheduler_tpu.router.resilience import FaultInjector
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+def _pool(ds: Datastore, spec: dict[str, str]) -> None:
+    for addr, role in spec.items():
+        host, _, port = addr.rpartition(":")
+        ds.endpoint_add_or_update(EndpointMetadata(
+            name=addr, address=host, port=int(port),
+            labels={ROLE_LABEL: role}))
+
+
+def _scrape(ds: Datastore, addr: str, *, at: float, waiting: int = 0,
+            running: int = 0) -> None:
+    ep = ds.endpoint_get(addr)
+    ep.metrics.update_time = at
+    ep.metrics.waiting_queue_size = waiting
+    ep.metrics.running_requests_size = running
+
+
+class StubLauncher:
+    """Registers the spawned pod DRAINING (the launcher contract) and
+    deletes on retire; ``fail`` makes spawn return a failed handle."""
+
+    def __init__(self, ds: Datastore, *, fail: bool = False):
+        self.ds = ds
+        self.fail = fail
+        self.spawned: list[str] = []
+        self.retired: list[str] = []
+        self._next = 50
+
+    def spawn(self, role: str) -> SpawnHandle:
+        h = SpawnHandle()
+        if self.fail:
+            h.state = "failed"
+            h.error = "chaos spawn_fail"
+            return h
+        addr = f"10.0.0.{self._next}:8000"
+        self._next += 1
+        self.ds.endpoint_add_or_update(EndpointMetadata(
+            name=addr, address=addr.rsplit(":", 1)[0], port=8000,
+            labels={ROLE_LABEL: role, DRAINING_LABEL: "true"}))
+        h.state = "ok"
+        h.address_port = addr
+        self.spawned.append(addr)
+        return h
+
+    def retire(self, address_port: str) -> None:
+        self.retired.append(address_port)
+        self.ds.endpoint_delete(address_port)
+
+
+class StubScaler:
+    def __init__(self, active: int = 3, provisioned: int = 3, *,
+                 refuse: bool = False):
+        self.active = active
+        self.provisioned = provisioned
+        self.refuse = refuse
+        self.calls: list[str] = []
+
+    def counts(self):
+        return self.active, self.provisioned
+
+    def retire(self):
+        self.calls.append("retire")
+        if self.refuse or self.active <= 1:
+            return None
+        self.active -= 1
+        return str(self.active)
+
+    def restore(self):
+        self.calls.append("restore")
+        if self.refuse or self.active >= self.provisioned:
+            return None
+        self.active += 1
+        return str(self.active - 1)
+
+
+def _ctrl(ds, clock, *, launcher=None, scaler=None, burn=None, att=None,
+          **over):
+    cfg = AutoscaleConfig(
+        enabled=True, tick_s=1.0, sustain_ticks=2, require_lead=True,
+        max_actions_per_window=4, window_s=300.0, dwell_s=60.0,
+        observation_window_s=30.0, spawn_timeout_s=10.0,
+        drain_timeout_s=10.0, max_pods_per_role=8)
+    for k, v in over.items():
+        setattr(cfg, k, v)
+    advice: dict = {}
+    c = ActuatorController(
+        cfg, datastore=ds, advice_fn=lambda: advice, launcher=launcher,
+        worker_scaler=scaler, burn_fn=burn, attainment_fn=att,
+        clock=clock, wall=lambda: clock.t)
+    return c, advice
+
+
+def _up(lead=60.0, headroom=-0.1):
+    return {"direction": "up", "why": "headroom below target",
+            "headroom": headroom, "lead_s": lead}
+
+
+def _down(headroom=0.8):
+    return {"direction": "down", "why": "surplus headroom",
+            "headroom": headroom}
+
+
+class TestConfig:
+    def test_defaults_off(self):
+        cfg = AutoscaleConfig.from_spec(None)
+        assert cfg.enabled is False
+        assert cfg.sustain_ticks == 3
+        assert cfg.require_lead is True
+        assert cfg.pods_per_worker == 0
+
+    def test_spec_roundtrip(self):
+        cfg = AutoscaleConfig.from_spec({
+            "enabled": True, "tickS": 0.5, "sustainTicks": 5,
+            "requireLead": False, "maxActionsPerWindow": 2,
+            "windowS": 120, "dwellS": 30, "observationWindowS": 15,
+            "rollbackAttainment": 0.7, "spawnTimeoutS": 12,
+            "drainTimeoutS": 8, "minPodsPerRole": 2, "maxPodsPerRole": 6,
+            "podsPerWorker": 4, "minWorkers": 2})
+        assert (cfg.tick_s, cfg.sustain_ticks) == (0.5, 5)
+        assert cfg.require_lead is False
+        assert (cfg.max_actions_per_window, cfg.window_s) == (2, 120.0)
+        assert (cfg.min_pods_per_role, cfg.max_pods_per_role) == (2, 6)
+        assert (cfg.pods_per_worker, cfg.min_workers) == (4, 2)
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            AutoscaleConfig.from_spec({"tickS": 0})
+        with pytest.raises(ValueError):
+            AutoscaleConfig.from_spec({"windowS": -1})
+        with pytest.raises(ValueError):
+            AutoscaleConfig.from_spec({"minPodsPerRole": 4,
+                                       "maxPodsPerRole": 2})
+        with pytest.raises(ValueError):
+            AutoscaleConfig.from_spec({"rollbackAttainment": 1.5})
+
+
+class TestKillSwitch:
+    def test_disabled_is_bit_identical(self):
+        ds = Datastore()
+        _pool(ds, {"10.0.0.1:8000": "decode"})
+        clock = FakeClock()
+        c = ActuatorController(AutoscaleConfig(enabled=False),
+                               datastore=ds, clock=clock,
+                               wall=lambda: clock.t)
+        for _ in range(10):
+            c.tick()
+            clock.advance(1.0)
+        assert c.ticks_total == 0
+        assert c.actions_total == 0
+        assert c.snapshot()["records"] == []
+
+    def test_non_acting_follower_is_inert(self):
+        ds = Datastore()
+        clock = FakeClock()
+        c, advice = _ctrl(ds, clock, launcher=StubLauncher(ds))
+        c.acting = False
+        advice["decode"] = _up()
+        for _ in range(5):
+            c.tick()
+            clock.advance(1.0)
+        assert c.ticks_total == 0 and c.actions_total == 0
+
+
+class TestHysteresis:
+    """Satellite: flapping advice produces ZERO actions; sustained advice
+    with positive lead produces EXACTLY ONE."""
+
+    def test_flapping_advice_zero_actions(self):
+        ds = Datastore()
+        _pool(ds, {"10.0.0.1:8000": "decode", "10.0.0.2:8000": "decode",
+                   "10.0.0.3:8000": "prefill"})
+        clock = FakeClock()
+        c, advice = _ctrl(ds, clock, launcher=StubLauncher(ds),
+                          sustain_ticks=3)
+        for i in range(30):     # oscillate every tick: up, down, up, ...
+            advice["decode"] = _up() if i % 2 == 0 else _down()
+            c.tick()
+            clock.advance(1.0)
+        assert c.actions_total == 0
+        assert c.refusals_total > 0
+        kinds = {r["state"] for r in c.snapshot()["records"]}
+        assert kinds == {REFUSED}
+
+    def test_sustained_advice_exactly_one_action(self):
+        ds = Datastore()
+        _pool(ds, {"10.0.0.1:8000": "decode", "10.0.0.2:8000": "prefill"})
+        clock = FakeClock()
+        c, advice = _ctrl(ds, clock, launcher=StubLauncher(ds),
+                          sustain_ticks=3)
+        advice["decode"] = _up(lead=45.0)
+        for _ in range(6):
+            c.tick()
+            clock.advance(1.0)
+        # One spawn started (then the controller serializes on it).
+        assert c.actions_total == 1
+        pending = c.snapshot()["pending"]
+        assert pending["kind"] == SPAWN_POD and pending["role"] == "decode"
+        assert pending["inputs"]["lead_s"] == 45.0
+
+    def test_refusal_dedup_bumps_count(self):
+        ds = Datastore()
+        _pool(ds, {"10.0.0.1:8000": "decode"})
+        clock = FakeClock()
+        c, advice = _ctrl(ds, clock, launcher=StubLauncher(ds),
+                          sustain_ticks=100)
+        advice["decode"] = _up()
+        for _ in range(7):
+            c.tick()
+            clock.advance(1.0)
+        recs = [r for r in c.snapshot()["records"]
+                if r["state"] == REFUSED]
+        assert len(recs) == 1           # deduped, not one per tick
+        assert recs[0]["count"] == 7
+        assert c.refusals_total == 7
+
+
+class TestPreflight:
+    def test_scale_up_requires_positive_lead(self):
+        ds = Datastore()
+        _pool(ds, {"10.0.0.1:8000": "decode"})
+        clock = FakeClock()
+        c, advice = _ctrl(ds, clock, launcher=StubLauncher(ds))
+        advice["decode"] = {"direction": "up", "why": "w",
+                            "headroom": -0.1, "lead_s": None}
+        for _ in range(4):
+            c.tick()
+            clock.advance(1.0)
+        assert c.actions_total == 0
+        rec = c.snapshot()["records"][0]
+        assert "lead" in rec["why"]
+        # requireLead: false acts on sustain alone.
+        c2, advice2 = _ctrl(ds, clock, launcher=StubLauncher(ds),
+                            require_lead=False)
+        advice2["decode"] = {"direction": "up", "why": "w",
+                             "headroom": -0.1, "lead_s": None}
+        for _ in range(3):
+            c2.tick()
+            clock.advance(1.0)
+        assert c2.actions_total == 1
+
+    def test_never_retire_last_pod(self):
+        ds = Datastore()
+        _pool(ds, {"10.0.0.1:8000": "decode", "10.0.0.2:8000": "prefill"})
+        clock = FakeClock()
+        c, advice = _ctrl(ds, clock, launcher=StubLauncher(ds))
+        advice["decode"] = _down()
+        for _ in range(5):
+            c.tick()
+            clock.advance(1.0)
+        assert c.actions_total == 0
+        assert "last pod" in c.snapshot()["records"][0]["why"]
+
+    def test_max_pods_bound(self):
+        ds = Datastore()
+        _pool(ds, {f"10.0.0.{i}:8000": "decode" for i in range(1, 4)})
+        clock = FakeClock()
+        c, advice = _ctrl(ds, clock, launcher=StubLauncher(ds),
+                          max_pods_per_role=3)
+        advice["decode"] = _up()
+        for _ in range(5):
+            c.tick()
+            clock.advance(1.0)
+        assert c.actions_total == 0
+        assert "maxPodsPerRole" in c.snapshot()["records"][0]["why"]
+
+    def test_dry_run_without_launcher(self):
+        ds = Datastore()
+        _pool(ds, {"10.0.0.1:8000": "decode"})
+        clock = FakeClock()
+        c, advice = _ctrl(ds, clock, launcher=None)
+        advice["decode"] = _up()
+        for _ in range(4):
+            c.tick()
+            clock.advance(1.0)
+        assert c.actions_total == 0
+        assert "dry-run" in c.snapshot()["records"][0]["why"]
+
+    def test_budget_and_dwell(self):
+        ds = Datastore()
+        _pool(ds, {"10.0.0.1:8000": "decode", "10.0.0.2:8000": "decode",
+                   "10.0.0.3:8000": "decode", "10.0.0.4:8000": "prefill"})
+        clock = FakeClock()
+        launcher = StubLauncher(ds)
+        c, advice = _ctrl(ds, clock, launcher=launcher, sustain_ticks=1,
+                          require_lead=False, max_actions_per_window=1,
+                          window_s=100.0, dwell_s=200.0)
+        advice["decode"] = _down()
+        c.tick()
+        assert c.actions_total == 1          # retire started
+        addr = c.snapshot()["pending"]["target"]
+        clock.advance(1.0)
+        _scrape(ds, addr, at=clock.t)        # drained -> completes
+        advice["decode"] = {"direction": "hold", "why": "ok"}
+        c.tick()
+        assert launcher.retired == [addr]
+        # Budget: a second action inside the window refuses.
+        advice["decode"] = _up()
+        clock.advance(1.0)
+        c.tick()
+        c.tick()
+        assert c.actions_total == 1
+        assert "budget exhausted" in c.snapshot()["records"][0]["why"]
+        # Window expires but the OPPOSING action still sits out dwellS.
+        clock.advance(150.0)
+        c.tick()
+        assert c.actions_total == 1
+        assert "dwell" in c.snapshot()["records"][0]["why"]
+        # Past the dwell it acts.
+        clock.advance(60.0)
+        c.tick()
+        assert c.actions_total == 2
+        assert c.snapshot()["pending"]["kind"] == SPAWN_POD
+
+
+class TestSpawnStateMachine:
+    def test_spawn_completes_after_first_scrape(self):
+        ds = Datastore()
+        _pool(ds, {"10.0.0.1:8000": "decode"})
+        clock = FakeClock()
+        launcher = StubLauncher(ds)
+        c, advice = _ctrl(ds, clock, launcher=launcher, sustain_ticks=1)
+        advice["decode"] = _up()
+        c.tick()
+        addr = launcher.spawned[0]
+        ep = ds.endpoint_get(addr)
+        assert ep.metadata.labels.get(DRAINING_LABEL) == "true"
+        # No scrape yet: stays pending (not pick-eligible).
+        clock.advance(1.0)
+        c.tick()
+        assert c.snapshot()["pending"]["kind"] == SPAWN_POD
+        # First scrape lands: draining clears, action completes.
+        _scrape(ds, addr, at=clock.t)
+        advice["decode"] = {"direction": "hold", "why": "ok"}
+        clock.advance(1.0)
+        c.tick()
+        doc = c.snapshot()
+        assert "pending" not in doc
+        ep = ds.endpoint_get(addr)
+        assert DRAINING_LABEL not in (ep.metadata.labels or {})
+        rec = doc["records"][0]
+        assert (rec["state"], rec["target"]) == (COMPLETED, addr)
+
+    def test_spawn_failure_aborts_and_opens_breaker(self):
+        ds = Datastore()
+        _pool(ds, {"10.0.0.1:8000": "decode"})
+        clock = FakeClock()
+        c, advice = _ctrl(ds, clock, launcher=StubLauncher(ds, fail=True),
+                          sustain_ticks=1, breaker_failure_threshold=2)
+        advice["decode"] = _up()
+        c.tick()                              # spawn #1 starts
+        clock.advance(1.0)
+        c.tick()                              # abort #1, spawn #2 starts
+        aborted = [r for r in c.snapshot()["records"]
+                   if r["state"] == ABORTED]
+        assert len(aborted) == 1
+        assert "spawn failed" in aborted[0]["why"]
+        clock.advance(1.0)
+        c.tick()                              # abort #2 -> breaker opens
+        doc = c.snapshot()
+        assert doc["breakers"] == {"pod:decode": "open"}
+        assert "circuit open" in doc["records"][0]["why"]
+        assert len([r for r in doc["records"]
+                    if r["state"] == ABORTED]) == 2
+
+    def test_spawn_timeout_watchdog_cleans_up(self):
+        ds = Datastore()
+        _pool(ds, {"10.0.0.1:8000": "decode"})
+        clock = FakeClock()
+        launcher = StubLauncher(ds)
+        c, advice = _ctrl(ds, clock, launcher=launcher, sustain_ticks=1,
+                          spawn_timeout_s=5.0)
+        advice["decode"] = _up()
+        c.tick()
+        addr = launcher.spawned[0]
+        advice["decode"] = {"direction": "hold", "why": "ok"}
+        clock.advance(6.0)                    # never scraped
+        c.tick()
+        rec = c.snapshot()["records"][0]
+        assert rec["state"] == ABORTED and rec["watchdog"] is True
+        assert launcher.retired == [addr]     # half-made pod torn down
+        assert c.watchdog_total == 1
+
+
+class TestRetireStateMachine:
+    def test_retire_drains_then_tears_down(self):
+        ds = Datastore()
+        _pool(ds, {"10.0.0.1:8000": "decode", "10.0.0.2:8000": "decode",
+                   "10.0.0.3:8000": "prefill"})
+        _scrape(ds, "10.0.0.1:8000", at=900.0, running=0)
+        _scrape(ds, "10.0.0.2:8000", at=900.0, running=3)
+        clock = FakeClock()
+        launcher = StubLauncher(ds)
+        c, advice = _ctrl(ds, clock, launcher=launcher, sustain_ticks=1)
+        advice["decode"] = _down()
+        c.tick()
+        # Victim is the least-loaded decode pod, marked draining.
+        ep = ds.endpoint_get("10.0.0.1:8000")
+        assert ep.metadata.labels.get(DRAINING_LABEL) == "true"
+        # Still has queued work at the next scrape: not yet torn down.
+        _scrape(ds, "10.0.0.1:8000", at=clock.advance(1.0), running=1)
+        c.tick()
+        assert launcher.retired == []
+        # Drains empty: teardown.
+        _scrape(ds, "10.0.0.1:8000", at=clock.advance(1.0))
+        advice["decode"] = {"direction": "hold", "why": "ok"}
+        c.tick()
+        assert launcher.retired == ["10.0.0.1:8000"]
+        assert c.snapshot()["records"][0]["state"] == COMPLETED
+
+    def test_completed_retire_refreshes_census_same_tick(self):
+        # Regression: the census is taken AFTER _advance_pending. A
+        # retire that completes at the top of a tick deletes its
+        # endpoint; the preflight for any follow-up action that same
+        # tick must see the post-teardown pool — a stale census once
+        # let sustained down-advice retire the genuinely last pod.
+        ds = Datastore()
+        _pool(ds, {"10.0.0.1:8000": "decode", "10.0.0.2:8000": "decode"})
+        _scrape(ds, "10.0.0.1:8000", at=900.0, running=0)
+        _scrape(ds, "10.0.0.2:8000", at=900.0, running=3)
+        clock = FakeClock()
+        launcher = StubLauncher(ds)
+        c, advice = _ctrl(ds, clock, launcher=launcher, sustain_ticks=1)
+        advice["decode"] = _down()
+        c.tick()
+        assert c.snapshot()["pending"]["target"] == "10.0.0.1:8000"
+        # Drained: the next tick completes the retire AND, with advice
+        # still down, immediately considers another one.
+        _scrape(ds, "10.0.0.1:8000", at=clock.advance(1.0), running=0)
+        c.tick()
+        assert launcher.retired == ["10.0.0.1:8000"]
+        snap = c.snapshot()
+        assert snap.get("pending") is None
+        survivor = ds.endpoint_get("10.0.0.2:8000")
+        assert survivor is not None
+        assert survivor.metadata.labels.get(DRAINING_LABEL) != "true"
+        assert any(r["state"] == REFUSED and "last pod" in r["why"]
+                   for r in snap["records"])
+
+    def test_stuck_drain_force_finalized(self):
+        ds = Datastore()
+        _pool(ds, {"10.0.0.1:8000": "decode", "10.0.0.2:8000": "decode",
+                   "10.0.0.3:8000": "prefill"})
+        clock = FakeClock()
+        launcher = StubLauncher(ds)
+        c, advice = _ctrl(ds, clock, launcher=launcher, sustain_ticks=1,
+                          drain_timeout_s=5.0,
+                          breaker_failure_threshold=1)
+        advice["decode"] = _down()
+        c.tick()
+        addr = c.snapshot()["pending"]["target"]
+        # The chaos stall_drain shape: scrapes keep showing running work
+        # (until the watchdog tears the pod down and it vanishes).
+        for _ in range(7):
+            clock.advance(1.0)
+            if ds.endpoint_get(addr) is not None:
+                _scrape(ds, addr, at=clock.t, running=2)
+            c.tick()
+        rec = [r for r in c.snapshot()["records"]
+               if r["kind"] == RETIRE_POD and r["state"] == COMPLETED][0]
+        assert rec["drain_timed_out"] is True and rec["watchdog"] is True
+        assert launcher.retired == [addr]     # torn down anyway
+        assert c.watchdog_total == 1
+        assert c._breaker("pod:decode").state == "open"
+
+
+class TestRollback:
+    def test_burn_trip_reverses_and_freezes(self):
+        ds = Datastore()
+        _pool(ds, {"10.0.0.1:8000": "decode", "10.0.0.2:8000": "prefill"})
+        clock = FakeClock()
+        launcher = StubLauncher(ds)
+        tripped = {"burn": False}
+        c, advice = _ctrl(ds, clock, launcher=launcher, sustain_ticks=1,
+                          burn=lambda: tripped["burn"])
+        advice["decode"] = _up()
+        c.tick()
+        addr = launcher.spawned[0]
+        _scrape(ds, addr, at=clock.advance(1.0))
+        advice["decode"] = {"direction": "hold", "why": "ok"}
+        c.tick()                              # spawn completed, observing
+        tripped["burn"] = True
+        clock.advance(1.0)
+        c.tick()                              # rollback fires
+        doc = c.snapshot()
+        assert doc["frozen"] is True
+        assert "burn-rate" in doc["frozen_reason"]
+        assert c.rollbacks_total == 1
+        rolled = [r for r in doc["records"]
+                  if r["state"] == ROLLED_BACK]
+        assert rolled and rolled[0]["kind"] == SPAWN_POD
+        # The reverse action (retire of the spawned pod) is in flight...
+        assert doc["pending"]["kind"] == RETIRE_POD
+        assert doc["pending"]["target"] == addr
+        assert doc["pending"]["rollback_of"] == rolled[0]["id"]
+        # ...and completes once the pod drains.
+        _scrape(ds, addr, at=clock.advance(1.0))
+        c.tick()
+        assert launcher.retired == [addr]
+        # Frozen: new advice only refuses.
+        advice["decode"] = _up()
+        for _ in range(4):
+            clock.advance(1.0)
+            c.tick()
+        assert "frozen" in c.snapshot()["records"][0]["why"]
+        c.unfreeze()
+        assert c.snapshot()["frozen"] is False
+
+    def test_attainment_collapse_triggers_rollback(self):
+        ds = Datastore()
+        _pool(ds, {"10.0.0.1:8000": "decode", "10.0.0.2:8000": "prefill"})
+        clock = FakeClock()
+        launcher = StubLauncher(ds)
+        att = {"v": None}
+        c, advice = _ctrl(ds, clock, launcher=launcher, sustain_ticks=1,
+                          att=lambda: att["v"], rollback_attainment=0.5)
+        advice["decode"] = _up()
+        c.tick()
+        _scrape(ds, launcher.spawned[0], at=clock.advance(1.0))
+        advice["decode"] = {"direction": "hold", "why": "ok"}
+        c.tick()
+        att["v"] = 0.2                        # collapse inside the window
+        clock.advance(1.0)
+        c.tick()
+        assert c.snapshot()["frozen"] is True
+        assert "attainment" in c.snapshot()["frozen_reason"]
+
+    def test_quiet_observation_window_judges_outcome(self):
+        ds = Datastore()
+        _pool(ds, {"10.0.0.1:8000": "decode", "10.0.0.2:8000": "prefill"})
+        clock = FakeClock()
+        launcher = StubLauncher(ds)
+        c, advice = _ctrl(ds, clock, launcher=launcher, sustain_ticks=1,
+                          observation_window_s=10.0)
+        advice["decode"] = _up(headroom=-0.2)
+        c.tick()
+        _scrape(ds, launcher.spawned[0], at=clock.advance(1.0))
+        advice["decode"] = {"direction": "hold", "why": "ok"}
+        c.tick()
+        # Window passes quietly; realized headroom improved.
+        advice["decode"] = {"direction": "hold", "why": "ok",
+                            "headroom": 0.3}
+        clock.advance(15.0)
+        c.tick()
+        rec = [r for r in c.snapshot()["records"]
+               if r["kind"] == SPAWN_POD][0]
+        assert rec["state"] == COMPLETED
+        assert rec["outcome"] == "improved"
+        assert rec["realized_headroom"] == 0.3
+        assert c.snapshot()["frozen"] is False
+
+
+class TestWorkerDimension:
+    def test_worker_count_tracks_pods(self):
+        ds = Datastore()
+        _pool(ds, {f"10.0.0.{i}:8000": "decode" for i in range(1, 5)})
+        clock = FakeClock()
+        scaler = StubScaler(active=3, provisioned=3)
+        c, _ = _ctrl(ds, clock, scaler=scaler, pods_per_worker=2,
+                     sustain_ticks=1)
+        # 4 pods / 2 podsPerWorker = 2 workers wanted, 3 active: retire.
+        c.tick()
+        assert scaler.calls == ["retire"]
+        assert c.snapshot()["pending"]["kind"] == RETIRE_WORKER
+        clock.advance(1.0)
+        c.tick()                              # counts converged
+        assert c.snapshot()["records"][0]["state"] == COMPLETED
+        assert scaler.active == 2
+
+    def test_scaler_refusal_is_leddered(self):
+        ds = Datastore()
+        _pool(ds, {f"10.0.0.{i}:8000": "decode" for i in range(1, 5)})
+        clock = FakeClock()
+        scaler = StubScaler(active=3, provisioned=3, refuse=True)
+        c, _ = _ctrl(ds, clock, scaler=scaler, pods_per_worker=2,
+                     sustain_ticks=1)
+        c.tick()
+        rec = c.snapshot()["records"][0]
+        assert rec["state"] == REFUSED
+        assert "scaler refused" in rec["why"]
+
+
+class TestMergeAndSnapshot:
+    def test_merge_autoscale(self):
+        acting = {"enabled": True, "acting": True, "actions_total": 3,
+                  "refusals_total": 2, "rollbacks_total": 1,
+                  "frozen": True, "frozen_reason": "burn",
+                  "fleet_size": {"prefill": 1, "decode": 2},
+                  "records": [{"id": 1, "t_unix": 10.0, "kind": SPAWN_POD,
+                               "state": COMPLETED}]}
+        follower = {"enabled": True, "acting": False, "actions_total": 0,
+                    "refusals_total": 0, "rollbacks_total": 0,
+                    "frozen": False, "records": []}
+        out = merge_autoscale([(0, acting), (1, follower)])
+        assert out["workers"] == 2
+        assert out["acting_shards"] == [0]
+        assert out["frozen"] is True and out["frozen_reason"] == "burn"
+        assert out["actions_total"] == 3
+        assert out["fleet_size"] == {"prefill": 1, "decode": 2}
+        assert out["records"][0]["shard"] == 0
+        assert out["shards"]["1"]["acting"] is False
+
+    def test_snapshot_caps_records(self):
+        ds = Datastore()
+        clock = FakeClock()
+        c, advice = _ctrl(ds, clock)
+        for i in range(100):
+            advice["decode"] = (_up() if i % 2 else _down())
+            c.tick()
+            clock.advance(1.0)
+        assert len(c.snapshot(records_n=5)["records"]) <= 5
+
+
+class TestLifecycleChaos:
+    def test_spec_parses_new_kinds(self):
+        inj = FaultInjector.from_spec(
+            "spawn_fail:100,slow_start:50:1500,stall_drain:100:2", seed=11)
+        kinds = [r.kind for r in inj.rules]
+        assert kinds == ["spawn_fail", "slow_start", "stall_drain"]
+        assert inj.rules[1].arg == 1500.0
+
+    def test_lifecycle_decides_per_pod_and_is_deterministic(self):
+        inj = FaultInjector.from_spec("spawn_fail:50", seed=11)
+        verdicts = {p: inj.decide_lifecycle("spawn_fail", p) is not None
+                    for p in (f"10.0.0.{i}:8000" for i in range(20))}
+        again = FaultInjector.from_spec("spawn_fail:50", seed=11)
+        assert verdicts == {
+            p: again.decide_lifecycle("spawn_fail", p) is not None
+            for p in verdicts}
+        assert any(verdicts.values()) and not all(verdicts.values())
+
+    def test_request_plane_skips_lifecycle_rules(self):
+        inj = FaultInjector.from_spec("spawn_fail:100,stall_drain:100",
+                                      seed=11)
+        assert inj.decide("req-1") is None
+        assert inj.triggered["spawn_fail"] == 0
+
+    def test_engine_spawn_fail_raises_on_start(self):
+        from llm_d_inference_scheduler_tpu.engine import EngineConfig
+        from llm_d_inference_scheduler_tpu.engine.server import EngineServer
+
+        async def body():
+            s = EngineServer(EngineConfig(
+                backend="sim", model="tiny", port=18631,
+                chaos="spawn_fail:100", chaos_seed=7))
+            with pytest.raises(RuntimeError, match="spawn_fail"):
+                await s.start()
+
+        asyncio.run(body())
+
+    def test_engine_stall_drain_pins_phantom_running(self):
+        from llm_d_inference_scheduler_tpu.engine import EngineConfig
+        from llm_d_inference_scheduler_tpu.engine.server import EngineServer
+
+        async def body():
+            s = EngineServer(EngineConfig(
+                backend="sim", model="tiny", port=18632,
+                chaos="stall_drain:100:3", chaos_seed=7))
+            await s.start()
+            try:
+                async with httpx.AsyncClient(timeout=10) as cx:
+                    r = await cx.get("http://127.0.0.1:18632/metrics")
+                line = [ln for ln in r.text.splitlines()
+                        if ln.startswith("jetstream:num_requests_running ")]
+                assert float(line[0].rsplit(" ", 1)[1]) >= 3.0
+            finally:
+                await s.stop()
+
+        asyncio.run(body())
+
+    def test_engine_slow_start_holds_health_503(self):
+        from llm_d_inference_scheduler_tpu.engine import EngineConfig
+        from llm_d_inference_scheduler_tpu.engine.server import EngineServer
+
+        async def body():
+            s = EngineServer(EngineConfig(
+                backend="sim", model="tiny", port=18633,
+                chaos="slow_start:100:400", chaos_seed=7))
+            await s.start()
+            try:
+                async with httpx.AsyncClient(timeout=10) as cx:
+                    r = await cx.get("http://127.0.0.1:18633/health")
+                    assert r.status_code == 503
+                    assert r.json()["status"] == "warming"
+                    await asyncio.sleep(0.5)
+                    r = await cx.get("http://127.0.0.1:18633/health")
+                    assert r.status_code == 200
+            finally:
+                await s.stop()
+
+        asyncio.run(body())
+
+
+# ---------------------------------------------------------------------------
+# Fleet plane: supervisor retiring state machine + admin surfaces.
+# ---------------------------------------------------------------------------
+
+SCALE_A, SCALE_B = 18641, 18642
+SCALE_ADMIN = 18643
+
+
+class _FakeProc:
+    def __init__(self, alive=True):
+        self.alive = alive
+        self.terminated = False
+        self.pid = 4242
+        self.exitcode = None
+
+    def is_alive(self):
+        return self.alive
+
+    def terminate(self):
+        self.terminated = True
+        self.alive = False   # drain resolves instantly in the fake
+
+
+def _fake_sup(workers=3, leader=0):
+    from llm_d_inference_scheduler_tpu.router.fleet import (
+        FleetConfig,
+        FleetSupervisor,
+    )
+
+    sup = FleetSupervisor(None, fleet=FleetConfig(workers=workers))
+    sup._procs = [_FakeProc() for _ in range(workers)]
+    sup.leader_index = leader
+    sup._spawn = lambda i: sup._procs.__setitem__(i, _FakeProc())
+    return sup
+
+
+class TestSupervisorScaleIn:
+    def test_retire_refuses_leader_and_last_worker(self):
+        sup = _fake_sup(workers=2)
+        assert sup.retire_worker(0) is None          # leader
+        assert sup.retire_worker(1) == 1             # ok
+        assert sup.retire_worker(None) is None       # last active = leader
+
+    def test_retire_picks_highest_non_leader_and_restores(self):
+        sup = _fake_sup(workers=3)
+        assert sup.retire_worker(None) == 2
+        assert sup._procs[2].terminated is True
+        assert sup.worker_state(2) in ("retiring", "retired")
+        assert sup.worker_state(1) == "up"
+        assert sup.active_workers() == 2
+        # A crashed worker reads "down", not "retired".
+        sup._procs[1].alive = False
+        assert sup.worker_state(1) == "down"
+        sup._procs[1].alive = True
+        # Restore brings the retired shard back.
+        assert sup.restore_worker(None) == 2
+        assert sup.worker_state(2) == "up"
+        assert sup.retire_worker(2) == 2             # and it can retire again
+
+    def test_scale_request_dispatch(self):
+        sup = _fake_sup(workers=3)
+        assert sup._scale_request("retire", None) == 2
+        assert sup._scale_request("restore", None) == 2
+        assert sup._scale_request("retire", 0) is None
+
+    def test_balancer_remaps_disabled_shard(self):
+        from llm_d_inference_scheduler_tpu.router.fleet import HashBalancer
+
+        bal = HashBalancer("127.0.0.1", 0,
+                           [("127.0.0.1", p) for p in (1, 2, 3)])
+        bal.disable(1)
+        assert bal.disabled == {1}
+        bal.enable(1)
+        assert bal.disabled == set()
+
+
+def _scale_stub_worker(port, *, doc):
+    app = web.Application()
+
+    async def autoscale(request):
+        return web.json_response(doc)
+
+    async def health(request):
+        return web.json_response({"status": "ok"})
+
+    app.add_routes([web.get("/debug/autoscale", autoscale),
+                    web.get("/health", health)])
+    return app, port
+
+
+def test_fleet_admin_autoscale_fan_in_and_scale_route():
+    """/debug/autoscale fan-in (acting shard's ledger + follower rows +
+    supervisor worker states) and the token-guarded POST /fleet/scale."""
+    from llm_d_inference_scheduler_tpu.router.fleet import FleetAdmin
+
+    acting_doc = {"enabled": True, "acting": True, "actions_total": 2,
+                  "refusals_total": 1, "rollbacks_total": 0,
+                  "frozen": False,
+                  "records": [{"id": 1, "t_unix": 5.0, "kind": SPAWN_POD,
+                               "state": COMPLETED}]}
+    follower_doc = {"enabled": True, "acting": False, "actions_total": 0,
+                    "refusals_total": 0, "rollbacks_total": 0,
+                    "frozen": False, "records": []}
+    scale_calls = []
+
+    def scale_fn(action, shard):
+        scale_calls.append((action, shard))
+        return 1 if action == "retire" else None
+
+    async def body():
+        runners = []
+        for app, port in (_scale_stub_worker(SCALE_A, doc=acting_doc),
+                          _scale_stub_worker(SCALE_B, doc=follower_doc)):
+            runner = web.AppRunner(app)
+            await runner.setup()
+            await web.TCPSite(runner, "127.0.0.1", port).start()
+            runners.append(runner)
+        states = {0: "up", 1: "up"}
+        admin = FleetAdmin([("127.0.0.1", SCALE_A), ("127.0.0.1", SCALE_B)],
+                           host="127.0.0.1", port=SCALE_ADMIN,
+                           worker_state=lambda i: states[i],
+                           scale_fn=scale_fn, control_token="tok")
+        await admin.start()
+        try:
+            async with httpx.AsyncClient(timeout=10) as c:
+                base = f"http://127.0.0.1:{SCALE_ADMIN}"
+                r = await c.get(base + "/debug/autoscale")
+                doc = r.json()
+                assert doc["acting_shards"] == [0]
+                assert doc["actions_total"] == 2
+                assert doc["records"][0]["shard"] == 0
+                assert doc["worker_states"] == ["up", "up"]
+                # Token guard.
+                r = await c.post(base + "/fleet/scale",
+                                 json={"action": "retire"})
+                assert r.status_code == 403
+                r = await c.post(base + "/fleet/scale",
+                                 json={"action": "retire"},
+                                 headers={"x-fleet-token": "tok"})
+                assert r.status_code == 200 and r.json()["shard"] == 1
+                # Refusal -> 409.
+                r = await c.post(base + "/fleet/scale",
+                                 json={"action": "restore"},
+                                 headers={"x-fleet-token": "tok"})
+                assert r.status_code == 409 and r.json()["refused"]
+                r = await c.post(base + "/fleet/scale",
+                                 json={"action": "nuke"},
+                                 headers={"x-fleet-token": "tok"})
+                assert r.status_code == 400
+                assert scale_calls == [("retire", None), ("restore", None)]
+                # Satellite: a RETIRED shard does not 503 fleet /health
+                # the way a crashed one does.
+                await runners[1].cleanup()
+                states[1] = "retired"
+                r = await c.get(base + "/health")
+                assert r.status_code == 200
+                w = r.json()["workers"][1]
+                assert (w["alive"], w["state"]) == (False, "retired")
+                states[1] = "down"
+                r = await c.get(base + "/health")
+                assert r.status_code == 503
+        finally:
+            await admin.stop()
+            for runner in runners:
+                await runner.cleanup()
+
+    asyncio.run(body())
+
+
+def test_fleet_admin_scale_without_hooks_is_501():
+    from llm_d_inference_scheduler_tpu.router.fleet import FleetAdmin
+
+    async def body():
+        admin = FleetAdmin([], host="127.0.0.1", port=SCALE_ADMIN + 1)
+        await admin.start()
+        try:
+            async with httpx.AsyncClient(timeout=10) as c:
+                r = await c.post(
+                    f"http://127.0.0.1:{SCALE_ADMIN + 1}/fleet/scale",
+                    json={"action": "retire"})
+                assert r.status_code == 501
+        finally:
+            await admin.stop()
+
+    asyncio.run(body())
+
+
+@pytest.mark.slow
+def test_fleet_scale_in_drain_e2e_zero_client_errors():
+    """Satellite: retiring a worker mid-traffic is invisible to clients —
+    in-flight requests on the retiring shard complete, new flows re-hash
+    to survivors, fleet /health never flips, and the shard lands in
+    ``retired`` (router_shard_state 3), not ``down``."""
+    from prometheus_client.parser import text_string_to_metric_families
+
+    from llm_d_inference_scheduler_tpu.engine import EngineConfig
+    from llm_d_inference_scheduler_tpu.engine.server import EngineServer
+    from llm_d_inference_scheduler_tpu.router.fleet import (
+        FleetConfig,
+        FleetSupervisor,
+    )
+
+    E, GW, ADMIN = 18651, 18652, 18653
+    CFG = f"""
+pool:
+  endpoints:
+    - {{address: 127.0.0.1, port: {E}}}
+scheduling: {{pickSeed: 7}}
+"""
+
+    async def body():
+        eng = EngineServer(EngineConfig(backend="sim", model="tiny",
+                                        port=E, max_batch=8,
+                                        sim_decode_ms_per_token=5.0))
+        await eng.start()
+        sup = FleetSupervisor(
+            CFG, host="127.0.0.1", port=GW,
+            fleet=FleetConfig(workers=2, balancer="hash",
+                              admin_port=ADMIN),
+            poll_interval=0.02, drain_timeout_s=5.0)
+        await sup.start()
+        try:
+            # Both workers must have scraped the engine before traffic.
+            async with httpx.AsyncClient(timeout=5) as c:
+                for _ in range(200):
+                    try:
+                        r = await c.get(
+                            f"http://127.0.0.1:{ADMIN}/health")
+                        if (r.status_code == 200
+                                and r.json().get("workers_ready") ==
+                                sup.fleet.workers):
+                            break
+                    except httpx.HTTPError:
+                        pass
+                    await asyncio.sleep(0.05)
+                else:
+                    pytest.fail("fleet never became ready")
+            victim = 1 if sup.leader_index == 0 else 0
+
+            async def one(i):
+                # flow pinned to the victim shard via the fairness id
+                # search below; slow decode keeps it in flight across
+                # the retire.
+                async with httpx.AsyncClient(timeout=30) as c:
+                    return await c.post(
+                        f"http://127.0.0.1:{GW}/v1/completions",
+                        headers={"x-gateway-inference-fairness-id":
+                                     flows[i]},
+                        json={"model": "tiny", "prompt": "hi",
+                              "max_tokens": 40})
+
+            from llm_d_inference_scheduler_tpu.router.fleet import (
+                flow_shard,
+            )
+
+            # Flows that hash to the victim shard (in-flight during the
+            # retire) and one that doesn't (post-retire traffic).
+            flows = [f for f in (f"flow-{i}" for i in range(64))
+                     if flow_shard(f, 2) == victim][:3]
+            tasks = [asyncio.create_task(one(i))
+                     for i in range(len(flows))]
+            await asyncio.sleep(0.15)         # requests reach the engine
+            assert sup.retire_worker(victim) == victim
+            results = await asyncio.gather(*tasks)
+            assert [r.status_code for r in results] == [200] * len(flows)
+            # New flow re-hashes to the survivor.
+            async with httpx.AsyncClient(timeout=30) as c:
+                r = await c.post(
+                    f"http://127.0.0.1:{GW}/v1/completions",
+                    headers={"x-gateway-inference-fairness-id":
+                                 "post-retire"},
+                    json={"model": "tiny", "prompt": "hi",
+                          "max_tokens": 4})
+                assert r.status_code == 200
+                assert r.headers["x-router-shard"] == str(sup.leader_index)
+                # The retiring shard settles into "retired".
+                for _ in range(100):
+                    if sup.worker_state(victim) == "retired":
+                        break
+                    await asyncio.sleep(0.1)
+                assert sup.worker_state(victim) == "retired"
+                base = f"http://127.0.0.1:{ADMIN}"
+                r = await c.get(base + "/health")
+                assert r.status_code == 200   # scale-in is not an outage
+                doc = r.json()
+                assert doc["workers"][victim]["state"] == "retired"
+                r = await c.get(base + "/debug/fleet")
+                assert r.json()["admin"][victim]["state"] == "retired"
+                r = await c.get(base + "/metrics")
+                fams = {f.name: f
+                        for f in text_string_to_metric_families(r.text)}
+                st = {s.labels["shard"]: s.value
+                      for s in fams["router_shard_state"].samples}
+                assert st[str(victim)] == 3.0
+                assert st[str(sup.leader_index)] == 1.0
+                # Restore: the shard comes back and serves again.
+                r = await c.post(base + "/fleet/scale",
+                                 json={"action": "restore"},
+                                 headers={"x-fleet-token":
+                                              sup._control_token})
+                assert r.status_code == 200
+                assert r.json()["shard"] == victim
+                for _ in range(100):
+                    if sup.worker_state(victim) == "up":
+                        break
+                    await asyncio.sleep(0.1)
+                assert sup.worker_state(victim) == "up"
+        finally:
+            await sup.stop()
+            await eng.stop()
+
+    asyncio.run(body())
