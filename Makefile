@@ -8,7 +8,7 @@ PY ?= python
 	bench-router-sse bench-decisions bench-sched bench-sched-offload \
 	bench-scaleout bench-slo bench-overload bench-kvobs bench-multiturn \
 	bench-timeline bench-fleet-chaos bench-shadow bench-rebalance \
-	bench-forecast bench-autoscale \
+	bench-forecast bench-autoscale bench-tails \
 	dryrun render-chart \
 	compile-check \
 	verify-metrics verify-decisions verify-hotpath verify-threadsafe \
@@ -143,6 +143,17 @@ bench-timeline:
 # judging forecast skill vs persistence (docs/forecast.md).
 bench-forecast:
 	$(PY) bench.py --forecast
+
+# Tail-latency attribution observatory (CPU-only): the per-request
+# waterfall lifecycle cost vs the scheduling-cycle floor (kill-switch
+# ~0%), two injected-skew scenarios (one slow transfer pair via the
+# per-peer sim pull map; one delay-chaos endpoint) where /debug/tails
+# must attribute >= 60% of the tail cohort's excess to the injected
+# stage with the correct culprit named, and a kill-switch parity arm
+# (zero stamps, identical /debug/decisions). Writes
+# benchmarks/TAILS.json (docs/tails.md).
+bench-tails:
+	$(PY) bench.py --tails
 
 # Multi-turn conversation scenario (CPU-only): N users x M turns with a
 # shared system prompt and per-user history growth through the full

@@ -216,6 +216,15 @@ class TpuEngine:
         # aggregates. Bounded ring; individually GIL-atomic dict/deque ops.
         self.kv_import_stats: dict[str, dict[str, Any]] = {}
         self._kv_import_order: collections.deque[str] = collections.deque()
+        # Per-request admission wait (request_id -> ms): submit() stamps
+        # the enqueue instant, the FIRST _admit pop measures the wait —
+        # first-pop-wins, so a KV-fetch re-insert (same admission resumed,
+        # not a new one) never re-measures — and the server pops the value
+        # for the x-engine-queue-ms response header. Bounded rings;
+        # individually GIL-atomic dict/deque ops.
+        self._queue_submit: dict[str, float] = {}
+        self.queue_waits: dict[str, float] = {}
+        self._queue_wait_order: collections.deque[str] = collections.deque()
         # Per-request ACTUAL prefix-hit accounting (telemetry.PrefixHitLog,
         # shared with the sim), recorded once at prefill admission — the
         # engine-confirmed number the router's prefix scorers only PREDICT.
@@ -565,6 +574,11 @@ class TpuEngine:
         out: asyncio.Queue = asyncio.Queue()
         loop = asyncio.get_running_loop()
         with self._cond:
+            self._queue_submit[req.request_id] = time.monotonic()
+            # Cap the stamp map: aborted/drained entries never reach the
+            # admit-side pop, so trim oldest-first on the way in.
+            while len(self._queue_submit) > 2048:
+                self._queue_submit.pop(next(iter(self._queue_submit)))
             self._waiting.append((req, out, loop))
             self.telemetry.waiting.set(len(self._waiting))
             self._cond.notify()
@@ -1029,6 +1043,18 @@ class TpuEngine:
             need = max(need, int(ktp["remote_num_blocks"]))
         return need
 
+    def _record_queue_wait(self, request_id: str) -> None:
+        """Measure admission wait at the FIRST _admit pop (first-pop-wins:
+        a KV-fetch re-insert finds its stamp already consumed and is not
+        re-measured). The server pops the result for x-engine-queue-ms."""
+        t0 = self._queue_submit.pop(request_id, None)
+        if t0 is None:
+            return
+        self.queue_waits[request_id] = (time.monotonic() - t0) * 1e3
+        self._queue_wait_order.append(request_id)
+        while len(self._queue_wait_order) > 512:
+            self.queue_waits.pop(self._queue_wait_order.popleft(), None)
+
     def _admit(self):
         group: list[tuple[int, EngineRequest, Any, Any, int]] = []
         for i, slot in enumerate(self.slots):
@@ -1043,6 +1069,7 @@ class TpuEngine:
                     # Impossible request: reject instead of wedging the queue.
                     self._waiting.pop(0)
                     self.telemetry.waiting.set(len(self._waiting))
+                    self._record_queue_wait(req.request_id)
                     self._emit_to(out, loop, TokenEvent(
                         request_id=req.request_id, token_id=None,
                         finish_reason=FinishReason.ABORT,
@@ -1052,6 +1079,7 @@ class TpuEngine:
                     # Fetch off-thread; the payload comes back via _import_ready.
                     self._waiting.pop(0)
                     self.telemetry.waiting.set(len(self._waiting))
+                    self._record_queue_wait(req.request_id)
                     self._start_kv_fetch(req, out, loop)
                     continue
                 available = getattr(self.allocator, "reusable_blocks",
@@ -1063,6 +1091,7 @@ class TpuEngine:
                     break  # head-of-line waits for capacity
                 self._waiting.pop(0)
                 self.telemetry.waiting.set(len(self._waiting))
+                self._record_queue_wait(req.request_id)
             group.append((i, req, out, loop, need))
         self._flush_admissions(group)
 

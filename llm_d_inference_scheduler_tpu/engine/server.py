@@ -636,6 +636,19 @@ class EngineServer:
                 "x-kv-pull-bytes": str(stats["bytes"]),
                 "x-kv-pull-route": stats["route"]}
 
+    def _queue_headers(self, req: EngineRequest) -> dict[str, str]:
+        """Measured admission wait — submit() to the first ``_admit`` pop
+        (engine/core.py ``_record_queue_wait``) — stamped on non-streaming
+        responses as ``x-engine-queue-ms`` so the router's tail waterfall
+        (router/tails.py) can split engine queueing out of the decode
+        residual. Streaming responses send headers before admission
+        completes, so they carry nothing."""
+        waits = getattr(self.engine, "queue_waits", None)
+        ms = waits.pop(req.request_id, None) if waits is not None else None
+        if ms is None:
+            return {}
+        return {"x-engine-queue-ms": f"{ms:.2f}"}
+
     def _kv_hit_headers(self, req: EngineRequest) -> dict[str, str]:
         """ACTUAL prefix-hit depth measured at prefill admission
         (engine/core.py ``_note_prefix_hit``), stamped on non-streaming
@@ -683,7 +696,8 @@ class EngineServer:
                     resp = web.json_response(
                         await self._collect(req, out, stops, timing),
                         headers={**self._kv_pull_headers(req),
-                                 **self._kv_hit_headers(req)})
+                                 **self._kv_hit_headers(req),
+                                 **self._queue_headers(req)})
             except (asyncio.CancelledError, ConnectionResetError):
                 self.engine.abort(req.request_id)  # client went away: stop decoding
                 raise
@@ -719,7 +733,8 @@ class EngineServer:
         text = resp["choices"][0].pop("text")
         resp["choices"][0]["message"] = {"role": "assistant", "content": text}
         return web.json_response(resp, headers={**self._kv_pull_headers(req),
-                                                **self._kv_hit_headers(req)})
+                                                **self._kv_hit_headers(req),
+                                                **self._queue_headers(req)})
 
     async def embeddings(self, request: web.Request) -> web.Response:
         """OpenAI /v1/embeddings: mean-pooled final-hidden-state vectors

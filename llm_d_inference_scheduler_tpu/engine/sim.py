@@ -91,6 +91,11 @@ class SimEngine:
         # popped at response time; streamed legs (whose headers leave
         # early) are swept by the cap.
         self.kv_import_stats: OrderedDict[str, dict[str, Any]] = OrderedDict()
+        # Admission-wait parity (the real engine's queue_waits contract,
+        # engine/core.py _record_queue_wait): measured around the
+        # batch-slot semaphore, popped by the server for the
+        # x-engine-queue-ms header. Same 512-entry sweep as above.
+        self.queue_waits: OrderedDict[str, float] = OrderedDict()
 
     async def start(self):
         pass
@@ -217,6 +222,7 @@ class SimEngine:
     async def _serve(self, req: EngineRequest, out: asyncio.Queue):
         self._waiting += 1
         self._update_gauges()
+        t_queue = time.monotonic()
         try:
             await self._sem.acquire()
         except asyncio.CancelledError:  # aborted while queued
@@ -227,6 +233,10 @@ class SimEngine:
                 finish_reason=FinishReason.ABORT,
                 prompt_tokens=len(req.prompt_token_ids)))
             return
+        # Admission wait = semaphore hold time (the sim's only queue).
+        self.queue_waits[req.request_id] = (time.monotonic() - t_queue) * 1e3
+        while len(self.queue_waits) > 512:
+            self.queue_waits.popitem(last=False)
         try:
             self._waiting -= 1
             self._running += 1

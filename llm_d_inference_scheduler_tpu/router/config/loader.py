@@ -55,6 +55,7 @@ class RawConfig:
     rebalance: dict[str, Any]
     forecast: dict[str, Any]
     autoscale: dict[str, Any]
+    tails: dict[str, Any]
     tls_client: dict[str, Any]
     pool: dict[str, Any]
     objectives: list[dict[str, Any]]
@@ -153,6 +154,12 @@ class RouterConfig:
     # enabled: false (the default) is the kill-switch — no task, zero
     # ticks, zero actions, bit-identical).
     autoscale: dict[str, Any]
+    # tails: the tail-latency attribution observatory knobs
+    # (router/tails.py TailsConfig — {enabled, capacity, tailQuantile,
+    # exemplars}; default-on per the kvCache precedent, enabled: false is
+    # the kill-switch — no waterfall object ever rides a request, every
+    # layer hook degrades to one `is None` check, bit-identical).
+    tails: dict[str, Any]
     # The parsed YAML verbatim: /debug/config serves a redacted view and
     # router_config_info{hash} fingerprints it.
     raw_doc: dict[str, Any]
@@ -195,6 +202,7 @@ def load_raw_config(text: str | None) -> RawConfig:
         rebalance=doc.get("rebalance") or {},
         forecast=doc.get("forecast") or {},
         autoscale=doc.get("autoscale") or {},
+        tails=doc.get("tails") or {},
         tls_client=doc.get("tlsClient") or {},
         pool=doc.get("pool") or {},
         objectives=doc.get("objectives") or [],
@@ -428,6 +436,7 @@ def instantiate(raw: RawConfig, handle: Handle,
         rebalance=raw.rebalance,
         forecast=raw.forecast,
         autoscale=raw.autoscale,
+        tails=raw.tails,
         raw_doc=raw.doc,
         tls_client=raw.tls_client,
         static_endpoints=static_endpoints,

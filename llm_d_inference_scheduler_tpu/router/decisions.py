@@ -54,7 +54,7 @@ class DecisionRecord:
     __slots__ = ("request_id", "model", "target_model", "priority",
                  "_start", "_admission", "_producers",
                  "_rounds", "_attempts", "_final", "_outcome", "_shed",
-                 "_cache", "_classifier", "_shadow", "top_k")
+                 "_cache", "_classifier", "_shadow", "_waterfall", "top_k")
 
     # Container fields are lazily created (None until first write): a record
     # is opened on EVERY request, and five eager container allocations per
@@ -99,6 +99,7 @@ class DecisionRecord:
         self._cache = None
         self._classifier = None
         self._shadow = None
+        self._waterfall = None
 
     @property
     def start_unix(self) -> float:
@@ -146,6 +147,11 @@ class DecisionRecord:
     @property
     def shadow(self) -> dict[str, Any]:
         return self._shadow if self._shadow is not None else self._EMPTY_DICT
+
+    @property
+    def waterfall(self) -> dict[str, Any]:
+        return (self._waterfall if self._waterfall is not None
+                else self._EMPTY_DICT)
 
     # ---- layer hooks ----------------------------------------------------
 
@@ -338,6 +344,15 @@ class DecisionRecord:
         if self._outcome is None:
             self._outcome = outcome
 
+    def record_waterfall(self, block: dict[str, Any]) -> None:
+        """Critical-path stage waterfall (router/tails.py): per-stage time
+        split, decode residual, the cohort key, and — when the request
+        landed in its cohort's tail — the dominant-stage verdict the
+        ``?stage=`` list filter pages on. Stamped exactly once on every
+        terminal path (the record_outcome contract). First stamp wins."""
+        if self._waterfall is None:
+            self._waterfall = block
+
     def finalize(self, status: int, *, destination: str | None = None,
                  reason: str | None = None) -> None:
         if self._final:
@@ -372,6 +387,8 @@ class DecisionRecord:
             doc["classifier"] = self._classifier
         if self._shadow is not None:
             doc["shadow"] = self._render_shadow()
+        if self._waterfall is not None:
+            doc["waterfall"] = self._waterfall
         if compact:
             doc["summary"] = self.summary_line()
             return doc
@@ -483,6 +500,18 @@ class DecisionRecord:
             if actual is not None:
                 verdict += f"/act:{actual.get('blocks', '?')}"
             parts.append(verdict)
+        wf = self._waterfall
+        if wf is not None:
+            # Waterfall verdict beside the pick: the dominant stage when
+            # this request landed in its cohort's tail, else the decode
+            # residual that closed the split.
+            dom = wf.get("dominant")
+            if dom is not None:
+                ms = (wf.get("stages") or {}).get(dom)
+                parts.append(f"tail={dom}" + (f":{ms:.1f}ms"
+                                              if ms is not None else ""))
+            elif wf.get("ttft_ms") is not None:
+                parts.append(f"ttft={wf['ttft_ms']:.1f}ms")
         drops = []
         for rnd in list(self.rounds):
             for pname, sec in self._live_items(rnd["profiles"]):
@@ -540,7 +569,8 @@ def record_matches(doc: dict[str, Any], *, verdict: str | None = None,
                    endpoint: str | None = None,
                    outcome: str | None = None,
                    profile: str | None = None,
-                   divergent: Any = None) -> bool:
+                   divergent: Any = None,
+                   stage: str | None = None) -> bool:
     """Operator-side list-view filters over a rendered record dict (the
     gateway's ``/debug/decisions?verdict=&endpoint=&outcome=&profile=`` —
     and the fleet fan-in forwards the same params to every worker):
@@ -560,7 +590,11 @@ def record_matches(doc: dict[str, Any], *, verdict: str | None = None,
     - ``divergent``: shadow-policy counterfactual filter (``?divergent=1``)
       — records where at least one registered shadow policy would have
       picked differently (the ``shadow`` block's ``diverged`` flag,
-      router/shadow.py).
+      router/shadow.py);
+    - ``stage``: tail-attribution filter (``?stage=kv_transfer``) — records
+      whose waterfall landed in the cohort tail with that dominant stage
+      (router/tails.py), so an operator can page straight from a
+      /debug/tails attribution to the requests behind it.
 
     All given filters must match (AND)."""
     out = doc.get("outcome") or {}
@@ -612,6 +646,12 @@ def record_matches(doc: dict[str, Any], *, verdict: str | None = None,
         if not isinstance(divergent, bool):
             return False  # unknown value matches nothing, loudly-by-empty
         if bool((doc.get("shadow") or {}).get("diverged")) != divergent:
+            return False
+    if stage is not None:
+        # Unknown stage names match nothing, loudly-by-empty (the profile
+        # filter convention) — and only TAIL-classified records carry a
+        # dominant stage, so ?stage pages exactly the attributed cohort.
+        if (doc.get("waterfall") or {}).get("dominant") != stage:
             return False
     return True
 

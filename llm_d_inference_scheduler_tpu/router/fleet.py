@@ -983,6 +983,7 @@ class FleetAdmin:
             web.get("/debug/decisions/{request_id}", self.decision_detail),
             web.get("/debug/slo", self.slo),
             web.get("/debug/transfers", self.transfers),
+            web.get("/debug/tails", self.tails),
             web.get("/debug/kv", self.kv),
             web.get("/debug/shadow", self.shadow),
             web.get("/debug/traces", self.traces),
@@ -1220,7 +1221,7 @@ class FleetAdmin:
 
         params = {"n": str(n)}
         for key in ("verdict", "endpoint", "outcome", "profile",
-                    "divergent"):
+                    "divergent", "stage"):
             v = request.query.get(key)
             if v:
                 params[key] = v
@@ -1278,6 +1279,17 @@ class FleetAdmin:
         multiple shards is ONE row, not duplicates."""
         results = await self._fan_out("/debug/transfers")
         return web.json_response(merge_transfers(
+            [(shard, doc) for shard, (status, doc) in enumerate(results)
+             if status == 200 and isinstance(doc, dict)]))
+
+    async def tails(self, request: web.Request) -> web.Response:
+        """Fleet /debug/tails: per-cohort stage digests merged n-weighted
+        across shards (router/tails.py merge_tails) — exemplars carry the
+        owning shard so a drill-down knows which worker's ring to ask."""
+        from .tails import merge_tails
+
+        results = await self._fan_out("/debug/tails")
+        return web.json_response(merge_tails(
             [(shard, doc) for shard, (status, doc) in enumerate(results)
              if status == 200 and isinstance(doc, dict)]))
 

@@ -64,7 +64,10 @@ class FlowControlAdmissionController:
         item = self._make_item(request, flow_key)
         rec = request.decision  # decision flight recorder (may be None)
         obs = getattr(request, "outcome", None)  # SLO ledger (may be None)
-        t0 = time.monotonic() if rec is not None or obs is not None else 0.0
+        wf = getattr(request, "waterfall", None)  # tails.py (may be None)
+        t0 = (time.monotonic()
+              if rec is not None or obs is not None or wf is not None
+              else 0.0)
         retried_after_shed = False
         shed_victims: list[str] = []
         outcome = await self.controller.enqueue_and_wait(item)
@@ -87,7 +90,7 @@ class FlowControlAdmissionController:
                 retried_after_shed = True
                 item = self._make_item(request, flow_key)
                 outcome = await self.controller.enqueue_and_wait(item)
-        if rec is not None or obs is not None:
+        if rec is not None or obs is not None or wf is not None:
             queue_ms = (time.monotonic() - t0) * 1e3
             if rec is not None:
                 rec.record_admission(
@@ -101,6 +104,9 @@ class FlowControlAdmissionController:
                 # The SLO ledger's queue-time component: admission wait is
                 # part of the client-observed TTFT budget.
                 obs.queue_ms = queue_ms
+            if wf is not None:
+                # The waterfall's queue stage (router/tails.py).
+                wf.queue_ms = queue_ms
         if outcome != QueueOutcome.DISPATCHED:
             code, reason = _OUTCOME_ERRORS.get(outcome, (429, outcome.value))
             if (outcome == QueueOutcome.EVICTED_UNMEETABLE

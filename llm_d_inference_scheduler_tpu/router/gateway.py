@@ -77,6 +77,7 @@ from .rebalance import RebalanceConfig, RebalanceController
 from .schedpool import LoopLagMonitor, SchedulerPool, SchedulingConfig
 from .shadow import ShadowConfig, ShadowEvaluator
 from .slo import SloConfig, SloLedger, finite_float_or_none
+from .tails import TailsConfig, TailsObservatory
 from .timeline import (
     TimelineConfig,
     TimelineSampler,
@@ -104,6 +105,13 @@ H_DECISION_SUMMARY = "x-decision-summary"
 # worker process served this request — the per-request twin of the
 # supervisor's router_shard_* families.
 H_ROUTER_SHARD = "x-router-shard"
+
+# Engine queue-wait stamp (engine/server.py, sim parity in engine/sim.py;
+# the sidecar relays it on the disagg path): per-request admission-to-
+# first-step wait, separating engine queueing from compute in the
+# waterfall's decode residual (router/tails.py). Non-streaming responses
+# only — a streamed response's headers leave before admission completes.
+H_ENGINE_QUEUE = "x-engine-queue-ms"
 
 # Request bodies at or above this size have their JSON parse routed through
 # the scheduler pool's workers instead of the event loop (json.loads of a
@@ -166,6 +174,12 @@ class Gateway:
         # closing the predict→observe loop. `slo: {enabled: false}` removes
         # the per-chunk hook from the streaming path entirely.
         self.slo_ledger = SloLedger(SloConfig.from_spec(cfg.slo))
+
+        # Tail-latency attribution observatory (router/tails.py): the
+        # per-request critical-path waterfall + body-vs-tail cohort ledger
+        # behind /debug/tails. Default-on (the kvCache precedent); `tails:
+        # {enabled: false}` means no waterfall object ever rides a request.
+        self.tails_obs = TailsObservatory(TailsConfig.from_spec(cfg.tails))
 
         # KV-cache & prefix-reuse observability (router/kvobs.py): the
         # predicted-vs-confirmed hit ledger behind /debug/kv. `kvCache:
@@ -373,7 +387,8 @@ class Gateway:
             shadow=self.shadow_eval if self.shadow_eval.active else None,
             rebalance=self.rebalancer if self.rebalancer.enabled else None,
             forecast=self.forecaster if fc_live else None,
-            autoscale=self.autoscaler if self.autoscaler.enabled else None)
+            autoscale=self.autoscaler if self.autoscaler.enabled else None,
+            tails=self.tails_obs if self.tails_obs.enabled else None)
         if fc_live and self.rebalancer.enabled:
             self.rebalancer.forecast = self.forecaster
 
@@ -399,6 +414,7 @@ class Gateway:
             web.get("/debug/decisions", self.decisions),
             web.get("/debug/decisions/{request_id}", self.decision_detail),
             web.get("/debug/slo", self.slo),
+            web.get("/debug/tails", self.tails_view),
             web.get("/debug/transfers", self.transfers),
             web.get("/debug/kv", self.kv),
             web.get("/debug/shadow", self.shadow_view),
@@ -641,8 +657,10 @@ class Gateway:
         (the destination that served), ?outcome=miss|shed (convenience
         aliases), ?profile=prefill|decode|skip-hop (the disaggregation
         shape the request took — skip-hop isolates the prefill
-        classifier's skipped P/D hops) — so records are findable without
-        client-side scans."""
+        classifier's skipped P/D hops), ?stage=<dominant-stage> (tail
+        attribution: records whose waterfall landed in the cohort tail
+        with that dominant stage, router/tails.py) — so records are
+        findable without client-side scans."""
         from .decisions import record_matches
 
         try:
@@ -654,6 +672,7 @@ class Gateway:
         endpoint = request.query.get("endpoint") or None
         outcome = request.query.get("outcome") or None
         profile = request.query.get("profile") or None
+        stage = request.query.get("stage") or None
         # ?divergent=1 — shadow-policy counterfactual filter: only records
         # where a registered shadow policy would have picked differently
         # (?divergent=0 inverts; any other value matches nothing,
@@ -666,7 +685,7 @@ class Gateway:
                           else "invalid")
         filtered = verdict is not None or endpoint is not None \
             or outcome is not None or profile is not None \
-            or divergent is not None
+            or divergent is not None or stage is not None
         # Filtering scans the WHOLE ring (the n newest matches, not the
         # matches within the n newest); the unfiltered path keeps the
         # cheap bounded snapshot.
@@ -690,7 +709,8 @@ class Gateway:
                         probe["rounds"] = r.rounds
                 if not record_matches(probe, verdict=verdict,
                                       endpoint=endpoint, outcome=outcome,
-                                      profile=profile, divergent=divergent):
+                                      profile=profile, divergent=divergent,
+                                      stage=stage):
                     continue
             docs.append(doc)
             if len(docs) >= n:
@@ -939,6 +959,15 @@ class Gateway:
         token counts, bounded miss-reason tallies."""
         return web.json_response(self.slo_ledger.snapshot())
 
+    async def tails_view(self, request: web.Request) -> web.Response:
+        """Tail-latency attribution observatory (router/tails.py): per-
+        (model, band, shape) body-vs-tail cohort split with per-stage
+        p50/p95/p99, dominant-stage attribution of the tail cohort's
+        excess time with culprit drill-down (endpoint / transfer pair /
+        shed rung), and bounded exemplar request-ids linking into
+        /debug/decisions/<id>."""
+        return web.json_response(self.tails_obs.snapshot())
+
     async def transfers(self, request: web.Request) -> web.Response:
         """Per-(prefill, decode)-pair KV-transfer EWMA table
         (datalayer/transfers.py): pull duration, bytes, derived wire speed,
@@ -1087,6 +1116,9 @@ class Gateway:
         # admission hook can stamp queue time and the predicted-latency
         # PreRequest hook can stamp this request's prediction.
         self.slo_ledger.start(ireq, t_start)
+        # Waterfall (router/tails.py): opened beside the SLO observation so
+        # every layer hook past this point can stamp its stage.
+        self.tails_obs.start(ireq, t_start)
 
         try:
             result = await self.director.handle_request(None, ireq)
@@ -1100,6 +1132,8 @@ class Gateway:
             retry_after = getattr(e, "retry_after_s", None)
             self.slo_ledger.complete(ireq, status=e.code, reason=e.reason,
                                      shed=shed)
+            self.tails_obs.complete(ireq, status=e.code, reason=e.reason,
+                                    shed=shed)
             body: dict[str, Any] = {"error": e.reason}
             headers = {X_REMOVAL_REASON: e.reason,
                        **self._decision_headers(ireq)}
@@ -1155,6 +1189,8 @@ class Gateway:
                     ireq.decision.finalize(429, reason=EVICTED_REASON)
                 self.slo_ledger.complete(ireq, status=429,
                                          reason=EVICTED_REASON)
+                self.tails_obs.complete(ireq, status=429,
+                                        reason=EVICTED_REASON)
                 self.shadow_eval.observe_response(ireq, transfer=None,
                                                   status=429)
                 return web.json_response(
@@ -1167,6 +1203,8 @@ class Gateway:
             # stream is slo_met=false, not an absent row).
             self.slo_ledger.complete(ireq, status=499,
                                      reason="cancelled-mid-stream")
+            self.tails_obs.complete(ireq, status=499,
+                                    reason="cancelled-mid-stream")
             self.shadow_eval.observe_response(ireq, transfer=None,
                                               status=499)
             raise
@@ -1228,6 +1266,10 @@ class Gateway:
         breakers = self.datastore.breakers
         self.retry_budget.deposit()
         rec = ireq.decision if ireq is not None else None
+        # Waterfall attempts stage (router/tails.py): time burned in FAILED
+        # dispatch attempts — the serving attempt's own time lands in the
+        # downstream stages, so only the walk's dead ends are charged here.
+        wf = getattr(ireq, "waterfall", None) if ireq is not None else None
         attempted: set[str] = set()
         rescheduled = ireq is None  # only scheduled requests can re-schedule
         failure: UpstreamFailure | None = None
@@ -1289,6 +1331,7 @@ class Gateway:
             last_target = target
             override = (self._dp_override(ireq, target)
                         if ireq is not None else None)
+            attempt_t0 = time.monotonic() if wf is not None else 0.0
             try:
                 resp = await self._proxy(
                     request, ireq, target, body, headers, t_start,
@@ -1296,6 +1339,8 @@ class Gateway:
                     stream_state=stream_state, url_override=override,
                     deadline=deadline)
             except UpstreamFailure as f:
+                if wf is not None:
+                    wf.attempts_ms += (time.monotonic() - attempt_t0) * 1e3
                 failure = f
                 attempted.add(key)
                 breakers.record_failure(key)
@@ -1347,6 +1392,8 @@ class Gateway:
             if ireq is not None:
                 self.slo_ledger.complete(ireq, status=504,
                                          reason=DEADLINE_EXCEEDED_REASON)
+                self.tails_obs.complete(ireq, status=504,
+                                        reason=DEADLINE_EXCEEDED_REASON)
             return web.json_response(
                 {"error": "deadline exceeded"}, status=504,
                 headers={X_REMOVAL_REASON: DEADLINE_EXCEEDED_REASON,
@@ -1361,6 +1408,8 @@ class Gateway:
             if ireq is not None:  # retry-exhausted terminal
                 self.slo_ledger.complete(ireq, status=502,
                                          reason=failure.reason)
+                self.tails_obs.complete(ireq, status=502,
+                                        reason=failure.reason)
             return web.json_response(
                 {"error": f"upstream {failure.kind} failed: {failure.detail}",
                  **extra},
@@ -1372,6 +1421,8 @@ class Gateway:
             if ireq is not None:
                 self.slo_ledger.complete(ireq, status=failure.status,
                                          reason=failure.reason)
+                self.tails_obs.complete(ireq, status=failure.status,
+                                        reason=failure.reason)
             return web.json_response(
                 {"error": failure.reason, **extra}, status=failure.status,
                 headers={X_REMOVAL_REASON: failure.reason, **dec_headers})
@@ -1380,6 +1431,8 @@ class Gateway:
         if ireq is not None:
             self.slo_ledger.complete(ireq, status=503,
                                      reason="no-upstream-available")
+            self.tails_obs.complete(ireq, status=503,
+                                    reason="no-upstream-available")
         return web.json_response(
             {"error": "no upstream endpoint available"}, status=503,
             headers={X_REMOVAL_REASON: "no-upstream-available", **dec_headers})
@@ -1496,6 +1549,39 @@ class Gateway:
         # per-chunk hook below costs exactly one `is None` check.
         obs = ireq.outcome if ireq is not None else None
 
+        # Per-pair KV-transfer landing at HEADER time — for streams too:
+        # the pair row's headers travel with the status line, so waiting
+        # for the terminal usage chunk (the pre-PR-18 behavior) left a
+        # mid-incident stream's transfer invisible in /debug/transfers
+        # until it finished — the gap PR 10's header-time-join hardening
+        # noted. The `finally` below reuses this row; calling
+        # _record_transfer there again would double-count the EWMA table.
+        transfer: dict[str, Any] | None = None
+        wf = getattr(ireq, "waterfall", None) if ireq is not None else None
+        if ireq is not None:
+            transfer = self._record_transfer(ireq, endpoint, resp.headers)
+            if wf is not None:
+                # Waterfall stage stamps (router/tails.py): every stage the
+                # engine/sidecar measured rides the response headers, in
+                # hand before any byte is relayed.
+                v = finite_float_or_none(resp.headers.get(H_ENGINE_QUEUE))
+                if v is not None and v > 0:
+                    wf.engine_queue_ms = v
+                v = finite_float_or_none(
+                    resp.headers.get("x-prefill-duration-ms"))
+                if v is not None and v > 0:
+                    wf.prefill_ms = v
+                v = finite_float_or_none(
+                    resp.headers.get("x-kv-transfer-ms"))
+                if v is not None and v > 0:
+                    wf.kv_transfer_ms = v
+                v = finite_float_or_none(
+                    resp.headers.get("x-kv-transfer-bytes"))
+                if v is not None:
+                    wf.kv_bytes = int(v)
+                if transfer is not None:
+                    wf.pair = f"{transfer['prefill']}→{transfer['decode']}"
+
         try:
             if streaming_body:
                 ws = web.StreamResponse(status=resp.status, headers=out_headers)
@@ -1597,10 +1683,10 @@ class Gateway:
                 if (obs is not None and obs.abort_reason is None
                         and sys.exc_info()[0] is not None):
                     obs.abort_reason = "cancelled-mid-stream"
-                # Terminal ledger accounting: per-pair KV-transfer stats off
-                # the sidecar's response headers, then the SLO verdict
-                # (met/missed, or error for relayed 4xx/5xx and aborts).
-                transfer = self._record_transfer(ireq, endpoint, resp.headers)
+                # Terminal ledger accounting: the per-pair KV-transfer row
+                # landed at header time above (streams included), then the
+                # SLO verdict (met/missed, or error for relayed 4xx/5xx
+                # and aborts) and the waterfall close ride the same spot.
                 # Streamed responses confirm the hit via the terminal usage
                 # record (prompt_tokens_details.cached_tokens); the early
                 # header-time join above already marked non-streamed ones
@@ -1610,6 +1696,8 @@ class Gateway:
                 self.slo_ledger.complete(ireq, status=resp.status,
                                          endpoint=endpoint, usage=usage,
                                          transfer=transfer)
+                self.tails_obs.complete(ireq, status=resp.status,
+                                        endpoint=endpoint, usage=usage)
                 # Shadow judge (router/shadow.py): hand the measured
                 # outcome to the counterfactual ledger — one attribute
                 # check for unsampled requests, an enqueue otherwise.

@@ -422,6 +422,29 @@ FLEET_SIZE = Gauge(
     "routing role (prefill / decode, draining included) plus the active "
     "gateway worker count under role=\"worker\" when worker scaling is "
     "wired", ("role",), registry=REGISTRY)
+# Tail-latency attribution observatory (router/tails.py, ISSUE 18): the
+# per-request critical-path waterfall decomposed into stage histograms, and
+# the online dominant-stage verdict for requests classified into a cohort's
+# tail at close time. Exemplar request-ids live in the /debug/tails JSON
+# payload, never on labels (FORBIDDEN_LABELS).
+STAGE_MS = Histogram(
+    "router_stage_ms",
+    "Per-request critical-path stage time (ms) from the closed waterfall: "
+    "queue (flow-control admission wait), sched (scheduling cycle + "
+    "offload dispatch), attempts (time burned in failed failover "
+    "attempts), engine_queue (engine admission-to-first-step wait), "
+    "prefill (x-prefill-duration-ms), kv_transfer (x-kv-transfer-ms), "
+    "decode (residual TTFT), stream (first-to-last token relay)",
+    ("stage",),
+    buckets=(1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000,
+             10000),
+    registry=REGISTRY)
+TAIL_DOMINANT_STAGE_TOTAL = Counter(
+    "router_tail_dominant_stage",
+    "Requests classified into their cohort's tail at close time (TTFT "
+    "above the rolling tailQuantile threshold), by the stage with the "
+    "largest excess over the cohort's body mean — the online twin of the "
+    "/debug/tails attribution", ("cohort", "stage"), registry=REGISTRY)
 # Multi-process sharded gateway (router/fleet.py): each worker exposes the
 # pool-snapshot epoch it last built (leader) or applied from the IPC stream
 # (follower) — the supervisor re-labels it per shard, making snapshot-IPC

@@ -246,7 +246,13 @@ class Director:
                     rec.finalize(429, reason=reason)
                 raise RequestError(429, reason)
 
-        # 7. schedule (off-loop via the scheduler pool when configured)
+        # 7. schedule (off-loop via the scheduler pool when configured).
+        # The waterfall's sched stage (router/tails.py) wraps the await:
+        # cycle compute PLUS the offload queue/dispatch wait — the
+        # request-visible scheduling cost, which the inline path and the
+        # pool path must account identically.
+        wf = getattr(request, "waterfall", None)
+        t_sched = time.monotonic() if wf is not None else 0.0
         try:
             result = await self._schedule(ctx, request, candidates)
         except Exception as e:
@@ -254,6 +260,8 @@ class Director:
             if rec is not None:
                 rec.finalize(503, reason=f"scheduling failed: {e}")
             raise RequestError(503, f"scheduling failed: {e}") from None
+        if wf is not None:
+            wf.sched_ms = (time.monotonic() - t_sched) * 1e3
         request.scheduling_result = result
 
         # 7b. shadow policy evaluation (router/shadow.py): submit the live

@@ -715,6 +715,12 @@ class Sidecar:
             v = finite_float_or_none(pull_ms)
             if v is not None:
                 self._h_kv_transfer.observe(v)
+        # Relay the decode engine's measured admission wait (same
+        # non-streaming caveat) so the router's tail waterfall can split
+        # engine queueing out of the decode residual (router/tails.py).
+        queue_ms = resp.headers.get("x-engine-queue-ms")
+        if queue_ms:
+            out_headers["x-engine-queue-ms"] = queue_ms
         # Local-decode fallback (and passthrough/monolithic fronting): the
         # decode engine's own prefix-hit headers relay unless a prefill
         # leg already supplied the authoritative pair (extra_headers).

@@ -1,0 +1,754 @@
+"""Tail-latency attribution observatory: per-request critical-path
+waterfalls and the body-vs-tail cohort ledger behind ``GET /debug/tails``.
+
+Every closed loop the router ships (SLO ledger, shadow regret, rebalance,
+autoscale) judges *whether* a request was slow; nothing explains *where the
+time went*. P/D-Serve (arXiv:2408.08147) shows the production tail is
+dominated by a *changing* culprit stage — queueing vs prefill vs KV pull vs
+decode — and NetKV (arXiv:2606.03910) shows transfer-pair skew specifically
+hides inside aggregate TTFT. Both signals are already captured per request
+here; this module is the read-side join that decomposes them.
+
+One ``RequestWaterfall`` rides each InferenceRequest (``request.waterfall``),
+mirroring the slo.py ``request.outcome`` discipline:
+
+- opened by the gateway before orchestration (beside ``SloLedger.start``);
+- stamped in place by the layer hooks, each a ``getattr(..., None)`` check
+  when the kill-switch is off: flow-control admission (queue wait),
+  the director's scheduling call (cycle + offload-dispatch time), the
+  gateway's failover walk (time burned in failed attempts), and the
+  response-header landing (``x-engine-queue-ms``, ``x-prefill-duration-ms``,
+  ``x-kv-transfer-ms``/``-bytes`` + the ``x-kv-prefiller`` pair identity);
+- closed exactly once on EVERY terminal path (first call wins), computing
+  the decode-side residual TTFT — client TTFT minus every accounted stage,
+  clamped at zero — and the streaming leg (first→last token).
+
+The closed waterfall is stamped as a ``waterfall`` block on the
+DecisionRecord (so ``/debug/decisions/<id>`` shows the stage split and
+``?stage=<dominant>`` pages straight to a culprit cohort), summarized in the
+``x-debug-decision`` echo, observed into ``router_stage_ms{stage}``, and fed
+to the per-(model, band, shape) cohort rings that ``/debug/tails`` renders:
+body-vs-tail split at ``tailQuantile``, per-stage p50/p95/p99, dominant-stage
+attribution of the tail cohort's excess time with culprit drill-down
+(endpoint, transfer pair, shed/degrade rung) and bounded exemplar request
+ids. ``merge_tails`` fans shard payloads in for the fleet supervisor:
+n-weighted stage quantiles via the bounded fixed-bin digests each cohort
+exports, shard-annotated exemplars.
+
+Config: ``tails: {enabled, capacity, tailQuantile, exemplars}`` — default-on
+(the kvCache precedent); ``enabled: false`` is bit-identical (no waterfall
+object is ever created, every hook degrades to one ``is None`` check).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from bisect import bisect_left
+from collections import OrderedDict, deque
+from typing import Any
+
+from .metrics import STAGE_MS, TAIL_DOMINANT_STAGE_TOTAL
+
+# Critical-path stage names, in waterfall order. ``decode`` is the RESIDUAL
+# stage (client TTFT minus every accounted stage, clamped >= 0 — clock skew
+# between router and engine/sidecar stamps must never mint negative time);
+# ``stream`` is the post-TTFT token relay (first→last token), outside the
+# TTFT critical path.
+STAGES = ("queue", "sched", "attempts", "engine_queue",
+          "prefill", "kv_transfer", "decode")
+STREAM_STAGE = "stream"
+
+# Fixed log-spaced digest bounds (ms) shared by every per-stage digest: the
+# bounded mergeable sketch merge_tails sums across shards. An upper bin
+# catches everything past the last bound; per-digest max tightens its edge.
+DIGEST_BOUNDS_MS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+                    500.0, 1000.0, 2500.0, 5000.0, 10000.0)
+
+# Cohort cache refresh cadence: the rolling tail threshold and body stage
+# means used for complete()-time classification are recomputed every N
+# closes (an O(capacity log capacity) sort amortized off the per-request
+# path).
+_REFRESH_EVERY = 32
+# Minimum ring population before a cohort starts classifying tails — a
+# 3-sample "p95" is noise, not a cohort.
+_MIN_SAMPLES = 20
+
+
+@dataclasses.dataclass
+class TailsConfig:
+    """The YAML ``tails:`` section (camelCase keys like the rest of the
+    config surface). Default-on per the kvCache precedent; ``enabled:
+    false`` is the bit-identical kill-switch the overhead contract
+    (``bench.py --tails``) measures."""
+
+    enabled: bool = True
+    capacity: int = 512        # per-cohort sample ring
+    tail_quantile: float = 0.95
+    exemplars: int = 8         # bounded exemplar request-ids per cohort
+
+    @classmethod
+    def from_spec(cls, spec: dict[str, Any] | None) -> "TailsConfig":
+        spec = spec or {}
+        q = float(spec.get("tailQuantile", 0.95))
+        return cls(enabled=bool(spec.get("enabled", True)),
+                   capacity=max(16, int(spec.get("capacity", 512))),
+                   tail_quantile=min(max(q, 0.5), 0.999),
+                   exemplars=max(0, int(spec.get("exemplars", 8))))
+
+
+class RequestWaterfall:
+    """One request's critical-path stage accumulator. Mutated in place by
+    the layer hooks; the observatory's ``complete()`` computes residual +
+    verdict exactly once (first call wins — error paths overlap the proxy's
+    finally, same as slo.py)."""
+
+    __slots__ = ("request_id", "model", "band", "t_start",
+                 "queue_ms", "sched_ms", "attempts_ms", "engine_queue_ms",
+                 "prefill_ms", "kv_transfer_ms", "kv_bytes", "pair",
+                 "endpoint", "shed_rung", "done")
+
+    def __init__(self, request_id: str, model: str, band: int,
+                 t_start: float):
+        self.request_id = request_id
+        self.model = model
+        self.band = band
+        self.t_start = t_start
+        self.queue_ms = 0.0
+        self.sched_ms = 0.0
+        self.attempts_ms = 0.0
+        self.engine_queue_ms = 0.0
+        self.prefill_ms = 0.0
+        self.kv_transfer_ms = 0.0
+        self.kv_bytes = 0
+        self.pair: str | None = None
+        self.endpoint = ""
+        self.shed_rung: str | None = None
+        self.done = False
+
+    def accounted_ms(self) -> float:
+        """Sum of every directly-measured pre-first-token stage (everything
+        but the decode residual)."""
+        return (self.queue_ms + self.sched_ms + self.attempts_ms
+                + self.engine_queue_ms + self.prefill_ms
+                + self.kv_transfer_ms)
+
+
+class _Digest:
+    """Bounded fixed-bin histogram sketch — the mergeable per-stage quantile
+    carrier for fleet fan-in. Bins share DIGEST_BOUNDS_MS; ``max`` tightens
+    the overflow bin's upper edge at quantile time."""
+
+    __slots__ = ("counts", "n", "sum_ms", "max_ms")
+
+    def __init__(self):
+        self.counts = [0] * (len(DIGEST_BOUNDS_MS) + 1)
+        self.n = 0
+        self.sum_ms = 0.0
+        self.max_ms = 0.0
+
+    def add(self, v: float) -> None:
+        self.counts[bisect_left(DIGEST_BOUNDS_MS, v)] += 1
+        self.n += 1
+        self.sum_ms += v
+        if v > self.max_ms:
+            self.max_ms = v
+
+    def to_doc(self) -> dict[str, Any]:
+        return {"counts": list(self.counts), "n": self.n,
+                "sum_ms": round(self.sum_ms, 3),
+                "max_ms": round(self.max_ms, 3)}
+
+
+def _digest_quantile(counts: list[int], n: int, max_ms: float,
+                     q: float) -> float | None:
+    """Linear-interpolated quantile from fixed-bin counts (the merged-shard
+    read path; single-shard /debug/tails quantiles come from the exact ring
+    instead)."""
+    if n <= 0:
+        return None
+    target = q * n
+    cum = 0
+    for i, c in enumerate(counts):
+        if c <= 0:
+            continue
+        lo = DIGEST_BOUNDS_MS[i - 1] if i > 0 else 0.0
+        hi = (DIGEST_BOUNDS_MS[i] if i < len(DIGEST_BOUNDS_MS)
+              else max(max_ms, lo))
+        if cum + c >= target:
+            frac = (target - cum) / c
+            return round(lo + (hi - lo) * min(max(frac, 0.0), 1.0), 3)
+        cum += c
+    return round(max_ms, 3)
+
+
+def _quantile(sorted_vals: list[float], q: float) -> float | None:
+    """Exact linear-interpolated quantile over a pre-sorted list."""
+    n = len(sorted_vals)
+    if not n:
+        return None
+    if n == 1:
+        return sorted_vals[0]
+    pos = q * (n - 1)
+    i = int(pos)
+    frac = pos - i
+    if i + 1 >= n:
+        return sorted_vals[-1]
+    return sorted_vals[i] + (sorted_vals[i + 1] - sorted_vals[i]) * frac
+
+
+class _Sample:
+    """One closed, served request in a cohort ring (compact slots — the
+    ring holds capacity× of these per cohort)."""
+
+    __slots__ = ("ttft_ms", "stages", "stream_ms", "request_id",
+                 "endpoint", "pair", "rung")
+
+    def __init__(self, ttft_ms: float, stages: tuple[float, ...],
+                 stream_ms: float, request_id: str, endpoint: str,
+                 pair: str | None, rung: str | None):
+        self.ttft_ms = ttft_ms
+        self.stages = stages          # aligned with STAGES
+        self.stream_ms = stream_ms
+        self.request_id = request_id
+        self.endpoint = endpoint
+        self.pair = pair
+        self.rung = rung
+
+
+class _Cohort:
+    """Rolling per-(model, band, shape) ledger: sample ring + the cached
+    classification state complete() reads. The per-stage digests are
+    derived FROM the ring at render time, so the digest window and the
+    quantile window are one and the same — and the close path stays out
+    of the digest-maintenance business entirely."""
+
+    __slots__ = ("ring", "exemplars",
+                 "closed", "tail_closed", "dominant_counts",
+                 "_since_refresh", "threshold_ms", "body_stage_means")
+
+    def __init__(self, capacity: int, exemplars: int):
+        self.ring: deque[_Sample] = deque(maxlen=capacity)
+        self.exemplars: deque[dict[str, Any]] = deque(maxlen=max(1, exemplars))
+        self.closed = 0
+        self.tail_closed = 0
+        self.dominant_counts: dict[str, int] = {}
+        self._since_refresh = 0
+        self.threshold_ms: float | None = None
+        self.body_stage_means: tuple[float, ...] = (0.0,) * len(STAGES)
+
+    def refresh(self, tail_q: float) -> None:
+        """Recompute the rolling tail threshold and body per-stage means
+        from the ring (amortized every _REFRESH_EVERY closes)."""
+        self._since_refresh = 0
+        n = len(self.ring)
+        if n < _MIN_SAMPLES:
+            self.threshold_ms = None
+            return
+        ttfts = sorted([s.ttft_ms for s in self.ring])
+        self.threshold_ms = _quantile(ttfts, tail_q)
+        thr = self.threshold_ms or 0.0
+        # Column-sum via zip(*rows): the per-sample Python inner loop was
+        # ~half the amortized close cost at capacity (bench.py --tails).
+        body = [s.stages for s in self.ring if s.ttft_ms <= thr]
+        if body:
+            body_n = len(body)
+            self.body_stage_means = tuple(
+                col_sum / body_n for col_sum in map(sum, zip(*body)))
+
+
+def _cohort_key(model: str, band: int, streamed: bool) -> str:
+    return f"{model}|b{band}|{'stream' if streamed else 'unary'}"
+
+
+def _fast_observer(child: Any):
+    """Pre-bound histogram observe for a labeled child: one C bisect over
+    the fixed bounds plus two value incs, skipping the public observe()'s
+    per-call validation and Python bounds walk. Falls back to the public
+    method if the client library's internals ever change shape."""
+    try:
+        sum_inc = child._sum.inc
+        bucket_incs = tuple(b.inc for b in child._buckets)
+        bounds = tuple(child._upper_bounds)
+    except AttributeError:
+        return child.observe
+    if len(bucket_incs) != len(bounds) or list(bounds) != sorted(bounds):
+        return child.observe
+
+    def observe(v: float, _sum_inc=sum_inc, _cells=bucket_incs,
+                _bounds=bounds) -> None:
+        _sum_inc(v)
+        _cells[bisect_left(_bounds, v)](1)
+
+    return observe
+
+
+class TailsObservatory:
+    """Fleet-level tail-attribution rollup. All writers run on the
+    gateway's event loop (the slo.py rule), so no locking; ``snapshot()``
+    renders a point-in-time view for /debug/tails."""
+
+    # Cohort cardinality is (models × bands × 2) — operationally bounded,
+    # but model names arrive from clients, so the table is LRU-capped like
+    # SloLedger.MAX_ENDPOINTS / TransferTable.MAX_PAIRS.
+    MAX_COHORTS = 128
+
+    def __init__(self, cfg: TailsConfig | None = None):
+        self.cfg = cfg or TailsConfig()
+        self._cohorts: OrderedDict[str, _Cohort] = OrderedDict()
+        self._start_unix = time.time()
+        # Cached metric children, pre-bound to their bucket cells: the
+        # close path feeds up to 6 histogram stages per request under a 1%
+        # cycle-floor budget (bench.py --tails), and the public observe()
+        # re-validates observability and walks the bounds in Python on
+        # every call — roughly half the whole hook's cost. (The timeline
+        # _burn_fast_g precedent, taken one step further.)
+        self._stage_hist = {s: _fast_observer(STAGE_MS.labels(s))
+                            for s in STAGES}
+        self._stage_hist[STREAM_STAGE] = _fast_observer(
+            STAGE_MS.labels(STREAM_STAGE))
+        self._dominant_children: dict[tuple[str, str], Any] = {}
+        # Flat counters the timeline sampler reads every tick (delta
+        # source — the SloLedger.totals precedent).
+        self.closed_total = 0
+        self.tail_total = 0
+        self.dominant_total: dict[str, int] = {}
+
+    @property
+    def enabled(self) -> bool:
+        return self.cfg.enabled
+
+    # ---- open -----------------------------------------------------------
+
+    def start(self, request: Any, t_start: float) -> RequestWaterfall | None:
+        """Open a waterfall (None when the kill-switch is off — every layer
+        hook then degrades to a single ``is None`` check and the request
+        object never grows a ``waterfall`` attribute: bit-identical)."""
+        if not self.cfg.enabled:
+            return None
+        wf = RequestWaterfall(request.request_id, request.target_model,
+                              request.objectives.priority, t_start)
+        request.waterfall = wf
+        return wf
+
+    # ---- close ----------------------------------------------------------
+
+    def complete(self, request: Any, *, status: int,
+                 endpoint: Any = None, usage: dict[str, int] | None = None,
+                 reason: str | None = None, shed: bool = False) -> None:
+        """Terminal accounting, exactly once per request (first call wins —
+        mirrors SloLedger.complete's signature so the gateway closes both
+        ledgers side by side on every terminal path)."""
+        wf: RequestWaterfall | None = getattr(request, "waterfall", None)
+        if wf is None or wf.done:
+            return
+        wf.done = True
+        now = time.monotonic()
+        # Band/model re-read at completion (the slo.py rationale: start()
+        # runs before the director resolves objectives/rewrites).
+        objectives = getattr(request, "objectives", None)
+        if objectives is not None:
+            wf.band = objectives.priority
+        wf.model = getattr(request, "target_model", wf.model)
+        if endpoint is not None:
+            wf.endpoint = endpoint.metadata.address_port
+        rec = getattr(request, "decision", None)
+        if wf.shed_rung is None and rec is not None:
+            # Shed/degrade rung culprit: the overload controller's ladder
+            # action (router/overload.py record_shed block) — a degraded-
+            # then-slow request's tail attribution names the rung.
+            shed_block = getattr(rec, "shed", None)
+            if isinstance(shed_block, dict) and shed_block.get("action"):
+                wf.shed_rung = str(shed_block["action"])
+        e2e_ms = (now - wf.t_start) * 1e3
+
+        # TTFT and the streamed shape come from the SLO observation when it
+        # exists (one clock discipline for both ledgers); fall back to
+        # e2e-as-TTFT for non-streamed success when slo is disabled.
+        obs = getattr(request, "outcome", None)
+        streamed = bool(getattr(obs, "streamed", False))
+        abort_reason = getattr(obs, "abort_reason", None)
+        ttft_ms: float | None = None
+        stream_ms = 0.0
+        first = getattr(obs, "first_token_at", None)
+        if first is not None:
+            ttft_ms = (first - wf.t_start) * 1e3
+            last = getattr(obs, "last_token_at", None)
+            if last is not None:
+                stream_ms = max(0.0, (last - first) * 1e3)
+        elif status < 400 and reason is None and abort_reason is None \
+                and not shed:
+            ttft_ms = e2e_ms
+        if obs is not None and obs.queue_ms and not wf.queue_ms:
+            wf.queue_ms = obs.queue_ms
+
+        # Verdict: the cohort rings hold SERVED requests only (a shed or
+        # errored request has no meaningful stage split past its refusal
+        # point), but the waterfall block stamps on every terminal shape.
+        if shed:
+            verdict = "shed"
+        elif reason is not None or abort_reason is not None or status >= 400:
+            verdict = "error"
+        else:
+            verdict = "ok"
+
+        # Decode residual: client TTFT minus every accounted stage. Clamped
+        # at zero — engine/sidecar stamps ride wall clocks on other hosts,
+        # so skew must never mint negative decode time. Slot reads hoisted
+        # once: this close path is the per-request hook the --tails bench
+        # holds under 1% of the scheduling-cycle floor.
+        q_ms, s_ms, a_ms = wf.queue_ms, wf.sched_ms, wf.attempts_ms
+        eq_ms, p_ms, kv_ms = (wf.engine_queue_ms, wf.prefill_ms,
+                              wf.kv_transfer_ms)
+        decode_ms = 0.0
+        if ttft_ms is not None:
+            decode_ms = max(0.0, ttft_ms - (q_ms + s_ms + a_ms + eq_ms
+                                            + p_ms + kv_ms))
+        stage_vals = (q_ms, s_ms, a_ms, eq_ms, p_ms, kv_ms, decode_ms)
+
+        self.closed_total += 1
+        tail = False
+        dominant: str | None = None
+        stages_doc: dict[str, Any] = {}
+        cohort_key = _cohort_key(wf.model, wf.band, streamed)
+        if verdict == "ok" and ttft_ms is not None:
+            cohort = self._cohort(cohort_key)
+            cohort.closed += 1
+            sample = _Sample(ttft_ms, stage_vals, stream_ms, wf.request_id,
+                             wf.endpoint, wf.pair, wf.shed_rung)
+            cohort.ring.append(sample)
+            hist = self._stage_hist
+            for name, v in zip(STAGES, stage_vals):
+                if v > 0.0:
+                    hist[name](v)
+                    stages_doc[name] = round(v, 3)
+            if stream_ms > 0.0:
+                hist[STREAM_STAGE](stream_ms)
+            cohort._since_refresh += 1
+            if cohort._since_refresh >= _REFRESH_EVERY \
+                    or cohort.threshold_ms is None:
+                cohort.refresh(self.cfg.tail_quantile)
+            thr = cohort.threshold_ms
+            if thr is not None and ttft_ms > thr:
+                # Complete()-time tail classification against the ROLLING
+                # threshold: the counter family and the exemplar ring want
+                # an online verdict; /debug/tails recomputes the split
+                # exactly from the ring at read time.
+                tail = True
+                best = -1.0
+                for name, v, m in zip(STAGES, stage_vals,
+                                      cohort.body_stage_means):
+                    excess = v - m
+                    if excess > best:
+                        best = excess
+                        dominant = name
+                cohort.tail_closed += 1
+                self.tail_total += 1
+                if dominant is not None:
+                    cohort.dominant_counts[dominant] = \
+                        cohort.dominant_counts.get(dominant, 0) + 1
+                    self.dominant_total[dominant] = \
+                        self.dominant_total.get(dominant, 0) + 1
+                    child = self._dominant_children.get((cohort_key, dominant))
+                    if child is None:
+                        child = TAIL_DOMINANT_STAGE_TOTAL.labels(
+                            cohort_key, dominant)
+                        self._dominant_children[(cohort_key, dominant)] = child
+                    child.inc()
+                    ex: dict[str, Any] = {
+                        "request_id": wf.request_id,
+                        "ttft_ms": round(ttft_ms, 3),
+                        "dominant": dominant,
+                        "excess_ms": round(best, 3),
+                    }
+                    if wf.endpoint:
+                        ex["endpoint"] = wf.endpoint
+                    if wf.pair:
+                        ex["pair"] = wf.pair
+                    if wf.shed_rung:
+                        ex["rung"] = wf.shed_rung
+                    cohort.exemplars.append(ex)
+
+        # Stamp the waterfall block into the decision record.
+        if rec is not None and hasattr(rec, "record_waterfall"):
+            if not stages_doc:  # non-ok verdicts skip the cohort loop
+                stages_doc = {name: round(v, 3)
+                              for name, v in zip(STAGES, stage_vals)
+                              if v > 0.0}
+            if stream_ms > 0.0:
+                stages_doc[STREAM_STAGE] = round(stream_ms, 3)
+            block: dict[str, Any] = {
+                "stages": stages_doc,
+                "e2e_ms": round(e2e_ms, 3),
+                "verdict": verdict,
+                "cohort": cohort_key,
+            }
+            if ttft_ms is not None:
+                block["ttft_ms"] = round(ttft_ms, 3)
+            if wf.pair:
+                block["pair"] = wf.pair
+            if wf.shed_rung:
+                block["rung"] = wf.shed_rung
+            if tail:
+                block["tail"] = True
+            if dominant is not None:
+                block["dominant"] = dominant
+            rec.record_waterfall(block)
+
+    def _cohort(self, key: str) -> _Cohort:
+        table = self._cohorts
+        cohort = table.get(key)
+        if cohort is not None:
+            table.move_to_end(key)
+            return cohort
+        if len(table) >= self.MAX_COHORTS:
+            table.popitem(last=False)
+        cohort = table[key] = _Cohort(self.cfg.capacity, self.cfg.exemplars)
+        return cohort
+
+    # ---- render ---------------------------------------------------------
+
+    def snapshot(self) -> dict[str, Any]:
+        """The /debug/tails payload: per-cohort body-vs-tail split with
+        per-stage quantiles, dominant-stage attribution of the tail
+        cohort's excess time, culprit drill-down, exemplars, and the
+        bounded digests merge_tails needs."""
+        doc: dict[str, Any] = {
+            "enabled": self.cfg.enabled,
+            "since_unix": self._start_unix,
+            "tail_quantile": self.cfg.tail_quantile,
+            "closed": self.closed_total,
+            "tail_closed": self.tail_total,
+            "cohorts": {key: self._render_cohort(c)
+                        for key, c in sorted(self._cohorts.items())},
+        }
+        return doc
+
+    def _render_cohort(self, cohort: _Cohort) -> dict[str, Any]:
+        samples = list(cohort.ring)
+        n = len(samples)
+        tail_q = self.cfg.tail_quantile
+        # Digests are built here, from the same ring the quantiles read —
+        # one rolling window for both, zero digest work on the close path.
+        digests = {name: _Digest() for name in STAGES}
+        ttft_digest = _Digest()
+        for s in samples:
+            ttft_digest.add(s.ttft_ms)
+            for name, v in zip(STAGES, s.stages):
+                if v > 0.0:
+                    digests[name].add(v)
+        out: dict[str, Any] = {
+            "closed": cohort.closed,
+            "tail_closed": cohort.tail_closed,
+            "window_n": n,
+            "digests": {name: d.to_doc() for name, d in digests.items()},
+            "ttft_digest": ttft_digest.to_doc(),
+            "exemplars": list(cohort.exemplars),
+        }
+        if not n:
+            return out
+        # Exact read-time split over the window (complete()-time counters
+        # above track the rolling/online view).
+        ttfts = sorted(s.ttft_ms for s in samples)
+        thr = _quantile(ttfts, tail_q) or 0.0
+        body = [s for s in samples if s.ttft_ms <= thr]
+        tail = [s for s in samples if s.ttft_ms > thr]
+        out["threshold_ttft_ms"] = round(thr, 3)
+        out["body_n"] = len(body)
+        out["tail_n"] = len(tail)
+        out["ttft_ms"] = _stage_quantiles([s.ttft_ms for s in samples])
+        stages_doc: dict[str, Any] = {}
+        for i, name in enumerate(STAGES):
+            vals = [s.stages[i] for s in samples]
+            if not any(v > 0.0 for v in vals):
+                continue
+            row = _stage_quantiles(vals)
+            if body:
+                row["body_mean_ms"] = round(
+                    sum(s.stages[i] for s in body) / len(body), 3)
+            if tail:
+                row["tail_mean_ms"] = round(
+                    sum(s.stages[i] for s in tail) / len(tail), 3)
+            stages_doc[name] = row
+        stream_vals = [s.stream_ms for s in samples if s.stream_ms > 0.0]
+        if stream_vals:
+            stages_doc[STREAM_STAGE] = _stage_quantiles(stream_vals)
+        out["stages"] = stages_doc
+        if body and tail:
+            out["attribution"] = _attribute(body, tail)
+        return out
+
+
+def _stage_quantiles(vals: list[float]) -> dict[str, Any]:
+    vals = sorted(vals)
+    return {"p50_ms": round(_quantile(vals, 0.50) or 0.0, 3),
+            "p95_ms": round(_quantile(vals, 0.95) or 0.0, 3),
+            "p99_ms": round(_quantile(vals, 0.99) or 0.0, 3)}
+
+
+def _attribute(body: list[_Sample], tail: list[_Sample]) -> dict[str, Any]:
+    """Dominant-stage attribution: how the tail cohort's excess TTFT (vs
+    the body mean) splits across stages, plus culprit drill-down from the
+    tail samples themselves. The shares answer "p99 TTFT is 71%
+    kv_transfer"; the culprits answer "concentrated on pair
+    prefill-X→decode-Y"."""
+    nb, nt = len(body), len(tail)
+    excess_by_stage: dict[str, float] = {}
+    total_excess = 0.0
+    for i, name in enumerate(STAGES):
+        body_mean = sum(s.stages[i] for s in body) / nb
+        tail_mean = sum(s.stages[i] for s in tail) / nt
+        excess = max(0.0, tail_mean - body_mean)
+        if excess > 0.0:
+            excess_by_stage[name] = excess
+            total_excess += excess
+    doc: dict[str, Any] = {
+        "tail_excess_ms_by_stage": {k: round(v, 3)
+                                    for k, v in excess_by_stage.items()},
+        "total_excess_ms": round(total_excess, 3),
+    }
+    if total_excess > 0.0:
+        shares = {k: v / total_excess for k, v in excess_by_stage.items()}
+        dominant = max(shares, key=shares.get)
+        doc["shares"] = {k: round(v, 4) for k, v in shares.items()}
+        doc["dominant"] = dominant
+        doc["dominant_share"] = round(shares[dominant], 4)
+        culprits: dict[str, Any] = {}
+        ep = _top_count(s.endpoint for s in tail if s.endpoint)
+        if ep is not None:
+            culprits["endpoint"] = {"value": ep[0], "tail_n": ep[1]}
+        pair = _top_count(s.pair for s in tail if s.pair)
+        if pair is not None:
+            culprits["pair"] = {"value": pair[0], "tail_n": pair[1]}
+        rung = _top_count(s.rung for s in tail if s.rung)
+        if rung is not None:
+            culprits["rung"] = {"value": rung[0], "tail_n": rung[1]}
+        if culprits:
+            doc["culprits"] = culprits
+        doc["statement"] = _statement(dominant, shares[dominant], culprits)
+    return doc
+
+
+def _top_count(values) -> tuple[str, int] | None:
+    counts: dict[str, int] = {}
+    for v in values:
+        counts[v] = counts.get(v, 0) + 1
+    if not counts:
+        return None
+    top = max(counts, key=counts.get)
+    return top, counts[top]
+
+
+def _statement(dominant: str, share: float,
+               culprits: dict[str, Any]) -> str:
+    s = f"tail TTFT excess is {share:.0%} {dominant}"
+    where = culprits.get("pair") or culprits.get("endpoint")
+    if where:
+        s += f", concentrated on {where['value']}"
+    return s
+
+
+# ---- fleet fan-in -------------------------------------------------------
+
+
+def merge_tails(shards: list[tuple[int, dict[str, Any]]]) -> dict[str, Any]:
+    """Fleet supervisor fan-in for /debug/tails: n-weighted per-stage
+    quantiles via the summed fixed-bin digests, n-weighted attribution from
+    the per-shard tail-excess totals, shard-annotated exemplars. Input:
+    (shard_index, worker /debug/tails payload) pairs."""
+    merged: dict[str, Any] = {
+        "shards": len(shards),
+        "enabled": any(doc.get("enabled") for _, doc in shards),
+        "closed": sum(int(doc.get("closed") or 0) for _, doc in shards),
+        "tail_closed": sum(int(doc.get("tail_closed") or 0)
+                           for _, doc in shards),
+    }
+    quantiles = (0.50, 0.95, 0.99)
+    cohorts: dict[str, dict[str, Any]] = {}
+    for key in sorted({k for _, doc in shards
+                       for k in (doc.get("cohorts") or {})}):
+        rows = [(shard, (doc.get("cohorts") or {}).get(key))
+                for shard, doc in shards]
+        rows = [(shard, c) for shard, c in rows if isinstance(c, dict)]
+        if not rows:
+            continue
+        out: dict[str, Any] = {
+            "closed": sum(int(c.get("closed") or 0) for _, c in rows),
+            "tail_closed": sum(int(c.get("tail_closed") or 0)
+                               for _, c in rows),
+            "window_n": sum(int(c.get("window_n") or 0) for _, c in rows),
+            "body_n": sum(int(c.get("body_n") or 0) for _, c in rows),
+            "tail_n": sum(int(c.get("tail_n") or 0) for _, c in rows),
+        }
+        # n-weighted stage quantiles: sum each stage's fixed-bin digest
+        # across shards, then read quantiles off the merged sketch.
+        stages_doc: dict[str, Any] = {}
+        for name in list(STAGES) + [STREAM_STAGE, "ttft"]:
+            counts = [0] * (len(DIGEST_BOUNDS_MS) + 1)
+            n = 0
+            max_ms = 0.0
+            for _, c in rows:
+                d = (c.get("ttft_digest") if name == "ttft"
+                     else (c.get("digests") or {}).get(name))
+                if not isinstance(d, dict):
+                    continue
+                dc = d.get("counts") or []
+                for i in range(min(len(counts), len(dc))):
+                    counts[i] += int(dc[i])
+                n += int(d.get("n") or 0)
+                max_ms = max(max_ms, float(d.get("max_ms") or 0.0))
+            if n <= 0:
+                continue
+            stages_doc[name] = {
+                f"p{int(q * 100)}_ms": _digest_quantile(counts, n, max_ms, q)
+                for q in quantiles}
+            stages_doc[name]["n"] = n
+        if stages_doc:
+            ttft_row = stages_doc.pop("ttft", None)
+            if ttft_row is not None:
+                out["ttft_ms"] = ttft_row
+            out["stages"] = stages_doc
+        # n-weighted attribution: tail_n-weighted sum of each shard's
+        # per-stage tail excess, shares recomputed over the merged totals.
+        excess: dict[str, float] = {}
+        for _, c in rows:
+            attr = c.get("attribution") or {}
+            tn = int(c.get("tail_n") or 0)
+            for stage, ms in (attr.get("tail_excess_ms_by_stage")
+                              or {}).items():
+                try:
+                    excess[stage] = excess.get(stage, 0.0) + float(ms) * tn
+                except (TypeError, ValueError):
+                    continue
+        total = sum(excess.values())
+        if total > 0.0:
+            shares = {k: v / total for k, v in excess.items()}
+            dominant = max(shares, key=shares.get)
+            out["attribution"] = {
+                "shares": {k: round(v, 4) for k, v in shares.items()},
+                "dominant": dominant,
+                "dominant_share": round(shares[dominant], 4),
+            }
+            # Culprit fan-in: the most tail-loaded shard's culprits speak
+            # for the merged cohort (each shard already reduced its own
+            # window; re-reducing value counts across shards would need the
+            # raw samples the digests exist to avoid shipping).
+            top_shard = max(rows, key=lambda r: int(r[1].get("tail_n") or 0))
+            culprits = (top_shard[1].get("attribution") or {}).get("culprits")
+            if culprits:
+                out["attribution"]["culprits"] = culprits
+                out["attribution"]["culprit_shard"] = top_shard[0]
+            out["attribution"]["statement"] = _statement(
+                dominant, shares[dominant], culprits or {})
+        # Shard-annotated exemplars, bounded to one cohort's worth.
+        exemplars: list[dict[str, Any]] = []
+        for shard, c in rows:
+            for ex in c.get("exemplars") or []:
+                if isinstance(ex, dict):
+                    exemplars.append({**ex, "shard": shard})
+        exemplars.sort(key=lambda e: -(e.get("ttft_ms") or 0.0))
+        if exemplars:
+            out["exemplars"] = exemplars[:8]
+        cohorts[key] = out
+    merged["cohorts"] = cohorts
+    return merged

@@ -477,7 +477,8 @@ class _Baseline:
                  "good_tokens", "prompt_tokens", "degraded", "kv_stamps",
                  "kv_joins", "gc_pause_s", "by_role",
                  "shadow_eval", "shadow_div", "shadow_regret", "flips",
-                 "as_actions", "as_refusals", "as_rollbacks")
+                 "as_actions", "as_refusals", "as_rollbacks",
+                 "tails_closed", "tails_tail", "tails_dominant")
 
     def __init__(self):
         self.requests = 0
@@ -498,6 +499,9 @@ class _Baseline:
         self.as_actions = 0
         self.as_refusals = 0
         self.as_rollbacks = 0
+        self.tails_closed = 0
+        self.tails_tail = 0
+        self.tails_dominant: dict[str, int] = {}
 
 
 class TimelineSampler:
@@ -531,6 +535,7 @@ class TimelineSampler:
                  rebalance: Any = None,
                  forecast: Any = None,
                  autoscale: Any = None,
+                 tails: Any = None,
                  wall: Callable[[], float] = time.time):
         self.cfg = cfg
         self.slo_ledger = slo_ledger
@@ -557,6 +562,10 @@ class TimelineSampler:
         # deltas + the freeze latch, so a scaling action (or rollback)
         # lands in the same ring tick as the traffic swing it answered.
         self.autoscale = autoscale
+        # Tail observatory (router/tails.py): per-tick closed/tail deltas
+        # plus the dominant-stage mix, so an incident snapshot embeds
+        # WHICH stage the tail was at trigger time.
+        self.tails = tails
         self._wall = wall
         self.ring: deque[dict[str, Any]] = deque(maxlen=cfg.ring_capacity)
         self.burn = BurnRateMonitor(cfg)
@@ -785,6 +794,25 @@ class TimelineSampler:
             if ac.frozen:
                 row["frozen"] = True
             sample["autoscale"] = row
+
+        # Tail observatory (router/tails.py): closed/tail-cohort deltas +
+        # the dominant-stage mix — flat counter reads, so an incident
+        # snapshot embeds WHICH stage owned the tail at trigger time.
+        to = self.tails
+        if to is not None and to.enabled:
+            row = {"closed": to.closed_total - prev.tails_closed,
+                   "tail": to.tail_total - prev.tails_tail}
+            prev.tails_closed = to.closed_total
+            prev.tails_tail = to.tail_total
+            dom: dict[str, int] = {}
+            for stage, n in to.dominant_total.items():
+                d = n - prev.tails_dominant.get(stage, 0)
+                prev.tails_dominant[stage] = n
+                if d:
+                    dom[stage] = d
+            if dom:
+                row["dominant"] = dom
+            sample["tails"] = row
 
         # Process self-telemetry (gauges + the timeline series). The /proc
         # reads are real syscalls (~15-25µs together), so they run every

@@ -129,6 +129,11 @@ REQUIRED_FAMILIES = {
     ("router_autoscale_frozen", "router"),
     ("router_fleet_size", "router"),
     ("router_shard_state", "fleet"),
+    # Tail-latency attribution observatory (ISSUE 18): the per-stage
+    # critical-path histogram and the per-cohort dominant-stage counter
+    # behind /debug/tails.
+    ("router_stage_ms", "router"),
+    ("router_tail_dominant_stage", "router"),
 }
 
 # Registries whose every family must have a docs/metrics.md row (the

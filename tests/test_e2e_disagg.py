@@ -606,3 +606,140 @@ schedulingProfiles:
             await ea.stop()
 
     asyncio.run(body())
+
+
+def test_golden_disagg_waterfall_and_stream_header_time_join():
+    """Golden tail waterfall through the full disagg path (router/tails.py):
+    the decision record's waterfall block must decompose the request into
+    queue (flow-control wait) + sched + prefill + kv_transfer + decode
+    residual — every stage > 0, stages summing back to the TTFT — and the
+    /debug/tails cohort ledger must have absorbed it. Second half: the
+    per-pair TransferTable row must land at HEADER time for STREAMED
+    responses too (the PR 10 gap), observable while the stream is open."""
+    GW8, SC8, DEC8, PRE8 = 18990, 18991, 18992, 18993
+
+    cfg = f"""
+featureGates: {{flowControl: true}}
+pool:
+  endpoints:
+    - {{address: 127.0.0.1, port: {SC8}, labels: {{llm-d.ai/role: decode}}}}
+    - {{address: 127.0.0.1, port: {PRE8}, labels: {{llm-d.ai/role: prefill}}}}
+plugins:
+  - {{type: decode-filter}}
+  - {{type: prefill-filter}}
+  - {{type: queue-scorer}}
+  - type: disagg-profile-handler
+    parameters:
+      pdDecider:
+        type: prefix-based-pd-decider
+        parameters: {{thresholdTokens: 16}}
+schedulingProfiles:
+  - name: decode
+    plugins:
+      - {{pluginRef: decode-filter}}
+      - {{pluginRef: queue-scorer, weight: 2}}
+  - name: prefill
+    plugins:
+      - {{pluginRef: prefill-filter}}
+      - {{pluginRef: queue-scorer}}
+"""
+
+    async def body():
+        dec = _engine(DEC8, "decode")
+        pre = _engine(PRE8, "prefill")
+        await dec.start()
+        await pre.start()
+        sc = Sidecar(SidecarConfig(port=SC8,
+                                   decoder_url=f"http://127.0.0.1:{DEC8}",
+                                   ssrf_allowlist=[f"127.0.0.1:{PRE8}"]))
+        await sc.start()
+        gw = build_gateway(cfg, port=GW8, poll_interval=0.02)
+        await gw.start()
+        try:
+            async with httpx.AsyncClient(timeout=120) as c:
+                r = await c.post(f"http://127.0.0.1:{GW8}/v1/completions",
+                                 json={"model": "tiny", "prompt": LONG_PROMPT,
+                                       "max_tokens": 4, "temperature": 0},
+                                 headers={"x-request-id": "wf-gold-1",
+                                          "x-debug-decision": "summary"})
+                assert r.status_code == 200
+                # The echo header leaves before the waterfall closes, so
+                # it carries the pre-close summary; the post-close list
+                # view's summary (below) gains the TTFT note.
+                assert "winner=" in r.headers["x-decision-summary"]
+
+                lst = (await c.get(f"http://127.0.0.1:{GW8}"
+                                   "/debug/decisions?n=5")).json()
+                row = next(d for d in lst["decisions"]
+                           if d["request_id"] == "wf-gold-1")
+                assert "ttft=" in row["summary"]
+
+                rec = (await c.get(f"http://127.0.0.1:{GW8}"
+                                   "/debug/decisions/wf-gold-1")).json()
+                wf = rec["waterfall"]
+                assert wf["verdict"] == "ok"
+                assert wf["cohort"] == "tiny|b0|unary"
+                st = wf["stages"]
+                # Every critical-path stage measured and positive: the
+                # flow-control queue wait, the scheduling cycle, the
+                # prefill leg, the measured KV pull, and the decode
+                # residual that absorbs the rest of the TTFT.
+                for stage in ("queue", "sched", "prefill", "kv_transfer",
+                              "decode"):
+                    assert st.get(stage, 0) > 0, f"stage {stage} missing"
+                # Non-streamed: TTFT == e2e, and the stages (decode being
+                # the residual) reassemble it to rounding tolerance.
+                assert wf["ttft_ms"] > 0
+                assert abs(wf["e2e_ms"] - wf["ttft_ms"]) < 5.0
+                assert abs(sum(st.values()) - wf["ttft_ms"]) < 5.0
+                assert wf["pair"] == \
+                    f"127.0.0.1:{PRE8}→127.0.0.1:{SC8}"
+
+                # The tail observatory absorbed the served request.
+                tails = (await c.get(
+                    f"http://127.0.0.1:{GW8}/debug/tails")).json()
+                assert tails["enabled"] is True
+                cohort = tails["cohorts"]["tiny|b0|unary"]
+                assert cohort["closed"] >= 1
+                assert cohort["digests"]["kv_transfer"]["n"] >= 1
+
+                # And the stage histogram family saw the same close.
+                m = await c.get(f"http://127.0.0.1:{GW8}/metrics")
+                assert 'router_stage_ms_count{stage="kv_transfer"}' in m.text
+
+                # ---- streamed header-time pair landing (PR 10 gap) ----
+                tr = (await c.get(
+                    f"http://127.0.0.1:{GW8}/debug/transfers")).json()
+                row = next(p for p in tr["pairs"]
+                           if p["prefill"] == f"127.0.0.1:{PRE8}")
+                stamp_before = row["last_unix"]
+
+                # A DIFFERENT long prompt (cold for the approx index, so
+                # the PD decider splits again), streamed this time.
+                stream_prompt = ("stream this other important document: "
+                                 * 4)
+                async with c.stream(
+                        "POST", f"http://127.0.0.1:{GW8}/v1/completions",
+                        json={"model": "tiny", "prompt": stream_prompt,
+                              "max_tokens": 64, "stream": True},
+                        headers={"x-request-id": "wf-stream-1"}) as sr:
+                    assert sr.status_code == 200
+                    # Response headers are on the wire but the token
+                    # stream is NOT consumed yet: the pair row must have
+                    # landed already (header-time join — pre-PR-18 it
+                    # waited for the terminal usage chunk).
+                    tr = (await c.get(
+                        f"http://127.0.0.1:{GW8}/debug/transfers")).json()
+                    row = next(p for p in tr["pairs"]
+                               if p["prefill"] == f"127.0.0.1:{PRE8}")
+                    assert row["last_unix"] > stamp_before
+                    assert row["ewma_prefill_ms"] > 0
+                    async for _ in sr.aiter_bytes():
+                        pass
+        finally:
+            await gw.stop()
+            await sc.stop()
+            await pre.stop()
+            await dec.stop()
+
+    asyncio.run(body())

@@ -1,0 +1,365 @@
+"""Tail-latency attribution observatory (router/tails.py): waterfall
+assembly on every terminal shape, the decode residual clamp, body-vs-tail
+cohort split + dominant-stage attribution, exemplar bounds, the
+kill-switch, fleet fan-in weighting, the ?stage= list filter, and the
+engine-side first-pop-wins queue-wait measurement."""
+
+import time
+from types import SimpleNamespace
+
+from llm_d_inference_scheduler_tpu.router.decisions import (
+    DecisionRecord,
+    record_matches,
+)
+from llm_d_inference_scheduler_tpu.router.framework.datalayer import (
+    Endpoint,
+    EndpointMetadata,
+)
+from llm_d_inference_scheduler_tpu.router.framework.scheduling import (
+    InferenceRequest,
+    InferenceRequestBody,
+    Objectives,
+)
+from llm_d_inference_scheduler_tpu.router.tails import (
+    STAGES,
+    TailsConfig,
+    TailsObservatory,
+    merge_tails,
+)
+from llm_d_inference_scheduler_tpu.router.timeline import (
+    TimelineConfig,
+    TimelineSampler,
+)
+
+
+def _req(rid="r1", model="m", priority=0) -> InferenceRequest:
+    return InferenceRequest(
+        request_id=rid, target_model=model,
+        body=InferenceRequestBody(completions={"prompt": "x"}),
+        headers={}, objectives=Objectives(priority=priority))
+
+
+def _ep(port=9001) -> Endpoint:
+    return Endpoint(EndpointMetadata(name=f"e{port}", address="127.0.0.1",
+                                     port=port, labels={}))
+
+
+def _obs(t0, ttft_ms=None, last_ms=None, streamed=False, queue_ms=0.0):
+    """Duck-typed slo.py RequestObservation — only the fields tails reads."""
+    first = t0 + ttft_ms / 1e3 if ttft_ms is not None else None
+    last = t0 + last_ms / 1e3 if last_ms is not None else None
+    return SimpleNamespace(first_token_at=first, last_token_at=last,
+                           streamed=streamed, abort_reason=None,
+                           queue_ms=queue_ms)
+
+
+def _close_served(obs_ry, rid, ttft_ms, stages=None, pair=None,
+                  model="m", priority=0, endpoint=None):
+    """Open + stamp + complete one served (verdict ok) request."""
+    req = _req(rid, model=model, priority=priority)
+    req.decision = DecisionRecord(rid, model)
+    t0 = time.monotonic()
+    wf = obs_ry.start(req, t0)
+    for name, v in (stages or {}).items():
+        setattr(wf, f"{name}_ms", v)
+    wf.pair = pair
+    req.outcome = _obs(t0, ttft_ms=ttft_ms)
+    obs_ry.complete(req, status=200, endpoint=endpoint or _ep())
+    return req
+
+
+# ---- config / kill-switch ----------------------------------------------
+
+
+def test_from_spec_defaults_and_clamps():
+    cfg = TailsConfig.from_spec(None)
+    assert cfg.enabled and cfg.capacity == 512
+    assert cfg.tail_quantile == 0.95 and cfg.exemplars == 8
+    cfg = TailsConfig.from_spec({"capacity": 2, "tailQuantile": 2.0,
+                                 "exemplars": -1})
+    assert cfg.capacity == 16          # floor
+    assert cfg.tail_quantile == 0.999  # clamp
+    assert cfg.exemplars == 0
+
+
+def test_killswitch_is_inert():
+    obs_ry = TailsObservatory(TailsConfig.from_spec({"enabled": False}))
+    req = _req()
+    assert obs_ry.start(req, time.monotonic()) is None
+    # No waterfall attribute ever rides the request (bit-identical).
+    assert getattr(req, "waterfall", None) is None
+    obs_ry.complete(req, status=200)  # no-op, not a crash
+    snap = obs_ry.snapshot()
+    assert snap["enabled"] is False
+    assert snap["closed"] == 0 and snap["cohorts"] == {}
+
+
+# ---- waterfall assembly -------------------------------------------------
+
+
+def test_waterfall_block_and_decode_residual():
+    obs_ry = TailsObservatory()
+    req = _close_served(obs_ry, "w1", ttft_ms=100.0,
+                        stages={"queue": 10.0, "sched": 5.0,
+                                "prefill": 30.0, "kv_transfer": 20.0},
+                        pair="127.0.0.1:1→127.0.0.1:2")
+    block = req.decision.waterfall
+    assert block["verdict"] == "ok"
+    assert block["cohort"] == "m|b0|unary"
+    assert abs(block["ttft_ms"] - 100.0) < 1.0
+    st = block["stages"]
+    assert st["queue"] == 10.0 and st["prefill"] == 30.0
+    assert st["kv_transfer"] == 20.0
+    # Residual: TTFT minus every accounted stage.
+    assert abs(st["decode"] - 35.0) < 1.0
+    assert block["pair"] == "127.0.0.1:1→127.0.0.1:2"
+    # Sums: stages (incl. residual) reassemble the TTFT.
+    assert abs(sum(st.values()) - block["ttft_ms"]) < 1.0
+    # Summary echo names the waterfall.
+    assert "ttft=" in req.decision.summary_line()
+
+
+def test_residual_never_negative_under_clock_skew():
+    obs_ry = TailsObservatory()
+    # Engine-stamped stages exceed the observed TTFT (cross-host clock
+    # skew): the residual clamps at zero instead of minting negative time.
+    req = _close_served(obs_ry, "w2", ttft_ms=50.0,
+                        stages={"prefill": 200.0})
+    st = req.decision.waterfall["stages"]
+    assert "decode" not in st  # clamped to 0 → not emitted
+    assert all(v >= 0 for v in st.values())
+
+
+def test_streamed_shape_gets_stream_stage():
+    obs_ry = TailsObservatory()
+    req = _req("w3")
+    req.decision = DecisionRecord("w3", "m")
+    t0 = time.monotonic()
+    obs_ry.start(req, t0)
+    req.outcome = _obs(t0, ttft_ms=40.0, last_ms=90.0, streamed=True)
+    obs_ry.complete(req, status=200, endpoint=_ep())
+    block = req.decision.waterfall
+    assert block["cohort"] == "m|b0|stream"
+    assert abs(block["stages"]["stream"] - 50.0) < 1.0
+
+
+def test_queue_backfills_from_slo_observation():
+    obs_ry = TailsObservatory()
+    req = _req("w4")
+    t0 = time.monotonic()
+    obs_ry.start(req, t0)
+    req.outcome = _obs(t0, ttft_ms=30.0, queue_ms=12.0)
+    req.decision = DecisionRecord("w4", "m")
+    obs_ry.complete(req, status=200, endpoint=_ep())
+    assert req.decision.waterfall["stages"]["queue"] == 12.0
+
+
+# ---- terminal shapes ----------------------------------------------------
+
+
+def test_error_shed_abort_verdicts_skip_cohorts():
+    obs_ry = TailsObservatory()
+    # Error status.
+    req = _req("e1")
+    req.decision = DecisionRecord("e1", "m")
+    obs_ry.start(req, time.monotonic())
+    obs_ry.complete(req, status=500)
+    assert req.decision.waterfall["verdict"] == "error"
+    # Shed.
+    req = _req("e2")
+    req.decision = DecisionRecord("e2", "m")
+    obs_ry.start(req, time.monotonic())
+    obs_ry.complete(req, status=429, reason="shed under saturation",
+                    shed=True)
+    assert req.decision.waterfall["verdict"] == "shed"
+    # Mid-stream abort (status 200 but the observation says aborted).
+    req = _req("e3")
+    req.decision = DecisionRecord("e3", "m")
+    t0 = time.monotonic()
+    obs_ry.start(req, t0)
+    o = _obs(t0, ttft_ms=10.0, streamed=True)
+    o.abort_reason = "client-disconnect"
+    req.outcome = o
+    obs_ry.complete(req, status=200)
+    assert req.decision.waterfall["verdict"] == "error"
+    # All three closed, none fed a cohort ring (served-only).
+    snap = obs_ry.snapshot()
+    assert snap["closed"] == 3 and snap["cohorts"] == {}
+
+
+def test_complete_is_first_call_wins():
+    obs_ry = TailsObservatory()
+    req = _close_served(obs_ry, "d1", ttft_ms=20.0)
+    obs_ry.complete(req, status=500)  # duplicate close must be a no-op
+    assert obs_ry.closed_total == 1
+    assert req.decision.waterfall["verdict"] == "ok"
+
+
+def test_shed_rung_culprit_read_from_decision_record():
+    obs_ry = TailsObservatory()
+    req = _req("s1")
+    rec = DecisionRecord("s1", "m")
+    rec.record_shed({"action": "drop-context", "reason": "overload"})
+    req.decision = rec
+    t0 = time.monotonic()
+    obs_ry.start(req, t0)
+    req.outcome = _obs(t0, ttft_ms=15.0)
+    obs_ry.complete(req, status=200, endpoint=_ep())
+    assert rec.waterfall["rung"] == "drop-context"
+
+
+# ---- cohort split + attribution -----------------------------------------
+
+
+def _skewed_observatory(n_body=96, n_tail=4, exemplars=8):
+    # Tail fraction stays under (1 - tailQuantile) so the rolling p95
+    # threshold sits inside the body band, not on the slow value.
+    obs_ry = TailsObservatory(TailsConfig(capacity=256,
+                                          exemplars=exemplars))
+    for i in range(n_body):
+        _close_served(obs_ry, f"b{i}", ttft_ms=50.0,
+                      stages={"queue": 2.0, "prefill": 10.0,
+                              "kv_transfer": 5.0})
+    for i in range(n_tail):
+        _close_served(obs_ry, f"t{i}", ttft_ms=260.0,
+                      stages={"queue": 2.0, "prefill": 10.0,
+                              "kv_transfer": 215.0},
+                      pair="127.0.0.1:9100→127.0.0.1:9001",
+                      endpoint=_ep(9001))
+    return obs_ry
+
+
+def test_cohort_split_and_dominant_stage_attribution():
+    obs_ry = _skewed_observatory()
+    snap = obs_ry.snapshot()
+    cohort = snap["cohorts"]["m|b0|unary"]
+    assert cohort["window_n"] == 100
+    assert cohort["body_n"] + cohort["tail_n"] == 100
+    assert cohort["tail_n"] >= 1
+    # The tail cohort's excess time is overwhelmingly the injected stage.
+    attr = cohort["attribution"]
+    assert attr["dominant"] == "kv_transfer"
+    assert attr["dominant_share"] >= 0.6
+    assert "kv_transfer" in attr["statement"]
+    # Culprit drill-down names the skewed transfer pair.
+    assert attr["culprits"]["pair"]["value"] == \
+        "127.0.0.1:9100→127.0.0.1:9001"
+    assert attr["culprits"]["endpoint"]["value"] == "127.0.0.1:9001"
+    # Online classification fed the flat counters + the metric family.
+    assert obs_ry.tail_total > 0
+    assert obs_ry.dominant_total.get("kv_transfer", 0) > 0
+    # Body cohort is unattributed: its stages sit at their own means.
+    assert cohort["stages"]["kv_transfer"]["body_mean_ms"] < 10
+
+
+def test_tail_classified_records_page_via_stage_filter():
+    obs_ry = _skewed_observatory()
+    ex = obs_ry.snapshot()["cohorts"]["m|b0|unary"]["exemplars"]
+    assert ex, "tail exemplars expected"
+    # Exemplar rows carry the drill-down identity.
+    assert all(e["dominant"] == "kv_transfer" for e in ex)
+    assert all("request_id" in e and e["ttft_ms"] > 0 for e in ex)
+
+
+def test_exemplar_ring_is_bounded():
+    obs_ry = _skewed_observatory(n_body=60, n_tail=40, exemplars=4)
+    ex = obs_ry.snapshot()["cohorts"]["m|b0|unary"]["exemplars"]
+    assert len(ex) <= 4
+
+
+def test_cohort_table_is_lru_capped():
+    obs_ry = TailsObservatory()
+    for i in range(TailsObservatory.MAX_COHORTS + 10):
+        _close_served(obs_ry, f"c{i}", ttft_ms=10.0, model=f"m{i}")
+    assert len(obs_ry.snapshot()["cohorts"]) == TailsObservatory.MAX_COHORTS
+
+
+# ---- decisions ?stage= filter -------------------------------------------
+
+
+def test_record_matches_stage_filter():
+    doc = {"waterfall": {"dominant": "kv_transfer", "tail": True}}
+    assert record_matches(doc, stage="kv_transfer")
+    assert not record_matches(doc, stage="decode")
+    # Records without a tail verdict (or any waterfall) match nothing.
+    assert not record_matches({"waterfall": {"stages": {}}}, stage="decode")
+    assert not record_matches({}, stage="decode")
+
+
+# ---- timeline row -------------------------------------------------------
+
+
+def test_timeline_tick_embeds_tails_deltas():
+    obs_ry = _skewed_observatory()
+    sampler = TimelineSampler(TimelineConfig.from_spec({"tickS": 1.0}),
+                              tails=obs_ry)
+    row = sampler.tick(wall=1000.0)["tails"]
+    assert row["closed"] == obs_ry.closed_total
+    assert row["tail"] == obs_ry.tail_total
+    assert row["dominant"].get("kv_transfer", 0) > 0
+    # Deltas, not totals: a quiet tick reads zero.
+    row = sampler.tick(wall=1001.0)["tails"]
+    assert row == {"closed": 0, "tail": 0}
+
+
+# ---- fleet fan-in -------------------------------------------------------
+
+
+def test_merge_tails_weights_by_n_and_annotates_shards():
+    heavy = _skewed_observatory()
+    light = TailsObservatory()
+    for i in range(30):
+        _close_served(light, f"l{i}", ttft_ms=20.0,
+                      stages={"prefill": 8.0})
+    merged = merge_tails([(0, heavy.snapshot()), (1, light.snapshot())])
+    assert merged["shards"] == 2 and merged["enabled"]
+    assert merged["closed"] == heavy.closed_total + light.closed_total
+    cohort = merged["cohorts"]["m|b0|unary"]
+    assert cohort["window_n"] == 130
+    # Digest-merged stage quantiles carry the combined population.
+    assert cohort["stages"]["prefill"]["n"] == 130
+    assert cohort["ttft_ms"]["n"] == 130
+    assert cohort["ttft_ms"]["p99_ms"] > cohort["ttft_ms"]["p50_ms"]
+    # Attribution comes from the (only) shard with tail excess; its
+    # culprits speak for the merged cohort, tagged with the shard.
+    attr = cohort["attribution"]
+    assert attr["dominant"] == "kv_transfer"
+    assert attr["culprit_shard"] == 0
+    assert attr["culprits"]["pair"]["value"] == \
+        "127.0.0.1:9100→127.0.0.1:9001"
+    # Exemplars are shard-annotated and bounded.
+    ex = cohort["exemplars"]
+    assert ex and len(ex) <= 8
+    assert all(e["shard"] == 0 for e in ex)
+
+
+def test_merge_tails_empty_and_disabled_shards():
+    merged = merge_tails([])
+    assert merged["shards"] == 0 and merged["cohorts"] == {}
+    off = TailsObservatory(TailsConfig(enabled=False))
+    merged = merge_tails([(0, off.snapshot())])
+    assert merged["enabled"] is False and merged["closed"] == 0
+
+
+# ---- engine queue-wait measurement --------------------------------------
+
+
+def test_engine_queue_wait_is_first_pop_wins_and_bounded():
+    from llm_d_inference_scheduler_tpu.engine.core import TpuEngine
+
+    stub = SimpleNamespace(_queue_submit={}, queue_waits={},
+                           _queue_wait_order=__import__("collections").deque())
+    stub._queue_submit["r1"] = time.monotonic() - 0.05
+    TpuEngine._record_queue_wait(stub, "r1")
+    first = stub.queue_waits["r1"]
+    assert first >= 50.0
+    # A KV-fetch re-insert pops again: the stamp is consumed, so the wait
+    # is NOT re-measured (first-pop-wins keeps it disjoint from the
+    # transfer stage).
+    TpuEngine._record_queue_wait(stub, "r1")
+    assert stub.queue_waits["r1"] == first
+    # Bounded ring: 512 entries max.
+    for i in range(600):
+        stub._queue_submit[f"x{i}"] = time.monotonic()
+        TpuEngine._record_queue_wait(stub, f"x{i}")
+    assert len(stub.queue_waits) <= 512
