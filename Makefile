@@ -12,17 +12,17 @@ PY ?= python
 	dryrun render-chart \
 	compile-check \
 	verify-metrics verify-decisions verify-hotpath verify-threadsafe \
-	verify-slo verify-debug verify-fleet
+	verify-vectorized verify-slo verify-debug verify-fleet
 
 # Full hermetic suite (virtual 8-device CPU mesh; no TPU or cluster needed —
 # the reference needs envtest + kind for the equivalent coverage).
-test: verify-metrics verify-decisions verify-hotpath verify-threadsafe verify-slo verify-debug
+test: verify-metrics verify-decisions verify-hotpath verify-threadsafe verify-vectorized verify-slo verify-debug
 	$(PY) -m pytest tests/ -q
 
 # Everything except the spawned-process distributed tests (the slow tail)
 # and the slow-marked multi-process fleet drills (those ride
 # make test-chaos / make verify-fleet).
-test-fast: verify-metrics verify-decisions verify-hotpath verify-threadsafe verify-debug
+test-fast: verify-metrics verify-decisions verify-hotpath verify-threadsafe verify-vectorized verify-debug
 	$(PY) -m pytest tests/ -q -m "not slow" \
 		--deselect tests/test_multihost.py \
 		--deselect tests/test_multihost_pd.py
@@ -51,6 +51,14 @@ verify-hotpath:
 # offload (also hooked into pytest via tests/test_schedpool.py).
 verify-threadsafe:
 	$(PY) scripts/verify_threadsafe.py
+
+# Vectorized-kernel coverage lint: every registered filter/scorer/picker
+# must define its columnar batch kernel or be explicitly declared
+# scalar-fallback — a silently-lost kernel costs the whole vectorized
+# hot-path win with no error anywhere (also hooked into pytest via
+# tests/test_vectorized.py).
+verify-vectorized:
+	$(PY) scripts/verify_vectorized.py
 
 # SLO-ledger terminal-path check: success, shed, retry-exhausted, deadline,
 # and mid-stream abort must ALL stamp an slo_met outcome on the decision

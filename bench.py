@@ -800,6 +800,12 @@ def sched_pool_sweep(quick: bool = False) -> dict:
             for E in (8, 32, 128)
             for B in (16, 64, 128)
             for rec_label in ("on", "off")]
+    # Thousand-pod cells: B=64 (the gate block count) only — the legacy
+    # emulation's per-endpoint chain walk makes a full B cross at 1024
+    # endpoints cost minutes for no extra information.
+    rows += [measure(E, 64, rec_label)
+             for E in (256, 512, 1024)
+             for rec_label in ("on", "off")]
     gate = [r for r in rows if r["endpoints"] == 128 and r["blocks"] == 64]
     out = {
         "metric": "sched_hotpath_pool_sweep",
@@ -814,6 +820,321 @@ def sched_pool_sweep(quick: bool = False) -> dict:
             "measured_improvement_pct": {r["recorder"]: r["improvement_pct"]
                                          for r in gate},
             "passed": all(r["improvement_pct"] >= 30.0 for r in gate),
+        },
+    }
+    print(json.dumps(out))
+    return out
+
+
+def sched_vectorized_sweep(quick: bool = False) -> dict:
+    """Scalar vs columnar scheduling-cycle sweep (CPU-only, no chip).
+
+    Runs the SAME 7-plugin profile (decode + fresh-metrics filters, five
+    weighted scorers, max-score picker) over one pool
+    snapshot two ways — the scalar per-endpoint path (``snap.view()``) and
+    the vectorized columnar path (``EndpointBatch(snap)``, kernels over
+    ``PoolColumns`` arrays) — at 8..1024 endpoints, and asserts the picks
+    are BIT-identical at every size before reporting the speedup. The
+    ≥10×-at-1024 acceptance is the tentpole gate of the columnar refactor
+    (router/scheduling/scheduler.py ``_run_batch``). Methodology matches
+    sched_microbench: interleaved scalar/batch chunks, GC parked, MIN over
+    chunks."""
+    import gc
+    import random as _random
+
+    from llm_d_inference_scheduler_tpu.router.config.loader import (
+        Handle,
+        load_config,
+    )
+    from llm_d_inference_scheduler_tpu.router.datalayer.datastore import (
+        Datastore,
+    )
+    from llm_d_inference_scheduler_tpu.router.framework.datalayer import (
+        Endpoint,
+        EndpointMetadata,
+    )
+    from llm_d_inference_scheduler_tpu.router.framework.scheduling import (
+        InferenceRequest,
+        InferenceRequestBody,
+    )
+    from llm_d_inference_scheduler_tpu.router.snapshot import (
+        EndpointBatch,
+        PoolSnapshot,
+    )
+
+    yaml_text = """
+scheduling: {pickSeed: 7}
+plugins:
+  - type: decode-filter
+  - type: fresh-metrics-filter
+  - type: queue-scorer
+  - type: kv-cache-utilization-scorer
+  - type: load-aware-scorer
+  - type: context-length-aware-scorer
+  - type: session-affinity-scorer
+  - type: max-score-picker
+schedulingProfiles:
+  - name: default
+    plugins:
+      - pluginRef: decode-filter
+      - pluginRef: fresh-metrics-filter
+      - pluginRef: queue-scorer
+        weight: 2
+      - pluginRef: kv-cache-utilization-scorer
+        weight: 2
+      - pluginRef: load-aware-scorer
+        weight: 1
+      - pluginRef: context-length-aware-scorer
+        weight: 1
+      - pluginRef: session-affinity-scorer
+        weight: 1
+      - pluginRef: max-score-picker
+"""
+
+    def mk_snapshot(n):
+        rng = _random.Random(n)
+        now = time.monotonic()
+        entries = []
+        for i in range(n):
+            role = rng.choice(["decode", "decode", "both", None])
+            meta = EndpointMetadata(
+                name=f"p{i}", address=f"10.0.{i // 256}.{i % 256}",
+                port=8000,
+                labels={"llm-d.ai/role": role} if role else {})
+            ep = Endpoint(meta)
+            ep.metrics.waiting_queue_size = rng.randrange(0, 50)
+            ep.metrics.kv_cache_usage_percent = rng.random()
+            ep.metrics.running_requests_size = rng.randrange(0, 30)
+            ep.metrics.kv_cache_max_token_capacity = 100000
+            ep.metrics.update_time = now
+            entries.append((meta, ep.metrics, {}))
+        return PoolSnapshot.from_entries(1, entries)
+
+    def measure(n):
+        snap = mk_snapshot(n)
+        cfgs = {lbl: load_config(yaml_text, Handle(datastore=Datastore()))
+                for lbl in ("scalar", "batch")}
+        chunk = max(8, min(200, 30000 // n))
+        reps = 2 if quick else 4
+
+        def candidates(lbl):
+            return (snap.view() if lbl == "scalar"
+                    else EndpointBatch(snap))
+
+        def run_chunk(lbl, salt):
+            sched = cfgs[lbl].scheduler
+            t0 = time.monotonic()
+            for i in range(chunk):
+                req = InferenceRequest(
+                    request_id=f"vec-{salt}-{i}", target_model="tiny",
+                    body=InferenceRequestBody(
+                        completions={"model": "tiny", "prompt": "x"}))
+                sched.schedule(None, req, candidates(lbl))
+            return (time.monotonic() - t0) / chunk * 1e6  # us/cycle
+
+        # Parity first: same request ids through both paths → same picks.
+        picks = {}
+        for lbl in ("scalar", "batch"):
+            out = []
+            for i in range(32):
+                req = InferenceRequest(
+                    request_id=f"par-{i}", target_model="tiny",
+                    body=InferenceRequestBody(
+                        completions={"model": "tiny", "prompt": "x"}))
+                res = cfgs[lbl].scheduler.schedule(None, req,
+                                                   candidates(lbl))
+                out.append([ep.metadata.address_port
+                            for ep in res.primary().target_endpoints])
+            picks[lbl] = out
+        identical = picks["scalar"] == picks["batch"]
+
+        best = {"scalar": float("inf"), "batch": float("inf")}
+        for lbl in ("scalar", "batch"):  # warm
+            run_chunk(lbl, -1)
+        gc.collect()
+        gc.disable()
+        try:
+            for r in range(reps):
+                for lbl in ("scalar", "batch"):  # interleaved
+                    best[lbl] = min(best[lbl], run_chunk(lbl, r))
+        finally:
+            gc.enable()
+        return {
+            "endpoints": n,
+            "scalar_us_per_cycle": round(best["scalar"], 2),
+            "vectorized_us_per_cycle": round(best["batch"], 2),
+            "speedup": round(best["scalar"] / best["batch"], 2),
+            "picks_identical": identical,
+        }
+
+    rows = [measure(n) for n in (8, 32, 128, 256, 512, 1024)]
+    gate = next(r for r in rows if r["endpoints"] == 1024)
+    out = {
+        "metric": "sched_vectorized_sweep",
+        "profile": "decode+fresh-metrics filters, 5 weighted scorers, "
+                   "max-score picker (pickSeed 7)",
+        "sweep": rows,
+        "acceptance": {
+            "required_speedup_at_1024": 10.0,
+            "measured_speedup_at_1024": gate["speedup"],
+            "picks_identical_all_sizes": all(r["picks_identical"]
+                                             for r in rows),
+            "passed": (gate["speedup"] >= 10.0
+                       and all(r["picks_identical"] for r in rows)),
+        },
+    }
+    print(json.dumps(out))
+    return out
+
+
+def fleet_frame_bench(quick: bool = False) -> dict:
+    """Fleet snapshot-IPC frame cost sweep (CPU-only, no chip needed).
+
+    Times the leader-side encode and the follower-side decode+apply of one
+    pool snapshot per wire format at 128..1024 endpoints:
+
+    - **pickle**: the pre-binary path — ``entries()`` materialization +
+      ``pickle.dumps`` on the leader; ``pickle.loads`` +
+      ``apply_remote_snapshot`` (per-endpoint Metrics re-marshal) on the
+      follower;
+    - **binary full**: ``snapwire.encode_full`` (columnar arrays as raw
+      buffers + string table); ``snapwire.decode`` +
+      ``apply_remote_columns`` (zero-copy array views installed directly
+      as the scheduling view);
+    - **binary delta**: the steady-state metrics-only frame —
+      ``encode_delta``; ``decode`` + ``apply_remote_delta`` (one columns
+      pointer swap).
+
+    Every endpoint carries one unpicklable attribute so the sanitizer's
+    per-value probe pass runs; the cold (first-frame) vs warm
+    (verdict-memoized) blob cost is reported per size — the steady-state
+    saving of the probe cache. Acceptance: the steady-state follower apply
+    (binary delta decode+apply) at 1024 endpoints costs ≤ 2× its
+    128-endpoint figure — i.e. frame-apply stopped scaling with pool
+    size."""
+    import gc
+    import pickle as _pickle
+    import threading
+
+    from llm_d_inference_scheduler_tpu.router import snapwire
+    from llm_d_inference_scheduler_tpu.router.datalayer.datastore import (
+        Datastore,
+    )
+    from llm_d_inference_scheduler_tpu.router.fleet import _encode_frame
+    from llm_d_inference_scheduler_tpu.router.framework.datalayer import (
+        EndpointMetadata,
+    )
+
+    def mk_leader(n):
+        ds = Datastore()
+        for i in range(n):
+            meta = EndpointMetadata(
+                name=f"pod-{i}", address=f"10.{i // 65536}.{(i // 256) % 256}"
+                                         f".{i % 256}",
+                port=8000, namespace="infer",
+                labels={"llm-d.ai/role": "decode", "zone": f"z{i % 3}"})
+            ds.endpoint_add_or_update(meta)
+            ep = ds.endpoint_get(meta.address_port)
+            ep.metrics.waiting_queue_size = i % 17
+            ep.metrics.kv_cache_usage_percent = (i % 100) / 100.0
+            ep.attributes.put("warm", True)
+            ep.attributes.put("lock", threading.Lock())  # sanitizer probe
+        return ds
+
+    def best_of(fn, reps):
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            best = min(best, (time.perf_counter() - t0) * 1e6)
+        return best
+
+    def measure(n):
+        reps = 5 if quick else 20
+        snap = mk_leader(n).snapshot()
+        cols = snap.columns()
+
+        # Sanitizer: cold first-frame probe pass vs memoized steady state.
+        san = snapwire.AttrSanitizer()
+        t0 = time.perf_counter()
+        blob = san.blob(cols.attrs, cols.models)
+        sanitizer_cold = (time.perf_counter() - t0) * 1e6
+        sanitizer_warm = best_of(
+            lambda: san.blob(cols.attrs, cols.models), reps)
+
+        pickle_sanitizer = snapwire.AttrSanitizer()
+        pickle_frame = _encode_frame(snap.epoch, snap.entries(),
+                                     pickle_sanitizer)[4:]  # strip u32 len
+        pickle_encode = best_of(
+            lambda: _encode_frame(snap.epoch, snap.entries(),
+                                  pickle_sanitizer), reps)
+        full_frame = snapwire.encode_full(snap.epoch, cols, blob)
+        full_encode = best_of(
+            lambda: snapwire.encode_full(snap.epoch, cols,
+                                         san.blob(cols.attrs, cols.models)),
+            reps)
+        delta_frame = snapwire.encode_delta(snap.epoch + 1, snap.epoch,
+                                            cols.num)
+        delta_encode = best_of(
+            lambda: snapwire.encode_delta(snap.epoch + 1, snap.epoch,
+                                          cols.num), reps)
+
+        followers = {"pickle": Datastore(), "binary": Datastore()}
+
+        def pickle_apply():
+            _, epoch, entries = _pickle.loads(pickle_frame)
+            followers["pickle"].apply_remote_snapshot(epoch, entries)
+
+        def full_apply():
+            _, epoch, got = snapwire.decode(full_frame)
+            followers["binary"].apply_remote_columns(epoch, got)
+
+        def delta_apply():
+            _, epoch, base_id, num = snapwire.decode(delta_frame)
+            followers["binary"].apply_remote_delta(epoch, base_id, num)
+
+        full_apply()  # anchor the delta's base columns
+        gc.collect()
+        gc.disable()
+        try:
+            row = {
+                "endpoints": n,
+                "pickle_frame_bytes": len(pickle_frame),
+                "binary_full_bytes": len(full_frame),
+                "binary_delta_bytes": len(delta_frame),
+                "pickle_encode_us": round(pickle_encode, 1),
+                "binary_full_encode_us": round(full_encode, 1),
+                "binary_delta_encode_us": round(delta_encode, 1),
+                "pickle_decode_apply_us": round(best_of(pickle_apply,
+                                                        reps), 1),
+                "binary_full_decode_apply_us": round(best_of(full_apply,
+                                                             reps), 1),
+                "binary_delta_decode_apply_us": round(best_of(delta_apply,
+                                                              reps), 1),
+                "sanitizer_cold_us": round(sanitizer_cold, 1),
+                "sanitizer_warm_us": round(sanitizer_warm, 1),
+            }
+        finally:
+            gc.enable()
+        return row
+
+    rows = [measure(n) for n in (128, 256, 512, 1024)]
+    apply_128 = next(r for r in rows if r["endpoints"] == 128)
+    apply_1024 = next(r for r in rows if r["endpoints"] == 1024)
+    ratio = (apply_1024["binary_delta_decode_apply_us"]
+             / max(apply_128["binary_delta_decode_apply_us"], 1e-9))
+    out = {
+        "metric": "fleet_frame_sweep",
+        "before": "pickle of entries() per frame + apply_remote_snapshot "
+                  "per-endpoint re-marshal",
+        "after": "snapwire binary frames: full = raw columnar buffers + "
+                 "string table, delta = numeric columns only, applied as "
+                 "zero-copy views / one columns-pointer swap",
+        "sweep": rows,
+        "acceptance": {
+            "steady_state_apply_1024_vs_128_max_ratio": 2.0,
+            "measured_ratio": round(ratio, 2),
+            "passed": ratio <= 2.0,
         },
     }
     print(json.dumps(out))
@@ -5361,6 +5682,10 @@ def main() -> None:
                 json.dump(res, f, indent=1)
         if run_sweep:
             sweep = sched_pool_sweep(quick=quick)
+            # Columnar-path phases (ISSUE 19): scalar↔vectorized cycle
+            # cost + parity, and the snapshot-IPC frame cost per wire.
+            sweep["vectorized"] = sched_vectorized_sweep(quick=quick)
+            sweep["fleet_frame"] = fleet_frame_bench(quick=quick)
             with open(os.path.join(here, "benchmarks",
                                    "SCHED_HOTPATH.json"), "w") as f:
                 json.dump(sweep, f, indent=1)

@@ -21,7 +21,13 @@ from ..framework.datalayer import (
 )
 from ..metrics import SNAPSHOT_EPOCH
 from ..resilience import BreakerRegistry
-from ..snapshot import PoolSnapshot
+from ..snapshot import (
+    NUMERIC_FIELDS,
+    ColumnMetrics,
+    ColumnsRef,
+    PoolColumns,
+    PoolSnapshot,
+)
 from .transfers import TransferTable
 
 
@@ -122,6 +128,11 @@ class Datastore:
         # epochs — membership and scrape state both arrive via IPC frames,
         # and a locally-built epoch would race the leader's numbering.
         self._remote_snapshots = False
+        # Binary-wire follower state (router/snapwire.py): the one mutable
+        # cell every live ColumnMetrics proxy reads through, so a
+        # metrics-delta apply is ONE pointer swap — not a rebind of every
+        # endpoint's metrics object.
+        self._columns_ref: ColumnsRef | None = None
 
     # ---- scheduling snapshot ------------------------------------------
 
@@ -176,13 +187,69 @@ class Datastore:
         self._snapshot_dirty = False
         self._snapshot_stale = False
         self._remote_snapshots = True
+        self._columns_ref = None  # pickle frames retire any binary-wire view
         SNAPSHOT_EPOCH.set(epoch)
+
+    def apply_remote_columns(self, epoch: int, cols: PoolColumns) -> None:
+        """Install a decoded binary full frame (router/snapwire.py) as THE
+        scheduling snapshot — the received columns ARE the scheduling view,
+        no per-endpoint re-marshal. Live Endpoint objects are resynced to
+        the frame's membership and handed ColumnMetrics proxies that read
+        through ``self._columns_ref``, so subsequent metrics-delta frames
+        reach the saturation detector / pool gauges / proxy legs via one
+        pointer swap."""
+        self.resync(list(cols.metas))
+        ref = self._columns_ref
+        if ref is None:
+            ref = self._columns_ref = ColumnsRef(cols)
+        else:
+            ref.cols = cols
+        for i, key in enumerate(cols.keys):
+            ep = self._endpoints.get(key)
+            if ep is not None:
+                ep.metrics = ColumnMetrics(ref, i)
+                ep.attributes._data = dict(cols.attrs[i])
+        self._snapshot = PoolSnapshot.from_columns(epoch, cols)
+        self._snapshot_epoch = epoch
+        self._snapshot_dirty = False
+        self._snapshot_stale = False
+        self._remote_snapshots = True
+        SNAPSHOT_EPOCH.set(epoch)
+
+    def apply_remote_delta(self, epoch: int, base_id: int,
+                           num: dict) -> bool:
+        """Apply a metrics-only binary delta frame on top of the installed
+        full frame. Returns False (caller drops the frame; the next full
+        re-anchors) when no binary full is installed or the delta was cut
+        against a different full than the one installed here — its row
+        order would be meaningless."""
+        ref = self._columns_ref
+        if ref is None:
+            return False
+        cols = ref.cols
+        if cols.base_id != base_id or cols.n != len(num[NUMERIC_FIELDS[0]]):
+            return False
+        new_cols = cols.with_arrays(num)
+        ref.cols = new_cols  # every live ColumnMetrics proxy now reads this
+        self._snapshot = PoolSnapshot.from_columns(epoch, new_cols)
+        self._snapshot_epoch = epoch
+        self._snapshot_dirty = False
+        self._snapshot_stale = False
+        SNAPSHOT_EPOCH.set(epoch)
+        return True
 
     def resume_local_snapshots(self) -> None:
         """Fleet leader promotion (router/fleet.py): this follower now owns
         the datalayer, so snapshot epochs are minted locally again. Epoch
         numbering CONTINUES from the last applied remote epoch — follower
-        epoch gauges must never run backwards across an election."""
+        epoch gauges must never run backwards across an election. Any
+        binary-wire ColumnMetrics proxies are materialized into mutable
+        Metrics first: the promoted worker's own collectors write scrape
+        fields in place, which a read-only column proxy can't absorb."""
+        for ep in self._endpoints.values():
+            if isinstance(ep.metrics, ColumnMetrics):
+                ep.metrics = ep.metrics.materialize()
+        self._columns_ref = None
         self._remote_snapshots = False
         self._snapshot_dirty = True
 

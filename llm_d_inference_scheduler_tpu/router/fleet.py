@@ -73,6 +73,7 @@ from aiohttp import web
 from prometheus_client import generate_latest
 from prometheus_client.parser import text_string_to_metric_families
 
+from . import snapwire
 from .metrics import (
     FLEET_BALANCER_CONNECTIONS,
     FLEET_LEADER,
@@ -85,6 +86,7 @@ from .metrics import (
     SHARD_SNAPSHOT_EPOCH,
     SHARD_STATE,
     SHARD_UP,
+    SNAPSHOT_FRAME_ERRORS,
 )
 
 log = logging.getLogger("router.fleet")
@@ -126,6 +128,10 @@ class FleetConfig:
     balancer: str = "reuseport"   # reuseport | hash
     snapshot_ipc: bool = True     # leader publishes PoolSnapshot epochs
     admin_port: int | None = None  # default: data port + 1000
+    # Snapshot frame encoding (ISSUE 19): "binary" ships the columnar
+    # arrays raw (router/snapwire.py) with metrics-only delta frames;
+    # "pickle" is the kill-switch back to whole-pool pickled entries.
+    wire: str = "binary"
     # Confirmed-index replication (ISSUE 13a): the leader appends
     # sequence-numbered KvBlockIndex add/remove deltas + periodic
     # full-index checkpoints to the snapshot frame stream; followers apply
@@ -145,6 +151,10 @@ class FleetConfig:
         if balancer not in ("reuseport", "hash"):
             raise ValueError(f"fleet.balancer must be 'reuseport' or 'hash', "
                              f"got {balancer!r}")
+        wire = str(spec.get("wire", "binary"))
+        if wire not in ("binary", "pickle"):
+            raise ValueError(f"fleet.wire must be 'binary' or 'pickle', "
+                             f"got {wire!r}")
         ckpt = float(spec.get("kvCheckpointS", 2.0))
         # Replica confirmed entries are renewed ONLY by checkpoints (the
         # engines' idempotent 1 s re-publication is deliberately
@@ -167,6 +177,7 @@ class FleetConfig:
             snapshot_ipc=bool(spec.get("snapshotIpc", True)),
             admin_port=(int(spec["adminPort"])
                         if spec.get("adminPort") is not None else None),
+            wire=wire,
             replication=bool(spec.get("replication", True)),
             kv_checkpoint_s=ckpt,
             election=bool(spec.get("election", True)))
@@ -187,6 +198,8 @@ class FleetWorkerSpec:
     # Confirmed-index replication on the snapshot stream (fleet.replication)
     replication: bool = True
     kv_checkpoint_s: float = 2.0
+    # Snapshot frame encoding (fleet.wire): binary | pickle
+    wire: str = "binary"
     # Shared per-fleet-run secret for the /fleet/promote + /fleet/retarget
     # control routes: the loopback peer check alone is spoofable through
     # the hash balancer's splice (the worker sees the balancer's loopback
@@ -264,29 +277,22 @@ class KvReplicationSource:
         self.index.set_delta_listener(None)
 
 
-def _encode_frame(epoch: int, entries: list, bad_keys: set[str]) -> bytes:
+def _encode_frame(epoch: int, entries: list,
+                  sanitizer: snapwire.AttrSanitizer) -> bytes:
     """Length-prefixed pickle of one snapshot epoch. Endpoint attributes
     can hold arbitrary producer outputs; anything unpicklable is dropped
-    from the frame (with its key cached so the common case stays one
-    whole-frame pickle)."""
+    from the frame. Probe verdicts are memoized per (key, id(value)) by the
+    sanitizer, so steady-state frames after a pickle failure cost one
+    whole-frame attempt plus dict lookups — not a re-pickle of every
+    attribute of every endpoint (and a picklable value under a
+    once-poisoned key is no longer dropped forever)."""
     try:
         return _pack(("snap", epoch, entries))
     except Exception:
-        sanitized = []
-        for meta, metrics, attrs in entries:
-            keep = {}
-            for k, v in attrs.items():
-                if k in bad_keys:
-                    continue
-                try:
-                    pickle.dumps(v, protocol=pickle.HIGHEST_PROTOCOL)
-                    keep[k] = v
-                except Exception:
-                    bad_keys.add(k)
-                    log.warning("snapshot IPC: dropping unpicklable "
-                                "endpoint attribute %r from published "
-                                "frames", k)
-            sanitized.append((meta, metrics, keep))
+        sanitized = [
+            (meta, metrics,
+             {k: v for k, v in attrs.items() if sanitizer.probe(k, v)})
+            for meta, metrics, attrs in entries]
         return _pack(("snap", epoch, sanitized))
 
 
@@ -307,20 +313,27 @@ class SnapshotPublisher:
     def __init__(self, datastore: Any, path: str,
                  interval_s: float | None = None,
                  kv_source: KvReplicationSource | None = None,
-                 kv_checkpoint_s: float = 2.0):
+                 kv_checkpoint_s: float = 2.0,
+                 wire: str = "binary"):
         self.datastore = datastore
         self.path = path
         self.interval_s = (interval_s if interval_s is not None
                            else type(datastore).SNAPSHOT_MIN_REFRESH_S)
         self.kv_source = kv_source
         self.kv_checkpoint_s = kv_checkpoint_s
+        self.wire = wire
         self._server: asyncio.AbstractServer | None = None
         self._task: asyncio.Task | None = None
         self._writers: list[asyncio.StreamWriter] = []
-        self._frame: bytes | None = None
+        self._frame: bytes | None = None       # last full frame (joiners)
+        self._delta_frame: bytes | None = None  # latest delta on top of it
         self._epoch = -1
         self._next_checkpoint = 0.0
-        self._bad_keys: set[str] = set()
+        self._sanitizer = snapwire.AttrSanitizer()
+        # Delta-eligibility anchors: the full frame a delta may ride on.
+        self._full_epoch = -1
+        self._full_cols: Any = None
+        self._full_blob: bytes | None = None
 
     async def start(self) -> None:
         with contextlib.suppress(OSError):
@@ -348,9 +361,14 @@ class SnapshotPublisher:
 
     async def _on_client(self, reader: asyncio.StreamReader,
                          writer: asyncio.StreamWriter) -> None:
+        # Mid-stream joiner warm-up: the cached full frame re-anchors
+        # membership/attrs, then the latest delta (binary wire) brings the
+        # metrics forward to the current epoch.
         if self._frame is not None:
             try:
                 writer.write(self._frame)
+                if self._delta_frame is not None:
+                    writer.write(self._delta_frame)
                 await writer.drain()
             except Exception:
                 writer.close()
@@ -367,9 +385,7 @@ class SnapshotPublisher:
                     # within ~one poll), not retried in a 10 ms log storm.
                     self._epoch = snap.epoch
                     try:
-                        frame = _encode_frame(snap.epoch, snap.entries(),
-                                              self._bad_keys)
-                        self._frame = frame
+                        frame = self._encode_snapshot(snap)
                         await self._broadcast(frame)
                     except asyncio.CancelledError:
                         raise
@@ -393,6 +409,38 @@ class SnapshotPublisher:
                 await asyncio.sleep(self.interval_s)
         except asyncio.CancelledError:
             pass
+
+    def _encode_snapshot(self, snap: Any) -> bytes:
+        """Encode one new epoch and refresh the joiner cache. Binary wire:
+        when membership, metadata, and the (attrs, models) blob are all
+        unchanged since the last full frame, the epoch ships as a
+        metrics-only delta (absolute numeric columns over ``base_id``) —
+        the steady-state frame whose size and apply cost don't scale with
+        anything but the numeric columns themselves."""
+        if self.wire != "binary":
+            frame = _encode_frame(snap.epoch, snap.entries(),
+                                  self._sanitizer)
+            self._frame = frame
+            return frame
+        cols = snap.columns()
+        blob = self._sanitizer.blob(cols.attrs, cols.models)
+        prev = self._full_cols
+        if (prev is not None and prev.n == cols.n
+                and blob == self._full_blob
+                and all(a is b for a, b in zip(prev.metas, cols.metas))):
+            inner = snapwire.encode_delta(snap.epoch, self._full_epoch,
+                                          cols.num)
+            frame = _FRAME_LEN.pack(len(inner)) + inner
+            self._delta_frame = frame
+            return frame
+        inner = snapwire.encode_full(snap.epoch, cols, blob)
+        frame = _FRAME_LEN.pack(len(inner)) + inner
+        self._frame = frame
+        self._delta_frame = None
+        self._full_epoch = snap.epoch
+        self._full_cols = cols
+        self._full_blob = blob
+        return frame
 
     async def _publish_kv(self) -> None:
         """Drain pending confirmed-index deltas into one ``kv`` frame, and
@@ -555,6 +603,14 @@ class SnapshotSubscriber:
             if not 0 < length <= _FRAME_MAX:
                 raise ConnectionError(f"bad snapshot frame length {length}")
             payload = await reader.readexactly(length)
+            if snapwire.is_binary_frame(payload):
+                # Binary frames carry their own magic/version/checksum: a
+                # bad one is counted and SKIPPED, never a crash or even a
+                # reconnect — the outer length prefix already re-aligned
+                # the stream past it.
+                self._handle_binary(payload)
+                self._consecutive_failures = 0
+                continue
             frame = pickle.loads(payload)
             kind = frame[0]
             if kind == "snap":
@@ -568,6 +624,28 @@ class SnapshotSubscriber:
             else:
                 raise ConnectionError(f"unknown frame kind {kind!r}")
             self._consecutive_failures = 0
+
+    def _handle_binary(self, payload: bytes) -> None:
+        try:
+            decoded = snapwire.decode(payload)
+        except snapwire.FrameError as e:
+            SNAPSHOT_FRAME_ERRORS.labels(reason=e.reason).inc()
+            log.warning("snapshot IPC: skipping bad binary frame (%s)", e)
+            return
+        if decoded[0] == "full":
+            _, epoch, cols = decoded
+            self.datastore.apply_remote_columns(epoch, cols)
+            self.applied_epoch = epoch
+        else:
+            _, epoch, base_id, num = decoded
+            # False = the delta's base full frame isn't what's installed
+            # (e.g. frames raced a reconnect): not corruption — drop it,
+            # the next full re-anchors.
+            if self.datastore.apply_remote_delta(epoch, base_id, num):
+                self.applied_epoch = epoch
+            else:
+                log.debug("snapshot IPC: delta for base %d does not match "
+                          "installed columns; dropped", base_id)
 
     def _apply_kv_deltas(self, seq: int, deltas: list) -> None:
         if self.kv_index is None:
@@ -1742,6 +1820,7 @@ class FleetSupervisor:
                 "reuse_port": self.fleet.balancer == "reuseport",
                 "replication": self.fleet.replication,
                 "kv_checkpoint_s": self.fleet.kv_checkpoint_s,
+                "wire": self.fleet.wire,
                 "control_token": self._control_token,
                 "sup_admin_port": self.admin_port,
             },

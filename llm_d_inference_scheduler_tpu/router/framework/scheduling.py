@@ -211,6 +211,28 @@ class SchedulingResult:
 # ``scripts/verify_threadsafe.py`` lints that in-tree plugins always
 # declare) are transparently trampolined back onto the event loop when the
 # pool is offloaded: correct, just not concurrent.
+#
+# Vectorized-kernel contract (columnar scheduling, router/snapshot.py
+# PoolColumns + scheduling/scheduler.py SchedulerProfile._run_batch): a
+# plugin MAY additionally expose a batch kernel —
+#
+#   filter_batch(ctx, state, request, batch, rows) -> bool mask | None
+#   score_batch(ctx, state, request, batch, rows)  -> float64 vector | None
+#   pick_batch(ctx, state, request, totals)        -> list[int] | None
+#
+# where ``batch`` is a router.snapshot.EndpointBatch, ``rows`` the int64
+# row-index array of surviving candidates (kernel outputs align with it),
+# and a picker's ``totals`` the weighted score vector (returned ints are
+# positions into it). Returning None DECLINES the batch — the scheduler
+# falls back to the scalar method through its auto-adapter, which is also
+# what happens when no kernel exists at all, so scalar-only out-of-tree
+# plugins schedule unchanged inside vectorized cycles. A kernel MUST be
+# bit-identical to its scalar method (same IEEE ops, same RNG draw
+# sequence); when that cannot hold for some input (e.g. NaN metrics under
+# Python's order-dependent min/max), decline instead of approximating.
+# ``scripts/verify_vectorized.py`` lints that every registered in-tree
+# filter/scorer/picker either ships a kernel or is explicitly listed as
+# scalar-fallback.
 
 
 @runtime_checkable

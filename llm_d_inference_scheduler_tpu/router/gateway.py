@@ -840,7 +840,8 @@ class Gateway:
                 kv_source = KvReplicationSource(index)
         self._snapshot_pub = SnapshotPublisher(
             self.datastore, path, kv_source=kv_source,
-            kv_checkpoint_s=self.fleet.kv_checkpoint_s)
+            kv_checkpoint_s=self.fleet.kv_checkpoint_s,
+            wire=self.fleet.wire)
         await self._snapshot_pub.start()
 
     def _fleet_request_allowed(self, request: web.Request) -> str | None:

@@ -455,6 +455,18 @@ SNAPSHOT_EPOCH = Gauge(
     "single-process router) or applied from the fleet leader's IPC stream "
     "(follower worker)", registry=REGISTRY)
 
+# Binary snapshot frames (router/snapwire.py) that failed validation and
+# were skipped by a follower. Skipped, not fatal: the outer length prefix
+# keeps the stream aligned, so one bad frame costs one epoch of staleness.
+# reason: truncated | checksum | version | malformed.
+SNAPSHOT_FRAME_ERRORS = Counter(
+    "router_snapshot_frame_errors",
+    "Binary snapshot-IPC frames a follower rejected and skipped (bad "
+    "magic/shape=malformed, payload digest mismatch=checksum, length "
+    "short of the header's claim=truncated, unsupported format "
+    "version=version)",
+    ("reason",), registry=REGISTRY)
+
 # Fleet-supervisor registry (router/fleet.py): families that exist only in
 # the supervisor process — worker liveness, per-shard request/epoch views
 # derived from the admin-plane scrapes, and the hash balancer's connection

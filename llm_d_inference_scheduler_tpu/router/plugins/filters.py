@@ -2,11 +2,15 @@
 
 from __future__ import annotations
 
+import time
 from typing import Any
+
+import numpy as np
 
 from ..framework.datalayer import DRAINING_LABEL, ROLE_LABEL, Endpoint
 from ..framework.plugin import PluginBase, register_plugin
 from ..framework.scheduling import CycleState, InferenceRequest
+from ..snapshot import role_mask_table
 
 
 class _RoleFilter(PluginBase):
@@ -18,6 +22,9 @@ class _RoleFilter(PluginBase):
     # Thread-safety audit (scheduler-pool offload, router/schedpool.py):
     # pure read of immutable metadata labels.
     THREAD_SAFE = True
+    # Role-code lookup table for the vectorized kernel, built once per
+    # class on first batch cycle (immutable afterwards).
+    _ROLE_TABLE: np.ndarray | None = None
 
     def filter(self, ctx: Any, state: CycleState, request: InferenceRequest,
                endpoints: list[Endpoint]) -> list[Endpoint]:
@@ -34,6 +41,15 @@ class _RoleFilter(PluginBase):
             if role in self.ROLES or (role in (None, "") and self.MATCH_UNLABELED):
                 out.append(ep)
         return out
+
+    def filter_batch(self, ctx, state, request, batch, rows):
+        cls = type(self)
+        table = cls._ROLE_TABLE
+        if table is None:
+            table = cls._ROLE_TABLE = role_mask_table(cls.ROLES,
+                                                      cls.MATCH_UNLABELED)
+        cols = batch.columns
+        return table[cols.role_code[rows]] & ~cols.draining[rows]
 
 
 @register_plugin("decode-filter")
@@ -102,6 +118,15 @@ class FreshMetricsFilter(PluginBase):
     def filter(self, ctx, state, request, endpoints):
         fresh = [ep for ep in endpoints if ep.metrics.fresh]
         return fresh or endpoints
+
+    def filter_batch(self, ctx, state, request, batch, rows):
+        # Metrics.fresh: update_time truthy AND (monotonic - update_time) < 5.
+        ut = batch.columns.num["update_time"][rows]
+        now = time.monotonic()
+        mask = (ut != 0) & ((now - ut) < 5.0)
+        if not mask.any():  # fail-open parity with `fresh or endpoints`
+            return np.ones(len(rows), dtype=bool)
+        return mask
 
 
 @register_plugin("prefix-cache-affinity-filter")

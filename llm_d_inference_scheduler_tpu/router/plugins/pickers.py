@@ -6,6 +6,8 @@ from __future__ import annotations
 import random
 from typing import Any
 
+import numpy as np
+
 from ..framework.plugin import PluginBase, register_plugin
 from ..framework.scheduling import ScoredEndpoint
 
@@ -61,6 +63,39 @@ class MaxScorePicker(_PickerBase):
         pool.sort(key=lambda s: s.score, reverse=True)
         return [s.endpoint for s in pool[: self.max_endpoints]]
 
+    def pick_batch(self, ctx, state, request, totals):
+        n = len(totals)
+        if n == 0:
+            return []
+        if np.isnan(totals).any():
+            # NaN makes comparison sorts order-dependent; only the scalar
+            # path's exact sequence of comparisons is authoritative.
+            return None
+        if self.max_endpoints == 1:
+            hi = totals.max()
+            if (totals == hi).sum() == 1:
+                # Unique max: the shuffle only permutes TIE order, so the
+                # winner is the argmax no matter what the RNG draws — skip
+                # the O(n)-draw Fisher-Yates entirely (the dominant cost of
+                # a large-pool cycle). In seeded mode the per-request RNG is
+                # private and discarded, so skipping draws is unobservable;
+                # in shared-RNG mode the pick is still exactly what the
+                # scalar path would have returned, only the (already
+                # nondeterministic) global draw stream advances differently.
+                return [int(np.argmax(totals))]
+        # Ties: shuffling an index list consumes the identical Fisher-Yates
+        # draw sequence as shuffling the ScoredEndpoint list, and a stable
+        # descending sort of the shuffled scores reproduces the scalar
+        # shuffle-then-stable-sort tie-break exactly.
+        order = list(range(n))
+        self._rng_for(request).shuffle(order)
+        shuffled = totals[order]
+        if self.max_endpoints == 1:
+            # argmax = first max in shuffled order = stable-sort winner.
+            return [order[int(np.argmax(shuffled))]]
+        top = np.argsort(-shuffled, kind="stable")[: self.max_endpoints]
+        return [order[int(j)] for j in top]
+
 
 @register_plugin("random-picker")
 class RandomPicker(_PickerBase):
@@ -70,6 +105,15 @@ class RandomPicker(_PickerBase):
         picked = self._rng_for(request).sample(
             scored, k=min(self.max_endpoints, len(scored)))
         return [s.endpoint for s in picked]
+
+    def pick_batch(self, ctx, state, request, totals):
+        n = len(totals)
+        if n == 0:
+            return []
+        # sample() draws depend only on (len(population), k), so sampling
+        # positions consumes the same RNG sequence as sampling the list.
+        return list(self._rng_for(request).sample(range(n),
+                                                  k=min(self.max_endpoints, n)))
 
 
 @register_plugin("weighted-random-picker")

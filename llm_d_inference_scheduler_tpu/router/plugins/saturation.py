@@ -9,6 +9,8 @@ from __future__ import annotations
 
 from typing import Any
 
+import numpy as np
+
 from ..framework.datalayer import Endpoint
 from ..framework.plugin import PluginBase, register_plugin
 from ..plugins.attributes import INFLIGHT_ATTRIBUTE_KEY, InFlightLoad
@@ -44,6 +46,17 @@ class UtilizationDetector(PluginBase):
     def filter(self, ctx, state, request, endpoints):
         ok = [ep for ep in endpoints if self.endpoint_score(ep) < 1.0]
         return ok or endpoints  # fail open
+
+    def filter_batch(self, ctx, state, request, batch, rows):
+        cols = batch.columns
+        q = cols.num["waiting_queue_size"][rows] / max(self.queue_threshold, 1)
+        kv = (cols.num["kv_cache_usage_percent"][rows]
+              / max(self.kv_threshold, 1e-9))
+        # Scalar parity incl. NaN: max(q, kv) keeps q when kv is NaN (q is
+        # the running max and NaN comparisons are False), but yields NaN —
+        # dropped by `< 1.0` — when q itself is NaN.
+        keep = (q < 1.0) & ((kv < 1.0) | np.isnan(kv))
+        return keep if keep.any() else np.ones(len(rows), dtype=bool)
 
 
 @register_plugin("concurrency-detector")
@@ -83,3 +96,16 @@ class ConcurrencyDetector(PluginBase):
     def filter(self, ctx, state, request, endpoints):
         ok = [ep for ep in endpoints if self.endpoint_score(ep) < 1.0]
         return ok or endpoints
+
+    def filter_batch(self, ctx, state, request, batch, rows):
+        view_row = batch.view_row  # overlay reads: producers may stage loads
+        n = len(rows)
+        keep = np.empty(n, dtype=bool)
+        limit = max(self.capacity * (1 + self.headroom), 1e-9)
+        tokens = self.mode == "tokens"
+        for i, r in enumerate(rows.tolist()):
+            load = view_row(r).attributes.peek(INFLIGHT_ATTRIBUTE_KEY)
+            used = (0 if load is None
+                    else load.tokens if tokens else load.requests)
+            keep[i] = (used / limit) < 1.0
+        return keep if keep.any() else np.ones(n, dtype=bool)

@@ -5,6 +5,8 @@ from __future__ import annotations
 
 from typing import Any
 
+import numpy as np
+
 from ..framework.datalayer import Endpoint
 from ..framework.plugin import PluginBase, register_plugin
 from ..framework.scheduling import CycleState, InferenceRequest
@@ -26,6 +28,22 @@ def _normalized_inverse(values: dict[str, float]) -> dict[str, float]:
     if hi == lo:
         return {k: 1.0 for k in values}
     return {k: (hi - v) / (hi - lo) for k, v in values.items()}
+
+
+def _normalized_inverse_vec(vals: np.ndarray) -> np.ndarray | None:
+    """Vector twin of _normalized_inverse — same IEEE ops, so scores are
+    bit-identical. Declines (None) on NaN input: Python's min/max over a
+    dict is order-dependent with NaN, so only the scalar path is
+    authoritative there."""
+    if vals.size == 0:
+        return vals
+    if np.isnan(vals).any():
+        return None
+    lo = vals.min()
+    hi = vals.max()
+    if hi == lo:
+        return np.ones(vals.size, dtype=np.float64)
+    return (hi - vals) / (hi - lo)
 
 
 @register_plugin("transfer-aware-pair-scorer")
@@ -79,6 +97,10 @@ class QueueScorer(PluginBase):
             {ep.metadata.address_port: float(ep.metrics.waiting_queue_size)
              for ep in endpoints})
 
+    def score_batch(self, ctx, state, request, batch, rows):
+        return _normalized_inverse_vec(
+            batch.columns.num["waiting_queue_size"][rows])
+
 
 @register_plugin("kv-cache-utilization-scorer", "kv-cache-scorer")
 class KvCacheUtilizationScorer(PluginBase):
@@ -91,6 +113,12 @@ class KvCacheUtilizationScorer(PluginBase):
                 min(max(1.0 - ep.metrics.kv_cache_usage_percent, 0.0), 1.0)
                 for ep in endpoints}
 
+    def score_batch(self, ctx, state, request, batch, rows):
+        # np.clip matches min(max(x, 0), 1) bit-for-bit, NaN included
+        # (both propagate NaN through the comparisons).
+        usage = batch.columns.num["kv_cache_usage_percent"][rows]
+        return np.clip(1.0 - usage, 0.0, 1.0)
+
 
 @register_plugin("running-requests-size-scorer")
 class RunningRequestsScorer(PluginBase):
@@ -100,6 +128,10 @@ class RunningRequestsScorer(PluginBase):
         return _normalized_inverse(
             {ep.metadata.address_port: float(ep.metrics.running_requests_size)
              for ep in endpoints})
+
+    def score_batch(self, ctx, state, request, batch, rows):
+        return _normalized_inverse_vec(
+            batch.columns.num["running_requests_size"][rows])
 
 
 @register_plugin("load-aware-scorer")
@@ -122,6 +154,16 @@ class LoadAwareScorer(PluginBase):
                 max(0.0, 1.0 - ep.metrics.waiting_queue_size / t)
                 for ep in endpoints}
 
+    def score_batch(self, ctx, state, request, batch, rows):
+        q = batch.columns.num["waiting_queue_size"][rows]
+        if np.isnan(q).any():
+            # Scalar max(0.0, nan) yields 0.0 (Python returns the first
+            # operand on an unordered compare) while np.maximum propagates
+            # NaN — decline so the authoritative scalar path decides.
+            return None
+        t = max(self.queue_threshold, 1)
+        return np.maximum(1.0 - q / t, 0.0)
+
 
 @register_plugin("prefix-cache-scorer", "prefix-cache")
 class PrefixCacheScorer(PluginBase):
@@ -138,6 +180,18 @@ class PrefixCacheScorer(PluginBase):
         for ep in endpoints:
             info: PrefixCacheMatchInfo | None = ep.attributes.get(PREFIX_ATTRIBUTE_KEY)
             out[ep.metadata.address_port] = info.hit_ratio if info else 0.0
+        return out
+
+    def score_batch(self, ctx, state, request, batch, rows):
+        # Attribute-backed: still one Python pass over the per-request
+        # views (producer writes land on their overlays, so the base
+        # columns alone are blind to them), but peek() borrows the stored
+        # value instead of clone-per-read and no dict is built.
+        view_row = batch.view_row
+        out = np.empty(len(rows), dtype=np.float64)
+        for i, r in enumerate(rows.tolist()):
+            info = view_row(r).attributes.peek(PREFIX_ATTRIBUTE_KEY)
+            out[i] = info.hit_ratio if info else 0.0
         return out
 
 
@@ -158,6 +212,14 @@ class ActiveRequestScorer(PluginBase):
             vals[ep.metadata.address_port] = float(load.requests if load else 0)
         return _normalized_inverse(vals)
 
+    def score_batch(self, ctx, state, request, batch, rows):
+        view_row = batch.view_row
+        vals = np.empty(len(rows), dtype=np.float64)
+        for i, r in enumerate(rows.tolist()):
+            load = view_row(r).attributes.peek(INFLIGHT_ATTRIBUTE_KEY)
+            vals[i] = float(load.requests if load else 0)
+        return _normalized_inverse_vec(vals)
+
 
 @register_plugin("token-load-scorer")
 class TokenLoadScorer(PluginBase):
@@ -174,6 +236,14 @@ class TokenLoadScorer(PluginBase):
             load: InFlightLoad | None = ep.attributes.get(INFLIGHT_ATTRIBUTE_KEY)
             vals[ep.metadata.address_port] = float(load.tokens if load else 0)
         return _normalized_inverse(vals)
+
+    def score_batch(self, ctx, state, request, batch, rows):
+        view_row = batch.view_row
+        vals = np.empty(len(rows), dtype=np.float64)
+        for i, r in enumerate(rows.tolist()):
+            load = view_row(r).attributes.peek(INFLIGHT_ATTRIBUTE_KEY)
+            vals[i] = float(load.tokens if load else 0)
+        return _normalized_inverse_vec(vals)
 
 
 @register_plugin("lora-affinity-scorer")
@@ -235,6 +305,15 @@ class SessionAffinityScorer(PluginBase):
         return {ep.metadata.address_port:
                 (1.0 if target and target == ep.metadata.address_port else 0.0)
                 for ep in endpoints}
+
+    def score_batch(self, ctx, state, request, batch, rows):
+        out = np.zeros(len(rows), dtype=np.float64)
+        target = self._decode(request.headers.get(self.SESSION_HEADER, ""))
+        if target:
+            r = batch.columns.row_of().get(target)
+            if r is not None:
+                out[rows == r] = 1.0
+        return out
 
     def pre_request(self, ctx, request, result) -> None:
         primary = result.primary().target_endpoints
@@ -371,4 +450,13 @@ class ContextLengthAwareScorer(PluginBase):
                 continue
             free_tokens = cap * (1.0 - ep.metrics.kv_cache_usage_percent)
             out[ep.metadata.address_port] = 1.0 if need <= free_tokens else 0.0
+        return out
+
+    def score_batch(self, ctx, state, request, batch, rows):
+        need = estimate_input_tokens(request)
+        cols = batch.columns
+        cap = cols.num["kv_cache_max_token_capacity"][rows]
+        usage = cols.num["kv_cache_usage_percent"][rows]
+        out = np.where(need <= cap * (1.0 - usage), 1.0, 0.0)
+        out[cap <= 0] = 0.5
         return out

@@ -17,6 +17,7 @@ from typing import Any
 from ..datalayer.datastore import Datastore
 from ..framework.datalayer import Endpoint
 from ..framework.scheduling import InferenceRequest, SchedulingResult
+from ..snapshot import EndpointBatch
 from ..metrics import (
     REQUEST_ERROR_TOTAL,
     REQUEST_TOTAL,
@@ -217,9 +218,13 @@ class Director:
         # pre-admission candidates: scheduling proceeds against the old
         # epoch (endpoint deletion mid-flight is a proxy-time failure, not
         # a scheduling KeyError).
-        if self.sched_pool is not None and self.sched_pool.offloaded:
+        # The vectorized path (SchedulingConfig.vectorized) rides the same
+        # re-resolve: an EndpointBatch over the snapshot's columns is what
+        # lets plugin batch kernels index whole-pool arrays.
+        if self.sched_pool is not None and (
+                self.sched_pool.offloaded or self.sched_pool.vectorized):
             snap_candidates = self._candidates(request, snapshot=True)
-            if snap_candidates:
+            if len(snap_candidates):
                 candidates = snap_candidates
             # Remembered for failover reschedules: the producer attribute
             # overlays live on these per-request views, not on the shared
@@ -290,9 +295,21 @@ class Director:
     def _candidates(self, request: InferenceRequest,
                     *, snapshot: bool = False) -> list[Endpoint]:
         if snapshot:
+            snap = self.datastore.snapshot()
+            if self.sched_pool is not None and self.sched_pool.vectorized:
+                # Columnar candidate set: vectorized kernels index the
+                # snapshot's arrays; list-duck iteration still hands
+                # producers and scalar fallbacks per-request overlay views.
+                batch = EndpointBatch(snap)
+                subset = request.headers.get(H_SUBSET_HINT)
+                if subset:
+                    allowed = {s.strip() for s in subset.split(",")
+                               if s.strip()}
+                    batch = batch.subset(allowed)
+                return batch
             # Per-request overlay views over the current snapshot epoch
             # (router/snapshot.py) — safe to score off-loop.
-            eps: list = self.datastore.snapshot().view()
+            eps: list = snap.view()
         else:
             eps = self.datastore.endpoint_list()
         subset = request.headers.get(H_SUBSET_HINT)
@@ -326,10 +343,12 @@ class Director:
         surviving candidates carry the original cycle's producer overlays.
         Returns None when no viable result exists."""
         base = None
-        if self.sched_pool is not None and self.sched_pool.offloaded:
-            # Offloaded cycles scored per-request snapshot views; the
-            # producer overlays (prefix match info, in-flight load) exist
-            # only there, so the reschedule reuses them.
+        if self.sched_pool is not None and (
+                self.sched_pool.offloaded or self.sched_pool.vectorized):
+            # Offloaded/vectorized cycles scored per-request snapshot views;
+            # the producer overlays (prefix match info, in-flight load)
+            # exist only there, so the reschedule reuses them. Iterating an
+            # EndpointBatch base materializes those same views.
             base = getattr(request, "_sched_candidates", None)
         if base is None:
             base = self._candidates(request)

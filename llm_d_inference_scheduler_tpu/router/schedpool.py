@@ -90,12 +90,19 @@ class SchedulingConfig:
 
     workers: int = 0
     max_batch: int = 8
+    # Columnar scheduling (router/snapshot.py PoolColumns): when True the
+    # director hands the scheduler an EndpointBatch and plugins with batch
+    # kernels run vectorized; scalar-only plugins fall back transparently
+    # through the scheduler's auto-adapter. `vectorized: false` is the
+    # kill-switch back to the pure scalar cycle.
+    vectorized: bool = True
 
     @classmethod
     def from_spec(cls, spec: dict[str, Any] | None) -> "SchedulingConfig":
         spec = spec or {}
         return cls(workers=max(0, int(spec.get("workers", 0))),
-                   max_batch=max(1, int(spec.get("maxBatch", 8))))
+                   max_batch=max(1, int(spec.get("maxBatch", 8))),
+                   vectorized=bool(spec.get("vectorized", True)))
 
 
 def _is_threadsafe(plugin: Any) -> bool:
@@ -267,6 +274,10 @@ class SchedulerPool:
     @property
     def offloaded(self) -> bool:
         return self._executor is not None
+
+    @property
+    def vectorized(self) -> bool:
+        return self.cfg.vectorized
 
     @property
     def executor(self) -> concurrent.futures.ThreadPoolExecutor | None:

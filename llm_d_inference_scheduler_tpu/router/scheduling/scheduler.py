@@ -19,14 +19,18 @@ can annotate the cycle too.
 
 from __future__ import annotations
 
+import collections.abc
 import dataclasses
 import itertools
 import logging
 import time
 from typing import Any
 
+import numpy as np
+
 from ..decisions import DECISION_STATE_KEY
 from ..framework.datalayer import Endpoint
+from ..snapshot import EndpointBatch
 from ..framework.scheduling import (
     CycleState,
     InferenceRequest,
@@ -43,6 +47,58 @@ from ..metrics import (
 )
 
 log = logging.getLogger("router.scheduler")
+
+
+class LazyScoreTable(collections.abc.Mapping):
+    """A {address_port: score} view over a score vector that materializes
+    its dict on first key access. Vectorized cycles hand these to
+    ProfileRunResult so a recorder-off cycle builds ZERO per-key dicts; the
+    gated consumers (shadow policies, cache ledger, no-hit-lru's
+    pre_request probe) trigger materialization only when they actually
+    read. Never flows into a DecisionRecord — the scheduler materializes
+    eagerly when the recorder is on, so /debug/decisions always serializes
+    plain dicts."""
+
+    __slots__ = ("_batch", "_rows", "_vec", "_d")
+
+    def __init__(self, batch: "EndpointBatch", rows: np.ndarray,
+                 vec: np.ndarray):
+        self._batch = batch
+        self._rows = rows
+        self._vec = vec
+        self._d: dict[str, float] | None = None
+
+    def _mat(self) -> dict[str, float]:
+        d = self._d
+        if d is None:
+            d = self._d = dict(zip(self._batch.keys_at(self._rows),
+                                   self._vec.tolist()))
+        return d
+
+    def __getitem__(self, k):
+        return self._mat()[k]
+
+    def __iter__(self):
+        return iter(self._mat())
+
+    def __len__(self):
+        return len(self._vec)
+
+    def __contains__(self, k):
+        return k in self._mat()
+
+    def get(self, k, default=None):
+        return self._mat().get(k, default)
+
+    def __eq__(self, other):
+        if isinstance(other, LazyScoreTable):
+            other = other._mat()
+        return self._mat() == other
+
+    __hash__ = None
+
+    def __repr__(self):
+        return repr(self._mat())
 
 
 @dataclasses.dataclass
@@ -94,6 +150,8 @@ class SchedulerProfile:
 
     def run(self, ctx: Any, request: InferenceRequest, state: CycleState,
             endpoints: list[Endpoint]) -> ProfileRunResult | None:
+        if isinstance(endpoints, EndpointBatch):
+            return self._run_batch(ctx, request, state, endpoints)
         # Plugins shared across profiles (one instance per pluginRef) can
         # read which profile pass they are scoring (e.g. no-hit-lru records
         # its cold decision per profile).
@@ -180,6 +238,151 @@ class SchedulerProfile:
         if observe_dur:
             PLUGIN_DURATION_SECONDS.labels("picker", pname).observe(
                 time.monotonic() - t0)
+        if rec_sec is not None:
+            picked_keys = [ep.metadata.address_port for ep in picked]
+            if picked and len(totals) > 1:
+                winner = totals[picked_keys[0]]
+                runner_up = max(v for k, v in totals.items()
+                                if k != picked_keys[0])
+                self._picker_margin.observe(max(winner - runner_up, 0.0))
+            rec.profile_picker(rec_sec, pname, picked_keys, totals)
+        if not picked:
+            return None
+        return ProfileRunResult(target_endpoints=picked,
+                                raw_scores=raw_scores, totals=totals)
+
+    # ---- vectorized (columnar) cycle -----------------------------------
+    #
+    # One row per endpoint over the snapshot's PoolColumns: filters reduce a
+    # row-index array with boolean masks, scorers contribute whole-pool
+    # score vectors, the weighted sum is one fused multiply-add pass, and
+    # the picker argmax/top-Ks the total vector. Every in-tree plugin may
+    # expose a batch kernel (filter_batch / score_batch / pick_batch); a
+    # plugin without one — or one that DECLINES by returning None (e.g. a
+    # NaN pool where Python's order-dependent min/max semantics can't be
+    # reproduced in array form) — falls back to its scalar method through
+    # the auto-adapter below, bit-identically. The scalar path above and
+    # this path produce identical picks, identical DecisionRecord tables,
+    # and identical sampled metric observations: the float ops are the same
+    # IEEE ops in the same order, the RNG draw sequences are identical, and
+    # the shared sampling counters advance identically.
+
+    def _run_batch(self, ctx: Any, request: InferenceRequest,
+                   state: CycleState, batch: EndpointBatch
+                   ) -> ProfileRunResult | None:
+        state.write("current_profile", self.name)
+        cols = batch.columns
+        rows = batch.all_rows()
+        rec = state.read(DECISION_STATE_KEY)
+        rec_sec = (rec.begin_profile(self.name, len(rows))
+                   if rec is not None else None)
+        observe_dur = next(self._dur_counter) % self.DURATION_OBS_SAMPLE == 0
+        # Key list maintained only when the recorder needs per-filter
+        # kept/dropped bookkeeping (matches the scalar path's rec gate).
+        keys = batch.keys_at(rows) if rec_sec is not None else None
+        row_of = None
+        for f, fname, drop_counter in self._filter_meta:
+            prev_keys = keys
+            t0 = time.monotonic() if observe_dur else 0.0
+            kern = getattr(f, "filter_batch", None)
+            mask = kern(ctx, state, request, batch, rows) \
+                if kern is not None else None
+            if mask is not None:
+                new_rows = rows[mask]
+            else:
+                # Auto-adapter: scalar filter over materialized views; its
+                # output order is preserved by mapping back to rows.
+                kept = f.filter(ctx, state, request,
+                                batch.endpoints_at(rows))
+                if row_of is None:
+                    row_of = cols.row_of()
+                new_rows = np.fromiter(
+                    (row_of[ep.metadata.address_port] for ep in kept),
+                    dtype=np.int64, count=len(kept))
+            if observe_dur:
+                PLUGIN_DURATION_SECONDS.labels("filter", fname).observe(
+                    time.monotonic() - t0)
+            rows = new_rows
+            if rec_sec is not None:
+                keys = batch.keys_at(rows)
+                if len(keys) == len(prev_keys):
+                    rec.profile_filter(rec_sec, fname, len(prev_keys),
+                                       keys, [])
+                else:
+                    kept_set = set(keys)
+                    dropped = [k for k in prev_keys if k not in kept_set]
+                    if dropped:
+                        drop_counter.inc(len(dropped))
+                    rec.profile_filter(rec_sec, fname, len(prev_keys),
+                                       keys, dropped)
+            if len(rows) == 0:
+                log.debug("profile %s: filter %s emptied the candidate set",
+                          self.name, f.typed_name())
+                if rec_sec is not None:
+                    rec_sec["outcome"] = "filtered_empty"
+                return None
+
+        observe_scores = False
+        if rec_sec is not None:
+            observe_scores = (
+                next(self._obs_counter) % self.SCORE_OBS_SAMPLE == 0)
+        n = len(rows)
+        acc = np.zeros(n, dtype=np.float64)
+        raw_scores: dict[str, dict[str, float]] = {}
+        for ws, sname, score_hist in self._scorer_meta:
+            t0 = time.monotonic() if observe_dur else 0.0
+            kern = getattr(ws.scorer, "score_batch", None)
+            vec = kern(ctx, state, request, batch, rows) \
+                if kern is not None else None
+            if vec is None:
+                if keys is None:
+                    keys = batch.keys_at(rows)
+                scores = ws.scorer.score(ctx, state, request,
+                                         batch.endpoints_at(rows))
+                vec = np.fromiter((scores.get(k, 0.0) for k in keys),
+                                  dtype=np.float64, count=n)
+            elif rec_sec is not None:
+                # Recorder on: the decision record serializes the table, so
+                # materialize the plain dict now (one zip at C speed).
+                if keys is None:
+                    keys = batch.keys_at(rows)
+                scores = dict(zip(keys, vec.tolist()))
+            else:
+                # Kernel result, recorder off: the per-key view (shadow
+                # policies / cache ledger / pre_request probes) stays a
+                # lazy table — nothing is built unless a consumer reads.
+                scores = LazyScoreTable(batch, rows, vec)
+            if observe_dur:
+                PLUGIN_DURATION_SECONDS.labels("scorer", sname).observe(
+                    time.monotonic() - t0)
+            raw_scores[sname] = scores
+            # min(max(s, 0.0), 1.0) ≡ np.clip elementwise, NaN included
+            # (both propagate a NaN score unchanged).
+            clamped = np.clip(vec, 0.0, 1.0)
+            acc = acc + ws.weight * clamped
+            if rec_sec is not None:
+                if observe_scores:
+                    for s in clamped.tolist():
+                        score_hist.observe(s)
+                rec.profile_scorer(rec_sec, sname, ws.weight, scores)
+
+        pname = self._picker_name
+        t0 = time.monotonic() if observe_dur else 0.0
+        kern = getattr(self.picker, "pick_batch", None)
+        picked_pos = kern(ctx, state, request, acc) \
+            if kern is not None else None
+        if picked_pos is not None:
+            picked = batch.endpoints_at([int(rows[p]) for p in picked_pos])
+        else:
+            totals_list = acc.tolist()
+            scored = [ScoredEndpoint(ep, s) for ep, s in
+                      zip(batch.endpoints_at(rows), totals_list)]
+            picked = self.picker.pick(ctx, state, request, scored)
+        if observe_dur:
+            PLUGIN_DURATION_SECONDS.labels("picker", pname).observe(
+                time.monotonic() - t0)
+        totals = (dict(zip(keys, acc.tolist())) if rec_sec is not None
+                  else LazyScoreTable(batch, rows, acc))
         if rec_sec is not None:
             picked_keys = [ep.metadata.address_port for ep in picked]
             if picked and len(totals) > 1:

@@ -34,7 +34,15 @@ import dataclasses
 import time
 from typing import Any, Iterable
 
-from .framework.datalayer import Endpoint, EndpointMetadata, Metrics
+import numpy as np
+
+from .framework.datalayer import (
+    DRAINING_LABEL,
+    ROLE_LABEL,
+    Endpoint,
+    EndpointMetadata,
+    Metrics,
+)
 
 
 def _copy_dict(d: dict) -> dict:
@@ -50,10 +58,230 @@ def _copy_metrics(m: Metrics) -> Metrics:
     model dicts are copied with the concurrent-mutation retry. Much cheaper
     than ``Metrics.clone()`` (deepcopy) — the snapshot rebuilds on every
     scrape landing under load."""
+    if not isinstance(m, Metrics):
+        # Fleet-follower promotion edge: live endpoints may still carry
+        # column-backed ColumnMetrics proxies when local snapshot building
+        # resumes — materialize a real dataclass copy.
+        return m.materialize()
     return dataclasses.replace(
         m,
         active_models=_copy_dict(m.active_models),
         waiting_models=_copy_dict(m.waiting_models))
+
+
+# ---------------------------------------------------------------------------
+# Columnar pool view (vectorized scheduling + binary snapshot IPC).
+#
+# One row per endpoint; every numeric Metrics field becomes a float64 column
+# (ints fit exactly — the pool's counts stay far below 2**53), the two role
+# labels collapse to small code arrays, and the non-numeric remainder
+# (metadata, model dicts, attribute dicts) stays as per-row object refs.
+# Built at most once per snapshot epoch and shared by every scheduling cycle
+# of that epoch; vectorized filter/scorer kernels index these arrays instead
+# of looping endpoint objects, and the fleet's binary snapshot frames
+# (router/snapwire.py) serialize the arrays as raw buffers.
+# ---------------------------------------------------------------------------
+
+# Column order is part of the binary wire format (router/snapwire.py):
+# append only, never reorder — the frame VERSION must bump otherwise.
+NUMERIC_FIELDS = (
+    "waiting_queue_size", "running_requests_size", "kv_cache_usage_percent",
+    "kv_cache_max_token_capacity", "cache_block_size", "cache_num_blocks",
+    "free_kv_blocks", "prefill_tokens", "prefix_hit_tokens",
+    "max_active_models", "update_time",
+)
+_INT_FIELDS = frozenset((
+    "waiting_queue_size", "running_requests_size",
+    "kv_cache_max_token_capacity", "cache_block_size", "cache_num_blocks",
+    "free_kv_blocks", "max_active_models",
+))
+
+# Role-label codes for the int8 role column. Codes are part of the wire
+# format too. Any role outside this table maps to ROLE_OTHER: the in-tree
+# role filters can never match it, exactly like the scalar `role in ROLES`
+# test on an unknown label.
+ROLE_CODES = {"": 0, "decode": 1, "prefill": 2, "both": 3, "encode": 4}
+ROLE_OTHER = 5
+N_ROLE_CODES = 6
+
+
+def role_code_for(labels: dict[str, str]) -> int:
+    role = labels.get(ROLE_LABEL)
+    if role in (None, ""):
+        return 0
+    return ROLE_CODES.get(role, ROLE_OTHER)
+
+
+def role_mask_table(roles: tuple[str, ...], match_unlabeled: bool) -> np.ndarray:
+    """Boolean lookup table over role codes for a role-filter class:
+    ``table[role_code]`` ⇔ the scalar ``role in ROLES or (unlabeled and
+    MATCH_UNLABELED)`` test."""
+    table = np.zeros(N_ROLE_CODES, dtype=bool)
+    for r in roles:
+        code = ROLE_CODES.get(r)
+        if code is not None:
+            table[code] = True
+    table[0] = bool(match_unlabeled) or table[0]
+    return table
+
+
+class PoolColumns:
+    """The columnar half of one snapshot epoch: numeric metrics as float64
+    arrays (one row per endpoint), role/draining as code arrays, and object
+    refs (metadata, model dicts, attribute dicts) per row. Immutable after
+    construction — a metrics-only update produces a NEW PoolColumns via
+    ``with_arrays`` so in-flight cycles keep their torn-free view."""
+
+    __slots__ = ("n", "keys", "metas", "attrs", "models", "role_code",
+                 "draining", "num", "base_id", "_row_of")
+
+    def __init__(self, n: int, keys: list[str],
+                 metas: list[EndpointMetadata], attrs: list[dict],
+                 models: list[tuple[dict, dict]], role_code: np.ndarray,
+                 draining: np.ndarray, num: dict[str, np.ndarray],
+                 base_id: int = 0):
+        self.n = n
+        self.keys = keys
+        self.metas = metas
+        self.attrs = attrs
+        self.models = models
+        self.role_code = role_code
+        self.draining = draining
+        self.num = num
+        # Identity of the full frame these columns were carved from (binary
+        # IPC: a delta frame only applies over its own base).
+        self.base_id = base_id
+        self._row_of: dict[str, int] | None = None
+
+    @classmethod
+    def from_entries(cls, entries: list[tuple[EndpointMetadata, Metrics, dict]]
+                     ) -> "PoolColumns":
+        n = len(entries)
+        num = {f: np.empty(n, dtype=np.float64) for f in NUMERIC_FIELDS}
+        role_code = np.empty(n, dtype=np.int8)
+        draining = np.empty(n, dtype=bool)
+        keys: list[str] = []
+        metas: list[EndpointMetadata] = []
+        attrs: list[dict] = []
+        models: list[tuple[dict, dict]] = []
+        cols = [num[f] for f in NUMERIC_FIELDS]
+        for i, (meta, m, a) in enumerate(entries):
+            keys.append(meta.address_port)
+            metas.append(meta)
+            attrs.append(a)
+            models.append((m.active_models, m.waiting_models))
+            labels = meta.labels
+            role_code[i] = role_code_for(labels)
+            draining[i] = bool(labels.get(DRAINING_LABEL))
+            for arr, f in zip(cols, NUMERIC_FIELDS):
+                arr[i] = getattr(m, f)
+        return cls(n, keys, metas, attrs, models, role_code, draining, num)
+
+    # Duck-compat with ColumnsRef: ColumnMetrics resolves `src.cols`, which
+    # is the live holder's current columns or — bound to a frozen snapshot —
+    # these columns themselves.
+    @property
+    def cols(self) -> "PoolColumns":
+        return self
+
+    def row_of(self) -> dict[str, int]:
+        m = self._row_of
+        if m is None:
+            m = self._row_of = {k: i for i, k in enumerate(self.keys)}
+        return m
+
+    def with_arrays(self, num: dict[str, np.ndarray]) -> "PoolColumns":
+        """Metrics-only successor (binary delta frame): new numeric arrays,
+        everything else shared by reference."""
+        return PoolColumns(self.n, self.keys, self.metas, self.attrs,
+                           self.models, self.role_code, self.draining,
+                           num, base_id=self.base_id)
+
+    def _metrics_at(self, row: int) -> Metrics:
+        kwargs: dict[str, Any] = {}
+        for f in NUMERIC_FIELDS:
+            v = float(self.num[f][row])
+            if f in _INT_FIELDS and v == v and float(int(v)) == v:
+                kwargs[f] = int(v)
+            else:
+                kwargs[f] = v
+        active, waiting = self.models[row]
+        return Metrics(active_models=dict(active),
+                       waiting_models=dict(waiting), **kwargs)
+
+    def materialize_entries(self) -> list[tuple[EndpointMetadata, Metrics, dict]]:
+        return [(self.metas[i], self._metrics_at(i), self.attrs[i])
+                for i in range(self.n)]
+
+
+class ColumnsRef:
+    """Mutable holder the fleet follower swaps on each delta frame: live
+    ``Endpoint.metrics`` proxies bound to this ref always read the newest
+    applied columns (O(1) per frame), while snapshot views bind the frozen
+    PoolColumns directly."""
+
+    __slots__ = ("cols",)
+
+    def __init__(self, cols: "PoolColumns"):
+        self.cols = cols
+
+
+def _num_prop(field: str, as_int: bool):
+    if as_int:
+        def get(self):
+            v = float(self._src.cols.num[field][self._row])
+            if v != v:  # NaN passes through un-cast
+                return v
+            i = int(v)
+            return i if i == v else v
+    else:
+        def get(self):
+            return float(self._src.cols.num[field][self._row])
+    return property(get)
+
+
+class ColumnMetrics:
+    """Column-backed read-only stand-in for ``Metrics``: one (source, row)
+    pair instead of a 13-field dataclass copy. Duck-compatible with every
+    metrics READER in the tree (scorers, saturation detector, pool gauges);
+    writers must ``materialize()`` first — followers have no scrape
+    collectors, and leader promotion re-materializes live endpoints
+    (Datastore.resume_local_snapshots)."""
+
+    __slots__ = ("_src", "_row")
+
+    def __init__(self, src: Any, row: int):
+        # src: a ColumnsRef (live endpoints — tracks delta applies) or a
+        # PoolColumns (frozen snapshot views).
+        self._src = src
+        self._row = row
+
+    @property
+    def active_models(self) -> dict:
+        return self._src.cols.models[self._row][0]
+
+    @property
+    def waiting_models(self) -> dict:
+        return self._src.cols.models[self._row][1]
+
+    @property
+    def fresh(self) -> bool:
+        ut = float(self._src.cols.num["update_time"][self._row])
+        return (time.monotonic() - ut) < 5.0 if ut else False
+
+    def materialize(self) -> Metrics:
+        return self._src.cols._metrics_at(self._row)
+
+    def clone(self) -> Metrics:
+        return self.materialize()
+
+    def __repr__(self) -> str:
+        return f"ColumnMetrics(row={self._row})"
+
+
+for _f in NUMERIC_FIELDS:
+    setattr(ColumnMetrics, _f, _num_prop(_f, _f in _INT_FIELDS))
+del _f
 
 
 class OverlayAttributes:
@@ -84,6 +312,19 @@ class OverlayAttributes:
                 return default
         if hasattr(v, "clone"):
             return v.clone()
+        return v
+
+    def peek(self, key: str, default: Any = None) -> Any:
+        """Read WITHOUT the clone-on-read copy: a read-only borrow for
+        vectorized scorer kernels that extract one numeric field per row —
+        the clone (a dataclasses.replace per endpoint per cycle) is the
+        dominant cost of attribute-driven scoring at pool scale. Callers
+        must not mutate the returned value."""
+        v = self._data.get(key, self._MISS)
+        if v is self._MISS:
+            v = self._base.get(key, self._MISS)
+            if v is self._MISS:
+                return default
         return v
 
     def keys(self) -> Iterable[str]:
@@ -119,16 +360,17 @@ class PoolSnapshot:
     builds fresh per-request SnapshotEndpoints (cheap: three slot stores
     per endpoint) so concurrent cycles never share a mutable object."""
 
-    __slots__ = ("epoch", "built_at", "_entries")
+    __slots__ = ("epoch", "built_at", "_entries", "_columns")
 
     def __init__(self, epoch: int, endpoints: Iterable[Endpoint]):
         self.epoch = epoch
         self.built_at = time.monotonic()
         # (metadata ref, metrics copy, attributes base copy) per endpoint.
-        self._entries: list[tuple[EndpointMetadata, Metrics, dict]] = [
+        self._entries: list[tuple[EndpointMetadata, Metrics, dict]] | None = [
             (ep.metadata, _copy_metrics(ep.metrics),
              _copy_dict(ep.attributes._data))
             for ep in endpoints]
+        self._columns: PoolColumns | None = None
 
     @classmethod
     def from_entries(cls, epoch: int,
@@ -144,19 +386,160 @@ class PoolSnapshot:
         snap.built_at = time.monotonic()
         snap._entries = [(meta, metrics, dict(attrs))
                          for meta, metrics, attrs in entries]
+        snap._columns = None
+        return snap
+
+    @classmethod
+    def from_columns(cls, epoch: int, cols: PoolColumns) -> "PoolSnapshot":
+        """Install decoded binary-frame columns directly as the scheduling
+        view (fleet follower, router/snapwire.py): no per-endpoint
+        re-marshal — entries materialize lazily only if something (e.g. a
+        promotion-time republish) actually asks for them."""
+        snap = cls.__new__(cls)
+        snap.epoch = epoch
+        snap.built_at = time.monotonic()
+        snap._entries = None
+        snap._columns = cols
         return snap
 
     def entries(self) -> list[tuple[EndpointMetadata, Metrics, dict]]:
         """The raw (metadata, metrics, attrs) entries — the serialization
         unit the fleet's snapshot publisher pickles onto the IPC socket.
         Treat as immutable: the tuples are shared with live views."""
+        if self._entries is None:
+            self._entries = self._columns.materialize_entries()
         return self._entries
 
+    def columns(self) -> PoolColumns:
+        """The columnar view of this epoch, built lazily once and shared by
+        every scheduling cycle against it (vectorized kernels index these
+        arrays). Benign to race: two threads may both build; both results
+        are equivalent and immutable."""
+        cols = self._columns
+        if cols is None:
+            cols = self._columns = PoolColumns.from_entries(self._entries)
+        return cols
+
     def __len__(self) -> int:
+        if self._entries is None:
+            return self._columns.n
         return len(self._entries)
 
     def view(self) -> list[SnapshotEndpoint]:
-        """A fresh scheduling view: one overlay endpoint per pool member."""
+        """A fresh scheduling view: one overlay endpoint per pool member.
+        Columns-backed snapshots (fleet follower) hand out column-metrics
+        proxies instead of dataclass copies — same reads, zero re-marshal."""
         epoch = self.epoch
+        if self._entries is None:
+            cols = self._columns
+            return [SnapshotEndpoint(cols.metas[i], ColumnMetrics(cols, i),
+                                     cols.attrs[i], epoch)
+                    for i in range(cols.n)]
         return [SnapshotEndpoint(meta, metrics, attrs, epoch)
                 for meta, metrics, attrs in self._entries]
+
+    def view_at(self, i: int) -> SnapshotEndpoint:
+        """One pool member's overlay view without materializing the rest —
+        the vectorized cycle's picked-rows path (EndpointBatch.view_row)."""
+        if self._entries is None:
+            cols = self._columns
+            return SnapshotEndpoint(cols.metas[i], ColumnMetrics(cols, i),
+                                    cols.attrs[i], self.epoch)
+        meta, metrics, attrs = self._entries[i]
+        return SnapshotEndpoint(meta, metrics, attrs, self.epoch)
+
+
+class EndpointBatch:
+    """The candidate set handed to a vectorized scheduling cycle: the
+    snapshot's shared PoolColumns plus an optional base row restriction
+    (Envoy subset hint). List-duck-compatible — ``len``/iteration/indexing
+    materialize per-request ``SnapshotEndpoint`` views lazily, so scalar
+    consumers (producers, fallback plugins, the proxy leg) keep working
+    while vectorized kernels index the arrays and never build views at
+    all."""
+
+    __slots__ = ("snapshot", "columns", "base_rows", "_views", "_row_views")
+
+    def __init__(self, snapshot: PoolSnapshot,
+                 base_rows: np.ndarray | None = None):
+        self.snapshot = snapshot
+        self.columns = snapshot.columns()
+        # None = every pool row; else an int64 row-index array (subset).
+        self.base_rows = base_rows
+        self._views: list[SnapshotEndpoint] | None = None
+        # Sparse row → view cache: a pure-kernel cycle that only needs its
+        # few PICKED endpoints must not pay O(pool) view construction.
+        # Identity-stable with views(): a row's view is built once per
+        # batch whichever path asks first, so overlay writes stay shared.
+        self._row_views: dict[int, SnapshotEndpoint] = {}
+
+    def all_rows(self) -> np.ndarray:
+        if self.base_rows is not None:
+            return self.base_rows
+        return np.arange(self.columns.n, dtype=np.int64)
+
+    def view_row(self, r: int) -> SnapshotEndpoint:
+        """This batch's overlay view of pool row ``r`` (built on demand)."""
+        v = self._views
+        if v is not None:
+            return v[r]
+        view = self._row_views.get(r)
+        if view is None:
+            view = self._row_views[r] = self.snapshot.view_at(r)
+        return view
+
+    def views(self) -> list[SnapshotEndpoint]:
+        """Full-pool per-request views, materialized once per batch (the
+        producer/scalar-fallback path; overlay writes land here)."""
+        v = self._views
+        if v is None:
+            cache = self._row_views
+            v = self._views = [
+                cache.get(i) if i in cache else self.snapshot.view_at(i)
+                for i in range(self.columns.n)]
+        return v
+
+    def endpoints_at(self, rows) -> list[SnapshotEndpoint]:
+        rs = rows.tolist() if isinstance(rows, np.ndarray) else rows
+        v = self._views
+        if v is not None:
+            return [v[r] for r in rs]
+        return [self.view_row(r) for r in rs]
+
+    def keys_at(self, rows) -> list[str]:
+        ks = self.columns.keys
+        return [ks[r] for r in rows.tolist()] if isinstance(rows, np.ndarray) \
+            else [ks[r] for r in rows]
+
+    def subset(self, allowed: set[str]) -> "EndpointBatch":
+        """Restrict to the address_ports in ``allowed`` (subset hint),
+        sharing the materialized views so overlay writes stay visible."""
+        keys = self.columns.keys
+        rows = np.fromiter((r for r in self.all_rows().tolist()
+                            if keys[r] in allowed), dtype=np.int64)
+        nb = EndpointBatch.__new__(EndpointBatch)
+        nb.snapshot = self.snapshot
+        nb.columns = self.columns
+        nb.base_rows = rows
+        nb._views = self._views
+        nb._row_views = self._row_views
+        return nb
+
+    def __len__(self) -> int:
+        if self.base_rows is not None:
+            return len(self.base_rows)
+        return self.columns.n
+
+    def __iter__(self):
+        if self.base_rows is None:
+            return iter(self.views())
+        return iter(self.endpoints_at(self.base_rows))
+
+    def __getitem__(self, i):
+        if self.base_rows is None:
+            return self.views()[i]
+        return self.views()[int(self.base_rows[i])]
+
+    def __repr__(self) -> str:
+        return (f"EndpointBatch(n={len(self)}, "
+                f"epoch={self.snapshot.epoch})")

@@ -1,0 +1,313 @@
+"""Binary snapshot wire format for the fleet's snapshot IPC (router/fleet.py).
+
+Replaces the whole-pool pickle `("snap", epoch, entries)` frame with a
+versioned columnar layout built directly from PoolSnapshot's PoolColumns
+(router/snapshot.py): the numeric metric columns ship as raw float64
+buffers, role/draining as byte arrays, endpoint metadata through a compact
+string table, and only the irreducibly-dynamic remainder (attribute dicts +
+model dicts) as one pickle blob. The follower decodes with ``np.frombuffer``
+— zero-copy array views over the received payload — and installs the columns
+DIRECTLY as its scheduling view (Datastore.apply_remote_columns), so frame
+apply cost stops scaling with pool size the way per-entry unpickling did.
+
+Metrics-only epochs (the steady state: scrapes land, membership and
+attributes unchanged) ship as DELTA frames carrying just the numeric
+columns with ABSOLUTE values — a dropped delta is healed by the next one,
+and continuity is anchored by ``base_id`` (the epoch of the full frame whose
+metas/attrs the delta rides on), never by fragile per-frame diffs.
+
+Layout (all integers big-endian in the header, native in array payloads —
+frames never leave the host: this is unix-socket IPC):
+
+    header  "!4sBBHQQI" = magic | version | kind | flags | epoch
+                          | xxh64(payload) | payload_len
+    full    u32 n | NUMERIC_FIELDS × (n × f8) | n × i1 role | n × u1 drain
+            | string table | meta ints (u32) | u32 blob_len | pickle blob
+    delta   u32 n | u64 base_id | NUMERIC_FIELDS × (n × f8)
+
+Corruption never crashes a subscriber: every decode failure raises
+FrameError with a reason in {"truncated", "checksum", "version",
+"malformed"}, counted by router_snapshot_frame_errors_total and skipped
+(the outer length prefix keeps the stream aligned regardless).
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+import struct
+from typing import Any
+
+import numpy as np
+import xxhash
+
+from .framework.datalayer import EndpointMetadata
+from .snapshot import NUMERIC_FIELDS, PoolColumns
+
+log = logging.getLogger("router.snapwire")
+
+MAGIC = b"SNPW"
+VERSION = 1
+KIND_FULL = 1
+KIND_DELTA = 2
+
+# magic 4s | version B | kind B | flags H | epoch Q | checksum Q | len I
+_HEADER = struct.Struct("!4sBBHQQI")
+_U32 = struct.Struct("!I")
+_U64 = struct.Struct("!Q")
+
+_F8 = np.dtype(np.float64)
+
+
+class FrameError(Exception):
+    """A frame that must be skipped, never crash the subscriber. ``reason``
+    is the router_snapshot_frame_errors_total label value."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        super().__init__(f"{reason}: {detail}" if detail else reason)
+        self.reason = reason
+
+
+def _checksum(payload: bytes) -> int:
+    return xxhash.xxh64(payload).intdigest()
+
+
+# ---- string table ---------------------------------------------------------
+
+
+class _StringTable:
+    """Deduplicating string pool for metadata encoding: names, addresses,
+    namespaces, schemes, and label keys/values repeat heavily across a
+    pool's endpoints."""
+
+    def __init__(self):
+        self._index: dict[str, int] = {}
+        self.strings: list[str] = []
+
+    def add(self, s: str) -> int:
+        i = self._index.get(s)
+        if i is None:
+            i = self._index[s] = len(self.strings)
+            self.strings.append(s)
+        return i
+
+    def encode(self) -> bytes:
+        parts = [_U32.pack(len(self.strings))]
+        for s in self.strings:
+            b = s.encode("utf-8")
+            parts.append(_U32.pack(len(b)))
+            parts.append(b)
+        return b"".join(parts)
+
+
+def _decode_strings(payload: bytes, off: int) -> tuple[list[str], int]:
+    (count,) = _U32.unpack_from(payload, off)
+    off += 4
+    out: list[str] = []
+    for _ in range(count):
+        (ln,) = _U32.unpack_from(payload, off)
+        off += 4
+        out.append(payload[off:off + ln].decode("utf-8"))
+        off += ln
+    return out, off
+
+
+# ---- attribute sanitization (per-(key, id) verdict cache) ----------------
+
+
+class AttrSanitizer:
+    """Pickles the (attrs, models) remainder of a frame, dropping
+    unpicklable attribute values. The whole-blob pickle is tried first; on
+    failure, per-value probes are memoized by ``(attr_key, id(value))`` so
+    steady-state frames (same value objects every epoch) skip the probe
+    pass entirely — the pre-cache behavior re-pickled every attribute of
+    every endpoint on every frame. The id() key can collide after an object
+    is freed and its address reused; the worst case is one stale verdict
+    for one value (a spuriously dropped or re-probed attribute), strictly
+    better than the old global drop-this-key-forever cache."""
+
+    MAX_CACHE = 65536
+
+    def __init__(self):
+        self._verdicts: dict[tuple[str, int], bool] = {}
+        self.dropped_keys: set[str] = set()
+
+    def probe(self, key: str, value: Any) -> bool:
+        vk = (key, id(value))
+        ok = self._verdicts.get(vk)
+        if ok is None:
+            if len(self._verdicts) >= self.MAX_CACHE:
+                self._verdicts.clear()
+            try:
+                pickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
+                ok = True
+            except Exception:
+                ok = False
+            self._verdicts[vk] = ok
+            if not ok and key not in self.dropped_keys:
+                self.dropped_keys.add(key)
+                log.warning("snapshot IPC: dropping unpicklable endpoint "
+                            "attribute %r from published frames", key)
+        return ok
+
+    def blob(self, attrs: list[dict], models: list[tuple]) -> bytes:
+        try:
+            return pickle.dumps((attrs, models),
+                                protocol=pickle.HIGHEST_PROTOCOL)
+        except Exception:
+            sanitized = [{k: v for k, v in a.items() if self.probe(k, v)}
+                         for a in attrs]
+            return pickle.dumps((sanitized, models),
+                                protocol=pickle.HIGHEST_PROTOCOL)
+
+
+# ---- encode ---------------------------------------------------------------
+
+
+def _pack_frame(kind: int, epoch: int, payload: bytes) -> bytes:
+    return _HEADER.pack(MAGIC, VERSION, kind, 0, epoch,
+                        _checksum(payload), len(payload)) + payload
+
+
+def encode_full(epoch: int, cols: PoolColumns, blob: bytes) -> bytes:
+    """One full frame: membership + metadata + numeric columns + the
+    sanitized (attrs, models) pickle ``blob`` (AttrSanitizer.blob)."""
+    n = cols.n
+    parts: list[bytes] = [_U32.pack(n)]
+    for f in NUMERIC_FIELDS:
+        parts.append(cols.num[f].tobytes())
+    parts.append(cols.role_code.tobytes())
+    parts.append(cols.draining.tobytes())
+
+    table = _StringTable()
+    ints: list[int] = []
+    for meta in cols.metas:
+        ints.append(table.add(meta.name))
+        ints.append(table.add(meta.address))
+        ints.append(meta.port)
+        ints.append(table.add(meta.namespace))
+        # 0 = None, else metrics_port + 1
+        ints.append(0 if meta.metrics_port is None else meta.metrics_port + 1)
+        ints.append(table.add(meta.scheme))
+        labels = meta.labels
+        ints.append(len(labels))
+        for k, v in labels.items():
+            ints.append(table.add(k))
+            ints.append(table.add(str(v)))
+    parts.append(table.encode())
+    meta_ints = np.asarray(ints, dtype=np.uint32)
+    parts.append(_U32.pack(len(meta_ints)))
+    parts.append(meta_ints.tobytes())
+    parts.append(_U32.pack(len(blob)))
+    parts.append(blob)
+    return _pack_frame(KIND_FULL, epoch, b"".join(parts))
+
+
+def encode_delta(epoch: int, base_id: int,
+                 num: dict[str, np.ndarray]) -> bytes:
+    """Metrics-only frame over the full frame ``base_id``: absolute column
+    values, so a lost delta is healed by the next one."""
+    n = len(num[NUMERIC_FIELDS[0]])
+    parts = [_U32.pack(n), _U64.pack(base_id)]
+    for f in NUMERIC_FIELDS:
+        parts.append(num[f].tobytes())
+    return _pack_frame(KIND_DELTA, epoch, b"".join(parts))
+
+
+# ---- decode ---------------------------------------------------------------
+
+
+def is_binary_frame(payload: bytes) -> bool:
+    """Binary frames lead with MAGIC; pickle protocol 2+ leads with 0x80,
+    so the two cannot collide on the shared length-prefixed stream."""
+    return payload[:4] == MAGIC
+
+
+def _num_views(payload: bytes, off: int, n: int
+               ) -> tuple[dict[str, np.ndarray], int]:
+    """Zero-copy read-only float64 views over the payload — the arrays ARE
+    the frame bytes (PoolColumns is immutable by contract, so read-only
+    backing is fine)."""
+    num: dict[str, np.ndarray] = {}
+    for f in NUMERIC_FIELDS:
+        num[f] = np.frombuffer(payload, dtype=_F8, count=n, offset=off)
+        off += n * 8
+    return num, off
+
+
+def decode(payload: bytes) -> tuple:
+    """Decode one binary frame payload (already magic-checked is fine but
+    not required). Returns ``("full", epoch, PoolColumns)`` or
+    ``("delta", epoch, base_id, num_arrays)``. Raises FrameError."""
+    if len(payload) < _HEADER.size:
+        raise FrameError("truncated",
+                         f"{len(payload)}B < {_HEADER.size}B header")
+    magic, version, kind, _flags, epoch, checksum, length = \
+        _HEADER.unpack_from(payload, 0)
+    if magic != MAGIC:
+        raise FrameError("malformed", "bad magic")
+    if version != VERSION:
+        raise FrameError("version", f"frame v{version}, supported v{VERSION}")
+    body = payload[_HEADER.size:]
+    if len(body) != length:
+        raise FrameError("truncated",
+                         f"payload {len(body)}B, header says {length}B")
+    if _checksum(body) != checksum:
+        raise FrameError("checksum", "payload digest mismatch")
+    try:
+        if kind == KIND_FULL:
+            return ("full", epoch, _decode_full(body, epoch))
+        if kind == KIND_DELTA:
+            (n,) = _U32.unpack_from(body, 0)
+            (base_id,) = _U64.unpack_from(body, 4)
+            num, off = _num_views(body, 12, n)
+            if off > len(body):
+                raise FrameError("truncated", "delta arrays overrun payload")
+            return ("delta", epoch, base_id, num)
+        raise FrameError("malformed", f"unknown kind {kind}")
+    except FrameError:
+        raise
+    except Exception as e:  # struct/pickle/index errors on a valid digest
+        raise FrameError("malformed", str(e)) from e
+
+
+def _decode_full(body: bytes, epoch: int) -> PoolColumns:
+    (n,) = _U32.unpack_from(body, 0)
+    num, off = _num_views(body, 4, n)
+    role_code = np.frombuffer(body, dtype=np.int8, count=n, offset=off)
+    off += n
+    draining = np.frombuffer(body, dtype=bool, count=n, offset=off)
+    off += n
+    strings, off = _decode_strings(body, off)
+    (n_ints,) = _U32.unpack_from(body, off)
+    off += 4
+    ints = np.frombuffer(body, dtype=np.uint32, count=n_ints, offset=off)
+    off += n_ints * 4
+    (blob_len,) = _U32.unpack_from(body, off)
+    off += 4
+    attrs, models = pickle.loads(body[off:off + blob_len])
+    if len(attrs) != n or len(models) != n:
+        raise FrameError("malformed",
+                         f"blob rows {len(attrs)}/{len(models)} != n {n}")
+
+    metas: list[EndpointMetadata] = []
+    keys: list[str] = []
+    it = ints.tolist()
+    pos = 0
+    for _ in range(n):
+        name_i, addr_i, port, ns_i, mport, scheme_i, n_labels = \
+            it[pos:pos + 7]
+        pos += 7
+        labels = {}
+        for _ in range(n_labels):
+            labels[strings[it[pos]]] = strings[it[pos + 1]]
+            pos += 2
+        meta = EndpointMetadata(
+            name=strings[name_i], address=strings[addr_i], port=port,
+            namespace=strings[ns_i],
+            metrics_port=None if mport == 0 else mport - 1,
+            labels=labels, scheme=strings[scheme_i])
+        metas.append(meta)
+        keys.append(meta.address_port)
+    return PoolColumns(n, keys, metas, attrs, models, role_code, draining,
+                       num, base_id=epoch)

@@ -88,6 +88,10 @@ REQUIRED_FAMILIES = {
     # Multi-process sharded fleet (ISSUE 9): per-worker snapshot epoch and
     # the supervisor's shard-labeled liveness/request/epoch families.
     ("router_snapshot_epoch", "router"),
+    # Binary snapshot-wire robustness (ISSUE 19): corrupt/truncated/
+    # version-mismatched frames are counted and skipped, never a
+    # subscriber crash.
+    ("router_snapshot_frame_errors", "router"),
     ("router_fleet_workers", "fleet"),
     ("router_shard_up", "fleet"),
     ("router_shard_snapshot_epoch", "fleet"),

@@ -1,0 +1,128 @@
+"""Vectorized-kernel coverage lint for scheduling plugins.
+
+The columnar scheduling hot path (router/scheduling/scheduler.py
+``_run_batch``) runs a plugin's vectorized kernel (``filter_batch`` /
+``score_batch`` / ``pick_batch``) when it has one and silently falls back
+to the scalar per-endpoint loop when it doesn't. The fallback is correct —
+that's the compatibility contract (router/framework/scheduling.py) — but
+SILENT: a kernel lost in a refactor, or never written for a new plugin,
+costs the whole ≥10× per-cycle win at 1024 endpoints with no error
+anywhere (benchmarks/SCHED_HOTPATH.json).
+
+So scalar-only must be a DECLARED state, not an accident: every registered
+in-tree filter/scorer/picker either defines its kernel or is listed in
+``SCALAR_FALLBACK`` below with the reason it stays scalar. A plugin doing
+neither fails this lint; so does a stale listing (kernel present AND
+listed), exactly like scripts/verify_threadsafe.py fails on undeclared
+THREAD_SAFE.
+
+Run via ``make verify-vectorized``; tests/test_vectorized.py hooks it into
+the pytest run.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Registered plugin types that deliberately stay on the scalar fallback,
+# with why a whole-pool array form doesn't pay (or can't be bit-identical).
+# The scheduler's per-request cost for these is O(pool) Python — fine for
+# per-request-targeted filters and attr-graph scorers, wrong for anything
+# on the broad hot path.
+SCALAR_FALLBACK: dict[str, str] = {
+    "label-selector-filter": "arbitrary per-request label expressions",
+    "prefix-cache-affinity-filter": "threshold over per-request attr graph",
+    "circuit-breaker-filter": "reads breaker registry objects per endpoint",
+    "model-serving-filter": "set-membership over per-endpoint model dicts",
+    "slo-headroom-tier-filter": "tiering over per-request prediction attrs",
+    "header-based-testing-filter": "exact-match routing on request headers",
+    "transfer-aware-pair-scorer": "pairwise EWMA table lookups",
+    "lora-affinity-scorer": "adapter-set intersection per endpoint",
+    "no-hit-lru-scorer": "mutates its own LRU during scoring",
+    "latency-scorer": "reads per-request prediction attr objects",
+    "precise-prefix-cache-scorer": "per-request confirmed-index walk",
+    "weighted-random-picker": "sequential draw consumes data-dependent RNG",
+}
+
+_KERNELS = {"filter": "filter_batch", "scorer": "score_batch",
+            "picker": "pick_batch"}
+
+
+def check() -> list[str]:
+    import llm_d_inference_scheduler_tpu.router.plugins  # noqa: F401
+    import llm_d_inference_scheduler_tpu.router.plugins.saturation  # noqa: F401
+    import llm_d_inference_scheduler_tpu.router.requestcontrol.producers  # noqa: F401
+    from llm_d_inference_scheduler_tpu.router.config.loader import Handle
+    from llm_d_inference_scheduler_tpu.router.datalayer.datastore import Datastore
+    from llm_d_inference_scheduler_tpu.router.framework.plugin import (
+        global_registry,
+    )
+
+    handle = Handle(datastore=Datastore())
+    errors: list[str] = []
+    checked = 0
+    seen_classes: set[type] = set()
+    seen_types: set[str] = set()
+    for type_name in global_registry.known_types():
+        try:
+            obj = global_registry.instantiate(type_name, type_name, {}, handle)
+        except Exception as e:
+            errors.append(f"plugin type {type_name!r} failed to instantiate "
+                          f"with empty parameters: {e}")
+            continue
+        cls = type(obj)
+        if cls in seen_classes:  # aliases collapse onto one class
+            continue
+        seen_classes.add(cls)
+        # Out-of-tree plugins (tests, operator extensions) are exactly what
+        # the auto-adapter exists for — scalar-only is their contract, not
+        # a lint violation. This lint polices the in-tree set only.
+        if not cls.__module__.startswith("llm_d_inference_scheduler_tpu."):
+            continue
+        role = ("filter" if hasattr(obj, "filter") else
+                "scorer" if hasattr(obj, "score") else
+                "picker" if hasattr(obj, "pick") else None)
+        if role is None:
+            continue  # profile handler / decider / producer: no batch form
+        checked += 1
+        seen_types.add(cls.TYPE)
+        has_kernel = hasattr(cls, _KERNELS[role])
+        listed = cls.TYPE in SCALAR_FALLBACK
+        if has_kernel and listed:
+            errors.append(
+                f"{role} {cls.TYPE!r} ({cls.__name__}) defines "
+                f"{_KERNELS[role]} but is still listed in SCALAR_FALLBACK — "
+                f"remove the stale listing")
+        elif not has_kernel and not listed:
+            errors.append(
+                f"{role} {cls.TYPE!r} ({cls.__name__}) has no "
+                f"{_KERNELS[role]} kernel and is not declared in "
+                f"SCALAR_FALLBACK — write the vectorized kernel "
+                f"(bit-identical to the scalar path, None to decline) or "
+                f"list the type here with the reason it stays scalar")
+    for type_name in SCALAR_FALLBACK:
+        if type_name not in seen_types:
+            errors.append(f"SCALAR_FALLBACK lists {type_name!r}, which is "
+                          f"not a registered filter/scorer/picker type")
+    if checked == 0:
+        errors.append("no filter/scorer/picker types registered — "
+                      "registry import broken?")
+    return errors
+
+
+def main() -> int:
+    errors = check()
+    for e in errors:
+        print(f"verify-vectorized: {e}", file=sys.stderr)
+    if errors:
+        return 1
+    print("verify-vectorized: every registered filter/scorer/picker either "
+          "defines its vectorized kernel or declares scalar fallback")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
