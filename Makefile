@@ -8,7 +8,7 @@ PY ?= python
 	bench-router-sse bench-decisions bench-sched bench-sched-offload \
 	bench-scaleout bench-slo bench-overload bench-kvobs bench-multiturn \
 	bench-timeline bench-fleet-chaos bench-shadow bench-rebalance \
-	bench-forecast bench-autoscale bench-tails \
+	bench-forecast bench-autoscale bench-tails bench-pd-pipeline \
 	dryrun render-chart \
 	compile-check \
 	verify-metrics verify-decisions verify-hotpath verify-threadsafe \
@@ -174,6 +174,15 @@ bench-tails:
 # CacheLedger's engine-confirmed actual hit depths.
 bench-multiturn:
 	$(PY) bench.py --multi-turn
+
+# Pipelined P/D disaggregation bench (CPU-only): chunk-streamed KV
+# handoff (decode pulls chunk k while prefill computes chunk k+1) vs the
+# serial 2-phase protocol, on a sim pair whose per-peer pull map prices
+# the transfer >= 0.5x the prefill cost. Writes benchmarks/PD_PIPELINE.json
+# — gates: pipelined TTFT p50 >= 25% below serial at token parity, the
+# pipeline_enabled: false arm bit-identical to the pre-pipeline protocol.
+bench-pd-pipeline:
+	$(PY) bench.py --pd-pipeline
 
 # Shadow-policy evaluation bench (CPU-only): the live-path hook cost vs
 # the scheduling-cycle floor (kill-switch ~0%), then a skewed transfer

@@ -1472,6 +1472,82 @@ class TpuEngine:
         block = self.mcfg.kv_block_size
         return max(block, (w + block - 1) // block * block)
 
+    def _maybe_stage_chunk(self, s: "_Slot") -> None:
+        """Incremental KV staging for a chunk-streamed remote-decode
+        prefill (``kv_transfer_params.stream_chunks``, single-device host
+        path only — sharded/multi-host pages have no host-addressable chunk
+        bytes, so those exports stage whole at completion and the decode
+        peer's chunked pull degrades to the legacy full GET). Gathers the
+        newly COMPLETE blocks to host and appends them to the request's
+        ``kv_exports`` record; the record is created at the first chunk
+        (``complete=False``) so the SIGTERM drain gate (idle()) pins the
+        pod for the decode peer from the very first staged block."""
+        ktp = s.req.kv_transfer_params or {}
+        if not (ktp.get("do_remote_decode") and ktp.get("stream_chunks")):
+            return
+        if self._dist or self._page_layout()[0] is not None:
+            return
+        block = self.mcfg.kv_block_size
+        rid = s.req.request_id
+        with self._exports_lock:
+            rec = self.kv_exports.get(rid)
+        upto = min(s.prefill_written // block, len(s.blocks))
+        staged = int(rec["blocks_staged"]) if rec is not None else 0
+        if upto - staged <= 0:
+            return
+        if rec is None:
+            rec = {"created": time.monotonic(), "seq_len": s.prompt_len,
+                   "num_blocks": len(s.blocks), "chunk_data": [],
+                   "chunk_blocks": [], "chunks_staged": 0,
+                   "blocks_staged": 0, "complete": False}
+            with self._exports_lock:
+                self.kv_exports[rid] = rec
+        ids = np.asarray(s.blocks[staged:upto], np.int32)
+        k_np = np.asarray(self.k_pages[:, ids])
+        v_np = np.asarray(self.v_pages[:, ids])
+        # Append data BEFORE bumping the counters: the server's long-poll
+        # reads chunks_staged without the lock, so a reader that sees N
+        # staged chunks must find N chunk_data entries.
+        rec["chunk_data"].append((k_np, v_np))
+        rec["chunk_blocks"].append(upto - staged)
+        rec["blocks_staged"] = upto
+        rec["chunks_staged"] += 1
+
+    def _finalize_chunk_export(self, rec: dict[str, Any],
+                               blocks: list[int]) -> None:
+        """Completion staging for a chunk-streamed export: the remaining
+        blocks (including the final partial block) become the last chunk,
+        sliced out of the full gathered arrays _op_stage_kv just staged,
+        and the record flips ``complete`` — the decode peer's long-poll
+        terminates. Exports whose pages were never host-addressable
+        (sharded) carry no chunk_data; they complete with zero chunks and
+        the peer falls back to the full-payload GET."""
+        if "chunks_staged" not in rec:
+            rec.update({"chunk_data": [], "chunk_blocks": [],
+                        "chunks_staged": 0, "blocks_staged": 0})
+        staged = int(rec["blocks_staged"])
+        n = len(blocks)
+        if (n > staged and rec.get("k") is not None
+                and getattr(rec["k"], "is_fully_addressable", True)
+                and not self._dist):
+            k_np, v_np = np.asarray(rec["k"]), np.asarray(rec["v"])
+            rec["chunk_data"].append((k_np[:, staged:n], v_np[:, staged:n]))
+            rec["chunk_blocks"].append(n - staged)
+            rec["blocks_staged"] = n
+            rec["chunks_staged"] += 1
+        rec["complete"] = True
+
+    def _drop_partial_export(self, request_id: str) -> None:
+        """Reclaim a partially-staged chunk export whose prefill died
+        mid-stream (abort / window failure): the decode peer's next poll
+        404s and it falls back to local prefill. Completed exports are
+        never touched — a pulled-but-unreleased record stays for the TTL
+        sweep."""
+        with self._exports_lock:
+            rec = self.kv_exports.get(request_id)
+            if rec is not None and not rec.get("complete", True):
+                self.kv_exports.pop(request_id, None)
+
     def _advance_prefills(self):
         """Write ONE window for the first PREFILLING slot (round-robin is
         unnecessary: windows are small, and one per step keeps the decode
@@ -1520,6 +1596,7 @@ class TpuEngine:
                             **self._sample_np([req])))
             except Exception:
                 self.slots[idx] = None
+                self._drop_partial_export(req.request_id)
                 with self._cond:
                     self.allocator.free(s.blocks)
                     self.telemetry.observe_allocator(self.allocator)
@@ -1533,6 +1610,12 @@ class TpuEngine:
             self.telemetry.prompt_tokens.inc(len(window))
             s.prefill_written = written + len(window)
             s.prefill_rest = s.prefill_rest[len(window):]
+            if not last:
+                # Chunk-streamed remote-decode prefill: stage the window's
+                # newly COMPLETE blocks so a decode peer's long-poll pulls
+                # chunk k while chunk k+1 computes. The final (partial)
+                # block rides the completion staging in _finish_slot.
+                self._maybe_stage_chunk(s)
             if last:
                 hashes, caching = s.chunk_meta
                 s.chunk_meta = None
@@ -1627,20 +1710,25 @@ class TpuEngine:
     KV_IMPORT_STATS_CAP = 512
 
     def _note_kv_import(self, request_id: str, t0: float,
-                        nbytes: int | None, route: str) -> None:
+                        nbytes: int | None, route: str,
+                        exposed_ms: float | None = None) -> None:
         """Record one completed pull's duration/bytes for the server to
         stamp on the decode response (x-kv-pull-ms/-bytes → the router's
-        per-pair /debug/transfers table)."""
+        per-pair /debug/transfers table). Chunk-streamed pulls also carry
+        ``exposed_ms`` — the non-overlapped tail (x-kv-pull-exposed-ms)."""
         # A re-dispatched request id overwrites its dict entry; appending a
         # duplicate ring slot too would make a later eviction pop the LIVE
         # entry when the stale first occurrence reaches the front.
         if request_id not in self.kv_import_stats:
             self._kv_import_order.append(request_id)
-        self.kv_import_stats[request_id] = {
+        stats = {
             "ms": (time.monotonic() - t0) * 1e3,
             "bytes": int(nbytes or 0),
             "route": route,
         }
+        if exposed_ms is not None:
+            stats["exposed_ms"] = exposed_ms
+        self.kv_import_stats[request_id] = stats
         while len(self._kv_import_order) > self.KV_IMPORT_STATS_CAP:
             self.kv_import_stats.pop(self._kv_import_order.popleft(), None)
 
@@ -1722,13 +1810,16 @@ class TpuEngine:
                f"/kv/{ktp['remote_request_id']}")
         verify = self._client_tls_verify()
         try:
-            r = httpx.get(url, timeout=30.0, verify=verify)
-            r.raise_for_status()
-            pi.payload = r.content
-            pi.headers = dict(r.headers)
-            self.kv_import_host_count += 1
-            self._note_kv_import(pi.req.request_id, t0,
-                                 len(r.content), "host")
+            if ktp.get("stream_chunks"):
+                self._pull_host_chunks(pi, ktp, url, verify, t0)
+            else:
+                r = httpx.get(url, timeout=30.0, verify=verify)
+                r.raise_for_status()
+                pi.payload = r.content
+                pi.headers = dict(r.headers)
+                self.kv_import_host_count += 1
+                self._note_kv_import(pi.req.request_id, t0,
+                                     len(r.content), "host")
             try:
                 httpx.delete(url, timeout=5.0, verify=verify)
             except Exception:
@@ -1738,6 +1829,83 @@ class TpuEngine:
         with self._cond:
             self._import_ready.append(pi)
             self._cond.notify()
+
+    # Overall stall bound for one chunk-streamed pull (the per-poll
+    # long-poll bound is the server's KV_CHUNK_WAIT_CAP_MS).
+    KV_CHUNK_STREAM_TIMEOUT_S = 120.0
+
+    def _pull_host_chunks(self, pi, ktp, url: str, verify, t0: float) -> None:
+        """Pipelined host pull: long-poll ``?chunk=N`` so chunk k moves
+        while the prefill peer computes chunk k+1, then assemble the full
+        payload + synthesized geometry headers for the regular import path.
+        An exporter that never staged chunks (sharded pages) completes with
+        zero chunks — degrade to the legacy full-payload GET. Raises on any
+        protocol failure; the caller records pi.error and the engine falls
+        back to local prefill (zero client-visible errors)."""
+        import httpx
+
+        k_parts: list[bytes] = []
+        v_parts: list[bytes] = []
+        chunk = 0
+        total_blocks = 0
+        complete_at: float | None = None
+        chunk_shape = None
+        dtype = None
+        meta: dict[str, str] = {}
+        deadline = t0 + self.KV_CHUNK_STREAM_TIMEOUT_S
+        while True:
+            if time.monotonic() > deadline:
+                raise TimeoutError("kv chunk stream stalled")
+            r = httpx.get(url, params={"chunk": chunk, "wait_ms": 2000},
+                          timeout=30.0, verify=verify)
+            if r.status_code == 202:  # chunk not staged yet: re-poll
+                continue
+            if r.status_code == 204:  # complete, no further chunks
+                meta = dict(r.headers)
+                if complete_at is None:
+                    complete_at = time.monotonic()
+                break
+            r.raise_for_status()
+            hdrs = dict(r.headers)
+            if hdrs.get("x-kv-complete") == "1" and complete_at is None:
+                complete_at = time.monotonic()
+            body = r.content
+            half = len(body) // 2
+            k_parts.append(body[:half])
+            v_parts.append(body[half:])
+            total_blocks += int(hdrs.get("x-kv-chunk-blocks") or 0)
+            if hdrs.get("x-kv-chunk-shape"):
+                chunk_shape = json.loads(hdrs["x-kv-chunk-shape"])
+                dtype = hdrs.get("x-kv-dtype")
+            chunk += 1
+            if (hdrs.get("x-kv-complete") == "1"
+                    and chunk >= int(hdrs.get("x-kv-chunks-staged") or 0)):
+                meta = hdrs
+                break
+        if not k_parts or chunk_shape is None:
+            # Exporter had no host-addressable chunks: full-payload GET.
+            r = httpx.get(url, timeout=30.0, verify=verify)
+            r.raise_for_status()
+            pi.payload = r.content
+            pi.headers = dict(r.headers)
+            self.kv_import_host_count += 1
+            self._note_kv_import(pi.req.request_id, t0,
+                                 len(r.content), "host")
+            return
+        L, _, block, Hkv, Dh = (int(d) for d in chunk_shape)
+        pi.payload = b"".join(k_parts) + b"".join(v_parts)
+        pi.headers = {
+            "x-kv-shape": json.dumps([L, total_blocks, block, Hkv, Dh]),
+            "x-kv-seq-len": meta["x-kv-seq-len"],
+            "x-kv-dtype": str(dtype),
+            "x-kv-real-blocks": str(total_blocks),
+            "x-kv-first-token": meta.get("x-kv-first-token", ""),
+        }
+        self.kv_import_host_count += 1
+        t_end = time.monotonic()
+        exposed_ms = (t_end - max(complete_at or t0, t0)) * 1e3
+        self._note_kv_import(pi.req.request_id, t0, len(pi.payload),
+                             "host-chunked", exposed_ms=exposed_ms)
 
     def _check_shard_geometry(self, ktp: dict[str, Any]) -> None:
         """A sharded pull needs identical page-sharding geometry on both
@@ -2113,7 +2281,8 @@ class TpuEngine:
                 addrs.append(hello.get("shard_wire_address") or "")
         return addrs
 
-    def _op_stage_kv(self, request_id: str, idx: np.ndarray, tuid: int):
+    def _op_stage_kv(self, request_id: str, idx: np.ndarray, tuid: int,
+                     stream: bool = False):
         """Gather the export's blocks out of the (possibly sharded) pages
         and register this process's unique shards under ``tuid``. Runs on
         every process under dist (the gather is a collective program on
@@ -2171,6 +2340,23 @@ class TpuEngine:
                "shard_wire_uuid": wire_uuid,
                "staged_shards": staged_shards, "created": time.monotonic()}
         with self._exports_lock:
+            prev = self.kv_exports.get(request_id)
+            if prev is not None and "chunks_staged" in prev:
+                # Chunk-streamed prefill staged partial chunks already:
+                # carry them into the completed record (the decode peer may
+                # be mid-pull against them right now).
+                for key in ("chunk_data", "chunk_blocks", "chunks_staged",
+                            "blocks_staged"):
+                    rec[key] = prev[key]
+                rec["complete"] = False  # _finalize_chunk_export flips it
+            elif stream:
+                # Short-prompt stream_chunks export (no mid-prefill chunks):
+                # a pre-assigned-rid puller may already be polling, so the
+                # record must read INCOMPLETE until the finish path stamps
+                # its metadata and stages the single chunk.
+                rec.update({"chunk_data": [], "chunk_blocks": [],
+                            "chunks_staged": 0, "blocks_staged": 0,
+                            "complete": False})
             self.kv_exports[request_id] = rec
         return rec
 
@@ -2426,6 +2612,10 @@ class TpuEngine:
         s = self.slots[idx]
         self.slots[idx] = None
         kv_params = None
+        if not retain_for_transfer:
+            # Abort/error of a chunk-streaming prefill: reclaim the partial
+            # export so the decode peer's next poll 404s and it falls back.
+            self._drop_partial_export(s.req.request_id)
         if retain_for_transfer:
             # Stage the prefilled KV for pickup. Device path: gather the
             # slot's pages into fresh device arrays (the gather breaks the
@@ -2449,7 +2639,9 @@ class TpuEngine:
             # each process registers its local shards — a leader-only gather
             # would deadlock the mesh, so it rides the replayed op stream.
             rec = self._device_call(("stage_kv",), dict(
-                request_id=s.req.request_id, idx=padded, tuid=tuid))
+                request_id=s.req.request_id, idx=padded, tuid=tuid,
+                stream=bool((s.req.kv_transfer_params or {})
+                            .get("stream_chunks"))))
             kv_params = {
                 "remote_engine_id": self.engine_id,
                 "remote_request_id": s.req.request_id,
@@ -2470,6 +2662,12 @@ class TpuEngine:
                     "seq_len": s.position,        # prompt tokens in cache
                     "first_token": first_token,
                 })
+            if "chunks_staged" in rec:
+                # Chunk-streamed export: stage the tail chunk (including the
+                # final partial block) and flip complete — AFTER the
+                # metadata update above, so a puller observing complete=1
+                # always finds seq_len/first_token stamped.
+                self._finalize_chunk_export(rec, list(s.blocks))
             if rec.get("transfer_uuid") is not None:
                 kv_params.update({
                     "transfer_uuid": rec["transfer_uuid"],

@@ -632,9 +632,15 @@ class EngineServer:
             req.request_id, None)
         if not stats:
             return {}
-        return {"x-kv-pull-ms": f"{stats['ms']:.2f}",
-                "x-kv-pull-bytes": str(stats["bytes"]),
-                "x-kv-pull-route": stats["route"]}
+        out = {"x-kv-pull-ms": f"{stats['ms']:.2f}",
+               "x-kv-pull-bytes": str(stats["bytes"]),
+               "x-kv-pull-route": stats["route"]}
+        if stats.get("exposed_ms") is not None:
+            # Chunk-streamed pulls only: the non-overlapped tail of the
+            # pull (wall-time minus what hid behind the peer's prefill) —
+            # what the router's pair-cost EWMAs should charge the pair.
+            out["x-kv-pull-exposed-ms"] = f"{stats['exposed_ms']:.2f}"
+        return out
 
     def _queue_headers(self, req: EngineRequest) -> dict[str, str]:
         """Measured admission wait — submit() to the first ``_admit`` pop
@@ -1005,17 +1011,101 @@ class EngineServer:
 
     # ---- KV handoff data path (P/D disaggregation) ---------------------
 
+    # Long-poll bound for the /kv chunk surface: a decode peer "waits for
+    # chunk N" at most this long per request before getting a 202 and
+    # re-polling (docs/disaggregation.md §Pipelined KV streaming).
+    KV_CHUNK_WAIT_CAP_MS = 5000.0
+
+    @staticmethod
+    def _kv_chunk_headers(rec: dict) -> dict[str, str]:
+        """Staging-progress headers for the chunk-streamed /kv protocol.
+        Legacy (serial) export records carry no chunk fields — they read as
+        complete with zero chunks, which steers chunked pullers to the
+        legacy full-payload GET."""
+        h = {"x-kv-chunks-staged": str(int(rec.get("chunks_staged", 0))),
+             "x-kv-blocks-staged": str(int(
+                 rec.get("blocks_staged",
+                         rec.get("num_blocks", rec.get("n_blocks", 0)) or 0))),
+             "x-kv-complete": "1" if rec.get("complete", True) else "0"}
+        if rec.get("seq_len") is not None:
+            h["x-kv-seq-len"] = str(rec["seq_len"])
+        if rec.get("first_token") is not None:
+            h["x-kv-first-token"] = str(rec["first_token"])
+        return h
+
+    def _kv_chunk_response(self, rec: dict, chunk: int) -> web.Response:
+        """One staged chunk's bytes (real engine) or just its block count
+        (sim — the decode sim prices the transfer, it does not move bytes);
+        204 once the export is complete and ``chunk`` is past the last one."""
+        staged = int(rec.get("chunks_staged", 0))
+        headers = self._kv_chunk_headers(rec)
+        if chunk >= staged:
+            return web.Response(status=204, headers=headers)
+        headers["x-kv-chunk"] = str(chunk)
+        headers["x-kv-chunk-blocks"] = str(int(rec["chunk_blocks"][chunk]))
+        body = b""
+        data = rec.get("chunk_data")
+        if data is not None:
+            import numpy as np
+
+            k_np, v_np = data[chunk]
+            k_np, v_np = np.asarray(k_np), np.asarray(v_np)
+            body = k_np.tobytes() + v_np.tobytes()
+            headers["x-kv-chunk-shape"] = json.dumps(list(k_np.shape))
+            headers["x-kv-dtype"] = str(k_np.dtype)
+        return web.Response(body=body,
+                            content_type="application/octet-stream",
+                            headers=headers)
+
     async def kv_fetch(self, request: web.Request) -> web.Response:
         """Serve retained prefill KV pages for a request (host-staged DCN path).
 
         Returns raw bytes: concatenated K then V, each
         [L, n_blocks, block, Hkv, Dh] in the model dtype, plus geometry headers.
+
+        Chunk-streamed pipeline extension (all bounded long-polls via
+        ``wait_ms``, capped at KV_CHUNK_WAIT_CAP_MS):
+
+        - ``?chunk=N`` — serve staged chunk N of a chunk-streamed export
+          ([L, chunk_blocks, block, Hkv, Dh] K then V); 202 when the wait
+          expires before chunk N is staged; 204 when the export is complete
+          and N is past the last chunk.
+        - ``?ack=1`` — the sidecar's non-consuming first-chunk ack: 200 as
+          soon as ANY chunk is staged (or the export completed), 202 on
+          wait expiry — the signal that releases the pipelined decode leg.
         """
         rid = request.match_info["request_id"]
+        q = request.query
+        chunk = int(q["chunk"]) if "chunk" in q else None
+        ack = q.get("ack") == "1"
+        wait_ms = min(float(q.get("wait_ms", 0) or 0),
+                      self.KV_CHUNK_WAIT_CAP_MS)
+        deadline = time.monotonic() + wait_ms / 1e3
         get = getattr(self.engine, "get_kv_export", self.engine.kv_exports.get)
-        rec = get(rid)
+        while True:
+            rec = get(rid)
+            ready = False
+            if rec is not None:
+                staged = int(rec.get("chunks_staged", 0))
+                complete = bool(rec.get("complete", True))
+                if ack:
+                    ready = staged > 0 or complete
+                elif chunk is not None:
+                    ready = chunk < staged or complete
+                else:
+                    ready = complete
+            if ready or time.monotonic() >= deadline:
+                break
+            await asyncio.sleep(0.002)
         if rec is None:
             raise web.HTTPNotFound(text=f"no kv export for {rid}")
+        if not ready:  # bounded wait expired mid-stream: caller re-polls
+            return web.Response(status=202,
+                                headers=self._kv_chunk_headers(rec))
+        if ack:
+            return web.Response(headers=self._kv_chunk_headers(rec))
+        if chunk is not None:
+            return self._kv_chunk_response(rec, chunk)
         if "k" not in rec:
             raise web.HTTPNotImplemented(text="sim engine holds no real KV")
         if not getattr(rec["k"], "is_fully_addressable", True):

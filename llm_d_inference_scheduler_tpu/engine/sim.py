@@ -219,6 +219,102 @@ class SimEngine:
             self._blocks_used -= rec["n_blocks"]
             self._update_gauges()
 
+    async def _stream_prefill_export(self, req: EngineRequest, n_blocks: int,
+                                     prompt_len: int, prefill_s: float,
+                                     first: int) -> None:
+        """Chunk-streamed remote-decode prefill: the export record is created
+        UP FRONT (``chunks_staged=0``, ``complete=False``) and gains one chunk
+        per simulated prefill window, so a decode peer long-polling the /kv
+        chunk surface pulls chunk k while chunk k+1 "computes" — the same
+        schedule the real engine's ``_maybe_stage_chunk`` runs, priced on CPU.
+        The record owns the request's blocks from creation (the serve path
+        zeroes its local count), so cancellation mid-stream releases exactly
+        once — via ``release_kv_export`` here or the TTL sweep later."""
+        block = self.mcfg.kv_block_size
+        win = self.cfg.prefill_chunk
+        win = max(block, (win + block - 1) // block * block) if win > 0 else 0
+        rec: dict[str, Any] = {
+            "n_blocks": n_blocks, "seq_len": prompt_len,
+            "created": time.monotonic(), "first_token": first,
+            "chunk_blocks": [], "chunks_staged": 0,
+            "blocks_staged": 0, "complete": False}
+        self.kv_exports[req.request_id] = rec
+        try:
+            rest = prompt_len
+            while True:
+                step = min(win, rest) if win else rest
+                rest -= step
+                await asyncio.sleep(prefill_s * step / max(prompt_len, 1))
+                done = rest <= 0
+                upto = (n_blocks if done
+                        else min((prompt_len - rest) // block, n_blocks))
+                cb = upto - rec["blocks_staged"]
+                if cb > 0:
+                    rec["chunk_blocks"].append(cb)
+                    rec["blocks_staged"] = upto
+                    rec["chunks_staged"] += 1
+                if done:
+                    rec["complete"] = True
+                    return
+        except asyncio.CancelledError:
+            self.release_kv_export(req.request_id)
+            raise
+
+    def _pull_kv_chunks(self, ktp: dict[str, Any], rate: float,
+                        block: int) -> dict[str, Any] | None:
+        """Pipelined decode-side import (thread body): real HTTP long-polls
+        against the prefill pod's /kv chunk surface, sleeping the per-block
+        transfer cost per chunk — so the transfer genuinely overlaps the
+        peer's remaining prefill in wall-clock, which is what the pd-pipeline
+        bench measures. Returns kv_import_stats (with the non-overlapped
+        ``exposed_ms``) or None on any failure (caller degrades to local
+        prefill — zero client-visible errors)."""
+        import httpx
+
+        t0 = time.monotonic()
+        url = (f"http://{ktp['remote_host']}:{ktp['remote_port']}"
+               f"/kv/{ktp['remote_request_id']}")
+        chunk = 0
+        pulled = 0
+        complete_at: float | None = None
+        deadline = t0 + 60.0
+        try:
+            while True:
+                if time.monotonic() > deadline:
+                    return None
+                r = httpx.get(url, params={"chunk": chunk, "wait_ms": 1000},
+                              timeout=10.0)
+                if r.status_code == 202:  # chunk not staged yet: re-poll
+                    continue
+                if r.status_code == 204:  # no further chunks
+                    if complete_at is None:
+                        complete_at = time.monotonic()
+                    break
+                r.raise_for_status()
+                cb = int(r.headers.get("x-kv-chunk-blocks") or 0)
+                done = r.headers.get("x-kv-complete") == "1"
+                if done and complete_at is None:
+                    complete_at = time.monotonic()
+                time.sleep(rate * cb / 1000)
+                pulled += cb
+                chunk += 1
+                if done and chunk >= int(
+                        r.headers.get("x-kv-chunks-staged") or 0):
+                    break
+        except Exception:
+            return None
+        try:
+            httpx.delete(url, timeout=5.0)
+        except Exception:
+            pass  # exporter TTL sweep reclaims
+        t_end = time.monotonic()
+        # Exposed = the tail of the pull that was NOT hidden behind the
+        # peer's prefill: nothing before the first complete=1 observation
+        # counts (the prefill engine was still computing anyway).
+        exposed_s = t_end - max(complete_at if complete_at else t0, t0)
+        return {"ms": (t_end - t0) * 1e3, "exposed_ms": exposed_s * 1e3,
+                "bytes": pulled * block * 1024, "route": "sim-chunked"}
+
     async def _serve(self, req: EngineRequest, out: asyncio.Queue):
         self._waiting += 1
         self._update_gauges()
@@ -254,11 +350,12 @@ class SimEngine:
             # served-block LRU does NOT already hold — cache-hit prefills
             # are cheap, cold prefills expensive (the PPD premise the
             # multi-turn bench measures).
-            imported = (bool(ktp.get("remote_block_ids"))
+            imported = ((bool(ktp.get("remote_block_ids"))
+                         or bool(ktp.get("stream_chunks")))
                         and not ktp.get("do_remote_decode"))
+            chunked_pull = imported and bool(ktp.get("stream_chunks"))
             if imported:
                 self._commit_prefix_blocks(req)
-                n_pull = len(ktp["remote_block_ids"])
                 # Per-peer transfer topology: the prefill peer that staged
                 # the export (remote_host:remote_port) may carry its own
                 # ms/block rate — skewed-pair benches price fast and slow
@@ -269,24 +366,48 @@ class SimEngine:
                     rate = peers.get(
                         f"{ktp.get('remote_host')}:{ktp.get('remote_port')}",
                         rate)
-                pull_s = rate * n_pull / 1000
-                self.kv_import_stats[req.request_id] = {
-                    "ms": pull_s * 1e3,
-                    "bytes": n_pull * block * 1024,  # nominal 1KiB/token
-                    "route": "sim"}
-                while len(self.kv_import_stats) > 512:
-                    self.kv_import_stats.popitem(last=False)
+                pull_s = 0.0
+                if not chunked_pull:
+                    n_pull = len(ktp["remote_block_ids"])
+                    pull_s = rate * n_pull / 1000
+                    self.kv_import_stats[req.request_id] = {
+                        "ms": pull_s * 1e3,
+                        "bytes": n_pull * block * 1024,  # nominal 1KiB/token
+                        "route": "sim"}
+                    while len(self.kv_import_stats) > 512:
+                        self.kv_import_stats.popitem(last=False)
             else:
                 hit_tokens = self._note_prefix_hit(req)
                 pull_s = 0.0
             try:
-                if imported:
+                if chunked_pull:
+                    stats = await asyncio.to_thread(
+                        self._pull_kv_chunks, ktp, rate, block)
+                    if stats is not None:
+                        self.kv_import_stats[req.request_id] = stats
+                        while len(self.kv_import_stats) > 512:
+                            self.kv_import_stats.popitem(last=False)
+                    else:
+                        # Prefill peer died mid-stream: recompute the
+                        # prefill locally (reference fallback semantics) —
+                        # the client still gets its tokens.
+                        await asyncio.sleep(self.cfg.sim_prefill_ms_per_token
+                                            * prompt_len / 1000)
+                elif imported:
                     await asyncio.sleep(pull_s)
                 else:
                     cold_tokens = max(prompt_len - hit_tokens, 0)
                     prefill_s = (self.cfg.sim_prefill_ms_per_token
                                  * cold_tokens / 1000)
-                    await asyncio.sleep(prefill_s)
+                    if (ktp.get("do_remote_decode")
+                            and ktp.get("stream_chunks")):
+                        n_export = n_blocks
+                        n_blocks = 0  # owned by the export from creation
+                        await self._stream_prefill_export(
+                            req, n_export, prompt_len, prefill_s,
+                            self._gen_tokens[0])
+                    else:
+                        await asyncio.sleep(prefill_s)
                     # Import legs record no prefill-step sample (the real
                     # engine observes only actual prefill dispatches — a
                     # zero-valued sample would drag the histogram's
@@ -296,11 +417,13 @@ class SimEngine:
                 self.telemetry.ttft.observe(time.monotonic() - req.arrival_time)
                 first = self._gen_tokens[0]
                 if ktp.get("do_remote_decode"):
-                    self.kv_exports[req.request_id] = {
-                        "n_blocks": n_blocks, "seq_len": prompt_len,
-                        "created": time.monotonic()}
-                    block_ids = list(range(n_blocks))
-                    n_blocks = 0  # retained by the export, not released below
+                    rec = self.kv_exports.get(req.request_id)
+                    if rec is None:  # serial 2-phase: stage at completion
+                        rec = {"n_blocks": n_blocks, "seq_len": prompt_len,
+                               "created": time.monotonic()}
+                        self.kv_exports[req.request_id] = rec
+                        n_blocks = 0  # retained by the export, not released below
+                    block_ids = list(range(rec["n_blocks"]))
                     out.put_nowait(TokenEvent(
                         request_id=req.request_id, token_id=first,
                         text=self.tokenizer.decode([first]),

@@ -23,16 +23,30 @@ from typing import Any
 class PairTransferStats:
     """EWMA transfer cost for one (prefill → decode) pair."""
 
-    __slots__ = ("pulls", "ewma_pull_ms", "ewma_bytes", "ewma_prefill_ms",
-                 "bytes_total", "last_unix")
+    __slots__ = ("pulls", "ewma_pull_ms", "ewma_exposed_ms", "ewma_bytes",
+                 "ewma_prefill_ms", "bytes_total", "last_unix")
 
     def __init__(self):
         self.pulls = 0
         self.ewma_pull_ms: float | None = None
+        # EXPOSED (non-overlapped) pull cost on pipelined P/D requests —
+        # raw pull minus the portion hidden behind the prefill engine's
+        # remaining compute. None until the pair serves a pipelined pull.
+        self.ewma_exposed_ms: float | None = None
         self.ewma_bytes: float | None = None
         self.ewma_prefill_ms: float | None = None
         self.bytes_total = 0
         self.last_unix = 0.0
+
+    def cost_ms(self) -> float | None:
+        """The pair cost consumers (pair scorer, shadow judge, rebalancer,
+        prefill classifier) should score against: the EXPOSED pull EWMA
+        when the pair has pipelined observations — what a request actually
+        waits on — falling back to the raw pull EWMA for serial-only
+        pairs, where exposed == raw by definition."""
+        if self.ewma_exposed_ms is not None:
+            return self.ewma_exposed_ms
+        return self.ewma_pull_ms
 
     def render(self) -> dict[str, Any]:
         doc: dict[str, Any] = {"pulls": self.pulls,
@@ -40,6 +54,8 @@ class PairTransferStats:
                                "last_unix": self.last_unix}
         if self.ewma_pull_ms is not None:
             doc["ewma_pull_ms"] = round(self.ewma_pull_ms, 3)
+        if self.ewma_exposed_ms is not None:
+            doc["exposed_ms"] = round(self.ewma_exposed_ms, 3)
         if self.ewma_bytes is not None:
             doc["ewma_bytes"] = round(self.ewma_bytes, 1)
             if self.ewma_pull_ms:
@@ -65,7 +81,8 @@ class TransferTable:
 
     def record(self, prefill: str, decode: str, *,
                pull_ms: float | None = None, nbytes: int | None = None,
-               prefill_ms: float | None = None) -> None:
+               prefill_ms: float | None = None,
+               exposed_ms: float | None = None) -> None:
         key = (prefill, decode)
         stats = self._pairs.get(key)
         if stats is None:
@@ -84,6 +101,10 @@ class TransferTable:
             stats.ewma_pull_ms = (pull_ms if stats.ewma_pull_ms is None
                                   else (1 - a) * stats.ewma_pull_ms
                                   + a * pull_ms)
+        if exposed_ms is not None:
+            stats.ewma_exposed_ms = (
+                exposed_ms if stats.ewma_exposed_ms is None
+                else (1 - a) * stats.ewma_exposed_ms + a * exposed_ms)
         if nbytes is not None:
             stats.bytes_total += nbytes
             stats.ewma_bytes = (float(nbytes) if stats.ewma_bytes is None
@@ -99,17 +120,22 @@ class TransferTable:
         return self._pairs.get((prefill, decode))
 
     def cheapest_pull_ms(self, decode: str) -> float | None:
-        """Cheapest measured pull EWMA INTO one decode pod over every
+        """Cheapest measured pull cost INTO one decode pod over every
         measured (prefill, decode) pair — the prefill classifier's
         pair-cost margin input (a cheap available pull weakens the case
-        for skipping the P/D hop). None when no pair into the pod has a
-        measured pull yet. Bounded O(MAX_PAIRS) scan, paid only while the
-        classifier's pairCostRefMs coupling is configured on."""
+        for skipping the P/D hop). Reads the EXPOSED cost when a pair has
+        pipelined observations (``cost_ms``): a pull fully hidden behind
+        prefill compute is ~free from the request's perspective. None when
+        no pair into the pod has a measured pull yet. Bounded
+        O(MAX_PAIRS) scan, paid only while the classifier's pairCostRefMs
+        coupling is configured on."""
         best: float | None = None
         for (_p, d), stats in self._pairs.items():
-            if d == decode and stats.ewma_pull_ms is not None \
-                    and (best is None or stats.ewma_pull_ms < best):
-                best = stats.ewma_pull_ms
+            if d != decode:
+                continue
+            cost = stats.cost_ms()
+            if cost is not None and (best is None or cost < best):
+                best = cost
         return best
 
     def snapshot(self) -> dict[str, Any]:

@@ -924,7 +924,8 @@ def merge_transfers(docs: list[tuple[int, dict[str, Any]]]) -> dict[str, Any]:
                 out = merged[key] = {"prefill": key[0], "decode": key[1],
                                      "pulls": 0, "bytes_total": 0,
                                      "last_unix": 0.0, "shards": []}
-                weights[key] = {"pull": 0.0, "bytes": 0.0, "prefill": 0.0}
+                weights[key] = {"pull": 0.0, "exposed": 0.0, "bytes": 0.0,
+                                "prefill": 0.0}
             w = weights[key]
             pulls = int(row.get("pulls") or 0)
             out["pulls"] += pulls
@@ -938,6 +939,7 @@ def merge_transfers(docs: list[tuple[int, dict[str, Any]]]) -> dict[str, Any]:
             # weight 1.
             pw = float(max(pulls, 1))
             for field, wkey, wval in (("ewma_pull_ms", "pull", float(pulls)),
+                                      ("exposed_ms", "exposed", float(pulls)),
                                       ("ewma_bytes", "bytes", float(pulls)),
                                       ("ewma_prefill_ms", "prefill", pw)):
                 v = row.get(field)
@@ -951,7 +953,8 @@ def merge_transfers(docs: list[tuple[int, dict[str, Any]]]) -> dict[str, Any]:
                 w[wkey] = prev_w + wval
     pairs = []
     for out in merged.values():
-        for field in ("ewma_pull_ms", "ewma_bytes", "ewma_prefill_ms"):
+        for field in ("ewma_pull_ms", "exposed_ms", "ewma_bytes",
+                      "ewma_prefill_ms"):
             if out.get(field) is not None:
                 out[field] = round(out[field], 3)
         if out.get("ewma_bytes") is not None and out.get("ewma_pull_ms"):

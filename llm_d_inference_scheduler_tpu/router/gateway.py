@@ -39,6 +39,7 @@ from .framework.scheduling import InferenceRequest
 from .handlers.parsers import make_parser
 from .metrics import (
     DEADLINE_EXCEEDED_TOTAL,
+    KV_TRANSFER_EXPOSED_MS,
     KV_TRANSFER_MS,
     POOL_AVG_KV_CACHE,
     POOL_AVG_QUEUE,
@@ -1576,6 +1577,16 @@ class Gateway:
                     resp.headers.get("x-kv-transfer-ms"))
                 if v is not None and v > 0:
                     wf.kv_transfer_ms = v
+                    # Pipelined P/D pulls stamp exposed (non-overlapped)
+                    # time separately: the waterfall's kv_transfer stage
+                    # holds ONLY the exposed cost so stage sums reconcile
+                    # against TTFT, with the hidden remainder in
+                    # overlap_ms (excluded from accounted_ms()).
+                    ve = finite_float_or_none(
+                        resp.headers.get("x-kv-transfer-exposed-ms"))
+                    if ve is not None and 0 <= ve <= v:
+                        wf.kv_transfer_ms = ve
+                        wf.overlap_ms = v - ve
                 v = finite_float_or_none(
                     resp.headers.get("x-kv-transfer-bytes"))
                 if v is not None:
@@ -1743,16 +1754,30 @@ class Gateway:
             return None
         pull_ms = finite_float_or_none(pull)
         prefill_ms = finite_float_or_none(prefill)
+        # Exposed (non-overlapped) pull cost from pipelined P/D pulls.
+        # Clamped into [0, pull_ms] — both stamps ride the same engine
+        # clock, so anything outside that range is a malformed relay, and
+        # landing it would poison the exposed EWMA pair scorers read.
+        exposed_ms = finite_float_or_none(
+            resp_headers.get("x-kv-transfer-exposed-ms"))
+        if exposed_ms is not None and (
+                pull_ms is None or not 0 <= exposed_ms <= pull_ms):
+            exposed_ms = None
         nbytes = finite_float_or_none(resp_headers.get("x-kv-transfer-bytes"))
         nbytes = int(nbytes) if nbytes is not None else None
         decode = endpoint.metadata.address_port
         self.datastore.transfers.record(prefiller, decode, pull_ms=pull_ms,
-                                        nbytes=nbytes, prefill_ms=prefill_ms)
+                                        nbytes=nbytes, prefill_ms=prefill_ms,
+                                        exposed_ms=exposed_ms)
         if pull_ms is not None:
             KV_TRANSFER_MS.observe(pull_ms)
+        if exposed_ms is not None:
+            KV_TRANSFER_EXPOSED_MS.observe(exposed_ms)
         row: dict[str, Any] = {"prefill": prefiller, "decode": decode}
         if pull_ms is not None:
             row["pull_ms"] = pull_ms
+        if exposed_ms is not None:
+            row["exposed_ms"] = exposed_ms
         if nbytes is not None:
             row["bytes"] = nbytes
         if prefill_ms is not None:

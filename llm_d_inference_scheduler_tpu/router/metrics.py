@@ -187,6 +187,13 @@ KV_TRANSFER_MS = Histogram(
     "through the sidecar (per-pair EWMA table at /debug/transfers)",
     registry=REGISTRY,
     buckets=(1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000, 2500))
+KV_TRANSFER_EXPOSED_MS = Histogram(
+    "router_kv_transfer_exposed_ms",
+    "Per-request KV pull time NOT hidden behind prefill compute on pipelined "
+    "P/D requests (raw pull minus overlap; the cost pair scorers/rebalancer "
+    "read). Absent on serial 2-phase pulls, where exposed == raw.",
+    registry=REGISTRY,
+    buckets=(1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000, 2500))
 # Goodput-max overload control (router/overload.py): predictive SLO
 # admission, degrade ladder, Retry-After shedding, and predicted-unmeetable
 # queue eviction. Reason/action label sets are fixed small enums.

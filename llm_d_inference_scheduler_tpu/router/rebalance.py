@@ -614,8 +614,13 @@ class RebalanceController:
             if table is not None:
                 for peer in peers:
                     stats = table.pair(addr, peer)
-                    if stats is not None and stats.ewma_pull_ms is not None:
-                        pulls[peer] = round(stats.ewma_pull_ms, 3)
+                    if stats is not None:
+                        # Exposed-preferred (cost_ms): a pull hidden behind
+                        # pipelined prefill compute should not make a pair
+                        # look expensive to the rebalancer.
+                        cost = stats.cost_ms()
+                        if cost is not None:
+                            pulls[peer] = round(cost, 3)
             load = (ep.metrics.waiting_queue_size
                     + ep.metrics.running_requests_size)
             mean = (sum(pulls.values()) / len(pulls)) if pulls else None

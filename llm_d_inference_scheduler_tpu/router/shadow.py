@@ -83,13 +83,18 @@ def transfer_pair_scores(table: Any, decode: str,
 
     Returns None when NO candidate pair has a measured pull EWMA (no
     signal — the policy abstains rather than scoring noise); pairs without
-    their own row score ``UNMEASURED_PAIR_SCORE``.
+    their own row score ``UNMEASURED_PAIR_SCORE``. Costs are the pair's
+    EXPOSED pull EWMA when pipelined observations exist (``cost_ms``):
+    scoring the raw wall time would penalize a pair whose transfer hides
+    entirely behind prefill compute.
     """
     costs: dict[str, float] = {}
     for p in candidates:
         stats = table.pair(p, decode)
-        if stats is not None and stats.ewma_pull_ms is not None:
-            costs[p] = stats.ewma_pull_ms
+        if stats is not None:
+            cost = stats.cost_ms()
+            if cost is not None:
+                costs[p] = cost
     if not costs:
         return None
     lo, hi = min(costs.values()), max(costs.values())
@@ -229,13 +234,16 @@ class TransferAwarePairPolicy:
         table = self.datastore.transfers
         decode = entry["live"]["decode"]
         transfer = outcome.get("transfer") or {}
-        live_ms = transfer.get("pull_ms")
+        # Pipelined pulls carry exposed (non-overlapped) time — the cost a
+        # request actually waited — beside the raw wall time; regret is
+        # computed in exposed terms so both arms price what TTFT paid.
+        live_ms = transfer.get("exposed_ms", transfer.get("pull_ms"))
         live_source = "measured"
         if live_ms is None:
             # Streamed responses carry no engine pull stats — fall back to
             # the live pair's own measured EWMA.
             stats = table.pair(entry["live"]["prefill"], decode)
-            live_ms = stats.ewma_pull_ms if stats is not None else None
+            live_ms = stats.cost_ms() if stats is not None else None
             live_source = "ewma"
         if entry["verdict"] == "agree":
             judged: dict[str, Any] = {"agreed": True}
@@ -249,7 +257,7 @@ class TransferAwarePairPolicy:
             return ("agree",
                     live_ms if live_source == "measured" else None)
         stats = table.pair(entry["shadow"]["prefill"], decode)
-        est_shadow = stats.ewma_pull_ms if stats is not None else None
+        est_shadow = stats.cost_ms() if stats is not None else None
         if live_ms is None or est_shadow is None:
             entry["judged"] = {"estimate": "unavailable"}
             return ("diverge", None)

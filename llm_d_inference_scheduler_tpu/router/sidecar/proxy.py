@@ -22,6 +22,7 @@ are honored (reference allowlist.go).
 
 from __future__ import annotations
 
+import asyncio
 import dataclasses
 import json
 import logging
@@ -79,6 +80,15 @@ class SidecarConfig:
     insecure_skip_verify_prefiller: bool = False
     insecure_skip_verify_decoder: bool = False
     insecure_skip_verify_encoder: bool = False
+    # Pipelined P/D (the ``pipeline: {enabled: ...}`` mode): pre-assign the
+    # prefill request id, fire the prefill leg concurrently, and dispatch
+    # the decode leg — with a chunk-streaming KV pull — as soon as the
+    # prefill engine acks first-chunk staging, so the transfer overlaps the
+    # remainder of prefill (docs/disaggregation.md §Pipelined KV streaming).
+    # Default OFF: the serial 2-phase path stays bit-identical (the
+    # vectorized/rebalance kill-switch precedent). Any pre-dispatch failure
+    # falls back to the serial candidate walk.
+    pipeline_enabled: bool = False
 
 
 class Sidecar:
@@ -151,6 +161,18 @@ class Sidecar:
             "through this sidecar (x-kv-pull-ms -> x-kv-transfer-ms)",
             registry=self.metrics_registry,
             buckets=(1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000, 2500))
+        self._h_kv_overlap = Histogram(
+            "sidecar_kv_overlap_ms",
+            "Per-request KV pull time hidden behind the prefill engine's "
+            "remaining compute on pipelined P/D requests (pull wall-time "
+            "minus exposed time; 0 on serial requests)",
+            registry=self.metrics_registry,
+            buckets=(1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000, 2500))
+        self._c_pipeline_fallback = Counter(
+            "sidecar_pipeline_fallbacks_total",
+            "Pipelined P/D attempts that fell back to the serial 2-phase "
+            "candidate walk (prefill leg failed or never acked a chunk)",
+            registry=self.metrics_registry)
 
     # ---- per-leg TLS (reference proxy.go:153-166) -----------------------
 
@@ -588,6 +610,16 @@ class Sidecar:
 
     async def _run_pd_protocol_inner(self, request, body, prefillers, span,
                                      deadline=None):
+        if self.cfg.pipeline_enabled:
+            resp = await self._run_pd_pipelined(request, body, prefillers,
+                                                span, deadline)
+            if resp is not None:
+                return resp
+            # Pipelined attempt failed BEFORE the decode leg was dispatched
+            # (prefill error / no ack): fall through to the serial
+            # candidate walk below — the client sees no error, and the
+            # fallback is visible via sidecar_pipeline_fallbacks_total and
+            # the span's pipeline_fallback attribute.
         t0 = time.monotonic()
         prefill_body = dict(body)
         prefill_body["kv_transfer_params"] = {"do_remote_decode": True}
@@ -664,6 +696,136 @@ class Sidecar:
                                            extra_headers=extra,
                                            deadline=deadline)
 
+    async def _run_pd_pipelined(self, request, body, prefillers, span,
+                                deadline=None):
+        """Pipelined P/D handoff (``pipeline_enabled``): pre-assign the
+        prefill request id so the export record is addressable before the
+        prefill response exists, fire the prefill leg concurrently, long-poll
+        the prefill engine's ``/kv/{rid}?ack=1`` surface for first-chunk
+        staging, and dispatch the decode leg — whose engine pulls KV chunk k
+        while the prefill engine computes chunk k+1 — the moment the ack
+        lands. Returns the client response, or None to fall back to the
+        serial candidate walk (nothing was dispatched decode-side yet, so
+        the fallback is invisible to the client). A prefill engine that dies
+        AFTER decode dispatch is the decode engine's problem: its chunk poll
+        404s and it degrades to local prefill (zero client-visible errors —
+        the chaos drill's contract)."""
+        import uuid as _uuid
+
+        t0 = time.monotonic()
+        prefiller = prefillers[0]
+        rid = str(body.get("request_id")
+                  or f"pd-{_uuid.uuid4().hex[:12]}")
+        prefill_body = dict(body)
+        prefill_body["request_id"] = rid
+        prefill_body["kv_transfer_params"] = {"do_remote_decode": True,
+                                              "stream_chunks": True}
+        prefill_body["stream"] = False
+        prefill_body[self._max_tokens_field(request.path)] = 1
+        timeout = self.cfg.prefill_timeout_s
+        headers = self._trace_headers()
+        if deadline is not None:
+            timeout = max(min(timeout, deadline.remaining_s), 0.001)
+            headers[H_REQUEST_TIMEOUT] = deadline.header_value()
+
+        async def _prefill_leg():
+            r = await self._prefill_client.post(
+                self._prefill_base(prefiller) + request.path,
+                json=prefill_body, headers=headers, timeout=timeout)
+            return r, (time.monotonic() - t0) * 1e3
+
+        task = asyncio.get_running_loop().create_task(_prefill_leg())
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._bg_tasks.discard)
+
+        if not await self._await_first_chunk(prefiller, rid, task, deadline):
+            self._c_pipeline_fallback.inc()
+            span.set_attribute("pipeline_fallback", True)
+            self._reap_pipelined_prefill(prefiller, rid, task)
+            return None
+
+        span.set_attribute("prefill_endpoint", prefiller)
+        span.set_attribute("pipelined", True)
+        host, _, port = prefiller.rpartition(":")
+        decode_body = dict(body)
+        decode_body["kv_transfer_params"] = {
+            "remote_host": host, "remote_port": int(port),
+            "remote_request_id": rid, "stream_chunks": True,
+            "remote_scheme": ("https" if self.cfg.use_tls_for_prefiller
+                              else "http"),
+        }
+        resp = await self._dispatch_decode(
+            request, decode_body,
+            extra_headers={"x-kv-prefiller": prefiller}, deadline=deadline)
+
+        # The prefill leg necessarily finished before the decode engine's
+        # final chunk pull, so stamping its timing/hit headers here adds no
+        # wall-clock — but a prepared stream's headers are already on the
+        # wire (same loss as the serial path's pull headers on streams).
+        try:
+            r, prefill_ms = await asyncio.wait_for(asyncio.shield(task),
+                                                   timeout=10.0)
+        except Exception:
+            return resp
+        if not resp.prepared:
+            resp.headers["x-prefill-duration-ms"] = f"{prefill_ms:.1f}"
+            if r.status_code == 200:
+                for h in ("x-kv-hit-blocks", "x-kv-hit-tokens"):
+                    v = r.headers.get(h)
+                    if v is not None:
+                        resp.headers[h] = v
+        span.set_attribute("prefill_duration_ms", round(prefill_ms, 1))
+        return resp
+
+    async def _await_first_chunk(self, prefiller: str, rid: str, task,
+                                 deadline=None) -> bool:
+        """Bounded long-poll for first-chunk staging on the prefill engine.
+        True once any chunk is staged (or the whole prefill completed —
+        engines that never chunk still ack at completion); False when the
+        prefill leg failed or the budget ran out (caller falls back)."""
+        bound = self.cfg.prefill_timeout_s
+        if deadline is not None:
+            bound = max(min(bound, deadline.remaining_s), 0.001)
+        t_end = time.monotonic() + bound
+        url = self._prefill_base(prefiller) + f"/kv/{rid}"
+        while time.monotonic() < t_end:
+            if task.done():
+                try:
+                    r, _ = task.result()
+                except Exception:
+                    return False
+                return r.status_code == 200
+            try:
+                r = await self._prefill_client.get(
+                    url, params={"ack": "1", "wait_ms": 500}, timeout=5.0)
+                if r.status_code == 200:
+                    return True
+            except Exception:
+                pass  # engine booting / mid-restart: keep polling in budget
+            await asyncio.sleep(0.01)
+        return False
+
+    def _reap_pipelined_prefill(self, prefiller: str, rid: str, task) -> None:
+        """Fallback cleanup: let the stray prefill leg drain in the
+        background, then release whatever export it staged (best-effort —
+        the engine's TTL sweep is the backstop)."""
+
+        async def _reap():
+            try:
+                await task
+            except Exception:
+                pass
+            try:
+                await self._prefill_client.delete(
+                    self._prefill_base(prefiller) + f"/kv/{rid}",
+                    timeout=5.0)
+            except Exception:
+                pass
+
+        t = asyncio.get_running_loop().create_task(_reap())
+        self._bg_tasks.add(t)
+        t.add_done_callback(self._bg_tasks.discard)
+
     async def _dispatch_decode(self, request: web.Request, body: dict[str, Any],
                                extra_headers: dict[str, str] | None = None,
                                deadline: Deadline | None = None
@@ -715,6 +877,15 @@ class Sidecar:
             v = finite_float_or_none(pull_ms)
             if v is not None:
                 self._h_kv_transfer.observe(v)
+            # Pipelined pulls also report the NON-overlapped tail: relay it
+            # (x-kv-transfer-exposed-ms → the router's exposed pair EWMAs)
+            # and observe how much transfer time the overlap hid.
+            exposed_ms = resp.headers.get("x-kv-pull-exposed-ms")
+            if exposed_ms:
+                out_headers["x-kv-transfer-exposed-ms"] = exposed_ms
+                ve = finite_float_or_none(exposed_ms)
+                if v is not None and ve is not None:
+                    self._h_kv_overlap.observe(max(v - ve, 0.0))
         # Relay the decode engine's measured admission wait (same
         # non-streaming caveat) so the router's tail waterfall can split
         # engine queueing out of the decode residual (router/tails.py).
@@ -966,6 +1137,10 @@ def main(argv: list[str] | None = None):
     p.add_argument("--enable-prefiller-sampling", action="store_true",
                    help="sample a random prefiller from the candidate list "
                         "instead of the first (chat_completions.go:89)")
+    p.add_argument("--pipeline", action="store_true",
+                   help="pipelined P/D: dispatch the decode leg on first-"
+                        "chunk staging so the KV pull overlaps prefill "
+                        "(docs/disaggregation.md); default serial 2-phase")
     p.add_argument("--secure-serving", action="store_true",
                    help="serve HTTPS; without --cert-path a self-signed "
                         "certificate is minted (proxy_helpers.go:55-100)")
@@ -989,6 +1164,7 @@ def main(argv: list[str] | None = None):
         cache_hit_threshold=args.cache_hit_threshold,
         bootstrap_port=args.bootstrap_port,
         enable_prefiller_sampling=args.enable_prefiller_sampling,
+        pipeline_enabled=args.pipeline,
         secure_serving=args.secure_serving,
         cert_path=args.cert_path,
         enable_cert_reload=args.enable_cert_reload,

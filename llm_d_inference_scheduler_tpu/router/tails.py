@@ -105,8 +105,8 @@ class RequestWaterfall:
 
     __slots__ = ("request_id", "model", "band", "t_start",
                  "queue_ms", "sched_ms", "attempts_ms", "engine_queue_ms",
-                 "prefill_ms", "kv_transfer_ms", "kv_bytes", "pair",
-                 "endpoint", "shed_rung", "done")
+                 "prefill_ms", "kv_transfer_ms", "overlap_ms", "kv_bytes",
+                 "pair", "endpoint", "shed_rung", "done")
 
     def __init__(self, request_id: str, model: str, band: int,
                  t_start: float):
@@ -120,6 +120,11 @@ class RequestWaterfall:
         self.engine_queue_ms = 0.0
         self.prefill_ms = 0.0
         self.kv_transfer_ms = 0.0
+        # Pipelined-P/D pull time hidden behind prefill compute (raw pull −
+        # exposed). Informational: kv_transfer_ms already holds only the
+        # EXPOSED cost, so overlap is excluded from accounted_ms() — adding
+        # it would double-count the hidden portion against TTFT.
+        self.overlap_ms = 0.0
         self.kv_bytes = 0
         self.pair: str | None = None
         self.endpoint = ""
@@ -487,6 +492,11 @@ class TailsObservatory:
                 block["ttft_ms"] = round(ttft_ms, 3)
             if wf.pair:
                 block["pair"] = wf.pair
+            if wf.overlap_ms > 0.0:
+                # Pull time hidden behind pipelined prefill: kept OUT of
+                # the stage sums (kv_transfer above is exposed-only) so
+                # stages still reconcile against ttft_ms.
+                block["overlap_ms"] = round(wf.overlap_ms, 3)
             if wf.shed_rung:
                 block["rung"] = wf.shed_rung
             if tail:

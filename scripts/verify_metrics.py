@@ -138,6 +138,11 @@ REQUIRED_FAMILIES = {
     # behind /debug/tails.
     ("router_stage_ms", "router"),
     ("router_tail_dominant_stage", "router"),
+    # Pipelined P/D disaggregation (ISSUE 20): the sidecar's hidden-pull
+    # (overlap) histogram and the router's exposed-transfer-cost landing —
+    # the cost the pair scorer, shadow judge, and rebalancer read.
+    ("sidecar_kv_overlap_ms", "sidecar"),
+    ("router_kv_transfer_exposed_ms", "router"),
 }
 
 # Registries whose every family must have a docs/metrics.md row (the

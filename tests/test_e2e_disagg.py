@@ -743,3 +743,230 @@ schedulingProfiles:
             await dec.stop()
 
     asyncio.run(body())
+
+
+def test_pd_pipeline_token_parity_exposed_cost_and_waterfall():
+    """Pipelined P/D (ISSUE 20): with `pipeline_enabled` the sidecar
+    dispatches the decode leg on first-chunk ack and the decode engine
+    chunk-streams the KV while prefill computes. Gates: token parity with
+    the serial 2-phase arm; the serial arm's response headers bit-identical
+    to the pre-PR protocol (no exposed stamp — kill-switch contract); the
+    pipelined response carries x-kv-transfer-exposed-ms <= x-kv-transfer-ms;
+    the waterfall's kv_transfer stage holds the EXPOSED cost so stage sums
+    still reconcile vs TTFT; /debug/transfers lands the exposed EWMA."""
+    GW9, SC9, SC9P, DEC9, PRE9 = 18860, 18861, 18862, 18863, 18864
+
+    cfg = f"""
+featureGates: {{flowControl: true}}
+pool:
+  endpoints:
+    - {{address: 127.0.0.1, port: {SC9P}, labels: {{llm-d.ai/role: decode}}}}
+    - {{address: 127.0.0.1, port: {PRE9}, labels: {{llm-d.ai/role: prefill}}}}
+plugins:
+  - {{type: decode-filter}}
+  - {{type: prefill-filter}}
+  - {{type: queue-scorer}}
+  - type: disagg-profile-handler
+    parameters:
+      pdDecider: always-disagg-pd-decider
+schedulingProfiles:
+  - name: decode
+    plugins:
+      - {{pluginRef: decode-filter}}
+      - {{pluginRef: queue-scorer}}
+  - name: prefill
+    plugins:
+      - {{pluginRef: prefill-filter}}
+      - {{pluginRef: queue-scorer}}
+"""
+
+    async def body():
+        dec = _engine(DEC9, "decode")
+        pre = _engine(PRE9, "prefill")
+        await dec.start()
+        await pre.start()
+        sc_serial = Sidecar(SidecarConfig(
+            port=SC9, decoder_url=f"http://127.0.0.1:{DEC9}",
+            ssrf_allowlist=[f"127.0.0.1:{PRE9}"]))
+        sc_pipe = Sidecar(SidecarConfig(
+            port=SC9P, decoder_url=f"http://127.0.0.1:{DEC9}",
+            ssrf_allowlist=[f"127.0.0.1:{PRE9}"],
+            pipeline_enabled=True))
+        await sc_serial.start()
+        await sc_pipe.start()
+        gw = build_gateway(cfg, port=GW9, poll_interval=0.02)
+        await gw.start()
+        try:
+            async with httpx.AsyncClient(timeout=120) as c:
+                # Pipelined arm through the gateway, cold caches: the
+                # decode leg MUST chunk-stream the KV (a warm decode-side
+                # prefix would skip the pull and hide the transfer).
+                r = await c.post(
+                    f"http://127.0.0.1:{GW9}/v1/completions",
+                    json={"model": "tiny", "prompt": LONG_PROMPT,
+                          "max_tokens": 6, "temperature": 0},
+                    headers={"x-request-id": "pipe-gold-1"})
+                assert r.status_code == 200
+                pipe_text = r.json()["choices"][0]["text"]
+
+                # Token parity: the serial 2-phase arm over the same
+                # prompt (prefixes now warm — that changes timing, never
+                # greedy logits) produces the identical continuation.
+                r = await c.post(
+                    f"http://127.0.0.1:{SC9}/v1/completions",
+                    json={"prompt": LONG_PROMPT, "max_tokens": 6,
+                          "temperature": 0},
+                    headers={"x-prefiller-host-port": f"127.0.0.1:{PRE9}"})
+                assert r.status_code == 200, r.text
+                assert r.json()["choices"][0]["text"] == pipe_text
+
+                # Kill-switch contract on a cold prompt: the serial
+                # sidecar's headers stay bit-identical to the pre-pipeline
+                # protocol — raw pull stamped, NO exposed stamp.
+                r = await c.post(
+                    f"http://127.0.0.1:{SC9}/v1/completions",
+                    json={"prompt": "a different saga about container "
+                          "fleets sailing the high seas " * 4,
+                          "max_tokens": 6, "temperature": 0},
+                    headers={"x-prefiller-host-port": f"127.0.0.1:{PRE9}"})
+                assert r.status_code == 200
+                assert float(r.headers["x-kv-transfer-ms"]) > 0
+                assert "x-kv-transfer-exposed-ms" not in r.headers
+
+                # Waterfall: the gateway consumed the transfer headers
+                # (they are not relayed to clients) — kv_transfer carries
+                # the EXPOSED cost, overlap_ms rides beside it excluded
+                # from the accounted sum, and stage sums still reconcile
+                # vs TTFT (no double-counted transfer time). overlap_ms
+                # present at all proves the chunk-streamed pull ran: the
+                # serial 2-phase path never stamps an exposed split.
+                rec = (await c.get(f"http://127.0.0.1:{GW9}"
+                                   "/debug/decisions/pipe-gold-1")).json()
+                wf = rec["waterfall"]
+                assert wf["verdict"] == "ok"
+                st = wf["stages"]
+                exposed = st.get("kv_transfer", 0.0)
+                overlap = wf["overlap_ms"]
+                assert exposed >= 0 and overlap > 0
+                assert abs(sum(st.values()) - wf["ttft_ms"]) < 10.0
+                assert wf["pair"] == f"127.0.0.1:{PRE9}→127.0.0.1:{SC9P}"
+
+                # The pair EWMA table landed the exposed cost beside the
+                # raw pull EWMA.
+                tr = (await c.get(
+                    f"http://127.0.0.1:{GW9}/debug/transfers")).json()
+                pair = next(p for p in tr["pairs"]
+                            if p["prefill"] == f"127.0.0.1:{PRE9}"
+                            and p["decode"] == f"127.0.0.1:{SC9P}")
+                assert pair["pulls"] >= 1
+                assert pair["ewma_pull_ms"] > 0
+                assert pair["exposed_ms"] <= pair["ewma_pull_ms"]
+
+                # And the new histogram families observed the request.
+                m = (await c.get(f"http://127.0.0.1:{GW9}/metrics")).text
+                v = next(ln.split()[-1] for ln in m.splitlines()
+                         if ln.startswith("router_kv_transfer_exposed_ms_count"))
+                assert float(v) >= 1
+                ms = (await c.get(
+                    f"http://127.0.0.1:{SC9P}/metrics")).text
+                v = next(ln.split()[-1] for ln in ms.splitlines()
+                         if ln.startswith("sidecar_kv_overlap_ms_count"))
+                assert float(v) >= 1
+        finally:
+            await gw.stop()
+            await sc_pipe.stop()
+            await sc_serial.stop()
+            await pre.stop()
+            await dec.stop()
+
+    asyncio.run(body())
+
+
+def test_kv_chunk_longpoll_timeout_and_gap_edges():
+    """The /kv chunk surface's protocol edges (ISSUE 20): a bounded
+    long-poll for a not-yet-staged chunk expires 202 (not a hang, not an
+    error); a chunk index past the end of a COMPLETE export answers 204
+    with the final metadata; an unknown rid 404s even with a wait; the ack
+    probe releases as soon as the first chunk stages."""
+    E10 = 18865
+
+    async def body():
+        # Slow streamed prefill: 64 tokens at 10 ms/token over 16-token
+        # windows -> 4 chunks ~160 ms apart, plenty to observe mid-stream.
+        srv = EngineServer(EngineConfig(
+            backend="sim", model="tiny", port=E10, max_batch=4,
+            prefill_chunk=16, sim_prefill_ms_per_token=10.0))
+        await srv.start()
+        try:
+            async with httpx.AsyncClient(timeout=30) as c:
+                gen = asyncio.create_task(c.post(
+                    f"http://127.0.0.1:{E10}/v1/completions",
+                    json={"prompt": list(range(3, 67)), "max_tokens": 1,
+                          "request_id": "lp-1",
+                          "kv_transfer_params": {"do_remote_decode": True,
+                                                 "stream_chunks": True}}))
+                base = f"http://127.0.0.1:{E10}/kv/lp-1"
+                # Unknown rid (export not created yet is indistinguishable
+                # from never-existed): bounded wait, then 404.
+                r = await c.get(f"http://127.0.0.1:{E10}/kv/nope",
+                                params={"chunk": 0, "wait_ms": 30})
+                assert r.status_code == 404
+
+                # Ack long-poll: 200 the moment the first chunk stages.
+                t0 = asyncio.get_event_loop().time()
+                while True:
+                    r = await c.get(base, params={"ack": "1",
+                                                  "wait_ms": 1000})
+                    if r.status_code == 200:
+                        break
+                    assert r.status_code in (202, 404)
+                    assert asyncio.get_event_loop().time() - t0 < 20
+                assert int(r.headers["x-kv-chunks-staged"]) >= 1
+
+                # A far-future chunk with a short wait: 202 (mid-stream,
+                # chunk not staged yet), carrying the staging progress.
+                r = await c.get(base, params={"chunk": 30, "wait_ms": 40})
+                if r.headers.get("x-kv-complete") != "1":
+                    assert r.status_code == 202
+                    assert int(r.headers["x-kv-chunks-staged"]) < 30
+
+                # Chunk 0 is staged: served immediately (sim: headers only).
+                r = await c.get(base, params={"chunk": 0, "wait_ms": 100})
+                assert r.status_code == 200
+                assert r.headers["x-kv-chunk"] == "0"
+                assert int(r.headers["x-kv-chunk-blocks"]) >= 1
+
+                resp = await gen
+                assert resp.status_code == 200
+
+                # Complete export: a past-the-end chunk answers 204 with
+                # the terminal metadata (the puller's stop signal).
+                # Long-poll until the completion flag lands.
+                t0 = asyncio.get_event_loop().time()
+                while True:
+                    r = await c.get(base, params={"chunk": 99,
+                                                  "wait_ms": 500})
+                    if r.status_code == 204:
+                        break
+                    assert asyncio.get_event_loop().time() - t0 < 20
+                assert r.headers["x-kv-complete"] == "1"
+                staged = int(r.headers["x-kv-chunks-staged"])
+                assert staged >= 2
+                assert int(r.headers["x-kv-blocks-staged"]) >= 4
+
+                # Every staged chunk is individually addressable.
+                blocks = 0
+                for i in range(staged):
+                    r = await c.get(base, params={"chunk": i})
+                    assert r.status_code == 200
+                    blocks += int(r.headers["x-kv-chunk-blocks"])
+                assert blocks == int(r.headers["x-kv-blocks-staged"])
+
+                r = await c.delete(base)
+                assert r.status_code == 200
+                r = await c.get(base, params={"chunk": 0, "wait_ms": 10})
+                assert r.status_code == 404
+        finally:
+            await srv.stop()
+
+    asyncio.run(body())

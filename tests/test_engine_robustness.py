@@ -442,3 +442,98 @@ def test_drain_gate_waits_for_staged_kv_export():
             await eng.stop()
 
     run(body())
+
+
+def test_chunk_streamed_export_record_shape_and_drain_gate():
+    """Pipelined P/D: a ``stream_chunks`` prefill stages its KV incrementally
+    into the export record (chunk_blocks/chunks_staged/blocks_staged/complete
+    state machine, chunk data aligned with the counters), and the SIGTERM
+    drain gate pins the chunk-staged export exactly like a legacy one — a
+    decode peer may still be mid-chunk-stream."""
+    async def body():
+        from llm_d_inference_scheduler_tpu.engine.core import TpuEngine
+
+        eng = TpuEngine(_cfg("tpu", 0, role="prefill", prefill_chunk=16))
+        await eng.start()
+        try:
+            assert eng.idle()
+            req = EngineRequest(
+                request_id="chunk-exp",
+                prompt_token_ids=list(range(3, 52)),  # 49 tokens, 4 blocks
+                max_tokens=1,
+                kv_transfer_params={"do_remote_decode": True,
+                                    "stream_chunks": True})
+            out = eng.submit(req)
+            while True:
+                ev = await asyncio.wait_for(out.get(), timeout=30)
+                if ev.finish_reason is not None:
+                    break
+            assert ev.kv_transfer_params is not None
+            rec = eng.kv_exports["chunk-exp"]
+            # Record shape: counters and staged data agree, and the record
+            # reads complete exactly once finalized.
+            assert rec["complete"] is True
+            assert rec["chunks_staged"] >= 2  # 16-token windows really chunked
+            assert len(rec["chunk_blocks"]) == rec["chunks_staged"]
+            assert len(rec["chunk_data"]) == rec["chunks_staged"]
+            assert sum(rec["chunk_blocks"]) == rec["blocks_staged"]
+            assert rec["blocks_staged"] == rec["num_blocks"]
+            for (k_np, v_np), cb in zip(rec["chunk_data"],
+                                        rec["chunk_blocks"]):
+                assert k_np.shape[1] == cb and v_np.shape[1] == cb
+            # Reassembled chunk bytes == the legacy full-payload serve.
+            import numpy as np
+            k_all = np.concatenate([k for k, _ in rec["chunk_data"]], axis=1)
+            assert k_all.shape[1] == rec["num_blocks"]
+            assert np.array_equal(k_all, np.asarray(rec["k"]))
+            # Drain gate: the chunk-staged export pins idle() until released.
+            assert not eng.idle()
+            eng.release_kv_export("chunk-exp")
+            for _ in range(100):
+                if eng.idle():
+                    break
+                await asyncio.sleep(0.05)
+            assert eng.idle()
+        finally:
+            await eng.stop()
+
+    run(body())
+
+
+def test_partial_chunk_export_dropped_on_abort():
+    """A chunk-streamed prefill aborted mid-stream must not leave a
+    partially-staged (complete=False) export behind: the decode peer's next
+    poll 404s (it degrades to local prefill) and the drain gate is not
+    pinned forever by a record no peer will ever release."""
+    async def body():
+        from llm_d_inference_scheduler_tpu.engine.core import TpuEngine
+
+        eng = TpuEngine(_cfg("tpu", 0, role="prefill", prefill_chunk=16))
+        await eng.start()
+        try:
+            req = EngineRequest(
+                request_id="chunk-abort",
+                prompt_token_ids=list(range(3, 120)),
+                max_tokens=1,
+                kv_transfer_params={"do_remote_decode": True,
+                                    "stream_chunks": True})
+            out = eng.submit(req)
+            # Abort while the prefill windows are still being written.
+            eng.abort("chunk-abort")
+            while True:
+                ev = await asyncio.wait_for(out.get(), timeout=30)
+                if ev.finish_reason is not None:
+                    break
+            for _ in range(100):
+                if eng.idle():
+                    break
+                await asyncio.sleep(0.05)
+            # Whatever was staged before the abort is gone (incomplete
+            # records are dropped; a COMPLETE export would be kept).
+            rec = eng.kv_exports.get("chunk-abort")
+            assert rec is None or rec.get("complete", True)
+            assert eng.idle()
+        finally:
+            await eng.stop()
+
+    run(body())

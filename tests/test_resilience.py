@@ -729,3 +729,117 @@ def test_prefiller_candidates_full_list_and_rotation():
     sampling._prefill_sampler = lambda n: 1
     assert sampling._prefiller_candidates(r) == ["b:2", "c:3", "a:1"]
     assert sampling._pick_prefiller(r) == "b:2"
+
+
+def test_chaos_pipelined_prefill_503_serial_fallback_zero_errors():
+    """Chaos drill (ISSUE 20): every prefill answers 503, the sidecar runs
+    in pipelined mode. The pipelined handoff aborts BEFORE the decode leg
+    dispatches (first-chunk ack never lands), falls back to the serial
+    candidate walk — which also finds the prefiller dead and degrades to
+    local decode. The client sees 200 every time; the fallback is counted
+    on sidecar_pipeline_fallbacks_total and the request's DecisionRecord
+    still carries the full attempt trail."""
+    GW, SC, DEC, PRE = 18918, 18919, 18920, 18921
+    cfg = f"""
+pool:
+  endpoints:
+    - {{address: 127.0.0.1, port: {SC}, labels: {{llm-d.ai/role: decode}}}}
+    - {{address: 127.0.0.1, port: {PRE}, labels: {{llm-d.ai/role: prefill}}}}
+plugins:
+  - {{type: decode-filter}}
+  - {{type: prefill-filter}}
+  - {{type: queue-scorer}}
+  - type: disagg-profile-handler
+    parameters:
+      pdDecider: always-disagg-pd-decider
+schedulingProfiles:
+  - name: decode
+    plugins:
+      - {{pluginRef: decode-filter}}
+      - {{pluginRef: queue-scorer}}
+  - name: prefill
+    plugins:
+      - {{pluginRef: prefill-filter}}
+      - {{pluginRef: queue-scorer}}
+"""
+
+    async def body():
+        dec = await _sim(DEC)
+        pre = await _sim(PRE, chaos="http503:100", chaos_seed=CHAOS_SEED)
+        sc = Sidecar(SidecarConfig(port=SC,
+                                   decoder_url=f"http://127.0.0.1:{DEC}",
+                                   prefill_timeout_s=5.0,
+                                   pipeline_enabled=True))
+        await sc.start()
+        gw = build_gateway(cfg, port=GW, poll_interval=0.02)
+        await gw.start()
+        try:
+            async with httpx.AsyncClient(timeout=60) as c:
+                for i in range(4):
+                    r = await c.post(
+                        f"http://127.0.0.1:{GW}/v1/completions",
+                        json={"model": "tiny", "prompt": "drill " * 8,
+                              "max_tokens": 2},
+                        headers={"x-request-id": f"chaos-pipe-{i}"})
+                    assert r.status_code == 200, r.text
+                m = (await c.get(f"http://127.0.0.1:{SC}/metrics")).text
+                assert _metric_value(
+                    m, "sidecar_pipeline_fallbacks_total") >= 4
+                # The attempt trail survives: the router's DecisionRecord
+                # for a drilled request shows the disagg round that picked
+                # the (doomed) prefiller — the fallback is explainable.
+                r = await c.get(
+                    f"http://127.0.0.1:{GW}/debug/decisions/chaos-pipe-0")
+                assert r.status_code == 200
+                rec = r.json()
+                prof = rec["rounds"][0]["profiles"]
+                assert prof["prefill"]["outcome"] == "picked"
+                assert prof["decode"]["outcome"] == "picked"
+        finally:
+            await gw.stop()
+            await sc.stop()
+            await pre.stop()
+            await dec.stop()
+
+    run(body())
+
+
+def test_chaos_prefiller_killed_mid_chunk_stream_zero_errors():
+    """Chaos drill (ISSUE 20): the prefill engine DIES mid-chunk-stream,
+    after the decode leg already dispatched against its partial export.
+    The decode engine's chunk poll hits connection errors, abandons the
+    import, and degrades to local prefill — the client still sees a 200
+    with the full completion (zero client-visible errors)."""
+    SC, DEC, PRE = 18922, 18923, 18924
+
+    async def body():
+        dec = await _sim(DEC)
+        # Slow, chunked prefill: 64 tokens at 20 ms/token over 8-token
+        # windows -> first chunk staged ~160 ms in, export complete only
+        # at ~1.3 s. Killing the server at ~450 ms lands mid-stream.
+        pre = await _sim(PRE, role="prefill", prefill_chunk=8,
+                         sim_prefill_ms_per_token=20.0)
+        sc = Sidecar(SidecarConfig(port=SC,
+                                   decoder_url=f"http://127.0.0.1:{DEC}",
+                                   prefill_timeout_s=10.0,
+                                   pipeline_enabled=True))
+        await sc.start()
+        try:
+            async with httpx.AsyncClient(timeout=60) as c:
+                req = asyncio.create_task(c.post(
+                    f"http://127.0.0.1:{SC}/v1/completions",
+                    json={"prompt": list(range(3, 67)), "max_tokens": 2},
+                    headers={"x-prefiller-host-port":
+                             f"127.0.0.1:{PRE}"}))
+                await asyncio.sleep(0.45)
+                await pre.stop()  # mid-stream kill
+                r = await req
+                assert r.status_code == 200, r.text
+                out = r.json()
+                assert out["usage"]["completion_tokens"] == 2
+                assert out["usage"]["prompt_tokens"] == 64
+        finally:
+            await sc.stop()
+            await dec.stop()
+
+    run(body())
